@@ -1,0 +1,63 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capabilities
+of PaddlePaddle Fluid (reference: codeWorm2015/Paddle @ /root/reference).
+
+Declarative Program/Block/Op IR + layers API like `paddle.fluid`, but the
+execution engine lowers whole programs to single jitted XLA computations
+(MXU-shaped kernels, lax control flow, pjit/shard_map distribution) instead
+of per-op CUDA kernel dispatch.
+
+Typical use — identical in shape to fluid:
+
+    import paddle_tpu as fluid
+    x = fluid.layers.data(name="x", shape=[784])
+    y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+    h = fluid.layers.fc(x, 128, act="relu")
+    logits = fluid.layers.fc(h, 10)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, y))
+    fluid.optimizer.Adam(1e-3).minimize(loss)
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(fluid.default_startup_program())
+    exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
+"""
+
+# kernels register themselves on import
+from .ops import math as _k_math  # noqa: F401
+from .ops import nn as _k_nn  # noqa: F401
+from .ops import rnn as _k_rnn  # noqa: F401
+from .ops import optim as _k_optim  # noqa: F401
+from .ops import sequence as _k_sequence  # noqa: F401
+from .ops import metric as _k_metric  # noqa: F401
+
+from .framework import (  # noqa: F401
+    Block,
+    CPUPlace,
+    CUDAPlace,
+    Operator,
+    Parameter,
+    Program,
+    Scope,
+    TPUPlace,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    global_scope,
+    grad_var_name,
+    name_scope,
+    program_guard,
+    scope_guard,
+    switch_main_program,
+    switch_startup_program,
+    unique_name,
+)
+from .executor import Executor  # noqa: F401
+from .backward import append_backward  # noqa: F401
+from . import layers  # noqa: F401
+from . import initializer  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import clip  # noqa: F401
+from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
+from .layer_helper import LayerHelper  # noqa: F401
+
+__version__ = "0.1.0"

@@ -1,0 +1,183 @@
+"""Executor: runs Programs on TPU as single jitted XLA computations.
+
+Reference: paddle/fluid/framework/executor.cc + python/paddle/fluid/
+executor.py. The reference interprets a ProgramDesc op-by-op, launching one
+device kernel per operator. Here `run()` compiles the whole main block into
+ONE `jax.jit` function
+
+    (feeds, state, rng_key) -> (fetches, new_state)
+
+with the persistable state (parameters, optimizer accumulators, BN running
+stats) donated, so parameter updates are in-place at the XLA buffer level —
+the TPU-native equivalent of the reference's in-place Scope writes. Compiled
+functions are cached on (program identity+version, feed signature, fetch
+names), matching the reference's `use_program_cache` executor cache.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .framework.core import Program, Variable, default_main_program
+from .framework.dtypes import as_numpy_dtype
+from .framework.scope import CPUPlace, Place, Scope, global_scope
+from .framework.trace import RngStream, trace_block
+
+__all__ = ["Executor"]
+
+
+def _as_feed_array(value, var: Optional[Variable]):
+    arr = np.asarray(value)
+    if var is not None:
+        want = as_numpy_dtype(var.dtype)
+        if arr.dtype != want:
+            arr = arr.astype(want)
+    return arr
+
+
+def _fetch_name(f) -> str:
+    return f.name if isinstance(f, Variable) else str(f)
+
+
+class _Compiled:
+    __slots__ = ("fn", "state_in_names", "state_out_names", "fetch_names", "program")
+
+    def __init__(self, fn, state_in_names, state_out_names, fetch_names, program):
+        self.fn = fn
+        self.state_in_names = state_in_names
+        self.state_out_names = state_out_names
+        self.fetch_names = fetch_names
+        # strong ref: the cache key uses id(program), so the program must
+        # stay alive for as long as the cache entry does (prevents id reuse)
+        self.program = program
+
+
+class Executor:
+    def __init__(self, place: Optional[Place] = None):
+        self.place = place if place is not None else CPUPlace()
+        self._cache: Dict = {}
+        self._step = 0
+        self._seed = 0
+
+    # -- compilation -----------------------------------------------------
+    def _analyze_state(self, program: Program, feed_names):
+        """Persistable vars read (state inputs) and written (state outputs)
+        by the program's ops."""
+        read, written = [], []
+        seen_r, seen_w = set(), set()
+        for block in program.blocks:
+            for op in block.ops:
+                for name in op.input_arg_names:
+                    var = block._find_var_recursive(name)
+                    if var is not None and var.persistable and name not in seen_r and name not in feed_names:
+                        seen_r.add(name)
+                        read.append(name)
+                for name in op.output_arg_names:
+                    var = block._find_var_recursive(name)
+                    if var is not None and var.persistable and name not in seen_w:
+                        seen_w.add(name)
+                        written.append(name)
+        return read, written
+
+    def _compile(self, program: Program, feed_sig, fetch_names, scope: Scope) -> _Compiled:
+        feed_names = tuple(n for n, _, _ in feed_sig)
+        state_in, state_out = self._analyze_state(program, set(feed_names))
+        # state vars written before ever being read (pure init, e.g. startup
+        # programs) need no input value
+        missing = [n for n in state_in if scope.find_var(n) is None]
+        if missing:
+            raise RuntimeError(
+                "persistable variables %s have no value in scope; run the "
+                "startup program first" % (missing,)
+            )
+
+        block = program.global_block()
+
+        def stepfn(feeds: Dict, state: Dict, rng_key):
+            env: Dict = {}
+            env.update(state)
+            env.update(feeds)
+            rng = RngStream(rng_key)
+            trace_block(block, env, rng)
+            fetches = []
+            for name in fetch_names:
+                if name not in env:
+                    raise KeyError(
+                        "fetch target %r was not produced by the program" % name
+                    )
+                fetches.append(env[name])
+            # Every donated state input must reappear as an output (XLA
+            # aliases unchanged ones straight through); otherwise the Scope
+            # would be left holding donated (invalidated) buffers.
+            out_names = set(state_in) | set(state_out)
+            new_state = {n: env[n] for n in out_names if n in env}
+            return tuple(fetches), new_state
+
+        fn = jax.jit(stepfn, donate_argnums=(1,))
+        return _Compiled(fn, state_in, state_out, fetch_names, program)
+
+    # -- public API ------------------------------------------------------
+    def run(
+        self,
+        program: Optional[Program] = None,
+        feed: Optional[Dict] = None,
+        fetch_list: Optional[Sequence] = None,
+        feed_var_name: str = "feed",
+        fetch_var_name: str = "fetch",
+        scope: Optional[Scope] = None,
+        return_numpy: bool = True,
+        use_program_cache: bool = True,
+    ):
+        if program is None:
+            program = default_main_program()
+        if scope is None:
+            scope = global_scope()
+        feed = feed or {}
+        fetch_list = list(fetch_list or [])
+        fetch_names = tuple(_fetch_name(f) for f in fetch_list)
+
+        gb = program.global_block()
+        feed_arrays = {}
+        for name, value in feed.items():
+            var = gb._find_var_recursive(name)
+            feed_arrays[name] = _as_feed_array(value, var)
+        feed_sig = tuple(
+            (name, arr.shape, str(arr.dtype)) for name, arr in sorted(feed_arrays.items())
+        )
+
+        key = (id(program), program._version, feed_sig, fetch_names)
+        compiled = self._cache.get(key) if use_program_cache else None
+        if compiled is None:
+            compiled = self._compile(program, feed_sig, fetch_names, scope)
+            if use_program_cache:
+                self._cache[key] = compiled
+
+        state = {}
+        for name in compiled.state_in_names:
+            val = scope.find_var(name)
+            if val is None:
+                raise RuntimeError(
+                    "persistable variable %r has no value in scope; run the "
+                    "startup program first" % name
+                )
+            state[name] = val
+
+        seed = program.random_seed if program.random_seed else self._seed
+        rng_key = jax.random.PRNGKey(seed)
+        rng_key = jax.random.fold_in(rng_key, self._step)
+        self._step += 1
+
+        fetches, new_state = compiled.fn(feed_arrays, state, rng_key)
+        for name, val in new_state.items():
+            scope.set_var(name, val)
+
+        if return_numpy:
+            return [np.asarray(v) for v in fetches]
+        return list(fetches)
+
+    def close(self):
+        self._cache.clear()

@@ -1,0 +1,16 @@
+from .core import (
+    Block,
+    Operator,
+    Parameter,
+    Program,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    grad_var_name,
+    name_scope,
+    program_guard,
+    switch_main_program,
+    switch_startup_program,
+)
+from .scope import CPUPlace, CUDAPlace, Scope, TPUPlace, global_scope, scope_guard
+from . import unique_name

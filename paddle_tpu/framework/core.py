@@ -1,0 +1,478 @@
+"""Program / Block / Operator / Variable IR.
+
+This is the declarative graph IR at the heart of the framework, playing the
+role of the reference's ProgramDesc/BlockDesc/OpDesc/VarDesc protobuf stack
+(reference: paddle/fluid/framework/framework.proto, program_desc.cc,
+block_desc.cc, op_desc.cc and python/paddle/fluid/framework.py).
+
+Differences from the reference, by design (TPU-first):
+- Pure-Python dataclass-style IR with JSON serialization instead of protobuf;
+  the IR is only ever consumed by our own tracer, which lowers a whole Block
+  into ONE jitted XLA computation (see trace.py). There are no per-op kernels
+  to dispatch, so there is no need for a C++ desc mirror.
+- No LoD: variable-length sequence data is carried as dense padded tensors
+  plus explicit integer length tensors (TPU/XLA want static shapes).
+  ``Variable.lod_level > 0`` simply marks that a companion ``<name>.lens``
+  variable exists.
+"""
+from __future__ import annotations
+
+import contextlib
+import copy
+import hashlib
+import json
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from . import unique_name
+from .dtypes import convert_dtype
+
+__all__ = [
+    "Variable",
+    "Parameter",
+    "Operator",
+    "Block",
+    "Program",
+    "default_main_program",
+    "default_startup_program",
+    "switch_main_program",
+    "switch_startup_program",
+    "program_guard",
+    "name_scope",
+    "grad_var_name",
+]
+
+GRAD_SUFFIX = "@GRAD"
+
+
+def grad_var_name(name: str) -> str:
+    return name + GRAD_SUFFIX
+
+
+class Variable:
+    """A symbolic tensor in a Block.
+
+    Mirrors VarDesc + python Variable (reference:
+    python/paddle/fluid/framework.py:Variable). ``shape`` may contain -1 for
+    dimensions only known at feed time (typically batch).
+    """
+
+    def __init__(
+        self,
+        block: "Block",
+        name: Optional[str] = None,
+        shape: Optional[Sequence[int]] = None,
+        dtype: Any = "float32",
+        lod_level: int = 0,
+        persistable: bool = False,
+        stop_gradient: bool = False,
+        is_data: bool = False,
+        **kwargs,
+    ):
+        self.block = block
+        if name is None:
+            name = unique_name.generate("_generated_var")
+        self.name = name
+        self.shape = tuple(int(s) for s in shape) if shape is not None else ()
+        self.dtype = convert_dtype(dtype)
+        self.lod_level = lod_level
+        self.persistable = persistable
+        self.stop_gradient = stop_gradient
+        self.is_data = is_data
+        self.op: Optional[Operator] = None  # producing op, set by append_op
+
+    # -- numpy-ish sugar -------------------------------------------------
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    def astype(self, dtype):
+        from ..layers import tensor as tensor_layers
+
+        return tensor_layers.cast(self, dtype)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "shape": list(self.shape),
+            "dtype": self.dtype,
+            "lod_level": self.lod_level,
+            "persistable": self.persistable,
+            "stop_gradient": self.stop_gradient,
+            "is_data": self.is_data,
+            "is_parameter": isinstance(self, Parameter),
+            "trainable": getattr(self, "trainable", False),
+        }
+
+    def __repr__(self):
+        return "Variable(name=%s, shape=%s, dtype=%s)" % (
+            self.name,
+            self.shape,
+            self.dtype,
+        )
+
+    __str__ = __repr__
+
+
+class Parameter(Variable):
+    """A trainable, persistable Variable (reference:
+    python/paddle/fluid/framework.py:Parameter)."""
+
+    def __init__(self, block, shape, dtype, **kwargs):
+        kwargs.setdefault("persistable", True)
+        self.trainable = kwargs.pop("trainable", True)
+        self.optimize_attr = kwargs.pop("optimize_attr", {"learning_rate": 1.0})
+        self.regularizer = kwargs.pop("regularizer", None)
+        self.gradient_clip_attr = kwargs.pop("gradient_clip_attr", None)
+        self.do_model_average = kwargs.pop("do_model_average", None)
+        super().__init__(block, shape=shape, dtype=dtype, **kwargs)
+        if any(s < 0 for s in self.shape):
+            raise ValueError("Parameter shape must be fully static: %s" % (shape,))
+
+
+class Operator:
+    """One node in a Block (reference: OpDesc / framework.py:Operator).
+
+    inputs/outputs map slot names ("X", "Out", ...) to lists of variable
+    names. attrs are JSON-serializable Python values; sub-blocks for control
+    flow are referenced by block index via the ``sub_block`` attr.
+    """
+
+    def __init__(
+        self,
+        block: "Block",
+        type: str,
+        inputs: Optional[Dict[str, Any]] = None,
+        outputs: Optional[Dict[str, Any]] = None,
+        attrs: Optional[Dict[str, Any]] = None,
+    ):
+        self.block = block
+        self.type = type
+        self.inputs = {k: _to_name_list(v) for k, v in (inputs or {}).items()}
+        self.outputs = {k: _to_name_list(v) for k, v in (outputs or {}).items()}
+        self.attrs = dict(attrs or {})
+
+    def input(self, slot: str) -> List[str]:
+        return self.inputs.get(slot, [])
+
+    def output(self, slot: str) -> List[str]:
+        return self.outputs.get(slot, [])
+
+    @property
+    def input_arg_names(self) -> List[str]:
+        return [n for v in self.inputs.values() for n in v]
+
+    @property
+    def output_arg_names(self) -> List[str]:
+        return [n for v in self.outputs.values() for n in v]
+
+    def attr(self, name: str, default=None):
+        return self.attrs.get(name, default)
+
+    def set_attr(self, name: str, val):
+        self.attrs[name] = val
+
+    def to_dict(self) -> dict:
+        return {
+            "type": self.type,
+            "inputs": self.inputs,
+            "outputs": self.outputs,
+            "attrs": _jsonable_attrs(self.attrs),
+        }
+
+    def __repr__(self):
+        return "Op(%s, inputs=%s, outputs=%s)" % (self.type, self.inputs, self.outputs)
+
+
+def _to_name_list(v) -> List[str]:
+    if v is None:
+        return []
+    if isinstance(v, (Variable, str)):
+        v = [v]
+    out = []
+    for item in v:
+        out.append(item.name if isinstance(item, Variable) else str(item))
+    return out
+
+
+def _jsonable_attrs(attrs: dict) -> dict:
+    out = {}
+    for k, v in attrs.items():
+        if isinstance(v, np.ndarray):
+            out[k] = {"__ndarray__": v.tolist(), "dtype": str(v.dtype)}
+        elif isinstance(v, Block):
+            out[k] = {"__block__": v.idx}
+        elif isinstance(v, (np.integer,)):
+            out[k] = int(v)
+        elif isinstance(v, (np.floating,)):
+            out[k] = float(v)
+        else:
+            out[k] = v
+    return out
+
+
+class Block:
+    """An ordered list of Operators plus the Variables they reference
+    (reference: BlockDesc / framework.py:Block)."""
+
+    def __init__(self, program: "Program", idx: int, parent_idx: int = -1):
+        self.program = program
+        self.idx = idx
+        self.parent_idx = parent_idx
+        self.vars: Dict[str, Variable] = {}
+        self.ops: List[Operator] = []
+
+    @property
+    def parent_block(self) -> Optional["Block"]:
+        if self.parent_idx < 0:
+            return None
+        return self.program.block(self.parent_idx)
+
+    # -- variables -------------------------------------------------------
+    def create_var(self, **kwargs) -> Variable:
+        name = kwargs.get("name")
+        if name is not None and name in self.vars:
+            return self.vars[name]
+        var = Variable(self, **kwargs)
+        self.vars[var.name] = var
+        return var
+
+    def create_parameter(self, **kwargs) -> Parameter:
+        # Parameters always live in the top-level (global) block, like the
+        # reference's global_block parameters.
+        global_block = self.program.global_block()
+        name = kwargs.get("name")
+        if name is not None and name in global_block.vars:
+            return global_block.vars[name]
+        param = Parameter(global_block, **{k: v for k, v in kwargs.items() if k != "block"})
+        global_block.vars[param.name] = param
+        return param
+
+    def var(self, name: str) -> Variable:
+        v = self._find_var_recursive(name)
+        if v is None:
+            raise ValueError("variable %r not found in block %d" % (name, self.idx))
+        return v
+
+    def has_var(self, name: str) -> bool:
+        return self._find_var_recursive(name) is not None
+
+    def _find_var_recursive(self, name: str) -> Optional[Variable]:
+        block: Optional[Block] = self
+        while block is not None:
+            if name in block.vars:
+                return block.vars[name]
+            block = block.parent_block
+        return None
+
+    def all_parameters(self) -> List[Parameter]:
+        return [v for v in self.vars.values() if isinstance(v, Parameter)]
+
+    # -- ops -------------------------------------------------------------
+    def append_op(self, type: str, inputs=None, outputs=None, attrs=None) -> Operator:
+        op = Operator(self, type=type, inputs=inputs, outputs=outputs, attrs=attrs)
+        self.ops.append(op)
+        self.program._bump()
+        for slot_vars in (outputs or {}).values():
+            if isinstance(slot_vars, (Variable,)):
+                slot_vars = [slot_vars]
+            for v in slot_vars or []:
+                if isinstance(v, Variable):
+                    v.op = op
+        return op
+
+    def prepend_op(self, type: str, inputs=None, outputs=None, attrs=None) -> Operator:
+        op = Operator(self, type=type, inputs=inputs, outputs=outputs, attrs=attrs)
+        self.ops.insert(0, op)
+        self.program._bump()
+        return op
+
+    def insert_op(self, index: int, type: str, inputs=None, outputs=None, attrs=None) -> Operator:
+        op = Operator(self, type=type, inputs=inputs, outputs=outputs, attrs=attrs)
+        self.ops.insert(index, op)
+        self.program._bump()
+        return op
+
+    def to_dict(self) -> dict:
+        return {
+            "idx": self.idx,
+            "parent_idx": self.parent_idx,
+            "vars": [v.to_dict() for v in self.vars.values()],
+            "ops": [op.to_dict() for op in self.ops],
+        }
+
+
+class Program:
+    """A list of Blocks; block 0 is global (reference: ProgramDesc /
+    framework.py:Program)."""
+
+    def __init__(self):
+        self.blocks: List[Block] = [Block(self, 0)]
+        self.current_block_idx = 0
+        self._seed = 0
+        self.random_seed = 0
+        self._version = 0  # bumped on every mutation; part of the fingerprint
+
+    # -- block management ------------------------------------------------
+    def global_block(self) -> Block:
+        return self.blocks[0]
+
+    def block(self, idx: int) -> Block:
+        return self.blocks[idx]
+
+    def current_block(self) -> Block:
+        return self.blocks[self.current_block_idx]
+
+    def _create_block(self, parent_idx: Optional[int] = None) -> Block:
+        parent = self.current_block_idx if parent_idx is None else parent_idx
+        b = Block(self, len(self.blocks), parent)
+        self.blocks.append(b)
+        self.current_block_idx = b.idx
+        return b
+
+    def _rollback(self):
+        self.current_block_idx = self.current_block().parent_idx
+
+    @property
+    def num_blocks(self) -> int:
+        return len(self.blocks)
+
+    # -- mutation tracking ----------------------------------------------
+    def _bump(self):
+        self._version += 1
+
+    def fingerprint(self) -> str:
+        payload = json.dumps(self.to_dict(), sort_keys=True).encode()
+        return hashlib.sha1(payload).hexdigest()
+
+    # -- parity APIs -----------------------------------------------------
+    def clone(self, for_test: bool = False) -> "Program":
+        """Deep-copies the program. With for_test=True, flips train-only ops
+        (dropout, batch_norm) into inference mode like the reference's
+        Program.clone(for_test=True) (reference framework.py:1241)."""
+        p = copy.deepcopy(self)
+        if for_test:
+            for block in p.blocks:
+                for op in block.ops:
+                    if "is_test" in _TRAIN_TEST_OPS.get(op.type, ()):
+                        op.attrs["is_test"] = True
+                    if op.type == "dropout":
+                        op.attrs["is_test"] = True
+        return p
+
+    def list_vars(self):
+        for block in self.blocks:
+            for v in block.vars.values():
+                yield v
+
+    def all_parameters(self) -> List[Parameter]:
+        return self.global_block().all_parameters()
+
+    def to_dict(self) -> dict:
+        return {
+            "version": 1,
+            "random_seed": self.random_seed,
+            "blocks": [b.to_dict() for b in self.blocks],
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict())
+
+    @staticmethod
+    def from_dict(d: dict) -> "Program":
+        p = Program()
+        p.random_seed = d.get("random_seed", 0)
+        # first pass: blocks
+        p.blocks = []
+        for bd in d["blocks"]:
+            b = Block(p, bd["idx"], bd["parent_idx"])
+            p.blocks.append(b)
+        for bd, b in zip(d["blocks"], p.blocks):
+            for vd in bd["vars"]:
+                cls = Parameter if vd.get("is_parameter") else Variable
+                kwargs = dict(
+                    name=vd["name"],
+                    shape=vd["shape"],
+                    dtype=vd["dtype"],
+                    lod_level=vd.get("lod_level", 0),
+                    persistable=vd.get("persistable", False),
+                    stop_gradient=vd.get("stop_gradient", False),
+                    is_data=vd.get("is_data", False),
+                )
+                if cls is Parameter:
+                    kwargs["trainable"] = vd.get("trainable", True)
+                    var = Parameter(b, **kwargs)
+                else:
+                    var = Variable(b, **kwargs)
+                b.vars[var.name] = var
+            for od in bd["ops"]:
+                attrs = dict(od["attrs"])
+                for k, v in attrs.items():
+                    if isinstance(v, dict) and "__ndarray__" in v:
+                        attrs[k] = np.array(v["__ndarray__"], dtype=v["dtype"])
+                b.append_op(type=od["type"], inputs=od["inputs"], outputs=od["outputs"], attrs=attrs)
+        p.current_block_idx = 0
+        return p
+
+    @staticmethod
+    def from_json(s: str) -> "Program":
+        return Program.from_dict(json.loads(s))
+
+
+# ops whose behavior differs between train and test
+_TRAIN_TEST_OPS = {
+    "dropout": ("is_test",),
+    "batch_norm": ("is_test",),
+}
+
+# -- default programs ----------------------------------------------------
+_main_program_ = Program()
+_startup_program_ = Program()
+
+
+def default_main_program() -> Program:
+    return _main_program_
+
+
+def default_startup_program() -> Program:
+    return _startup_program_
+
+
+def switch_main_program(program: Program) -> Program:
+    global _main_program_
+    prev, _main_program_ = _main_program_, program
+    return prev
+
+
+def switch_startup_program(program: Program) -> Program:
+    global _startup_program_
+    prev, _startup_program_ = _startup_program_, program
+    return prev
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program, startup_program: Optional[Program] = None):
+    """Reference: python/paddle/fluid/framework.py:program_guard."""
+    prev_main = switch_main_program(main_program)
+    prev_startup = None
+    if startup_program is not None:
+        prev_startup = switch_startup_program(startup_program)
+    try:
+        yield
+    finally:
+        switch_main_program(prev_main)
+        if prev_startup is not None:
+            switch_startup_program(prev_startup)
+
+
+_name_scope_stack: List[str] = []
+
+
+@contextlib.contextmanager
+def name_scope(prefix: str):
+    _name_scope_stack.append(prefix)
+    try:
+        yield
+    finally:
+        _name_scope_stack.pop()

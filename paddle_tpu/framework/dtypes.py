@@ -1,0 +1,60 @@
+"""Dtype system for paddle_tpu.
+
+Mirrors the reference's VarType dtype enum (reference:
+paddle/fluid/framework/framework.proto:91-115, data_type.h) but maps directly
+onto JAX/numpy dtypes. bfloat16 is first-class because it is the native MXU
+input type on TPU.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+try:
+    import jax.numpy as jnp
+
+    _BF16 = jnp.bfloat16
+except Exception:  # pragma: no cover
+    _BF16 = None
+
+# Canonical string names -> numpy dtype objects.
+_STR2DTYPE = {
+    "bool": np.dtype(np.bool_),
+    "int8": np.dtype(np.int8),
+    "uint8": np.dtype(np.uint8),
+    "int16": np.dtype(np.int16),
+    "int32": np.dtype(np.int32),
+    "int64": np.dtype(np.int64),
+    "float16": np.dtype(np.float16),
+    "float32": np.dtype(np.float32),
+    "float64": np.dtype(np.float64),
+}
+if _BF16 is not None:
+    _STR2DTYPE["bfloat16"] = np.dtype(_BF16)
+
+
+def convert_dtype(dtype) -> str:
+    """Normalize a dtype spec (string / numpy dtype / jnp dtype) to its
+    canonical string name."""
+    if dtype is None:
+        return "float32"
+    if isinstance(dtype, str):
+        name = dtype
+    else:
+        name = np.dtype(dtype).name
+        if name == "bfloat16" and "bfloat16" not in _STR2DTYPE:
+            raise TypeError("bfloat16 requires jax")
+    if name not in _STR2DTYPE:
+        raise TypeError("unsupported dtype: %r" % (dtype,))
+    return name
+
+
+def as_numpy_dtype(dtype) -> np.dtype:
+    return _STR2DTYPE[convert_dtype(dtype)]
+
+
+def is_float(dtype) -> bool:
+    return convert_dtype(dtype) in ("float16", "bfloat16", "float32", "float64")
+
+
+def is_integer(dtype) -> bool:
+    return convert_dtype(dtype) in ("int8", "uint8", "int16", "int32", "int64")

@@ -1,0 +1,112 @@
+"""Scope: runtime variable store, and Place: device abstraction.
+
+Reference: paddle/fluid/framework/scope.h (Scope holds Variables by name,
+hierarchical) and paddle/fluid/platform/place.h (CPUPlace / CUDAPlace).
+
+TPU-native: a Scope maps names to live ``jax.Array``s (device-resident,
+possibly sharded across a Mesh). Memory is owned by XLA — there is no buddy
+allocator to port; donation in the executor gives in-place parameter update
+semantics without copies.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+__all__ = ["Scope", "CPUPlace", "TPUPlace", "CUDAPlace", "global_scope", "scope_guard"]
+
+
+class Scope:
+    def __init__(self, parent: Optional["Scope"] = None):
+        self.parent = parent
+        self.vars: Dict[str, object] = {}
+        self.kids = []
+
+    def var(self, name: str):
+        """Find or create (as None placeholder) a variable slot."""
+        if name not in self.vars and (self.parent is None or self.parent.find_var(name) is None):
+            self.vars[name] = None
+        return self.find_var(name)
+
+    def find_var(self, name: str):
+        if name in self.vars:
+            return self.vars[name]
+        if self.parent is not None:
+            return self.parent.find_var(name)
+        return None
+
+    def has_var(self, name: str) -> bool:
+        return name in self.vars or (self.parent is not None and self.parent.has_var(name))
+
+    def set_var(self, name: str, value):
+        self.vars[name] = value
+
+    def erase(self, name: str):
+        self.vars.pop(name, None)
+
+    def new_scope(self) -> "Scope":
+        kid = Scope(self)
+        self.kids.append(kid)
+        return kid
+
+    def local_var_names(self):
+        return list(self.vars.keys())
+
+
+class Place:
+    """Base device place. Resolves to a concrete jax.Device."""
+
+    _kind = "cpu"
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = device_id
+
+    def jax_device(self):
+        import jax
+
+        devs = [d for d in jax.devices() if d.platform == self._kind]
+        if not devs:  # fall back to default backend (e.g. tests force CPU)
+            devs = jax.devices()
+        return devs[self.device_id % len(devs)]
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.device_id == other.device_id
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.device_id))
+
+    def __repr__(self):
+        return "%s(%d)" % (type(self).__name__, self.device_id)
+
+
+class CPUPlace(Place):
+    _kind = "cpu"
+
+
+class TPUPlace(Place):
+    _kind = "tpu"
+
+
+# The reference's CUDAPlace; maps to the accelerator (TPU) so that reference
+# scripts using CUDAPlace run unchanged.
+class CUDAPlace(TPUPlace):
+    pass
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    global _global_scope
+    prev, _global_scope = _global_scope, scope
+    try:
+        yield
+    finally:
+        _global_scope = prev

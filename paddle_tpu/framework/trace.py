@@ -1,0 +1,183 @@
+"""Block tracer: lowers a Program Block to one pure JAX function.
+
+This replaces the reference's per-op dispatch loop (reference:
+paddle/fluid/framework/executor.cc:Executor::RunPreparedContext — creates an
+OperatorBase per OpDesc and launches a kernel per op). Here the whole block
+is traced symbolically once and handed to XLA as a single computation, so
+op boundaries vanish: XLA fuses elementwise chains into matmul/conv
+epilogues and schedules the entire step.
+
+The ``autodiff`` pseudo-op (inserted by backward.append_backward) is handled
+specially: the forward prefix of the block is replayed inside ``jax.vjp`` so
+XLA differentiates the whole graph at once — the traced training step
+contains forward+backward+optimizer in one XLA program. Several autodiff
+ops in one block (e.g. two optimizers on two losses) are supported: each
+replays the forward ops before it; identical replayed subcomputations are
+CSE'd by XLA, and per-op keyed RNG keeps any dropout masks identical across
+replays.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.registry import OpContext, get_kernel
+from .core import Block, Operator, grad_var_name
+
+# op types the tracer interprets (or skips) itself rather than via a kernel:
+# autodiff is expanded into a vjp; feed/fetch (present in reference-style
+# serialized programs) are no-ops because the executor feeds/fetches
+# directly.
+_SKIP_OPS = {"feed", "fetch"}
+
+
+class RngStream:
+    """Deterministic PRNG stream keyed on (block idx, op position, draw #):
+    replaying an op (e.g. inside an autodiff vjp) yields the same bits, and
+    adding ops elsewhere never perturbs other ops' streams."""
+
+    def __init__(self, base_key):
+        self.base_key = base_key
+
+    def for_op(self, block_idx: int, op_idx: int) -> Callable:
+        draws = [0]
+
+        def next_key():
+            k = jax.random.fold_in(self.base_key, block_idx * 1000003 + op_idx)
+            k = jax.random.fold_in(k, draws[0])
+            draws[0] += 1
+            return k
+
+        return next_key
+
+
+class TraceError(RuntimeError):
+    """Carries the failing op's context, mirroring the reference's enforce
+    messages that name the op and its inputs."""
+
+
+def _apply_outputs(op: Operator, block: Block, env: Dict, result: Dict):
+    for slot, names in op.outputs.items():
+        if slot not in result:
+            continue
+        vals = result[slot]
+        if not isinstance(vals, (list, tuple)):
+            vals = [vals]
+        for name, val in zip(names, vals):
+            var = block._find_var_recursive(name)
+            if var is not None and var.stop_gradient and not var.persistable:
+                val = jax.lax.stop_gradient(val)
+            env[name] = val
+
+
+def trace_op(op: Operator, block: Block, env: Dict, rng_fn, subblock_fn=None):
+    kernel = get_kernel(op.type)
+    ctx = OpContext(op, _EnvView(env, op), rng_fn, subblock_fn, block)
+    try:
+        result = kernel(ctx)
+    except (NotImplementedError,):
+        raise
+    except Exception as e:
+        in_shapes = {
+            slot: [getattr(env.get(n), "shape", None) for n in names]
+            for slot, names in op.inputs.items()
+        }
+        raise TraceError(
+            "error while lowering op %r (inputs %s, attrs %s): %s"
+            % (op.type, in_shapes, op.attrs, e)
+        ) from e
+    _apply_outputs(op, block, env, result)
+
+
+class _EnvView(dict):
+    """Env lookup that raises with op context for variables that were never
+    produced. (Optional inputs never reach here: layers omit the slot
+    entirely, so OpContext.input() returns the default before lookup.)"""
+
+    def __init__(self, env, op):
+        super().__init__()
+        self._env = env
+        self._op = op
+
+    def __getitem__(self, name):
+        if name in self._env:
+            return self._env[name]
+        raise KeyError(
+            "variable %r (input of op %r) has no value: not a feed, not "
+            "persistable state, and not produced by any earlier op"
+            % (name, self._op.type)
+        )
+
+    def __contains__(self, name):
+        return name in self._env
+
+
+def trace_block(block: Block, env: Dict, rng: RngStream) -> Dict:
+    """Trace all ops of `block` into `env` (mutated in place and returned)."""
+    program = block.program
+
+    def subblock_fn(block_idx: int, sub_env: Dict) -> Dict:
+        return trace_block(program.block(block_idx), sub_env, rng)
+
+    env_start = dict(env)
+    # (op, op_idx) pairs replayed inside each vjp. Frozen at the first
+    # autodiff: ops after it (optimizer/clip/regularizer updates, metrics)
+    # are not part of any loss's forward graph. In fluid programs every
+    # forward op precedes the first minimize(), so all losses are covered.
+    forward_ops: List[tuple] = []
+    saw_autodiff = False
+
+    for op_idx, op in enumerate(block.ops):
+        if op.type in _SKIP_OPS:
+            continue
+        if op.type != "autodiff":
+            trace_op(op, block, env, rng.for_op(block.idx, op_idx), subblock_fn)
+            if not saw_autodiff:
+                forward_ops.append((op, op_idx))
+            continue
+        saw_autodiff = True
+
+        # -- autodiff: differentiate loss wrt params over the full forward
+        # prefix (all non-autodiff ops so far), replayed under jax.vjp.
+        loss_name = op.attr("loss_name")
+        param_names: List[str] = list(op.attr("param_names"))
+        replay = list(forward_ops)
+
+        def forward(pvals: Dict[str, jnp.ndarray]):
+            fenv = dict(env_start)
+            fenv.update(pvals)
+            for fop, fidx in replay:
+                trace_op(fop, block, fenv, rng.for_op(block.idx, fidx), subblock_fn)
+            if loss_name not in fenv:
+                raise TraceError(
+                    "loss %r is not computed by the forward ops preceding "
+                    "the first backward pass; differentiating a loss built "
+                    "between two minimize() calls is unsupported" % loss_name
+                )
+            loss = fenv[loss_name]
+            return jnp.sum(loss), fenv
+
+        pvals = {}
+        for name in param_names:
+            if name not in env:
+                raise TraceError(
+                    "parameter %r has no value in scope — run the startup "
+                    "program first" % name
+                )
+            pvals[name] = env[name]
+
+        loss_val, vjp_fn, fenv = jax.vjp(forward, pvals, has_aux=True)
+        (grads,) = vjp_fn(jnp.ones_like(loss_val))
+
+        # fenv is the authoritative post-forward env; keep grad vars and
+        # any state written by earlier autodiff sections.
+        merged = dict(env)
+        merged.update(fenv)
+        env.clear()
+        env.update(merged)
+        for name in param_names:
+            env[grad_var_name(name)] = grads[name]
+
+    return env

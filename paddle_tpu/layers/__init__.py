@@ -1,0 +1,17 @@
+"""paddle_tpu.layers (reference: python/paddle/fluid/layers/__init__.py)."""
+from . import nn, ops, tensor, io, metric_op, learning_rate_scheduler
+from .nn import *  # noqa: F401,F403
+from .ops import *  # noqa: F401,F403
+from .tensor import *  # noqa: F401,F403
+from .io import *  # noqa: F401,F403
+from .metric_op import *  # noqa: F401,F403
+from .learning_rate_scheduler import *  # noqa: F401,F403
+
+__all__ = (
+    nn.__all__
+    + ops.__all__
+    + tensor.__all__
+    + io.__all__
+    + metric_op.__all__
+    + learning_rate_scheduler.__all__
+)

@@ -1,0 +1,39 @@
+"""layers.io (reference: python/paddle/fluid/layers/io.py).
+
+`data` declares feed variables. The reference's py_reader / double_buffer /
+open_recordio_file pipeline is provided in paddle_tpu.io.reader backed by
+the C++ prefetch runtime; here we expose the layer-level API surface.
+"""
+from __future__ import annotations
+
+from ..framework.core import default_main_program, default_startup_program
+from ..framework.dtypes import convert_dtype
+
+__all__ = ["data"]
+
+
+def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0, type=None, stop_gradient=True):
+    """Declare a feed variable (reference io.py:data). With lod_level>0 a
+    companion `<name>.lens` int32 vector is declared for sequence lengths
+    (dense+lengths replaces LoD on TPU)."""
+    helper_block = default_main_program().current_block()
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    var = helper_block.create_var(
+        name=name,
+        shape=tuple(shape),
+        dtype=convert_dtype(dtype),
+        lod_level=lod_level,
+        stop_gradient=stop_gradient,
+        is_data=True,
+    )
+    if lod_level > 0:
+        helper_block.create_var(
+            name=name + ".lens",
+            shape=(-1,),
+            dtype="int32",
+            stop_gradient=True,
+            is_data=True,
+        )
+    return var

@@ -1,0 +1,50 @@
+"""layers.metric_op (reference: python/paddle/fluid/layers/metric_op.py)."""
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+from ..initializer import ConstantInitializer
+
+__all__ = ["accuracy", "auc"]
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """reference metric_op.py:accuracy — top-k accuracy of `input` logits."""
+    helper = LayerHelper("accuracy")
+    from .nn import topk
+
+    topk_out, topk_indices = topk(input, k=k)
+    acc_out = helper.create_variable_for_type_inference(dtype="float32", shape=())
+    if correct is None:
+        correct = helper.create_variable_for_type_inference(dtype="int32", shape=())
+    if total is None:
+        total = helper.create_variable_for_type_inference(dtype="int32", shape=())
+    helper.append_op(
+        type="accuracy",
+        inputs={"Out": [topk_out], "Indices": [topk_indices], "Label": [label]},
+        outputs={"Accuracy": [acc_out], "Correct": [correct], "Total": [total]},
+    )
+    return acc_out
+
+
+def auc(input, label, curve="ROC", num_thresholds=200, topk=1):
+    """reference metric_op.py:auc — streaming AUC with persistable stat
+    buckets updated each step."""
+    helper = LayerHelper("auc")
+    stat_pos = helper.create_global_variable(
+        persistable=True, dtype="float32", shape=(num_thresholds + 1,),
+        name=helper.name + ".stat_pos",
+    )
+    stat_neg = helper.create_global_variable(
+        persistable=True, dtype="float32", shape=(num_thresholds + 1,),
+        name=helper.name + ".stat_neg",
+    )
+    for var in [stat_pos, stat_neg]:
+        helper.set_variable_initializer(var, ConstantInitializer(0.0))
+    auc_out = helper.create_variable_for_type_inference(dtype="float32", shape=())
+    helper.append_op(
+        type="auc",
+        inputs={"Predict": [input], "Label": [label], "StatPos": [stat_pos], "StatNeg": [stat_neg]},
+        outputs={"AUC": [auc_out], "StatPosOut": [stat_pos], "StatNegOut": [stat_neg]},
+        attrs={"curve": curve, "num_thresholds": num_thresholds},
+    )
+    return auc_out, [stat_pos, stat_neg]

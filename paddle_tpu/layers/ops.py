@@ -1,0 +1,197 @@
+"""layers.ops — generated elementwise/activation layers (reference:
+python/paddle/fluid/layers/ops.py + layer_function_generator.py)."""
+from __future__ import annotations
+
+from ..framework.dtypes import convert_dtype
+from ..layer_helper import LayerHelper
+
+_UNARY_OPS = [
+    "sigmoid",
+    "logsigmoid",
+    "exp",
+    "relu",
+    "tanh",
+    "tanh_shrink",
+    "softshrink",
+    "sqrt",
+    "abs",
+    "ceil",
+    "floor",
+    "cos",
+    "sin",
+    "round",
+    "reciprocal",
+    "square",
+    "softplus",
+    "softsign",
+    "brelu",
+    "leaky_relu",
+    "soft_relu",
+    "elu",
+    "relu6",
+    "pow",
+    "stanh",
+    "hard_sigmoid",
+    "swish",
+    "thresholded_relu",
+    "hard_shrink",
+    "cumsum",
+    "logical_not",
+]
+
+__all__ = list(_UNARY_OPS) + [
+    "scale",
+    "elementwise_add",
+    "elementwise_sub",
+    "elementwise_mul",
+    "elementwise_div",
+    "elementwise_max",
+    "elementwise_min",
+    "elementwise_pow",
+    "clip",
+    "clip_by_norm",
+    "uniform_random",
+    "gaussian_random",
+    "sampling_id",
+    "logical_and",
+    "logical_or",
+    "logical_xor",
+    "maxout",
+]
+
+
+def _make_unary(op_type):
+    def layer(x, name=None, **attrs):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_variable_for_type_inference(dtype=x.dtype, shape=x.shape)
+        helper.append_op(
+            type=op_type, inputs={"X": [x]}, outputs={"Out": [out]}, attrs=attrs
+        )
+        return out
+
+    layer.__name__ = op_type
+    layer.__doc__ = "Elementwise %s (generated; reference layers/ops.py)." % op_type
+    return layer
+
+
+_g = globals()
+for _op in _UNARY_OPS:
+    _g[_op] = _make_unary(_op)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    helper = LayerHelper("scale", name=name, act=act)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype, shape=x.shape)
+    helper.append_op(
+        type="scale",
+        inputs={"X": [x]},
+        outputs={"Out": [out]},
+        attrs={"scale": float(scale), "bias": float(bias), "bias_after_scale": bias_after_scale},
+    )
+    return helper.append_activation(out)
+
+
+def _broadcast_shape(xs, ys, axis):
+    if len(ys) > len(xs):
+        return ys
+    return xs
+
+
+def _make_binary(op_type, out_dtype=None):
+    def layer(x, y, axis=-1, act=None, name=None):
+        helper = LayerHelper(op_type, name=name, act=act)
+        dtype = out_dtype or x.dtype
+        out = helper.create_variable_for_type_inference(
+            dtype=dtype, shape=_broadcast_shape(x.shape, y.shape, axis)
+        )
+        helper.append_op(
+            type=op_type,
+            inputs={"X": [x], "Y": [y]},
+            outputs={"Out": [out]},
+            attrs={"axis": axis},
+        )
+        return helper.append_activation(out)
+
+    layer.__name__ = op_type
+    return layer
+
+
+elementwise_add = _make_binary("elementwise_add")
+elementwise_sub = _make_binary("elementwise_sub")
+elementwise_mul = _make_binary("elementwise_mul")
+elementwise_div = _make_binary("elementwise_div")
+elementwise_max = _make_binary("elementwise_max")
+elementwise_min = _make_binary("elementwise_min")
+elementwise_pow = _make_binary("elementwise_pow")
+logical_and = _make_binary("logical_and", out_dtype="bool")
+logical_or = _make_binary("logical_or", out_dtype="bool")
+logical_xor = _make_binary("logical_xor", out_dtype="bool")
+
+
+def clip(x, min, max, name=None):
+    helper = LayerHelper("clip", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype, shape=x.shape)
+    helper.append_op(
+        type="clip",
+        inputs={"X": [x]},
+        outputs={"Out": [out]},
+        attrs={"min": float(min), "max": float(max)},
+    )
+    return out
+
+
+def clip_by_norm(x, max_norm, name=None):
+    helper = LayerHelper("clip_by_norm", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype, shape=x.shape)
+    helper.append_op(
+        type="clip_by_norm",
+        inputs={"X": [x]},
+        outputs={"Out": [out]},
+        attrs={"max_norm": float(max_norm)},
+    )
+    return out
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0):
+    helper = LayerHelper("uniform_random")
+    out = helper.create_variable_for_type_inference(
+        dtype=convert_dtype(dtype), shape=tuple(shape)
+    )
+    helper.append_op(
+        type="uniform_random",
+        outputs={"Out": [out]},
+        attrs={"shape": list(shape), "dtype": convert_dtype(dtype), "min": min, "max": max, "seed": seed},
+    )
+    return out
+
+
+def gaussian_random(shape, dtype="float32", mean=0.0, std=1.0, seed=0):
+    helper = LayerHelper("gaussian_random")
+    out = helper.create_variable_for_type_inference(
+        dtype=convert_dtype(dtype), shape=tuple(shape)
+    )
+    helper.append_op(
+        type="gaussian_random",
+        outputs={"Out": [out]},
+        attrs={"shape": list(shape), "dtype": convert_dtype(dtype), "mean": mean, "std": std, "seed": seed},
+    )
+    return out
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0):
+    helper = LayerHelper("sampling_id")
+    out = helper.create_variable_for_type_inference(dtype="int64", shape=(x.shape[0],))
+    helper.append_op(
+        type="sampling_id", inputs={"X": [x]}, outputs={"Out": [out]}, attrs={"seed": seed}
+    )
+    return out
+
+
+def maxout(x, groups, name=None):
+    helper = LayerHelper("maxout", name=name)
+    n, c, h, w = x.shape
+    out = helper.create_variable_for_type_inference(dtype=x.dtype, shape=(n, c // groups, h, w))
+    helper.append_op(
+        type="maxout", inputs={"X": [x]}, outputs={"Out": [out]}, attrs={"groups": groups}
+    )
+    return out

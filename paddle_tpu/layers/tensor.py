@@ -1,0 +1,207 @@
+"""layers.tensor (reference: python/paddle/fluid/layers/tensor.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.core import Variable
+from ..framework.dtypes import convert_dtype
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "create_tensor",
+    "create_parameter",
+    "create_global_var",
+    "cast",
+    "concat",
+    "sums",
+    "assign",
+    "fill_constant",
+    "fill_constant_batch_size_like",
+    "ones",
+    "zeros",
+    "reverse",
+    "argmin",
+    "argmax",
+    "argsort",
+]
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    helper = LayerHelper("create_tensor", name=name)
+    return helper.create_variable(
+        name=helper.name, dtype=dtype, persistable=persistable, shape=()
+    )
+
+
+def create_parameter(
+    shape, dtype, name=None, attr=None, is_bias=False, default_initializer=None
+):
+    helper = LayerHelper("create_parameter", name=name)
+    from ..param_attr import ParamAttr
+
+    attr = attr if attr is not None else ParamAttr(name=name)
+    return helper.create_parameter(attr, shape, convert_dtype(dtype), is_bias, default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False, force_cpu=False, name=None):
+    helper = LayerHelper("global_var", name=name)
+    var = helper.create_global_variable(
+        name=helper.name, dtype=dtype, shape=tuple(shape), persistable=persistable
+    )
+    from ..initializer import ConstantInitializer
+
+    helper.set_variable_initializer(var, ConstantInitializer(value))
+    return var
+
+
+def cast(x, dtype):
+    helper = LayerHelper("cast")
+    dtype = convert_dtype(dtype)
+    out = helper.create_variable_for_type_inference(dtype=dtype, shape=x.shape)
+    helper.append_op(
+        type="cast",
+        inputs={"X": [x]},
+        outputs={"Out": [out]},
+        attrs={"in_dtype": x.dtype, "out_dtype": dtype},
+    )
+    return out
+
+
+def concat(input, axis=0, name=None):
+    helper = LayerHelper("concat", name=name)
+    shapes = [v.shape for v in input]
+    out_shape = list(shapes[0])
+    ax = axis % len(out_shape)
+    out_shape[ax] = sum(s[ax] for s in shapes) if all(s[ax] >= 0 for s in shapes) else -1
+    out = helper.create_variable_for_type_inference(
+        dtype=helper.input_dtype(), shape=tuple(out_shape)
+    )
+    helper.append_op(
+        type="concat", inputs={"X": input}, outputs={"Out": [out]}, attrs={"axis": axis}
+    )
+    return out
+
+
+def sums(input, out=None):
+    helper = LayerHelper("sums")
+    if out is None:
+        out = helper.create_variable_for_type_inference(
+            dtype=helper.input_dtype(), shape=input[0].shape
+        )
+    helper.append_op(type="sum", inputs={"X": input}, outputs={"Out": [out]})
+    return out
+
+
+def assign(input, output=None):
+    helper = LayerHelper("assign")
+    if isinstance(input, Variable):
+        if output is None:
+            output = helper.create_variable_for_type_inference(
+                dtype=input.dtype, shape=input.shape
+            )
+        helper.append_op(type="assign", inputs={"X": [input]}, outputs={"Out": [output]})
+    elif isinstance(input, np.ndarray):
+        if output is None:
+            output = helper.create_variable_for_type_inference(
+                dtype=convert_dtype(input.dtype), shape=input.shape
+            )
+        helper.append_op(
+            type="assign_value",
+            outputs={"Out": [output]},
+            attrs={
+                "shape": list(input.shape),
+                "dtype": convert_dtype(input.dtype),
+                "values": input,
+            },
+        )
+    else:
+        raise TypeError("assign expects Variable or ndarray")
+    return output
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None):
+    helper = LayerHelper("fill_constant")
+    dtype = convert_dtype(dtype)
+    if out is None:
+        out = helper.create_variable_for_type_inference(dtype=dtype, shape=tuple(shape))
+    helper.append_op(
+        type="fill_constant",
+        outputs={"Out": [out]},
+        attrs={"shape": list(shape), "dtype": dtype, "value": float(value)},
+    )
+    out.stop_gradient = True
+    return out
+
+
+def fill_constant_batch_size_like(
+    input, shape, dtype, value, input_dim_idx=0, output_dim_idx=0
+):
+    helper = LayerHelper("fill_constant_batch_size_like")
+    dtype = convert_dtype(dtype)
+    out_shape = list(shape)
+    out_shape[output_dim_idx] = input.shape[input_dim_idx]
+    out = helper.create_variable_for_type_inference(dtype=dtype, shape=tuple(out_shape))
+    helper.append_op(
+        type="fill_constant_batch_size_like",
+        inputs={"Input": [input]},
+        outputs={"Out": [out]},
+        attrs={
+            "shape": list(shape),
+            "dtype": dtype,
+            "value": float(value),
+            "input_dim_idx": input_dim_idx,
+            "output_dim_idx": output_dim_idx,
+        },
+    )
+    out.stop_gradient = True
+    return out
+
+
+def ones(shape, dtype, force_cpu=False):
+    return fill_constant(shape=shape, dtype=dtype, value=1.0)
+
+
+def zeros(shape, dtype, force_cpu=False):
+    return fill_constant(shape=shape, dtype=dtype, value=0.0)
+
+
+def reverse(x, axis):
+    helper = LayerHelper("reverse")
+    out = helper.create_variable_for_type_inference(dtype=x.dtype, shape=x.shape)
+    helper.append_op(
+        type="reverse", inputs={"X": [x]}, outputs={"Out": [out]}, attrs={"axis": axis}
+    )
+    return out
+
+
+def _arg_op(op_type, x, axis):
+    helper = LayerHelper(op_type)
+    shape = list(x.shape)
+    ax = axis % len(shape)
+    del shape[ax]
+    out = helper.create_variable_for_type_inference(dtype="int64", shape=tuple(shape))
+    helper.append_op(
+        type=op_type, inputs={"X": [x]}, outputs={"Out": [out]}, attrs={"axis": axis}
+    )
+    return out
+
+
+def argmin(x, axis=0):
+    return _arg_op("arg_min", x, axis)
+
+
+def argmax(x, axis=0):
+    return _arg_op("arg_max", x, axis)
+
+
+def argsort(input, axis=-1, name=None):
+    helper = LayerHelper("argsort", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype, shape=input.shape)
+    ids = helper.create_variable_for_type_inference(dtype="int64", shape=input.shape)
+    helper.append_op(
+        type="argsort",
+        inputs={"X": [input]},
+        outputs={"Out": [out], "Indices": [ids]},
+        attrs={"axis": axis},
+    )
+    return out, ids

@@ -1,0 +1,61 @@
+"""Metric op kernels (reference: paddle/fluid/operators/accuracy_op.cc,
+auc_op.cc, mean_iou_op.cc)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+@register_op("accuracy")
+def _accuracy(ctx):
+    indices = ctx.input("Indices")  # (B, k) top-k predicted classes
+    label = ctx.input("Label")  # (B, 1) or (B,)
+    lbl = label.reshape(-1, 1).astype(indices.dtype)
+    correct = jnp.any(indices == lbl, axis=1)
+    num_correct = jnp.sum(correct.astype(jnp.int32))
+    total = jnp.int32(indices.shape[0])
+    acc = num_correct.astype(jnp.float32) / total
+    return {"Accuracy": acc, "Correct": num_correct, "Total": total}
+
+
+@register_op("auc")
+def _auc(ctx):
+    """Streaming AUC via threshold buckets (reference: auc_op.cc keeps
+    TP/FP/TN/FN stat tensors across batches)."""
+    preds = ctx.input("Predict")  # (B, 2) class probabilities
+    label = ctx.input("Label").reshape(-1)
+    stat_pos = ctx.input("StatPos")  # (num_thresholds+1,)
+    stat_neg = ctx.input("StatNeg")
+    num_t = stat_pos.shape[0] - 1
+    pos_prob = preds[:, 1] if preds.ndim == 2 else preds.reshape(-1)
+    bucket = jnp.clip((pos_prob * num_t).astype(jnp.int32), 0, num_t)
+    is_pos = (label > 0).astype(stat_pos.dtype)
+    new_pos = stat_pos + jax.ops.segment_sum(is_pos, bucket, num_segments=num_t + 1)
+    new_neg = stat_neg + jax.ops.segment_sum(1 - is_pos, bucket, num_segments=num_t + 1)
+    # integrate ROC (trapezoid over buckets, descending threshold)
+    tp = jnp.cumsum(new_pos[::-1])
+    fp = jnp.cumsum(new_neg[::-1])
+    tot_pos = tp[-1]
+    tot_neg = fp[-1]
+    tpr = tp / jnp.maximum(tot_pos, 1)
+    fpr = fp / jnp.maximum(tot_neg, 1)
+    auc = jnp.trapezoid(tpr, fpr)
+    return {"AUC": auc.astype(jnp.float64) if auc.dtype == jnp.float64 else auc, "StatPosOut": new_pos, "StatNegOut": new_neg}
+
+
+@register_op("mean_iou")
+def _mean_iou(ctx):
+    preds = ctx.input("Predictions").reshape(-1).astype(jnp.int32)
+    labels = ctx.input("Labels").reshape(-1).astype(jnp.int32)
+    num_classes = ctx.attr("num_classes")
+    idx = labels * num_classes + preds
+    cm = jax.ops.segment_sum(jnp.ones_like(idx, jnp.float32), idx, num_segments=num_classes * num_classes)
+    cm = cm.reshape(num_classes, num_classes)
+    inter = jnp.diag(cm)
+    union = cm.sum(0) + cm.sum(1) - inter
+    valid = union > 0
+    iou = jnp.where(valid, inter / jnp.maximum(union, 1e-9), 0.0)
+    mean_iou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+    return {"OutMeanIou": mean_iou, "OutWrong": (union - inter).astype(jnp.int32), "OutCorrect": inter.astype(jnp.int32)}

@@ -1,0 +1,450 @@
+"""Convolution / pooling / normalization / random op kernels.
+
+Reference kernels: paddle/fluid/operators/conv_op.cc (+conv_cudnn_op.cu.cc),
+pool_op.cc, batch_norm_op.cc, layer_norm_op.cc, dropout_op.cc, lrn_op.cc,
+bilinear_interp_op.cc, gaussian_random_op.cc, uniform_random_op.cc.
+
+TPU notes: convs lower onto the MXU via lax.conv_general_dilated; we keep the
+reference's NCHW/OIHW layout semantics and let XLA's layout assignment pick
+the fastest physical layout. Batch/layer norm are plain jnp expressions that
+XLA fuses — the reference's hand-written fused CUDA kernels are unnecessary.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v,) * n
+
+
+# ---------------------------------------------------------------------------
+# convolution
+# ---------------------------------------------------------------------------
+
+
+@register_op("conv2d")
+def _conv2d(ctx):
+    x = ctx.input("Input")  # NCHW
+    w = ctx.input("Filter")  # OIHW
+    strides = _pair(ctx.attr("strides", [1, 1]))
+    pads = _pair(ctx.attr("paddings", [0, 0]))
+    dilations = _pair(ctx.attr("dilations", [1, 1]))
+    groups = ctx.attr("groups", 1) or 1
+    out = lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups,
+    )
+    return {"Output": out}
+
+
+@register_op("conv3d")
+def _conv3d(ctx):
+    x = ctx.input("Input")  # NCDHW
+    w = ctx.input("Filter")  # OIDHW
+    strides = _pair(ctx.attr("strides", [1, 1, 1]), 3)
+    pads = _pair(ctx.attr("paddings", [0, 0, 0]), 3)
+    dilations = _pair(ctx.attr("dilations", [1, 1, 1]), 3)
+    groups = ctx.attr("groups", 1) or 1
+    out = lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=strides,
+        padding=[(p, p) for p in pads],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=groups,
+    )
+    return {"Output": out}
+
+
+@register_op("conv2d_transpose")
+def _conv2d_transpose(ctx):
+    x = ctx.input("Input")  # NCHW
+    w = ctx.input("Filter")  # IOHW in paddle transpose convention
+    strides = _pair(ctx.attr("strides", [1, 1]))
+    pads = _pair(ctx.attr("paddings", [0, 0]))
+    dilations = _pair(ctx.attr("dilations", [1, 1]))
+    # deconv == gradient of conv: fractionally-strided conv via lhs_dilation
+    out = lax.conv_general_dilated(
+        x,
+        jnp.flip(w, axis=(-1, -2)),
+        window_strides=(1, 1),
+        padding=[
+            (dilations[0] * (w.shape[2] - 1) - pads[0], dilations[0] * (w.shape[2] - 1) - pads[0]),
+            (dilations[1] * (w.shape[3] - 1) - pads[1], dilations[1] * (w.shape[3] - 1) - pads[1]),
+        ],
+        lhs_dilation=strides,
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "IOHW", "NCHW"),
+    )
+    return {"Output": out}
+
+
+@register_op("conv3d_transpose")
+def _conv3d_transpose(ctx):
+    x = ctx.input("Input")
+    w = ctx.input("Filter")
+    strides = _pair(ctx.attr("strides", [1, 1, 1]), 3)
+    pads = _pair(ctx.attr("paddings", [0, 0, 0]), 3)
+    dilations = _pair(ctx.attr("dilations", [1, 1, 1]), 3)
+    pad_cfg = [
+        (dilations[i] * (w.shape[2 + i] - 1) - pads[i],) * 2 for i in range(3)
+    ]
+    out = lax.conv_general_dilated(
+        x,
+        jnp.flip(w, axis=(-1, -2, -3)),
+        window_strides=(1, 1, 1),
+        padding=pad_cfg,
+        lhs_dilation=strides,
+        rhs_dilation=dilations,
+        dimension_numbers=("NCDHW", "IODHW", "NCDHW"),
+    )
+    return {"Output": out}
+
+
+@register_op("depthwise_conv2d")
+def _depthwise_conv2d(ctx):
+    return _conv2d(ctx)
+
+
+@register_op("im2sequence")
+def _im2sequence(ctx):
+    """Extract image patches as a sequence (reference: im2sequence_op.cc).
+    Output: (batch * out_h * out_w, C*kh*kw) dense rows."""
+    x = ctx.input("X")  # NCHW
+    kernels = _pair(ctx.attr("kernels"))
+    strides = _pair(ctx.attr("strides", [1, 1]))
+    pads = ctx.attr("paddings", [0, 0, 0, 0])
+    patches = lax.conv_general_dilated_patches(
+        x,
+        filter_shape=kernels,
+        window_strides=strides,
+        padding=[(pads[0], pads[2]), (pads[1], pads[3])],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )  # (N, C*kh*kw, oh, ow)
+    n, ckk, oh, ow = patches.shape
+    out = patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, ckk)
+    return {"Out": out}
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+
+
+def _pool(ctx, spatial_dims):
+    x = ctx.input("X")
+    ptype = ctx.attr("pooling_type", "max")
+    ksize = _pair(ctx.attr("ksize"), spatial_dims)
+    strides = _pair(ctx.attr("strides", [1] * spatial_dims), spatial_dims)
+    pads = _pair(ctx.attr("paddings", [0] * spatial_dims), spatial_dims)
+    if ctx.attr("global_pooling", False):
+        ksize = x.shape[2 : 2 + spatial_dims]
+        pads = (0,) * spatial_dims
+    window = (1, 1) + tuple(ksize)
+    strides_full = (1, 1) + tuple(strides)
+    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pads)
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        out = lax.reduce_window(x, init, lax.max, window, strides_full, padding)
+    else:
+        summed = lax.reduce_window(x, 0.0, lax.add, window, strides_full, padding)
+        if ctx.attr("exclusive", True):
+            ones = jnp.ones_like(x)
+            counts = lax.reduce_window(ones, 0.0, lax.add, window, strides_full, padding)
+            out = summed / counts
+        else:
+            out = summed / float(jnp.prod(jnp.array(ksize)))
+    return {"Out": out}
+
+
+@register_op("pool2d")
+def _pool2d(ctx):
+    return _pool(ctx, 2)
+
+
+@register_op("pool3d")
+def _pool3d(ctx):
+    return _pool(ctx, 3)
+
+
+@register_op("roi_pool")
+def _roi_pool(ctx):
+    """ROI max pooling (reference: roi_pool_op.cc). Rois are dense
+    (num_rois, 5): [batch_idx, x1, y1, x2, y2]."""
+    x = ctx.input("X")  # NCHW
+    rois = ctx.input("ROIs")
+    pooled_h = ctx.attr("pooled_height")
+    pooled_w = ctx.attr("pooled_width")
+    scale = ctx.attr("spatial_scale", 1.0)
+    h, w = x.shape[2], x.shape[3]
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * scale).astype(jnp.int32)
+        y1 = jnp.round(roi[2] * scale).astype(jnp.int32)
+        x2 = jnp.maximum(jnp.round(roi[3] * scale).astype(jnp.int32), x1 + 1)
+        y2 = jnp.maximum(jnp.round(roi[4] * scale).astype(jnp.int32), y1 + 1)
+        img = x[b]  # (C, H, W)
+        ys = jnp.arange(h)
+        xs = jnp.arange(w)
+        bin_h = (y2 - y1).astype(jnp.float32) / pooled_h
+        bin_w = (x2 - x1).astype(jnp.float32) / pooled_w
+        ybin = jnp.clip(((ys - y1) / jnp.maximum(bin_h, 1e-6)).astype(jnp.int32), 0, pooled_h - 1)
+        xbin = jnp.clip(((xs - x1) / jnp.maximum(bin_w, 1e-6)).astype(jnp.int32), 0, pooled_w - 1)
+        valid_y = (ys >= y1) & (ys < y2)
+        valid_x = (xs >= x1) & (xs < x2)
+        mask = valid_y[:, None] & valid_x[None, :]
+        neg = jnp.full_like(img, -jnp.inf)
+        masked = jnp.where(mask[None], img, neg)
+        onehot_y = jax.nn.one_hot(ybin, pooled_h).T  # (ph, H)
+        onehot_x = jax.nn.one_hot(xbin, pooled_w).T  # (pw, W)
+        # gather-max: iterate bins statically (pooled sizes are small, static)
+        outs = []
+        for i in range(pooled_h):
+            row_mask = onehot_y[i].astype(bool)
+            rows = jnp.where(row_mask[None, :, None], masked, -jnp.inf)
+            for j in range(pooled_w):
+                col_mask = onehot_x[j].astype(bool)
+                cell = jnp.where(col_mask[None, None, :], rows, -jnp.inf)
+                outs.append(cell.max(axis=(1, 2)))
+        out = jnp.stack(outs, axis=1).reshape(img.shape[0], pooled_h, pooled_w)
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    return {"Out": jax.vmap(one_roi)(rois)}
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+
+@register_op("batch_norm")
+def _batch_norm(ctx):
+    x = ctx.input("X")
+    scale = ctx.input("Scale")
+    bias = ctx.input("Bias")
+    mean = ctx.input("Mean")
+    var = ctx.input("Variance")
+    eps = ctx.attr("epsilon", 1e-5)
+    momentum = ctx.attr("momentum", 0.9)
+    layout = ctx.attr("data_layout", "NCHW")
+    is_test = ctx.attr("is_test", False)
+
+    c_axis = 1 if layout == "NCHW" else x.ndim - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    bshape = [1] * x.ndim
+    bshape[c_axis] = x.shape[c_axis]
+
+    if is_test:
+        use_mean, use_var = mean, var
+        mean_out, var_out = mean, var
+        saved_mean = mean
+        saved_var = var
+    else:
+        use_mean = jnp.mean(x, axis=reduce_axes)
+        use_var = jnp.var(x, axis=reduce_axes)
+        mean_out = momentum * mean + (1 - momentum) * use_mean
+        var_out = momentum * var + (1 - momentum) * use_var
+        saved_mean = use_mean
+        saved_var = use_var
+
+    inv = lax.rsqrt(use_var + eps)
+    y = (x - use_mean.reshape(bshape)) * inv.reshape(bshape) * scale.reshape(bshape) + bias.reshape(bshape)
+    return {
+        "Y": y,
+        "MeanOut": mean_out,
+        "VarianceOut": var_out,
+        "SavedMean": saved_mean,
+        "SavedVariance": saved_var,
+    }
+
+
+@register_op("layer_norm")
+def _layer_norm(ctx):
+    x = ctx.input("X")
+    eps = ctx.attr("epsilon", 1e-5)
+    begin = ctx.attr("begin_norm_axis", 1)
+    axes = tuple(range(begin, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + eps)
+    if ctx.has_input("Scale"):
+        y = y * ctx.input("Scale").reshape(x.shape[begin:])
+    if ctx.has_input("Bias"):
+        y = y + ctx.input("Bias").reshape(x.shape[begin:])
+    return {"Y": y, "Mean": mean.reshape(x.shape[:begin]), "Variance": var.reshape(x.shape[:begin])}
+
+
+@register_op("lrn")
+def _lrn(ctx):
+    x = ctx.input("X")  # NCHW
+    n = ctx.attr("n", 5)
+    k = ctx.attr("k", 2.0)
+    alpha = ctx.attr("alpha", 1e-4)
+    beta = ctx.attr("beta", 0.75)
+    sq = jnp.square(x)
+    half = n // 2
+    padded = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    acc = jnp.zeros_like(x)
+    for i in range(n):
+        acc = acc + padded[:, i : i + x.shape[1]]
+    mid = k + alpha * acc
+    return {"Out": x / jnp.power(mid, beta), "MidOut": mid}
+
+
+@register_op("norm")
+def _norm(ctx):
+    x = ctx.input("X")
+    axis = ctx.attr("axis", 1)
+    eps = ctx.attr("epsilon", 1e-10)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return {"Out": x / norm, "Norm": norm}
+
+
+# ---------------------------------------------------------------------------
+# dropout & random
+# ---------------------------------------------------------------------------
+
+
+@register_op("dropout")
+def _dropout(ctx):
+    x = ctx.input("X")
+    p = ctx.attr("dropout_prob", 0.5)
+    impl = ctx.attr("dropout_implementation", "downgrade_in_infer")
+    if ctx.attr("is_test", False) or p == 0.0:
+        # reference dropout_op.h: downgrade_in_infer scales by (1-p) at
+        # inference; upscale_in_train is identity at inference.
+        if p != 0.0 and impl == "downgrade_in_infer":
+            return {"Out": x * (1.0 - p), "Mask": jnp.ones_like(x)}
+        return {"Out": x, "Mask": jnp.ones_like(x)}
+    key = ctx.rng()
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    if impl == "upscale_in_train":
+        out = jnp.where(keep, x / (1.0 - p), 0.0)
+    else:  # reference default: scale at inference instead (but inference
+        # multiplies by (1-p) there; train just masks)
+        out = jnp.where(keep, x, 0.0)
+    return {"Out": out, "Mask": keep.astype(x.dtype)}
+
+
+@register_op("gaussian_random")
+def _gaussian_random(ctx):
+    from ..framework.dtypes import as_numpy_dtype
+
+    shape = ctx.attr("shape")
+    mean = ctx.attr("mean", 0.0)
+    std = ctx.attr("std", 1.0)
+    dtype = as_numpy_dtype(ctx.attr("dtype", "float32"))
+    key = ctx.rng()
+    return {"Out": (mean + std * jax.random.normal(key, tuple(shape))).astype(dtype)}
+
+
+@register_op("uniform_random")
+def _uniform_random(ctx):
+    from ..framework.dtypes import as_numpy_dtype
+
+    shape = ctx.attr("shape")
+    lo = ctx.attr("min", -1.0)
+    hi = ctx.attr("max", 1.0)
+    dtype = as_numpy_dtype(ctx.attr("dtype", "float32"))
+    key = ctx.rng()
+    return {"Out": jax.random.uniform(key, tuple(shape), minval=lo, maxval=hi).astype(dtype)}
+
+
+@register_op("truncated_gaussian_random")
+def _truncated_gaussian_random(ctx):
+    from ..framework.dtypes import as_numpy_dtype
+
+    shape = ctx.attr("shape")
+    mean = ctx.attr("mean", 0.0)
+    std = ctx.attr("std", 1.0)
+    dtype = as_numpy_dtype(ctx.attr("dtype", "float32"))
+    key = ctx.rng()
+    out = mean + std * jax.random.truncated_normal(key, -2.0, 2.0, tuple(shape))
+    return {"Out": out.astype(dtype)}
+
+
+@register_op("random_crop")
+def _random_crop(ctx):
+    x = ctx.input("X")
+    shape = ctx.attr("shape")  # crop shape for trailing dims
+    key = ctx.rng()
+    lead = x.ndim - len(shape)
+    starts = []
+    keys = jax.random.split(key, len(shape))
+    slices = [slice(None)] * lead
+    out = x
+    for i, (s, k) in enumerate(zip(shape, keys)):
+        dim = lead + i
+        max_start = x.shape[dim] - s
+        st = jax.random.randint(k, (), 0, max_start + 1)
+        out = lax.dynamic_slice_in_dim(out, st, s, axis=dim)
+    return {"Out": out}
+
+
+@register_op("sampling_id")
+def _sampling_id(ctx):
+    x = ctx.input("X")  # (batch, classes) probabilities
+    key = ctx.rng()
+    return {"Out": jax.random.categorical(key, jnp.log(jnp.maximum(x, 1e-20)), axis=-1)}
+
+
+# ---------------------------------------------------------------------------
+# image resize
+# ---------------------------------------------------------------------------
+
+
+@register_op("bilinear_interp")
+def _bilinear_interp(ctx):
+    """Bilinear up/down-sampling with the reference's align-corners ratio
+    (reference: bilinear_interp_op.cc: ratio = (in-1)/(out-1))."""
+    x = ctx.input("X")  # NCHW
+    out_h = ctx.attr("out_h")
+    out_w = ctx.attr("out_w")
+    if ctx.has_input("OutSize"):
+        pass  # dynamic out size unsupported under jit; attr path only
+    n, c, h, w = x.shape
+    ratio_h = (h - 1.0) / (out_h - 1.0) if out_h > 1 else 0.0
+    ratio_w = (w - 1.0) / (out_w - 1.0) if out_w > 1 else 0.0
+    ys = jnp.arange(out_h) * ratio_h
+    xs = jnp.arange(out_w) * ratio_w
+    y0 = jnp.floor(ys).astype(jnp.int32)
+    x0 = jnp.floor(xs).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, h - 1)
+    x1 = jnp.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[None, None, :, None]
+    wx = (xs - x0)[None, None, None, :]
+    v00 = x[:, :, y0][:, :, :, x0]
+    v01 = x[:, :, y0][:, :, :, x1]
+    v10 = x[:, :, y1][:, :, :, x0]
+    v11 = x[:, :, y1][:, :, :, x1]
+    out = (
+        v00 * (1 - wy) * (1 - wx)
+        + v01 * (1 - wy) * wx
+        + v10 * wy * (1 - wx)
+        + v11 * wy * wx
+    )
+    return {"Out": out}
+
+
+@register_op("nearest_interp")
+def _nearest_interp(ctx):
+    x = ctx.input("X")
+    out_h, out_w = ctx.attr("out_h"), ctx.attr("out_w")
+    n, c, h, w = x.shape
+    ys = jnp.minimum(jnp.round(jnp.arange(out_h) * (h / out_h)).astype(jnp.int32), h - 1)
+    xs = jnp.minimum(jnp.round(jnp.arange(out_w) * (w / out_w)).astype(jnp.int32), w - 1)
+    return {"Out": x[:, :, ys][:, :, :, xs]}

@@ -1,0 +1,159 @@
+"""Optimizer op kernels.
+
+Reference kernels: paddle/fluid/operators/{sgd,momentum,adam,adamax,adagrad,
+adadelta,decayed_adagrad,rmsprop,ftrl}_op.cc. Each kernel is a pure
+functional state update; the executor writes outputs back into the Scope, so
+Param/Moment "in-place" outputs behave exactly like the reference's
+in-place updates — but the whole optimizer pass fuses into the one XLA
+training-step computation (no per-op kernel launches).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+
+@register_op("sgd")
+def _sgd(ctx):
+    p = ctx.input("Param")
+    g = ctx.input("Grad")
+    lr = ctx.input("LearningRate").reshape(())
+    return {"ParamOut": p - lr * g.astype(p.dtype)}
+
+
+@register_op("momentum")
+def _momentum(ctx):
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    v = ctx.input("Velocity")
+    lr = ctx.input("LearningRate").reshape(())
+    mu = ctx.attr("mu")
+    v_new = mu * v + g
+    if ctx.attr("use_nesterov", False):
+        p_new = p - (g + mu * v_new) * lr
+    else:
+        p_new = p - lr * v_new
+    return {"ParamOut": p_new, "VelocityOut": v_new}
+
+
+@register_op("adam")
+def _adam(ctx):
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    m, v = ctx.input("Moment1"), ctx.input("Moment2")
+    lr = ctx.input("LearningRate").reshape(())
+    b1p = ctx.input("Beta1Pow").reshape(())
+    b2p = ctx.input("Beta2Pow").reshape(())
+    b1 = ctx.attr("beta1", 0.9)
+    b2 = ctx.attr("beta2", 0.999)
+    eps = ctx.attr("epsilon", 1e-8)
+    g = g.astype(p.dtype)
+    m_new = b1 * m + (1 - b1) * g
+    v_new = b2 * v + (1 - b2) * jnp.square(g)
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    p_new = p - lr_t * m_new / (jnp.sqrt(v_new) + eps)
+    return {
+        "ParamOut": p_new,
+        "Moment1Out": m_new,
+        "Moment2Out": v_new,
+        "Beta1PowOut": b1p * b1,
+        "Beta2PowOut": b2p * b2,
+    }
+
+
+@register_op("adamax")
+def _adamax(ctx):
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    m, inf = ctx.input("Moment"), ctx.input("InfNorm")
+    lr = ctx.input("LearningRate").reshape(())
+    b1p = ctx.input("Beta1Pow").reshape(())
+    b1 = ctx.attr("beta1", 0.9)
+    b2 = ctx.attr("beta2", 0.999)
+    eps = ctx.attr("epsilon", 1e-8)
+    m_new = b1 * m + (1 - b1) * g
+    inf_new = jnp.maximum(b2 * inf, jnp.abs(g))
+    lr_t = lr / (1 - b1p)
+    p_new = p - lr_t * m_new / (inf_new + eps)
+    return {
+        "ParamOut": p_new,
+        "MomentOut": m_new,
+        "InfNormOut": inf_new,
+        "Beta1PowOut": b1p * b1,
+    }
+
+
+@register_op("adagrad")
+def _adagrad(ctx):
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    m = ctx.input("Moment")
+    lr = ctx.input("LearningRate").reshape(())
+    eps = ctx.attr("epsilon", 1e-6)
+    m_new = m + jnp.square(g)
+    p_new = p - lr * g / (jnp.sqrt(m_new) + eps)
+    return {"ParamOut": p_new, "MomentOut": m_new}
+
+
+@register_op("decayed_adagrad")
+def _decayed_adagrad(ctx):
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    m = ctx.input("Moment")
+    lr = ctx.input("LearningRate").reshape(())
+    decay = ctx.attr("decay", 0.95)
+    eps = ctx.attr("epsilon", 1e-6)
+    m_new = decay * m + (1 - decay) * jnp.square(g)
+    p_new = p - lr * g / (jnp.sqrt(m_new) + eps)
+    return {"ParamOut": p_new, "MomentOut": m_new}
+
+
+@register_op("adadelta")
+def _adadelta(ctx):
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    avg_sq_grad = ctx.input("AvgSquaredGrad")
+    avg_sq_upd = ctx.input("AvgSquaredUpdate")
+    rho = ctx.attr("rho", 0.95)
+    eps = ctx.attr("epsilon", 1e-6)
+    asg_new = rho * avg_sq_grad + (1 - rho) * jnp.square(g)
+    update = -jnp.sqrt((avg_sq_upd + eps) / (asg_new + eps)) * g
+    asu_new = rho * avg_sq_upd + (1 - rho) * jnp.square(update)
+    return {
+        "ParamOut": p + update,
+        "AvgSquaredGradOut": asg_new,
+        "AvgSquaredUpdateOut": asu_new,
+    }
+
+
+@register_op("rmsprop")
+def _rmsprop(ctx):
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    ms = ctx.input("MeanSquare")
+    mom = ctx.input("Moment")
+    lr = ctx.input("LearningRate").reshape(())
+    rho = ctx.attr("decay", 0.9)
+    mu = ctx.attr("momentum", 0.0)
+    eps = ctx.attr("epsilon", 1e-10)
+    ms_new = rho * ms + (1 - rho) * jnp.square(g)
+    mom_new = mu * mom + lr * g / jnp.sqrt(ms_new + eps)
+    return {"ParamOut": p - mom_new, "MeanSquareOut": ms_new, "MomentOut": mom_new}
+
+
+@register_op("ftrl")
+def _ftrl(ctx):
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    sq_accum = ctx.input("SquaredAccumulator")
+    lin_accum = ctx.input("LinearAccumulator")
+    lr = ctx.input("LearningRate").reshape(())
+    l1 = ctx.attr("l1", 0.0)
+    l2 = ctx.attr("l2", 0.0)
+    power = ctx.attr("lr_power", -0.5)
+    new_accum = sq_accum + jnp.square(g)
+    if power == -0.5:
+        lin_new = lin_accum + g - (jnp.sqrt(new_accum) - jnp.sqrt(sq_accum)) / lr * p
+    else:
+        lin_new = lin_accum + g - (jnp.power(new_accum, -power) - jnp.power(sq_accum, -power)) / lr * p
+    x = l1 * jnp.sign(lin_new) - lin_new
+    if power == -0.5:
+        y = jnp.sqrt(new_accum) / lr + 2 * l2
+    else:
+        y = jnp.power(new_accum, -power) / lr + 2 * l2
+    p_new = jnp.where(jnp.abs(lin_new) > l1, x / y, jnp.zeros_like(p))
+    return {"ParamOut": p_new, "SquaredAccumOut": new_accum, "LinearAccumOut": lin_new}

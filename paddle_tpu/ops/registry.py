@@ -1,0 +1,113 @@
+"""Operator kernel registry.
+
+The reference registers per-device C++ kernels through OpKernelType and a
+global OpInfoMap (reference: paddle/fluid/framework/op_registry.h,
+op_info.cc). Here every op has exactly ONE implementation: a pure function
+from JAX arrays to JAX arrays. The tracer (framework/trace.py) calls these
+while tracing a Block, and XLA compiles + fuses the whole program — there is
+no per-op dispatch at run time.
+
+Kernel signature::
+
+    @register_op("relu")
+    def relu(ctx):
+        return {"Out": jnp.maximum(ctx.input("X"), 0)}
+
+``ctx`` (OpContext) gives inputs, attrs, output var metadata, a PRNG stream,
+and a callback to trace sub-blocks (control flow).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+KERNELS: Dict[str, Callable] = {}
+
+# ops that need train/test awareness, rng, etc. can inspect ctx freely.
+
+
+def register_op(op_type: str):
+    def deco(fn):
+        if op_type in KERNELS:
+            raise ValueError("duplicate kernel for op %r" % op_type)
+        KERNELS[op_type] = fn
+        return fn
+
+    return deco
+
+
+def get_kernel(op_type: str) -> Callable:
+    if op_type not in KERNELS:
+        raise NotImplementedError(
+            "no TPU kernel registered for op %r (registered: %d ops)"
+            % (op_type, len(KERNELS))
+        )
+    return KERNELS[op_type]
+
+
+def op_support_tpu(op_type: str) -> bool:
+    """Reference parity with core.op_support_gpu (pybind/pybind.cc)."""
+    return op_type in KERNELS
+
+
+def registered_ops() -> List[str]:
+    return sorted(KERNELS)
+
+
+class OpContext:
+    """Per-op view handed to a kernel during tracing."""
+
+    def __init__(self, op, env, rng_fn, subblock_fn=None, block=None):
+        self._op = op
+        self._env = env
+        self._rng_fn = rng_fn
+        self._subblock_fn = subblock_fn
+        self._block = block
+
+    # -- inputs ---------------------------------------------------------
+    def input(self, slot: str, default=None):
+        names = self._op.input(slot)
+        if not names:
+            return default
+        return self._env[names[0]]
+
+    def inputs(self, slot: str) -> list:
+        return [self._env[n] for n in self._op.input(slot)]
+
+    def has_input(self, slot: str) -> bool:
+        return bool(self._op.input(slot))
+
+    def input_name(self, slot: str) -> Optional[str]:
+        names = self._op.input(slot)
+        return names[0] if names else None
+
+    # -- attrs / metadata ------------------------------------------------
+    def attr(self, name: str, default=None):
+        return self._op.attr(name, default)
+
+    @property
+    def op(self):
+        return self._op
+
+    def out_var(self, slot: str, idx: int = 0):
+        """Variable metadata (shape/dtype) for an output slot."""
+        name = self._op.output(slot)[idx]
+        return self._block.var(name)
+
+    def out_dtype(self, slot: str = "Out"):
+        import numpy as np
+
+        from ..framework.dtypes import as_numpy_dtype
+
+        return as_numpy_dtype(self.out_var(slot).dtype)
+
+    # -- services --------------------------------------------------------
+    def rng(self):
+        """A fresh jax PRNG key for this op invocation."""
+        return self._rng_fn()
+
+    def trace_subblock(self, block_idx: int, env: dict) -> dict:
+        return self._subblock_fn(block_idx, env)
+
+    @property
+    def is_test(self) -> bool:
+        return bool(self._op.attr("is_test", False))

@@ -1,0 +1,245 @@
+"""Recurrent op kernels: LSTM / GRU via lax.scan.
+
+Reference kernels: paddle/fluid/operators/lstm_op.cc, gru_op.cc,
+lstm_unit_op.cc, gru_unit_op.cc. The reference walks LoD-batched sequences
+with a sequence2batch scheduler; on TPU we use dense (batch, time, ...)
+tensors, a `lax.scan` over time (compiled once, unrolled by XLA), and a
+length mask to freeze state past each sequence's end. Gate matmuls are
+batched so every step is one MXU matmul.
+
+Gate order convention: [input, forget, cell(candidate), output] for LSTM,
+[update(z), reset(r), candidate(c)] for GRU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+_ACT = {
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "identity": lambda x: x,
+}
+
+
+@register_op("lstm")
+def _lstm(ctx):
+    """Input: (batch, time, 4*hidden) pre-projected gates; Weight: (hidden,
+    4*hidden) recurrent weights; Bias: (4*hidden,) or (7*hidden,) with
+    peepholes. Optional Lengths: (batch,) int32."""
+    x = ctx.input("Input")
+    w = ctx.input("Weight")
+    bias = ctx.input("Bias")
+    lengths = ctx.input("Lengths")
+    hidden = w.shape[0]
+    gate_act = _ACT[ctx.attr("gate_activation", "sigmoid")]
+    cell_act = _ACT[ctx.attr("cell_activation", "tanh")]
+    cand_act = _ACT[ctx.attr("candidate_activation", "tanh")]
+    use_peepholes = ctx.attr("use_peepholes", False)
+    is_reverse = ctx.attr("is_reverse", False)
+
+    batch, time = x.shape[0], x.shape[1]
+    if bias is not None:
+        b_gates = bias[..., : 4 * hidden].reshape(4 * hidden)
+        if use_peepholes:
+            w_ic = bias[..., 4 * hidden : 5 * hidden].reshape(hidden)
+            w_fc = bias[..., 5 * hidden : 6 * hidden].reshape(hidden)
+            w_oc = bias[..., 6 * hidden : 7 * hidden].reshape(hidden)
+    else:
+        b_gates = jnp.zeros((4 * hidden,), x.dtype)
+
+    h0 = ctx.input("H0")
+    c0 = ctx.input("C0")
+    if h0 is None:
+        h0 = jnp.zeros((batch, hidden), x.dtype)
+    if c0 is None:
+        c0 = jnp.zeros((batch, hidden), x.dtype)
+
+    xs = jnp.swapaxes(x, 0, 1)  # (time, batch, 4H)
+    if is_reverse:
+        xs = jnp.flip(xs, 0)
+    ts = jnp.arange(time)
+    if is_reverse:
+        ts = jnp.flip(ts, 0)
+
+    def step(carry, inp):
+        h, c = carry
+        xt, t = inp
+        gates = xt + h @ w + b_gates
+        gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
+        if use_peepholes:
+            gi = gi + c * w_ic
+            gf = gf + c * w_fc
+        i = gate_act(gi)
+        f = gate_act(gf)
+        c_new = f * c + i * cand_act(gc)
+        if use_peepholes:
+            go = go + c_new * w_oc
+        o = gate_act(go)
+        h_new = o * cell_act(c_new)
+        if lengths is not None:
+            valid = (t < lengths)[:, None]
+            h_new = jnp.where(valid, h_new, h)
+            c_new = jnp.where(valid, c_new, c)
+        return (h_new, c_new), (h_new, c_new)
+
+    (hT, cT), (hs, cs) = lax.scan(step, (h0, c0), (xs, ts))
+    if is_reverse:
+        hs, cs = jnp.flip(hs, 0), jnp.flip(cs, 0)
+    return {
+        "Hidden": jnp.swapaxes(hs, 0, 1),
+        "Cell": jnp.swapaxes(cs, 0, 1),
+        "LastHidden": hT,
+        "LastCell": cT,
+    }
+
+
+@register_op("gru")
+def _gru(ctx):
+    """Input: (batch, time, 3*hidden) pre-projected; Weight: (hidden,
+    3*hidden) laid out [W_z | W_r | W_c]; optional Bias (3*hidden,)."""
+    x = ctx.input("Input")
+    w = ctx.input("Weight")
+    bias = ctx.input("Bias")
+    lengths = ctx.input("Lengths")
+    hidden = w.shape[0]
+    gate_act = _ACT[ctx.attr("gate_activation", "sigmoid")]
+    cand_act = _ACT[ctx.attr("activation", "tanh")]
+    is_reverse = ctx.attr("is_reverse", False)
+
+    batch, time = x.shape[0], x.shape[1]
+    b = bias.reshape(3 * hidden) if bias is not None else jnp.zeros((3 * hidden,), x.dtype)
+    w_zr = w[:, : 2 * hidden]
+    w_c = w[:, 2 * hidden :]
+
+    h0 = ctx.input("H0")
+    if h0 is None:
+        h0 = jnp.zeros((batch, hidden), x.dtype)
+
+    xs = jnp.swapaxes(x, 0, 1)
+    if is_reverse:
+        xs = jnp.flip(xs, 0)
+    ts = jnp.arange(time)
+    if is_reverse:
+        ts = jnp.flip(ts, 0)
+
+    def step(h, inp):
+        xt, t = inp
+        xz, xr, xc = jnp.split(xt + b, 3, axis=-1)
+        zr = gate_act(jnp.concatenate([xz, xr], -1) + h @ w_zr)
+        z, r = jnp.split(zr, 2, axis=-1)
+        c = cand_act(xc + (r * h) @ w_c)
+        h_new = (1 - z) * h + z * c
+        if lengths is not None:
+            valid = (t < lengths)[:, None]
+            h_new = jnp.where(valid, h_new, h)
+        return h_new, h_new
+
+    hT, hs = lax.scan(step, h0, (xs, ts))
+    if is_reverse:
+        hs = jnp.flip(hs, 0)
+    return {"Hidden": jnp.swapaxes(hs, 0, 1), "LastHidden": hT}
+
+
+@register_op("lstmp")
+def _lstmp(ctx):
+    """LSTM with recurrent projection (reference: lstmp_op.cc). Input:
+    (batch, time, 4H) pre-projected; Weight: (P, 4H); ProjWeight: (H, P)."""
+    x = ctx.input("Input")
+    w = ctx.input("Weight")
+    w_proj = ctx.input("ProjWeight")
+    bias = ctx.input("Bias")
+    lengths = ctx.input("Lengths")
+    hidden = w_proj.shape[0]
+    proj = w_proj.shape[1]
+    gate_act = _ACT[ctx.attr("gate_activation", "sigmoid")]
+    cell_act = _ACT[ctx.attr("cell_activation", "tanh")]
+    cand_act = _ACT[ctx.attr("candidate_activation", "tanh")]
+    proj_act = _ACT[ctx.attr("proj_activation", "tanh")]
+    use_peepholes = ctx.attr("use_peepholes", False)
+    is_reverse = ctx.attr("is_reverse", False)
+
+    batch, time = x.shape[0], x.shape[1]
+    if bias is not None:
+        b_gates = bias[..., : 4 * hidden].reshape(4 * hidden)
+        if use_peepholes:
+            w_ic = bias[..., 4 * hidden : 5 * hidden].reshape(hidden)
+            w_fc = bias[..., 5 * hidden : 6 * hidden].reshape(hidden)
+            w_oc = bias[..., 6 * hidden : 7 * hidden].reshape(hidden)
+    else:
+        b_gates = jnp.zeros((4 * hidden,), x.dtype)
+
+    r0 = jnp.zeros((batch, proj), x.dtype)
+    c0 = jnp.zeros((batch, hidden), x.dtype)
+    xs = jnp.swapaxes(x, 0, 1)
+    if is_reverse:
+        xs = jnp.flip(xs, 0)
+    ts = jnp.arange(time)
+    if is_reverse:
+        ts = jnp.flip(ts, 0)
+
+    def step(carry, inp):
+        r, c = carry
+        xt, t = inp
+        gates = xt + r @ w + b_gates
+        gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
+        if use_peepholes:
+            gi = gi + c * w_ic
+            gf = gf + c * w_fc
+        i = gate_act(gi)
+        f = gate_act(gf)
+        c_new = f * c + i * cand_act(gc)
+        if use_peepholes:
+            go = go + c_new * w_oc
+        o = gate_act(go)
+        h_new = o * cell_act(c_new)
+        r_new = proj_act(h_new @ w_proj)
+        if lengths is not None:
+            valid = (t < lengths)[:, None]
+            r_new = jnp.where(valid, r_new, r)
+            c_new = jnp.where(valid, c_new, c)
+        return (r_new, c_new), (r_new, c_new)
+
+    (_, _), (rs, cs) = lax.scan(step, (r0, c0), (xs, ts))
+    if is_reverse:
+        rs, cs = jnp.flip(rs, 0), jnp.flip(cs, 0)
+    return {"Projection": jnp.swapaxes(rs, 0, 1), "Cell": jnp.swapaxes(cs, 0, 1)}
+
+
+@register_op("lstm_unit")
+def _lstm_unit(ctx):
+    """Single LSTM cell step (reference: lstm_unit_op.cc). X: (batch, 4H)
+    pre-activation gates; C_prev: (batch, H)."""
+    x = ctx.input("X")
+    c_prev = ctx.input("C_prev")
+    forget_bias = ctx.attr("forget_bias", 0.0)
+    i, f, c, o = jnp.split(x, 4, axis=-1)
+    new_c = c_prev * jax.nn.sigmoid(f + forget_bias) + jax.nn.sigmoid(i) * jnp.tanh(c)
+    new_h = jnp.tanh(new_c) * jax.nn.sigmoid(o)
+    return {"C": new_c, "H": new_h}
+
+
+@register_op("gru_unit")
+def _gru_unit(ctx):
+    """Single GRU step (reference: gru_unit_op.cc). Input: (batch, 3H)
+    pre-projected; HiddenPrev: (batch, H); Weight: (H, 3H)."""
+    x = ctx.input("Input")
+    h_prev = ctx.input("HiddenPrev")
+    w = ctx.input("Weight")
+    bias = ctx.input("Bias")
+    hidden = h_prev.shape[-1]
+    gate_act = _ACT[{1: "sigmoid", 0: "identity", 2: "tanh", 3: "relu"}.get(ctx.attr("gate_activation", 1), "sigmoid")] if isinstance(ctx.attr("gate_activation", 1), int) else _ACT[ctx.attr("gate_activation", "sigmoid")]
+    act = _ACT[{1: "sigmoid", 0: "identity", 2: "tanh", 3: "relu"}.get(ctx.attr("activation", 2), "tanh")] if isinstance(ctx.attr("activation", 2), int) else _ACT[ctx.attr("activation", "tanh")]
+    if bias is not None:
+        x = x + bias.reshape(-1)
+    xz, xr, xc = x[:, :hidden], x[:, hidden : 2 * hidden], x[:, 2 * hidden :]
+    w_zr, w_c = w[:, : 2 * hidden], w[:, 2 * hidden :]
+    zr = gate_act(jnp.concatenate([xz, xr], -1) + h_prev @ w_zr)
+    z, r = zr[:, :hidden], zr[:, hidden:]
+    c = act(xc + (r * h_prev) @ w_c)
+    h = (1 - z) * h_prev + z * c
+    return {"Hidden": h, "Gate": jnp.concatenate([zr, c], -1), "ResetHiddenPrev": r * h_prev}

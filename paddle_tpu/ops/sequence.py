@@ -1,0 +1,175 @@
+"""Sequence op kernels — dense (batch, time, ...) + length-mask semantics.
+
+The reference implements these over LoD tensors (ragged batches flattened to
+(sum_len, d) with offset tables — e.g. sequence_pool_op.cc,
+sequence_conv_op.cc, sequence_softmax_op.cc). Ragged layouts defeat XLA's
+static shapes, so here every sequence tensor is a dense padded (batch, time,
+...) array with an int32 ``Lengths`` companion; masking replaces LoD offsets.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+
+def _time_mask(lengths, time, dtype=jnp.float32):
+    # (B, T) 1.0 where t < len
+    return (jnp.arange(time)[None, :] < lengths[:, None]).astype(dtype)
+
+
+@register_op("sequence_pool")
+def _sequence_pool(ctx):
+    x = ctx.input("X")  # (B, T, D)
+    lengths = ctx.input("Lengths")
+    ptype = ctx.attr("pooltype", "AVERAGE").upper()
+    b, t = x.shape[0], x.shape[1]
+    if lengths is None:
+        lengths = jnp.full((b,), t, jnp.int32)
+    mask = _time_mask(lengths, t, x.dtype)[..., None]
+    if ptype == "SUM":
+        out = jnp.sum(x * mask, axis=1)
+    elif ptype == "AVERAGE":
+        out = jnp.sum(x * mask, axis=1) / jnp.maximum(lengths[:, None], 1).astype(x.dtype)
+    elif ptype == "SQRT":
+        out = jnp.sum(x * mask, axis=1) / jnp.sqrt(jnp.maximum(lengths[:, None], 1).astype(x.dtype))
+    elif ptype == "MAX":
+        neg = jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        out = jnp.max(jnp.where(mask > 0, x, neg), axis=1)
+    elif ptype == "LAST":
+        idx = jnp.maximum(lengths - 1, 0)
+        out = jnp.take_along_axis(x, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise NotImplementedError(ptype)
+    return {"Out": out}
+
+
+@register_op("sequence_softmax")
+def _sequence_softmax(ctx):
+    x = ctx.input("X")  # (B, T) or (B, T, 1)
+    lengths = ctx.input("Lengths")
+    squeeze = x.ndim == 3 and x.shape[-1] == 1
+    v = x[..., 0] if squeeze else x
+    t = v.shape[1]
+    if lengths is None:
+        mask = jnp.ones_like(v, dtype=bool)
+    else:
+        mask = jnp.arange(t)[None, :] < lengths[:, None]
+    neg = jnp.finfo(v.dtype).min
+    out = jax.nn.softmax(jnp.where(mask, v, neg), axis=1)
+    out = jnp.where(mask, out, 0.0)
+    return {"Out": out[..., None] if squeeze else out}
+
+
+@register_op("sequence_mask")
+def _sequence_mask(ctx):
+    from ..framework.dtypes import as_numpy_dtype
+
+    x = ctx.input("X")  # lengths (B,)
+    maxlen = ctx.attr("maxlen", -1)
+    if maxlen < 0:
+        raise ValueError("sequence_mask requires static maxlen on TPU")
+    dtype = as_numpy_dtype(ctx.attr("out_dtype", "int64"))
+    return {"Y": (jnp.arange(maxlen)[None, :] < x.reshape(-1)[:, None]).astype(dtype)}
+
+
+@register_op("sequence_expand")
+def _sequence_expand(ctx):
+    """Dense analog of sequence_expand (reference: sequence_expand_op.cc):
+    broadcast each batch row of X across Y's time dimension."""
+    x = ctx.input("X")  # (B, D) or (B, 1, D)
+    y = ctx.input("Y")  # (B, T, ...)
+    t = y.shape[1]
+    if x.ndim == 2:
+        out = jnp.broadcast_to(x[:, None, :], (x.shape[0], t, x.shape[1]))
+    else:
+        out = jnp.broadcast_to(x, (x.shape[0], t) + x.shape[2:])
+    return {"Out": out}
+
+
+@register_op("sequence_conv")
+def _sequence_conv(ctx):
+    """Context-window projection over time (reference: sequence_conv_op.cc).
+    X: (B, T, D); Filter: (context_length*D, out_d)."""
+    x = ctx.input("X")
+    filt = ctx.input("Filter")
+    lengths = ctx.input("Lengths")
+    clen = ctx.attr("contextLength")
+    cstart = ctx.attr("contextStart", -((clen - 1) // 2))
+    b, t, d = x.shape
+    if lengths is not None:
+        x = x * _time_mask(lengths, t, x.dtype)[..., None]
+    cols = []
+    for i in range(clen):
+        off = cstart + i
+        shifted = jnp.roll(x, -off, axis=1)
+        if off >= 0:
+            valid = jnp.arange(t) < (t - off)
+        else:
+            valid = jnp.arange(t) >= (-off)
+        shifted = jnp.where(valid[None, :, None], shifted, 0.0)
+        cols.append(shifted)
+    ctx_mat = jnp.concatenate(cols, axis=-1)  # (B, T, clen*D)
+    out = ctx_mat.reshape(b * t, clen * d) @ filt
+    out = out.reshape(b, t, -1)
+    if lengths is not None:
+        out = out * _time_mask(lengths, t, out.dtype)[..., None]
+    return {"Out": out}
+
+
+@register_op("sequence_reshape")
+def _sequence_reshape(ctx):
+    x = ctx.input("X")  # (B, T, D)
+    new_dim = ctx.attr("new_dim")
+    b = x.shape[0]
+    total = x.shape[1] * x.shape[2]
+    return {"Out": x.reshape(b, total // new_dim, new_dim)}
+
+
+@register_op("sequence_pad")
+def _sequence_pad(ctx):
+    # dense convention: input already padded; just forward with lengths out
+    x = ctx.input("X")
+    lengths = ctx.input("Lengths")
+    if lengths is None:
+        lengths = jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+    return {"Out": x, "Length": lengths.astype(jnp.int64)}
+
+
+@register_op("sequence_unpad")
+def _sequence_unpad(ctx):
+    return {"Out": ctx.input("X")}
+
+
+@register_op("sequence_expand_as")
+def _sequence_expand_as(ctx):
+    return _sequence_expand(ctx)
+
+
+@register_op("sequence_slice")
+def _sequence_slice(ctx):
+    x = ctx.input("X")
+    offset = ctx.attr("offset")
+    length = ctx.attr("length")
+    return {"Out": lax.dynamic_slice_in_dim(x, offset, length, axis=1)}
+
+
+@register_op("sequence_concat")
+def _sequence_concat(ctx):
+    return {"Out": jnp.concatenate(ctx.inputs("X"), axis=1)}
+
+
+@register_op("sequence_erase")
+def _sequence_erase(ctx):
+    """Mark erased tokens (reference erases them; dense layout keeps shape —
+    erased positions are replaced with pad id 0 and lengths unchanged)."""
+    x = ctx.input("X")
+    tokens = ctx.attr("tokens", [])
+    keep = jnp.ones(x.shape, bool)
+    for tok in tokens:
+        keep = keep & (x != tok)
+    return {"Out": jnp.where(keep, x, 0)}

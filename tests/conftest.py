@@ -1,0 +1,40 @@
+import os
+
+# Force an 8-device virtual CPU mesh so parallel/sharding tests run without
+# TPU hardware (the driver dry-runs the real multi-chip path separately).
+# NOTE: in this container an `axon` TPU-tunnel PJRT plugin force-selects
+# itself via sitecustomize (it overrides JAX_PLATFORMS at import time), so
+# the env var alone is not enough — jax.config must be updated after import.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    """Each test gets fresh default programs / scope / name counters."""
+    import paddle_tpu as fluid
+    from paddle_tpu.framework import unique_name
+    from paddle_tpu.framework.core import Program, switch_main_program, switch_startup_program
+    from paddle_tpu.framework.scope import Scope, scope_guard
+
+    prev_main = switch_main_program(Program())
+    prev_startup = switch_startup_program(Program())
+    with scope_guard(Scope()):
+        with unique_name.guard():
+            yield
+    switch_main_program(prev_main)
+    switch_startup_program(prev_startup)
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)

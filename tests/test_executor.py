@@ -1,0 +1,121 @@
+"""Executor + framework core end-to-end tests (modeled on the reference's
+python/paddle/fluid/tests/unittests/test_executor_and_mul.py etc.)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def test_fill_and_fetch():
+    x = fluid.layers.fill_constant(shape=[2, 3], dtype="float32", value=7.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    (out,) = exe.run(fetch_list=[x])
+    np.testing.assert_allclose(out, np.full((2, 3), 7.0, np.float32))
+
+
+def test_feed_fetch_mul():
+    a = fluid.layers.data(name="a", shape=[3], dtype="float32")
+    b = fluid.layers.data(name="b", shape=[3], dtype="float32")
+    out = fluid.layers.elementwise_add(a, b)
+    exe = fluid.Executor(fluid.CPUPlace())
+    av = np.random.rand(4, 3).astype(np.float32)
+    bv = np.random.rand(4, 3).astype(np.float32)
+    (res,) = exe.run(feed={"a": av, "b": bv}, fetch_list=[out])
+    np.testing.assert_allclose(res, av + bv, rtol=1e-6)
+
+
+def test_fc_forward_shapes():
+    x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+    y = fluid.layers.fc(x, 4, act="relu")
+    assert y.shape == (-1, 4)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    (out,) = exe.run(feed={"x": np.ones((5, 8), np.float32)}, fetch_list=[y])
+    assert out.shape == (5, 4)
+    assert (out >= 0).all()
+
+
+def test_startup_deterministic_with_seed():
+    prog = fluid.default_startup_program()
+    prog.random_seed = 123
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    fluid.layers.fc(x, 3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(prog)
+    w_name = [p.name for p in fluid.default_main_program().all_parameters() if ".w" in p.name][0]
+    w1 = np.asarray(fluid.global_scope().find_var(w_name))
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    exe2.run(prog)
+    w2 = np.asarray(fluid.global_scope().find_var(w_name))
+    np.testing.assert_allclose(w1, w2)
+
+
+def test_linear_regression_converges():
+    """SGD on y = 2x + 1 must fit closely within 100 steps."""
+    x = fluid.layers.data(name="x", shape=[1], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(x, 1)
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(300):
+        xs = rng.rand(16, 1).astype(np.float32)
+        ys = 2 * xs + 1
+        (lv,) = exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < 1e-3, losses[-10:]
+
+
+def test_mnist_mlp_loss_decreases():
+    """Adam on a 2-layer MLP over synthetic MNIST-shaped data (reference
+    benchmark: benchmark/fluid/mnist.py)."""
+    img = fluid.layers.data(name="img", shape=[784], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    h = fluid.layers.fc(img, 64, act="relu")
+    logits = fluid.layers.fc(h, 10)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, label)
+    )
+    acc = fluid.layers.accuracy(logits, label)
+    fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(7)
+    # fixed synthetic dataset so loss must go down by memorization
+    xs = rng.rand(64, 784).astype(np.float32)
+    ys = rng.randint(0, 10, size=(64, 1)).astype(np.int64)
+    first = None
+    last = None
+    for i in range(30):
+        lv, av = exe.run(feed={"img": xs, "label": ys}, fetch_list=[loss, acc])
+        if first is None:
+            first = float(lv)
+        last = float(lv)
+    assert last < first * 0.5, (first, last)
+
+
+def test_program_clone_for_test_flips_dropout():
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.dropout(x, dropout_prob=0.5)
+    test_prog = fluid.default_main_program().clone(for_test=True)
+    drop_ops = [op for b in test_prog.blocks for op in b.ops if op.type == "dropout"]
+    assert drop_ops and all(op.attr("is_test") for op in drop_ops)
+    train_ops = [
+        op for b in fluid.default_main_program().blocks for op in b.ops if op.type == "dropout"
+    ]
+    assert not any(op.attr("is_test") for op in train_ops)
+
+
+def test_program_json_roundtrip():
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.fc(x, 2, act="tanh")
+    prog = fluid.default_main_program()
+    clone = fluid.Program.from_json(prog.to_json())
+    assert [op.type for b in clone.blocks for op in b.ops] == [
+        op.type for b in prog.blocks for op in b.ops
+    ]
+    assert clone.global_block().var(y.name).shape == y.shape
+    assert len(clone.all_parameters()) == len(prog.all_parameters())
