@@ -270,28 +270,34 @@ class Block:
         return [v for v in self.vars.values() if isinstance(v, Parameter)]
 
     # -- ops -------------------------------------------------------------
+    def _note_writes(self, op: Operator):
+        """Track each output var's producing op and write count (used for
+        static folding: a var is only foldable while it has ONE writer)."""
+        for name in op.output_arg_names:
+            v = self._find_var_recursive(name)
+            if v is not None:
+                v.op = op
+                v._writers = getattr(v, "_writers", 0) + 1
+
     def append_op(self, type: str, inputs=None, outputs=None, attrs=None) -> Operator:
         op = Operator(self, type=type, inputs=inputs, outputs=outputs, attrs=attrs)
         self.ops.append(op)
         self.program._bump()
-        for slot_vars in (outputs or {}).values():
-            if isinstance(slot_vars, (Variable,)):
-                slot_vars = [slot_vars]
-            for v in slot_vars or []:
-                if isinstance(v, Variable):
-                    v.op = op
+        self._note_writes(op)
         return op
 
     def prepend_op(self, type: str, inputs=None, outputs=None, attrs=None) -> Operator:
         op = Operator(self, type=type, inputs=inputs, outputs=outputs, attrs=attrs)
         self.ops.insert(0, op)
         self.program._bump()
+        self._note_writes(op)
         return op
 
     def insert_op(self, index: int, type: str, inputs=None, outputs=None, attrs=None) -> Operator:
         op = Operator(self, type=type, inputs=inputs, outputs=outputs, attrs=attrs)
         self.ops.insert(index, op)
         self.program._bump()
+        self._note_writes(op)
         return op
 
     def to_dict(self) -> dict:

@@ -1,0 +1,127 @@
+"""TensorArray runtime value (reference: LoDTensorArray,
+paddle/fluid/framework/lod_tensor_array.h + write_to_array / read_from_array
+ops in paddle/fluid/operators/tensor_array_read_write_op.cc).
+
+The reference's LoDTensorArray is a std::vector<LoDTensor> mutated
+imperatively by array ops inside While loops. XLA has no growable
+containers, so a TensorArray here has two trace-time modes:
+
+- **list mode** — outside any `lax.while_loop`, writes at concrete (python
+  int) indices are kept as a plain Python list of arrays. This is free and
+  exact.
+- **buffer mode** — when a TensorArray is carried through a `while` op, it
+  is converted to a fixed-capacity device buffer ``(capacity, *elem)`` plus
+  an int32 ``size`` scalar; reads/writes use ``lax.dynamic_*_index_in_dim``.
+  Capacity = current length + the while op's ``max_iters`` bound.
+
+Registered as a JAX pytree so it can ride inside while-loop carries.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class TensorArrayVal:
+    def __init__(self, items: Optional[List] = None, buffer=None, size=None):
+        self.items = items if items is not None else []
+        self.buffer = buffer
+        self.size = size
+
+    @property
+    def is_buffer(self) -> bool:
+        return self.buffer is not None
+
+    # -- list <-> buffer -------------------------------------------------
+    def to_buffer(self, capacity: int) -> "TensorArrayVal":
+        """Capacity of the result = current length + `capacity` extra slots
+        (a while loop carrying this array may write up to its max_iters new
+        elements past the existing ones)."""
+        if self.is_buffer:
+            return self
+        if not self.items:
+            raise ValueError(
+                "cannot carry an empty TensorArray into a while loop: write "
+                "at least one element before the loop so its element "
+                "shape/dtype is known"
+            )
+        stacked = jnp.stack(self.items)
+        n = len(self.items)
+        cap = n + capacity
+        buf = jnp.zeros((cap,) + stacked.shape[1:], stacked.dtype)
+        buf = lax.dynamic_update_slice_in_dim(buf, stacked, 0, axis=0)
+        return TensorArrayVal(buffer=buf, size=jnp.asarray(n, jnp.int32))
+
+    # -- ops -------------------------------------------------------------
+    def write(self, i, x, static_index=None) -> "TensorArrayVal":
+        """Outside while loops (list mode) the index must be statically
+        known — either concrete, or folded from the program graph
+        (fill_constant producer) by the write_to_array kernel. Failing
+        both, the write is treated as an append (i == len), which is how
+        every fluid program uses arrays outside loops (counter from 0)."""
+        if not self.is_buffer:
+            ci = _concrete_index(i)
+            if ci is None:
+                ci = static_index
+            items = list(self.items)
+            if ci is None:
+                items.append(x)
+                return TensorArrayVal(items=items)
+            while len(items) <= ci:
+                items.append(jnp.zeros_like(x))
+            items[ci] = x
+            return TensorArrayVal(items=items)
+        i = jnp.asarray(i, jnp.int32).reshape(())
+        buf = lax.dynamic_update_index_in_dim(self.buffer, x, i, axis=0)
+        size = jnp.maximum(self.size, i + 1)
+        return TensorArrayVal(buffer=buf, size=size)
+
+    def read(self, i, static_index=None):
+        if not self.is_buffer:
+            ci = _concrete_index(i)
+            if ci is None:
+                ci = static_index
+            if ci is not None:
+                return self.items[ci]
+            stacked = jnp.stack(self.items)
+            i = jnp.asarray(i, jnp.int32).reshape(())
+            return lax.dynamic_index_in_dim(stacked, i, axis=0, keepdims=False)
+        i = jnp.asarray(i, jnp.int32).reshape(())
+        return lax.dynamic_index_in_dim(self.buffer, i, axis=0, keepdims=False)
+
+    def length(self):
+        if not self.is_buffer:
+            return jnp.asarray(len(self.items), jnp.int32)
+        return self.size
+
+    def stack(self):
+        """Dense (n, *elem) view; buffer mode returns the full capacity
+        buffer (valid prefix = length())."""
+        if not self.is_buffer:
+            return jnp.stack(self.items) if self.items else jnp.zeros((0,))
+        return self.buffer
+
+
+def _concrete_index(i):
+    try:
+        return int(jnp.asarray(i).reshape(()))
+    except (TypeError, jax.errors.ConcretizationTypeError, jax.errors.TracerArrayConversionError):
+        return None
+
+
+def _flatten(ta: TensorArrayVal):
+    if ta.is_buffer:
+        return (ta.buffer, ta.size), "buffer"
+    return tuple(ta.items), ("list", len(ta.items))
+
+
+def _unflatten(aux, children):
+    if aux == "buffer":
+        return TensorArrayVal(buffer=children[0], size=children[1])
+    return TensorArrayVal(items=list(children))
+
+
+jax.tree_util.register_pytree_node(TensorArrayVal, _flatten, _unflatten)

@@ -36,16 +36,26 @@ _SKIP_OPS = {"feed", "fetch"}
 class RngStream:
     """Deterministic PRNG stream keyed on (block idx, op position, draw #):
     replaying an op (e.g. inside an autodiff vjp) yields the same bits, and
-    adding ops elsewhere never perturbs other ops' streams."""
+    adding ops elsewhere never perturbs other ops' streams.
+
+    ``salts`` holds loop-iteration indices (possibly traced) pushed by
+    control-flow kernels while tracing their sub-blocks, so an RNG-drawing
+    op inside lax.scan / lax.while_loop gets fresh bits every iteration
+    (the key becomes a function of the loop counter instead of a loop
+    constant)."""
 
     def __init__(self, base_key):
         self.base_key = base_key
+        self.salts: List = []
 
     def for_op(self, block_idx: int, op_idx: int) -> Callable:
         draws = [0]
+        salts = list(self.salts)
 
         def next_key():
             k = jax.random.fold_in(self.base_key, block_idx * 1000003 + op_idx)
+            for s in salts:
+                k = jax.random.fold_in(k, jnp.asarray(s, jnp.uint32).reshape(()))
             k = jax.random.fold_in(k, draws[0])
             draws[0] += 1
             return k
@@ -113,13 +123,22 @@ class _EnvView(dict):
     def __contains__(self, name):
         return name in self._env
 
+    def snapshot(self):
+        return dict(self._env)
+
 
 def trace_block(block: Block, env: Dict, rng: RngStream) -> Dict:
     """Trace all ops of `block` into `env` (mutated in place and returned)."""
     program = block.program
 
-    def subblock_fn(block_idx: int, sub_env: Dict) -> Dict:
-        return trace_block(program.block(block_idx), sub_env, rng)
+    def subblock_fn(block_idx: int, sub_env: Dict, salt=None) -> Dict:
+        if salt is None:
+            return trace_block(program.block(block_idx), sub_env, rng)
+        rng.salts.append(salt)
+        try:
+            return trace_block(program.block(block_idx), sub_env, rng)
+        finally:
+            rng.salts.pop()
 
     env_start = dict(env)
     # (op, op_idx) pairs replayed inside each vjp. Frozen at the first

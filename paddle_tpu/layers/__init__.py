@@ -1,11 +1,12 @@
 """paddle_tpu.layers (reference: python/paddle/fluid/layers/__init__.py)."""
-from . import nn, ops, tensor, io, metric_op, learning_rate_scheduler
+from . import nn, ops, tensor, io, metric_op, learning_rate_scheduler, control_flow
 from .nn import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
 from .io import *  # noqa: F401,F403
 from .metric_op import *  # noqa: F401,F403
 from .learning_rate_scheduler import *  # noqa: F401,F403
+from .control_flow import *  # noqa: F401,F403
 
 __all__ = (
     nn.__all__
@@ -14,4 +15,5 @@ __all__ = (
     + io.__all__
     + metric_op.__all__
     + learning_rate_scheduler.__all__
+    + control_flow.__all__
 )

@@ -1,0 +1,674 @@
+"""layers.control_flow (reference: python/paddle/fluid/layers/control_flow.py).
+
+Same user API as the reference — While / Switch / IfElse / StaticRNN /
+DynamicRNN / tensor-array ops — but every construct lowers to XLA-native
+control flow (lax.while_loop / lax.scan / traced-and-merged branches); see
+ops/control_flow.py for the kernels.
+
+Key semantic translation: the reference's IfElse physically partitions the
+batch by mask (split_lod_tensor) and runs each branch on its slice; on TPU
+both branches run on the full batch and rows are merged with a select —
+identical results, SIMD-friendly.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..framework.core import Variable, default_main_program
+from ..layer_helper import LayerHelper
+from . import tensor as tensor_layers
+
+__all__ = [
+    "While",
+    "Switch",
+    "IfElse",
+    "ConditionalBlock",
+    "StaticRNN",
+    "DynamicRNN",
+    "increment",
+    "array_write",
+    "array_read",
+    "array_length",
+    "create_array",
+    "less_than",
+    "equal",
+    "is_empty",
+    "Print",
+    "BlockGuard",
+]
+
+
+class BlockGuard:
+    """Context manager entering a new sub-block of `program`."""
+
+    def __init__(self, program=None):
+        self.program = program if program is not None else default_main_program()
+
+    def __enter__(self):
+        self.program._create_block()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.program._rollback()
+        return False
+
+
+def _written_names(block) -> List[str]:
+    """Output names of all ops in `block` and its nested sub-blocks, in
+    first-write order."""
+    seen, order = set(), []
+
+    def visit(b):
+        for op in b.ops:
+            for n in op.output_arg_names:
+                if n not in seen:
+                    seen.add(n)
+                    order.append(n)
+            sb = op.attr("sub_block")
+            if isinstance(sb, int):
+                visit(b.program.block(sb))
+            for key in ("case_blocks",):
+                for idx in op.attr(key, []) or []:
+                    visit(b.program.block(idx))
+
+    visit(block)
+    return order
+
+
+def _outer_defined(block, names) -> List[str]:
+    """Subset of `names` defined in an ancestor block of `block` (loop-
+    carried / branch-merged state)."""
+    out = []
+    for n in names:
+        b = block.parent_block
+        while b is not None:
+            if n in b.vars:
+                out.append(n)
+                break
+            b = b.parent_block
+    return out
+
+
+# -- While ----------------------------------------------------------------
+class While:
+    """while cond: body.  `cond` is a bool Variable the body must update.
+
+    `max_iters` bounds the capacity of any TensorArray carried through the
+    loop (XLA buffers are fixed-size); pure-tensor loops ignore it.
+    Reference: control_flow.py:While (while_op.cc). Not reverse-mode
+    differentiable (use StaticRNN/DynamicRNN for trainable recurrences).
+    """
+
+    def __init__(self, cond: Variable, max_iters: int = 4096, name=None):
+        self.helper = LayerHelper("while", name=name)
+        self.cond_var = cond
+        self.max_iters = max_iters
+
+    def block(self):
+        return _WhileGuard(self)
+
+    def _complete(self, sub_block):
+        parent = sub_block.parent_block
+        written = _written_names(sub_block)
+        carried = _outer_defined(sub_block, written)
+        if self.cond_var.name not in carried:
+            raise ValueError(
+                "While body never updates the condition variable %r — the "
+                "loop would not terminate" % self.cond_var.name
+            )
+        parent.append_op(
+            type="while",
+            inputs={
+                "Condition": [self.cond_var.name],
+                "X": carried,
+            },
+            outputs={"Out": carried},
+            attrs={
+                "sub_block": sub_block.idx,
+                "carried_names": carried,
+                "max_iters": self.max_iters,
+            },
+        )
+
+
+class _WhileGuard(BlockGuard):
+    def __init__(self, while_op: While):
+        super().__init__(while_op.helper.main_program)
+        self.while_op = while_op
+
+    def __enter__(self):
+        super().__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        block = self.program.current_block()
+        super().__exit__(exc_type, exc_val, exc_tb)
+        if exc_type is None:
+            self.while_op._complete(block)
+        return False
+
+
+# -- Switch ---------------------------------------------------------------
+class Switch:
+    """First-matching-case conditional over scalar bool conditions
+    (reference: control_flow.py:Switch; used by piecewise lr decay)."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("switch", name=name)
+        self.case_conds: List[Variable] = []
+        self.case_block_idxs: List[int] = []
+        self.default_block_idx = -1
+        self._entered = False
+
+    def __enter__(self):
+        self._entered = True
+        return self
+
+    def case(self, condition: Variable):
+        return _SwitchCaseGuard(self, condition)
+
+    def default(self):
+        return _SwitchCaseGuard(self, None)
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            return False
+        program = self.helper.main_program
+        blocks = [program.block(i) for i in self.case_block_idxs]
+        if self.default_block_idx >= 0:
+            blocks.append(program.block(self.default_block_idx))
+        written = []
+        seen = set()
+        for b in blocks:
+            for n in _outer_defined(b, _written_names(b)):
+                if n not in seen:
+                    seen.add(n)
+                    written.append(n)
+        program.current_block().append_op(
+            type="switch",
+            inputs={"Conditions": [c.name for c in self.case_conds]},
+            outputs={"Out": written},
+            attrs={
+                "case_blocks": self.case_block_idxs,
+                "default_block": self.default_block_idx,
+                "written_names": written,
+            },
+        )
+        return False
+
+
+class _SwitchCaseGuard(BlockGuard):
+    def __init__(self, switch: Switch, condition: Optional[Variable]):
+        super().__init__(switch.helper.main_program)
+        self.switch = switch
+        self.condition = condition
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        idx = self.program.current_block().idx
+        super().__exit__(exc_type, exc_val, exc_tb)
+        if exc_type is None:
+            if self.condition is None:
+                self.switch.default_block_idx = idx
+            else:
+                self.switch.case_conds.append(self.condition)
+                self.switch.case_block_idxs.append(idx)
+        return False
+
+
+# -- ConditionalBlock ------------------------------------------------------
+class ConditionalBlock:
+    """Run a block iff a scalar condition holds (reference:
+    conditional_block_op.cc). On TPU the block is always traced; writes are
+    merged with `where(cond, new, old)`."""
+
+    def __init__(self, inputs, name=None):
+        conds = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        if len(conds) != 1:
+            raise ValueError("ConditionalBlock takes exactly one condition")
+        self.cond = conds[0]
+        self.helper = LayerHelper("conditional_block", name=name)
+
+    def block(self):
+        return _CondGuard(self)
+
+    def _complete(self, sub_block):
+        parent = sub_block.parent_block
+        written = _outer_defined(sub_block, _written_names(sub_block))
+        parent.append_op(
+            type="conditional_block",
+            inputs={"Cond": [self.cond.name]},
+            outputs={"Out": written},
+            attrs={"sub_block": sub_block.idx, "written_names": written},
+        )
+
+
+class _CondGuard(BlockGuard):
+    def __init__(self, cb: ConditionalBlock):
+        super().__init__(cb.helper.main_program)
+        self.cb = cb
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        block = self.program.current_block()
+        super().__exit__(exc_type, exc_val, exc_tb)
+        if exc_type is None:
+            self.cb._complete(block)
+        return False
+
+
+# -- IfElse ----------------------------------------------------------------
+class IfElse:
+    """Row-wise two-branch conditional (reference: control_flow.py:IfElse).
+
+    `cond` is (batch, 1) bool. The reference splits the batch by mask and
+    runs each branch on its rows; here both branches are built inline on the
+    full batch (they execute unconditionally — cheap on TPU) and the
+    per-branch `output()`s are merged row-wise with a select op.
+    """
+
+    OUT_IF_ELSE_TRUE_BLOCKS = 0
+    OUT_IF_ELSE_FALSE_BLOCKS = 1
+
+    def __init__(self, cond: Variable, name=None):
+        self.helper = LayerHelper("ifelse", name=name)
+        self.cond = cond
+        self._true_outs: List[Variable] = []
+        self._false_outs: List[Variable] = []
+        self._in_true = None
+
+    class _Branch:
+        def __init__(self, parent, is_true):
+            self.parent = parent
+            self.is_true = is_true
+
+        def __enter__(self):
+            self.parent._in_true = self.is_true
+            return self
+
+        def __exit__(self, exc_type, exc_val, exc_tb):
+            self.parent._in_true = None
+            return False
+
+    def true_block(self):
+        return IfElse._Branch(self, True)
+
+    def false_block(self):
+        return IfElse._Branch(self, False)
+
+    def input(self, x: Variable) -> Variable:
+        if self._in_true is None:
+            raise RuntimeError("IfElse.input() must be called inside a branch")
+        return x
+
+    def output(self, *outs):
+        if self._in_true is None:
+            raise RuntimeError("IfElse.output() must be called inside a branch")
+        (self._true_outs if self._in_true else self._false_outs).extend(outs)
+
+    def __call__(self):
+        if len(self._true_outs) != len(self._false_outs):
+            raise ValueError(
+                "IfElse branches produced different numbers of outputs "
+                "(%d vs %d)" % (len(self._true_outs), len(self._false_outs))
+            )
+        merged = []
+        for t, f in zip(self._true_outs, self._false_outs):
+            out = self.helper.create_variable_for_type_inference(
+                dtype=t.dtype, shape=t.shape
+            )
+            self.helper.append_op(
+                type="select",
+                inputs={"Mask": [self.cond.name], "X": [t.name], "Y": [f.name]},
+                outputs={"Out": [out.name]},
+            )
+            merged.append(out)
+        return merged
+
+
+# -- StaticRNN -------------------------------------------------------------
+class StaticRNN:
+    """Unrolled-over-time RNN builder (reference: control_flow.py:StaticRNN,
+    recurrent_op.cc). Sequence inputs are time-major (T, B, ...); lowered to
+    lax.scan, so it is reverse-mode differentiable."""
+
+    BEFORE_RNN_BLOCK = 0
+    IN_RNN_BLOCK = 1
+    AFTER_RNN_BLOCK = 2
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("static_rnn", name=name)
+        self.status = StaticRNN.BEFORE_RNN_BLOCK
+        self.seq_vars: List[Variable] = []  # outer (T,B,...) inputs
+        self.in_vars: List[Variable] = []  # inner per-step vars
+        self.mem_boot: List[Variable] = []  # outer boot values
+        self.mem_vars: List[Variable] = []  # inner memory vars
+        self.mem_updates = {}  # inner mem name -> inner updated var
+        self.step_outs: List[Variable] = []  # inner step outputs
+        self.outer_outs: List[Variable] = []  # outer stacked outputs
+        self._sub_block = None
+
+    def step(self):
+        return _RnnGuard(self)
+
+    def _assert_in_rnn(self):
+        if self.status != StaticRNN.IN_RNN_BLOCK:
+            raise RuntimeError("this StaticRNN method must be called inside rnn.step()")
+
+    def step_input(self, x: Variable) -> Variable:
+        self._assert_in_rnn()
+        inner = self.helper.main_program.current_block().create_var(
+            name=self.helper.name + ".in.%d" % len(self.in_vars),
+            shape=x.shape[1:],
+            dtype=x.dtype,
+        )
+        self.seq_vars.append(x)
+        self.in_vars.append(inner)
+        return inner
+
+    def _boot_in_parent(self, ref, shape, dtype, value, input_dim_idx=0, output_dim_idx=0):
+        """Create the boot (initial memory) value via
+        fill_constant_batch_size_like appended to the PARENT block."""
+        prog = self.helper.main_program
+        cur = prog.current_block_idx
+        prog.current_block_idx = prog.current_block().parent_idx
+        try:
+            return tensor_layers.fill_constant_batch_size_like(
+                input=ref,
+                shape=list(shape),
+                dtype=dtype,
+                value=value,
+                input_dim_idx=input_dim_idx,
+                output_dim_idx=output_dim_idx,
+            )
+        finally:
+            prog.current_block_idx = cur
+
+    def _make_mem(self, init: Variable) -> Variable:
+        mem = self.helper.main_program.current_block().create_var(
+            name=self.helper.name + ".mem.%d" % len(self.mem_vars),
+            shape=init.shape,
+            dtype=init.dtype,
+        )
+        self.mem_boot.append(init)
+        self.mem_vars.append(mem)
+        return mem
+
+    def memory(
+        self,
+        init: Optional[Variable] = None,
+        shape=None,
+        batch_ref: Optional[Variable] = None,
+        init_value: float = 0.0,
+        init_batch_dim_idx: int = 0,
+        ref_batch_dim_idx: int = 1,
+    ) -> Variable:
+        """`shape` is the FULL boot shape including the batch slot; the dim
+        at `init_batch_dim_idx` is replaced by batch_ref's dim at
+        `ref_batch_dim_idx` (reference control_flow.py:StaticRNN.memory).
+        In the reference, inner step vars alias their outer sequence var by
+        name, so `ref_batch_dim_idx` indexes the OUTER (T, B, ...) shape;
+        we keep that convention and map inner refs to their outer var."""
+        self._assert_in_rnn()
+        if init is None:
+            if shape is None or batch_ref is None:
+                raise ValueError("memory() needs `init` or (`shape` + `batch_ref`)")
+            for inner, outer in zip(self.in_vars, self.seq_vars):
+                if batch_ref.name == inner.name:
+                    batch_ref = outer
+                    break
+            init = self._boot_in_parent(
+                batch_ref, shape, batch_ref.dtype, init_value,
+                input_dim_idx=ref_batch_dim_idx, output_dim_idx=init_batch_dim_idx,
+            )
+        return self._make_mem(init)
+
+    def update_memory(self, mem: Variable, var: Variable):
+        self._assert_in_rnn()
+        self.mem_updates[mem.name] = var
+
+    def step_output(self, o: Variable):
+        self._assert_in_rnn()
+        self.step_outs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def __call__(self):
+        if self.status != StaticRNN.AFTER_RNN_BLOCK:
+            raise RuntimeError("StaticRNN outputs are available after the step block")
+        return self.outer_outs if len(self.outer_outs) != 1 else self.outer_outs[0]
+
+    def _rnn_attrs(self, sub_block) -> dict:
+        missing = [m.name for m in self.mem_vars if m.name not in self.mem_updates]
+        if missing:
+            raise ValueError(
+                "%s memories never updated: %s" % (type(self).__name__, missing)
+            )
+        return {
+            "sub_block": sub_block.idx,
+            "in_names": [v.name for v in self.in_vars],
+            "mem_names": [v.name for v in self.mem_vars],
+            "mem_update_names": [self.mem_updates[m.name].name for m in self.mem_vars],
+            "out_names": [v.name for v in self.step_outs],
+        }
+
+    def _add_outer_out(self, parent, shape, dtype, lod_level=0) -> Variable:
+        outer = parent.create_var(
+            name=self.helper.name + ".out.%d" % len(self.outer_outs),
+            shape=shape,
+            dtype=dtype,
+            lod_level=lod_level,
+        )
+        self.outer_outs.append(outer)
+        return outer
+
+    def _complete(self, sub_block):
+        attrs = self._rnn_attrs(sub_block)
+        parent = sub_block.parent_block
+        T = self.seq_vars[0].shape[0] if self.seq_vars else -1
+        for o in self.step_outs:
+            self._add_outer_out(parent, (T,) + tuple(o.shape), o.dtype)
+        parent.append_op(
+            type="static_rnn",
+            inputs={
+                "Inputs": [v.name for v in self.seq_vars],
+                "Boot": [v.name for v in self.mem_boot],
+            },
+            outputs={"Out": [v.name for v in self.outer_outs]},
+            attrs=attrs,
+        )
+
+
+class _RnnGuard(BlockGuard):
+    def __init__(self, rnn):
+        super().__init__(rnn.helper.main_program)
+        self.rnn = rnn
+
+    def __enter__(self):
+        self.rnn.status = StaticRNN.IN_RNN_BLOCK
+        return super().__enter__()
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        block = self.program.current_block()
+        super().__exit__(exc_type, exc_val, exc_tb)
+        self.rnn.status = StaticRNN.AFTER_RNN_BLOCK
+        if exc_type is None:
+            self.rnn._complete(block)
+        return False
+
+
+# -- DynamicRNN ------------------------------------------------------------
+class DynamicRNN(StaticRNN):
+    """Variable-length RNN builder (reference: control_flow.py:DynamicRNN).
+
+    The reference sorts sequences by length and shrinks the batch as
+    sequences end; on TPU we keep dense (B, T, ...) tensors + a lengths
+    tensor and freeze each row's memory once t >= length (identical final
+    states, static shapes). Outputs are (B, T, ...) with padding zeroed.
+    """
+
+    def __init__(self, name=None):
+        super().__init__(name=name)
+        self.helper = LayerHelper("dynamic_rnn", name=name)
+        self.lengths: Optional[Variable] = None
+
+    def block(self):
+        return _RnnGuard(self)
+
+    def step_input(self, x: Variable, lengths: Optional[Variable] = None) -> Variable:
+        self._assert_in_rnn()
+        if lengths is not None:
+            self.lengths = lengths
+        inner = self.helper.main_program.current_block().create_var(
+            name=self.helper.name + ".in.%d" % len(self.in_vars),
+            shape=(x.shape[0],) + tuple(x.shape[2:]),
+            dtype=x.dtype,
+        )
+        self.seq_vars.append(x)
+        self.in_vars.append(inner)
+        return inner
+
+    def memory(self, init=None, shape=None, value: float = 0.0, dtype="float32", **kw):
+        """`shape` here EXCLUDES the batch dim (reference
+        control_flow.py:DynamicRNN.memory): memory(shape=[30]) gives a
+        (batch, 30) state."""
+        self._assert_in_rnn()
+        if init is None:
+            if shape is None or not self.seq_vars:
+                raise ValueError("memory() needs `init`, or `shape` after step_input")
+            init = self._boot_in_parent(
+                self.seq_vars[0], [-1] + list(shape), dtype, value
+            )
+        return self._make_mem(init)
+
+    def _complete(self, sub_block):
+        attrs = self._rnn_attrs(sub_block)
+        parent = sub_block.parent_block
+        B = self.seq_vars[0].shape[0] if self.seq_vars else -1
+        T = self.seq_vars[0].shape[1] if self.seq_vars else -1
+        for o in self.step_outs:
+            self._add_outer_out(parent, (B, T) + tuple(o.shape[1:]), o.dtype, lod_level=1)
+        inputs = {
+            "Inputs": [v.name for v in self.seq_vars],
+            "Boot": [v.name for v in self.mem_boot],
+        }
+        if self.lengths is not None:
+            inputs["Lengths"] = [self.lengths.name]
+        parent.append_op(
+            type="dynamic_rnn",
+            inputs=inputs,
+            outputs={"Out": [v.name for v in self.outer_outs]},
+            attrs=attrs,
+        )
+
+
+# -- small ops -------------------------------------------------------------
+def increment(x: Variable, value: float = 1.0, in_place: bool = True) -> Variable:
+    helper = LayerHelper("increment")
+    if in_place:
+        out = x
+    else:
+        out = helper.create_variable_for_type_inference(dtype=x.dtype, shape=x.shape)
+    helper.append_op(
+        type="increment", inputs={"X": [x.name]}, outputs={"Out": [out.name]},
+        attrs={"step": float(value)},
+    )
+    return out
+
+
+def create_array(dtype) -> Variable:
+    helper = LayerHelper("array")
+    arr = helper.create_variable(
+        name=helper.name, dtype=dtype, shape=(), lod_level=0
+    )
+    arr.type = "tensor_array"
+    helper.append_op(type="create_array", outputs={"Out": [arr.name]})
+    return arr
+
+
+def array_write(x: Variable, i: Variable, array: Optional[Variable] = None) -> Variable:
+    helper = LayerHelper("array_write")
+    if array is None:
+        array = create_array(x.dtype)
+    helper.append_op(
+        type="write_to_array",
+        inputs={"X": [x.name], "I": [i.name]},
+        outputs={"Out": [array.name]},
+    )
+    return array
+
+
+def array_read(array: Variable, i: Variable) -> Variable:
+    helper = LayerHelper("array_read")
+    out = helper.create_variable_for_type_inference(dtype=array.dtype)
+    helper.append_op(
+        type="read_from_array",
+        inputs={"X": [array.name], "I": [i.name]},
+        outputs={"Out": [out.name]},
+    )
+    return out
+
+
+def array_length(array: Variable) -> Variable:
+    helper = LayerHelper("array_length")
+    out = helper.create_variable_for_type_inference(dtype="int32", shape=())
+    helper.append_op(
+        type="lod_array_length",
+        inputs={"X": [array.name]},
+        outputs={"Out": [out.name]},
+    )
+    return out
+
+
+def _cmp(op_type, x, y, cond=None):
+    helper = LayerHelper(op_type)
+    if cond is None:
+        cond = helper.create_variable_for_type_inference(dtype="bool", shape=x.shape)
+    helper.append_op(
+        type=op_type,
+        inputs={"X": [x.name], "Y": [y.name]},
+        outputs={"Out": [cond.name]},
+    )
+    return cond
+
+
+def less_than(x, y, force_cpu=None, cond=None, **ignored):
+    return _cmp("less_than", x, y, cond)
+
+
+def equal(x, y, cond=None, **ignored):
+    return _cmp("equal", x, y, cond)
+
+
+def is_empty(x, cond=None, **ignored):
+    helper = LayerHelper("is_empty")
+    if cond is None:
+        cond = helper.create_variable_for_type_inference(dtype="bool", shape=())
+    helper.append_op(type="is_empty", inputs={"X": [x.name]}, outputs={"Out": [cond.name]})
+    return cond
+
+
+def Print(
+    input: Variable,
+    first_n: int = -1,
+    message: Optional[str] = None,
+    summarize: int = -1,
+    print_tensor_name: bool = True,
+    print_tensor_type: bool = True,
+    print_tensor_shape: bool = True,
+    print_tensor_lod: bool = True,
+    print_phase: str = "both",
+) -> Variable:
+    helper = LayerHelper("print")
+    helper.append_op(
+        type="print",
+        inputs={"X": [input.name]},
+        outputs={"Out": [input.name]},
+        attrs={
+            "message": (message + " ") if message else "",
+            "first_n": first_n,
+            "summarize": summarize,
+            "print_phase": print_phase,
+        },
+    )
+    return input

@@ -1,0 +1,279 @@
+"""Control-flow op kernels.
+
+Reference kernels: paddle/fluid/operators/while_op.cc,
+conditional_block_op.cc, tensor_array_read_write_op.cc, recurrent_op.cc.
+The reference runs sub-blocks by re-entering the interpreter with a child
+scope per iteration. Here control flow must stay inside ONE traced XLA
+computation, so:
+
+- ``while``        -> lax.while_loop over an explicit loop-carried state
+                      (vars defined outside the body and written inside it)
+- ``static_rnn``   -> lax.scan over the sequence axis (differentiable)
+- ``dynamic_rnn``  -> lax.scan over time with per-sequence length masking
+- ``conditional_block`` / ``switch`` -> both/all branches are traced, then
+  results are merged with jnp.where (XLA-friendly; no divergent branches
+  on a SIMD machine). First matching case wins, like the reference.
+- tensor arrays    -> TensorArrayVal (list mode outside loops, fixed-
+                      capacity buffer mode inside; see framework/tensor_array.py)
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.tensor_array import TensorArrayVal
+from .registry import register_op
+
+
+def _as_pred(x):
+    return jnp.asarray(x).reshape(()).astype(bool)
+
+
+def _static_int(block, var_name):
+    """Fold a variable to a static int by walking its producing ops
+    (fill_constant / assign / increment-by-integer chains). Used for
+    TensorArray indices outside loops, where every value is a tracer under
+    jit but the program graph still pins the index."""
+    bump = 0
+    for _ in range(64):
+        var = block._find_var_recursive(var_name)
+        op = getattr(var, "op", None)
+        # a var with more than one writer (e.g. a counter mutated by a
+        # while body) has no single static value — refuse to fold
+        if op is None or getattr(var, "_writers", 0) != 1:
+            return None
+        if op.type == "fill_constant":
+            return int(op.attr("value")) + bump
+        if op.type == "assign":
+            var_name = op.input("X")[0]
+        elif op.type == "increment":
+            src = op.input("X")[0]
+            if src == var_name:  # in-place increment: not single-valued
+                return None
+            bump += int(op.attr("step", 1))
+            var_name = src
+        else:
+            return None
+    return None
+
+
+# -- tensor arrays -------------------------------------------------------
+@register_op("create_array")
+def _create_array(ctx):
+    return {"Out": TensorArrayVal()}
+
+
+@register_op("write_to_array")
+def _write_to_array(ctx):
+    x = ctx.input("X")
+    i = ctx.input("I")
+    name = ctx.op.output("Out")[0]
+    arr = ctx.value(name)
+    if arr is None:
+        arr = TensorArrayVal()
+    si = _static_int(ctx._block, ctx.op.input("I")[0])
+    return {"Out": arr.write(i, x, static_index=si)}
+
+
+@register_op("read_from_array")
+def _read_from_array(ctx):
+    si = _static_int(ctx._block, ctx.op.input("I")[0])
+    return {"Out": ctx.input("X").read(ctx.input("I"), static_index=si)}
+
+
+@register_op("lod_array_length")
+def _lod_array_length(ctx):
+    return {"Out": ctx.input("X").length()}
+
+
+@register_op("array_stack")
+def _array_stack(ctx):
+    return {"Out": ctx.input("X").stack()}
+
+
+# -- while ---------------------------------------------------------------
+@register_op("while")
+def _while(ctx):
+    sub_block = ctx.attr("sub_block")
+    carried = list(ctx.attr("carried_names"))
+    max_iters = int(ctx.attr("max_iters", 4096))
+    cond_name = ctx.op.input("Condition")[0]
+
+    outer = ctx.full_env()
+    init = []
+    for n in carried:
+        v = outer[n]
+        if isinstance(v, TensorArrayVal):
+            v = v.to_buffer(max_iters)
+        init.append(v)
+    cond_idx = carried.index(cond_name)
+
+    # carry[0] is a hidden iteration counter used only to salt RNG keys
+    def cond_fn(carry):
+        return _as_pred(carry[1 + cond_idx])
+
+    def body_fn(carry):
+        t = carry[0]
+        benv = dict(outer)
+        benv.update(zip(carried, carry[1:]))
+        ctx.trace_subblock(sub_block, benv, salt=t)
+        return (t + 1,) + tuple(benv[n] for n in carried)
+
+    final = lax.while_loop(cond_fn, body_fn, (jnp.asarray(0, jnp.int32),) + tuple(init))
+    return {"Out": list(final[1:])}
+
+
+# -- static RNN (lax.scan, differentiable) --------------------------------
+@register_op("static_rnn")
+def _static_rnn(ctx):
+    sub_block = ctx.attr("sub_block")
+    in_names = list(ctx.attr("in_names"))  # inner per-step vars
+    mem_names = list(ctx.attr("mem_names"))  # inner memory vars
+    mem_update_names = list(ctx.attr("mem_update_names"))
+    out_names = list(ctx.attr("out_names"))  # inner step outputs
+
+    seqs = ctx.inputs("Inputs")  # each (T, B, ...)
+    boots = ctx.inputs("Boot")
+    outer = ctx.full_env()
+    T = seqs[0].shape[0] if seqs else 0
+
+    def step(carry, inp):
+        t = inp[0]
+        xs_t = inp[1:]
+        benv = dict(outer)
+        benv.update(zip(mem_names, carry))
+        benv.update(zip(in_names, xs_t))
+        ctx.trace_subblock(sub_block, benv, salt=t)
+        new_carry = tuple(benv[n] for n in mem_update_names)
+        outs = tuple(benv[n] for n in out_names)
+        return new_carry, outs
+
+    _, stacked = lax.scan(step, tuple(boots), (jnp.arange(T),) + tuple(seqs))
+    return {"Out": list(stacked)}
+
+
+# -- dynamic RNN (scan over time + length masking) ------------------------
+@register_op("dynamic_rnn")
+def _dynamic_rnn(ctx):
+    sub_block = ctx.attr("sub_block")
+    in_names = list(ctx.attr("in_names"))
+    mem_names = list(ctx.attr("mem_names"))
+    mem_update_names = list(ctx.attr("mem_update_names"))
+    out_names = list(ctx.attr("out_names"))
+
+    seqs = ctx.inputs("Inputs")  # each (B, T, ...)
+    boots = ctx.inputs("Boot")
+    lengths = ctx.input("Lengths")  # (B,) int
+    outer = ctx.full_env()
+    T = seqs[0].shape[1]
+    if lengths is None:
+        lengths = jnp.full((seqs[0].shape[0],), T, jnp.int32)
+
+    xs = tuple(jnp.swapaxes(s, 0, 1) for s in seqs)  # (T, B, ...)
+
+    def bmask(m, ref):
+        return m.reshape((-1,) + (1,) * (ref.ndim - 1))
+
+    def step(carry, inp):
+        t = inp[0]
+        xs_t = inp[1:]
+        benv = dict(outer)
+        benv.update(zip(mem_names, carry))
+        benv.update(zip(in_names, xs_t))
+        ctx.trace_subblock(sub_block, benv, salt=t)
+        alive = (t < lengths)
+        new_carry = tuple(
+            jnp.where(bmask(alive, new), new, old)
+            for old, new in zip(carry, (benv[n] for n in mem_update_names))
+        )
+        outs = tuple(
+            jnp.where(bmask(alive, o), o, jnp.zeros_like(o))
+            for o in (benv[n] for n in out_names)
+        )
+        return new_carry, outs
+
+    _, stacked = lax.scan(step, tuple(boots), (jnp.arange(T),) + xs)
+    # back to batch-major (B, T, ...)
+    return {"Out": [jnp.swapaxes(s, 0, 1) for s in stacked]}
+
+
+# -- conditionals ---------------------------------------------------------
+@register_op("conditional_block")
+def _conditional_block(ctx):
+    sub_block = ctx.attr("sub_block")
+    written = list(ctx.attr("written_names"))
+    cond = _as_pred(ctx.input("Cond"))
+    outer = ctx.full_env()
+    benv = dict(outer)
+    ctx.trace_subblock(sub_block, benv)
+    merged = []
+    for n in written:
+        new = benv[n]
+        old = outer.get(n)
+        if old is None:
+            old = jnp.zeros_like(new)
+        merged.append(jnp.where(cond, new, old))
+    return {"Out": merged}
+
+
+@register_op("switch")
+def _switch(ctx):
+    case_blocks = list(ctx.attr("case_blocks"))
+    default_block = ctx.attr("default_block", -1)
+    written = list(ctx.attr("written_names"))
+    conds = [_as_pred(c) for c in ctx.inputs("Conditions")]
+    outer = ctx.full_env()
+
+    branch_vals = []
+    for b in case_blocks:
+        benv = dict(outer)
+        ctx.trace_subblock(b, benv)
+        branch_vals.append([benv[n] for n in written])
+    if default_block >= 0:
+        benv = dict(outer)
+        ctx.trace_subblock(default_block, benv)
+        acc = [benv[n] for n in written]
+    else:
+        acc = [outer.get(n, jnp.zeros_like(v)) for n, v in zip(written, branch_vals[0])]
+    # reverse order => first true condition wins
+    for cond, vals in zip(reversed(conds), reversed(branch_vals)):
+        acc = [jnp.where(cond, v, a) for v, a in zip(vals, acc)]
+    return {"Out": acc}
+
+
+@register_op("select")
+def _select(ctx):
+    """Row-wise (or scalar) where: Out = Mask ? X : Y (IfElse merge).
+    The mask is aligned to x's rank on leading axes: trailing singleton
+    mask dims are dropped when x has fewer dims, singleton dims appended
+    when x has more."""
+    mask = ctx.input("Mask")
+    x = ctx.input("X")
+    m = jnp.asarray(mask).astype(bool)
+    while m.ndim > x.ndim:
+        if m.shape[-1] != 1:
+            raise ValueError(
+                "select mask shape %s cannot align to value shape %s"
+                % (mask.shape, x.shape)
+            )
+        m = m[..., 0]
+    m = m.reshape(m.shape + (1,) * (x.ndim - m.ndim))
+    return {"Out": jnp.where(m, x, ctx.input("Y"))}
+
+
+# -- misc -----------------------------------------------------------------
+@register_op("print")
+def _print(ctx):
+    x = ctx.input("X")
+    msg = ctx.attr("message", "") or ""
+    phase = ctx.attr("print_phase", "forward")
+    if phase != "none":
+        jax.debug.print(msg + "{x}", x=x)
+    return {"Out": x}
+
+
+@register_op("is_empty")
+def _is_empty(ctx):
+    x = ctx.input("X")
+    return {"Out": jnp.asarray(x.size == 0)}

@@ -100,13 +100,29 @@ class OpContext:
 
         return as_numpy_dtype(self.out_var(slot).dtype)
 
+    def value(self, name: str, default=None):
+        """Current env value of an arbitrary variable name (used by ops that
+        read their own output slot, e.g. write_to_array)."""
+        return self._env[name] if name in self._env else default
+
+    def full_env(self) -> dict:
+        """Snapshot of the whole tracing env (control-flow ops close over
+        outer values when tracing their sub-blocks)."""
+        snap = getattr(self._env, "snapshot", None)
+        return snap() if snap is not None else dict(self._env)
+
     # -- services --------------------------------------------------------
     def rng(self):
         """A fresh jax PRNG key for this op invocation."""
         return self._rng_fn()
 
-    def trace_subblock(self, block_idx: int, env: dict) -> dict:
-        return self._subblock_fn(block_idx, env)
+    def trace_subblock(self, block_idx: int, env: dict, salt=None) -> dict:
+        """Trace a sub-block into `env`. `salt` (a possibly-traced loop
+        counter) is folded into every RNG key drawn inside, so stochastic
+        ops get fresh bits per loop iteration."""
+        if salt is None:
+            return self._subblock_fn(block_idx, env)
+        return self._subblock_fn(block_idx, env, salt)
 
     @property
     def is_test(self) -> bool:

@@ -1,0 +1,256 @@
+"""Control-flow tests (modeled on the reference's
+tests/unittests/test_while_op.py, test_switch.py, test_dyn_rnn.py etc.)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.layers import control_flow as cf
+
+
+def test_while_sum_to_ten():
+    i = fluid.layers.fill_constant(shape=[1], dtype="int32", value=0)
+    acc = fluid.layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+    limit = fluid.layers.fill_constant(shape=[1], dtype="int32", value=10)
+    cond = cf.less_than(i, limit)
+    w = cf.While(cond)
+    with w.block():
+        fluid.layers.assign(
+            fluid.layers.elementwise_add(acc, fluid.layers.cast(i, "float32")), acc
+        )
+        cf.increment(i)
+        cf.less_than(i, limit, cond=cond)
+    exe = fluid.Executor(fluid.CPUPlace())
+    (out,) = exe.run(fetch_list=[acc])
+    assert float(out) == sum(range(10))
+
+
+def test_while_with_tensor_array():
+    """Write i^2 into a TensorArray inside a While, read back after."""
+    i = fluid.layers.fill_constant(shape=[1], dtype="int32", value=0)
+    limit = fluid.layers.fill_constant(shape=[1], dtype="int32", value=5)
+    x0 = fluid.layers.fill_constant(shape=[2], dtype="float32", value=0.0)
+    arr = cf.array_write(x0, fluid.layers.fill_constant(shape=[1], dtype="int32", value=0))
+    cond = cf.less_than(i, limit)
+    w = cf.While(cond, max_iters=8)
+    with w.block():
+        sq = fluid.layers.cast(fluid.layers.elementwise_mul(i, i), "float32")
+        val = fluid.layers.elementwise_add(x0, sq)
+        cf.array_write(val, i, array=arr)
+        cf.increment(i)
+        cf.less_than(i, limit, cond=cond)
+    n = cf.array_length(arr)
+    last = cf.array_read(arr, fluid.layers.fill_constant(shape=[1], dtype="int32", value=4))
+    exe = fluid.Executor(fluid.CPUPlace())
+    nv, lastv = exe.run(fetch_list=[n, last])
+    assert int(nv) == 5
+    np.testing.assert_allclose(lastv, [16.0, 16.0])
+
+
+def test_static_rnn_cumsum():
+    """StaticRNN computing a running sum over a (T, B, D) sequence."""
+    T, B, D = 5, 3, 2
+    x = fluid.layers.data(name="x", shape=[T, B, D], dtype="float32", append_batch_size=False)
+    rnn = cf.StaticRNN()
+    with rnn.step():
+        xt = rnn.step_input(x)
+        mem = rnn.memory(shape=[-1, D], batch_ref=xt, init_value=0.0)
+        s = fluid.layers.elementwise_add(mem, xt)
+        rnn.update_memory(mem, s)
+        rnn.step_output(s)
+    out = rnn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.random.RandomState(0).rand(T, B, D).astype(np.float32)
+    (ov,) = exe.run(feed={"x": xv}, fetch_list=[out])
+    np.testing.assert_allclose(ov, np.cumsum(xv, axis=0), rtol=1e-5)
+
+
+def test_static_rnn_is_differentiable():
+    T, B, D = 4, 2, 3
+    x = fluid.layers.data(name="x", shape=[T, B, D], dtype="float32", append_batch_size=False)
+    rnn = cf.StaticRNN()
+    with rnn.step():
+        xt = rnn.step_input(x)
+        mem = rnn.memory(shape=[-1, D], batch_ref=xt, init_value=0.0)
+        h = fluid.layers.fc(xt, D, act="tanh")
+        s = fluid.layers.elementwise_add(mem, h)
+        rnn.update_memory(mem, s)
+        rnn.step_output(s)
+    out = rnn()
+    loss = fluid.layers.mean(out)
+    fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xv = np.random.RandomState(0).rand(T, B, D).astype(np.float32)
+    l1 = exe.run(feed={"x": xv}, fetch_list=[loss])[0]
+    for _ in range(20):
+        l2 = exe.run(feed={"x": xv}, fetch_list=[loss])[0]
+    assert float(l2) < float(l1)
+
+
+def test_dynamic_rnn_respects_lengths():
+    B, T, D = 3, 6, 2
+    x = fluid.layers.data(name="x", shape=[B, T, D], dtype="float32", append_batch_size=False)
+    lens = fluid.layers.data(name="lens", shape=[B], dtype="int32", append_batch_size=False)
+    rnn = cf.DynamicRNN()
+    with rnn.block():
+        xt = rnn.step_input(x, lengths=lens)
+        mem = rnn.memory(shape=[D], value=0.0)
+        s = fluid.layers.elementwise_add(mem, xt)
+        rnn.update_memory(mem, s)
+        rnn.step_output(s)
+    out = rnn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.ones((B, T, D), np.float32)
+    lv = np.array([2, 6, 4], np.int32)
+    (ov,) = exe.run(feed={"x": xv, "lens": lv}, fetch_list=[out])
+    # running sum frozen at each row's length; padding zeroed
+    assert ov[0, 1, 0] == 2.0 and ov[0, 2, 0] == 0.0
+    assert ov[1, 5, 0] == 6.0
+    assert ov[2, 3, 0] == 4.0 and ov[2, 4, 0] == 0.0
+
+
+def test_switch_first_match_wins():
+    lr = fluid.layers.tensor.create_global_var(
+        shape=[1], value=0.0, dtype="float32", persistable=True, name="lr"
+    )
+    step = fluid.layers.fill_constant(shape=[1], dtype="float32", value=5.0)
+    b1 = fluid.layers.fill_constant(shape=[1], dtype="float32", value=3.0)
+    b2 = fluid.layers.fill_constant(shape=[1], dtype="float32", value=10.0)
+    with cf.Switch() as switch:
+        with switch.case(cf.less_than(step, b1)):
+            fluid.layers.assign(fluid.layers.fill_constant([1], "float32", 0.1), lr)
+        with switch.case(cf.less_than(step, b2)):
+            fluid.layers.assign(fluid.layers.fill_constant([1], "float32", 0.01), lr)
+        with switch.default():
+            fluid.layers.assign(fluid.layers.fill_constant([1], "float32", 0.001), lr)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    (out,) = exe.run(fetch_list=[lr])
+    np.testing.assert_allclose(out, [0.01])
+
+
+def test_ifelse_rowwise_merge():
+    x = fluid.layers.data(name="x", shape=[1], dtype="float32")
+    zero = fluid.layers.fill_constant_batch_size_like(x, [-1, 1], "float32", 0.0)
+    cond = cf.less_than(x, zero)
+    ie = cf.IfElse(cond)
+    with ie.true_block():
+        xt = ie.input(x)
+        ie.output(fluid.layers.scale(xt, scale=-1.0))
+    with ie.false_block():
+        xf = ie.input(x)
+        ie.output(xf)
+    (absx,) = ie()
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.array([[-2.0], [3.0], [-0.5]], np.float32)
+    (out,) = exe.run(feed={"x": xv}, fetch_list=[absx])
+    np.testing.assert_allclose(out, np.abs(xv))
+
+
+def test_conditional_block_merges_on_cond():
+    flag = fluid.layers.data(name="flag", shape=[1], dtype="float32", append_batch_size=False)
+    y = fluid.layers.fill_constant(shape=[1], dtype="float32", value=1.0)
+    zero = fluid.layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+    cond = cf.less_than(zero, flag)  # flag > 0
+    cb = cf.ConditionalBlock([cond])
+    with cb.block():
+        fluid.layers.assign(fluid.layers.fill_constant([1], "float32", 42.0), y)
+    exe = fluid.Executor(fluid.CPUPlace())
+    (out_t,) = exe.run(feed={"flag": np.array([1.0], np.float32)}, fetch_list=[y])
+    (out_f,) = exe.run(feed={"flag": np.array([-1.0], np.float32)}, fetch_list=[y])
+    np.testing.assert_allclose(out_t, [42.0])
+    np.testing.assert_allclose(out_f, [1.0])
+
+
+def test_array_write_after_loop_with_mutated_counter():
+    """Regression: a counter mutated by a While must NOT fold to its initial
+    fill_constant value — post-loop writes land at the final counter."""
+    i = fluid.layers.fill_constant(shape=[1], dtype="int32", value=0)
+    limit = fluid.layers.fill_constant(shape=[1], dtype="int32", value=3)
+    x0 = fluid.layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+    zero = fluid.layers.fill_constant(shape=[1], dtype="int32", value=0)
+    arr = cf.array_write(x0, zero)
+    cond = cf.less_than(i, limit)
+    w = cf.While(cond, max_iters=4)
+    with w.block():
+        cf.array_write(fluid.layers.cast(i, "float32"), i, array=arr)
+        cf.increment(i)
+        cf.less_than(i, limit, cond=cond)
+    marker = fluid.layers.fill_constant(shape=[1], dtype="float32", value=99.0)
+    cf.array_write(marker, i, array=arr)  # i == 3 now
+    n = cf.array_length(arr)
+    three = fluid.layers.fill_constant(shape=[1], dtype="int32", value=3)
+    at3 = cf.array_read(arr, three)
+    exe = fluid.Executor(fluid.CPUPlace())
+    nv, v3 = exe.run(fetch_list=[n, at3])
+    assert int(nv) == 4
+    np.testing.assert_allclose(v3, [99.0])
+
+
+def test_prepopulated_array_loop_capacity():
+    """Regression: While writes past a pre-populated array's length must not
+    clamp (capacity = existing length + max_iters)."""
+    vals = []
+    zero = fluid.layers.fill_constant(shape=[1], dtype="int32", value=0)
+    one = fluid.layers.fill_constant(shape=[1], dtype="int32", value=1)
+    a = fluid.layers.fill_constant(shape=[1], dtype="float32", value=10.0)
+    b = fluid.layers.fill_constant(shape=[1], dtype="float32", value=11.0)
+    arr = cf.array_write(a, zero)
+    cf.array_write(b, one, array=arr)
+    i = fluid.layers.fill_constant(shape=[1], dtype="int32", value=2)
+    limit = fluid.layers.fill_constant(shape=[1], dtype="int32", value=6)
+    cond = cf.less_than(i, limit)
+    w = cf.While(cond, max_iters=4)
+    with w.block():
+        cf.array_write(fluid.layers.cast(i, "float32"), i, array=arr)
+        cf.increment(i)
+        cf.less_than(i, limit, cond=cond)
+    five = fluid.layers.fill_constant(shape=[1], dtype="int32", value=5)
+    at5 = cf.array_read(arr, five)
+    n = cf.array_length(arr)
+    exe = fluid.Executor(fluid.CPUPlace())
+    nv, v5 = exe.run(fetch_list=[n, at5])
+    assert int(nv) == 6
+    np.testing.assert_allclose(v5, [5.0])
+
+
+def test_ifelse_1d_branch_outputs():
+    """Regression: IfElse merge with (B,) branch outputs and (B,1) mask must
+    produce (B,), not broadcast to (B,B)."""
+    x = fluid.layers.data(name="x", shape=[1], dtype="float32")
+    zero = fluid.layers.fill_constant_batch_size_like(x, [-1, 1], "float32", 0.0)
+    cond = cf.less_than(x, zero)
+    ie = cf.IfElse(cond)
+    with ie.true_block():
+        xt = ie.input(x)
+        ie.output(fluid.layers.reduce_sum(xt, dim=1))  # (B,)
+    with ie.false_block():
+        xf = ie.input(x)
+        ie.output(fluid.layers.reduce_sum(fluid.layers.scale(xf, scale=2.0), dim=1))
+    (out,) = ie()
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.array([[-1.0], [3.0]], np.float32)
+    (ov,) = exe.run(feed={"x": xv}, fetch_list=[out])
+    assert ov.shape == (2,)
+    np.testing.assert_allclose(ov, [-1.0, 6.0])
+
+
+def test_dropout_varies_per_scan_step():
+    """Regression: dropout inside an RNN step must draw fresh bits each
+    timestep (RNG salted by the loop counter)."""
+    T, B, D = 6, 2, 50
+    x = fluid.layers.data(name="x", shape=[T, B, D], dtype="float32", append_batch_size=False)
+    rnn = cf.StaticRNN()
+    with rnn.step():
+        xt = rnn.step_input(x)
+        mem = rnn.memory(shape=[-1, D], batch_ref=xt, init_value=0.0)
+        d = fluid.layers.dropout(xt, dropout_prob=0.5)
+        s = fluid.layers.elementwise_add(mem, d)
+        rnn.update_memory(mem, s)
+        rnn.step_output(d)
+    out = rnn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.ones((T, B, D), np.float32)
+    (ov,) = exe.run(feed={"x": xv}, fetch_list=[out])
+    masks = (ov != 0).astype(int)
+    assert any((masks[t] != masks[0]).any() for t in range(1, T)), "same mask every step"
