@@ -54,6 +54,7 @@ from .framework import (  # noqa: F401
 from .executor import Executor  # noqa: F401
 from .backward import append_backward  # noqa: F401
 from . import layers  # noqa: F401
+from . import nets  # noqa: F401
 from . import initializer  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import regularizer  # noqa: F401

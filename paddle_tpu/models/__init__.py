@@ -1,0 +1,15 @@
+"""Model zoo with the reference's benchmark/book models
+(reference: benchmark/fluid/models/*, python/paddle/fluid/tests/book/*),
+built on the paddle_tpu layers API.
+
+Each module exposes the network builder plus a ``get_model(...)`` helper
+returning ``(avg_cost, aux-metric-or-None, feed_vars)`` for training scripts and
+bench.py.
+"""
+from . import mnist  # noqa: F401
+from . import vgg  # noqa: F401
+from . import resnet  # noqa: F401
+from . import stacked_lstm  # noqa: F401
+from . import transformer  # noqa: F401
+from . import word2vec  # noqa: F401
+from . import deepfm  # noqa: F401

@@ -1,0 +1,82 @@
+"""DeepFM / wide&deep CTR model (reference capability: the ctr / pserver
+benchmark path — sparse lookup_table + wide linear part + deep MLP;
+reference sparse kernels: lookup_table_op with SelectedRows grads).
+
+TPU-native: sparse id features are dense int tensors of shape (B, F)
+(one id per field); embedding grads are dense scatter-adds, and the tables
+shard over the mesh via the DistributeTranspiler plan (expert-style row
+sharding) instead of a parameter server.
+"""
+from __future__ import annotations
+
+from .. import layers
+from ..param_attr import ParamAttr
+
+
+def deepfm_net(
+    feat_ids,
+    dense_feats,
+    label,
+    num_features: int = 1000,
+    num_fields: int = 10,
+    embed_dim: int = 10,
+    hidden_sizes=(400, 400, 400),
+):
+    """feat_ids: (B, F) int64 field ids; dense_feats: (B, Dd) float.
+    Returns (avg_cost, auc_prob)."""
+    # -- first-order (wide) term: per-id scalar weight ------------------
+    first_w = layers.embedding(
+        input=feat_ids,
+        size=[num_features, 1],
+        param_attr=ParamAttr(name="fm_first_w"),
+    )  # (B, F, 1)
+    first_order = layers.reduce_sum(first_w, dim=1)  # (B, 1)
+
+    # -- second-order (FM) term -----------------------------------------
+    emb = layers.embedding(
+        input=feat_ids,
+        size=[num_features, embed_dim],
+        param_attr=ParamAttr(name="fm_emb"),
+    )  # (B, F, K)
+    summed = layers.reduce_sum(emb, dim=1)  # (B, K)
+    summed_sq = layers.square(summed)
+    sq = layers.square(emb)
+    sq_summed = layers.reduce_sum(sq, dim=1)
+    second_order = layers.scale(
+        layers.reduce_sum(
+            layers.elementwise_sub(summed_sq, sq_summed), dim=1, keep_dim=True
+        ),
+        scale=0.5,
+    )  # (B, 1)
+
+    # -- deep part -------------------------------------------------------
+    B, F = feat_ids.shape
+    deep = layers.reshape(emb, shape=[-1, F * emb.shape[-1]])
+    if dense_feats is not None:
+        deep = layers.concat([deep, dense_feats], axis=-1)
+    for h in hidden_sizes:
+        deep = layers.fc(input=deep, size=h, act="relu")
+    deep_out = layers.fc(input=deep, size=1, act=None)
+
+    logit = layers.elementwise_add(
+        layers.elementwise_add(first_order, second_order), deep_out
+    )
+    prob = layers.sigmoid(logit)
+    label_f = layers.cast(label, "float32")
+    # numerically-stable BCE on logits: relu(x) + softplus(-|x|) - x*y
+    cost = layers.elementwise_sub(
+        layers.elementwise_add(
+            layers.relu(logit),
+            layers.softplus(layers.scale(layers.abs(logit), scale=-1.0)),
+        ),
+        layers.elementwise_mul(logit, label_f),
+    )
+    return layers.mean(cost), prob
+
+
+def get_model(num_features: int = 1000, num_fields: int = 10, dense_dim: int = 13):
+    feat_ids = layers.data(name="feat_ids", shape=[num_fields], dtype="int64")
+    dense = layers.data(name="dense", shape=[dense_dim], dtype="float32")
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    avg_cost, prob = deepfm_net(feat_ids, dense, label, num_features, num_fields)
+    return avg_cost, prob, [feat_ids, dense, label]

@@ -1,0 +1,43 @@
+"""MNIST models (reference: benchmark/fluid/models/mnist.py and
+python/paddle/fluid/tests/book/test_recognize_digits.py).
+
+- ``mlp_model``: 2x fc(200, tanh) + softmax head (book: recognize_digits MLP)
+- ``cnn_model``: conv-pool(20,5) -> conv-pool(50,5) -> fc(softmax) (reference
+  mnist.py:cnn_model — simple_img_conv_pool twice)
+"""
+from __future__ import annotations
+
+from .. import layers
+from ..nets import simple_img_conv_pool
+
+
+def mlp_model(img, class_dim: int = 10):
+    h1 = layers.fc(img, 200, act="tanh")
+    h2 = layers.fc(h1, 200, act="tanh")
+    return layers.fc(h2, class_dim, act="softmax")
+
+
+def cnn_model(img, class_dim: int = 10):
+    conv1 = simple_img_conv_pool(
+        input=img, filter_size=5, num_filters=20, pool_size=2, pool_stride=2, act="relu"
+    )
+    conv2 = simple_img_conv_pool(
+        input=conv1, filter_size=5, num_filters=50, pool_size=2, pool_stride=2, act="relu"
+    )
+    return layers.fc(conv2, class_dim, act="softmax")
+
+
+def get_model(batch_size: int = 64, use_cnn: bool = True):
+    """Returns (avg_cost, accuracy, feed list) like the reference's
+    get_model(args) (mnist.py:68)."""
+    if use_cnn:
+        img = layers.data(name="pixel", shape=[1, 28, 28], dtype="float32")
+        predict = cnn_model(img)
+    else:
+        img = layers.data(name="pixel", shape=[784], dtype="float32")
+        predict = mlp_model(img)
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    cost = layers.cross_entropy(input=predict, label=label)
+    avg_cost = layers.mean(cost)
+    acc = layers.accuracy(input=predict, label=label)
+    return avg_cost, acc, [img, label]

@@ -1,0 +1,104 @@
+"""ResNet for ImageNet / cifar10 (reference: benchmark/fluid/models/
+resnet.py). Depths 50/101/152 use the bottleneck block; cifar uses basic
+blocks. NCHW layout — our conv2d lowers to lax.conv_general_dilated which
+XLA retiles for the MXU regardless of the logical layout."""
+from __future__ import annotations
+
+from .. import layers
+
+
+def conv_bn_layer(input, ch_out, filter_size, stride, padding, act="relu"):
+    conv = layers.conv2d(
+        input=input,
+        num_filters=ch_out,
+        filter_size=filter_size,
+        stride=stride,
+        padding=padding,
+        act=None,
+        bias_attr=False,
+    )
+    return layers.batch_norm(input=conv, act=act)
+
+
+def shortcut(input, ch_out, stride):
+    ch_in = input.shape[1]
+    if ch_in != ch_out:
+        return conv_bn_layer(input, ch_out, 1, stride, 0, None)
+    return input
+
+
+def basicblock(input, ch_out, stride):
+    short = shortcut(input, ch_out, stride)
+    conv1 = conv_bn_layer(input, ch_out, 3, stride, 1)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, act=None)
+    return layers.elementwise_add(x=short, y=conv2, act="relu")
+
+
+def bottleneck(input, ch_out, stride):
+    short = shortcut(input, ch_out * 4, stride)
+    conv1 = conv_bn_layer(input, ch_out, 1, stride, 0)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1)
+    conv3 = conv_bn_layer(conv2, ch_out * 4, 1, 1, 0, act=None)
+    return layers.elementwise_add(x=short, y=conv3, act="relu")
+
+
+def layer_warp(block_func, input, ch_out, count, stride):
+    res_out = block_func(input, ch_out, stride)
+    for _ in range(1, count):
+        res_out = block_func(res_out, ch_out, 1)
+    return res_out
+
+
+def resnet_imagenet(input, class_dim: int = 1000, depth: int = 50):
+    cfg = {
+        18: ([2, 2, 2, 1], basicblock),
+        34: ([3, 4, 6, 3], basicblock),
+        50: ([3, 4, 6, 3], bottleneck),
+        101: ([3, 4, 23, 3], bottleneck),
+        152: ([3, 8, 36, 3], bottleneck),
+    }
+    stages, block_func = cfg[depth]
+    conv1 = conv_bn_layer(input, ch_out=64, filter_size=7, stride=2, padding=3)
+    pool1 = layers.pool2d(
+        input=conv1, pool_type="max", pool_size=3, pool_stride=2, pool_padding=1
+    )
+    res1 = layer_warp(block_func, pool1, 64, stages[0], 1)
+    res2 = layer_warp(block_func, res1, 128, stages[1], 2)
+    res3 = layer_warp(block_func, res2, 256, stages[2], 2)
+    res4 = layer_warp(block_func, res3, 512, stages[3], 2)
+    pool2 = layers.pool2d(input=res4, pool_size=7, pool_type="avg", global_pooling=True)
+    return layers.fc(input=pool2, size=class_dim, act="softmax")
+
+
+def resnet_cifar10(input, class_dim: int = 10, depth: int = 32):
+    assert (depth - 2) % 6 == 0
+    n = (depth - 2) // 6
+    conv1 = conv_bn_layer(input=input, ch_out=16, filter_size=3, stride=1, padding=1)
+    res1 = layer_warp(basicblock, conv1, 16, n, 1)
+    res2 = layer_warp(basicblock, res1, 32, n, 2)
+    res3 = layer_warp(basicblock, res2, 64, n, 2)
+    pool = layers.pool2d(input=res3, pool_size=8, pool_type="avg", pool_stride=1)
+    return layers.fc(input=pool, size=class_dim, act="softmax")
+
+
+def get_model(
+    dataset: str = "flowers",
+    depth: int = 50,
+    class_dim: int = 1000,
+    image_shape=(3, 224, 224),
+):
+    """(avg_cost, acc, feeds) for imagenet-shaped or cifar input
+    (reference resnet.py:get_model)."""
+    if dataset == "cifar10":
+        class_dim = 10
+        image_shape = (3, 32, 32)
+        builder, kwargs = resnet_cifar10, {"depth": 32}
+    else:
+        builder, kwargs = resnet_imagenet, {"depth": depth}
+    input = layers.data(name="data", shape=list(image_shape), dtype="float32")
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    predict = builder(input, class_dim, **kwargs)
+    cost = layers.cross_entropy(input=predict, label=label)
+    avg_cost = layers.mean(cost)
+    acc = layers.accuracy(input=predict, label=label)
+    return avg_cost, acc, [input, label]

@@ -1,0 +1,66 @@
+"""Stacked dynamic LSTM sentiment model (reference: benchmark/fluid/models/
+stacked_dynamic_lstm.py — IMDB classification with `stacked_num` LSTM
+layers).
+
+Dense (B, T) word ids + `lengths` replace LoD; each dynamic_lstm layer is a
+single lax.scan whose per-step gate matmul is batched onto the MXU.
+"""
+from __future__ import annotations
+
+from .. import layers
+
+
+def stacked_lstm_net(
+    words,
+    lengths,
+    dict_dim: int,
+    class_dim: int = 2,
+    emb_dim: int = 512,
+    hid_dim: int = 512,
+    stacked_num: int = 3,
+):
+    emb = layers.embedding(input=words, size=[dict_dim, emb_dim])
+
+    fc1 = layers.fc(input=emb, size=hid_dim * 4, num_flatten_dims=2)
+    lstm1, _cell1 = layers.dynamic_lstm(
+        input=fc1, size=hid_dim * 4, sequence_length=lengths
+    )
+
+    inputs = [fc1, lstm1]
+    for _ in range(2, stacked_num + 1):
+        fc = layers.fc(input=layers.concat(inputs, axis=-1), size=hid_dim * 4,
+                       num_flatten_dims=2)
+        lstm, _cell = layers.dynamic_lstm(
+            input=fc, size=hid_dim * 4, is_reverse=False, sequence_length=lengths
+        )
+        inputs = [fc, lstm]
+
+    fc_last = layers.sequence_pool(input=inputs[0], pool_type="max",
+                                   sequence_length=lengths)
+    lstm_last = layers.sequence_pool(input=inputs[1], pool_type="max",
+                                     sequence_length=lengths)
+    return layers.fc(
+        input=layers.concat([fc_last, lstm_last], axis=-1),
+        size=class_dim,
+        act="softmax",
+    )
+
+
+def get_model(
+    dict_dim: int = 30000,
+    seq_len: int = 80,
+    class_dim: int = 2,
+    emb_dim: int = 512,
+    hid_dim: int = 512,
+    stacked_num: int = 3,
+):
+    words = layers.data(name="words", shape=[seq_len], dtype="int64")
+    lengths = layers.data(name="lengths", shape=[], dtype="int32")
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    predict = stacked_lstm_net(
+        words, lengths, dict_dim, class_dim, emb_dim, hid_dim, stacked_num
+    )
+    cost = layers.cross_entropy(input=predict, label=label)
+    avg_cost = layers.mean(cost)
+    acc = layers.accuracy(input=predict, label=label)
+    return avg_cost, acc, [words, lengths, label]

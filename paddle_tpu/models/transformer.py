@@ -1,0 +1,225 @@
+"""Transformer (reference capability: Transformer NMT training à la
+benchmark/fluid/machine_translation.py + the fluid transformer test nets).
+
+TPU-first design notes:
+- all attention heads in one batched matmul pair ((B*H, T, Dh) shapes keep
+  the MXU saturated); softmax/dropout/residual fuse into epilogues.
+- causal + padding masks are additive -inf masks built once per step from
+  the lengths tensor (no ragged ops).
+- `transformer_lm` is the decoder-only variant used as the flagship model
+  (see __graft_entry__.py); pre-norm residuals for stable bf16 training.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import layers
+from ..initializer import NormalInitializer
+from ..param_attr import ParamAttr
+
+
+def _linear(x, size, name=None, num_flatten_dims=2, act=None):
+    return layers.fc(
+        input=x,
+        size=size,
+        num_flatten_dims=num_flatten_dims,
+        act=act,
+        param_attr=ParamAttr(name=name + ".w" if name else None,
+                             initializer=NormalInitializer(0.0, 0.02)),
+        bias_attr=ParamAttr(name=name + ".b" if name else None),
+    )
+
+
+def multi_head_attention(
+    q_in, kv_in, n_head, d_model, dropout_rate=0.0, causal=False,
+    kv_lengths=None, name=None,
+):
+    """(B, Tq, D) x (B, Tk, D) -> (B, Tq, D)."""
+    B, Tq, _ = q_in.shape
+    Tk = kv_in.shape[1]
+    d_head = d_model // n_head
+
+    q = _linear(q_in, d_model, name and name + ".q")
+    k = _linear(kv_in, d_model, name and name + ".k")
+    v = _linear(kv_in, d_model, name and name + ".v")
+
+    def split_heads(x, T):
+        x = layers.reshape(x, shape=[B, T, n_head, d_head])
+        return layers.transpose(x, perm=[0, 2, 1, 3])  # (B, H, T, Dh)
+
+    q = split_heads(q, Tq)
+    k = split_heads(k, Tk)
+    v = split_heads(v, Tk)
+
+    q = layers.scale(q, scale=float(d_head) ** -0.5)
+    logits = layers.matmul(q, k, transpose_y=True)  # (B, H, Tq, Tk)
+
+    mask = _attn_mask(B, Tq, Tk, causal=causal, kv_lengths=kv_lengths)
+    if mask is not None:
+        logits = layers.elementwise_add(logits, mask)
+    weights = layers.softmax(logits)
+    if dropout_rate:
+        weights = layers.dropout(weights, dropout_prob=dropout_rate)
+    ctx = layers.matmul(weights, v)  # (B, H, Tq, Dh)
+    ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
+    ctx = layers.reshape(ctx, shape=[B, Tq, d_model])
+    return _linear(ctx, d_model, name and name + ".out")
+
+
+def _attn_mask(B, Tq, Tk, causal=False, kv_lengths=None):
+    """Additive mask (B or 1, 1, Tq, Tk): 0 keep, -1e9 drop."""
+    parts = []
+    if causal:
+        causal_np = np.triu(np.full((Tq, Tk), -1e9, np.float32), k=1)
+        causal_var = layers.assign(causal_np.reshape(1, 1, Tq, Tk))
+        parts.append(causal_var)
+    if kv_lengths is not None:
+        # (B, Tk) padding mask from lengths
+        mask = layers.sequence_mask(kv_lengths, maxlen=Tk, dtype="float32")
+        neg = layers.scale(mask, scale=1e9, bias=-1e9)  # 0 where valid, -1e9 where pad
+        neg = layers.reshape(neg, shape=[B, 1, 1, Tk])
+        parts.append(neg)
+    if not parts:
+        return None
+    out = parts[0]
+    for p in parts[1:]:
+        out = layers.elementwise_add(out, p)
+    return out
+
+
+def positionwise_ffn(x, d_inner, d_model, dropout_rate=0.0, name=None):
+    h = _linear(x, d_inner, name and name + ".fc1", act="relu")
+    if dropout_rate:
+        h = layers.dropout(h, dropout_prob=dropout_rate)
+    return _linear(h, d_model, name and name + ".fc2")
+
+
+def _pre_norm(x, name=None):
+    return layers.layer_norm(x, begin_norm_axis=len(x.shape) - 1)
+
+
+def encoder_layer(x, n_head, d_model, d_inner, dropout_rate, lengths, name):
+    h = _pre_norm(x)
+    attn = multi_head_attention(
+        h, h, n_head, d_model, dropout_rate,
+        kv_lengths=lengths, name=name + ".attn",
+    )
+    x = layers.elementwise_add(x, attn)
+    ffn = positionwise_ffn(_pre_norm(x), d_inner, d_model, dropout_rate,
+                           name=name + ".ffn")
+    return layers.elementwise_add(x, ffn)
+
+
+def decoder_layer(x, enc, n_head, d_model, d_inner, dropout_rate,
+                  src_lengths, tgt_lengths, name):
+    """`enc` must already be normalized (transformer_encoder output)."""
+    h = _pre_norm(x)
+    self_attn = multi_head_attention(
+        h, h, n_head, d_model, dropout_rate,
+        causal=True, kv_lengths=tgt_lengths, name=name + ".self",
+    )
+    x = layers.elementwise_add(x, self_attn)
+    if enc is not None:
+        cross = multi_head_attention(
+            _pre_norm(x), enc, n_head, d_model, dropout_rate,
+            kv_lengths=src_lengths, name=name + ".cross",
+        )
+        x = layers.elementwise_add(x, cross)
+    ffn = positionwise_ffn(_pre_norm(x), d_inner, d_model, dropout_rate,
+                           name=name + ".ffn")
+    return layers.elementwise_add(x, ffn)
+
+
+def _embed(ids, vocab_size, d_model, max_len, name):
+    B, T = ids.shape
+    tok = layers.embedding(
+        input=ids, size=[vocab_size, d_model],
+        param_attr=ParamAttr(name=name + ".tok_emb",
+                             initializer=NormalInitializer(0.0, 0.02)),
+    )
+    pos_ids = layers.assign(np.arange(max_len, dtype=np.int64)[:T].reshape(1, T))
+    pos = layers.embedding(
+        input=pos_ids, size=[max_len, d_model],
+        param_attr=ParamAttr(name=name + ".pos_emb",
+                             initializer=NormalInitializer(0.0, 0.02)),
+    )
+    return layers.elementwise_add(tok, pos)
+
+
+def transformer_encoder(src_ids, src_lengths, vocab_size, n_layer, n_head,
+                        d_model, d_inner, dropout_rate=0.1, max_len=512):
+    x = _embed(src_ids, vocab_size, d_model, max_len, "enc")
+    for i in range(n_layer):
+        x = encoder_layer(x, n_head, d_model, d_inner, dropout_rate,
+                          src_lengths, "enc.l%d" % i)
+    return _pre_norm(x)
+
+
+def transformer_nmt(
+    src_ids, src_lengths, tgt_ids, tgt_lengths, label_ids,
+    src_vocab_size, tgt_vocab_size,
+    n_layer=2, n_head=8, d_model=512, d_inner=2048,
+    dropout_rate=0.1, max_len=512,
+):
+    """Encoder-decoder training graph; returns (avg_cost, logits)."""
+    enc = transformer_encoder(src_ids, src_lengths, src_vocab_size, n_layer,
+                              n_head, d_model, d_inner, dropout_rate, max_len)
+    x = _embed(tgt_ids, tgt_vocab_size, d_model, max_len, "dec")
+    for i in range(n_layer):
+        x = decoder_layer(x, enc, n_head, d_model, d_inner, dropout_rate,
+                          src_lengths, tgt_lengths, "dec.l%d" % i)
+    x = _pre_norm(x)
+    logits = _linear(x, tgt_vocab_size, "dec.head")
+    B, T = tgt_ids.shape
+    loss = layers.softmax_with_cross_entropy(
+        layers.reshape(logits, shape=[B * T, tgt_vocab_size]),
+        layers.reshape(label_ids, shape=[B * T, 1]),
+    )
+    # mask padding positions out of the loss
+    mask = layers.sequence_mask(tgt_lengths, maxlen=T, dtype="float32")
+    mask = layers.reshape(mask, shape=[B * T, 1])
+    loss = layers.elementwise_mul(loss, mask)
+    avg_cost = layers.elementwise_div(
+        layers.reduce_sum(loss), layers.reduce_sum(mask)
+    )
+    return avg_cost, logits
+
+
+def transformer_lm(
+    ids, labels, vocab_size, n_layer=4, n_head=8, d_model=512, d_inner=2048,
+    dropout_rate=0.0, max_len=2048,
+):
+    """Decoder-only causal LM (flagship). Returns (avg_cost, logits)."""
+    x = _embed(ids, vocab_size, d_model, max_len, "lm")
+    for i in range(n_layer):
+        x = decoder_layer(x, None, n_head, d_model, d_inner, dropout_rate,
+                          None, None, "lm.l%d" % i)
+    x = _pre_norm(x)
+    logits = _linear(x, vocab_size, "lm.head")
+    B, T = ids.shape
+    loss = layers.softmax_with_cross_entropy(
+        layers.reshape(logits, shape=[B * T, vocab_size]),
+        layers.reshape(labels, shape=[B * T, 1]),
+    )
+    return layers.mean(loss), logits
+
+
+def get_model(
+    batch_size=16, seq_len=64, src_vocab_size=10000, tgt_vocab_size=10000,
+    n_layer=2, n_head=8, d_model=512, d_inner=2048, dropout_rate=0.1,
+):
+    src = layers.data(name="src_ids", shape=[batch_size, seq_len],
+                      dtype="int64", append_batch_size=False)
+    src_len = layers.data(name="src_len", shape=[batch_size], dtype="int32",
+                          append_batch_size=False)
+    tgt = layers.data(name="tgt_ids", shape=[batch_size, seq_len],
+                      dtype="int64", append_batch_size=False)
+    tgt_len = layers.data(name="tgt_len", shape=[batch_size], dtype="int32",
+                          append_batch_size=False)
+    lbl = layers.data(name="lbl_ids", shape=[batch_size, seq_len],
+                      dtype="int64", append_batch_size=False)
+    avg_cost, _logits = transformer_nmt(
+        src, src_len, tgt, tgt_len, lbl, src_vocab_size, tgt_vocab_size,
+        n_layer, n_head, d_model, d_inner, dropout_rate,
+    )
+    return avg_cost, None, [src, src_len, tgt, tgt_len, lbl]

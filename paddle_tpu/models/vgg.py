@@ -1,0 +1,46 @@
+"""VGG16 with BN + dropout (reference: benchmark/fluid/models/vgg.py:
+vgg16_bn_drop). Five img_conv_group stacks (64,128,256,512,512) then two
+fc(512)+BN heads."""
+from __future__ import annotations
+
+from .. import layers
+from ..nets import img_conv_group
+
+
+def conv_block(input, num_filter, groups, dropouts):
+    return img_conv_group(
+        input=input,
+        pool_size=2,
+        pool_stride=2,
+        conv_num_filter=[num_filter] * groups,
+        conv_filter_size=3,
+        conv_act="relu",
+        conv_with_batchnorm=True,
+        conv_batchnorm_drop_rate=dropouts,
+        pool_type="max",
+    )
+
+
+def vgg16_bn_drop(input, class_dim: int = 1000):
+    conv1 = conv_block(input, 64, 2, [0.3, 0.0])
+    conv2 = conv_block(conv1, 128, 2, [0.4, 0.0])
+    conv3 = conv_block(conv2, 256, 3, [0.4, 0.4, 0.0])
+    conv4 = conv_block(conv3, 512, 3, [0.4, 0.4, 0.0])
+    conv5 = conv_block(conv4, 512, 3, [0.4, 0.4, 0.0])
+
+    drop = layers.dropout(x=conv5, dropout_prob=0.5)
+    fc1 = layers.fc(input=drop, size=512, act=None)
+    bn = layers.batch_norm(input=fc1, act="relu")
+    drop2 = layers.dropout(x=bn, dropout_prob=0.5)
+    fc2 = layers.fc(input=drop2, size=512, act=None)
+    return layers.fc(input=fc2, size=class_dim, act="softmax")
+
+
+def get_model(image_shape=(3, 224, 224), class_dim: int = 1000):
+    image = layers.data(name="data", shape=list(image_shape), dtype="float32")
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    predict = vgg16_bn_drop(image, class_dim)
+    cost = layers.cross_entropy(input=predict, label=label)
+    avg_cost = layers.mean(cost)
+    acc = layers.accuracy(input=predict, label=label)
+    return avg_cost, acc, [image, label]

@@ -1,0 +1,33 @@
+"""word2vec CBOW model (reference: python/paddle/fluid/tests/book/
+test_word2vec.py — 4-gram context predicting the next word, shared
+embedding table)."""
+from __future__ import annotations
+
+from .. import layers
+from ..param_attr import ParamAttr
+
+
+def word2vec_net(words, dict_size: int, embed_size: int = 32, hidden_size: int = 256):
+    """`words` = list of 4 context-word id tensors + 1 target; returns
+    (avg_cost, predict)."""
+    embeds = [
+        layers.embedding(
+            input=w,
+            size=[dict_size, embed_size],
+            param_attr=ParamAttr(name="shared_w"),
+        )
+        for w in words[:-1]
+    ]
+    concat = layers.concat(input=embeds, axis=-1)
+    concat = layers.reshape(concat, shape=[-1, embed_size * len(embeds)])
+    hidden = layers.fc(input=concat, size=hidden_size, act="sigmoid")
+    predict = layers.fc(input=hidden, size=dict_size, act="softmax")
+    cost = layers.cross_entropy(input=predict, label=words[-1])
+    return layers.mean(cost), predict
+
+
+def get_model(dict_size: int = 2000, embed_size: int = 32, hidden_size: int = 256):
+    names = ["firstw", "secondw", "thirdw", "fourthw", "nextw"]
+    words = [layers.data(name=n, shape=[1], dtype="int64") for n in names]
+    avg_cost, predict = word2vec_net(words, dict_size, embed_size, hidden_size)
+    return avg_cost, predict, words

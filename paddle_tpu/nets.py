@@ -1,0 +1,175 @@
+"""Composite network helpers (reference: python/paddle/fluid/nets.py).
+
+simple_img_conv_pool / img_conv_group / sequence_conv_pool / glu /
+scaled_dot_product_attention, built purely from layers.* so every helper
+lowers to fused XLA (conv+bias+act epilogues ride the MXU).
+"""
+from __future__ import annotations
+
+from . import layers
+
+__all__ = [
+    "simple_img_conv_pool",
+    "img_conv_group",
+    "sequence_conv_pool",
+    "glu",
+    "scaled_dot_product_attention",
+]
+
+
+def simple_img_conv_pool(
+    input,
+    num_filters,
+    filter_size,
+    pool_size,
+    pool_stride,
+    pool_padding=0,
+    pool_type="max",
+    global_pooling=False,
+    conv_stride=1,
+    conv_padding=0,
+    conv_dilation=1,
+    conv_groups=1,
+    param_attr=None,
+    bias_attr=None,
+    act=None,
+    use_cudnn=True,
+):
+    conv_out = layers.conv2d(
+        input=input,
+        num_filters=num_filters,
+        filter_size=filter_size,
+        stride=conv_stride,
+        padding=conv_padding,
+        dilation=conv_dilation,
+        groups=conv_groups,
+        param_attr=param_attr,
+        bias_attr=bias_attr,
+        act=act,
+    )
+    return layers.pool2d(
+        input=conv_out,
+        pool_size=pool_size,
+        pool_type=pool_type,
+        pool_stride=pool_stride,
+        pool_padding=pool_padding,
+        global_pooling=global_pooling,
+    )
+
+
+def img_conv_group(
+    input,
+    conv_num_filter,
+    pool_size,
+    conv_padding=1,
+    conv_filter_size=3,
+    conv_act=None,
+    param_attr=None,
+    conv_with_batchnorm=False,
+    conv_batchnorm_drop_rate=0.0,
+    pool_stride=1,
+    pool_type="max",
+    use_cudnn=True,
+):
+    """Stack of convs (optionally +BN +dropout) followed by one pool
+    (reference nets.py:img_conv_group; the VGG building block)."""
+    tmp = input
+    assert isinstance(conv_num_filter, (list, tuple))
+
+    def _to_list(v):
+        if hasattr(v, "__len__"):
+            return list(v)
+        return [v] * len(conv_num_filter)
+
+    conv_padding = _to_list(conv_padding)
+    conv_filter_size = _to_list(conv_filter_size)
+    param_attr = _to_list(param_attr)
+    conv_with_batchnorm = _to_list(conv_with_batchnorm)
+    conv_batchnorm_drop_rate = _to_list(conv_batchnorm_drop_rate)
+
+    for i in range(len(conv_num_filter)):
+        local_conv_act = conv_act
+        if conv_with_batchnorm[i]:
+            local_conv_act = None
+        tmp = layers.conv2d(
+            input=tmp,
+            num_filters=conv_num_filter[i],
+            filter_size=conv_filter_size[i],
+            padding=conv_padding[i],
+            param_attr=param_attr[i],
+            act=local_conv_act,
+        )
+        if conv_with_batchnorm[i]:
+            tmp = layers.batch_norm(input=tmp, act=conv_act)
+            drop_rate = conv_batchnorm_drop_rate[i]
+            if abs(drop_rate) > 1e-5:
+                tmp = layers.dropout(x=tmp, dropout_prob=drop_rate)
+
+    return layers.pool2d(
+        input=tmp, pool_size=pool_size, pool_type=pool_type, pool_stride=pool_stride
+    )
+
+
+def sequence_conv_pool(input, num_filters, filter_size, param_attr=None,
+                       act="sigmoid", pool_type="max", sequence_length=None):
+    """Conv over time then pool over time (reference nets.py:
+    sequence_conv_pool); dense (B, T, C) + sequence_length convention."""
+    conv_out = layers.sequence_conv(
+        input=input,
+        num_filters=num_filters,
+        filter_size=filter_size,
+        param_attr=param_attr,
+        act=act,
+        sequence_length=sequence_length,
+    )
+    return layers.sequence_pool(
+        input=conv_out, pool_type=pool_type, sequence_length=sequence_length
+    )
+
+
+def glu(input, dim=-1):
+    """Gated linear unit: split in two along dim, a * sigmoid(b)
+    (reference nets.py:glu)."""
+    a, b = layers.split(input, num_or_sections=2, dim=dim)
+    return layers.elementwise_mul(x=a, y=layers.sigmoid(b))
+
+
+def scaled_dot_product_attention(queries, keys, values, num_heads=1, dropout_rate=0.0):
+    """Multi-head scaled dot-product attention over (B, T, D) tensors
+    (reference nets.py:scaled_dot_product_attention). Returns (B, Tq, Dv).
+
+    All heads are computed in ONE batched matmul pair — (B*H, T, D/H)
+    shapes keep the MXU busy; softmax+dropout fuse into the epilogue.
+    """
+    if queries.shape[-1] != keys.shape[-1]:
+        raise ValueError("queries and keys must have the same hidden size")
+    if keys.shape[-2] != values.shape[-2]:
+        raise ValueError("keys and values must share the time dimension")
+    if queries.shape[-1] % num_heads != 0:
+        raise ValueError("num_heads must evenly divide the hidden size")
+
+    def _split_heads(x):
+        if num_heads == 1:
+            return x
+        B, T, D = x.shape
+        x = layers.reshape(x, shape=[B, T, num_heads, D // num_heads])
+        return layers.transpose(x, perm=[0, 2, 1, 3])
+
+    def _merge_heads(x):
+        if num_heads == 1:
+            return x
+        B, H, T, Dh = x.shape
+        x = layers.transpose(x, perm=[0, 2, 1, 3])
+        return layers.reshape(x, shape=[B, T, H * Dh])
+
+    q = _split_heads(queries)
+    k = _split_heads(keys)
+    v = _split_heads(values)
+    key_dim = float(queries.shape[-1] // num_heads)
+    scaled_q = layers.scale(q, scale=key_dim ** -0.5)
+    product = layers.matmul(x=scaled_q, y=k, transpose_y=True)
+    weights = layers.softmax(product)
+    if dropout_rate:
+        weights = layers.dropout(weights, dropout_prob=dropout_rate)
+    ctx = layers.matmul(weights, v)
+    return _merge_heads(ctx)

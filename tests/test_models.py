@@ -1,0 +1,144 @@
+"""Model-zoo smoke + convergence tests (reference test strategy:
+python/paddle/fluid/tests/book/*)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import models
+
+
+def _train(loss, feeds_fn, steps=8, lr=1e-3, opt=None):
+    (opt or fluid.optimizer.Adam(lr)).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    out = [float(exe.run(feed=feeds_fn(), fetch_list=[loss])[0]) for _ in range(steps)]
+    return out
+
+
+def test_mnist_cnn_trains():
+    avg_cost, acc, (img, label) = models.mnist.get_model(use_cnn=True)
+    r = np.random.RandomState(0)
+    feed = {
+        "pixel": r.rand(8, 1, 28, 28).astype(np.float32),
+        "label": r.randint(0, 10, (8, 1)).astype(np.int64),
+    }
+    losses = _train(avg_cost, lambda: feed, steps=15, lr=1e-2)
+    assert losses[-1] < losses[0]
+
+
+def test_resnet_cifar_forward_and_step():
+    avg_cost, acc, (img, label) = models.resnet.get_model(dataset="cifar10")
+    r = np.random.RandomState(0)
+    feed = {
+        "data": r.rand(4, 3, 32, 32).astype(np.float32),
+        "label": r.randint(0, 10, (4, 1)).astype(np.int64),
+    }
+    losses = _train(avg_cost, lambda: feed, steps=3, lr=1e-2)
+    assert np.isfinite(losses).all()
+
+
+def test_vgg_cifar_shaped_forward():
+    # smaller input keeps the test fast; same graph structure
+    image = fluid.layers.data(name="data", shape=[3, 32, 32], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    predict = models.vgg.vgg16_bn_drop(image, class_dim=10)
+    avg_cost = fluid.layers.mean(fluid.layers.cross_entropy(predict, label))
+    r = np.random.RandomState(0)
+    feed = {
+        "data": r.rand(2, 3, 32, 32).astype(np.float32),
+        "label": r.randint(0, 10, (2, 1)).astype(np.int64),
+    }
+    losses = _train(avg_cost, lambda: feed, steps=2, lr=1e-3)
+    assert np.isfinite(losses).all()
+
+
+def test_stacked_lstm_trains():
+    avg_cost, acc, feeds = models.stacked_lstm.get_model(
+        dict_dim=200, seq_len=12, emb_dim=32, hid_dim=32, stacked_num=2
+    )
+    r = np.random.RandomState(0)
+    feed = {
+        "words": r.randint(0, 200, (4, 12)).astype(np.int64),
+        "lengths": r.randint(1, 13, (4,)).astype(np.int32),
+        "label": r.randint(0, 2, (4, 1)).astype(np.int64),
+    }
+    losses = _train(avg_cost, lambda: feed, steps=10, lr=1e-2)
+    assert losses[-1] < losses[0]
+
+
+def test_transformer_nmt_trains():
+    B, T = 4, 10
+    avg_cost, _, feeds = models.transformer.get_model(
+        batch_size=B, seq_len=T, src_vocab_size=100, tgt_vocab_size=100,
+        n_layer=1, n_head=2, d_model=32, d_inner=64, dropout_rate=0.0,
+    )
+    r = np.random.RandomState(0)
+    feed = {
+        "src_ids": r.randint(0, 100, (B, T)).astype(np.int64),
+        "src_len": np.full((B,), T, np.int32),
+        "tgt_ids": r.randint(0, 100, (B, T)).astype(np.int64),
+        "tgt_len": np.full((B,), T, np.int32),
+        "lbl_ids": r.randint(0, 100, (B, T)).astype(np.int64),
+    }
+    losses = _train(avg_cost, lambda: feed, steps=12, lr=1e-2)
+    assert losses[-1] < losses[0], losses
+
+
+def test_transformer_lm_trains():
+    B, T, V = 4, 16, 50
+    ids = fluid.layers.data(name="ids", shape=[B, T], dtype="int64", append_batch_size=False)
+    lbl = fluid.layers.data(name="lbl", shape=[B, T], dtype="int64", append_batch_size=False)
+    loss, _ = models.transformer.transformer_lm(
+        ids, lbl, V, n_layer=1, n_head=2, d_model=32, d_inner=64, max_len=T
+    )
+    r = np.random.RandomState(0)
+    feed = {
+        "ids": r.randint(0, V, (B, T)).astype(np.int64),
+        "lbl": r.randint(0, V, (B, T)).astype(np.int64),
+    }
+    losses = _train(loss, lambda: feed, steps=12, lr=1e-2)
+    assert losses[-1] < losses[0]
+
+
+def test_transformer_lm_causality():
+    """Changing a future token must not change earlier logits."""
+    B, T, V = 1, 8, 30
+    ids = fluid.layers.data(name="ids", shape=[B, T], dtype="int64", append_batch_size=False)
+    lbl = fluid.layers.data(name="lbl", shape=[B, T], dtype="int64", append_batch_size=False)
+    _, logits = models.transformer.transformer_lm(
+        ids, lbl, V, n_layer=1, n_head=2, d_model=16, d_inner=32, max_len=T
+    )
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    r = np.random.RandomState(0)
+    a = r.randint(0, V, (B, T)).astype(np.int64)
+    b = a.copy()
+    b[0, -1] = (b[0, -1] + 1) % V
+    l = np.zeros((B, T), np.int64)
+    (la,) = exe.run(feed={"ids": a, "lbl": l}, fetch_list=[logits])
+    (lb,) = exe.run(feed={"ids": b, "lbl": l}, fetch_list=[logits])
+    np.testing.assert_allclose(la[0, :-1], lb[0, :-1], atol=1e-5)
+    assert not np.allclose(la[0, -1], lb[0, -1])
+
+
+def test_word2vec_trains():
+    avg_cost, predict, words = models.word2vec.get_model(dict_size=100)
+    r = np.random.RandomState(0)
+    feed = {n: r.randint(0, 100, (16, 1)).astype(np.int64)
+            for n in ["firstw", "secondw", "thirdw", "fourthw", "nextw"]}
+    losses = _train(avg_cost, lambda: feed, steps=10, lr=1e-2)
+    assert losses[-1] < losses[0]
+
+
+def test_deepfm_trains():
+    avg_cost, prob, feeds = models.deepfm.get_model(
+        num_features=500, num_fields=8, dense_dim=4
+    )
+    r = np.random.RandomState(0)
+    feed = {
+        "feat_ids": r.randint(0, 500, (16, 8)).astype(np.int64),
+        "dense": r.rand(16, 4).astype(np.float32),
+        "label": r.randint(0, 2, (16, 1)).astype(np.int64),
+    }
+    losses = _train(avg_cost, lambda: feed, steps=10, lr=1e-2)
+    assert losses[-1] < losses[0]
