@@ -61,5 +61,16 @@ from . import regularizer  # noqa: F401
 from . import clip  # noqa: F401
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
 from .layer_helper import LayerHelper  # noqa: F401
+from . import io  # noqa: F401
+from . import parallel  # noqa: F401
+from .parallel import ParallelExecutor, ExecutionStrategy, BuildStrategy  # noqa: F401
+from . import transpiler  # noqa: F401
+from .transpiler import (  # noqa: F401
+    DistributeTranspiler,
+    DistributeTranspilerConfig,
+    InferenceTranspiler,
+    memory_optimize,
+    release_memory,
+)
 
 __version__ = "0.1.0"

@@ -56,6 +56,57 @@ class _Compiled:
         self.program = program
 
 
+def analyze_state(program: Program, feed_names):
+    """Persistable vars read (state inputs) and written (state outputs)
+    by the program's ops."""
+    read, written = [], []
+    seen_r, seen_w = set(), set()
+    for block in program.blocks:
+        for op in block.ops:
+            for name in op.input_arg_names:
+                var = block._find_var_recursive(name)
+                if var is not None and var.persistable and name not in seen_r and name not in feed_names:
+                    seen_r.add(name)
+                    read.append(name)
+            for name in op.output_arg_names:
+                var = block._find_var_recursive(name)
+                if var is not None and var.persistable and name not in seen_w:
+                    seen_w.add(name)
+                    written.append(name)
+    return read, written
+
+
+def build_step_fn(program: Program, fetch_names, state_in, state_out):
+    """The pure traced step: (feeds, state, rng_key) -> (fetches, new_state).
+
+    Shared by Executor (jit, one device) and ParallelExecutor (jit over a
+    Mesh with shardings) — the SAME computation, different partitionings.
+    """
+    block = program.global_block()
+
+    def stepfn(feeds: Dict, state: Dict, rng_key):
+        env: Dict = {}
+        env.update(state)
+        env.update(feeds)
+        rng = RngStream(rng_key)
+        trace_block(block, env, rng)
+        fetches = []
+        for name in fetch_names:
+            if name not in env:
+                raise KeyError(
+                    "fetch target %r was not produced by the program" % name
+                )
+            fetches.append(env[name])
+        # Every donated state input must reappear as an output (XLA
+        # aliases unchanged ones straight through); otherwise the Scope
+        # would be left holding donated (invalidated) buffers.
+        out_names = set(state_in) | set(state_out)
+        new_state = {n: env[n] for n in out_names if n in env}
+        return tuple(fetches), new_state
+
+    return stepfn
+
+
 class Executor:
     def __init__(self, place: Optional[Place] = None):
         self.place = place if place is not None else CPUPlace()
@@ -64,28 +115,9 @@ class Executor:
         self._seed = 0
 
     # -- compilation -----------------------------------------------------
-    def _analyze_state(self, program: Program, feed_names):
-        """Persistable vars read (state inputs) and written (state outputs)
-        by the program's ops."""
-        read, written = [], []
-        seen_r, seen_w = set(), set()
-        for block in program.blocks:
-            for op in block.ops:
-                for name in op.input_arg_names:
-                    var = block._find_var_recursive(name)
-                    if var is not None and var.persistable and name not in seen_r and name not in feed_names:
-                        seen_r.add(name)
-                        read.append(name)
-                for name in op.output_arg_names:
-                    var = block._find_var_recursive(name)
-                    if var is not None and var.persistable and name not in seen_w:
-                        seen_w.add(name)
-                        written.append(name)
-        return read, written
-
     def _compile(self, program: Program, feed_sig, fetch_names, scope: Scope) -> _Compiled:
         feed_names = tuple(n for n, _, _ in feed_sig)
-        state_in, state_out = self._analyze_state(program, set(feed_names))
+        state_in, state_out = analyze_state(program, set(feed_names))
         # state vars written before ever being read (pure init, e.g. startup
         # programs) need no input value
         missing = [n for n in state_in if scope.find_var(n) is None]
@@ -95,28 +127,7 @@ class Executor:
                 "startup program first" % (missing,)
             )
 
-        block = program.global_block()
-
-        def stepfn(feeds: Dict, state: Dict, rng_key):
-            env: Dict = {}
-            env.update(state)
-            env.update(feeds)
-            rng = RngStream(rng_key)
-            trace_block(block, env, rng)
-            fetches = []
-            for name in fetch_names:
-                if name not in env:
-                    raise KeyError(
-                        "fetch target %r was not produced by the program" % name
-                    )
-                fetches.append(env[name])
-            # Every donated state input must reappear as an output (XLA
-            # aliases unchanged ones straight through); otherwise the Scope
-            # would be left holding donated (invalidated) buffers.
-            out_names = set(state_in) | set(state_out)
-            new_state = {n: env[n] for n in out_names if n in env}
-            return tuple(fetches), new_state
-
+        stepfn = build_step_fn(program, fetch_names, state_in, state_out)
         fn = jax.jit(stepfn, donate_argnums=(1,))
         return _Compiled(fn, state_in, state_out, fetch_names, program)
 

@@ -187,7 +187,16 @@ def trace_block(block: Block, env: Dict, rng: RngStream) -> Dict:
                 )
             pvals[name] = env[name]
 
-        loss_val, vjp_fn, fenv = jax.vjp(forward, pvals, has_aux=True)
+        # memory_optimize() (transpiler/memory_optimizer.py) sets a remat
+        # policy: the replayed forward is checkpointed so the backward
+        # recomputes activations instead of saving them (HBM for FLOPs).
+        policy_name = getattr(block.program, "_remat_policy", None)
+        fwd_fn = forward
+        if policy_name:
+            fwd_fn = jax.checkpoint(
+                forward, policy=getattr(jax.checkpoint_policies, policy_name)
+            )
+        loss_val, vjp_fn, fenv = jax.vjp(fwd_fn, pvals, has_aux=True)
         (grads,) = vjp_fn(jnp.ones_like(loss_val))
 
         # fenv is the authoritative post-forward env; keep grad vars and

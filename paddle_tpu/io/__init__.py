@@ -1,0 +1,414 @@
+"""Model persistence: vars, inference models, training checkpoints.
+
+Reference: python/paddle/fluid/io.py (save_vars/save_params/
+save_persistables/load_* and save/load_inference_model, which run C++
+`save`/`load` ops writing LoDTensor protobufs) and trainer.py:
+save_checkpoint/load_checkpoint.
+
+TPU-native format:
+- variables: one ``.npy`` per var, or a single ``.npz`` when ``filename``
+  is given (the reference's save_combine). Device arrays are fetched from
+  the Scope — there are no save ops in the graph.
+- inference model: program JSON (framework/core.py serialization) +
+  params npz. Loading returns a ready-to-jit Program.
+- checkpoints: step + program fingerprint + every persistable (parameters
+  AND optimizer accumulators AND bn stats), with retention like the
+  reference's max_num_checkpoints. For multi-host sharded state, orbax
+  (save_sharded_checkpoint) writes each host's shards in parallel.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..framework.core import Parameter, Program, Variable, default_main_program
+from ..framework.scope import Scope, global_scope
+
+__all__ = [
+    "is_parameter",
+    "is_persistable",
+    "save_vars",
+    "save_params",
+    "save_persistables",
+    "load_vars",
+    "load_params",
+    "load_persistables",
+    "get_inference_program",
+    "save_inference_model",
+    "load_inference_model",
+    "save_checkpoint",
+    "load_checkpoint",
+    "clean_checkpoint",
+    "get_latest_checkpoint_serial",
+    "get_parameter_value",
+    "get_parameter_value_by_name",
+    "save_sharded_checkpoint",
+    "load_sharded_checkpoint",
+]
+
+_MODEL_FILE = "__model__"
+_CKPT_PREFIX = "checkpoint_"
+
+
+def is_parameter(var: Variable) -> bool:
+    """Reference: io.py:is_parameter."""
+    return isinstance(var, Parameter)
+
+
+def is_persistable(var: Variable) -> bool:
+    """Reference: io.py:is_persistable."""
+    return bool(var.persistable)
+
+
+def _np_name(name: str) -> str:
+    # var names are filesystem-safe except path separators
+    return name.replace("/", "%2F")
+
+
+def _npz_path(dirname: str, filename: str) -> str:
+    # np.savez appends ".npz" to extensionless paths; normalize so that
+    # save(filename="__params__") and load(filename="__params__") agree
+    if not filename.endswith(".npz"):
+        filename += ".npz"
+    return os.path.join(dirname, filename)
+
+
+def _scope_of(executor, scope: Optional[Scope]) -> Scope:
+    return scope if scope is not None else global_scope()
+
+
+# ---------------------------------------------------------------------------
+# save/load vars
+# ---------------------------------------------------------------------------
+
+
+def save_vars(
+    executor,
+    dirname: str,
+    main_program: Optional[Program] = None,
+    vars: Optional[Sequence[Variable]] = None,
+    predicate: Optional[Callable[[Variable], bool]] = None,
+    filename: Optional[str] = None,
+    scope: Optional[Scope] = None,
+):
+    """Reference: io.py:save_vars. Values come from the Scope (the runtime
+    store), not from graph save ops."""
+    scope = _scope_of(executor, scope)
+    if vars is None:
+        program = main_program if main_program is not None else default_main_program()
+        vars = [v for v in program.list_vars() if predicate is None or predicate(v)]
+    os.makedirs(dirname, exist_ok=True)
+    arrays: Dict[str, np.ndarray] = {}
+    for var in vars:
+        name = var.name if isinstance(var, Variable) else str(var)
+        val = scope.find_var(name)
+        if val is None:
+            raise RuntimeError("variable %r has no value in scope" % name)
+        arrays[name] = np.asarray(val)
+    if filename is not None:
+        np.savez(_npz_path(dirname, filename), **{_np_name(k): v for k, v in arrays.items()})
+    else:
+        for name, arr in arrays.items():
+            np.save(os.path.join(dirname, _np_name(name) + ".npy"), arr)
+    return sorted(arrays)
+
+
+def save_params(executor, dirname, main_program=None, filename=None, scope=None):
+    """Reference: io.py:save_params — trainable parameters only."""
+    return save_vars(executor, dirname, main_program=main_program,
+                     predicate=is_parameter, filename=filename, scope=scope)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None, scope=None):
+    """Reference: io.py:save_persistables — params + optimizer accumulators
+    + bn stats + lr vars: everything needed to resume."""
+    return save_vars(executor, dirname, main_program=main_program,
+                     predicate=is_persistable, filename=filename, scope=scope)
+
+
+def load_vars(
+    executor,
+    dirname: str,
+    main_program: Optional[Program] = None,
+    vars: Optional[Sequence[Variable]] = None,
+    predicate: Optional[Callable[[Variable], bool]] = None,
+    filename: Optional[str] = None,
+    scope: Optional[Scope] = None,
+):
+    """Reference: io.py:load_vars. Loaded arrays are set in the Scope and
+    re-land on device at the next jitted step (XLA transfers once)."""
+    scope = _scope_of(executor, scope)
+    if vars is None:
+        program = main_program if main_program is not None else default_main_program()
+        vars = [v for v in program.list_vars() if predicate is None or predicate(v)]
+    names = [v.name if isinstance(v, Variable) else str(v) for v in vars]
+    if filename is not None:
+        with np.load(_npz_path(dirname, filename)) as npz:
+            data = {k: npz[k] for k in npz.files}
+        for name in names:
+            key = _np_name(name)
+            if key not in data:
+                raise RuntimeError("variable %r not found in %s" % (name, filename))
+            scope.set_var(name, data[key])
+    else:
+        for name in names:
+            path = os.path.join(dirname, _np_name(name) + ".npy")
+            if not os.path.exists(path):
+                raise RuntimeError("variable file %s does not exist" % path)
+            scope.set_var(name, np.load(path))
+    return sorted(names)
+
+
+def load_params(executor, dirname, main_program=None, filename=None, scope=None):
+    return load_vars(executor, dirname, main_program=main_program,
+                     predicate=is_parameter, filename=filename, scope=scope)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None, scope=None):
+    return load_vars(executor, dirname, main_program=main_program,
+                     predicate=is_persistable, filename=filename, scope=scope)
+
+
+def get_parameter_value(para: Parameter, executor, scope=None) -> np.ndarray:
+    """Reference: io.py:get_parameter_value."""
+    return get_parameter_value_by_name(para.name, executor, scope=scope)
+
+
+def get_parameter_value_by_name(name: str, executor, program=None, scope=None) -> np.ndarray:
+    val = _scope_of(executor, scope).find_var(name)
+    if val is None:
+        raise RuntimeError("variable %r has no value in scope" % name)
+    return np.asarray(val)
+
+
+# ---------------------------------------------------------------------------
+# inference model
+# ---------------------------------------------------------------------------
+
+
+def _prune_for_targets(program: Program, target_names: List[str]) -> Program:
+    """Backward slice: keep only ops whose outputs (transitively) feed the
+    targets. Plays the role of the reference's Program.prune()."""
+    pruned = program.clone(for_test=True)
+    gb = pruned.global_block()
+    needed = set(target_names)
+    kept = []
+    for op in reversed(gb.ops):
+        if any(n in needed for n in op.output_arg_names):
+            kept.append(op)
+            needed.update(op.input_arg_names)
+    gb.ops = list(reversed(kept))
+    pruned._bump()
+    return pruned
+
+
+def get_inference_program(target_vars, main_program: Optional[Program] = None) -> Program:
+    """Reference: io.py:get_inference_program."""
+    program = main_program if main_program is not None else default_main_program()
+    if not isinstance(target_vars, (list, tuple)):
+        target_vars = [target_vars]
+    names = [v.name if isinstance(v, Variable) else str(v) for v in target_vars]
+    return _prune_for_targets(program, names)
+
+
+def save_inference_model(
+    dirname: str,
+    feeded_var_names: Sequence[str],
+    target_vars: Sequence,
+    executor,
+    main_program: Optional[Program] = None,
+    model_filename: Optional[str] = None,
+    params_filename: Optional[str] = None,
+    export_for_deployment: bool = True,
+    scope: Optional[Scope] = None,
+):
+    """Reference: io.py:save_inference_model. Writes the pruned inference
+    program as JSON plus the params it needs."""
+    program = main_program if main_program is not None else default_main_program()
+    if not isinstance(target_vars, (list, tuple)):
+        target_vars = [target_vars]
+    target_names = [v.name if isinstance(v, Variable) else str(v) for v in target_vars]
+    pruned = _prune_for_targets(program, target_names)
+
+    os.makedirs(dirname, exist_ok=True)
+    meta = {
+        "feed_names": list(feeded_var_names),
+        "fetch_names": target_names,
+        "program": pruned.to_dict(),
+    }
+    model_filename = model_filename or _MODEL_FILE
+    with open(os.path.join(dirname, model_filename), "w") as f:
+        json.dump(meta, f)
+
+    # params actually referenced by the pruned program (any block)
+    used = {n for blk in pruned.blocks for op in blk.ops for n in op.input_arg_names}
+    params = [v for v in pruned.list_vars() if is_persistable(v) and v.name in used]
+    save_vars(executor, dirname, vars=params,
+              filename=params_filename or "__params__.npz", scope=scope)
+    return target_names
+
+
+def load_inference_model(
+    dirname: str,
+    executor,
+    model_filename: Optional[str] = None,
+    params_filename: Optional[str] = None,
+    scope: Optional[Scope] = None,
+):
+    """Reference: io.py:load_inference_model →
+    (program, feed_target_names, fetch_targets)."""
+    model_filename = model_filename or _MODEL_FILE
+    with open(os.path.join(dirname, model_filename)) as f:
+        meta = json.load(f)
+    program = Program.from_dict(meta["program"])
+    scope = _scope_of(executor, scope)
+    path = _npz_path(dirname, params_filename or "__params__.npz")
+    if os.path.exists(path):
+        with np.load(path) as npz:
+            for key in npz.files:
+                scope.set_var(key.replace("%2F", "/"), npz[key])
+    fetch_targets = [program.global_block().var(n) for n in meta["fetch_names"]]
+    return program, list(meta["feed_names"]), fetch_targets
+
+
+# ---------------------------------------------------------------------------
+# training checkpoints
+# ---------------------------------------------------------------------------
+
+
+def save_checkpoint(
+    executor,
+    checkpoint_dir: str,
+    trainer_id: int = 0,
+    main_program: Optional[Program] = None,
+    max_num_checkpoints: int = 3,
+    step: int = 0,
+    epoch: int = 0,
+    scope: Optional[Scope] = None,
+):
+    """Reference: trainer.py:save_checkpoint — serial-numbered dirs with
+    retention; stores every persistable + meta (step/epoch/fingerprint)."""
+    program = main_program if main_program is not None else default_main_program()
+    serial = get_latest_checkpoint_serial(checkpoint_dir) + 1
+    cur = os.path.join(checkpoint_dir, _CKPT_PREFIX + "%d" % serial)
+    os.makedirs(cur, exist_ok=True)
+    save_persistables(executor, cur, main_program=program,
+                      filename="__persistables__.npz", scope=scope)
+    with open(os.path.join(cur, "meta.json"), "w") as f:
+        json.dump({
+            "step": step,
+            "epoch": epoch,
+            "trainer_id": trainer_id,
+            "fingerprint": program.fingerprint(),
+        }, f)
+    # retention
+    serials = _checkpoint_serials(checkpoint_dir)
+    for s in serials[:-max_num_checkpoints]:
+        shutil.rmtree(os.path.join(checkpoint_dir, _CKPT_PREFIX + "%d" % s),
+                      ignore_errors=True)
+    return serial
+
+
+def load_checkpoint(
+    executor,
+    checkpoint_dir: str,
+    serial: Optional[int] = None,
+    main_program: Optional[Program] = None,
+    scope: Optional[Scope] = None,
+) -> dict:
+    """Reference: trainer.py:load_checkpoint. Returns the meta dict
+    (step/epoch) so training loops can resume counters."""
+    program = main_program if main_program is not None else default_main_program()
+    if serial is None:
+        serial = get_latest_checkpoint_serial(checkpoint_dir)
+    if serial < 0:
+        raise RuntimeError("no checkpoint found under %s" % checkpoint_dir)
+    cur = os.path.join(checkpoint_dir, _CKPT_PREFIX + "%d" % serial)
+    load_persistables(executor, cur, main_program=program,
+                      filename="__persistables__.npz", scope=scope)
+    with open(os.path.join(cur, "meta.json")) as f:
+        meta = json.load(f)
+    if meta.get("fingerprint") not in (None, program.fingerprint()):
+        import warnings
+
+        warnings.warn(
+            "checkpoint was written by a different program version; "
+            "loading anyway (var-name matched)")
+    return meta
+
+
+def clean_checkpoint(checkpoint_dir: str, delete_dir: bool = False):
+    """Reference: trainer.py:clean_checkpoint."""
+    for s in _checkpoint_serials(checkpoint_dir):
+        shutil.rmtree(os.path.join(checkpoint_dir, _CKPT_PREFIX + "%d" % s),
+                      ignore_errors=True)
+    if delete_dir and os.path.isdir(checkpoint_dir) and not os.listdir(checkpoint_dir):
+        os.rmdir(checkpoint_dir)
+
+
+def _checkpoint_serials(checkpoint_dir: str) -> List[int]:
+    if not os.path.isdir(checkpoint_dir):
+        return []
+    out = []
+    for entry in os.listdir(checkpoint_dir):
+        m = re.fullmatch(_CKPT_PREFIX + r"(\d+)", entry)
+        if m:
+            out.append(int(m.group(1)))
+    return sorted(out)
+
+
+def get_latest_checkpoint_serial(checkpoint_dir: str) -> int:
+    """Reference: io.py/trainer.py:get_latest_checkpoint_serial (-1 when
+    none exist)."""
+    serials = _checkpoint_serials(checkpoint_dir)
+    return serials[-1] if serials else -1
+
+
+# ---------------------------------------------------------------------------
+# sharded (multi-host) checkpoints — orbax-backed
+# ---------------------------------------------------------------------------
+
+
+def save_sharded_checkpoint(
+    checkpoint_dir: str,
+    step: int,
+    main_program: Optional[Program] = None,
+    scope: Optional[Scope] = None,
+):
+    """Multi-host/sharded state: each host writes only its shards via orbax
+    — the dense-checkpoint twin of the reference's per-pserver save path
+    (distribute_transpiler)."""
+    import orbax.checkpoint as ocp
+
+    program = main_program if main_program is not None else default_main_program()
+    scope = scope if scope is not None else global_scope()
+    state = {}
+    for v in program.list_vars():
+        if is_persistable(v):
+            val = scope.find_var(v.name)
+            if val is not None:
+                state[v.name] = val
+    path = os.path.abspath(os.path.join(checkpoint_dir, "sharded_%d" % step))
+    ocp.PyTreeCheckpointer().save(path, state)
+    return path
+
+
+def load_sharded_checkpoint(
+    checkpoint_dir: str,
+    step: int,
+    main_program: Optional[Program] = None,
+    scope: Optional[Scope] = None,
+):
+    import orbax.checkpoint as ocp
+
+    scope = scope if scope is not None else global_scope()
+    path = os.path.abspath(os.path.join(checkpoint_dir, "sharded_%d" % step))
+    state = ocp.PyTreeCheckpointer().restore(path)
+    for name, val in state.items():
+        scope.set_var(name, val)
+    return sorted(state)

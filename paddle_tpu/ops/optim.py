@@ -42,8 +42,9 @@ def _adam(ctx):
     p, g = ctx.input("Param"), ctx.input("Grad")
     m, v = ctx.input("Moment1"), ctx.input("Moment2")
     lr = ctx.input("LearningRate").reshape(())
-    b1p = ctx.input("Beta1Pow").reshape(())
-    b2p = ctx.input("Beta2Pow").reshape(())
+    b1p_in, b2p_in = ctx.input("Beta1Pow"), ctx.input("Beta2Pow")
+    b1p = b1p_in.reshape(())
+    b2p = b2p_in.reshape(())
     b1 = ctx.attr("beta1", 0.9)
     b2 = ctx.attr("beta2", 0.999)
     eps = ctx.attr("epsilon", 1e-8)
@@ -56,8 +57,10 @@ def _adam(ctx):
         "ParamOut": p_new,
         "Moment1Out": m_new,
         "Moment2Out": v_new,
-        "Beta1PowOut": b1p * b1,
-        "Beta2PowOut": b2p * b2,
+        # state updates preserve the accumulator's shape (rank changes
+        # would break sharded-state out_shardings and donation aliasing)
+        "Beta1PowOut": (b1p * b1).reshape(b1p_in.shape),
+        "Beta2PowOut": (b2p * b2).reshape(b2p_in.shape),
     }
 
 
@@ -66,7 +69,8 @@ def _adamax(ctx):
     p, g = ctx.input("Param"), ctx.input("Grad")
     m, inf = ctx.input("Moment"), ctx.input("InfNorm")
     lr = ctx.input("LearningRate").reshape(())
-    b1p = ctx.input("Beta1Pow").reshape(())
+    b1p_in = ctx.input("Beta1Pow")
+    b1p = b1p_in.reshape(())
     b1 = ctx.attr("beta1", 0.9)
     b2 = ctx.attr("beta2", 0.999)
     eps = ctx.attr("epsilon", 1e-8)
@@ -78,7 +82,7 @@ def _adamax(ctx):
         "ParamOut": p_new,
         "MomentOut": m_new,
         "InfNormOut": inf_new,
-        "Beta1PowOut": b1p * b1,
+        "Beta1PowOut": (b1p * b1).reshape(b1p_in.shape),
     }
 
 

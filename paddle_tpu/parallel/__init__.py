@@ -1,0 +1,40 @@
+"""Parallel / distributed execution over TPU meshes.
+
+Reference counterparts: python/paddle/fluid/parallel_executor.py (multi-GPU
+SSA graphs + NCCL), paddle/fluid/framework/details/* (all-reduce/broadcast
+op handles), transpiler/distribute_transpiler.py (pserver graphs). Here the
+whole area collapses onto jax.sharding: a Mesh names the device topology, a
+ShardingPlan assigns PartitionSpecs, pjit/GSPMD inserts the collectives.
+"""
+from .mesh import (  # noqa: F401
+    default_mesh,
+    device_count,
+    get_places,
+    init_distributed,
+    make_mesh,
+)
+from .collective import (  # noqa: F401
+    all_gather,
+    all_reduce,
+    all_to_all,
+    axis_index,
+    axis_size,
+    broadcast,
+    ppermute,
+    reduce_scatter,
+)
+from .sharding import (  # noqa: F401
+    PartitionSpec,
+    ShardingPlan,
+    megatron_transformer_plan,
+)
+from .parallel_executor import (  # noqa: F401
+    BuildStrategy,
+    ExecutionStrategy,
+    ParallelExecutor,
+)
+from .ring_attention import (  # noqa: F401
+    full_attention,
+    ring_attention,
+    ring_self_attention,
+)

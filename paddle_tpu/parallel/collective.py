@@ -1,0 +1,95 @@
+"""Collective communication primitives.
+
+The reference implements collectives as graph ops backed by NCCL
+(reference: paddle/fluid/framework/details/nccl_all_reduce_op_handle.cc,
+broadcast_op_handle.cc, reduce_op_handle.cc). TPU-native, collectives are
+``jax.lax`` primitives that XLA schedules onto ICI links; they are used
+inside ``shard_map``/``pjit`` bodies where a mesh axis name is in scope.
+
+These wrappers exist for API parity and readability — under ``pjit`` with
+sharding annotations XLA usually inserts them automatically; explicit use
+is for shard_map kernels (ring attention, custom reductions).
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import jax
+from jax import lax
+
+__all__ = [
+    "all_reduce",
+    "all_gather",
+    "reduce_scatter",
+    "broadcast",
+    "ppermute",
+    "all_to_all",
+    "axis_index",
+    "axis_size",
+]
+
+AxisName = Union[str, Tuple[str, ...]]
+
+
+def all_reduce(x, axis_name: AxisName = "dp", op: str = "sum"):
+    """NCCL allreduce equivalent (reference:
+    details/nccl_all_reduce_op_handle.cc). op in sum/mean/max/min/prod."""
+    if op == "sum":
+        return lax.psum(x, axis_name)
+    if op == "mean":
+        return lax.pmean(x, axis_name)
+    if op == "max":
+        return lax.pmax(x, axis_name)
+    if op == "min":
+        return lax.pmin(x, axis_name)
+    if op == "prod":
+        # no pprod primitive: log-domain trick is lossy, use all_gather+reduce
+        import jax.numpy as jnp
+
+        return jnp.prod(lax.all_gather(x, axis_name, axis=0), axis=0)
+    raise ValueError("unknown reduce op %r" % op)
+
+
+def all_gather(x, axis_name: AxisName = "dp", axis: int = 0, tiled: bool = True):
+    """Gather shards along ``axis``; tiled=True concatenates (the NCCL
+    allgather layout), tiled=False stacks a new leading device axis."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name: AxisName = "dp", axis: int = 0, op: str = "sum"):
+    if op not in ("sum", "mean"):
+        raise ValueError("reduce_scatter supports sum/mean, got %r" % op)
+    out = lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+    if op == "mean":
+        out = out / lax.psum(1.0, axis_name)
+    return out
+
+
+def broadcast(x, axis_name: AxisName = "dp", root: int = 0):
+    """Every device gets root's value (reference:
+    details/broadcast_op_handle.cc). Implemented as a masked psum — one
+    XLA all-reduce on ICI."""
+    import jax.numpy as jnp
+
+    idx = lax.axis_index(axis_name)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis_name)
+
+
+def ppermute(x, axis_name: AxisName, perm: Sequence[Tuple[int, int]]):
+    """Point-to-point ring permutation: perm is [(src, dst), ...]."""
+    return lax.ppermute(x, axis_name, perm=list(perm))
+
+
+def all_to_all(x, axis_name: AxisName, split_axis: int, concat_axis: int):
+    """The sequence/expert-parallel workhorse: transposes a device axis with
+    a tensor axis (e.g. heads<->sequence for long-context attention)."""
+    return lax.all_to_all(x, axis_name, split_axis, concat_axis, tiled=True)
+
+
+def axis_index(axis_name: AxisName):
+    return lax.axis_index(axis_name)
+
+
+def axis_size(axis_name: AxisName):
+    return lax.psum(1, axis_name)

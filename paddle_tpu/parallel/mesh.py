@@ -1,0 +1,94 @@
+"""Device mesh management.
+
+The reference enumerates CUDA devices and builds one SSA sub-graph per GPU
+(reference: python/paddle/fluid/parallel_executor.py:__init__ collects
+CUDAPlace list; paddle/fluid/framework/details/*). TPU-native, a
+``jax.sharding.Mesh`` is the device topology: named axes (dp/mp/pp/sp/ep)
+over which shardings are declared; XLA's SPMD partitioner inserts the
+collectives (over ICI within a slice, DCN across hosts).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+__all__ = [
+    "make_mesh",
+    "default_mesh",
+    "device_count",
+    "get_places",
+    "init_distributed",
+]
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def make_mesh(
+    shape: Optional[Sequence[int]] = None,
+    axis_names: Sequence[str] = ("dp",),
+    devices=None,
+) -> Mesh:
+    """Build a Mesh over (a prefix of) the available devices.
+
+    ``shape=None`` puts every device on the first axis. Multi-host meshes
+    should lay the DCN-crossing axis outermost (JAX enumerates devices
+    host-major, so axis 0 naturally maps across hosts).
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if shape is None:
+        shape = [len(devices)] + [1] * (len(axis_names) - 1)
+    shape = tuple(int(s) for s in shape)
+    n = int(np.prod(shape))
+    if n > len(devices):
+        raise ValueError(
+            "mesh shape %s needs %d devices, only %d available"
+            % (shape, n, len(devices))
+        )
+    arr = np.array(devices[:n]).reshape(shape)
+    return Mesh(arr, tuple(axis_names))
+
+
+def default_mesh(axis_name: str = "dp") -> Mesh:
+    """1-D mesh over all devices (the ParallelExecutor default)."""
+    return make_mesh(axis_names=(axis_name,))
+
+
+def get_places(device_count_: Optional[int] = None):
+    """Parity with fluid.layers.device.get_places (reference:
+    python/paddle/fluid/layers/device.py): enumerate execution places.
+    Returns TPUPlace list on accelerator backends, CPUPlace otherwise."""
+    from ..framework.scope import CPUPlace, TPUPlace
+
+    devs = jax.devices()
+    n = len(devs) if device_count_ is None else min(device_count_, len(devs))
+    cls = CPUPlace if devs[0].platform == "cpu" else TPUPlace
+    return [cls(i) for i in range(n)]
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+):
+    """Multi-host runtime initialization.
+
+    Plays the role of the reference's NCCL bootstrap (ParallelExecutor's
+    num_trainers/trainer_id → ncclCommInitRank). On TPU pods the arguments
+    are auto-detected from the environment; on CPU/GPU clusters pass them
+    explicitly. After this, ``jax.devices()`` spans the whole job and
+    meshes built from it are global.
+    """
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)

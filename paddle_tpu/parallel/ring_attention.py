@@ -1,0 +1,119 @@
+"""Ring attention: exact attention over sequence shards with O(T/N) memory
+per chip and compute/communication overlap on the ICI ring.
+
+No reference twin — codeWorm2015/Paddle (2018) predates long-context
+attention; this is the TPU-native capability the survey lists as
+first-class (SURVEY.md §2 parallel). The design follows the blockwise
+online-softmax formulation: K/V blocks rotate around the mesh axis with
+``lax.ppermute`` while each device keeps its Q shard resident and folds
+each visiting block into (m, num, den) running statistics, so the full
+(T, T) score matrix never materializes.
+
+Used three ways:
+- `ring_attention(...)` — inside an existing shard_map body (axis in scope)
+- `ring_self_attention(...)` — standalone: shard_maps itself over a mesh
+- the `ring_attention` IR op (ops/nn.py) — inside a Program; falls back to
+  exact full attention when the step is not compiled over a sequence axis.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax>=0.6 top level; older: experimental
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+__all__ = ["ring_attention", "ring_self_attention", "full_attention"]
+
+_NEG = -1e30
+
+
+def full_attention(q, k, v, causal: bool = False, scale: Optional[float] = None):
+    """Exact single-device attention, the numeric reference for the ring.
+    q,k,v: (B, H, T, Dh)."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        Tq, Tk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((Tq, Tk), bool), k=Tk - Tq)
+        logits = jnp.where(mask, logits, _NEG)
+    weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights.astype(v.dtype), v)
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False,
+                   scale: Optional[float] = None):
+    """Blockwise-exact attention inside a shard_map body.
+
+    q, k, v: (B, H, T_local, Dh) — the local sequence shard; the global
+    sequence is the concatenation over `axis_name` in axis-index order.
+    Accumulates in fp32 regardless of input dtype (bf16-safe).
+    """
+    size = lax.psum(1, axis_name)
+    my_blk = lax.axis_index(axis_name)
+    B, H, T, Dh = q.shape
+    if scale is None:
+        scale = Dh ** -0.5
+    qf = q.astype(jnp.float32) * scale
+
+    # kv rotates "forward" (device i -> i+1), so at step s device i holds
+    # the block originally resident on (i - s) mod size.
+    fwd = [(i, (i + 1) % size) for i in range(size)]
+    q_pos = my_blk * T + jnp.arange(T)  # global query positions
+
+    def body(s, carry):
+        kc, vc, m, num, den = carry
+        kv_blk = (my_blk - s) % size
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qf, kc.astype(jnp.float32))
+        if causal:
+            k_pos = kv_blk * T + jnp.arange(T)
+            keep = q_pos[:, None] >= k_pos[None, :]  # (T, T)
+            scores = jnp.where(keep[None, None], scores, _NEG)
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        # rows where everything so far is masked keep m=_NEG; exp(score-m)
+        # would be exp(0)=1 there, so zero masked terms explicitly.
+        p = jnp.exp(scores - m_new[..., None])
+        if causal:
+            p = jnp.where(scores <= _NEG / 2, 0.0, p)
+        corr = jnp.exp(m - m_new)
+        num = num * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vc.astype(jnp.float32))
+        den = den * corr + p.sum(axis=-1)
+        kc = lax.ppermute(kc, axis_name, perm=fwd)
+        vc = lax.ppermute(vc, axis_name, perm=fwd)
+        return kc, vc, m_new, num, den
+
+    init = (
+        k, v,
+        jnp.full((B, H, T), _NEG, jnp.float32),
+        jnp.zeros((B, H, T, Dh), jnp.float32),
+        jnp.zeros((B, H, T), jnp.float32),
+    )
+    # unrolled python loop (size is static): lets XLA overlap each step's
+    # einsums with the next ppermute's ICI transfer.
+    kc, vc, m, num, den = init
+    for s in range(int(size)):
+        kc, vc, m, num, den = body(s, (kc, vc, m, num, den))
+    out = num / jnp.maximum(den[..., None], 1e-30)
+    return out.astype(q.dtype)
+
+
+def ring_self_attention(q, k, v, mesh: Mesh, sp_axis: str = "sp",
+                        causal: bool = False, scale: Optional[float] = None):
+    """Standalone entry: q,k,v are global (B, H, T, Dh) arrays; the sequence
+    dim is sharded over mesh axis `sp_axis` and attention is exact."""
+    spec = P(None, None, sp_axis, None)
+    fn = shard_map(
+        functools.partial(ring_attention, axis_name=sp_axis, causal=causal,
+                          scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )
+    return fn(q, k, v)

@@ -1,0 +1,162 @@
+"""Sharding plans: how a Program's state and feeds map onto a Mesh.
+
+The reference distributes work by rewriting the graph — DistributeTranspiler
+splits params into pserver blocks, ParallelExecutor builds per-device SSA
+graphs with NCCL ops (reference: python/paddle/fluid/transpiler/
+distribute_transpiler.py, paddle/fluid/framework/details/
+multi_devices_graph_builder.cc). TPU-native, NOTHING in the program changes:
+a ShardingPlan assigns a ``PartitionSpec`` to each variable name and XLA's
+SPMD partitioner (GSPMD) materializes the distributed program, inserting
+all-reduce/all-gather/reduce-scatter on ICI as the specs require.
+
+Conventions:
+- mesh axes: "dp" data, "mp" tensor (model) parallel, "sp" sequence,
+  "pp" pipeline stage, "ep" expert.
+- optimizer accumulators are named "<param>_<kind>_acc" and have the
+  param's shape, so the longest-prefix rule gives them the param's spec.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, Optional, Sequence
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["ShardingPlan", "PartitionSpec", "megatron_transformer_plan",
+           "zero_plan"]
+
+PartitionSpec = P
+
+
+class ShardingPlan:
+    """name/pattern -> PartitionSpec mapping with sensible fallbacks.
+
+    Resolution order for a variable name:
+    1. exact entry
+    2. regex entries (first match, insertion order)
+    3. longest registered prefix (covers "<param>_moment_acc" etc.)
+    4. ``default`` (replicated unless overridden)
+    """
+
+    def __init__(self, mesh: Mesh, default: P = P(), batch_axes: Sequence[str] = ("dp",)):
+        self.mesh = mesh
+        self.default = default
+        # feed arrays get their leading (batch) dim split over these axes
+        self.batch_axes = tuple(a for a in batch_axes if a in mesh.axis_names)
+        self._exact: Dict[str, P] = {}
+        self._regex: list = []
+
+    # -- construction ----------------------------------------------------
+    def set(self, name: str, spec: P) -> "ShardingPlan":
+        self._exact[name] = spec
+        return self
+
+    def set_regex(self, pattern: str, spec: P) -> "ShardingPlan":
+        self._regex.append((re.compile(pattern), spec))
+        return self
+
+    # -- resolution ------------------------------------------------------
+    def spec(self, name: str, ndim: Optional[int] = None,
+             shape: Optional[Sequence[int]] = None) -> P:
+        s = self._lookup(name)
+        if shape is not None:
+            ndim = len(shape)
+        if ndim is not None and len(s) > ndim:
+            # e.g. scalar lr decayed from a matrix param's prefix
+            s = P(*s[:ndim]) if ndim else P()
+        if shape is not None and len(s):
+            # drop axes the actual dims can't be split over (e.g. the (1,)
+            # beta-pow accumulators that prefix-inherit a matrix spec)
+            import numpy as np
+
+            fixed = []
+            for i, ax in enumerate(s):
+                if ax is None:
+                    fixed.append(None)
+                    continue
+                axes = ax if isinstance(ax, tuple) else (ax,)
+                ways = int(np.prod([self.mesh.shape[a] for a in axes]))
+                fixed.append(ax if shape[i] % ways == 0 else None)
+            s = P(*fixed)
+        return s
+
+    def _lookup(self, name: str) -> P:
+        if name in self._exact:
+            return self._exact[name]
+        for rx, spec in self._regex:
+            if rx.search(name):
+                return spec
+        best, best_len = None, -1
+        for key, spec in self._exact.items():
+            if name.startswith(key) and len(key) > best_len:
+                best, best_len = spec, len(key)
+        if best is not None:
+            return best
+        return self.default
+
+    def sharding(self, name: str, ndim: Optional[int] = None,
+                 shape: Optional[Sequence[int]] = None) -> NamedSharding:
+        return NamedSharding(self.mesh, self.spec(name, ndim, shape))
+
+    def feed_sharding(self, ndim: int) -> NamedSharding:
+        """Feeds: batch dim split over the data axes, rest replicated."""
+        if not self.batch_axes or ndim == 0:
+            return NamedSharding(self.mesh, P())
+        axes = self.batch_axes[0] if len(self.batch_axes) == 1 else self.batch_axes
+        return NamedSharding(self.mesh, P(axes, *([None] * (ndim - 1))))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+
+def zero_plan(mesh: Mesh, program, axis: str = "dp") -> ShardingPlan:
+    """ZeRO-1-style plan: optimizer accumulators sharded over the data
+    axis, params replicated. The TPU-native reading of the reference's
+    BuildStrategy.ReduceStrategy.Reduce (each device owns one slice of the
+    update) and of DistributeTranspiler's pserver param blocks: GSPMD
+    lowers grad-allreduce + sharded update into reduce-scatter/all-gather.
+    """
+    from ..framework.core import Parameter
+
+    plan = ShardingPlan(mesh, batch_axes=(axis,))
+    n = mesh.shape[axis]
+    for var in program.global_block().vars.values():
+        if not isinstance(var, Parameter) or not var.trainable:
+            continue
+        if not var.shape or var.shape[0] % n != 0:
+            continue
+        spec = P(*([axis] + [None] * (len(var.shape) - 1)))
+        # "<param>_<kind>_acc" inherits via the prefix rule; the param
+        # itself is pinned replicated by the exact entry.
+        plan.set(var.name + "_", spec)
+        plan.set(var.name, P())
+    return plan
+
+
+def megatron_transformer_plan(
+    mesh: Mesh,
+    mp_axis: str = "mp",
+    batch_axes: Sequence[str] = ("dp",),
+) -> ShardingPlan:
+    """Tensor-parallel plan for our transformer naming convention
+    (models/transformer.py): q/k/v/fc1 weights column-parallel, out/fc2
+    row-parallel, embeddings hidden-sharded. With these param specs GSPMD
+    propagates head-sharded activations through attention and inserts one
+    all-reduce after each row-parallel matmul — the Megatron-LM comm
+    pattern, derived by the compiler instead of hand-written NCCL calls.
+    """
+    plan = ShardingPlan(mesh, batch_axes=batch_axes)
+    col_w = P(None, mp_axis)  # (in, out) split on out
+    row_w = P(mp_axis, None)  # (in, out) split on in
+    col_b = P(mp_axis)
+    for pat, spec in [
+        (r"\.(q|k|v|fc1)\.w", col_w),
+        (r"\.(q|k|v|fc1)\.b", col_b),
+        (r"\.(out|fc2)\.w", row_w),
+        (r"\.(out|fc2)\.b", P()),
+        (r"(tok|pos)_emb", P(None, mp_axis)),
+        (r"\.head\.w", col_w),  # vocab-parallel output projection
+        (r"\.head\.b", col_b),
+    ]:
+        plan.set_regex(pat, spec)
+    return plan

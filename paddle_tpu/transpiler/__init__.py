@@ -1,0 +1,21 @@
+"""Program transpilers (reference: python/paddle/fluid/transpiler/).
+
+- DistributeTranspiler: the reference rewrites the graph into trainer +
+  pserver programs with gRPC send/recv ops. TPU-native it emits sharding
+  plans (pserver param shards -> ZeRO-style sharded optimizer state).
+- memory_optimize / release_memory: the reference does liveness-based
+  var reuse; XLA owns buffer assignment here, so this exposes the
+  rematerialization policy knob instead (see memory_optimizer.py).
+- InferenceTranspiler: inference-time graph rewrites (BN fold).
+"""
+from .distribute_transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
+from .memory_optimizer import memory_optimize, release_memory  # noqa: F401
+from .inference_transpiler import InferenceTranspiler  # noqa: F401
+
+__all__ = [
+    "DistributeTranspiler",
+    "DistributeTranspilerConfig",
+    "memory_optimize",
+    "release_memory",
+    "InferenceTranspiler",
+]

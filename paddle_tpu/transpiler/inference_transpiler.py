@@ -1,0 +1,114 @@
+"""InferenceTranspiler: inference-time graph rewrites.
+
+Reference: python/paddle/fluid/transpiler/inference_transpiler.py — folds
+batch_norm into the preceding conv2d (adjusting the conv filter/bias in the
+Scope) and drops the bn op, plus relu/bn reordering for MKLDNN.
+
+On TPU the XLA fuser already fuses the bn arithmetic into the conv epilogue
+at runtime, so the fold is a compile-time simplification rather than a
+perf necessity — but it still shrinks the program and removes 4 state
+tensors per conv, and keeps parity with reference deployment flows.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..framework.core import Program
+from ..framework.scope import Scope, global_scope
+
+__all__ = ["InferenceTranspiler"]
+
+
+class InferenceTranspiler:
+    def transpile(self, program: Program, place=None, scope: Optional[Scope] = None):
+        """Fold conv2d+batch_norm pairs in-place (program AND scope params).
+
+        Only folds when the conv output feeds exactly the bn and nothing
+        else, mirroring the reference's adjacency check.
+        """
+        scope = scope if scope is not None else global_scope()
+        block = program.global_block()
+
+        # count readers of every var so we only fold single-consumer convs
+        readers = {}
+        for op in block.ops:
+            for name in op.input_arg_names:
+                readers[name] = readers.get(name, 0) + 1
+
+        def _bn_constants(bn):
+            scale = np.asarray(scope.find_var(bn.input("Scale")[0]))
+            beta = np.asarray(scope.find_var(bn.input("Bias")[0]))
+            mean = np.asarray(scope.find_var(bn.input("Mean")[0]))
+            var = np.asarray(scope.find_var(bn.input("Variance")[0]))
+            k = scale / np.sqrt(var + bn.attr("epsilon", 1e-5))
+            return k, beta, mean
+
+        i = 0
+        while i < len(block.ops):
+            conv = block.ops[i]
+            if conv.type != "conv2d":
+                i += 1
+                continue
+            conv_out = conv.output("Output")[0]
+            w_name = conv.input("Filter")[0]
+
+            # pattern A: conv2d -> batch_norm
+            # pattern B: conv2d -> elementwise_add(bias) -> batch_norm
+            #            (layers.conv2d with bias_attr emits the add)
+            nxt = block.ops[i + 1] if i + 1 < len(block.ops) else None
+            nxt2 = block.ops[i + 2] if i + 2 < len(block.ops) else None
+            if (
+                nxt is not None
+                and nxt.type == "batch_norm"
+                and nxt.input("X") == [conv_out]
+                and readers.get(conv_out, 0) == 1
+            ):
+                bn, bn_idx, bias_name = nxt, i + 1, None
+            elif (
+                nxt is not None
+                and nxt2 is not None
+                and nxt.type == "elementwise_add"
+                and nxt.input("X") == [conv_out]
+                and nxt2.type == "batch_norm"
+                and nxt2.input("X") == nxt.output("Out")
+                and readers.get(conv_out, 0) == 1
+                and readers.get(nxt.output("Out")[0], 0) == 1
+            ):
+                bn, bn_idx, bias_name = nxt2, i + 2, nxt.input("Y")[0]
+            else:
+                i += 1
+                continue
+
+            k, beta, mean = _bn_constants(bn)
+            w = np.asarray(scope.find_var(w_name))
+            scope.set_var(w_name, (w * k[:, None, None, None]).astype(w.dtype))
+            bn_out = bn.output("Y")[0]
+
+            if bias_name is not None:
+                # fold into the existing bias: y = (conv + b - mean)*k + beta
+                b = np.asarray(scope.find_var(bias_name))
+                scope.set_var(
+                    bias_name, ((b - mean) * k + beta).astype(b.dtype))
+                add = block.ops[bn_idx - 1]
+                add.outputs["Out"] = [bn_out]
+                block.ops.pop(bn_idx)
+            else:
+                # biasless conv: add a folded-bias elementwise_add in the
+                # bn's place
+                bias_name = w_name + ".bnfold_bias"
+                block.create_var(name=bias_name, shape=(len(k),),
+                                 dtype="float32", persistable=True)
+                scope.set_var(bias_name, (beta - mean * k).astype(np.float32))
+                block.ops.pop(bn_idx)
+                block.insert_op(
+                    bn_idx,
+                    type="elementwise_add",
+                    inputs={"X": conv_out, "Y": bias_name},
+                    outputs={"Out": bn_out},
+                    attrs={"axis": 1},
+                )
+            program._bump()
+            i = bn_idx + 1
+        return program

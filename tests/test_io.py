@@ -1,0 +1,134 @@
+"""Persistence round-trips: params, inference model, checkpoints."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _build_and_train(exe, rng, steps=3):
+    x = layers.data(name="x", shape=[8])
+    y = layers.data(name="y", shape=[1])
+    pred = layers.fc(input=x, size=1)
+    loss = layers.mean(layers.square_error_cost(input=pred, label=y))
+    fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    exe.run(fluid.default_startup_program())
+    xs = rng.randn(16, 8).astype(np.float32)
+    ys = (xs @ rng.randn(8, 1)).astype(np.float32)
+    for _ in range(steps):
+        exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])
+    return pred, loss, xs, ys
+
+
+def test_save_load_params_roundtrip(tmp_path, rng):
+    exe = fluid.Executor()
+    pred, loss, xs, ys = _build_and_train(exe, rng)
+    # pruned forward-only program: running the main program would also run
+    # the optimizer and mutate the params we're comparing
+    infer = fluid.io.get_inference_program([pred])
+    (before,) = exe.run(infer, feed={"x": xs}, fetch_list=[pred])
+
+    fluid.io.save_params(exe, str(tmp_path / "params"))
+
+    # clobber params, then restore
+    scope = fluid.global_scope()
+    for p in fluid.default_main_program().all_parameters():
+        scope.set_var(p.name, np.zeros(p.shape, np.float32))
+    fluid.io.load_params(exe, str(tmp_path / "params"))
+    (after,) = exe.run(infer, feed={"x": xs}, fetch_list=[pred])
+    np.testing.assert_allclose(after, before, rtol=1e-6)
+
+
+def test_save_load_params_combined_file(tmp_path, rng):
+    exe = fluid.Executor()
+    _build_and_train(exe, rng)
+    names = fluid.io.save_params(exe, str(tmp_path), filename="all.npz")
+    assert names
+    fluid.io.load_params(exe, str(tmp_path), filename="all.npz")
+    # extensionless filename (common in reference scripts): np.savez
+    # appends .npz on save; load must find it anyway
+    fluid.io.save_params(exe, str(tmp_path), filename="__params__")
+    fluid.io.load_params(exe, str(tmp_path), filename="__params__")
+
+
+def test_get_parameter_value_raises_on_missing(rng):
+    exe = fluid.Executor()
+    _build_and_train(exe, rng)
+    p = fluid.default_main_program().all_parameters()[0]
+    val = fluid.io.get_parameter_value(p, exe)
+    assert val.shape == tuple(p.shape)
+    with pytest.raises(RuntimeError):
+        fluid.io.get_parameter_value_by_name("no_such_var", exe)
+
+
+def test_inference_model_roundtrip(tmp_path, rng):
+    exe = fluid.Executor()
+    pred, loss, xs, ys = _build_and_train(exe, rng)
+    infer = fluid.io.get_inference_program([pred])
+    (before,) = exe.run(infer, feed={"x": xs}, fetch_list=[pred])
+
+    fluid.io.save_inference_model(
+        str(tmp_path / "model"), ["x"], [pred], exe)
+
+    # load into a fresh scope: inference must not need y or optimizer state
+    with fluid.scope_guard(fluid.Scope()):
+        exe2 = fluid.Executor()
+        program, feed_names, fetch_targets = fluid.io.load_inference_model(
+            str(tmp_path / "model"), exe2)
+        assert feed_names == ["x"]
+        # pruned program has no optimizer/backward ops
+        types = [op.type for op in program.global_block().ops]
+        assert "adam" not in types and "autodiff" not in types
+        (out,) = exe2.run(program, feed={"x": xs}, fetch_list=fetch_targets)
+    np.testing.assert_allclose(out, before, rtol=1e-6)
+
+
+def test_checkpoint_resume_and_retention(tmp_path, rng):
+    exe = fluid.Executor()
+    pred, loss, xs, ys = _build_and_train(exe, rng)
+    ckdir = str(tmp_path / "ck")
+
+    for step in range(5):
+        serial = fluid.io.save_checkpoint(
+            exe, ckdir, step=step, max_num_checkpoints=2)
+    assert serial == 4
+    # retention keeps only the last 2
+    assert fluid.io.get_latest_checkpoint_serial(ckdir) == 4
+    kept = sorted(os.listdir(ckdir))
+    assert kept == ["checkpoint_3", "checkpoint_4"]
+
+    # resume restores params AND optimizer accumulators: snapshot the
+    # checkpointed state, perturb everything, then load and compare
+    scope = fluid.global_scope()
+    state_names = [v.name for v in fluid.default_main_program().list_vars()
+                   if v.persistable and scope.find_var(v.name) is not None]
+    saved = {n: np.asarray(scope.find_var(n)) for n in state_names}
+    assert any("_acc" in n for n in state_names)  # optimizer state included
+    for n in state_names:
+        scope.set_var(n, np.full_like(saved[n], 7.0))
+    meta = fluid.io.load_checkpoint(exe, ckdir)
+    assert meta["step"] == 4
+    for n in state_names:
+        np.testing.assert_allclose(np.asarray(scope.find_var(n)), saved[n])
+
+    fluid.io.clean_checkpoint(ckdir, delete_dir=True)
+    assert not os.path.exists(ckdir)
+
+
+def test_sharded_checkpoint_orbax(tmp_path, rng):
+    pytest.importorskip("orbax.checkpoint")
+    exe = fluid.Executor()
+    pred, loss, xs, ys = _build_and_train(exe, rng)
+    scope = fluid.global_scope()
+    path = fluid.io.save_sharded_checkpoint(str(tmp_path / "oc"), step=1)
+    assert os.path.exists(path)
+    params = fluid.default_main_program().all_parameters()
+    before = {p.name: np.asarray(scope.find_var(p.name)) for p in params}
+    for p in params:
+        scope.set_var(p.name, np.zeros(p.shape, np.float32))
+    fluid.io.load_sharded_checkpoint(str(tmp_path / "oc"), step=1)
+    for p in params:
+        np.testing.assert_allclose(np.asarray(scope.find_var(p.name)),
+                                   before[p.name])

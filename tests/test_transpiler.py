@@ -1,0 +1,80 @@
+"""Transpiler tests: DistributeTranspiler plans, memory_optimize remat,
+InferenceTranspiler bn-fold."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.parallel import make_mesh
+from paddle_tpu.parallel.sharding import PartitionSpec as P
+
+
+def _build_mlp_with_opt():
+    x = layers.data(name="x", shape=[16])
+    y = layers.data(name="y", shape=[1], dtype="int64")
+    h = layers.fc(input=x, size=64, act="relu")
+    logits = layers.fc(input=h, size=2)
+    loss = layers.mean(layers.softmax_with_cross_entropy(logits, y))
+    fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    return loss
+
+
+def test_distribute_transpiler_plan():
+    loss = _build_mlp_with_opt()
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, pservers="ps0:6170,ps1:6170", trainers=2)
+    prog = t.get_trainer_program()
+    assert prog is fluid.default_main_program()
+
+    shard0, _startup = t.get_pserver_programs("ps0:6170")
+    shard1 = t.get_pserver_program("ps1:6170")
+    all_params = {p.name for p in prog.all_parameters() if p.trainable}
+    assert set(shard0.param_names) | set(shard1.param_names) == all_params
+    assert not (set(shard0.param_names) & set(shard1.param_names))
+
+    mesh = make_mesh([8], ("dp",))
+    plan = t.sharding_plan(mesh)
+    # fc weight (16, 64): dim0 16 divisible by 8 -> accumulators sharded
+    wname = next(n for n in all_params if "w" in n)
+    assert plan.spec(wname) == P()  # param replicated
+    assert plan.spec(wname + "_moment1_acc") == P("dp", None)
+
+
+def test_memory_optimize_still_trains(rng):
+    loss = _build_mlp_with_opt()
+    fluid.memory_optimize(fluid.default_main_program())
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    xs = rng.randn(8, 16).astype(np.float32)
+    ys = (rng.rand(8, 1) > 0.5).astype(np.int64)
+    losses = [
+        exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])[0] for _ in range(10)
+    ]
+    assert losses[-1] < losses[0]
+
+
+def test_inference_transpiler_bn_fold(rng):
+    x = layers.data(name="x", shape=[3, 8, 8])
+    c = layers.conv2d(input=x, num_filters=4, filter_size=3, padding=1)
+    b = layers.batch_norm(input=c)
+    out = layers.reduce_mean(b)
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    main = fluid.default_main_program()
+
+    # give the bn non-trivial stats so the fold actually matters
+    scope = fluid.global_scope()
+    for op in main.global_block().ops:
+        if op.type == "batch_norm":
+            scope.set_var(op.input("Mean")[0], rng.randn(4).astype(np.float32))
+            scope.set_var(op.input("Variance")[0],
+                          rng.rand(4).astype(np.float32) + 0.5)
+
+    infer = main.clone(for_test=True)
+    xs = rng.randn(2, 3, 8, 8).astype(np.float32)
+    (before,) = exe.run(infer, feed={"x": xs}, fetch_list=[out])
+
+    fluid.InferenceTranspiler().transpile(infer, scope=scope)
+    assert not any(op.type == "batch_norm" for op in infer.global_block().ops)
+    (after,) = exe.run(infer, feed={"x": xs}, fetch_list=[out])
+    np.testing.assert_allclose(after, before, rtol=1e-4, atol=1e-5)
