@@ -62,6 +62,10 @@ from . import clip  # noqa: F401
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
 from .layer_helper import LayerHelper  # noqa: F401
 from . import io  # noqa: F401
+from . import reader  # noqa: F401
+from .reader import batch  # noqa: F401  (reference: paddle.batch)
+from .data_feeder import DataFeeder  # noqa: F401
+from . import dataset  # noqa: F401
 from . import parallel  # noqa: F401
 from .parallel import ParallelExecutor, ExecutionStrategy, BuildStrategy  # noqa: F401
 from . import transpiler  # noqa: F401

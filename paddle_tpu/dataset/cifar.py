@@ -1,0 +1,46 @@
+"""CIFAR-10/100 (reference: python/paddle/dataset/cifar.py).
+
+Samples: (image float32[3072] in [0, 1], label int). Synthetic source:
+per-class color/texture template + noise (see common.py rationale).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import rng_for, synthetic_size
+
+__all__ = ["train10", "test10", "train100", "test100"]
+
+
+def _synthetic_reader(n_classes: int, split: str, n: int):
+    tmpl_rng = rng_for("cifar%d" % n_classes, "templates")
+    templates = tmpl_rng.rand(n_classes, 3, 32, 32).astype(np.float32)
+    for _ in range(2):
+        templates = (templates + np.roll(templates, 1, 2)
+                     + np.roll(templates, 1, 3)) / 3.0
+
+    def reader():
+        rng = rng_for("cifar%d" % n_classes, split)
+        for _ in range(n):
+            label = int(rng.randint(n_classes))
+            img = templates[label] + rng.randn(3, 32, 32).astype(np.float32) * 0.15
+            yield np.clip(img, 0.0, 1.0).reshape(3072), label
+
+    return reader
+
+
+def train10():
+    """Reference: cifar.py:train10."""
+    return _synthetic_reader(10, "train", synthetic_size("cifar_train", 4096))
+
+
+def test10():
+    return _synthetic_reader(10, "test", synthetic_size("cifar_test", 512))
+
+
+def train100():
+    return _synthetic_reader(100, "train", synthetic_size("cifar_train", 4096))
+
+
+def test100():
+    return _synthetic_reader(100, "test", synthetic_size("cifar_test", 512))

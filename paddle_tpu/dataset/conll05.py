@@ -1,0 +1,60 @@
+"""CoNLL-2005 SRL schema (reference: python/paddle/dataset/conll05.py).
+
+Samples: 9 slots — (word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2,
+verb_ids, mark, label_ids) as consumed by the label_semantic_roles book
+example. Synthetic source ties labels to (word, mark) structure so the
+CRF/SRL pipeline trains.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import rng_for, synthetic_size
+
+__all__ = ["get_dict", "test", "get_embedding"]
+
+_WORD_VOCAB = 4000
+_VERB_VOCAB = 300
+_N_LABELS = 59  # reference label dict size (B-/I-/O tags)
+
+
+def get_dict():
+    """Reference: conll05.py:get_dict -> (word_dict, verb_dict, label_dict)."""
+    word_dict = {"w%04d" % i: i for i in range(_WORD_VOCAB)}
+    verb_dict = {"v%03d" % i: i for i in range(_VERB_VOCAB)}
+    label_dict = {"L%02d" % i: i for i in range(_N_LABELS)}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    """Reference parity: pretrained word embedding matrix."""
+    rng = rng_for("conll05", "emb")
+    return rng.randn(_WORD_VOCAB, 32).astype(np.float32)
+
+
+def test():
+    """Reference: conll05.py:test (the reference only ships test data)."""
+    n = synthetic_size("conll05_test", 400)
+
+    def reader():
+        rng = rng_for("conll05", "test")
+        for _ in range(n):
+            length = int(rng.randint(5, 40))
+            words = rng.randint(0, _WORD_VOCAB, size=length)
+            verb_pos = int(rng.randint(length))
+            verb = int(rng.randint(_VERB_VOCAB))
+            mark = np.zeros(length, np.int64)
+            mark[verb_pos] = 1
+            # label correlates with distance to the verb (learnable)
+            dist = np.abs(np.arange(length) - verb_pos)
+            labels = (words * 7 + dist * 3) % _N_LABELS
+
+            def ctx(off):
+                idx = np.clip(np.arange(length) + off, 0, length - 1)
+                return list(map(int, words[idx]))
+
+            yield (list(map(int, words)), ctx(-2), ctx(-1), ctx(0), ctx(1),
+                   ctx(2), [verb] * length, list(map(int, mark)),
+                   list(map(int, labels)))
+
+    return reader

@@ -1,0 +1,80 @@
+"""Image preprocessing utilities (reference: python/paddle/dataset/image.py
+— cv2-backed resize/crop/flip/transpose helpers used by the vision
+readers). Implemented on numpy (no cv2 in this image): bilinear resize,
+center/random crop, horizontal flip, CHW conversion.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "resize_short", "to_chw", "center_crop", "random_crop", "left_right_flip",
+    "simple_transform",
+]
+
+
+def _bilinear_resize(im: np.ndarray, h: int, w: int) -> np.ndarray:
+    """im: HWC float/uint8 -> (h, w, C)."""
+    H, W = im.shape[:2]
+    ys = (np.arange(h) + 0.5) * H / h - 0.5
+    xs = (np.arange(w) + 0.5) * W / w - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, H - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, W - 1)
+    y1 = np.clip(y0 + 1, 0, H - 1)
+    x1 = np.clip(x0 + 1, 0, W - 1)
+    wy = np.clip(ys - y0, 0, 1)[:, None, None]
+    wx = np.clip(xs - x0, 0, 1)[None, :, None]
+    im = im.astype(np.float32)
+    top = im[y0][:, x0] * (1 - wx) + im[y0][:, x1] * wx
+    bot = im[y1][:, x0] * (1 - wx) + im[y1][:, x1] * wx
+    return top * (1 - wy) + bot * wy
+
+
+def resize_short(im: np.ndarray, size: int) -> np.ndarray:
+    """Reference: image.py:resize_short — scale so the short side == size."""
+    h, w = im.shape[:2]
+    if h < w:
+        return _bilinear_resize(im, size, int(round(w * size / h)))
+    return _bilinear_resize(im, int(round(h * size / w)), size)
+
+
+def to_chw(im: np.ndarray, order=(2, 0, 1)) -> np.ndarray:
+    """Reference: image.py:to_chw."""
+    return im.transpose(order)
+
+
+def center_crop(im: np.ndarray, size: int, is_color: bool = True) -> np.ndarray:
+    h, w = im.shape[:2]
+    sh, sw = max((h - size) // 2, 0), max((w - size) // 2, 0)
+    return im[sh:sh + size, sw:sw + size]
+
+
+def random_crop(im: np.ndarray, size: int, is_color: bool = True,
+                rng: np.random.RandomState = None) -> np.ndarray:
+    rng = rng or np.random
+    h, w = im.shape[:2]
+    sh = rng.randint(0, max(h - size, 0) + 1)
+    sw = rng.randint(0, max(w - size, 0) + 1)
+    return im[sh:sh + size, sw:sw + size]
+
+
+def left_right_flip(im: np.ndarray, is_color: bool = True) -> np.ndarray:
+    return im[:, ::-1]
+
+
+def simple_transform(im: np.ndarray, resize_size: int, crop_size: int,
+                     is_train: bool, is_color: bool = True, mean=None,
+                     rng=None) -> np.ndarray:
+    """Reference: image.py:simple_transform — resize-short, crop, maybe
+    flip, HWC->CHW, mean-subtract."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, rng=rng)
+        if (rng or np.random).randint(2) == 1:
+            im = left_right_flip(im)
+    else:
+        im = center_crop(im, crop_size)
+    im = to_chw(im).astype(np.float32)
+    if mean is not None:
+        im -= np.asarray(mean, np.float32).reshape(-1, 1, 1)
+    return im

@@ -1,0 +1,57 @@
+"""IMDB sentiment (reference: python/paddle/dataset/imdb.py).
+
+Samples: (word-id sequence list[int], label 0/1). Synthetic source: two
+sentiment-biased unigram distributions over a shared vocab — positive
+reviews over-sample the "positive" half of the vocab, so bag-of-words and
+LSTM classifiers genuinely separate the classes.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import rng_for, synthetic_size
+
+__all__ = ["build_dict", "train", "test", "word_dict"]
+
+_VOCAB_SIZE = 5148  # mirrors the reference's cutoff-150 dict size ballpark
+
+
+def word_dict():
+    """Reference: imdb.py:word_dict — word -> id, highest frequency first;
+    '<unk>' is the last id."""
+    d = {"w%04d" % i: i for i in range(_VOCAB_SIZE - 1)}
+    d["<unk>"] = _VOCAB_SIZE - 1
+    return d
+
+
+def build_dict(pattern=None, cutoff=150):
+    """Reference parity; the synthetic corpus has a fixed vocab."""
+    return word_dict()
+
+
+def _reader_creator(word_idx, split: str, n: int, epoch: int = 1):
+    vocab = len(word_idx)
+    half = vocab // 2
+
+    def reader():
+        rng = rng_for("imdb", split)
+        for _ in range(n * epoch):
+            label = int(rng.randint(2))
+            length = int(rng.randint(16, 200))
+            # sentiment-biased mixture: 70% from the class's half
+            biased = rng.randint(0, half, size=length)
+            uniform = rng.randint(0, vocab, size=length)
+            take = rng.rand(length) < 0.7
+            ids = np.where(take, biased + (half if label else 0), uniform)
+            yield list(map(int, ids)), label
+
+    return reader
+
+
+def train(word_idx):
+    """Reference: imdb.py:train(word_idx)."""
+    return _reader_creator(word_idx, "train", synthetic_size("imdb_train", 2000))
+
+
+def test(word_idx):
+    return _reader_creator(word_idx, "test", synthetic_size("imdb_test", 400))

@@ -1,0 +1,60 @@
+"""PTB-style n-gram LM data (reference: python/paddle/dataset/imikolov.py).
+
+train(word_idx, n) yields n-gram tuples of word ids (the word2vec book
+example's input); NGRAM mode matches the reference's DataType.NGRAM.
+Synthetic source: an order-1 Markov chain over the vocab so n-gram models
+have real structure to learn.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import rng_for, synthetic_size
+
+__all__ = ["build_dict", "train", "test"]
+
+_VOCAB_SIZE = 2074  # reference's min_word_freq=50 PTB dict size ballpark
+
+
+def build_dict(min_word_freq: int = 50):
+    """Reference: imikolov.py:build_dict — '<s>', '<e>', '<unk>' included."""
+    d = {"w%04d" % i: i for i in range(_VOCAB_SIZE - 3)}
+    d["<s>"] = _VOCAB_SIZE - 3
+    d["<e>"] = _VOCAB_SIZE - 2
+    d["<unk>"] = _VOCAB_SIZE - 1
+    return d
+
+
+def _markov_sentence(rng, vocab: int, length: int, trans_seed):
+    # shared low-rank transition structure: next ~ (cur * a + b) mod vocab
+    a, b = trans_seed
+    ids = [int(rng.randint(vocab))]
+    for _ in range(length - 1):
+        if rng.rand() < 0.8:
+            ids.append((ids[-1] * a + b + int(rng.randint(3))) % vocab)
+        else:
+            ids.append(int(rng.randint(vocab)))
+    return ids
+
+
+def _reader_creator(word_idx, n: int, split: str, count: int):
+    vocab = len(word_idx)
+
+    def reader():
+        rng = rng_for("imikolov", split)
+        for _ in range(count):
+            length = int(rng.randint(n + 2, 40))
+            sent = _markov_sentence(rng, vocab, length, (31, 7))
+            for i in range(n - 1, len(sent)):
+                yield tuple(sent[i - n + 1:i + 1])
+
+    return reader
+
+
+def train(word_idx, n):
+    """Reference: imikolov.py:train(word_idx, n) — yields n-word windows."""
+    return _reader_creator(word_idx, n, "train", synthetic_size("imikolov_train", 1000))
+
+
+def test(word_idx, n):
+    return _reader_creator(word_idx, n, "test", synthetic_size("imikolov_test", 200))

@@ -1,0 +1,115 @@
+"""MovieLens-1M schema (reference: python/paddle/dataset/movielens.py).
+
+Samples: (user_id, gender_id, age_id, job_id, movie_id, category_ids,
+title_ids, rating) — the recommender book example's 8 slots. Synthetic
+source: latent-factor ratings (user/movie embeddings drawn once), so
+factorization models can actually fit.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import rng_for, synthetic_size
+
+__all__ = [
+    "train", "test", "get_movie_title_dict", "max_movie_id", "max_user_id",
+    "max_job_id", "age_table", "movie_categories", "user_info", "movie_info",
+]
+
+_N_USERS = 600
+_N_MOVIES = 400
+_N_CATEGORIES = 18
+_TITLE_VOCAB = 1000
+_N_JOBS = 21
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+
+def max_user_id():
+    """Reference: movielens.py:max_user_id."""
+    return _N_USERS
+
+
+def max_movie_id():
+    return _N_MOVIES
+
+
+def max_job_id():
+    return _N_JOBS - 1
+
+
+def movie_categories():
+    return {"cat%02d" % i: i for i in range(_N_CATEGORIES)}
+
+
+def get_movie_title_dict():
+    return {"t%03d" % i: i for i in range(_TITLE_VOCAB)}
+
+
+class MovieInfo:
+    def __init__(self, index, categories, title):
+        self.index = index
+        self.categories = categories
+        self.title = title
+
+
+class UserInfo:
+    def __init__(self, index, gender, age, job_id):
+        self.index = index
+        self.is_male = gender == "M"
+        self.age = age
+        self.job_id = job_id
+
+
+def _factors():
+    r = rng_for("movielens", "factors")
+    uf = r.randn(_N_USERS + 1, 8).astype(np.float32)
+    mf = r.randn(_N_MOVIES + 1, 8).astype(np.float32)
+    return uf, mf
+
+
+def movie_info():
+    r = rng_for("movielens", "movies")
+    out = {}
+    for m in range(1, _N_MOVIES + 1):
+        cats = list(map(int, r.choice(_N_CATEGORIES, size=r.randint(1, 4),
+                                      replace=False)))
+        title = list(map(int, r.randint(0, _TITLE_VOCAB, size=r.randint(1, 6))))
+        out[m] = MovieInfo(m, cats, title)
+    return out
+
+
+def user_info():
+    r = rng_for("movielens", "users")
+    out = {}
+    for u in range(1, _N_USERS + 1):
+        out[u] = UserInfo(u, "M" if r.rand() < 0.5 else "F",
+                          int(r.choice(age_table)), int(r.randint(_N_JOBS)))
+    return out
+
+
+def _reader_creator(split: str, n: int):
+    def reader():
+        rng = rng_for("movielens", split)
+        uf, mf = _factors()
+        movies, users = movie_info(), user_info()
+        ages = {a: i for i, a in enumerate(age_table)}
+        for _ in range(n):
+            u = int(rng.randint(1, _N_USERS + 1))
+            m = int(rng.randint(1, _N_MOVIES + 1))
+            raw = float(uf[u] @ mf[m]) * 0.5 + 3.0 + rng.randn() * 0.3
+            rating = float(np.clip(round(raw), 1, 5))
+            usr, mov = users[u], movies[m]
+            yield (u, int(usr.is_male), ages[usr.age], usr.job_id,
+                   m, mov.categories, mov.title, rating)
+
+    return reader
+
+
+def train():
+    """Reference: movielens.py:train."""
+    return _reader_creator("train", synthetic_size("movielens_train", 4000))
+
+
+def test():
+    return _reader_creator("test", synthetic_size("movielens_test", 800))

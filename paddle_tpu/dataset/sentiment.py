@@ -1,0 +1,30 @@
+"""Movie-review sentiment (reference: python/paddle/dataset/sentiment.py,
+NLTK-backed in the reference). Same (word ids, label) schema as imdb with
+a smaller vocab.
+"""
+from __future__ import annotations
+
+from . import imdb
+from .common import synthetic_size
+
+__all__ = ["get_word_dict", "train", "test"]
+
+_VOCAB = 2000
+
+
+def get_word_dict():
+    """Reference: sentiment.py:get_word_dict."""
+    d = {"w%04d" % i: i for i in range(_VOCAB - 1)}
+    d["<unk>"] = _VOCAB - 1
+    return d
+
+
+def train():
+    """Reference: sentiment.py:train (no word_idx arg — fixed dict)."""
+    return imdb._reader_creator(get_word_dict(), "sent_train",
+                                synthetic_size("sentiment_train", 1600))
+
+
+def test():
+    return imdb._reader_creator(get_word_dict(), "sent_test",
+                                synthetic_size("sentiment_test", 400))

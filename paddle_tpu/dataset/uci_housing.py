@@ -1,0 +1,69 @@
+"""UCI housing regression (reference: python/paddle/dataset/uci_housing.py).
+
+Samples: (features float32[13] normalized, price float32[1]). Synthetic
+source is an exact linear model + noise over normalized features, so
+linear regression fits it to near-zero loss (the book example's behavior).
+Real `housing.data` in DATA_HOME/uci_housing is used when present.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .common import data_home, rng_for, synthetic_size
+
+__all__ = ["train", "test"]
+
+feature_names = [
+    "CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE", "DIS", "RAD", "TAX",
+    "PTRATIO", "B", "LSTAT",
+]
+
+UCI_TRAIN_RATIO = 0.8
+
+
+def _load_real():
+    path = data_home("uci_housing", "housing.data")
+    if not os.path.exists(path):
+        return None
+    data = np.loadtxt(path).astype(np.float32)
+    feats, target = data[:, :13], data[:, 13:14]
+    feats = (feats - feats.mean(0)) / (feats.std(0) + 1e-8)
+    return feats, target
+
+
+def _synthetic(split: str):
+    n = synthetic_size("uci_%s" % split, 404 if split == "train" else 102)
+    rng = rng_for("uci_housing", split)
+    w = rng_for("uci_housing", "weights").randn(13, 1).astype(np.float32)
+    feats = rng.randn(n, 13).astype(np.float32)
+    target = feats @ w + 0.1 * rng.randn(n, 1).astype(np.float32) + 22.5
+    return feats, target
+
+
+def _reader_creator(split: str):
+    def reader():
+        real = _load_real()
+        if real is not None:
+            feats, target = real
+            cut = int(len(feats) * UCI_TRAIN_RATIO)
+            if split == "train":
+                feats, target = feats[:cut], target[:cut]
+            else:
+                feats, target = feats[cut:], target[cut:]
+        else:
+            feats, target = _synthetic(split)
+        for f, t in zip(feats, target):
+            yield f, t
+
+    return reader
+
+
+def train():
+    """Reference: uci_housing.py:train."""
+    return _reader_creator("train")
+
+
+def test():
+    return _reader_creator("test")

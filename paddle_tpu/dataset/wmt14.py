@@ -1,0 +1,29 @@
+"""WMT14 fr-en NMT schema (reference: python/paddle/dataset/wmt14.py).
+
+Same 3-slot sample layout as wmt16 with the reference's 30k default dicts.
+"""
+from __future__ import annotations
+
+from . import wmt16
+from .common import synthetic_size
+
+__all__ = ["train", "test", "get_dict"]
+
+_DEFAULT_VOCAB = 30000
+
+
+def get_dict(dict_size: int = _DEFAULT_VOCAB, reverse: bool = False):
+    """Reference: wmt14.py:get_dict returns (src_dict, trg_dict)."""
+    return (wmt16.get_dict("fr", dict_size, reverse),
+            wmt16.get_dict("en", dict_size, reverse))
+
+
+def train(dict_size: int = _DEFAULT_VOCAB):
+    """Reference: wmt14.py:train."""
+    return wmt16._reader_creator("train14", synthetic_size("wmt14_train", 2000),
+                                 dict_size, dict_size, "fr")
+
+
+def test(dict_size: int = _DEFAULT_VOCAB):
+    return wmt16._reader_creator("test14", synthetic_size("wmt14_test", 400),
+                                 dict_size, dict_size, "fr")

@@ -1,0 +1,64 @@
+"""WMT16 en-de NMT schema (reference: python/paddle/dataset/wmt16.py).
+
+Samples: (src ids, trg ids with <s> prefix, trg_next ids with <e> suffix).
+Synthetic source: the "target" is a deterministic re-mapping of the source
+sequence (a learnable toy translation), so seq2seq/transformer models fit
+it and BLEU-ish overlap rises during training.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import rng_for, synthetic_size
+
+__all__ = ["train", "test", "validation", "get_dict"]
+
+_DEFAULT_SRC_VOCAB = 10000
+_DEFAULT_TRG_VOCAB = 10000
+
+_BOS, _EOS, _UNK = 0, 1, 2
+
+
+def get_dict(lang: str, dict_size: int, reverse: bool = False):
+    """Reference: wmt16.py:get_dict. ids 0/1/2 = <s>/<e>/<unk>."""
+    words = ["<s>", "<e>", "<unk>"] + [
+        "%s_w%05d" % (lang, i) for i in range(dict_size - 3)]
+    if reverse:
+        return {i: w for i, w in enumerate(words)}
+    return {w: i for i, w in enumerate(words)}
+
+
+def _translate(src_ids, trg_vocab):
+    # deterministic affine remap: the structure a model can learn
+    return [(3 + ((w * 17 + 5) % (trg_vocab - 3))) for w in src_ids]
+
+
+def _reader_creator(split, n, src_dict_size, trg_dict_size, src_lang):
+    def reader():
+        rng = rng_for("wmt16", split)
+        for _ in range(n):
+            length = int(rng.randint(4, 30))
+            src = [int(x) for x in rng.randint(3, src_dict_size, size=length)]
+            trg = _translate(src, trg_dict_size)
+            yield src, [_BOS] + trg, trg + [_EOS]
+
+    return reader
+
+
+def train(src_dict_size=_DEFAULT_SRC_VOCAB, trg_dict_size=_DEFAULT_TRG_VOCAB,
+          src_lang="en"):
+    """Reference: wmt16.py:train."""
+    return _reader_creator("train", synthetic_size("wmt16_train", 2000),
+                           src_dict_size, trg_dict_size, src_lang)
+
+
+def test(src_dict_size=_DEFAULT_SRC_VOCAB, trg_dict_size=_DEFAULT_TRG_VOCAB,
+         src_lang="en"):
+    return _reader_creator("test", synthetic_size("wmt16_test", 400),
+                           src_dict_size, trg_dict_size, src_lang)
+
+
+def validation(src_dict_size=_DEFAULT_SRC_VOCAB, trg_dict_size=_DEFAULT_TRG_VOCAB,
+               src_lang="en"):
+    return _reader_creator("val", synthetic_size("wmt16_val", 400),
+                           src_dict_size, trg_dict_size, src_lang)

@@ -147,15 +147,33 @@ class ParallelExecutor:
             )
         stepfn = build_step_fn(program, fetch_names, state_in, state_out)
 
+        # the traced step may return fewer state vars than analyze_state
+        # guesses (e.g. a persistable written only under a lax control-flow
+        # branch never lands in the top-level env): eval_shape gives the
+        # TRUE output pytree, so out_shardings always matches.
+        feeds_aval = {
+            name: jax.ShapeDtypeStruct(shape, np.dtype(dt))
+            for name, shape, dt in feed_sig
+        }
+        state_aval = {}
+        for n in state_in:
+            val = self._scope.find_var(n)
+            arr = val if hasattr(val, "shape") and hasattr(val, "dtype") else np.asarray(val)
+            state_aval[n] = jax.ShapeDtypeStruct(tuple(arr.shape), arr.dtype)
+        key_aval = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+        _, out_state_aval = jax.eval_shape(stepfn, feeds_aval, state_aval, key_aval)
+
         plan = self._plan
         feed_shardings = {
             name: plan.feed_sharding(len(shape)) for name, shape, _ in feed_sig
         }
-        state_names = sorted(set(state_in) | set(state_out))
-        state_shardings = {
-            n: plan.sharding(n, shape=self._state_shape(n)) for n in state_names
+        in_state_shardings = {
+            n: plan.sharding(n, shape=tuple(state_aval[n].shape)) for n in state_in
         }
-        in_state_shardings = {n: state_shardings[n] for n in state_in}
+        out_state_shardings = {
+            n: plan.sharding(n, shape=tuple(a.shape))
+            for n, a in out_state_aval.items()
+        }
         rep = plan.replicated()
 
         fn = jax.jit(
@@ -163,21 +181,11 @@ class ParallelExecutor:
             in_shardings=(feed_shardings, in_state_shardings, rep),
             out_shardings=(
                 tuple(rep for _ in fetch_names),
-                {n: state_shardings[n] for n in state_names},
+                out_state_shardings,
             ),
             donate_argnums=(1,),
         )
         return _ParCompiled(fn, state_in, state_out, fetch_names)
-
-    def _state_shape(self, name: str):
-        # scope value is authoritative (vars may declare -1 dims)
-        val = self._scope.find_var(name)
-        if val is not None and hasattr(val, "shape"):
-            return tuple(val.shape)
-        var = self._program.global_block()._find_var_recursive(name)
-        if var is not None and all(s >= 0 for s in var.shape):
-            return tuple(var.shape)
-        return None
 
     # -- feed assembly ---------------------------------------------------
     def _assemble_feed(self, feed, feed_dict) -> Dict[str, np.ndarray]:

@@ -1,0 +1,244 @@
+"""Reader decorators: composable python-generator data pipelines.
+
+Reference: python/paddle/reader/decorator.py (map_readers/buffered/compose/
+chain/shuffle/firstn/xmap_readers/PipeReader) and python/paddle/batch.py
+(batch). A *reader creator* is a zero-arg callable returning an iterator of
+samples; decorators wrap creators and stay lazy.
+
+The threaded decorators (buffered, xmap_readers) keep the host-side
+pipeline ahead of the device: on TPU the jitted step consumes a batch in
+one transfer, so a couple of worker threads is enough to hide IO — the
+heavier double-buffer path is runtime/prefetch.py (C++ bounded channel).
+"""
+from __future__ import annotations
+
+import itertools
+import random as _random
+from queue import Queue
+from threading import Thread
+
+__all__ = [
+    "map_readers",
+    "buffered",
+    "compose",
+    "chain",
+    "shuffle",
+    "ComposeNotAligned",
+    "firstn",
+    "xmap_readers",
+    "cache",
+    "batch",
+]
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def map_readers(func, *readers):
+    """Creator yielding func applied across the component readers' samples
+    (reference decorator.py:map_readers)."""
+
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    """Shuffle within a sliding buffer (reference decorator.py:shuffle)."""
+
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            for b in buf:
+                yield b
+
+    return data_reader
+
+
+def chain(*readers):
+    """Concatenate readers back-to-back (reference decorator.py:chain)."""
+
+    def reader():
+        return itertools.chain(*[r() for r in readers])
+
+    return reader
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into combined samples (reference decorator.py:compose).
+    check_alignment=True raises ComposeNotAligned on length mismatch."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        if isinstance(x, tuple):
+            return x
+        return (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in zip(*rs):
+                yield sum(list(map(make_tuple, outputs)), ())
+        else:
+            for outputs in itertools.zip_longest(*rs):
+                if any(o is None for o in outputs):
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned")
+                yield sum(list(map(make_tuple, outputs)), ())
+
+    return reader
+
+
+def buffered(reader, size):
+    """Worker thread keeps up to `size` samples decoded ahead of the
+    consumer (reference decorator.py:buffered)."""
+
+    class _End:
+        pass
+
+    def data_reader():
+        r = reader()
+        q = Queue(maxsize=size)
+
+        def read_worker():
+            for d in r:
+                q.put(d)
+            q.put(_End)
+
+        t = Thread(target=read_worker)
+        t.daemon = True
+        t.start()
+        e = q.get()
+        while e is not _End:
+            yield e
+            e = q.get()
+
+    return data_reader
+
+
+def firstn(reader, n):
+    """First n samples only (reference decorator.py:firstn)."""
+
+    def firstn_reader():
+        for i, item in enumerate(reader()):
+            if i == n:
+                break
+            yield item
+
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over samples with `process_num` worker THREADS
+    (reference decorator.py:xmap_readers uses threads too, despite the
+    name). With order=True output order matches input order."""
+    end = XmapEndSignal()
+
+    def read_worker(r, in_queue):
+        for i in r():
+            in_queue.put(i)
+        in_queue.put(end)
+
+    def order_read_worker(r, in_queue):
+        for i, d in enumerate(r()):
+            in_queue.put((i, d))
+        in_queue.put(end)
+
+    def handle_worker(in_queue, out_queue):
+        sample = in_queue.get()
+        while not isinstance(sample, XmapEndSignal):
+            out_queue.put(mapper(sample))
+            sample = in_queue.get()
+        in_queue.put(end)
+        out_queue.put(end)
+
+    def order_handle_worker(in_queue, out_queue, out_order):
+        ins = in_queue.get()
+        while not isinstance(ins, XmapEndSignal):
+            order, sample = ins
+            result = mapper(sample)
+            while order != out_order[0]:
+                pass
+            out_queue.put(result)
+            out_order[0] += 1
+            ins = in_queue.get()
+        in_queue.put(end)
+        out_queue.put(end)
+
+    def xreader():
+        in_queue = Queue(buffer_size)
+        out_queue = Queue(buffer_size)
+        out_order = [0]
+        target = order_read_worker if order else read_worker
+        t = Thread(target=target, args=(reader, in_queue))
+        t.daemon = True
+        t.start()
+        workers = []
+        htarget = order_handle_worker if order else handle_worker
+        hargs = (in_queue, out_queue, out_order) if order else (in_queue, out_queue)
+        for _ in range(process_num):
+            w = Thread(target=htarget, args=hargs)
+            w.daemon = True
+            w.start()
+            workers.append(w)
+        finish = 0
+        while finish < process_num:
+            sample = out_queue.get()
+            if isinstance(sample, XmapEndSignal):
+                finish += 1
+            else:
+                yield sample
+
+    return xreader
+
+
+class XmapEndSignal:
+    pass
+
+
+def cache(reader):
+    """Materialize once, replay from memory thereafter."""
+    all_data = []
+    filled = [False]
+
+    def cache_reader():
+        if not filled[0]:
+            for item in reader():
+                all_data.append(item)
+                yield item
+            filled[0] = True
+        else:
+            for item in all_data:
+                yield item
+
+    return cache_reader
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Group samples into lists of batch_size (reference:
+    python/paddle/batch.py:batch)."""
+
+    def batch_reader():
+        r = reader()
+        b = []
+        for instance in r:
+            b.append(instance)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batch_reader

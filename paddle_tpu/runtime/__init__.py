@@ -1,0 +1,26 @@
+"""C++ host runtime (built on import from runtime.cc, with pure-Python
+fallbacks): recordio chunk IO, prefetch readers, bounded channels, staging
+arena. See runtime.cc for the reference mapping."""
+from .recordio import (  # noqa: F401
+    Channel,
+    PrefetchReader,
+    RecordIOError,
+    RecordIOReader,
+    RecordIOWriter,
+    StagingArena,
+    native_available,
+    recordio_convert,
+    recordio_sample_reader,
+)
+
+__all__ = [
+    "Channel",
+    "PrefetchReader",
+    "RecordIOError",
+    "RecordIOReader",
+    "RecordIOWriter",
+    "StagingArena",
+    "native_available",
+    "recordio_convert",
+    "recordio_sample_reader",
+]

@@ -1,0 +1,55 @@
+"""Build the C++ runtime shared library on first import.
+
+g++ is part of the supported environment; the .so is cached next to the
+source keyed on a content hash, so rebuilds only happen when runtime.cc
+changes. When no toolchain is available the Python fallback in
+recordio.py keeps everything working (same on-disk format).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "runtime.cc")
+_lock = threading.Lock()
+_lib_path = None
+_build_error = None
+
+
+def lib_path():
+    """Returns the built .so path, or None (with the error recorded) when
+    the toolchain is unavailable."""
+    global _lib_path, _build_error
+    with _lock:
+        if _lib_path is not None or _build_error is not None:
+            return _lib_path
+        with open(_SRC, "rb") as f:
+            digest = hashlib.sha1(f.read()).hexdigest()[:16]
+        out = os.path.join(_HERE, "_ptrt_%s.so" % digest)
+        if not os.path.exists(out):
+            cmd = [
+                "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+                _SRC, "-o", out + ".tmp", "-lz",
+            ]
+            try:
+                subprocess.run(cmd, check=True, capture_output=True, text=True)
+                os.replace(out + ".tmp", out)
+            except (subprocess.CalledProcessError, OSError) as e:
+                _build_error = getattr(e, "stderr", None) or str(e)
+                return None
+        # clean stale builds
+        for entry in os.listdir(_HERE):
+            if entry.startswith("_ptrt_") and entry.endswith(".so") and entry != os.path.basename(out):
+                try:
+                    os.remove(os.path.join(_HERE, entry))
+                except OSError:
+                    pass
+        _lib_path = out
+        return _lib_path
+
+
+def build_error():
+    return _build_error

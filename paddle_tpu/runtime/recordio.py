@@ -1,0 +1,393 @@
+"""recordio: chunked record files with per-chunk crc32 + compression.
+
+ctypes bindings over the C++ runtime (runtime.cc), with a pure-Python
+implementation of the SAME on-disk format as fallback (and as the
+cross-check in tests). Reference: paddle/fluid/recordio/* and
+python/paddle/fluid/recordio_writer.py.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import struct
+import zlib
+from typing import Iterator, Optional
+
+from .build import lib_path
+
+__all__ = [
+    "RecordIOWriter",
+    "RecordIOReader",
+    "PrefetchReader",
+    "Channel",
+    "StagingArena",
+    "RecordIOError",
+    "native_available",
+    "recordio_convert",
+    "recordio_sample_reader",
+]
+
+_MAGIC = 0x50445452
+_HDR = struct.Struct("<IIIQQI")  # magic, comp, nrec, rawlen, complen, crc
+
+
+class RecordIOError(IOError):
+    pass
+
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    path = lib_path()
+    if path is None:
+        return None
+    lib = ctypes.CDLL(path)
+    lib.ptrt_rio_writer_open.restype = ctypes.c_void_p
+    lib.ptrt_rio_writer_open.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+    lib.ptrt_rio_writer_write.restype = ctypes.c_int
+    lib.ptrt_rio_writer_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+    lib.ptrt_rio_writer_close.restype = ctypes.c_int
+    lib.ptrt_rio_writer_close.argtypes = [ctypes.c_void_p]
+    lib.ptrt_rio_reader_open.restype = ctypes.c_void_p
+    lib.ptrt_rio_reader_open.argtypes = [ctypes.c_char_p]
+    lib.ptrt_rio_reader_next.restype = ctypes.c_int64
+    lib.ptrt_rio_reader_next.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_char))]
+    lib.ptrt_rio_reader_close.argtypes = [ctypes.c_void_p]
+    lib.ptrt_prefetch_open.restype = ctypes.c_void_p
+    lib.ptrt_prefetch_open.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.ptrt_prefetch_next.restype = ctypes.c_int64
+    lib.ptrt_prefetch_next.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_char))]
+    lib.ptrt_prefetch_close.argtypes = [ctypes.c_void_p]
+    lib.ptrt_chan_create.restype = ctypes.c_void_p
+    lib.ptrt_chan_create.argtypes = [ctypes.c_int64]
+    lib.ptrt_chan_send.restype = ctypes.c_int
+    lib.ptrt_chan_send.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+    lib.ptrt_chan_recv.restype = ctypes.c_int64
+    lib.ptrt_chan_recv.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_char))]
+    lib.ptrt_chan_size.restype = ctypes.c_int64
+    lib.ptrt_chan_size.argtypes = [ctypes.c_void_p]
+    lib.ptrt_chan_close.argtypes = [ctypes.c_void_p]
+    lib.ptrt_chan_destroy.argtypes = [ctypes.c_void_p]
+    lib.ptrt_free.argtypes = [ctypes.POINTER(ctypes.c_char)]
+    lib.ptrt_arena_create.restype = ctypes.c_void_p
+    lib.ptrt_arena_create.argtypes = [ctypes.c_int64]
+    lib.ptrt_arena_alloc.restype = ctypes.c_void_p
+    lib.ptrt_arena_alloc.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64]
+    lib.ptrt_arena_reset.argtypes = [ctypes.c_void_p]
+    lib.ptrt_arena_used.restype = ctypes.c_int64
+    lib.ptrt_arena_used.argtypes = [ctypes.c_void_p]
+    lib.ptrt_arena_destroy.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def _take(lib, buf_ptr, length: int) -> bytes:
+    data = ctypes.string_at(buf_ptr, length)
+    lib.ptrt_free(buf_ptr)
+    return data
+
+
+# ---------------------------------------------------------------------------
+# writer
+# ---------------------------------------------------------------------------
+
+
+class RecordIOWriter:
+    """with RecordIOWriter(path) as w: w.write(b"...")"""
+
+    def __init__(self, path: str, compressor: int = 1, max_chunk_records: int = 1000):
+        self._path = path
+        self._compressor = compressor
+        self._max = max_chunk_records
+        self._lib = _load()
+        if self._lib is not None:
+            self._h = self._lib.ptrt_rio_writer_open(
+                path.encode(), compressor, max_chunk_records)
+            if not self._h:
+                raise RecordIOError("cannot open %s for writing" % path)
+        else:  # pure-python fallback, same format
+            self._f = open(path, "wb")
+            self._pending = []
+
+    def write(self, record: bytes):
+        if self._lib is not None:
+            rc = self._lib.ptrt_rio_writer_write(self._h, record, len(record))
+            if rc != 0:
+                raise RecordIOError("write failed on %s" % self._path)
+            return
+        self._pending.append(bytes(record))
+        if len(self._pending) >= self._max:
+            self._flush_py()
+
+    def _flush_py(self):
+        if not self._pending:
+            return
+        raw = b"".join(struct.pack("<I", len(r)) + r for r in self._pending)
+        stored = zlib.compress(raw, 6) if self._compressor == 1 else raw
+        crc = zlib.crc32(stored) & 0xFFFFFFFF
+        self._f.write(_HDR.pack(_MAGIC, self._compressor, len(self._pending),
+                                len(raw), len(stored), crc))
+        self._f.write(stored)
+        self._pending = []
+
+    def close(self):
+        if self._lib is not None:
+            if self._h:
+                rc = self._lib.ptrt_rio_writer_close(self._h)
+                self._h = None
+                if rc != 0:
+                    raise RecordIOError("flush failed on %s" % self._path)
+        else:
+            self._flush_py()
+            self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# ---------------------------------------------------------------------------
+# reader
+# ---------------------------------------------------------------------------
+
+
+class RecordIOReader:
+    """Iterates records; raises RecordIOError on checksum/format corruption."""
+
+    def __init__(self, path: str):
+        if not os.path.exists(path):
+            raise RecordIOError("no such recordio file: %s" % path)
+        self._path = path
+        self._lib = _load()
+        if self._lib is not None:
+            self._h = self._lib.ptrt_rio_reader_open(path.encode())
+            if not self._h:
+                raise RecordIOError("cannot open %s" % path)
+        else:
+            self._f = open(path, "rb")
+            self._chunk: list = []
+
+    def __iter__(self) -> Iterator[bytes]:
+        if self._lib is not None:
+            buf = ctypes.POINTER(ctypes.c_char)()
+            while True:
+                n = self._lib.ptrt_rio_reader_next(self._h, ctypes.byref(buf))
+                if n == -1:
+                    return
+                if n < 0:
+                    raise RecordIOError(
+                        "corrupt recordio chunk in %s" % self._path)
+                yield _take(self._lib, buf, n)
+        else:
+            while True:
+                hdr = self._f.read(_HDR.size)
+                if not hdr:
+                    return
+                try:
+                    magic, comp, nrec, rawlen, complen, crc = _HDR.unpack(hdr)
+                except struct.error:
+                    raise RecordIOError("corrupt recordio header in %s" % self._path)
+                if magic != _MAGIC:
+                    raise RecordIOError("bad magic in %s" % self._path)
+                stored = self._f.read(complen)
+                if len(stored) != complen or (zlib.crc32(stored) & 0xFFFFFFFF) != crc:
+                    raise RecordIOError("corrupt recordio chunk in %s" % self._path)
+                raw = zlib.decompress(stored) if comp == 1 else stored
+                pos = 0
+                for _ in range(nrec):
+                    (ln,) = struct.unpack_from("<I", raw, pos)
+                    pos += 4
+                    yield raw[pos:pos + ln]
+                    pos += ln
+
+    def close(self):
+        if self._lib is not None:
+            if getattr(self, "_h", None):
+                self._lib.ptrt_rio_reader_close(self._h)
+                self._h = None
+        else:
+            self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class PrefetchReader:
+    """Background-thread record reader: disk + crc + decompress run on a
+    C++ thread into a bounded channel (reference double_buffer /
+    open_recordio_file pipeline). Python fallback = plain iteration."""
+
+    def __init__(self, path: str, capacity: int = 256):
+        self._lib = _load()
+        self._path = path
+        if self._lib is not None:
+            if not os.path.exists(path):
+                raise RecordIOError("no such recordio file: %s" % path)
+            self._h = self._lib.ptrt_prefetch_open(path.encode(), capacity)
+        else:
+            self._inner = RecordIOReader(path)
+
+    def __iter__(self) -> Iterator[bytes]:
+        if self._lib is None:
+            yield from self._inner
+            return
+        buf = ctypes.POINTER(ctypes.c_char)()
+        while True:
+            n = self._lib.ptrt_prefetch_next(self._h, ctypes.byref(buf))
+            if n == -1:
+                return
+            if n < 0:
+                raise RecordIOError("corrupt recordio chunk in %s" % self._path)
+            yield _take(self._lib, buf, n)
+
+    def close(self):
+        if self._lib is not None:
+            if getattr(self, "_h", None):
+                self._lib.ptrt_prefetch_close(self._h)
+                self._h = None
+        else:
+            self._inner.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# ---------------------------------------------------------------------------
+# channel + arena bindings
+# ---------------------------------------------------------------------------
+
+
+class Channel:
+    """Bounded blocking byte channel (framework/channel.h equivalent)."""
+
+    def __init__(self, capacity: int = 64):
+        self._lib = _load()
+        if self._lib is None:
+            import queue
+
+            self._q = queue.Queue(maxsize=capacity)
+            self._closed = False
+        else:
+            self._h = self._lib.ptrt_chan_create(capacity)
+
+    def send(self, data: bytes) -> bool:
+        if self._lib is None:
+            if self._closed:
+                return False
+            self._q.put(bytes(data))
+            return True
+        return self._lib.ptrt_chan_send(self._h, data, len(data)) == 0
+
+    def recv(self) -> Optional[bytes]:
+        if self._lib is None:
+            item = self._q.get()
+            return item
+        buf = ctypes.POINTER(ctypes.c_char)()
+        n = self._lib.ptrt_chan_recv(self._h, ctypes.byref(buf))
+        if n < 0:
+            return None
+        return _take(self._lib, buf, n)
+
+    def qsize(self) -> int:
+        if self._lib is None:
+            return self._q.qsize()
+        return self._lib.ptrt_chan_size(self._h)
+
+    def close(self):
+        if self._lib is None:
+            self._closed = True
+        else:
+            self._lib.ptrt_chan_close(self._h)
+
+    def destroy(self):
+        if self._lib is not None and getattr(self, "_h", None):
+            self._lib.ptrt_chan_destroy(self._h)
+            self._h = None
+
+
+class StagingArena:
+    """Page-aligned bump allocator for host-side batch assembly: numpy
+    batches built in arena memory transfer to device without an extra
+    staging copy. reset() per step reuses the pages."""
+
+    def __init__(self, nbytes: int = 64 << 20):
+        self._lib = _load()
+        self.nbytes = nbytes
+        if self._lib is None:
+            self._h = None
+        else:
+            self._h = self._lib.ptrt_arena_create(nbytes)
+
+    def alloc_array(self, shape, dtype, align: int = 4096):
+        import numpy as np
+
+        dtype = np.dtype(dtype)
+        n = int(np.prod(shape)) * dtype.itemsize
+        if self._h is None:
+            return np.empty(shape, dtype)  # fallback: ordinary numpy
+        ptr = self._lib.ptrt_arena_alloc(self._h, n, align)
+        if not ptr:
+            return np.empty(shape, dtype)  # arena full: degrade gracefully
+        buf = (ctypes.c_char * n).from_address(ptr)
+        return np.frombuffer(buf, dtype=dtype).reshape(shape)
+
+    def used(self) -> int:
+        return 0 if self._h is None else self._lib.ptrt_arena_used(self._h)
+
+    def reset(self):
+        if self._h is not None:
+            self._lib.ptrt_arena_reset(self._h)
+
+    def destroy(self):
+        if self._h is not None:
+            self._lib.ptrt_arena_destroy(self._h)
+            self._h = None
+
+
+# ---------------------------------------------------------------------------
+# sample-level helpers (pickled tuples, like the reference's convert())
+# ---------------------------------------------------------------------------
+
+
+def recordio_convert(sample_reader, path: str, compressor: int = 1,
+                     max_chunk_records: int = 1000):
+    """Serialize a sample reader into a recordio file (reference:
+    python/paddle/fluid/recordio_writer.py:convert_reader_to_recordio_file)."""
+    with RecordIOWriter(path, compressor, max_chunk_records) as w:
+        n = 0
+        for sample in sample_reader():
+            w.write(pickle.dumps(sample, protocol=4))
+            n += 1
+    return n
+
+
+def recordio_sample_reader(path: str, prefetch: bool = True, capacity: int = 256):
+    """Reader creator yielding the original samples back (C++ prefetch
+    thread keeps the channel full while the device computes)."""
+
+    def reader():
+        src = PrefetchReader(path, capacity) if prefetch else RecordIOReader(path)
+        try:
+            for rec in src:
+                yield pickle.loads(rec)
+        finally:
+            src.close()
+
+    return reader

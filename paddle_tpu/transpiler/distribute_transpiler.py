@@ -127,22 +127,11 @@ class DistributeTranspiler:
     # -- the TPU-native product ------------------------------------------
     def sharding_plan(self, mesh, axis: str = "dp"):
         """ZeRO-style plan from the pserver assignment: every assigned
-        param's optimizer accumulators are sharded over `axis` (dim 0 when
-        divisible). Params stay replicated; XLA lowers grad-allreduce +
-        sharded update into reduce-scatter/all-gather pairs."""
-        from ..parallel.sharding import PartitionSpec as P, ShardingPlan
+        param's optimizer accumulators are sharded over `axis`. transpile()
+        assigns every trainable param to some shard, so this is exactly
+        parallel.sharding.zero_plan over the transpiled program."""
+        if self._program is None:
+            raise RuntimeError("call transpile() first")
+        from ..parallel.sharding import zero_plan
 
-        plan = ShardingPlan(mesh, batch_axes=(axis,))
-        n = mesh.shape[axis]
-        gb = self._program.global_block()
-        for shard in self._shards:
-            for pname in shard.param_names:
-                var = gb.vars.get(pname)
-                if var is None or not var.shape or var.shape[0] % n != 0:
-                    continue
-                spec = P(*([axis] + [None] * (len(var.shape) - 1)))
-                # accumulators (<param>_<kind>_acc) inherit via prefix;
-                # the param itself stays replicated via an exact entry.
-                plan.set(pname + "_", spec)
-                plan.set(pname, P())
-        return plan
+        return zero_plan(mesh, self._program, axis=axis)

@@ -1,0 +1,131 @@
+"""Reader decorators, DataFeeder, and dataset schema tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, reader as rd
+from paddle_tpu.dataset import (
+    cifar, conll05, imdb, imikolov, mnist, movielens, sentiment, uci_housing,
+    wmt14, wmt16,
+)
+
+
+def _counting_reader(n):
+    def reader():
+        for i in range(n):
+            yield i
+
+    return reader
+
+
+def test_reader_decorators():
+    assert list(rd.firstn(_counting_reader(10), 3)()) == [0, 1, 2]
+    assert list(rd.chain(_counting_reader(2), _counting_reader(2))()) == [0, 1, 0, 1]
+    assert list(rd.map_readers(lambda a, b: a + b, _counting_reader(3),
+                               _counting_reader(3))()) == [0, 2, 4]
+    assert sorted(rd.shuffle(_counting_reader(10), 5)()) == list(range(10))
+    assert list(rd.buffered(_counting_reader(100), 10)()) == list(range(100))
+    out = list(rd.compose(_counting_reader(3), _counting_reader(3))())
+    assert out == [(0, 0), (1, 1), (2, 2)]
+    with pytest.raises(rd.ComposeNotAligned):
+        list(rd.compose(_counting_reader(3), _counting_reader(4))())
+    assert sorted(rd.xmap_readers(lambda x: x * 2, _counting_reader(20), 4, 8)()) == [
+        i * 2 for i in range(20)
+    ]
+    c = rd.cache(_counting_reader(5))
+    assert list(c()) == list(c()) == list(range(5))
+    batches = list(rd.batch(_counting_reader(7), 3)())
+    assert batches == [[0, 1, 2], [3, 4, 5], [6]]
+    assert list(rd.batch(_counting_reader(7), 3, drop_last=True)()) == [
+        [0, 1, 2], [3, 4, 5]
+    ]
+
+
+def test_dataset_schemas():
+    img, lbl = next(mnist.train()())
+    assert img.shape == (784,) and img.dtype == np.float32
+    assert -1.0 <= img.min() and img.max() <= 1.0 and 0 <= lbl <= 9
+
+    img, lbl = next(cifar.train10()())
+    assert img.shape == (3072,) and 0 <= lbl <= 9
+    _, lbl100 = next(cifar.train100()())
+    assert 0 <= lbl100 <= 99
+
+    x, y = next(uci_housing.train()())
+    assert x.shape == (13,) and y.shape == (1,)
+
+    d = imdb.word_dict()
+    seq, lbl = next(imdb.train(d)())
+    assert isinstance(seq, list) and all(0 <= w < len(d) for w in seq)
+    assert lbl in (0, 1)
+
+    wd = imikolov.build_dict()
+    gram = next(imikolov.train(wd, 5)())
+    assert len(gram) == 5 and all(0 <= w < len(wd) for w in gram)
+
+    sample = next(movielens.train()())
+    assert len(sample) == 8 and 1.0 <= sample[-1] <= 5.0
+
+    src, trg, trg_next = next(wmt16.train(1000, 1000)())
+    assert trg[0] == 0 and trg_next[-1] == 1  # <s> prefix / <e> suffix
+    assert len(trg) == len(trg_next) == len(src) + 1
+
+    src, trg, trg_next = next(wmt14.train(1000)())
+    assert len(trg) == len(trg_next)
+
+    s = next(conll05.test()())
+    assert len(s) == 9 and len(set(map(len, s))) == 1  # aligned columns
+
+    seq, lbl = next(sentiment.train()())
+    assert lbl in (0, 1)
+
+
+def test_datasets_deterministic():
+    a = [lbl for _, lbl in rd.firstn(mnist.train(), 20)()]
+    b = [lbl for _, lbl in rd.firstn(mnist.train(), 20)()]
+    assert a == b
+
+
+def test_data_feeder_dense():
+    x = layers.data(name="x", shape=[4])
+    y = layers.data(name="y", shape=[1], dtype="int64")
+    feeder = fluid.DataFeeder(feed_list=[x, y], place=fluid.CPUPlace())
+    minibatch = [(np.ones(4, np.float64), 3), (np.zeros(4, np.float64), 7)]
+    feed = feeder.feed(minibatch)
+    assert feed["x"].shape == (2, 4) and feed["x"].dtype == np.float32
+    assert feed["y"].shape == (2, 1) and feed["y"].dtype == np.int64
+    np.testing.assert_array_equal(feed["y"].ravel(), [3, 7])
+
+
+def test_data_feeder_sequences_pad_and_lens():
+    s = layers.data(name="s", shape=[1], dtype="int64", lod_level=1)
+    y = layers.data(name="y", shape=[1], dtype="int64")
+    feeder = fluid.DataFeeder(feed_list=[s, y], place=fluid.CPUPlace())
+    feed = feeder.feed([([1, 2, 3], 0), ([4], 1)])
+    assert feed["s"].shape == (2, 3)
+    np.testing.assert_array_equal(feed["s.lens"], [3, 1])
+    np.testing.assert_array_equal(feed["s"][1], [4, 0, 0])
+
+
+def test_data_feeder_trains_mnist_reader():
+    """The canonical reference loop: dataset -> shuffle -> batch -> feeder
+    -> executor, loss decreases."""
+    img = layers.data(name="img", shape=[784])
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    h = layers.fc(input=img, size=64, act="relu")
+    loss = layers.mean(layers.softmax_with_cross_entropy(
+        layers.fc(input=h, size=10), label))
+    fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    feeder = fluid.DataFeeder(feed_list=[img, label], place=fluid.CPUPlace())
+    train_reader = fluid.batch(
+        rd.shuffle(rd.firstn(mnist.train(), 512), buf_size=512),
+        batch_size=64, drop_last=True)
+    losses = []
+    for epoch in range(4):
+        for minibatch in train_reader():
+            (lv,) = exe.run(feed=feeder.feed(minibatch), fetch_list=[loss])
+            losses.append(float(lv))
+    assert np.mean(losses[-8:]) < np.mean(losses[:8])
