@@ -1,0 +1,107 @@
+"""Headline benchmark: decoder-only transformer LM training throughput.
+
+Prints ONE JSON line: {"metric", "value" (tokens/sec/chip), "unit",
+"vs_baseline"} where vs_baseline = achieved_MFU / 0.50 (the north-star 50%
+MFU target from BASELINE.json; the reference publishes no numbers).
+
+The whole training step (fwd + bwd + Adam) is one donated jax.jit XLA
+computation produced by tracing the Program — see executor.py.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+# model config (fits a single v5e chip with Adam state in fp32)
+BATCH, SEQ = 8, 1024
+VOCAB = 32768
+N_LAYER, N_HEAD, D_MODEL, D_INNER = 12, 16, 1024, 4096
+WARMUP, STEPS = 3, 12
+
+_PEAK_FLOPS = {
+    # bf16 peak matmul FLOP/s per chip
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v4": 275e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,   # v6e / Trillium
+}
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "")
+    for k, v in _PEAK_FLOPS.items():
+        if kind.startswith(k):
+            return v
+    return 197e12
+
+
+def _train_flops_per_step() -> float:
+    """Analytic matmul FLOPs for fwd+bwd (bwd = 2x fwd)."""
+    tokens = BATCH * SEQ
+    # per-layer matmul params: qkv+out (4 d^2) + mlp (2 d d_inner)
+    p_layer = 4 * D_MODEL * D_MODEL + 2 * D_MODEL * D_INNER
+    p_mm = N_LAYER * p_layer + VOCAB * D_MODEL  # + lm head
+    fwd = 2.0 * tokens * p_mm
+    # attention scores + context: 2 * (2 B H T^2 Dh) per layer
+    fwd += N_LAYER * 4.0 * BATCH * SEQ * SEQ * D_MODEL
+    return 3.0 * fwd
+
+
+def main():
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers, models, optimizer
+
+    dev = jax.devices()[0]
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup.random_seed = 1
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(main_p, startup):
+        with fluid.unique_name.guard():
+            ids = layers.data(name="ids", shape=[BATCH, SEQ], dtype="int64",
+                              append_batch_size=False)
+            labels = layers.data(name="labels", shape=[BATCH, SEQ],
+                                 dtype="int64", append_batch_size=False)
+            loss, _ = models.transformer.transformer_lm(
+                ids, labels, vocab_size=VOCAB, n_layer=N_LAYER, n_head=N_HEAD,
+                d_model=D_MODEL, d_inner=D_INNER, max_len=SEQ)
+            optimizer.Adam(learning_rate=1e-4).minimize(loss)
+
+        exe = fluid.Executor(fluid.TPUPlace() if dev.platform != "cpu"
+                             else fluid.CPUPlace())
+        exe.run(startup)
+
+        r = np.random.RandomState(0)
+        feed = {
+            "ids": r.randint(0, VOCAB, (BATCH, SEQ)).astype(np.int64),
+            "labels": r.randint(0, VOCAB, (BATCH, SEQ)).astype(np.int64),
+        }
+        for _ in range(WARMUP):
+            exe.run(main_p, feed=feed, fetch_list=[loss])
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            out = exe.run(main_p, feed=feed, fetch_list=[loss])
+        dt = (time.perf_counter() - t0) / STEPS
+
+    tokens_per_sec = BATCH * SEQ / dt
+    mfu = _train_flops_per_step() / dt / _peak_flops(dev)
+    print(json.dumps({
+        "metric": "transformer_lm_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.50, 4),
+        "mfu": round(mfu, 4),
+        "step_ms": round(dt * 1e3, 2),
+        "loss": float(np.asarray(out[0]).reshape(-1)[0]),
+        "device": getattr(dev, "device_kind", dev.platform),
+        "config": {"batch": BATCH, "seq": SEQ, "vocab": VOCAB,
+                   "layers": N_LAYER, "d_model": D_MODEL},
+    }))
+
+
+if __name__ == "__main__":
+    main()

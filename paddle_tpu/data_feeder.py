@@ -108,7 +108,17 @@ class DataFeeder:
 
                 n = num_places or jax.device_count()
                 for item in reader():
-                    if drop_last and len(item) % n != 0:
+                    if len(item) % n != 0:
+                        if not drop_last:
+                            # reference semantics: an indivisible final
+                            # batch with drop_last=False is an error (the
+                            # dp sharding cannot scatter it)
+                            raise ValueError(
+                                "batch of %d samples is not divisible by "
+                                "the %d devices and drop_last=False; use "
+                                "drop_last=True or pad the dataset"
+                                % (len(item), n)
+                            )
                         item = item[: len(item) // n * n]
                         if not item:
                             continue

@@ -14,7 +14,10 @@ __all__ = [
 
 
 def _bilinear_resize(im: np.ndarray, h: int, w: int) -> np.ndarray:
-    """im: HWC float/uint8 -> (h, w, C)."""
+    """im: HWC (or HW grayscale) float/uint8 -> (h, w[, C])."""
+    gray = im.ndim == 2
+    if gray:
+        im = im[:, :, None]
     H, W = im.shape[:2]
     ys = (np.arange(h) + 0.5) * H / h - 0.5
     xs = (np.arange(w) + 0.5) * W / w - 0.5
@@ -27,7 +30,8 @@ def _bilinear_resize(im: np.ndarray, h: int, w: int) -> np.ndarray:
     im = im.astype(np.float32)
     top = im[y0][:, x0] * (1 - wx) + im[y0][:, x1] * wx
     bot = im[y1][:, x0] * (1 - wx) + im[y1][:, x1] * wx
-    return top * (1 - wy) + bot * wy
+    out = top * (1 - wy) + bot * wy
+    return out[:, :, 0] if gray else out
 
 
 def resize_short(im: np.ndarray, size: int) -> np.ndarray:
@@ -74,6 +78,8 @@ def simple_transform(im: np.ndarray, resize_size: int, crop_size: int,
             im = left_right_flip(im)
     else:
         im = center_crop(im, crop_size)
+    if im.ndim == 2:  # grayscale: add the channel axis before CHW
+        im = im[:, :, None]
     im = to_chw(im).astype(np.float32)
     if mean is not None:
         im -= np.asarray(mean, np.float32).reshape(-1, 1, 1)

@@ -17,6 +17,15 @@ import random as _random
 from queue import Queue
 from threading import Thread
 
+class _RaiseSignal:
+    """Carries a worker-thread exception to the consuming generator."""
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
+_Raise = _RaiseSignal
+
 __all__ = [
     "map_readers",
     "buffered",
@@ -113,15 +122,22 @@ def buffered(reader, size):
         q = Queue(maxsize=size)
 
         def read_worker():
-            for d in r:
-                q.put(d)
-            q.put(_End)
+            # a reader exception must reach the consumer, not kill the
+            # thread silently (which would leave the consumer blocked)
+            try:
+                for d in r:
+                    q.put(d)
+                q.put(_End)
+            except BaseException as exc:  # noqa: B036
+                q.put(_Raise(exc))
 
         t = Thread(target=read_worker)
         t.daemon = True
         t.start()
         e = q.get()
         while e is not _End:
+            if isinstance(e, _Raise):
+                raise e.exc
             yield e
             e = q.get()
 
@@ -147,35 +163,47 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
     end = XmapEndSignal()
 
     def read_worker(r, in_queue):
-        for i in r():
-            in_queue.put(i)
-        in_queue.put(end)
+        try:
+            for i in r():
+                in_queue.put(i)
+            in_queue.put(end)
+        except BaseException as exc:  # noqa: B036
+            in_queue.put(_Raise(exc))
 
     def order_read_worker(r, in_queue):
-        for i, d in enumerate(r()):
-            in_queue.put((i, d))
-        in_queue.put(end)
+        try:
+            for i, d in enumerate(r()):
+                in_queue.put((i, d))
+            in_queue.put(end)
+        except BaseException as exc:  # noqa: B036
+            in_queue.put(_Raise(exc))
 
     def handle_worker(in_queue, out_queue):
         sample = in_queue.get()
-        while not isinstance(sample, XmapEndSignal):
-            out_queue.put(mapper(sample))
-            sample = in_queue.get()
-        in_queue.put(end)
-        out_queue.put(end)
+        try:
+            while not isinstance(sample, (XmapEndSignal, _Raise)):
+                out_queue.put(mapper(sample))
+                sample = in_queue.get()
+        except BaseException as exc:  # noqa: B036
+            sample = _Raise(exc)
+        in_queue.put(sample if isinstance(sample, _Raise) else end)
+        out_queue.put(sample if isinstance(sample, _Raise) else end)
 
     def order_handle_worker(in_queue, out_queue, out_order):
         ins = in_queue.get()
-        while not isinstance(ins, XmapEndSignal):
-            order, sample = ins
-            result = mapper(sample)
-            while order != out_order[0]:
-                pass
-            out_queue.put(result)
-            out_order[0] += 1
-            ins = in_queue.get()
-        in_queue.put(end)
-        out_queue.put(end)
+        try:
+            while not isinstance(ins, (XmapEndSignal, _Raise)):
+                order, sample = ins
+                result = mapper(sample)
+                while order != out_order[0]:
+                    pass
+                out_queue.put(result)
+                out_order[0] += 1
+                ins = in_queue.get()
+        except BaseException as exc:  # noqa: B036
+            ins = _Raise(exc)
+        in_queue.put(ins if isinstance(ins, _Raise) else end)
+        out_queue.put(ins if isinstance(ins, _Raise) else end)
 
     def xreader():
         in_queue = Queue(buffer_size)
@@ -196,6 +224,8 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
         finish = 0
         while finish < process_num:
             sample = out_queue.get()
+            if isinstance(sample, _Raise):
+                raise sample.exc
             if isinstance(sample, XmapEndSignal):
                 finish += 1
             else:

@@ -30,13 +30,16 @@ def lib_path():
             digest = hashlib.sha1(f.read()).hexdigest()[:16]
         out = os.path.join(_HERE, "_ptrt_%s.so" % digest)
         if not os.path.exists(out):
+            # per-process temp name: concurrent first-use builds (e.g.
+            # pytest workers) must not clobber each other's half-written .so
+            tmp = "%s.%d.tmp" % (out, os.getpid())
             cmd = [
                 "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-                _SRC, "-o", out + ".tmp", "-lz",
+                _SRC, "-o", tmp, "-lz",
             ]
             try:
                 subprocess.run(cmd, check=True, capture_output=True, text=True)
-                os.replace(out + ".tmp", out)
+                os.replace(tmp, out)
             except (subprocess.CalledProcessError, OSError) as e:
                 _build_error = getattr(e, "stderr", None) or str(e)
                 return None

@@ -280,25 +280,41 @@ class Channel:
     def __init__(self, capacity: int = 64):
         self._lib = _load()
         if self._lib is None:
-            import queue
+            import collections
+            import threading
 
-            self._q = queue.Queue(maxsize=capacity)
+            # native semantics: send blocks when full (False once closed),
+            # recv blocks when empty (None once closed AND drained), and
+            # close() wakes every blocked sender/receiver.
+            self._dq = collections.deque()
+            self._cap = capacity
             self._closed = False
+            self._cv = threading.Condition()
         else:
             self._h = self._lib.ptrt_chan_create(capacity)
 
     def send(self, data: bytes) -> bool:
         if self._lib is None:
-            if self._closed:
-                return False
-            self._q.put(bytes(data))
-            return True
+            with self._cv:
+                while len(self._dq) >= self._cap and not self._closed:
+                    self._cv.wait()
+                if self._closed:
+                    return False
+                self._dq.append(bytes(data))
+                self._cv.notify_all()
+                return True
         return self._lib.ptrt_chan_send(self._h, data, len(data)) == 0
 
     def recv(self) -> Optional[bytes]:
         if self._lib is None:
-            item = self._q.get()
-            return item
+            with self._cv:
+                while not self._dq and not self._closed:
+                    self._cv.wait()
+                if not self._dq:
+                    return None  # closed and drained
+                item = self._dq.popleft()
+                self._cv.notify_all()
+                return item
         buf = ctypes.POINTER(ctypes.c_char)()
         n = self._lib.ptrt_chan_recv(self._h, ctypes.byref(buf))
         if n < 0:
@@ -307,12 +323,15 @@ class Channel:
 
     def qsize(self) -> int:
         if self._lib is None:
-            return self._q.qsize()
+            with self._cv:
+                return len(self._dq)
         return self._lib.ptrt_chan_size(self._h)
 
     def close(self):
         if self._lib is None:
-            self._closed = True
+            with self._cv:
+                self._closed = True
+                self._cv.notify_all()
         else:
             self._lib.ptrt_chan_close(self._h)
 
