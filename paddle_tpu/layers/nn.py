@@ -91,6 +91,16 @@ __all__ = [
     "bilinear_tensor_product",
     "elementwise_add",
     "sum",
+    "linear_chain_crf",
+    "crf_decoding",
+    "chunk_eval",
+    "edit_distance",
+    "ctc_greedy_decoder",
+    "warpctc",
+    "nce",
+    "hsigmoid",
+    "beam_search",
+    "beam_search_decode",
 ]
 
 from .ops import elementwise_add  # re-export for parity
@@ -1676,3 +1686,271 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
         attrs={"maxlen": maxlen, "out_dtype": convert_dtype(dtype)},
     )
     return out
+
+
+# ---------------------------------------------------------------------------
+# structured prediction / decoding (kernels: ops/decode.py)
+# ---------------------------------------------------------------------------
+
+
+def linear_chain_crf(input, label, param_attr=None, sequence_length=None):
+    """reference nn.py:linear_chain_crf — CRF negative log-likelihood.
+    `input` is dense (B, T, num_tags) emissions (the reference takes LoD'd
+    (sum_len, num_tags)); `sequence_length` masks padding. The transition
+    parameter has shape [num_tags + 2, num_tags] (rows 0/1 = start/end)."""
+    helper = LayerHelper("linear_chain_crf", **locals())
+    size = input.shape[-1]
+    transition = helper.create_parameter(
+        attr=helper.param_attr, shape=[size + 2, size], dtype=helper.input_dtype()
+    )
+    b, t = input.shape[0], input.shape[1]
+    alpha = helper.create_variable_for_type_inference(
+        dtype=helper.input_dtype(), shape=(b, t, size))
+    log_likelihood = helper.create_variable_for_type_inference(
+        dtype=helper.input_dtype(), shape=(b, 1))
+    inputs = {"Emission": [input], "Transition": [transition], "Label": [label]}
+    if sequence_length is not None:
+        inputs["Lengths"] = [sequence_length]
+    helper.append_op(
+        type="linear_chain_crf",
+        inputs=inputs,
+        outputs={"Alpha": [alpha], "LogLikelihood": [log_likelihood]},
+    )
+    return log_likelihood
+
+
+def crf_decoding(input, param_attr, label=None, sequence_length=None):
+    """reference nn.py:crf_decoding — Viterbi decode with the transition
+    parameter learned by linear_chain_crf (pass the same ParamAttr name).
+    With `label`, emits per-token 0/1 correctness for chunk_eval."""
+    helper = LayerHelper("crf_decoding", **locals())
+    size = input.shape[-1]
+    transition = helper.create_parameter(
+        attr=helper.param_attr, shape=[size + 2, size], dtype=helper.input_dtype()
+    )
+    viterbi_path = helper.create_variable_for_type_inference(
+        dtype="int32", shape=(input.shape[0], input.shape[1]))
+    inputs = {"Emission": [input], "Transition": [transition]}
+    if label is not None:
+        inputs["Label"] = [label]
+    if sequence_length is not None:
+        inputs["Lengths"] = [sequence_length]
+    helper.append_op(
+        type="crf_decoding", inputs=inputs,
+        outputs={"ViterbiPath": [viterbi_path]},
+    )
+    return viterbi_path
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, sequence_length=None):
+    """reference nn.py:chunk_eval — precision/recall/F1 of chunk detection
+    (IOB/IOE/IOBES/plain). Returns (precision, recall, f1, num_infer,
+    num_label, num_correct)."""
+    helper = LayerHelper("chunk_eval", **locals())
+    precision = helper.create_variable_for_type_inference("float32", shape=())
+    recall = helper.create_variable_for_type_inference("float32", shape=())
+    f1_score = helper.create_variable_for_type_inference("float32", shape=())
+    num_infer = helper.create_variable_for_type_inference("int64", shape=())
+    num_label = helper.create_variable_for_type_inference("int64", shape=())
+    num_correct = helper.create_variable_for_type_inference("int64", shape=())
+    inputs = {"Inference": [input], "Label": [label]}
+    if sequence_length is not None:
+        inputs["Lengths"] = [sequence_length]
+    helper.append_op(
+        type="chunk_eval",
+        inputs=inputs,
+        outputs={
+            "Precision": [precision], "Recall": [recall],
+            "F1-Score": [f1_score], "NumInferChunks": [num_infer],
+            "NumLabelChunks": [num_label], "NumCorrectChunks": [num_correct],
+        },
+        attrs={
+            "chunk_scheme": chunk_scheme,
+            "num_chunk_types": num_chunk_types,
+            "excluded_chunk_types": excluded_chunk_types or [],
+        },
+    )
+    return precision, recall, f1_score, num_infer, num_label, num_correct
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    """reference nn.py:edit_distance — batch Levenshtein distance between
+    dense (B, L) hyp/ref token tensors. Returns (distance (B,1), seq_num)."""
+    helper = LayerHelper("edit_distance", **locals())
+    out = helper.create_variable_for_type_inference(
+        "float32", shape=(input.shape[0], 1))
+    seq_num = helper.create_variable_for_type_inference("int64", shape=())
+    inputs = {"Hyps": [input], "Refs": [label]}
+    if input_length is not None:
+        inputs["HypsLengths"] = [input_length]
+    if label_length is not None:
+        inputs["RefsLengths"] = [label_length]
+    helper.append_op(
+        type="edit_distance",
+        inputs=inputs,
+        outputs={"Out": [out], "SequenceNum": [seq_num]},
+        attrs={"normalized": normalized,
+               "ignored_tokens": list(ignored_tokens or [])},
+    )
+    return out, seq_num
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, name=None):
+    """reference nn.py:ctc_greedy_decoder — argmax, merge repeats, drop
+    blanks. Returns (decoded (B, T) zero-padded, decoded_lengths (B,))."""
+    helper = LayerHelper("ctc_greedy_decoder", name=name)
+    out = helper.create_variable_for_type_inference(
+        "int32", shape=(input.shape[0], input.shape[1]))
+    out_len = helper.create_variable_for_type_inference(
+        "int32", shape=(input.shape[0],))
+    inputs = {"Input": [input]}
+    if input_length is not None:
+        inputs["Lengths"] = [input_length]
+    helper.append_op(
+        type="ctc_greedy_decoder",
+        inputs=inputs,
+        outputs={"Out": [out], "OutLengths": [out_len]},
+        attrs={"blank": blank},
+    )
+    return out, out_len
+
+
+def warpctc(input, label, blank=0, norm_by_times=False, input_length=None,
+            label_length=None):
+    """reference nn.py:warpctc — CTC loss on (B, T, C) unnormalized logits
+    and dense (B, L) labels; differentiable (lax.scan alpha recursion
+    replaces the warp-ctc CUDA kernel)."""
+    helper = LayerHelper("warpctc", **locals())
+    loss = helper.create_variable_for_type_inference(
+        helper.input_dtype(), shape=(input.shape[0], 1))
+    inputs = {"Logits": [input], "Label": [label]}
+    if input_length is not None:
+        inputs["LogitsLengths"] = [input_length]
+    if label_length is not None:
+        inputs["LabelLengths"] = [label_length]
+    helper.append_op(
+        type="warpctc",
+        inputs=inputs,
+        outputs={"Loss": [loss]},
+        attrs={"blank": blank, "norm_by_times": norm_by_times},
+    )
+    return loss
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=None):
+    """reference nn.py:nce — noise-contrastive estimation loss with a
+    uniform negative sampler."""
+    helper = LayerHelper("nce", **locals())
+    dim = input.shape[-1]
+    weight = helper.create_parameter(
+        attr=helper.param_attr, shape=[num_total_classes, dim],
+        dtype=input.dtype)
+    bias = helper.create_parameter(
+        attr=bias_attr, shape=[num_total_classes], dtype=input.dtype,
+        is_bias=True)
+    cost = helper.create_variable_for_type_inference(
+        input.dtype, shape=(input.shape[0], 1))
+    inputs = {"Input": [input], "Label": [label], "Weight": [weight]}
+    if bias is not None:
+        inputs["Bias"] = [bias]
+    if sample_weight is not None:
+        inputs["SampleWeight"] = [sample_weight]
+    helper.append_op(
+        type="nce",
+        inputs=inputs,
+        outputs={"Cost": [cost]},
+        attrs={"num_total_classes": num_total_classes,
+               "num_neg_samples": num_neg_samples or 10},
+    )
+    return cost
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None):
+    """reference nn.py:hsigmoid — hierarchical sigmoid over a complete
+    binary tree of classes."""
+    helper = LayerHelper("hsigmoid", **locals())
+    dim = input.shape[-1]
+    weights = helper.create_parameter(
+        attr=helper.param_attr, shape=[num_classes - 1, dim],
+        dtype=input.dtype)
+    bias = helper.create_parameter(
+        attr=bias_attr, shape=[num_classes - 1], dtype=input.dtype,
+        is_bias=True)
+    out = helper.create_variable_for_type_inference(
+        input.dtype, shape=(input.shape[0], 1))
+    inputs = {"X": [input], "W": [weights], "Label": [label]}
+    if bias is not None:
+        inputs["Bias"] = [bias]
+    helper.append_op(
+        type="hierarchical_sigmoid",
+        inputs=inputs,
+        outputs={"Out": [out]},
+        attrs={"num_classes": num_classes},
+    )
+    return out
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id, level=0,
+                name=None):
+    """reference nn.py:beam_search — one decode step over dense (B, K)
+    beams. `scores` are ACCUMULATED log-probs (B, K, V); finished beams
+    (pre_id == end_id) only propose end_id with their score unchanged.
+    Returns (selected_ids, selected_scores, parent_idx), each (B, beam_size).
+    `level` is accepted for source compatibility (LoD levels do not exist
+    in the dense layout)."""
+    helper = LayerHelper("beam_search", name=name)
+    b = pre_ids.shape[0]
+    sel_ids = helper.create_variable_for_type_inference(
+        "int32", shape=(b, beam_size))
+    sel_scores = helper.create_variable_for_type_inference(
+        scores.dtype, shape=(b, beam_size))
+    parent_idx = helper.create_variable_for_type_inference(
+        "int32", shape=(b, beam_size))
+    inputs = {"pre_ids": [pre_ids], "pre_scores": [pre_scores],
+              "scores": [scores]}
+    if ids is not None:
+        inputs["ids"] = [ids]
+    helper.append_op(
+        type="beam_search",
+        inputs=inputs,
+        outputs={"selected_ids": [sel_ids], "selected_scores": [sel_scores],
+                 "parent_idx": [parent_idx]},
+        attrs={"beam_size": beam_size, "end_id": end_id},
+    )
+    return sel_ids, sel_scores, parent_idx
+
+
+def beam_search_decode(ids, scores, beam_size=None, end_id=0, parent_idx=None,
+                       name=None):
+    """reference nn.py:beam_search_decode — backtrack the stacked per-step
+    beam selections. `ids`/`scores` are (steps, B, K) stacks of the
+    per-step beam_search outputs (the reference's LoD TensorArrays) and
+    `parent_idx` the matching (steps, B, K) parent pointers. Returns
+    (sentence_ids (B, K, steps), sentence_scores (B, K))."""
+    if parent_idx is None:
+        raise ValueError(
+            "beam_search_decode needs the stacked parent_idx produced by "
+            "beam_search (dense backtracking replaces LoD lineage)")
+    helper = LayerHelper("beam_search_decode", name=name)
+    s, b, k = ids.shape
+    sent_ids = helper.create_variable_for_type_inference(
+        "int32", shape=(b, k, s))
+    sent_lens = helper.create_variable_for_type_inference(
+        "int32", shape=(b, k))
+    outputs = {"SentenceIds": [sent_ids], "SentenceLengths": [sent_lens]}
+    inputs = {"Ids": [ids], "ParentIdx": [parent_idx]}
+    if scores is not None:
+        sent_scores = helper.create_variable_for_type_inference(
+            scores.dtype, shape=(b, k))
+        inputs["Scores"] = [scores]
+        outputs["SentenceScores"] = [sent_scores]
+    helper.append_op(
+        type="beam_search_decode", inputs=inputs, outputs=outputs,
+        attrs={"end_id": end_id},
+    )
+    if scores is not None:
+        return sent_ids, sent_scores
+    return sent_ids, sent_lens

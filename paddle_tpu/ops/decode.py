@@ -1,0 +1,555 @@
+"""Structured prediction + decoding kernels: CRF, CTC, edit distance,
+chunk evaluation, NCE, hierarchical sigmoid, beam search.
+
+Reference ops: linear_chain_crf_op.h, crf_decoding_op.h, warpctc_op.cc,
+ctc_align_op / edit_distance_op.cc, chunk_eval_op.h, nce_op.h,
+hierarchical_sigmoid_op.h, beam_search_op.cc, beam_search_decode_op.cc.
+
+TPU-first design: every kernel is a batch-vectorized pure function on dense
+padded (B, T, ...) tensors with explicit length companions (the reference
+walks LoD'd sequences one by one on the CPU). Recurrences (CRF forward /
+viterbi, CTC alpha, edit-distance wavefront, beam backtracking) are
+``lax.scan`` loops with static trip counts, so the whole thing compiles to
+one XLA computation and differentiates with ``jax.vjp`` where it is a loss
+(linear_chain_crf, warpctc, nce, hsigmoid).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+_NEG = -1e30
+
+
+def _lengths_or_full(lengths, b, t):
+    if lengths is None:
+        return jnp.full((b,), t, jnp.int32)
+    return lengths.reshape(-1).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# linear-chain CRF
+# ---------------------------------------------------------------------------
+
+
+@register_op("linear_chain_crf")
+def _linear_chain_crf(ctx):
+    """Emission (B,T,N), Transition (N+2,N) [row0=start, row1=end, 2:=trans],
+    Label (B,T) -> LogLikelihood (B,1) = logZ - path_score (the reference's
+    positive per-sequence cost), Alpha (B,T,N) log-domain."""
+    x = ctx.input("Emission")
+    w = ctx.input("Transition")
+    label = ctx.input("Label")
+    if label.ndim == 3:
+        label = label[..., 0]
+    label = label.astype(jnp.int32)
+    b, t, n = x.shape
+    lengths = _lengths_or_full(ctx.input("Lengths"), b, t)
+    start_w, end_w, trans = w[0], w[1], w[2:]
+
+    # forward recursion in log space, frozen once t >= length
+    alpha0 = start_w[None, :] + x[:, 0, :]  # (B, N)
+
+    def step(carry, inp):
+        alpha = carry
+        xt, tt = inp
+        # (B, N_prev, 1) + (N_prev, N) -> logsumexp over prev
+        nxt = jax.nn.logsumexp(alpha[:, :, None] + trans[None], axis=1) + xt
+        alpha = jnp.where((tt < lengths)[:, None], nxt, alpha)
+        return alpha, alpha
+
+    xs = (jnp.moveaxis(x[:, 1:, :], 1, 0), jnp.arange(1, t))
+    alpha_last, alphas = lax.scan(step, alpha0, xs)
+    alpha_full = jnp.concatenate([alpha0[:, None], jnp.moveaxis(alphas, 0, 1)],
+                                 axis=1)
+    log_z = jax.nn.logsumexp(alpha_last + end_w[None, :], axis=1)
+
+    # numerator: score of the labeled path
+    tmask = (jnp.arange(t)[None, :] < lengths[:, None])
+    emit = jnp.take_along_axis(x, label[:, :, None], axis=2)[..., 0]
+    path = jnp.sum(jnp.where(tmask, emit, 0.0), axis=1)
+    path += start_w[label[:, 0]]
+    last = jnp.maximum(lengths - 1, 0)
+    path += end_w[jnp.take_along_axis(label, last[:, None], axis=1)[:, 0]]
+    pair = trans[label[:, :-1], label[:, 1:]]  # (B, T-1)
+    pmask = (jnp.arange(1, t)[None, :] < lengths[:, None])
+    path += jnp.sum(jnp.where(pmask, pair, 0.0), axis=1)
+
+    return {"LogLikelihood": (log_z - path)[:, None], "Alpha": alpha_full}
+
+
+@register_op("crf_decoding")
+def _crf_decoding(ctx):
+    """Viterbi decode. With Label given, emits per-token 0/1 correctness
+    (reference crf_decoding_op.cc doc) instead of the path itself."""
+    x = ctx.input("Emission")
+    w = ctx.input("Transition")
+    b, t, n = x.shape
+    lengths = _lengths_or_full(ctx.input("Lengths"), b, t)
+    start_w, end_w, trans = w[0], w[1], w[2:]
+
+    score0 = start_w[None, :] + x[:, 0, :]
+
+    def fwd(carry, inp):
+        score = carry
+        xt, tt = inp
+        tot = score[:, :, None] + trans[None]  # (B, prev, cur)
+        best = jnp.max(tot, axis=1) + xt
+        ptr = jnp.argmax(tot, axis=1).astype(jnp.int32)
+        nscore = jnp.where((tt < lengths)[:, None], best, score)
+        return nscore, ptr
+
+    xs = (jnp.moveaxis(x[:, 1:, :], 1, 0), jnp.arange(1, t))
+    score_last, ptrs = lax.scan(fwd, score0, xs)  # ptrs: (T-1, B, N)
+    best_last = jnp.argmax(score_last + end_w[None, :], axis=1).astype(jnp.int32)
+
+    # backtrack from position length-1 down to 0
+    def bwd(state, inp):
+        ptr_t, tt = inp  # ptr for transition t-1 -> t, t in [1, T)
+        prev = jnp.take_along_axis(ptr_t, state[:, None], axis=1)[:, 0]
+        # only follow the pointer while t < length (state at len-1 is the
+        # argmax end state; beyond the sequence keep it put)
+        nstate = jnp.where(tt < lengths, prev, state)
+        return nstate, nstate
+
+    ts = jnp.arange(t - 1, 0, -1)
+    _, rev_states = lax.scan(bwd, best_last, (ptrs[::-1], ts))
+    # rev_states[i] = state at time (t-2-i); full path:
+    path = jnp.concatenate(
+        [rev_states[::-1].T, best_last[:, None]], axis=1)  # (B, T)
+    # positions >= length-1 all hold best_last by construction; the true
+    # state at len-1 IS best_last, later positions are padding
+    tmask = jnp.arange(t)[None, :] < lengths[:, None]
+    path = jnp.where(tmask, path, 0).astype(jnp.int32)
+
+    label = ctx.input("Label")
+    if label is not None:
+        if label.ndim == 3:
+            label = label[..., 0]
+        ok = (path == label.astype(jnp.int32)) & tmask
+        return {"ViterbiPath": ok.astype(jnp.int32)}
+    return {"ViterbiPath": path}
+
+
+# ---------------------------------------------------------------------------
+# CTC
+# ---------------------------------------------------------------------------
+
+
+@register_op("ctc_greedy_decoder")
+def _ctc_greedy_decoder(ctx):
+    """Argmax, merge repeats, drop blanks; dense (B,T) out + lengths."""
+    x = ctx.input("Input")  # (B, T, C) probs or logits
+    blank = int(ctx.attr("blank", 0))
+    b, t, _ = x.shape
+    lengths = _lengths_or_full(ctx.input("Lengths"), b, t)
+
+    tok = jnp.argmax(x, axis=2).astype(jnp.int32)  # (B, T)
+    prev = jnp.concatenate([jnp.full((b, 1), -1, jnp.int32), tok[:, :-1]], 1)
+    inseq = jnp.arange(t)[None, :] < lengths[:, None]
+    keep = (tok != prev) & (tok != blank) & inseq
+    # left-compact the kept tokens: scatter to cumsum slots, drop the rest
+    pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    slot = jnp.where(keep, pos, t)  # t = out of range -> dropped
+
+    def compact(tk, sl):
+        return jnp.zeros((t,), jnp.int32).at[sl].set(tk, mode="drop")
+
+    out = jax.vmap(compact)(tok, slot)
+    out_len = jnp.sum(keep.astype(jnp.int32), axis=1)
+    return {"Out": out, "OutLengths": out_len}
+
+
+@register_op("warpctc")
+def _warpctc(ctx):
+    """CTC loss (log-space alpha recursion on the blank-extended label).
+    Logits (B,T,C) unnormalized, Label (B,L); differentiable via scan."""
+    logits = ctx.input("Logits")
+    label = ctx.input("Label")
+    if label.ndim == 3:
+        label = label[..., 0]
+    label = label.astype(jnp.int32)
+    blank = int(ctx.attr("blank", 0))
+    norm_by_times = bool(ctx.attr("norm_by_times", False))
+    b, t, c = logits.shape
+    l = label.shape[1]
+    logit_len = _lengths_or_full(ctx.input("LogitsLengths"), b, t)
+    label_len = _lengths_or_full(ctx.input("LabelLengths"), b, l)
+
+    logp = jax.nn.log_softmax(logits, axis=2)
+    s = 2 * l + 1
+    # extended label: blank at even s, label[(s-1)//2] at odd s
+    odd_idx = jnp.minimum((jnp.arange(s)[None, :] - 1) // 2, l - 1)
+    ext = jnp.where(jnp.arange(s)[None, :] % 2 == 1,
+                    jnp.take_along_axis(label, jnp.maximum(odd_idx, 0), axis=1),
+                    blank)  # (B, S)
+
+    # skip-connection allowed where z_s != blank and z_s != z_{s-2}
+    ext_m2 = jnp.concatenate([jnp.full((b, 2), -1, jnp.int32), ext[:, :-2]], 1)
+    can_skip = (ext != blank) & (ext != ext_m2)
+
+    lp_ext0 = jnp.take_along_axis(logp[:, 0, :], ext, axis=1)  # (B, S)
+    alpha0 = jnp.where(jnp.arange(s)[None, :] < 2, lp_ext0, _NEG)
+
+    def step(alpha, inp):
+        lp_t, tt = inp  # lp_t: (B, C)
+        lp_ext = jnp.take_along_axis(lp_t, ext, axis=1)  # (B, S)
+        a1 = jnp.concatenate([jnp.full((b, 1), _NEG), alpha[:, :-1]], 1)
+        a2 = jnp.concatenate([jnp.full((b, 2), _NEG), alpha[:, :-2]], 1)
+        a2 = jnp.where(can_skip, a2, _NEG)
+        m = jnp.maximum(jnp.maximum(alpha, a1), a2)
+        nxt = m + jnp.log(
+            jnp.exp(alpha - m) + jnp.exp(a1 - m) + jnp.exp(a2 - m)) + lp_ext
+        return jnp.where((tt < logit_len)[:, None], nxt, alpha), None
+
+    alpha_last, _ = lax.scan(
+        step, alpha0, (jnp.moveaxis(logp[:, 1:, :], 1, 0), jnp.arange(1, t)))
+
+    iS = 2 * label_len  # index of final blank
+    aS = jnp.take_along_axis(alpha_last, iS[:, None], axis=1)[:, 0]
+    aS1 = jnp.take_along_axis(
+        alpha_last, jnp.maximum(iS - 1, 0)[:, None], axis=1)[:, 0]
+    aS1 = jnp.where(label_len > 0, aS1, _NEG)
+    m = jnp.maximum(aS, aS1)
+    loss = -(m + jnp.log(jnp.exp(aS - m) + jnp.exp(aS1 - m)))
+    if norm_by_times:
+        loss = loss / jnp.maximum(logit_len, 1).astype(loss.dtype)
+    return {"Loss": loss[:, None]}
+
+
+# ---------------------------------------------------------------------------
+# edit distance
+# ---------------------------------------------------------------------------
+
+
+@register_op("edit_distance")
+def _edit_distance(ctx):
+    """Levenshtein distance via anti-diagonal wavefront (each diagonal
+    depends elementwise on the previous two, so the scan is vector-wide —
+    the row-by-row DP the reference runs is serial in both loops)."""
+    hyp = ctx.input("Hyps").astype(jnp.int32)
+    ref = ctx.input("Refs").astype(jnp.int32)
+    if hyp.ndim == 3:
+        hyp = hyp[..., 0]
+    if ref.ndim == 3:
+        ref = ref[..., 0]
+    b, m = hyp.shape
+    n = ref.shape[1]
+    hyp_len = _lengths_or_full(ctx.input("HypsLengths"), b, m)
+    ref_len = _lengths_or_full(ctx.input("RefsLengths"), b, n)
+    normalized = bool(ctx.attr("normalized", True))
+    ignored = list(ctx.attr("ignored_tokens", []) or [])
+
+    if ignored:
+        def drop(tokens, lens, width):
+            keep = jnp.ones_like(tokens, dtype=bool)
+            for tk in ignored:
+                keep &= tokens != int(tk)
+            keep &= jnp.arange(width)[None, :] < lens[:, None]
+            pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+            slot = jnp.where(keep, pos, width)
+
+            def compact(tk, sl):
+                return jnp.zeros((width,), jnp.int32).at[sl].set(tk, mode="drop")
+
+            return (jax.vmap(compact)(tokens, slot),
+                    jnp.sum(keep.astype(jnp.int32), axis=1))
+
+        hyp, hyp_len = drop(hyp, hyp_len, m)
+        ref, ref_len = drop(ref, ref_len, n)
+
+    big = jnp.float32(1e9)
+    i_idx = jnp.arange(m + 1)
+
+    # cost[i, j] for i>=1, j>=1 => hyp[i-1] != ref[j-1]
+    def boundary(k):
+        # d_k[i] = D[i, k-i]; D[0, j] = j, D[i, 0] = i (within bounds)
+        j = k - i_idx
+        d = jnp.where(i_idx == 0, j.astype(jnp.float32),
+                      jnp.where(j == 0, i_idx.astype(jnp.float32), big))
+        return jnp.where((j < 0) | (j > n), big, d)
+
+    d0 = jnp.broadcast_to(boundary(0), (b, m + 1))
+    d1 = jnp.broadcast_to(boundary(1), (b, m + 1))
+
+    def step(carry, k):
+        dm1, dm2 = carry  # d_{k-1}, d_{k-2}: (B, M+1)
+        j = k - i_idx  # (M+1,)
+        valid = (j >= 1) & (i_idx >= 1) & (j <= n)
+        jc = jnp.clip(j - 1, 0, n - 1)
+        sub = hyp[:, jnp.clip(i_idx - 1, 0, m - 1)] != ref[:, jc]
+        up = jnp.concatenate([jnp.full((b, 1), big), dm1[:, :-1]], 1)  # D[i-1,j]
+        left = dm1  # D[i, j-1]
+        diag = jnp.concatenate([jnp.full((b, 1), big), dm2[:, :-1]], 1)
+        d = jnp.minimum(jnp.minimum(up + 1, left + 1),
+                        diag + sub.astype(jnp.float32))
+        # boundaries D[0, k] = k and D[k, 0] = k live on this diagonal too
+        d = jnp.where(valid[None, :], d, boundary(k)[None, :])
+        return (d, dm1), d
+
+    ks = jnp.arange(2, m + n + 1)
+    _, diags = lax.scan(step, (d1, d0), ks)  # (m+n-1, B, M+1)
+    all_d = jnp.concatenate([d0[None], d1[None], diags], 0)  # (m+n+1, B, M+1)
+    k_fin = (hyp_len + ref_len).astype(jnp.int32)
+    dist = all_d[k_fin, jnp.arange(b), hyp_len]  # D[m_b, n_b]
+    if normalized:
+        dist = dist / jnp.maximum(ref_len, 1).astype(jnp.float32)
+    return {"Out": dist[:, None],
+            "SequenceNum": jnp.asarray(b, jnp.int32)}
+
+
+# ---------------------------------------------------------------------------
+# chunk evaluation
+# ---------------------------------------------------------------------------
+
+_SCHEMES = {
+    # num_tag_types, tag_begin, tag_inside, tag_end, tag_single
+    "IOB": (2, 0, 1, -1, -1),
+    "IOE": (2, -1, 0, 1, -1),
+    "IOBES": (4, 0, 1, 2, 3),
+    "plain": (1, -1, -1, -1, -1),
+}
+
+
+@register_op("chunk_eval")
+def _chunk_eval(ctx):
+    """Vectorized port of the reference's ChunkBegin/ChunkEnd automaton
+    (chunk_eval_op.h): both predicates are elementwise in (prev_tag,
+    prev_type, tag, type), so segments fall out of shifts + a reverse
+    cummin to find each chunk's end."""
+    inference = ctx.input("Inference")
+    label = ctx.input("Label")
+    if inference.ndim == 3:
+        inference = inference[..., 0]
+    if label.ndim == 3:
+        label = label[..., 0]
+    b, t = label.shape
+    lengths = _lengths_or_full(ctx.input("Lengths"), b, t)
+    scheme = ctx.attr("chunk_scheme", "IOB")
+    num_chunk_types = int(ctx.attr("num_chunk_types"))
+    excluded = list(ctx.attr("excluded_chunk_types", []) or [])
+    if scheme not in _SCHEMES:
+        raise ValueError("unknown chunk scheme %r" % scheme)
+    ntag, t_begin, t_inside, t_end, t_single = _SCHEMES[scheme]
+    other = num_chunk_types
+
+    def seq_info(tags):
+        tags = tags.astype(jnp.int32)
+        tag = tags % ntag
+        typ = tags // ntag
+        inseq = jnp.arange(t)[None, :] < lengths[:, None]
+        # out-of-sequence positions read as the 'other' (O) type
+        tag = jnp.where(inseq, tag, -1)
+        typ = jnp.where(inseq, typ, other)
+        ptag = jnp.concatenate([jnp.full((b, 1), -1, jnp.int32), tag[:, :-1]], 1)
+        ptyp = jnp.concatenate([jnp.full((b, 1), other, jnp.int32), typ[:, :-1]], 1)
+
+        def eq(a, v):
+            return a == v if v >= 0 else jnp.zeros_like(a, dtype=bool)
+
+        # ChunkBegin(prev_tag, prev_type, tag, type)
+        begin = jnp.where(
+            ptyp == other, typ != other,
+            jnp.where(
+                typ == other, False,
+                jnp.where(
+                    typ != ptyp, True,
+                    eq(tag, t_begin)
+                    | (eq(tag, t_inside) & (eq(ptag, t_end) | eq(ptag, t_single)))
+                    | (eq(tag, t_end) & (eq(ptag, t_end) | eq(ptag, t_single)))
+                    | eq(tag, t_single))))
+        # ChunkEnd fires at i for a chunk ending at i-1
+        end = jnp.where(
+            ptyp == other, False,
+            jnp.where(
+                typ == other, True,
+                jnp.where(
+                    typ != ptyp, True,
+                    (eq(ptag, t_begin) & (eq(tag, t_begin) | eq(tag, t_single)))
+                    | (eq(ptag, t_inside) & (eq(tag, t_begin) | eq(tag, t_single)))
+                    | eq(ptag, t_end) | eq(ptag, t_single))))
+        begin &= inseq
+        # a chunk is closed by an end trigger, a new begin, or sequence end
+        seq_end = jnp.arange(t)[None, :] >= lengths[:, None]
+        trigger = end | begin | seq_end
+        # next trigger index at or after i (reverse cummin)
+        idx = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None, :], (b, t))
+        nt = lax.associative_scan(
+            jnp.minimum, jnp.where(trigger, idx, t), axis=1, reverse=True)
+        # chunk starting at s ends at (next trigger after s) - 1
+        nt_after = jnp.concatenate([nt[:, 1:], jnp.full((b, 1), t, jnp.int32)], 1)
+        chunk_end = jnp.where(begin, jnp.minimum(nt_after, lengths[:, None]) - 1,
+                              -1)
+        counted = begin
+        for e in excluded:
+            counted &= typ != int(e)
+        return begin, chunk_end, typ, counted
+
+    lb, le, lt, lcount = seq_info(label)
+    ib, ie, it, icount = seq_info(inference)
+
+    num_label = jnp.sum(lcount.astype(jnp.int32))
+    num_infer = jnp.sum(icount.astype(jnp.int32))
+    correct = jnp.sum(
+        (lcount & icount & (lt == it) & (le == ie)).astype(jnp.int32))
+
+    nl = num_label.astype(jnp.float32)
+    ni = num_infer.astype(jnp.float32)
+    nc = correct.astype(jnp.float32)
+    precision = jnp.where(ni > 0, nc / jnp.maximum(ni, 1), 0.0)
+    recall = jnp.where(nl > 0, nc / jnp.maximum(nl, 1), 0.0)
+    f1 = jnp.where(nc > 0,
+                   2 * precision * recall / jnp.maximum(precision + recall, 1e-30),
+                   0.0)
+    return {"Precision": precision, "Recall": recall, "F1-Score": f1,
+            "NumInferChunks": num_infer, "NumLabelChunks": num_label,
+            "NumCorrectChunks": correct}
+
+
+# ---------------------------------------------------------------------------
+# NCE / hierarchical sigmoid
+# ---------------------------------------------------------------------------
+
+
+@register_op("nce")
+def _nce(ctx):
+    """Noise-contrastive estimation with a uniform negative sampler
+    (nce_op.h): cost = sum_true -log(o/(o+b)) + sum_neg -log(b/(o+b)),
+    b = num_neg / num_classes."""
+    x = ctx.input("Input")  # (B, D)
+    label = ctx.input("Label")  # (B, num_true)
+    w = ctx.input("Weight")  # (C, D)
+    bias = ctx.input("Bias")  # (C,) or None
+    sample_weight = ctx.input("SampleWeight")
+    num_total = int(ctx.attr("num_total_classes"))
+    num_neg = int(ctx.attr("num_neg_samples", 10))
+    if label.ndim == 1:
+        label = label[:, None]
+    bsz, num_true = label.shape
+
+    neg = jax.random.randint(ctx.rng(), (bsz, num_neg), 0, num_total)
+    samples = jnp.concatenate([label.astype(jnp.int32), neg], axis=1)
+    ws = w[samples]  # (B, S, D)
+    logits = jnp.einsum("bd,bsd->bs", x, ws)
+    if bias is not None:
+        logits = logits + bias.reshape(-1)[samples]
+    o = jax.nn.sigmoid(logits)
+    bconst = float(num_neg) / float(num_total)
+    eps = 1e-12
+    cost_true = -jnp.log(o[:, :num_true] / (o[:, :num_true] + bconst) + eps)
+    cost_neg = -jnp.log(bconst / (o[:, num_true:] + bconst) + eps)
+    cost = jnp.sum(cost_true, 1) + jnp.sum(cost_neg, 1)
+    if sample_weight is not None:
+        cost = cost * sample_weight.reshape(-1)
+    return {"Cost": cost[:, None]}
+
+
+@register_op("hierarchical_sigmoid")
+def _hsigmoid(ctx):
+    """Complete-binary-tree hierarchical softmax (hierarchical_sigmoid_op.h
+    + math/matrix_bit_code.h SimpleCode): class c encodes as c+num_classes;
+    internal-node index at depth j is (code >> (j+1)) - 1 and the branch
+    bit is (code >> j) & 1."""
+    x = ctx.input("X")  # (B, D)
+    w = ctx.input("W")  # (C-1, D)
+    bias = ctx.input("Bias")  # (C-1,) or None
+    label = ctx.input("Label")
+    if label.ndim == 2:
+        label = label[:, 0]
+    num_classes = int(ctx.attr("num_classes"))
+    code = label.astype(jnp.int32) + num_classes  # (B,)
+    max_len = int(num_classes - 1).bit_length()
+
+    # path length = bit_length(code) - 1 = #k>=1 with code >= 2^k
+    plen = jnp.zeros_like(code)
+    for k in range(1, max_len + 2):
+        plen = plen + (code >= (1 << k)).astype(jnp.int32)
+
+    js = jnp.arange(max_len + 1)
+    node = (code[:, None] >> (js[None, :] + 1)) - 1  # (B, J)
+    bit = ((code[:, None] >> js[None, :]) & 1).astype(x.dtype)
+    mask = (js[None, :] < plen[:, None]).astype(x.dtype)
+    node_c = jnp.clip(node, 0, w.shape[0] - 1)
+    pre = jnp.einsum("bd,bjd->bj", x, w[node_c])
+    if bias is not None:
+        pre = pre + bias.reshape(-1)[node_c]
+    # -[bit log s(pre) + (1-bit) log(1-s(pre))] = softplus(pre) - bit*pre
+    loss = jnp.sum(mask * (jax.nn.softplus(pre) - bit * pre), axis=1)
+    return {"Out": loss[:, None]}
+
+
+# ---------------------------------------------------------------------------
+# beam search
+# ---------------------------------------------------------------------------
+
+
+@register_op("beam_search")
+def _beam_search(ctx):
+    """One decode step: (B, K) beams x (B, K, V) accumulated scores ->
+    top-K continuations. Finished beams (pre_id == end_id) only propose
+    end_id, keeping their score (beam_search_op.cc semantics). Dense
+    replacement for the reference's LoD-based candidate selection."""
+    pre_ids = ctx.input("pre_ids")  # (B, K)
+    pre_scores = ctx.input("pre_scores")  # (B, K)
+    scores = ctx.input("scores")  # (B, K, V) accumulated log-probs
+    ids = ctx.input("ids")  # (B, K, V) candidate ids or None -> arange
+    beam_size = int(ctx.attr("beam_size"))
+    end_id = int(ctx.attr("end_id"))
+    if pre_ids.ndim == 3:
+        pre_ids = pre_ids[..., 0]
+    if pre_scores.ndim == 3:
+        pre_scores = pre_scores[..., 0]
+    b, k, v = scores.shape
+
+    finished = pre_ids.astype(jnp.int32) == end_id  # (B, K)
+    onehot_end = jnp.arange(v)[None, None, :] == end_id
+    # finished beams: only the end_id column, carrying the old score
+    cand = jnp.where(finished[:, :, None],
+                     jnp.where(onehot_end, pre_scores[:, :, None], _NEG),
+                     scores)
+    flat = cand.reshape(b, k * v)
+    top_scores, top_idx = lax.top_k(flat, beam_size)  # (B, K')
+    parent = (top_idx // v).astype(jnp.int32)
+    col = top_idx % v
+    if ids is None:
+        sel_ids = col.astype(jnp.int32)
+    else:
+        sel_ids = jnp.take_along_axis(
+            ids.reshape(b, k * v).astype(jnp.int32), top_idx, axis=1)
+    return {"selected_ids": sel_ids, "selected_scores": top_scores,
+            "parent_idx": parent}
+
+
+@register_op("beam_search_decode")
+def _beam_search_decode(ctx):
+    """Backtrack stacked per-step selections (S, B, K) through parent
+    pointers to full sentences (B, K, S) + lengths (first end_id wins)."""
+    ids = ctx.input("Ids").astype(jnp.int32)  # (S, B, K)
+    parents = ctx.input("ParentIdx").astype(jnp.int32)  # (S, B, K)
+    scores = ctx.input("Scores")  # (S, B, K) or None
+    end_id = int(ctx.attr("end_id"))
+    s, b, k = ids.shape
+
+    beam0 = jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32)[None, :], (b, k))
+
+    def back(beam, inp):
+        ids_t, par_t = inp  # (B, K) each
+        tok = jnp.take_along_axis(ids_t, beam, axis=1)
+        nbeam = jnp.take_along_axis(par_t, beam, axis=1)
+        return nbeam, tok
+
+    _, toks = lax.scan(back, beam0, (ids[::-1], parents[::-1]))
+    sent = jnp.moveaxis(toks[::-1], 0, 2)  # (B, K, S)
+    ended = sent == end_id
+    first_end = jnp.argmax(ended, axis=2)  # 0 if none
+    any_end = jnp.any(ended, axis=2)
+    lengths = jnp.where(any_end, first_end + 1, s).astype(jnp.int32)
+    out = {"SentenceIds": sent, "SentenceLengths": lengths}
+    if scores is not None:
+        out["SentenceScores"] = scores[-1]
+    return out

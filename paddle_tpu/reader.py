@@ -13,6 +13,7 @@ heavier double-buffer path is runtime/prefetch.py (C++ bounded channel).
 from __future__ import annotations
 
 import itertools
+import time as _time
 import random as _random
 from queue import Queue
 from threading import Thread
@@ -178,7 +179,17 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
         except BaseException as exc:  # noqa: B036
             in_queue.put(_Raise(exc))
 
-    def handle_worker(in_queue, out_queue):
+    def _relay(signal, in_queue, out_queue):
+        # out_queue FIRST (the consumer must unblock even if in_queue is
+        # full and no sibling will ever drain it); the in_queue relay to
+        # sibling workers is best-effort
+        out_queue.put(signal)
+        try:
+            in_queue.put_nowait(signal)
+        except Exception:
+            pass
+
+    def handle_worker(in_queue, out_queue, err):
         sample = in_queue.get()
         try:
             while not isinstance(sample, (XmapEndSignal, _Raise)):
@@ -186,24 +197,27 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                 sample = in_queue.get()
         except BaseException as exc:  # noqa: B036
             sample = _Raise(exc)
-        in_queue.put(sample if isinstance(sample, _Raise) else end)
-        out_queue.put(sample if isinstance(sample, _Raise) else end)
+        _relay(sample if isinstance(sample, _Raise) else end,
+               in_queue, out_queue)
 
-    def order_handle_worker(in_queue, out_queue, out_order):
+    def order_handle_worker(in_queue, out_queue, out_order, err):
         ins = in_queue.get()
         try:
             while not isinstance(ins, (XmapEndSignal, _Raise)):
                 order, sample = ins
                 result = mapper(sample)
-                while order != out_order[0]:
-                    pass
+                while order != out_order[0] and err[0] is None:
+                    _time.sleep(0)
+                if err[0] is not None:
+                    break
                 out_queue.put(result)
                 out_order[0] += 1
                 ins = in_queue.get()
         except BaseException as exc:  # noqa: B036
             ins = _Raise(exc)
-        in_queue.put(ins if isinstance(ins, _Raise) else end)
-        out_queue.put(ins if isinstance(ins, _Raise) else end)
+        if isinstance(ins, _Raise):
+            err[0] = ins.exc  # releases siblings spinning on out_order
+        _relay(ins if isinstance(ins, _Raise) else end, in_queue, out_queue)
 
     def xreader():
         in_queue = Queue(buffer_size)
@@ -214,8 +228,10 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
         t.daemon = True
         t.start()
         workers = []
+        err = [None]
         htarget = order_handle_worker if order else handle_worker
-        hargs = (in_queue, out_queue, out_order) if order else (in_queue, out_queue)
+        hargs = ((in_queue, out_queue, out_order, err) if order
+                 else (in_queue, out_queue, err))
         for _ in range(process_num):
             w = Thread(target=htarget, args=hargs)
             w.daemon = True

@@ -42,6 +42,10 @@ def lib_path():
                 os.replace(tmp, out)
             except (subprocess.CalledProcessError, OSError) as e:
                 _build_error = getattr(e, "stderr", None) or str(e)
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
                 return None
         # clean stale builds
         for entry in os.listdir(_HERE):

@@ -1,0 +1,399 @@
+"""Structured-prediction op tests (CRF, CTC, edit distance, chunk eval,
+NCE, hsigmoid, beam search) vs brute-force numpy / torch CPU references."""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _run(feeds, fetch_list):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    return exe.run(feed=feeds, fetch_list=fetch_list)
+
+
+# ---------------------------------------------------------------------------
+# CRF
+# ---------------------------------------------------------------------------
+
+
+def _crf_brute(emission, trans_full, label, length):
+    """Enumerate all paths; return (nll, best_path)."""
+    start_w, end_w, trans = trans_full[0], trans_full[1], trans_full[2:]
+    n = emission.shape[1]
+
+    def score(path):
+        s = start_w[path[0]] + emission[0, path[0]] + end_w[path[-1]]
+        for t in range(1, len(path)):
+            s += emission[t, path[t]] + trans[path[t - 1], path[t]]
+        return s
+
+    paths = list(itertools.product(range(n), repeat=length))
+    scores = np.array([score(p) for p in paths])
+    log_z = np.log(np.sum(np.exp(scores - scores.max()))) + scores.max()
+    nll = log_z - score(label[:length])
+    return nll, np.array(paths[int(np.argmax(scores))])
+
+
+def test_linear_chain_crf_matches_bruteforce():
+    b, t, n = 3, 5, 4
+    r = np.random.RandomState(0)
+    em = r.randn(b, t, n).astype(np.float32)
+    trans = (0.1 * r.randn(n + 2, n)).astype(np.float32)
+    lab = r.randint(0, n, (b, t)).astype(np.int64)
+    lens = np.array([5, 3, 4], np.int32)
+
+    emission = layers.data(name="em", shape=[b, t, n], append_batch_size=False)
+    label = layers.data(name="lab", shape=[b, t], dtype="int64",
+                        append_batch_size=False)
+    length = layers.data(name="len", shape=[b], dtype="int32",
+                         append_batch_size=False)
+    nll = layers.linear_chain_crf(
+        emission, label, param_attr=fluid.ParamAttr(name="crfw"),
+        sequence_length=length)
+    decoded = layers.crf_decoding(
+        emission, param_attr=fluid.ParamAttr(name="crfw"),
+        sequence_length=length)
+
+    scope = fluid.global_scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    scope.set_var("crfw", trans)
+    nll_v, dec_v = exe.run(feed={"em": em, "lab": lab, "len": lens},
+                           fetch_list=[nll, decoded])
+    for i in range(b):
+        want_nll, want_path = _crf_brute(em[i], trans, lab[i], int(lens[i]))
+        np.testing.assert_allclose(nll_v[i, 0], want_nll, rtol=1e-4, atol=1e-4)
+        np.testing.assert_array_equal(dec_v[i, :int(lens[i])], want_path)
+        assert (dec_v[i, int(lens[i]):] == 0).all()
+
+
+def test_crf_decoding_with_label_gives_correctness():
+    b, t, n = 2, 4, 3
+    r = np.random.RandomState(1)
+    em = r.randn(b, t, n).astype(np.float32)
+    emission = layers.data(name="em", shape=[b, t, n], append_batch_size=False)
+    label = layers.data(name="lab", shape=[b, t], dtype="int64",
+                        append_batch_size=False)
+    path = layers.crf_decoding(emission, param_attr=fluid.ParamAttr(name="w2"))
+    okvar = layers.crf_decoding(emission, param_attr=fluid.ParamAttr(name="w2"),
+                                label=label)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    p, = exe.run(feed={"em": em, "lab": np.zeros((b, t), np.int64)},
+                 fetch_list=[path])
+    ok, = exe.run(feed={"em": em, "lab": p.astype(np.int64)},
+                  fetch_list=[okvar])
+    assert (ok == 1).all()  # decoded vs itself is all-correct
+
+
+def test_crf_trains():
+    """CRF nll decreases under SGD on a fixed batch."""
+    b, t, n = 4, 6, 5
+    r = np.random.RandomState(2)
+    feed = {
+        "x": r.randn(b, t, 8).astype(np.float32),
+        "lab": r.randint(0, n, (b, t)).astype(np.int64),
+    }
+    x = layers.data(name="x", shape=[b, t, 8], append_batch_size=False)
+    label = layers.data(name="lab", shape=[b, t], dtype="int64",
+                        append_batch_size=False)
+    feat = layers.fc(x, n, num_flatten_dims=2)
+    nll = layers.linear_chain_crf(feat, label,
+                                  param_attr=fluid.ParamAttr(name="crfw3"))
+    loss = layers.reduce_mean(nll)
+    fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    vals = [float(exe.run(feed=feed, fetch_list=[loss])[0]) for _ in range(12)]
+    assert vals[-1] < vals[0]
+
+
+# ---------------------------------------------------------------------------
+# CTC
+# ---------------------------------------------------------------------------
+
+
+def test_warpctc_matches_torch():
+    torch = pytest.importorskip("torch")
+    b, t, c, l = 3, 12, 6, 4
+    r = np.random.RandomState(3)
+    logits = r.randn(b, t, c).astype(np.float32)
+    labels = r.randint(1, c, (b, l)).astype(np.int64)  # 0 is blank
+    logit_lens = np.array([12, 9, 10], np.int32)
+    label_lens = np.array([4, 2, 3], np.int32)
+
+    x = layers.data(name="x", shape=[b, t, c], append_batch_size=False)
+    lab = layers.data(name="lab", shape=[b, l], dtype="int64",
+                      append_batch_size=False)
+    xl = layers.data(name="xl", shape=[b], dtype="int32",
+                     append_batch_size=False)
+    ll = layers.data(name="ll", shape=[b], dtype="int32",
+                     append_batch_size=False)
+    loss = layers.warpctc(x, lab, blank=0, input_length=xl, label_length=ll)
+    out, = _run({"x": logits, "lab": labels, "xl": logit_lens, "ll": label_lens},
+                [loss])
+
+    tl = torch.nn.functional.ctc_loss(
+        torch.log_softmax(torch.tensor(logits).permute(1, 0, 2), dim=2),
+        torch.tensor(labels), torch.tensor(logit_lens.astype(np.int64)),
+        torch.tensor(label_lens.astype(np.int64)), blank=0, reduction="none")
+    np.testing.assert_allclose(out[:, 0], tl.numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_warpctc_trains():
+    b, t, c, l = 2, 10, 5, 3
+    r = np.random.RandomState(4)
+    feed = {
+        "x": r.randn(b, t, 8).astype(np.float32),
+        "lab": r.randint(1, c, (b, l)).astype(np.int64),
+    }
+    x = layers.data(name="x", shape=[b, t, 8], append_batch_size=False)
+    lab = layers.data(name="lab", shape=[b, l], dtype="int64",
+                      append_batch_size=False)
+    logits = layers.fc(x, c, num_flatten_dims=2)
+    loss = layers.reduce_mean(layers.warpctc(logits, lab, blank=0))
+    fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    vals = [float(exe.run(feed=feed, fetch_list=[loss])[0]) for _ in range(15)]
+    assert vals[-1] < vals[0]
+
+
+def test_ctc_greedy_decoder():
+    # probs argmax sequence: [1 1 0 2 2 0 0 3] (blank=0) -> [1 2 3]
+    seq = [1, 1, 0, 2, 2, 0, 0, 3]
+    t, c = len(seq), 4
+    probs = np.zeros((1, t, c), np.float32)
+    probs[0, np.arange(t), seq] = 1.0
+    x = layers.data(name="x", shape=[1, t, c], append_batch_size=False)
+    out, out_len = layers.ctc_greedy_decoder(x, blank=0)
+    o, ol = _run({"x": probs}, [out, out_len])
+    assert int(ol[0]) == 3
+    np.testing.assert_array_equal(o[0, :3], [1, 2, 3])
+
+
+# ---------------------------------------------------------------------------
+# edit distance
+# ---------------------------------------------------------------------------
+
+
+def _lev(a, b):
+    m, n = len(a), len(b)
+    d = np.zeros((m + 1, n + 1))
+    d[:, 0] = np.arange(m + 1)
+    d[0, :] = np.arange(n + 1)
+    for i in range(1, m + 1):
+        for j in range(1, n + 1):
+            d[i, j] = min(d[i - 1, j] + 1, d[i, j - 1] + 1,
+                          d[i - 1, j - 1] + (a[i - 1] != b[j - 1]))
+    return d[m, n]
+
+
+def test_edit_distance_matches_bruteforce():
+    b, lh, lr = 4, 7, 6
+    r = np.random.RandomState(5)
+    hyp = r.randint(1, 5, (b, lh)).astype(np.int64)
+    ref = r.randint(1, 5, (b, lr)).astype(np.int64)
+    hl = np.array([7, 4, 5, 1], np.int32)
+    rl = np.array([6, 6, 2, 3], np.int32)
+    x = layers.data(name="x", shape=[b, lh], dtype="int64",
+                    append_batch_size=False)
+    y = layers.data(name="y", shape=[b, lr], dtype="int64",
+                    append_batch_size=False)
+    xl = layers.data(name="xl", shape=[b], dtype="int32",
+                     append_batch_size=False)
+    yl = layers.data(name="yl", shape=[b], dtype="int32",
+                     append_batch_size=False)
+    dist, seq_num = layers.edit_distance(x, y, normalized=False,
+                                         input_length=xl, label_length=yl)
+    dv, sn = _run({"x": hyp, "y": ref, "xl": hl, "yl": rl}, [dist, seq_num])
+    assert int(sn) == b
+    for i in range(b):
+        want = _lev(list(hyp[i, :hl[i]]), list(ref[i, :rl[i]]))
+        assert dv[i, 0] == want, (i, dv[i, 0], want)
+
+
+def test_edit_distance_normalized_and_ignored():
+    x = layers.data(name="x", shape=[1, 4], dtype="int64",
+                    append_batch_size=False)
+    y = layers.data(name="y", shape=[1, 4], dtype="int64",
+                    append_batch_size=False)
+    dist, _ = layers.edit_distance(x, y, normalized=True, ignored_tokens=[9])
+    dv, = _run({"x": np.array([[1, 9, 2, 3]], np.int64),
+                "y": np.array([[1, 2, 9, 4]], np.int64)}, [dist])
+    # after dropping 9s: [1,2,3] vs [1,2,4] -> dist 1, normalized by ref len 3
+    np.testing.assert_allclose(dv[0, 0], 1.0 / 3, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# chunk eval
+# ---------------------------------------------------------------------------
+
+
+def test_chunk_eval_iob():
+    # 2 chunk types, IOB: tag = type*2 + {B:0, I:1}? No — reference layout is
+    # label = chunk_type * num_tag_types + tag_type; O = num_chunk_types*ntag
+    # types: PER=0, LOC=1;  B-PER=0, I-PER=1, B-LOC=2, I-LOC=3, O=4
+    B_PER, I_PER, B_LOC, I_LOC, O = 0, 1, 2, 3, 4
+    label = np.array([[B_PER, I_PER, O, B_LOC, I_LOC, O]], np.int64)
+    # inference: PER chunk correct, LOC chunk wrong extent
+    infer = np.array([[B_PER, I_PER, O, B_LOC, O, O]], np.int64)
+    x = layers.data(name="x", shape=[1, 6], dtype="int64",
+                    append_batch_size=False)
+    y = layers.data(name="y", shape=[1, 6], dtype="int64",
+                    append_batch_size=False)
+    res = layers.chunk_eval(x, y, chunk_scheme="IOB", num_chunk_types=2)
+    p, rec, f1, ni, nl, nc = _run({"x": infer, "y": label}, list(res))
+    assert int(ni) == 2 and int(nl) == 2 and int(nc) == 1
+    np.testing.assert_allclose(p, 0.5)
+    np.testing.assert_allclose(rec, 0.5)
+    np.testing.assert_allclose(f1, 0.5)
+
+
+def test_chunk_eval_lengths_and_excluded():
+    B_A, I_A, B_B, I_B, O = 0, 1, 2, 3, 4
+    label = np.array([[B_A, I_A, B_B, I_B, O, O]], np.int64)
+    infer = label.copy()
+    lens = np.array([4], np.int32)
+    x = layers.data(name="x", shape=[1, 6], dtype="int64",
+                    append_batch_size=False)
+    y = layers.data(name="y", shape=[1, 6], dtype="int64",
+                    append_batch_size=False)
+    sl = layers.data(name="sl", shape=[1], dtype="int32",
+                     append_batch_size=False)
+    res = layers.chunk_eval(x, y, chunk_scheme="IOB", num_chunk_types=2,
+                            excluded_chunk_types=[1], sequence_length=sl)
+    p, rec, f1, ni, nl, nc = _run({"x": infer, "y": label, "sl": lens},
+                                  list(res))
+    # type-1 (B) chunks excluded; only the type-0 chunk [0,1] counts
+    assert int(ni) == 1 and int(nl) == 1 and int(nc) == 1
+    np.testing.assert_allclose(f1, 1.0)
+
+
+# ---------------------------------------------------------------------------
+# NCE / hsigmoid
+# ---------------------------------------------------------------------------
+
+
+def test_nce_trains():
+    b, d, c = 8, 16, 50
+    r = np.random.RandomState(6)
+    feed = {
+        "x": r.randn(b, d).astype(np.float32),
+        "lab": r.randint(0, c, (b, 1)).astype(np.int64),
+    }
+    x = layers.data(name="x", shape=[b, d], append_batch_size=False)
+    lab = layers.data(name="lab", shape=[b, 1], dtype="int64",
+                      append_batch_size=False)
+    cost = layers.nce(x, lab, num_total_classes=c, num_neg_samples=5)
+    loss = layers.reduce_mean(cost)
+    fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    vals = [float(exe.run(feed=feed, fetch_list=[loss])[0]) for _ in range(15)]
+    assert np.isfinite(vals).all() and vals[-1] < vals[0]
+
+
+def test_hsigmoid_matches_manual():
+    b, d, c = 4, 8, 10
+    r = np.random.RandomState(7)
+    xv = r.randn(b, d).astype(np.float32)
+    wv = r.randn(c - 1, d).astype(np.float32)
+    bv = r.randn(c - 1).astype(np.float32)
+    labv = r.randint(0, c, (b, 1)).astype(np.int64)
+
+    x = layers.data(name="x", shape=[b, d], append_batch_size=False)
+    lab = layers.data(name="lab", shape=[b, 1], dtype="int64",
+                      append_batch_size=False)
+    out = layers.hsigmoid(x, lab, num_classes=c,
+                          param_attr=fluid.ParamAttr(name="hs_w"),
+                          bias_attr=fluid.ParamAttr(name="hs_b"))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    fluid.global_scope().set_var("hs_w", wv)
+    fluid.global_scope().set_var("hs_b", bv)
+    ov, = exe.run(feed={"x": xv, "lab": labv}, fetch_list=[out])
+
+    def softplus(z):
+        return np.log1p(np.exp(-abs(z))) + np.maximum(z, 0)
+
+    for i in range(b):
+        code = int(labv[i, 0]) + c
+        want = 0.0
+        length = code.bit_length() - 1
+        for j in range(length):
+            idx = (code >> (j + 1)) - 1
+            bit = (code >> j) & 1
+            pre = xv[i] @ wv[idx] + bv[idx]
+            want += softplus(pre) - bit * pre
+        np.testing.assert_allclose(ov[i, 0], want, rtol=1e-4, atol=1e-5)
+
+
+def test_hsigmoid_trains():
+    b, d, c = 8, 16, 12
+    r = np.random.RandomState(8)
+    feed = {
+        "x": r.randn(b, d).astype(np.float32),
+        "lab": r.randint(0, c, (b, 1)).astype(np.int64),
+    }
+    x = layers.data(name="x", shape=[b, d], append_batch_size=False)
+    lab = layers.data(name="lab", shape=[b, 1], dtype="int64",
+                      append_batch_size=False)
+    loss = layers.reduce_mean(layers.hsigmoid(x, lab, num_classes=c))
+    fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    vals = [float(exe.run(feed=feed, fetch_list=[loss])[0]) for _ in range(15)]
+    assert vals[-1] < vals[0]
+
+
+# ---------------------------------------------------------------------------
+# beam search
+# ---------------------------------------------------------------------------
+
+
+def test_beam_search_step():
+    b, k, v = 1, 2, 4
+    pre_ids = np.array([[1, 3]], np.int64)  # beam 1 finished (end_id=3)
+    pre_scores = np.array([[-1.0, -0.5]], np.float32)
+    # accumulated scores for beam 0's continuations; beam 1 is finished
+    scores = np.full((b, k, v), -10.0, np.float32)
+    scores[0, 0] = [-2.0, -0.3, -4.0, -9.0]
+
+    pi = layers.data(name="pi", shape=[b, k], dtype="int64",
+                     append_batch_size=False)
+    ps = layers.data(name="ps", shape=[b, k], append_batch_size=False)
+    sc = layers.data(name="sc", shape=[b, k, v], append_batch_size=False)
+    sel_ids, sel_scores, parent = layers.beam_search(
+        pi, ps, None, sc, beam_size=2, end_id=3)
+    si, ss, pa = _run({"pi": pre_ids, "ps": pre_scores, "sc": scores},
+                      [sel_ids, sel_scores, parent])
+    # best: beam 0 token 1 (-0.3); then finished beam 1 keeps end_id (-0.5)
+    np.testing.assert_array_equal(si[0], [1, 3])
+    np.testing.assert_allclose(ss[0], [-0.3, -0.5])
+    np.testing.assert_array_equal(pa[0], [0, 1])
+
+
+def test_beam_search_decode_backtracks():
+    # steps=3, B=1, K=2; chain: step2 beam0 <- step1 parent 1 <- step0 beam1
+    ids = np.array([[[5, 6]], [[7, 8]], [[9, 4]]], np.int64)  # (S,1,K)
+    parents = np.array([[[0, 1]], [[1, 0]], [[1, 0]]], np.int64)
+    scores = np.array([[[-1, -2]], [[-3, -4]], [[-5, -6]]], np.float32)
+    iv = layers.data(name="iv", shape=[3, 1, 2], dtype="int64",
+                     append_batch_size=False)
+    pv = layers.data(name="pv", shape=[3, 1, 2], dtype="int64",
+                     append_batch_size=False)
+    sv = layers.data(name="sv", shape=[3, 1, 2], append_batch_size=False)
+    sent, sscores = layers.beam_search_decode(iv, sv, end_id=4, parent_idx=pv)
+    sids, ssc = _run({"iv": ids, "pv": parents, "sv": scores}, [sent, sscores])
+    # beam 0 at last step: token 9, parent 1 -> step1 token 8, parent 0 ->
+    # step0 token 5
+    np.testing.assert_array_equal(sids[0, 0], [5, 8, 9])
+    # beam 1 at last step: token 4 (=end), parent 0 -> step1 token 7,
+    # parent 1 -> step0 token 6
+    np.testing.assert_array_equal(sids[0, 1], [6, 7, 4])
+    np.testing.assert_allclose(ssc[0], [-5, -6])
