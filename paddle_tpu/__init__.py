@@ -78,4 +78,20 @@ from .transpiler import (  # noqa: F401
     release_memory,
 )
 
+from . import metrics  # noqa: F401
+from . import evaluator  # noqa: F401
+from . import profiler  # noqa: F401
+from . import debugger  # noqa: F401
+from .framework.verifier import verify_program, ProgramVerifyError  # noqa: F401
+from .ops.registry import op_support_tpu, registered_ops, OpProtoHolder  # noqa: F401
+from .trainer import (  # noqa: F401
+    BeginEpochEvent,
+    BeginStepEvent,
+    CheckpointConfig,
+    EndEpochEvent,
+    EndStepEvent,
+    Inferencer,
+    Trainer,
+)
+
 __version__ = "0.1.0"

@@ -1,0 +1,94 @@
+"""Program debugging helpers: pretty-printer + graphviz drawer.
+
+Reference: python/paddle/fluid/debugger.py (pprint_program_codes,
+draw_block_graphviz). Operates on our Python-native Program IR instead of
+protobuf descs.
+"""
+from __future__ import annotations
+
+from .framework.core import Program, Variable
+
+__all__ = ["pprint_program_codes", "pprint_block_codes", "draw_block_graphviz"]
+
+
+def repr_var(var: Variable) -> str:
+    shape = "x".join(str(s) for s in (var.shape or ()))
+    kind = "param" if getattr(var, "trainable", None) is not None else (
+        "persist" if var.persistable else "var")
+    return "%s %s[%s] (%s)" % (kind, var.name, shape or "scalar", var.dtype)
+
+
+def repr_op(op) -> str:
+    outs = ", ".join(
+        "%s=%s" % (slot, "|".join(names)) for slot, names in op.outputs.items())
+    ins = ", ".join(
+        "%s=%s" % (slot, "|".join(names)) for slot, names in op.inputs.items())
+    attrs = ", ".join(
+        "%s=%r" % (k, v) for k, v in sorted(op.attrs.items())
+        if k not in ("op_callstack",))
+    s = "%s <- %s(%s)" % (outs or "()", op.type, ins)
+    if attrs:
+        s += "  {%s}" % attrs
+    return s
+
+
+def pprint_block_codes(block, show_backward=False) -> str:
+    lines = ["block %d (parent %s) {" % (block.idx, block.parent_idx)]
+    for var in block.vars.values():
+        if not show_backward and var.name.endswith("@GRAD"):
+            continue
+        lines.append("  " + repr_var(var))
+    lines.append("")
+    for i, op in enumerate(block.ops):
+        lines.append("  [%d] %s" % (i, repr_op(op)))
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def pprint_program_codes(program: Program, show_backward=False) -> str:
+    """Readable dump of every block (reference debugger.py:
+    pprint_program_codes prints; we also return the string)."""
+    text = "\n\n".join(
+        pprint_block_codes(b, show_backward) for b in program.blocks)
+    print(text)
+    return text
+
+
+def draw_block_graphviz(block, highlights=None, path="./temp.dot") -> str:
+    """Write a graphviz .dot of the block's op/var dataflow (reference
+    debugger.py:draw_block_graphviz). Render with `dot -Tpng`."""
+    highlights = set(highlights or ())
+
+    def vid(name):
+        return "var_" + name.replace("@", "_").replace(".", "_").replace("/", "_")
+
+    lines = ["digraph G {", "  rankdir=TB;"]
+    seen_vars = set()
+
+    def emit_var(name):
+        if name in seen_vars:
+            return
+        seen_vars.add(name)
+        var = block._find_var_recursive(name)
+        shape = "x".join(str(s) for s in (var.shape or ())) if var is not None else "?"
+        color = ', style=filled, fillcolor="#ffd2d2"' if name in highlights else (
+            ', style=filled, fillcolor="#d2e5ff"'
+            if var is not None and var.persistable else "")
+        lines.append('  %s [shape=oval, label="%s\\n(%s)"%s];'
+                     % (vid(name), name, shape, color))
+
+    for i, op in enumerate(block.ops):
+        oid = "op_%d" % i
+        lines.append('  %s [shape=box, style=filled, fillcolor="#e8e8e8", '
+                     'label="%d: %s"];' % (oid, i, op.type))
+        for name in op.input_arg_names:
+            emit_var(name)
+            lines.append("  %s -> %s;" % (vid(name), oid))
+        for name in op.output_arg_names:
+            emit_var(name)
+            lines.append("  %s -> %s;" % (oid, vid(name)))
+    lines.append("}")
+    dot = "\n".join(lines)
+    with open(path, "w") as f:
+        f.write(dot)
+    return dot

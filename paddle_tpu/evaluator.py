@@ -1,0 +1,99 @@
+"""Graph-building evaluators (deprecated in the reference in favor of
+fluid.metrics, kept for API parity).
+
+Reference: python/paddle/fluid/evaluator.py. The reference versions allocate
+accumulator *variables inside the program* and append sum ops; here the
+layer already returns per-batch counts as fetches, and accumulation happens
+host-side (the TPU step stays a pure function — mutable accumulators inside
+the graph would force un-donated state for a metric).
+"""
+from __future__ import annotations
+
+import warnings
+
+import numpy as np
+
+from . import layers
+
+__all__ = ["ChunkEvaluator", "EditDistance"]
+
+
+class Evaluator(object):
+    """Warn-on-use base matching evaluator.py:Evaluator."""
+
+    def __init__(self, name, **kwargs):
+        warnings.warn(
+            "fluid.evaluator.%s is deprecated, please use fluid.metrics.%s "
+            "instead." % (self.__class__.__name__, self.__class__.__name__))
+        self._name = name
+        self.states = []
+        self.metrics = []
+
+    def reset(self, executor, reset_program=None):
+        for s in self.states:
+            s.fill(0)
+
+    def eval(self, executor, eval_program=None):
+        raise NotImplementedError()
+
+
+class ChunkEvaluator(Evaluator):
+    """Builds a chunk_eval layer; update by fetching `self.metrics` each
+    step and passing the three counts to `update()`; `eval()` returns
+    (precision, recall, f1)."""
+
+    def __init__(self, input, label, chunk_scheme, num_chunk_types,
+                 excluded_chunk_types=None, sequence_length=None):
+        super().__init__("chunk_eval")
+        (precision, recall, f1_score, num_infer_chunks, num_label_chunks,
+         num_correct_chunks) = layers.chunk_eval(
+            input=input, label=label, chunk_scheme=chunk_scheme,
+            num_chunk_types=num_chunk_types,
+            excluded_chunk_types=excluded_chunk_types,
+            sequence_length=sequence_length)
+        self.metrics = [num_infer_chunks, num_label_chunks, num_correct_chunks]
+        self.precision = precision
+        self.recall = recall
+        self.f1_score = f1_score
+        self._acc = np.zeros(3, np.int64)
+        self.states = [self._acc]
+
+    def update(self, num_infer_chunks, num_label_chunks, num_correct_chunks):
+        self._acc += np.array(
+            [int(np.asarray(v).reshape(-1)[0])
+             for v in (num_infer_chunks, num_label_chunks, num_correct_chunks)],
+            np.int64)
+
+    def eval(self, executor=None, eval_program=None):
+        ni, nl, nc = (int(v) for v in self._acc)
+        precision = float(nc) / ni if ni else 0.0
+        recall = float(nc) / nl if nl else 0.0
+        f1 = 2 * precision * recall / (precision + recall) if nc else 0.0
+        return precision, recall, f1
+
+
+class EditDistance(Evaluator):
+    """Builds an edit_distance layer; fetch `self.metrics` per step into
+    `update()`; `eval()` returns (avg distance, instance error rate)."""
+
+    def __init__(self, input, label, ignored_tokens=None, input_length=None,
+                 label_length=None):
+        super().__init__("edit_distance")
+        distances, seq_num = layers.edit_distance(
+            input=input, label=label, ignored_tokens=ignored_tokens,
+            input_length=input_length, label_length=label_length)
+        self.metrics = [distances, seq_num]
+        self._total = np.zeros(3, np.float64)  # distance, seq_num, errors
+        self.states = [self._total]
+
+    def update(self, distances, seq_num):
+        d = np.asarray(distances, np.float64).reshape(-1)
+        n = int(np.asarray(seq_num).reshape(-1)[0])
+        self._total += np.array(
+            [float(d.sum()), n, n - int((d == 0).sum())], np.float64)
+
+    def eval(self, executor=None, eval_program=None):
+        dist, num, err = self._total
+        if num == 0:
+            raise ValueError("no data accumulated in EditDistance evaluator")
+        return dist / num, err / num

@@ -22,10 +22,16 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+import os
+import time
+import warnings
+
+from . import profiler
 from .framework.core import Program, Variable, default_main_program
 from .framework.dtypes import as_numpy_dtype
 from .framework.scope import CPUPlace, Place, Scope, global_scope
 from .framework.trace import RngStream, trace_block
+from .framework.verifier import verify_program
 
 __all__ = ["Executor"]
 
@@ -108,8 +114,15 @@ def build_step_fn(program: Program, fetch_names, state_in, state_out):
 
 
 class Executor:
-    def __init__(self, place: Optional[Place] = None):
+    """check_nan_inf=True (or env PADDLE_TPU_CHECK_NAN_INF=1) validates
+    every fetch and updated state var for NaN/Inf after each run — the
+    reference's FLAGS_check_nan_inf debug mode (framework/operator.cc)."""
+
+    def __init__(self, place: Optional[Place] = None, check_nan_inf: Optional[bool] = None):
         self.place = place if place is not None else CPUPlace()
+        if check_nan_inf is None:
+            check_nan_inf = os.environ.get("PADDLE_TPU_CHECK_NAN_INF", "0") == "1"
+        self.check_nan_inf = check_nan_inf
         self._cache: Dict = {}
         self._step = 0
         self._seed = 0
@@ -117,6 +130,12 @@ class Executor:
     # -- compilation -----------------------------------------------------
     def _compile(self, program: Program, feed_sig, fetch_names, scope: Scope) -> _Compiled:
         feed_names = tuple(n for n, _, _ in feed_sig)
+        # static pre-compile verification (SURVEY aux: race-detection
+        # equivalent): hard errors raise here with op context; write-once
+        # findings only warn
+        for kind, msg in verify_program(program, feed_names):
+            if kind == "write-once":
+                warnings.warn("program verifier: " + msg)
         state_in, state_out = analyze_state(program, set(feed_names))
         # state vars written before ever being read (pure init, e.g. startup
         # programs) need no input value
@@ -130,6 +149,29 @@ class Executor:
         stepfn = build_step_fn(program, fetch_names, state_in, state_out)
         fn = jax.jit(stepfn, donate_argnums=(1,))
         return _Compiled(fn, state_in, state_out, fetch_names, program)
+
+    @staticmethod
+    def _has_nan_inf(val) -> bool:
+        arr = np.asarray(val)
+        if np.issubdtype(arr.dtype, np.floating):
+            return not np.isfinite(arr).all()
+        if str(arr.dtype) in ("bfloat16", "float8_e4m3fn", "float8_e5m2"):
+            # ml_dtypes extension floats are not np.floating subtypes
+            return not np.isfinite(arr.astype(np.float32)).all()
+        return False
+
+    def _check_nan_inf(self, fetch_names, fetches, new_state):
+        bad = []
+        for name, val in zip(fetch_names, fetches):
+            if self._has_nan_inf(val):
+                bad.append("fetch %r" % name)
+        for name, val in new_state.items():
+            if self._has_nan_inf(val):
+                bad.append("var %r" % name)
+        if bad:
+            raise FloatingPointError(
+                "NaN/Inf detected after step %d in: %s (check_nan_inf mode)"
+                % (self._step - 1, ", ".join(bad)))
 
     # -- public API ------------------------------------------------------
     def run(
@@ -162,6 +204,9 @@ class Executor:
 
         key = (id(program), program._version, feed_sig, fetch_names)
         compiled = self._cache.get(key) if use_program_cache else None
+        if use_program_cache:
+            profiler.record_cache(compiled is not None)
+        first_run = compiled is None
         if compiled is None:
             compiled = self._compile(program, feed_sig, fetch_names, scope)
             if use_program_cache:
@@ -182,9 +227,23 @@ class Executor:
         rng_key = jax.random.fold_in(rng_key, self._step)
         self._step += 1
 
-        fetches, new_state = compiled.fn(feed_arrays, state, rng_key)
+        if profiler.is_profiling():
+            # jax.jit is lazy: trace + XLA compile all happen inside the
+            # FIRST call, so bill that call to a separate event
+            label = ("trace+compile+run" if first_run else "run")
+            t0 = time.perf_counter()
+            fetches, new_state = compiled.fn(feed_arrays, state, rng_key)
+            jax.block_until_ready(fetches)
+            profiler.record_event(
+                "%s/program_%x" % (label, id(program) & 0xFFFF),
+                time.perf_counter() - t0)
+        else:
+            fetches, new_state = compiled.fn(feed_arrays, state, rng_key)
         for name, val in new_state.items():
             scope.set_var(name, val)
+
+        if self.check_nan_inf:
+            self._check_nan_inf(compiled.fetch_names, fetches, new_state)
 
         if return_numpy:
             return [np.asarray(v) for v in fetches]

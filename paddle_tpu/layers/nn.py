@@ -1929,7 +1929,8 @@ def beam_search_decode(ids, scores, beam_size=None, end_id=0, parent_idx=None,
     beam selections. `ids`/`scores` are (steps, B, K) stacks of the
     per-step beam_search outputs (the reference's LoD TensorArrays) and
     `parent_idx` the matching (steps, B, K) parent pointers. Returns
-    (sentence_ids (B, K, steps), sentence_scores (B, K))."""
+    (sentence_ids (B, K, steps), sentence_scores (B, K)); with scores=None
+    returns (sentence_ids, sentence_lengths (B, K) int32) instead."""
     if parent_idx is None:
         raise ValueError(
             "beam_search_decode needs the stacked parent_idx produced by "

@@ -1,0 +1,260 @@
+"""Python-side metric accumulators.
+
+Reference: python/paddle/fluid/metrics.py — numpy state updated from fetched
+step outputs; nothing here touches the device (fetches are already host
+arrays), so the API carries over unchanged.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "MetricBase", "CompositeMetric", "Precision", "Recall", "Accuracy",
+    "ChunkEvaluator", "EditDistance", "Auc",
+]
+
+
+def _is_numpy_(var):
+    return isinstance(var, (np.ndarray, np.generic))
+
+
+def _is_number_(var):
+    return isinstance(var, (int, float, np.float32, np.float64)) or (
+        _is_numpy_(var) and var.size == 1)
+
+
+def _is_number_or_matrix_(var):
+    return _is_number_(var) or _is_numpy_(var)
+
+
+class MetricBase(object):
+    """Base: reset() zeroes the numpy state, update() folds in a step's
+    outputs, eval() returns the aggregate (metrics.py:MetricBase)."""
+
+    def __init__(self, name):
+        self._name = str(name) if name is not None else self.__class__.__name__
+
+    def __str__(self):
+        return self._name
+
+    def reset(self):
+        states = {
+            attr: value
+            for attr, value in self.__dict__.items()
+            if not attr.startswith("_")
+        }
+        for attr, value in states.items():
+            if isinstance(value, int):
+                setattr(self, attr, 0)
+            elif isinstance(value, float):
+                setattr(self, attr, 0.0)
+            elif isinstance(value, (np.ndarray, np.generic)):
+                setattr(self, attr, np.zeros_like(value))
+            else:
+                setattr(self, attr, None)
+
+    def get_config(self):
+        states = {
+            attr: value
+            for attr, value in self.__dict__.items()
+            if not attr.startswith("_")
+        }
+        config = {}
+        config.update({"name": self._name, "states": states})
+        return config
+
+    def update(self, preds, labels):
+        raise NotImplementedError()
+
+    def eval(self):
+        raise NotImplementedError()
+
+
+class CompositeMetric(MetricBase):
+    """Hold several metrics updated with the same (preds, labels)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._metrics = []
+
+    def add_metric(self, metric):
+        if not isinstance(metric, MetricBase):
+            raise ValueError("SubMetric should be inherit from MetricBase.")
+        self._metrics.append(metric)
+
+    def update(self, preds, labels):
+        for m in self._metrics:
+            m.update(preds, labels)
+
+    def eval(self):
+        return [m.eval() for m in self._metrics]
+
+
+class Precision(MetricBase):
+    """Binary precision over 0/1 preds vs labels (metrics.py:Precision)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        labels = np.asarray(labels)
+        preds = np.rint(preds).astype(np.int64).reshape(-1)
+        labels = np.asarray(labels).astype(np.int64).reshape(-1)
+        self.tp += int(np.sum((preds == 1) & (labels == 1)))
+        self.fp += int(np.sum((preds == 1) & (labels == 0)))
+
+    def eval(self):
+        ap = self.tp + self.fp
+        return float(self.tp) / ap if ap != 0 else 0.0
+
+
+class Recall(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype(np.int64).reshape(-1)
+        labels = np.asarray(labels).astype(np.int64).reshape(-1)
+        self.tp += int(np.sum((preds == 1) & (labels == 1)))
+        self.fn += int(np.sum((preds == 0) & (labels == 1)))
+
+    def eval(self):
+        recall = self.tp + self.fn
+        return float(self.tp) / recall if recall != 0 else 0.0
+
+
+class Accuracy(MetricBase):
+    """Weighted running mean of per-batch accuracy values
+    (metrics.py:Accuracy — pairs with layers.accuracy fetches)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.value = 0.0
+        self.weight = 0.0
+
+    def update(self, value, weight):
+        if not _is_number_or_matrix_(value):
+            raise ValueError("The 'value' must be a number(int, float) or a numpy ndarray.")
+        if not _is_number_(weight):
+            raise ValueError("The 'weight' must be a number(int, float).")
+        self.value += float(np.asarray(value).reshape(-1)[0]) * weight
+        self.weight += weight
+
+    def eval(self):
+        if self.weight == 0:
+            raise ValueError("There is no data in Accuracy Metrics. Please check layers.accuracy output has added to Accuracy.")
+        return self.value / self.weight
+
+
+class ChunkEvaluator(MetricBase):
+    """Accumulate (num_infer, num_label, num_correct) chunk counts from the
+    layers.chunk_eval fetches; eval() -> (precision, recall, f1)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks, num_correct_chunks):
+        for v in (num_infer_chunks, num_label_chunks, num_correct_chunks):
+            if not _is_number_or_matrix_(v):
+                raise ValueError("The 'chunk counts' must be a number(int, float) or a numpy ndarray.")
+        self.num_infer_chunks += int(np.asarray(num_infer_chunks).reshape(-1)[0])
+        self.num_label_chunks += int(np.asarray(num_label_chunks).reshape(-1)[0])
+        self.num_correct_chunks += int(np.asarray(num_correct_chunks).reshape(-1)[0])
+
+    def eval(self):
+        precision = (
+            float(self.num_correct_chunks) / self.num_infer_chunks
+            if self.num_infer_chunks else 0.0)
+        recall = (
+            float(self.num_correct_chunks) / self.num_label_chunks
+            if self.num_label_chunks else 0.0)
+        f1_score = (
+            2 * precision * recall / (precision + recall)
+            if self.num_correct_chunks else 0.0)
+        return precision, recall, f1_score
+
+
+class EditDistance(MetricBase):
+    """Accumulate layers.edit_distance fetches; eval() -> (avg distance,
+    instance error rate)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.total_distance = 0.0
+        self.seq_num = 0
+        self.instance_error = 0
+
+    def update(self, distances, seq_num):
+        if not _is_numpy_(distances):
+            distances = np.asarray(distances, np.float64)
+        seq_right_count = int(np.sum(distances == 0))
+        total_distance = float(np.sum(distances))
+        seq_num = int(np.asarray(seq_num).reshape(-1)[0])
+        self.seq_num += seq_num
+        self.instance_error += seq_num - seq_right_count
+        self.total_distance += total_distance
+
+    def eval(self):
+        if self.seq_num == 0:
+            raise ValueError("There is no data in EditDistance Metric. Please check layers.edit_distance output has been added to EditDistance.")
+        avg_distance = self.total_distance / self.seq_num
+        avg_instance_error = self.instance_error / float(self.seq_num)
+        return avg_distance, avg_instance_error
+
+
+class Auc(MetricBase):
+    """Threshold-bucketed ROC AUC over (N, 2) probabilities
+    (metrics.py:Auc; the reference's python fallback path)."""
+
+    def __init__(self, name=None, curve="ROC", num_thresholds=200):
+        super().__init__(name)
+        self._curve = curve
+        self._num_thresholds = num_thresholds
+        self._epsilon = 1e-6
+        self.tp_list = np.zeros((num_thresholds,))
+        self.fn_list = np.zeros((num_thresholds,))
+        self.tn_list = np.zeros((num_thresholds,))
+        self.fp_list = np.zeros((num_thresholds,))
+
+    def update(self, preds, labels):
+        if not _is_numpy_(labels):
+            labels = np.asarray(labels)
+        if not _is_numpy_(preds):
+            preds = np.asarray(preds)
+        kepsilon = 1e-7
+        thresholds = [
+            (i + 1) * 1.0 / (self._num_thresholds - 1)
+            for i in range(self._num_thresholds - 2)
+        ]
+        thresholds = [0.0 - kepsilon] + thresholds + [1.0 + kepsilon]
+        labels = labels.reshape(-1)
+        pos_prob = preds.reshape(preds.shape[0], -1)[:, -1]
+        for idx_thresh, thresh in enumerate(thresholds):
+            pred_pos = pos_prob >= thresh
+            self.tp_list[idx_thresh] += int(np.sum(pred_pos & (labels == 1)))
+            self.fp_list[idx_thresh] += int(np.sum(pred_pos & (labels == 0)))
+            self.fn_list[idx_thresh] += int(np.sum(~pred_pos & (labels == 1)))
+            self.tn_list[idx_thresh] += int(np.sum(~pred_pos & (labels == 0)))
+
+    def eval(self):
+        epsilon = self._epsilon
+        num_thresholds = self._num_thresholds
+        tpr = (self.tp_list.astype("float32") +
+               epsilon) / (self.tp_list + self.fn_list + epsilon)
+        fpr = self.fp_list.astype("float32") / (
+            self.fp_list + self.tn_list + epsilon)
+        rec = (self.tp_list.astype("float32") +
+               epsilon) / (self.tp_list + self.fp_list + epsilon)
+
+        x = fpr[:num_thresholds - 1] - fpr[1:]
+        y = (tpr[:num_thresholds - 1] + tpr[1:]) / 2.0
+        auc_value = float(np.sum(x * y))
+        return auc_value

@@ -53,6 +53,32 @@ def registered_ops() -> List[str]:
     return sorted(KERNELS)
 
 
+class OpProtoHolder:
+    """Reference parity with framework.OpProtoHolder (python/paddle/fluid/
+    framework.py): singleton answering "which ops exist / is this op
+    registered". Slot/attr schemas live in the kernels themselves here (one
+    python function per op), so the proto is just the registry entry."""
+
+    _instance = None
+
+    @classmethod
+    def instance(cls) -> "OpProtoHolder":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def get_op_proto(self, type: str):
+        if type not in KERNELS:
+            raise ValueError("Operator \"%s\" has not been registered." % type)
+        return KERNELS[type]
+
+    def get_all_op_protos(self):
+        return [KERNELS[k] for k in registered_ops()]
+
+    def has_op_proto(self, type: str) -> bool:
+        return type in KERNELS
+
+
 class OpContext:
     """Per-op view handed to a kernel during tracing."""
 

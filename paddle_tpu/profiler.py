@@ -1,0 +1,141 @@
+"""Profiler: wall-clock stats for compile/run events + XLA trace capture.
+
+Reference: python/paddle/fluid/profiler.py (start/stop_profiler, profiler
+context manager, reset_profiler, cuda_profiler). The reference times every
+op kernel launch; here a whole Program executes as ONE fused XLA
+computation, so the meaningful events are per-program compiles and step
+executions (plus compile-cache hits/misses), and deep per-op timelines come
+from the XLA trace viewer via ``jax.profiler`` (`tpu_trace`).
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+import warnings
+from collections import defaultdict
+from typing import Optional
+
+__all__ = [
+    "cuda_profiler", "reset_profiler", "start_profiler", "stop_profiler",
+    "profiler", "tpu_trace",
+]
+
+_enabled = False
+_events = defaultdict(lambda: [0, 0.0])  # name -> [calls, total_s]
+_cache_stats = {"hits": 0, "misses": 0}
+
+
+def is_profiling() -> bool:
+    return _enabled
+
+
+# -- hooks called by the executors --------------------------------------
+
+
+def record_event(name: str, seconds: float):
+    if _enabled:
+        ev = _events[name]
+        ev[0] += 1
+        ev[1] += seconds
+
+
+def record_cache(hit: bool):
+    if _enabled:
+        _cache_stats["hits" if hit else "misses"] += 1
+
+
+@contextlib.contextmanager
+def timed(name: str):
+    """Time a block into the profile (no-op when profiling is off)."""
+    if not _enabled:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        record_event(name, time.perf_counter() - t0)
+
+
+def cache_stats():
+    """Compile-cache stats (SURVEY aux: tracing / compile-cache stats)."""
+    return dict(_cache_stats)
+
+
+# -- reference API -------------------------------------------------------
+
+
+def reset_profiler():
+    _events.clear()
+    _cache_stats["hits"] = 0
+    _cache_stats["misses"] = 0
+
+
+def start_profiler(state="All"):
+    """reference profiler.py:start_profiler. `state` ('CPU'/'GPU'/'All') is
+    accepted for compatibility; there is one device timeline on TPU."""
+    global _enabled
+    if state not in ("CPU", "GPU", "All"):
+        raise ValueError("The state must be 'CPU' or 'GPU' or 'All'.")
+    _enabled = True
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    """Stop and emit the event table (reference profiler.py:stop_profiler).
+    sorted_key in {None, 'calls', 'total', 'ave'}."""
+    global _enabled
+    _enabled = False
+    rows = [(name, calls, total, total / max(calls, 1))
+            for name, (calls, total) in _events.items()]
+    if sorted_key == "calls":
+        rows.sort(key=lambda r: -r[1])
+    elif sorted_key in ("total", "max", "min"):
+        rows.sort(key=lambda r: -r[2])
+    elif sorted_key == "ave":
+        rows.sort(key=lambda r: -r[3])
+    lines = ["%-50s %8s %12s %12s" % ("Event", "Calls", "Total(ms)", "Avg(ms)")]
+    for name, calls, total, avg in rows:
+        lines.append("%-50s %8d %12.3f %12.3f"
+                     % (name[:50], calls, total * 1e3, avg * 1e3))
+    lines.append("compile cache: %(hits)d hits / %(misses)d misses"
+                 % _cache_stats)
+    report = "\n".join(lines)
+    print(report)
+    if profile_path:
+        try:
+            with open(profile_path, "w") as f:
+                f.write(report + "\n")
+        except OSError as e:
+            warnings.warn("could not write profile to %s: %s" % (profile_path, e))
+    return report
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path="/tmp/profile"):
+    """reference profiler.py:profiler context manager."""
+    start_profiler(state)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file, output_mode=None, config=None):
+    """CUDA-only in the reference; a warning no-op on TPU (use tpu_trace)."""
+    warnings.warn("cuda_profiler is a no-op on TPU; use "
+                  "profiler.tpu_trace(log_dir) for an XLA trace")
+    yield
+
+
+@contextlib.contextmanager
+def tpu_trace(log_dir: str, host_tracer_level: Optional[int] = None):
+    """Capture a jax.profiler trace viewable in TensorBoard/Perfetto —
+    the TPU equivalent of the reference's per-kernel timeline."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

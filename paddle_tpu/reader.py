@@ -189,7 +189,7 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
         except Exception:
             pass
 
-    def handle_worker(in_queue, out_queue, err):
+    def handle_worker(in_queue, out_queue):
         sample = in_queue.get()
         try:
             while not isinstance(sample, (XmapEndSignal, _Raise)):
@@ -231,7 +231,7 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
         err = [None]
         htarget = order_handle_worker if order else handle_worker
         hargs = ((in_queue, out_queue, out_order, err) if order
-                 else (in_queue, out_queue, err))
+                 else (in_queue, out_queue))
         for _ in range(process_num):
             w = Thread(target=htarget, args=hargs)
             w.daemon = True

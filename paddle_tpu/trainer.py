@@ -1,0 +1,278 @@
+"""High-level Trainer API.
+
+Reference: python/paddle/fluid/trainer.py — wraps program construction,
+the (Parallel)Executor loop, event callbacks and checkpointing. The TPU
+reading of `parallel=True` is a pjit data-parallel mesh instead of
+per-GPU graph clones.
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, List, Optional
+
+from . import io as io_mod
+from . import optimizer as optimizer_mod
+from .data_feeder import DataFeeder
+from .executor import Executor
+from .framework import core as framework
+from .framework.core import Program, program_guard
+from .framework.scope import CPUPlace, Scope, TPUPlace, scope_guard
+from .framework import unique_name
+
+__all__ = [
+    "BeginEpochEvent", "EndEpochEvent", "BeginStepEvent", "EndStepEvent",
+    "CheckpointConfig", "Trainer", "Inferencer",
+]
+
+
+class BeginEpochEvent(object):
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent(object):
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent(object):
+    def __init__(self, epoch_id, step_id):
+        self.epoch = epoch_id
+        self.step = step_id
+        #: set True to fetch metrics for the matching EndStepEvent
+        self.fetch_metrics = True
+
+
+class EndStepEvent(object):
+    def __init__(self, epoch_id, step_id, metrics):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.metrics = metrics
+
+
+class CheckpointConfig(object):
+    """reference trainer.py:CheckpointConfig."""
+
+    def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
+                 epoch_interval=1, step_interval=10):
+        self.checkpoint_dir = checkpoint_dir or os.getcwd()
+        self.max_num_checkpoints = max_num_checkpoints
+        self.epoch_interval = max(int(epoch_interval), 1)
+        self.step_interval = max(int(step_interval), 1)
+        self.epoch_id = 0
+        self.step_id = 0
+        self.load_serial = None
+
+
+def check_and_get_place(place):
+    """Default to the TPU when one is visible (reference
+    check_and_get_place prefers CUDA)."""
+    if place is not None:
+        return place
+    import jax
+
+    return CPUPlace() if jax.devices()[0].platform == "cpu" else TPUPlace()
+
+
+def build_feed_var_list(program: Program, feed_order):
+    if feed_order is None:
+        feed_var_list = [
+            var for var in program.global_block().vars.values()
+            if var.is_data
+        ]
+    elif isinstance(feed_order, (list, tuple)):
+        feed_var_list = [program.global_block().var(n) for n in feed_order]
+    elif isinstance(feed_order, dict):
+        order = sorted(feed_order, key=lambda n: feed_order[n])
+        feed_var_list = [program.global_block().var(n) for n in order]
+    else:
+        raise TypeError("feed_order should be a list, dict or None")
+    return feed_var_list
+
+
+class Trainer(object):
+    """reference trainer.py:Trainer.
+
+    train_func() builds the graph and returns loss (or [loss, *metrics]);
+    optimizer_func() returns the Optimizer. `parallel=True` runs the step
+    under a pjit data-parallel mesh (ParallelExecutor).
+    """
+
+    def __init__(self, train_func: Callable, optimizer_func: Callable,
+                 param_path: Optional[str] = None, place=None,
+                 parallel: bool = False,
+                 checkpoint_config: Optional[CheckpointConfig] = None):
+        self.__stop = False
+        self.parallel = parallel
+        self.trainer_id = 0
+        self.checkpoint_cfg = checkpoint_config
+        if self.checkpoint_cfg:
+            if not isinstance(self.checkpoint_cfg, CheckpointConfig):
+                raise TypeError("checkpoint_config must be a CheckpointConfig")
+            serial = io_mod.get_latest_checkpoint_serial(
+                self.checkpoint_cfg.checkpoint_dir)
+            self.checkpoint_cfg.load_serial = serial if serial >= 0 else None
+
+        self.scope = Scope()
+        self.startup_program = Program()
+        self.train_program = Program()
+        self.place = check_and_get_place(place)
+
+        with program_guard(self.train_program, self.startup_program):
+            with unique_name.guard():
+                outs = train_func()
+                self.train_func_outputs = list(outs) if isinstance(
+                    outs, (list, tuple)) else [outs]
+                self.test_program = self.train_program.clone(for_test=True)
+                optimizer = optimizer_func()
+                if not isinstance(optimizer, optimizer_mod.Optimizer):
+                    raise TypeError(
+                        "The optimizer should be an instance of Optimizer")
+                loss = self.train_func_outputs[0]
+                optimizer.minimize(loss)
+
+        self._exe = Executor(self.place)
+        with scope_guard(self.scope):
+            self._exe.run(self.startup_program)
+
+        if param_path is not None:
+            with scope_guard(self.scope):
+                io_mod.load_persistables(
+                    self._exe, param_path, main_program=self.startup_program)
+
+        if self.checkpoint_cfg and self.checkpoint_cfg.load_serial is not None:
+            with scope_guard(self.scope):
+                io_mod.load_checkpoint(
+                    self._exe, self.checkpoint_cfg.checkpoint_dir,
+                    serial=self.checkpoint_cfg.load_serial,
+                    main_program=self.train_program)
+
+        self._train_exe = None
+        if parallel:
+            from .parallel import ParallelExecutor
+
+            with scope_guard(self.scope):
+                self._train_exe = ParallelExecutor(
+                    loss_name=loss.name, main_program=self.train_program,
+                    scope=self.scope)
+
+    def stop(self):
+        """Stop training after the current step (callable from the event
+        handler)."""
+        self.__stop = True
+
+    def train(self, num_epochs: int, event_handler: Callable,
+              reader=None, feed_order=None):
+        """Run the train loop: reader yields batches (lists of tuples in
+        feed_order), event_handler receives Begin/End Epoch/Step events."""
+        if event_handler is None:
+            event_handler = lambda ev: None  # noqa: E731
+        feed_var_list = build_feed_var_list(self.train_program, feed_order)
+        feeder = DataFeeder(feed_list=feed_var_list, place=self.place)
+        exe = self._train_exe
+        with scope_guard(self.scope):
+            for epoch_id in range(num_epochs):
+                event_handler(BeginEpochEvent(epoch_id))
+                for step_id, data in enumerate(reader()):
+                    if self.__stop:
+                        if self.checkpoint_cfg:
+                            self._clean_checkpoint()
+                        return
+                    begin_event = BeginStepEvent(epoch_id, step_id)
+                    event_handler(begin_event)
+                    fetch_list = (
+                        [v.name for v in self.train_func_outputs]
+                        if begin_event.fetch_metrics else [])
+                    feed = feeder.feed(data)
+                    if exe is not None:
+                        metrics = exe.run(feed=feed, fetch_list=fetch_list)
+                    else:
+                        metrics = self._exe.run(
+                            self.train_program, feed=feed,
+                            fetch_list=fetch_list)
+                    if self.checkpoint_cfg:
+                        self._save_checkpoint(epoch_id, step_id)
+                    event_handler(EndStepEvent(epoch_id, step_id, metrics))
+                event_handler(EndEpochEvent(epoch_id))
+            if self.checkpoint_cfg:
+                self._clean_checkpoint()
+
+    def test(self, reader, feed_order=None):
+        """Average the train_func outputs over the reader on the test
+        (for_test clone) program."""
+        feed_var_list = build_feed_var_list(self.test_program, feed_order)
+        feeder = DataFeeder(feed_list=feed_var_list, place=self.place)
+        fetch = [v.name for v in self.train_func_outputs]
+        accumulated = [0.0] * len(fetch)
+        count = 0
+        with scope_guard(self.scope):
+            for data in reader():
+                outs = self._exe.run(self.test_program,
+                                     feed=feeder.feed(data), fetch_list=fetch)
+                accumulated = [a + float(o.reshape(-1)[0] if hasattr(o, "reshape") else o)
+                               for a, o in zip(accumulated, outs)]
+                count += 1
+        return [a / max(count, 1) for a in accumulated]
+
+    def save_params(self, param_path):
+        with scope_guard(self.scope):
+            io_mod.save_persistables(self._exe, param_path,
+                                     main_program=self.train_program)
+
+    def save_inference_model(self, param_path, feeded_var_names,
+                             target_var_indexes):
+        with scope_guard(self.scope):
+            io_mod.save_inference_model(
+                param_path, feeded_var_names,
+                [self.train_func_outputs[i] for i in target_var_indexes],
+                self._exe, main_program=self.train_program)
+
+    # -- checkpoints -----------------------------------------------------
+    def _save_checkpoint(self, epoch_id, step_id):
+        cfg = self.checkpoint_cfg
+        if epoch_id % cfg.epoch_interval or step_id % cfg.step_interval:
+            return
+        io_mod.save_checkpoint(
+            self._exe, cfg.checkpoint_dir, trainer_id=self.trainer_id,
+            main_program=self.train_program,
+            max_num_checkpoints=cfg.max_num_checkpoints,
+            step=step_id, epoch=epoch_id)
+
+    def _clean_checkpoint(self):
+        io_mod.clean_checkpoint(self.checkpoint_cfg.checkpoint_dir)
+
+
+class Inferencer(object):
+    """reference inferencer.py:Inferencer — build infer_func's graph, load
+    params from param_path, run the for_test program."""
+
+    def __init__(self, infer_func: Callable, param_path: str, place=None,
+                 parallel: bool = False):
+        self.param_path = param_path
+        self.scope = Scope()
+        self.parallel = parallel
+        self.place = check_and_get_place(place)
+
+        self.inference_program = Program()
+        startup = Program()
+        with program_guard(self.inference_program, startup):
+            with unique_name.guard():
+                self.predict_var = infer_func()
+
+        self.exe = Executor(self.place)
+        with scope_guard(self.scope):
+            self.exe.run(startup)
+            io_mod.load_params(self.exe, param_path,
+                               main_program=self.inference_program)
+        self.inference_program = self.inference_program.clone(for_test=True)
+
+    def infer(self, inputs: dict, return_numpy: bool = True):
+        if not isinstance(inputs, dict):
+            raise ValueError(
+                "inputs should be a map of {'input_name': input_var}")
+        with scope_guard(self.scope):
+            results = self.exe.run(
+                self.inference_program, feed=inputs,
+                fetch_list=[self.predict_var.name],
+                return_numpy=return_numpy)
+        return results

@@ -1,0 +1,213 @@
+"""Aux subsystems: Trainer/Inferencer, metrics, profiler, debugger,
+program verifier, NaN-check mode, op introspection."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, metrics
+
+
+def _mnist_like_reader(n=4, batch=8, seed=0):
+    r = np.random.RandomState(seed)
+
+    def reader():
+        for _ in range(n):
+            yield [(r.rand(16).astype(np.float32),
+                    np.array([r.randint(0, 4)], np.int64))
+                   for _ in range(batch)]
+
+    return reader
+
+
+def test_trainer_train_test_save_infer(tmp_path):
+    events = []
+
+    def train_func():
+        x = layers.data(name="x", shape=[16])
+        y = layers.data(name="y", shape=[1], dtype="int64")
+        h = layers.fc(x, 32, act="relu")
+        logits = layers.fc(h, 4)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, y))
+        return loss
+
+    trainer = fluid.Trainer(
+        train_func=train_func,
+        optimizer_func=lambda: fluid.optimizer.Adam(1e-2),
+        place=fluid.CPUPlace())
+
+    def handler(ev):
+        events.append(type(ev).__name__)
+        if isinstance(ev, fluid.EndStepEvent):
+            assert len(ev.metrics) == 1
+
+    trainer.train(num_epochs=2, event_handler=handler,
+                  reader=_mnist_like_reader(), feed_order=["x", "y"])
+    assert events[0] == "BeginEpochEvent" and events[-1] == "EndEpochEvent"
+    assert events.count("EndEpochEvent") == 2
+
+    test_loss = trainer.test(reader=_mnist_like_reader(n=2),
+                             feed_order=["x", "y"])
+    assert np.isfinite(test_loss[0])
+
+    param_dir = str(tmp_path / "params")
+    trainer.save_params(param_dir)
+
+    def infer_func():
+        x = layers.data(name="x", shape=[16])
+        h = layers.fc(x, 32, act="relu")
+        return layers.fc(h, 4)
+
+    inferencer = fluid.Inferencer(infer_func=infer_func, param_path=param_dir,
+                                  place=fluid.CPUPlace())
+    out, = inferencer.infer({"x": np.random.rand(3, 16).astype(np.float32)})
+    assert out.shape == (3, 4)
+
+
+def test_trainer_stop():
+    def train_func():
+        x = layers.data(name="x", shape=[16])
+        y = layers.data(name="y", shape=[1], dtype="int64")
+        return layers.mean(
+            layers.softmax_with_cross_entropy(layers.fc(x, 4), y))
+
+    trainer = fluid.Trainer(train_func=train_func,
+                            optimizer_func=lambda: fluid.optimizer.SGD(0.1))
+    steps = []
+
+    def handler(ev):
+        if isinstance(ev, fluid.EndStepEvent):
+            steps.append(ev.step)
+            trainer.stop()
+
+    trainer.train(num_epochs=5, event_handler=handler,
+                  reader=_mnist_like_reader(n=10), feed_order=["x", "y"])
+    assert len(steps) == 1  # stopped after the first step
+
+
+def test_metrics_accuracy_and_composite():
+    acc = metrics.Accuracy()
+    acc.update(value=0.5, weight=10)
+    acc.update(value=1.0, weight=10)
+    assert abs(acc.eval() - 0.75) < 1e-9
+    acc.reset()
+    assert acc.weight == 0.0
+
+    prec = metrics.Precision()
+    rec = metrics.Recall()
+    comp = metrics.CompositeMetric()
+    comp.add_metric(prec)
+    comp.add_metric(rec)
+    preds = np.array([1, 1, 0, 0])
+    labels = np.array([1, 0, 1, 0])
+    comp.update(preds, labels)
+    p, r = comp.eval()
+    assert p == 0.5 and r == 0.5
+
+
+def test_metrics_chunk_edit_auc():
+    ch = metrics.ChunkEvaluator()
+    ch.update(np.array([4]), np.array([4]), np.array([2]))
+    p, r, f1 = ch.eval()
+    assert p == 0.5 and r == 0.5 and abs(f1 - 0.5) < 1e-9
+
+    ed = metrics.EditDistance()
+    ed.update(np.array([[0.0], [2.0]]), np.array([2]))
+    avg, err = ed.eval()
+    assert avg == 1.0 and err == 0.5
+
+    auc = metrics.Auc(num_thresholds=200)
+    r = np.random.RandomState(0)
+    labels = r.randint(0, 2, 400)
+    # strongly separable scores -> AUC near 1
+    probs = np.stack([1 - (labels * 0.8 + 0.1), labels * 0.8 + 0.1], axis=1)
+    auc.update(probs, labels)
+    assert auc.eval() > 0.95
+
+
+def test_profiler_collects_events(capsys):
+    from paddle_tpu import profiler
+
+    profiler.reset_profiler()
+    x = layers.data(name="x", shape=[4])
+    out = layers.reduce_sum(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    with profiler.profiler("All", sorted_key="total", profile_path=""):
+        for _ in range(3):
+            exe.run(feed={"x": np.ones((2, 4), np.float32)},
+                    fetch_list=[out])
+    report = capsys.readouterr().out
+    assert "run/program_" in report and "Calls" in report
+    stats = profiler.cache_stats()
+    assert stats["hits"] >= 2
+
+
+def test_debugger_pprint_and_dot(tmp_path, capsys):
+    from paddle_tpu import debugger
+
+    x = layers.data(name="x", shape=[4])
+    h = layers.fc(x, 8, act="relu")
+    layers.reduce_sum(h)
+    text = debugger.pprint_program_codes(fluid.default_main_program())
+    assert "fc" in text or "mul" in text
+    dot_path = str(tmp_path / "g.dot")
+    dot = debugger.draw_block_graphviz(
+        fluid.default_main_program().global_block(), path=dot_path)
+    assert dot.startswith("digraph") and os.path.exists(dot_path)
+    assert "reduce_sum" in dot
+
+
+def test_verifier_catches_use_before_def():
+    from paddle_tpu.framework.verifier import ProgramVerifyError
+
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        x = layers.data(name="x", shape=[4])
+        out = layers.reduce_sum(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    # not feeding 'x' -> use-before-def at compile time, with op context
+    with pytest.raises(ProgramVerifyError, match="use-before-def"):
+        exe.run(prog, feed={}, fetch_list=[out])
+
+
+def test_check_nan_inf_mode():
+    x = layers.data(name="x", shape=[4])
+    out = layers.log(x)  # log of negatives -> NaN
+    exe = fluid.Executor(fluid.CPUPlace(), check_nan_inf=True)
+    exe.run(fluid.default_startup_program())
+    ok, = exe.run(feed={"x": np.ones((1, 4), np.float32)}, fetch_list=[out])
+    assert np.isfinite(ok).all()
+    with pytest.raises(FloatingPointError, match="NaN/Inf"):
+        exe.run(feed={"x": -np.ones((1, 4), np.float32)}, fetch_list=[out])
+
+
+def test_op_introspection():
+    holder = fluid.OpProtoHolder.instance()
+    assert holder.has_op_proto("matmul")
+    assert fluid.op_support_tpu("conv2d")
+    assert not fluid.op_support_tpu("nonexistent_op_xyz")
+    assert "softmax" in fluid.registered_ops()
+    with pytest.raises(ValueError, match="has not been registered"):
+        holder.get_op_proto("nonexistent_op_xyz")
+
+
+def test_evaluator_chunk():
+    from paddle_tpu import evaluator
+
+    x = layers.data(name="x", shape=[1, 6], dtype="int64",
+                    append_batch_size=False)
+    y = layers.data(name="y", shape=[1, 6], dtype="int64",
+                    append_batch_size=False)
+    with pytest.warns(UserWarning, match="deprecated"):
+        ev = evaluator.ChunkEvaluator(x, y, chunk_scheme="IOB",
+                                      num_chunk_types=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    lab = np.array([[0, 1, 4, 2, 3, 4]], np.int64)
+    outs = exe.run(feed={"x": lab, "y": lab},
+                   fetch_list=[m.name for m in ev.metrics])
+    ev.update(*outs)
+    p, r, f1 = ev.eval()
+    assert p == r == f1 == 1.0
