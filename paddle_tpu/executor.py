@@ -83,18 +83,21 @@ def analyze_state(program: Program, feed_names):
 
 
 def build_step_fn(program: Program, fetch_names, state_in, state_out):
-    """The pure traced step: (feeds, state, rng_key) -> (fetches, new_state).
+    """The pure traced step: (feeds, state, rng_key, step) -> (fetches,
+    new_state). `step` is folded into the RNG INSIDE the jitted program —
+    folding on the host would dispatch two device ops per step, a costly
+    extra roundtrip on a remote-tunneled TPU.
 
     Shared by Executor (jit, one device) and ParallelExecutor (jit over a
     Mesh with shardings) — the SAME computation, different partitionings.
     """
     block = program.global_block()
 
-    def stepfn(feeds: Dict, state: Dict, rng_key):
+    def stepfn(feeds: Dict, state: Dict, rng_key, step=0):
         env: Dict = {}
         env.update(state)
         env.update(feeds)
-        rng = RngStream(rng_key)
+        rng = RngStream(jax.random.fold_in(rng_key, jnp.asarray(step, jnp.uint32)))
         trace_block(block, env, rng)
         fetches = []
         for name in fetch_names:
@@ -126,6 +129,7 @@ class Executor:
         self._cache: Dict = {}
         self._step = 0
         self._seed = 0
+        self._base_keys: Dict = {}
 
     # -- compilation -----------------------------------------------------
     def _compile(self, program: Program, feed_sig, fetch_names, scope: Scope) -> _Compiled:
@@ -223,8 +227,10 @@ class Executor:
             state[name] = val
 
         seed = program.random_seed if program.random_seed else self._seed
-        rng_key = jax.random.PRNGKey(seed)
-        rng_key = jax.random.fold_in(rng_key, self._step)
+        if seed not in self._base_keys:
+            self._base_keys[seed] = jax.random.PRNGKey(seed)
+        rng_key = self._base_keys[seed]
+        step = np.uint32(self._step)
         self._step += 1
 
         if profiler.is_profiling():
@@ -232,13 +238,13 @@ class Executor:
             # FIRST call, so bill that call to a separate event
             label = ("trace+compile+run" if first_run else "run")
             t0 = time.perf_counter()
-            fetches, new_state = compiled.fn(feed_arrays, state, rng_key)
-            jax.block_until_ready(fetches)
+            fetches, new_state = compiled.fn(feed_arrays, state, rng_key, step)
+            jax.block_until_ready((fetches, new_state))
             profiler.record_event(
                 "%s/program_%x" % (label, id(program) & 0xFFFF),
                 time.perf_counter() - t0)
         else:
-            fetches, new_state = compiled.fn(feed_arrays, state, rng_key)
+            fetches, new_state = compiled.fn(feed_arrays, state, rng_key, step)
         for name, val in new_state.items():
             scope.set_var(name, val)
 

@@ -319,6 +319,7 @@ class Program:
         self._seed = 0
         self.random_seed = 0
         self._version = 0  # bumped on every mutation; part of the fingerprint
+        self._amp = False  # mixed-precision trace mode (see trace.py)
 
     # -- block management ------------------------------------------------
     def global_block(self) -> Block:
@@ -352,6 +353,15 @@ class Program:
         payload = json.dumps(self.to_dict(), sort_keys=True).encode()
         return hashlib.sha1(payload).hexdigest()
 
+    def enable_mixed_precision(self, enabled: bool = True) -> "Program":
+        """Run matmul-class ops in bf16 with fp32 master weights (TPU AMP;
+        see trace.py _AMP_BF16_OPS / _AMP_FP32_OPS). No reference twin —
+        fluid 0.14 predates AMP; exposed because bf16 is the TPU MXU's
+        native fast path."""
+        self._amp = bool(enabled)
+        self._bump()
+        return self
+
     # -- parity APIs -----------------------------------------------------
     def clone(self, for_test: bool = False) -> "Program":
         """Deep-copies the program. With for_test=True, flips train-only ops
@@ -379,6 +389,7 @@ class Program:
         return {
             "version": 1,
             "random_seed": self.random_seed,
+            "amp": self._amp,
             "blocks": [b.to_dict() for b in self.blocks],
         }
 
@@ -389,6 +400,7 @@ class Program:
     def from_dict(d: dict) -> "Program":
         p = Program()
         p.random_seed = d.get("random_seed", 0)
+        p._amp = bool(d.get("amp", False))
         # first pass: blocks
         p.blocks = []
         for bd in d["blocks"]:
@@ -430,6 +442,7 @@ class Program:
 _TRAIN_TEST_OPS = {
     "dropout": ("is_test",),
     "batch_norm": ("is_test",),
+    "fused_attention": ("is_test",),  # attention dropout off at test time
 }
 
 # -- default programs ----------------------------------------------------

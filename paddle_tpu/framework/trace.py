@@ -32,6 +32,23 @@ from .core import Block, Operator, grad_var_name
 # directly.
 _SKIP_OPS = {"feed", "fetch"}
 
+# Mixed precision (program.enable_mixed_precision()): matmul-class ops run
+# their float inputs in bf16 — MXU native, half the HBM traffic — while
+# numerically sensitive ops are pinned to fp32. Parameters and optimizer
+# state stay fp32 (master weights); the casts live inside the traced graph,
+# so vjp returns fp32 gradients and XLA dedups repeated casts. bf16 shares
+# fp32's exponent range, so no loss scaling is needed (unlike fp16 AMP).
+_AMP_BF16_OPS = {
+    "mul", "matmul", "conv2d", "conv3d", "conv2d_transpose",
+    "conv3d_transpose", "sequence_conv", "fused_attention",
+}
+_AMP_FP32_OPS = {
+    "softmax_with_cross_entropy", "cross_entropy", "layer_norm",
+    "batch_norm", "softmax", "sequence_softmax", "reduce_mean",
+    "reduce_sum", "mean", "exp", "log", "linear_chain_crf", "warpctc",
+    "nce", "hierarchical_sigmoid", "l2_normalize",
+}
+
 
 class RngStream:
     """Deterministic PRNG stream keyed on (block idx, op position, draw #):
@@ -84,7 +101,13 @@ def _apply_outputs(op: Operator, block: Block, env: Dict, result: Dict):
 
 def trace_op(op: Operator, block: Block, env: Dict, rng_fn, subblock_fn=None):
     kernel = get_kernel(op.type)
-    ctx = OpContext(op, _EnvView(env, op), rng_fn, subblock_fn, block)
+    view = _EnvView(env, op)
+    if getattr(block.program, "_amp", False):
+        if op.type in _AMP_BF16_OPS:
+            view = _CastEnvView(env, op, jnp.bfloat16)
+        elif op.type in _AMP_FP32_OPS:
+            view = _CastEnvView(env, op, jnp.float32)
+    ctx = OpContext(op, view, rng_fn, subblock_fn, block)
     try:
         result = kernel(ctx)
     except (NotImplementedError,):
@@ -125,6 +148,21 @@ class _EnvView(dict):
 
     def snapshot(self):
         return dict(self._env)
+
+
+class _CastEnvView(_EnvView):
+    """Env view that casts float inputs to the op's AMP compute dtype."""
+
+    def __init__(self, env, op, dtype):
+        super().__init__(env, op)
+        self._amp_dtype = dtype
+
+    def __getitem__(self, name):
+        v = super().__getitem__(name)
+        dt = getattr(v, "dtype", None)
+        if dt in (jnp.float32, jnp.bfloat16) and dt != self._amp_dtype:
+            return v.astype(self._amp_dtype)
+        return v
 
 
 def trace_block(block: Block, env: Dict, rng: RngStream) -> Dict:

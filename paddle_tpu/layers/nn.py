@@ -101,9 +101,15 @@ __all__ = [
     "hsigmoid",
     "beam_search",
     "beam_search_decode",
+    "fused_attention",
 ]
 
 from .ops import elementwise_add  # re-export for parity
+
+import os as _os
+
+# default KV block for fused_attention, overridable for perf sweeps
+_DEFAULT_ATTN_BLOCK_K = int(_os.environ.get("PADDLE_TPU_ATTN_BLOCK_K", 512))
 
 
 def _prod(xs):
@@ -1955,3 +1961,26 @@ def beam_search_decode(ids, scores, beam_size=None, end_id=0, parent_idx=None,
     if scores is not None:
         return sent_ids, sent_scores
     return sent_ids, sent_lens
+
+
+def fused_attention(q, k, v, causal=False, scale=None, sequence_length=None,
+                    dropout_rate=0.0, block_k=None, name=None):
+    """Flash attention over (B, H, T, Dh) tensors — one fused op instead of
+    the matmul/softmax/dropout/matmul chain (kernel: ops/attention.py).
+    Exact attention, O(T) memory; `sequence_length` masks padded KV
+    positions; TPU-native (no reference twin — the reference materializes
+    the (T, T) scores)."""
+    helper = LayerHelper("fused_attention", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype, shape=q.shape)
+    inputs = {"Q": [q], "K": [k], "V": [v]}
+    if sequence_length is not None:
+        inputs["Lengths"] = [sequence_length]
+    helper.append_op(
+        type="fused_attention",
+        inputs=inputs,
+        outputs={"Out": [out]},
+        attrs={"causal": causal, "scale": scale,
+               "dropout_rate": dropout_rate,
+               "block_k": block_k or _DEFAULT_ATTN_BLOCK_K},
+    )
+    return out

@@ -216,6 +216,8 @@ class Auc(MetricBase):
 
     def __init__(self, name=None, curve="ROC", num_thresholds=200):
         super().__init__(name)
+        if curve != "ROC":
+            raise ValueError("only curve='ROC' is implemented")
         self._curve = curve
         self._num_thresholds = num_thresholds
         self._epsilon = 1e-6
@@ -251,8 +253,6 @@ class Auc(MetricBase):
                epsilon) / (self.tp_list + self.fn_list + epsilon)
         fpr = self.fp_list.astype("float32") / (
             self.fp_list + self.tn_list + epsilon)
-        rec = (self.tp_list.astype("float32") +
-               epsilon) / (self.tp_list + self.fp_list + epsilon)
 
         x = fpr[:num_thresholds - 1] - fpr[1:]
         y = (tpr[:num_thresholds - 1] + tpr[1:]) / 2.0

@@ -32,9 +32,14 @@ def _linear(x, size, name=None, num_flatten_dims=2, act=None):
 
 def multi_head_attention(
     q_in, kv_in, n_head, d_model, dropout_rate=0.0, causal=False,
-    kv_lengths=None, name=None,
+    kv_lengths=None, name=None, use_fused=True,
 ):
-    """(B, Tq, D) x (B, Tk, D) -> (B, Tq, D)."""
+    """(B, Tq, D) x (B, Tk, D) -> (B, Tq, D).
+
+    use_fused=True routes through the flash-attention op (ops/attention.py):
+    no (Tq, Tk) score tensor ever hits HBM, which is what lets seq-1024
+    training batches fit a single v5e. The unfused path is kept for
+    numerics debugging."""
     B, Tq, _ = q_in.shape
     Tk = kv_in.shape[1]
     d_head = d_model // n_head
@@ -51,16 +56,20 @@ def multi_head_attention(
     k = split_heads(k, Tk)
     v = split_heads(v, Tk)
 
-    q = layers.scale(q, scale=float(d_head) ** -0.5)
-    logits = layers.matmul(q, k, transpose_y=True)  # (B, H, Tq, Tk)
-
-    mask = _attn_mask(B, Tq, Tk, causal=causal, kv_lengths=kv_lengths)
-    if mask is not None:
-        logits = layers.elementwise_add(logits, mask)
-    weights = layers.softmax(logits)
-    if dropout_rate:
-        weights = layers.dropout(weights, dropout_prob=dropout_rate)
-    ctx = layers.matmul(weights, v)  # (B, H, Tq, Dh)
+    if use_fused:
+        ctx = layers.fused_attention(
+            q, k, v, causal=causal, sequence_length=kv_lengths,
+            dropout_rate=dropout_rate)
+    else:
+        q = layers.scale(q, scale=float(d_head) ** -0.5)
+        logits = layers.matmul(q, k, transpose_y=True)  # (B, H, Tq, Tk)
+        mask = _attn_mask(B, Tq, Tk, causal=causal, kv_lengths=kv_lengths)
+        if mask is not None:
+            logits = layers.elementwise_add(logits, mask)
+        weights = layers.softmax(logits)
+        if dropout_rate:
+            weights = layers.dropout(weights, dropout_prob=dropout_rate)
+        ctx = layers.matmul(weights, v)  # (B, H, Tq, Dh)
     ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
     ctx = layers.reshape(ctx, shape=[B, Tq, d_model])
     return _linear(ctx, d_model, name and name + ".out")

@@ -129,6 +129,7 @@ class ParallelExecutor:
         self.trainer_id = trainer_id
         self._cache: Dict = {}
         self._step = 0
+        self._base_keys: Dict = {}
 
     @property
     def device_count(self) -> int:
@@ -161,7 +162,9 @@ class ParallelExecutor:
             arr = val if hasattr(val, "shape") and hasattr(val, "dtype") else np.asarray(val)
             state_aval[n] = jax.ShapeDtypeStruct(tuple(arr.shape), arr.dtype)
         key_aval = jax.eval_shape(lambda: jax.random.PRNGKey(0))
-        _, out_state_aval = jax.eval_shape(stepfn, feeds_aval, state_aval, key_aval)
+        step_aval = jax.ShapeDtypeStruct((), np.uint32)
+        _, out_state_aval = jax.eval_shape(stepfn, feeds_aval, state_aval, key_aval,
+                                           step_aval)
 
         plan = self._plan
         feed_shardings = {
@@ -178,7 +181,7 @@ class ParallelExecutor:
 
         fn = jax.jit(
             stepfn,
-            in_shardings=(feed_shardings, in_state_shardings, rep),
+            in_shardings=(feed_shardings, in_state_shardings, rep, rep),
             out_shardings=(
                 tuple(rep for _ in fetch_names),
                 out_state_shardings,
@@ -254,10 +257,12 @@ class ParallelExecutor:
         }
 
         seed = self._program.random_seed
-        rng_key = jax.random.fold_in(jax.random.PRNGKey(seed), self._step)
+        if seed not in self._base_keys:
+            self._base_keys[seed] = jax.random.PRNGKey(seed)
+        step = np.uint32(self._step)
         self._step += 1
 
-        fetches, new_state = compiled.fn(feeds, state, rng_key)
+        fetches, new_state = compiled.fn(feeds, state, self._base_keys[seed], step)
         for name, val in new_state.items():
             self._scope.set_var(name, val)
 

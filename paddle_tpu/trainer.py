@@ -142,10 +142,14 @@ class Trainer(object):
 
         if self.checkpoint_cfg and self.checkpoint_cfg.load_serial is not None:
             with scope_guard(self.scope):
-                io_mod.load_checkpoint(
+                meta = io_mod.load_checkpoint(
                     self._exe, self.checkpoint_cfg.checkpoint_dir,
                     serial=self.checkpoint_cfg.load_serial,
                     main_program=self.train_program)
+            # resume the counters so train() continues where the crashed
+            # run stopped instead of re-running finished epochs
+            self.checkpoint_cfg.epoch_id = int(meta.get("epoch", 0))
+            self.checkpoint_cfg.step_id = int(meta.get("step", 0))
 
         self._train_exe = None
         if parallel:
@@ -170,8 +174,10 @@ class Trainer(object):
         feed_var_list = build_feed_var_list(self.train_program, feed_order)
         feeder = DataFeeder(feed_list=feed_var_list, place=self.place)
         exe = self._train_exe
+        start_epoch = (self.checkpoint_cfg.epoch_id
+                       if self.checkpoint_cfg else 0)
         with scope_guard(self.scope):
-            for epoch_id in range(num_epochs):
+            for epoch_id in range(start_epoch, num_epochs):
                 event_handler(BeginEpochEvent(epoch_id))
                 for step_id, data in enumerate(reader()):
                     if self.__stop:

@@ -1,0 +1,149 @@
+"""Fused (flash) attention parity vs naive attention — values and grads."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.ops.attention import flash_attention, pallas_flash_fwd
+
+
+def _naive(q, k, v, causal=False, lengths=None):
+    d = q.shape[-1]
+    s = jnp.einsum("bhtd,bhsd->bhts", q, k) / np.sqrt(d)
+    t, tk = q.shape[2], k.shape[2]
+    mask = jnp.ones((t, tk), bool)
+    if causal:
+        mask = jnp.tril(mask)
+    mask = mask[None, None]
+    if lengths is not None:
+        mask = mask & (jnp.arange(tk)[None, None, None, :]
+                       < lengths[:, None, None, None])
+    s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhts,bhsd->bhtd", p, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_naive(causal):
+    r = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(r.randn(2, 3, 64, 16), jnp.float32)
+               for _ in range(3))
+    out = flash_attention(q, k, v, causal=causal, block_k=32)
+    ref = _naive(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_with_lengths():
+    r = np.random.RandomState(1)
+    q, k, v = (jnp.asarray(r.randn(3, 2, 40, 8), jnp.float32)
+               for _ in range(3))
+    lengths = jnp.asarray([40, 17, 3], jnp.int32)
+    out = flash_attention(q, k, v, lengths=lengths, block_k=16)
+    ref = _naive(q, k, v, lengths=lengths)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_gradients_match_naive():
+    r = np.random.RandomState(2)
+    q, k, v = (jnp.asarray(r.randn(2, 2, 32, 8), jnp.float32)
+               for _ in range(3))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, block_k=16) ** 2)
+
+    def loss_naive(q, k, v):
+        return jnp.sum(_naive(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gn = jax.grad(loss_naive, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gn):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_pallas_fwd_interpret_matches_naive():
+    r = np.random.RandomState(3)
+    q, k, v = (jnp.asarray(r.randn(1, 2, 128, 16), jnp.float32)
+               for _ in range(3))
+    for causal in (False, True):
+        out = pallas_flash_fwd(q, k, v, causal=causal, block_q=64,
+                               block_k=64, interpret=True)
+        ref = _naive(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_fused_attention_layer_in_program():
+    r = np.random.RandomState(4)
+    qv = r.randn(2, 2, 16, 8).astype(np.float32)
+    q = layers.data(name="q", shape=[2, 2, 16, 8], append_batch_size=False)
+    out = layers.fused_attention(q, q, q, causal=True)
+    loss = layers.reduce_mean(out)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    o, = exe.run(feed={"q": qv}, fetch_list=[out])
+    ref = _naive(jnp.asarray(qv), jnp.asarray(qv), jnp.asarray(qv),
+                 causal=True)
+    np.testing.assert_allclose(o, np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_transformer_lm_fused_matches_unfused():
+    """Same params/seed: fused and unfused attention give the same loss."""
+    from paddle_tpu import models
+
+    r = np.random.RandomState(5)
+    feed = {
+        "ids": r.randint(0, 100, (2, 32)).astype(np.int64),
+        "labels": r.randint(0, 100, (2, 32)).astype(np.int64),
+    }
+    losses = {}
+    for fused in (True, False):
+        main, start = fluid.Program(), fluid.Program()
+        main.random_seed = start.random_seed = 11
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope), fluid.program_guard(main, start):
+            with fluid.unique_name.guard():
+                ids = layers.data(name="ids", shape=[2, 32], dtype="int64",
+                                  append_batch_size=False)
+                labels = layers.data(name="labels", shape=[2, 32],
+                                     dtype="int64", append_batch_size=False)
+                import paddle_tpu.models.transformer as tfm
+                x = tfm._embed(ids, 100, 32, 32, "lm")
+                for i in range(2):
+                    h = tfm._pre_norm(x)
+                    attn = tfm.multi_head_attention(
+                        h, h, 4, 32, causal=True, name="l%d" % i,
+                        use_fused=fused)
+                    x = layers.elementwise_add(x, attn)
+                x = tfm._pre_norm(x)
+                logits = layers.fc(x, 100, num_flatten_dims=2)
+                loss = layers.mean(layers.softmax_with_cross_entropy(
+                    layers.reshape(logits, shape=[64, 100]),
+                    layers.reshape(labels, shape=[64, 1])))
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(start)
+            losses[fused], = exe.run(main, feed=feed, fetch_list=[loss])
+    np.testing.assert_allclose(losses[True], losses[False], rtol=1e-4)
+
+
+def test_fused_attention_dropout_off_in_test_clone():
+    """clone(for_test=True) must disable fused-attention dropout."""
+    r = np.random.RandomState(6)
+    qv = r.randn(1, 2, 16, 8).astype(np.float32)
+    q = layers.data(name="q", shape=[1, 2, 16, 8], append_batch_size=False)
+    out = layers.fused_attention(q, q, q, causal=True, dropout_rate=0.5)
+    test_prog = fluid.default_main_program().clone(for_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    t1, = exe.run(test_prog, feed={"q": qv}, fetch_list=[out.name])
+    t2, = exe.run(test_prog, feed={"q": qv}, fetch_list=[out.name])
+    np.testing.assert_array_equal(t1, t2)
+    # train program: dropout active -> differs across steps
+    a1, = exe.run(feed={"q": qv}, fetch_list=[out])
+    a2, = exe.run(feed={"q": qv}, fetch_list=[out])
+    assert not np.array_equal(a1, a2)
