@@ -1,5 +1,5 @@
 """paddle_tpu.layers (reference: python/paddle/fluid/layers/__init__.py)."""
-from . import nn, ops, tensor, io, metric_op, learning_rate_scheduler, control_flow
+from . import nn, ops, tensor, io, metric_op, learning_rate_scheduler, control_flow, detection
 from .nn import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
@@ -7,6 +7,7 @@ from .io import *  # noqa: F401,F403
 from .metric_op import *  # noqa: F401,F403
 from .learning_rate_scheduler import *  # noqa: F401,F403
 from .control_flow import *  # noqa: F401,F403
+from .detection import *  # noqa: F401,F403
 
 __all__ = (
     nn.__all__
@@ -16,4 +17,5 @@ __all__ = (
     + metric_op.__all__
     + learning_rate_scheduler.__all__
     + control_flow.__all__
+    + detection.__all__
 )

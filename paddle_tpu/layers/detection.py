@@ -1,0 +1,325 @@
+"""Detection layers (SSD stack).
+
+Reference: python/paddle/fluid/layers/detection.py — prior_box,
+multi_box_head, bipartite_match, target_assign, box_coder, iou_similarity,
+ssd_loss, detection_output (multiclass NMS), detection_map,
+polygon_box_transform.
+
+Dense+lengths convention: per-image ground truth is (B, G, ...) padded with
+a `gt_count` (B,) companion instead of the reference's LoD lists; NMS
+outputs are fixed-size (B, keep_top_k, 6) padded with -1 plus a count.
+"""
+from __future__ import annotations
+
+import math
+
+from ..layer_helper import LayerHelper
+from . import nn
+from . import ops as ops_layers
+from . import tensor as tensor_layers
+
+__all__ = [
+    "prior_box", "multi_box_head", "bipartite_match", "target_assign",
+    "box_coder", "iou_similarity", "ssd_loss", "detection_output",
+    "detection_map", "polygon_box_transform",
+]
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    """reference detection.py:iou_similarity — pairwise IoU between (N, 4)
+    (or (B, N, 4)) and (M, 4) boxes."""
+    helper = LayerHelper("iou_similarity", name=name)
+    shape = tuple(x.shape[:-1]) + (y.shape[-2],)
+    out = helper.create_variable_for_type_inference("float32", shape=shape)
+    helper.append_op(
+        type="iou_similarity", inputs={"X": [x], "Y": [y]},
+        outputs={"Out": [out]},
+        attrs={"box_normalized": box_normalized})
+    return out
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, name=None):
+    """reference detection.py:box_coder — encode/decode center-size offsets
+    against prior boxes."""
+    helper = LayerHelper("box_coder", name=name)
+    if code_type == "encode_center_size" and len(target_box.shape) == 2:
+        shape = (target_box.shape[0], prior_box.shape[0], 4)
+    else:
+        shape = tuple(target_box.shape)
+    out = helper.create_variable_for_type_inference(
+        target_box.dtype, shape=shape)
+    inputs = {"PriorBox": [prior_box], "TargetBox": [target_box]}
+    if prior_box_var is not None:
+        inputs["PriorBoxVar"] = [prior_box_var]
+    helper.append_op(
+        type="box_coder", inputs=inputs, outputs={"OutputBox": [out]},
+        attrs={"code_type": code_type, "box_normalized": box_normalized})
+    return out
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    row_valid=None, name=None):
+    """reference detection.py:bipartite_match — greedy max matching; returns
+    (matched_indices (B, M) int32 with -1 = unmatched, matched_distance).
+    `row_valid` (B,) marks how many rows (gt boxes) are real."""
+    helper = LayerHelper("bipartite_match", name=name)
+    b = dist_matrix.shape[0] if len(dist_matrix.shape) == 3 else 1
+    m = dist_matrix.shape[-1]
+    match_indices = helper.create_variable_for_type_inference(
+        "int32", shape=(b, m))
+    match_distance = helper.create_variable_for_type_inference(
+        "float32", shape=(b, m))
+    inputs = {"DistMat": [dist_matrix]}
+    if row_valid is not None:
+        inputs["RowValid"] = [row_valid]
+    helper.append_op(
+        type="bipartite_match", inputs=inputs,
+        outputs={"ColToRowMatchIndices": [match_indices],
+                 "ColToRowMatchDist": [match_distance]},
+        attrs={"match_type": match_type or "bipartite",
+               "dist_threshold": dist_threshold or 0.5})
+    return match_indices, match_distance
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=None, name=None):
+    """reference detection.py:target_assign — gather per-prior targets by
+    match indices; unmatched slots get mismatch_value and weight 0."""
+    helper = LayerHelper("target_assign", name=name)
+    b, m = matched_indices.shape
+    out = helper.create_variable_for_type_inference(
+        input.dtype, shape=(b, m, input.shape[-1]))
+    out_weight = helper.create_variable_for_type_inference(
+        "float32", shape=(b, m, 1))
+    helper.append_op(
+        type="target_assign",
+        inputs={"X": [input], "MatchIndices": [matched_indices]},
+        outputs={"Out": [out], "OutWeight": [out_weight]},
+        attrs={"mismatch_value": mismatch_value or 0})
+    return out, out_weight
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction",
+             mining_type="max_negative", normalize=True, sample_size=None,
+             gt_count=None):
+    """reference detection.py:ssd_loss — SSD multibox loss: bipartite/
+    per-prediction matching, hard-negative mining, smooth-L1 location loss +
+    softmax confidence loss. `gt_box` (B, G, 4) / `gt_label` (B, G, 1)
+    padded dense with `gt_count` (B,) (the reference's LoD equivalent).
+    Returns the weighted loss (B, Np, 1)."""
+    if mining_type != "max_negative":
+        raise ValueError("only mining_type='max_negative' is supported")
+    helper = LayerHelper("ssd_loss")
+    b, np_, c = confidence.shape
+
+    # 1. match priors to ground truth by IoU
+    iou = iou_similarity(gt_box, prior_box)  # (B, G, Np)
+    matched, matched_dist = bipartite_match(
+        iou, match_type, overlap_threshold, row_valid=gt_count)
+
+    # 2. per-prior class target (background for unmatched)
+    if len(gt_label.shape) == 2:
+        gt_label = nn.reshape(gt_label, shape=[b, gt_label.shape[1], 1])
+    gt_label_f = tensor_layers.cast(gt_label, "float32")
+    target_label_f, _ = target_assign(
+        gt_label_f, matched, mismatch_value=background_label)
+    target_label = tensor_layers.cast(target_label_f, "int64")  # (B, Np, 1)
+
+    conf_flat = nn.reshape(confidence, shape=[b * np_, c])
+    label_flat = nn.reshape(target_label, shape=[b * np_, 1])
+    conf_loss = nn.softmax_with_cross_entropy(conf_flat, label_flat)
+    conf_loss = nn.reshape(conf_loss, shape=[b, np_])
+
+    # 3. mine hard negatives on the confidence loss
+    neg_mask = _mine_hard_examples(
+        helper, conf_loss, matched, matched_dist, neg_pos_ratio, neg_overlap,
+        sample_size)
+
+    # 4. location targets: matched gt encoded against each prior
+    matched_gt_box, pos_weight = target_assign(gt_box, matched)
+    loc_target = box_coder(prior_box, prior_box_var, matched_gt_box)
+    loc_diff = nn.smooth_l1(
+        nn.reshape(location, shape=[b * np_, 4]),
+        nn.reshape(loc_target, shape=[b * np_, 4]))
+    loc_loss = nn.reshape(loc_diff, shape=[b, np_])
+
+    # 5. weighted sum, normalized by matched-prior count
+    pos_w = nn.reshape(pos_weight, shape=[b, np_])
+    neg_w = tensor_layers.cast(neg_mask, "float32")
+    conf_w = ops_layers.elementwise_add(pos_w, neg_w)
+    loss = ops_layers.elementwise_add(
+        ops_layers.scale(ops_layers.elementwise_mul(loc_loss, pos_w), scale=loc_loss_weight),
+        ops_layers.scale(ops_layers.elementwise_mul(conf_loss, conf_w),
+                 scale=conf_loss_weight))
+    if normalize:
+        denom = nn.reduce_sum(pos_w)
+        denom = ops_layers.clip(denom, min=1.0, max=float(b * np_))
+        loss = ops_layers.elementwise_div(loss, denom)
+    return nn.reshape(loss, shape=[b, np_, 1])
+
+
+def _mine_hard_examples(helper, conf_loss, matched, matched_dist,
+                        neg_pos_ratio, neg_overlap, sample_size):
+    b, m = conf_loss.shape
+    neg_mask = helper.create_variable_for_type_inference(
+        "int32", shape=(b, m))
+    num_neg = helper.create_variable_for_type_inference("int32", shape=(b,))
+    helper.append_op(
+        type="mine_hard_examples",
+        inputs={"ClsLoss": [conf_loss], "MatchIndices": [matched],
+                "MatchDist": [matched_dist]},
+        outputs={"NegMask": [neg_mask], "NumNeg": [num_neg]},
+        attrs={"neg_pos_ratio": neg_pos_ratio,
+               "neg_dist_threshold": neg_overlap,
+               "sample_size": sample_size})
+    return neg_mask
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0):
+    """reference detection.py:detection_output — decode + multiclass NMS.
+    Returns (out (B, keep_top_k, 6) [-1-padded rows of
+    [label, score, x1, y1, x2, y2]], out_count (B,))."""
+    helper = LayerHelper("detection_output")
+    b = loc.shape[0]
+    keep = min(int(keep_top_k), int(nms_top_k) * int(scores.shape[-1]))
+    out = helper.create_variable_for_type_inference(
+        "float32", shape=(b, keep, 6))
+    out_count = helper.create_variable_for_type_inference(
+        "int32", shape=(b,))
+    inputs = {"Loc": [loc], "Scores": [scores], "PriorBox": [prior_box]}
+    if prior_box_var is not None:
+        inputs["PriorBoxVar"] = [prior_box_var]
+    helper.append_op(
+        type="multiclass_nms", inputs=inputs,
+        outputs={"Out": [out], "OutCount": [out_count]},
+        attrs={"background_label": background_label,
+               "nms_threshold": nms_threshold, "nms_top_k": nms_top_k,
+               "keep_top_k": keep_top_k, "score_threshold": score_threshold,
+               "decode": True})
+    return out, out_count
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.3, evaluate_difficult=True,
+                  has_state=None, input_states=None, out_states=None,
+                  ap_version="integral", gt_count=None):
+    """reference detection.py:detection_map — batch mAP. `detect_res` is
+    the dense (B, K, 6) detection_output; `label` is (B, G, 5[,6]) rows
+    [label, x1, y1, x2, y2(, difficult)] with `gt_count` (B,). The
+    reference's cross-batch accumulator states are host-side here
+    (metrics.DetectionMAP)."""
+    helper = LayerHelper("detection_map")
+    m_ap = helper.create_variable_for_type_inference("float32", shape=())
+    inputs = {"DetectRes": [detect_res], "Label": [label]}
+    if gt_count is not None:
+        inputs["GtCount"] = [gt_count]
+    helper.append_op(
+        type="detection_map", inputs=inputs, outputs={"MAP": [m_ap]},
+        attrs={"class_num": class_num, "background_label": background_label,
+               "overlap_threshold": overlap_threshold,
+               "evaluate_difficult": evaluate_difficult,
+               "ap_version": ap_version})
+    return m_ap
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None,
+              min_max_aspect_ratios_order=False):
+    """reference detection.py:prior_box — SSD priors for one feature map.
+    Returns (boxes (H, W, P, 4), variances (H, W, P, 4))."""
+    helper = LayerHelper("prior_box", name=name)
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    num_priors = len(list(min_sizes)) * len(ars) + len(list(max_sizes or []))
+    h, w = input.shape[2], input.shape[3]
+    boxes = helper.create_variable_for_type_inference(
+        "float32", shape=(h, w, num_priors, 4))
+    variances = helper.create_variable_for_type_inference(
+        "float32", shape=(h, w, num_priors, 4))
+    helper.append_op(
+        type="prior_box", inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [boxes], "Variances": [variances]},
+        attrs={"min_sizes": list(min_sizes),
+               "max_sizes": list(max_sizes or []),
+               "aspect_ratios": list(aspect_ratios),
+               "variances": list(variance), "flip": flip, "clip": clip,
+               "step_w": steps[0], "step_h": steps[1], "offset": offset})
+    return boxes, variances
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """reference detection.py:multi_box_head — per-feature-map loc/conf conv
+    heads + priors, concatenated. Returns (mbox_locs (B, P, 4), mbox_confs
+    (B, P, C), boxes (P, 4), variances (P, 4))."""
+    n_layer = len(inputs)
+    if min_sizes is None:
+        # reference size heuristic from min/max ratio
+        min_sizes, max_sizes = [], []
+        step = int(math.floor((max_ratio - min_ratio) / max(n_layer - 2, 1)))
+        for ratio in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.10] + min_sizes
+        max_sizes = [base_size * 0.20] + max_sizes
+
+    locs, confs, all_boxes, all_vars = [], [], [], []
+    for i, inp in enumerate(inputs):
+        mins = min_sizes[i]
+        mins = mins if isinstance(mins, (list, tuple)) else [mins]
+        maxs = max_sizes[i] if max_sizes else None
+        if maxs is not None and not isinstance(maxs, (list, tuple)):
+            maxs = [maxs]
+        ar = aspect_ratios[i]
+        ar = ar if isinstance(ar, (list, tuple)) else [ar]
+        st = steps[i] if steps else (
+            (step_w[i] if step_w else 0.0, step_h[i] if step_h else 0.0))
+        box, var = prior_box(inp, image, mins, maxs, ar, list(variance),
+                             flip, clip, st, offset)
+        h, w, p = box.shape[0], box.shape[1], box.shape[2]
+        num_boxes = h * w * p
+        all_boxes.append(nn.reshape(box, shape=[num_boxes, 4]))
+        all_vars.append(nn.reshape(var, shape=[num_boxes, 4]))
+
+        b = inp.shape[0]
+        loc = nn.conv2d(inp, num_filters=p * 4, filter_size=kernel_size,
+                        padding=pad, stride=stride)
+        loc = nn.transpose(loc, perm=[0, 2, 3, 1])  # (B, H, W, P*4)
+        locs.append(nn.reshape(loc, shape=[b, num_boxes, 4]))
+        conf = nn.conv2d(inp, num_filters=p * num_classes,
+                         filter_size=kernel_size, padding=pad, stride=stride)
+        conf = nn.transpose(conf, perm=[0, 2, 3, 1])
+        confs.append(nn.reshape(conf, shape=[b, num_boxes, num_classes]))
+
+    mbox_locs = tensor_layers.concat(locs, axis=1)
+    mbox_confs = tensor_layers.concat(confs, axis=1)
+    boxes = tensor_layers.concat(all_boxes, axis=0)
+    variances = tensor_layers.concat(all_vars, axis=0)
+    return mbox_locs, mbox_confs, boxes, variances
+
+
+def polygon_box_transform(input, name=None):
+    """reference detection.py:polygon_box_transform (EAST text detection):
+    turn per-pixel offset channels into absolute quad coordinates."""
+    helper = LayerHelper("polygon_box_transform", name=name)
+    out = helper.create_variable_for_type_inference(
+        input.dtype, shape=tuple(input.shape))
+    helper.append_op(
+        type="polygon_box_transform", inputs={"Input": [input]},
+        outputs={"Output": [out]})
+    return out

@@ -17,6 +17,7 @@ __all__ = [
     "polynomial_decay",
     "piecewise_decay",
     "noam_decay",
+    "append_LARS",
 ]
 
 
@@ -113,3 +114,28 @@ def noam_decay(d_model, warmup_steps):
     b = ops.scale(step, scale=float(warmup_steps) ** -1.5)
     m = _binary("elementwise_min", a, b)
     return ops.scale(m, scale=float(d_model) ** -0.5)
+
+
+def append_LARS(params_grads, learning_rate, weight_decay):
+    """reference learning_rate_scheduler.py:append_LARS — layer-wise
+    adaptive rate scaling: per-param lr = global_lr * ||w|| /
+    (||g|| + weight_decay * ||w||). Mutates each param's optimize_attr so
+    the optimizer picks up the decayed lr variable."""
+    from . import nn, ops
+
+    def _balanced_weight(param_norm, grad_norm):
+        if weight_decay == 1.0:
+            return ops.elementwise_add(grad_norm, param_norm)
+        return ops.elementwise_add(
+            grad_norm, ops.scale(param_norm, scale=float(weight_decay)))
+
+    for param, grad in params_grads:
+        param_lr = param.optimize_attr.get("learning_rate", 1.0)
+        param_norm = ops.sqrt(nn.reduce_sum(ops.square(param)))
+        grad_norm = ops.sqrt(nn.reduce_sum(ops.square(grad)))
+        ratio = ops.elementwise_div(
+            param_norm, _balanced_weight(param_norm, grad_norm))
+        decayed_lr = ops.elementwise_mul(learning_rate, ratio)
+        if not (isinstance(param_lr, float) and param_lr == 1.0):
+            decayed_lr = ops.scale(decayed_lr, scale=float(param_lr))
+        param.optimize_attr["learning_rate"] = decayed_lr

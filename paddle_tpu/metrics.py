@@ -10,7 +10,7 @@ import numpy as np
 
 __all__ = [
     "MetricBase", "CompositeMetric", "Precision", "Recall", "Accuracy",
-    "ChunkEvaluator", "EditDistance", "Auc",
+    "ChunkEvaluator", "EditDistance", "Auc", "DetectionMAP",
 ]
 
 
@@ -258,3 +258,26 @@ class Auc(MetricBase):
         y = (tpr[:num_thresholds - 1] + tpr[1:]) / 2.0
         auc_value = float(np.sum(x * y))
         return auc_value
+
+
+class DetectionMAP(MetricBase):
+    """Running mean of per-batch mAP values from layers.detection_map
+    (metrics.py:DetectionMAP)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.value = 0.0
+        self.weight = 0.0
+
+    def update(self, value, weight=1):
+        if not _is_number_or_matrix_(value):
+            raise ValueError("The 'value' must be a number(int, float) or a numpy ndarray.")
+        if not _is_number_(weight):
+            raise ValueError("The 'weight' must be a number(int, float).")
+        self.value += float(np.asarray(value).reshape(-1)[0]) * weight
+        self.weight += weight
+
+    def eval(self):
+        if self.weight == 0:
+            raise ValueError("There is no data in DetectionMAP Metrics.")
+        return self.value / self.weight

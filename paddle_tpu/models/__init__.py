@@ -13,3 +13,4 @@ from . import stacked_lstm  # noqa: F401
 from . import transformer  # noqa: F401
 from . import word2vec  # noqa: F401
 from . import deepfm  # noqa: F401
+from . import se_resnext  # noqa: F401

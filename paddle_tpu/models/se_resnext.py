@@ -1,0 +1,99 @@
+"""SE-ResNeXt (reference capability: benchmark/fluid/models/se_resnext...
+the fluid SE-ResNeXt-50/101/152 image classifiers with squeeze-excitation
+blocks and grouped 3x3 convolutions).
+
+TPU notes: grouped convs lower to XLA feature_group_count (MXU-friendly);
+the squeeze-excitation gate is two tiny fcs + channel scale, which XLA
+fuses into the surrounding convs' epilogues.
+"""
+from __future__ import annotations
+
+from .. import layers
+
+__all__ = ["SE_ResNeXt", "get_model"]
+
+_DEPTH_CFG = {
+    50: ([3, 4, 6, 3], 32),
+    101: ([3, 4, 23, 3], 32),
+    152: ([3, 8, 36, 3], 64),
+}
+
+
+def conv_bn_layer(input, num_filters, filter_size, stride=1, groups=1,
+                  act=None):
+    conv = layers.conv2d(
+        input=input, num_filters=num_filters, filter_size=filter_size,
+        stride=stride, padding=(filter_size - 1) // 2, groups=groups,
+        act=None, bias_attr=False)
+    return layers.batch_norm(input=conv, act=act)
+
+
+def squeeze_excitation(input, num_channels, reduction_ratio=16):
+    pool = layers.pool2d(input=input, pool_type="avg", global_pooling=True)
+    pool = layers.reshape(pool, shape=[pool.shape[0], num_channels])
+    squeeze = layers.fc(input=pool, size=num_channels // reduction_ratio,
+                        act="relu")
+    excitation = layers.fc(input=squeeze, size=num_channels, act="sigmoid")
+    excitation = layers.reshape(
+        excitation, shape=[pool.shape[0], num_channels, 1, 1])
+    return layers.elementwise_mul(input, excitation)
+
+
+def shortcut(input, ch_out, stride):
+    ch_in = input.shape[1]
+    if ch_in != ch_out or stride != 1:
+        return conv_bn_layer(input, ch_out, 1, stride)
+    return input
+
+
+def bottleneck_block(input, num_filters, stride, cardinality,
+                     reduction_ratio=16):
+    conv0 = conv_bn_layer(input, num_filters, 1, act="relu")
+    conv1 = conv_bn_layer(conv0, num_filters, 3, stride=stride,
+                          groups=cardinality, act="relu")
+    conv2 = conv_bn_layer(conv1, num_filters * 2, 1, act=None)
+    scaled = squeeze_excitation(conv2, num_filters * 2, reduction_ratio)
+    short = shortcut(input, num_filters * 2, stride)
+    return layers.relu(layers.elementwise_add(short, scaled))
+
+
+def SE_ResNeXt(input, class_dim=1000, layers_num=50, reduction_ratio=16,
+               num_filters=(128, 256, 512, 1024)):
+    """Build the SE-ResNeXt classifier; returns softmax predictions."""
+    if layers_num not in _DEPTH_CFG:
+        raise ValueError("layers_num must be one of %s" % list(_DEPTH_CFG))
+    depth, cardinality = _DEPTH_CFG[layers_num]
+
+    if layers_num == 152:
+        conv = conv_bn_layer(input, 64, 3, stride=2, act="relu")
+        conv = conv_bn_layer(conv, 64, 3, act="relu")
+        conv = conv_bn_layer(conv, 128, 3, act="relu")
+    else:
+        conv = conv_bn_layer(input, 64, 7, stride=2, act="relu")
+    conv = layers.pool2d(input=conv, pool_size=3, pool_stride=2,
+                         pool_padding=1, pool_type="max")
+
+    for block, n in enumerate(depth):
+        for i in range(n):
+            conv = bottleneck_block(
+                conv, num_filters[block], stride=2 if i == 0 and block != 0
+                else 1, cardinality=cardinality,
+                reduction_ratio=reduction_ratio)
+
+    pool = layers.pool2d(input=conv, pool_type="avg", global_pooling=True)
+    pool = layers.reshape(pool, shape=[pool.shape[0], pool.shape[1]])
+    drop = layers.dropout(pool, dropout_prob=0.5)
+    return layers.fc(input=drop, size=class_dim, act="softmax")
+
+
+def get_model(batch_size=8, image_shape=(3, 224, 224), class_dim=1000,
+              layers_num=50):
+    img = layers.data(name="data",
+                      shape=[batch_size] + list(image_shape),
+                      append_batch_size=False)
+    label = layers.data(name="label", shape=[batch_size, 1], dtype="int64",
+                        append_batch_size=False)
+    predict = SE_ResNeXt(img, class_dim=class_dim, layers_num=layers_num)
+    avg_cost = layers.mean(layers.cross_entropy(input=predict, label=label))
+    acc = layers.accuracy(input=predict, label=label)
+    return avg_cost, acc, (img, label)

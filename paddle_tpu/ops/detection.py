@@ -1,0 +1,430 @@
+"""Detection op kernels: IoU, box coding, matching, hard-example mining,
+multiclass NMS, mean average precision.
+
+Reference: paddle/fluid/operators/detection/* (iou_similarity_op,
+box_coder_op, bipartite_match_op, target_assign_op, mine_hard_examples_op,
+multiclass_nms / detection_output, detection_map_op).
+
+TPU-first design: the reference walks LoD'd per-image ground-truth lists on
+the CPU with data-dependent loop bounds. Here every tensor is dense padded
+(B, G, ...) with explicit counts, and the sequential parts (greedy
+bipartite matching, NMS suppression, mAP matching) are `lax.fori_loop`s
+with static trip counts + masking, so the whole stack stays jittable.
+Boxes are [xmin, ymin, xmax, ymax].
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+_NEG = -1e30
+
+
+def iou_matrix(a, b, box_normalized=True):
+    """a: (..., N, 4), b: (..., M, 4) -> (..., N, M) IoU."""
+    off = 0.0 if box_normalized else 1.0
+    ax1, ay1, ax2, ay2 = (a[..., i] for i in range(4))
+    bx1, by1, bx2, by2 = (b[..., i] for i in range(4))
+    area_a = (ax2 - ax1 + off) * (ay2 - ay1 + off)
+    area_b = (bx2 - bx1 + off) * (by2 - by1 + off)
+    ix1 = jnp.maximum(ax1[..., :, None], bx1[..., None, :])
+    iy1 = jnp.maximum(ay1[..., :, None], by1[..., None, :])
+    ix2 = jnp.minimum(ax2[..., :, None], bx2[..., None, :])
+    iy2 = jnp.minimum(ay2[..., :, None], by2[..., None, :])
+    iw = jnp.maximum(ix2 - ix1 + off, 0.0)
+    ih = jnp.maximum(iy2 - iy1 + off, 0.0)
+    inter = iw * ih
+    union = area_a[..., :, None] + area_b[..., None, :] - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-10), 0.0)
+
+
+@register_op("iou_similarity")
+def _iou_similarity(ctx):
+    x = ctx.input("X")  # (N,4) or (B,N,4)
+    y = ctx.input("Y")  # (M,4)
+    box_normalized = bool(ctx.attr("box_normalized", True))
+    return {"Out": iou_matrix(x, y, box_normalized)}
+
+
+def encode_center_size(target, prior, prior_var):
+    """target (..., 4) gt vs prior (..., 4) -> offsets (..., 4)."""
+    pw = prior[..., 2] - prior[..., 0]
+    ph = prior[..., 3] - prior[..., 1]
+    pcx = prior[..., 0] + 0.5 * pw
+    pcy = prior[..., 1] + 0.5 * ph
+    gw = target[..., 2] - target[..., 0]
+    gh = target[..., 3] - target[..., 1]
+    gcx = target[..., 0] + 0.5 * gw
+    gcy = target[..., 1] + 0.5 * gh
+    out = jnp.stack([
+        (gcx - pcx) / jnp.maximum(pw, 1e-10),
+        (gcy - pcy) / jnp.maximum(ph, 1e-10),
+        jnp.log(jnp.maximum(gw / jnp.maximum(pw, 1e-10), 1e-10)),
+        jnp.log(jnp.maximum(gh / jnp.maximum(ph, 1e-10), 1e-10)),
+    ], axis=-1)
+    if prior_var is not None:
+        out = out / prior_var
+    return out
+
+
+def decode_center_size(code, prior, prior_var):
+    pw = prior[..., 2] - prior[..., 0]
+    ph = prior[..., 3] - prior[..., 1]
+    pcx = prior[..., 0] + 0.5 * pw
+    pcy = prior[..., 1] + 0.5 * ph
+    if prior_var is not None:
+        code = code * prior_var
+    cx = code[..., 0] * pw + pcx
+    cy = code[..., 1] * ph + pcy
+    w = jnp.exp(code[..., 2]) * pw
+    h = jnp.exp(code[..., 3]) * ph
+    return jnp.stack([cx - 0.5 * w, cy - 0.5 * h, cx + 0.5 * w, cy + 0.5 * h],
+                     axis=-1)
+
+
+@register_op("box_coder")
+def _box_coder(ctx):
+    prior = ctx.input("PriorBox")  # (M, 4)
+    prior_var = ctx.input("PriorBoxVar")  # (M, 4) or None
+    target = ctx.input("TargetBox")
+    code_type = ctx.attr("code_type", "encode_center_size")
+    if code_type == "encode_center_size":
+        if target.ndim == 3 and target.shape[1] == prior.shape[0]:
+            # matched layout (B, M, 4): encode each box against ITS prior
+            out = encode_center_size(target, prior[None], (
+                None if prior_var is None else prior_var[None]))
+        else:
+            # reference layout: target (N, 4) vs every prior -> (N, M, 4)
+            out = encode_center_size(
+                target[..., :, None, :], prior[None, :, :],
+                None if prior_var is None else prior_var[None, :, :])
+    else:  # decode: target (..., M, 4) offsets against the M priors
+        out = decode_center_size(
+            target, prior, prior_var)
+    return {"OutputBox": out}
+
+
+@register_op("bipartite_match")
+def _bipartite_match(ctx):
+    """Greedy max matching (bipartite_match_op.cc): repeatedly take the
+    globally best (row, col) pair; each row/col used once. With
+    match_type='per_prediction', unmatched columns additionally match their
+    argmax row when dist >= dist_threshold."""
+    dist = ctx.input("DistMat")  # (B, N, M) or (N, M)
+    squeeze = dist.ndim == 2
+    if squeeze:
+        dist = dist[None]
+    b, n, m = dist.shape
+    match_type = ctx.attr("match_type", "bipartite") or "bipartite"
+    thresh = float(ctx.attr("dist_threshold", 0.5) or 0.5)
+    valid = ctx.input("RowValid")  # (B,) valid row counts (dense gt counts)
+    if valid is not None:
+        row_ok = jnp.arange(n)[None, :] < valid.reshape(-1)[:, None]
+        dist = jnp.where(row_ok[:, :, None], dist, _NEG)
+
+    def one(d):
+        def step(_, carry):
+            match_idx, match_dist, d = carry
+            flat = jnp.argmax(d)
+            i, j = flat // m, flat % m
+            best = d[i, j]
+            ok = best > _NEG / 2
+            match_idx = jnp.where(ok, match_idx.at[j].set(i.astype(jnp.int32)),
+                                  match_idx)
+            match_dist = jnp.where(ok, match_dist.at[j].set(best), match_dist)
+            d = jnp.where(ok, d.at[i, :].set(_NEG).at[:, j].set(_NEG), d)
+            return match_idx, match_dist, d
+
+        init = (jnp.full((m,), -1, jnp.int32), jnp.zeros((m,), d.dtype), d)
+        match_idx, match_dist, _ = lax.fori_loop(0, min(n, m), step, init)
+        return match_idx, match_dist
+
+    match_idx, match_dist = jax.vmap(one)(dist)
+
+    if match_type == "per_prediction":
+        best_row = jnp.argmax(dist, axis=1).astype(jnp.int32)  # (B, M)
+        best_val = jnp.max(dist, axis=1)
+        extra = (match_idx < 0) & (best_val >= thresh)
+        match_idx = jnp.where(extra, best_row, match_idx)
+        match_dist = jnp.where(extra, best_val, match_dist)
+
+    if squeeze:
+        match_idx, match_dist = match_idx, match_dist  # keep (1, M) like ref
+    return {"ColToRowMatchIndices": match_idx, "ColToRowMatchDist": match_dist}
+
+
+@register_op("target_assign")
+def _target_assign(ctx):
+    """Gather rows of X by match_indices; -1 -> mismatch_value, weight 0
+    (target_assign_op.h)."""
+    x = ctx.input("X")  # (B, N, K)
+    match = ctx.input("MatchIndices")  # (B, M)
+    mismatch_value = ctx.attr("mismatch_value", 0)
+    idx = jnp.maximum(match, 0)
+    out = jnp.take_along_axis(x, idx[:, :, None], axis=1)
+    matched = (match >= 0)[:, :, None]
+    out = jnp.where(matched, out, jnp.asarray(mismatch_value, x.dtype))
+    weight = matched.astype(jnp.float32)
+    return {"Out": out, "OutWeight": weight}
+
+
+@register_op("mine_hard_examples")
+def _mine_hard_examples(ctx):
+    """max_negative mining (mine_hard_examples_op.cc): keep the
+    neg_pos_ratio * num_pos highest-loss negatives per image; negatives are
+    unmatched priors with overlap < neg_overlap."""
+    cls_loss = ctx.input("ClsLoss")  # (B, M)
+    match = ctx.input("MatchIndices")  # (B, M)
+    match_dist = ctx.input("MatchDist")  # (B, M)
+    neg_pos_ratio = float(ctx.attr("neg_pos_ratio", 3.0))
+    neg_overlap = float(ctx.attr("neg_dist_threshold", 0.5))
+    sample_size = ctx.attr("sample_size", None)
+    b, m = cls_loss.shape
+
+    is_pos = match >= 0
+    num_pos = jnp.sum(is_pos, axis=1)  # (B,)
+    if sample_size:
+        num_neg = jnp.minimum(jnp.full_like(num_pos, int(sample_size)), m)
+    else:
+        num_neg = jnp.minimum(
+            (num_pos.astype(jnp.float32) * neg_pos_ratio).astype(jnp.int32), m)
+    cand = (~is_pos) & (match_dist < neg_overlap)
+    neg_loss = jnp.where(cand, cls_loss, -jnp.inf)
+    order = jnp.argsort(-neg_loss, axis=1)  # desc
+    rank = jnp.argsort(order, axis=1)  # rank of each prior in the ordering
+    neg_mask = cand & (rank < num_neg[:, None])
+    return {"NegMask": neg_mask.astype(jnp.int32),
+            "NumNeg": num_neg.astype(jnp.int32)}
+
+
+def _nms_keep(boxes, scores, iou_threshold, box_normalized=True):
+    """boxes (K,4) sorted by score desc, scores (K,) (-inf = invalid) ->
+    keep mask (K,) via sequential greedy suppression."""
+    k = boxes.shape[0]
+    iou = iou_matrix(boxes, boxes, box_normalized)
+    valid = scores > -jnp.inf / 2
+
+    def step(i, state):
+        keep, suppressed = state
+        can = valid[i] & ~suppressed[i]
+        keep = keep.at[i].set(can)
+        sup_new = can & (iou[i] > iou_threshold) & (
+            jnp.arange(k) > i)
+        return keep, suppressed | sup_new
+
+    keep, _ = lax.fori_loop(
+        0, k, step, (jnp.zeros((k,), bool), jnp.zeros((k,), bool)))
+    return keep
+
+
+@register_op("multiclass_nms")
+def _multiclass_nms(ctx):
+    """SSD detection_output (multiclass_nms_op.cc): decode loc against
+    priors, per-class NMS, keep overall top keep_top_k. Dense output
+    (B, keep_top_k, 6) rows [label, score, x1, y1, x2, y2], padded with -1;
+    plus OutCount (B,)."""
+    loc = ctx.input("Loc")  # (B, M, 4) encoded offsets (or raw boxes if
+    scores = ctx.input("Scores")  # (B, M, C)
+    prior = ctx.input("PriorBox")  # (M, 4)
+    prior_var = ctx.input("PriorBoxVar")
+    background = int(ctx.attr("background_label", 0))
+    nms_threshold = float(ctx.attr("nms_threshold", 0.3))
+    nms_top_k = int(ctx.attr("nms_top_k", 400))
+    keep_top_k = int(ctx.attr("keep_top_k", 200))
+    score_threshold = float(ctx.attr("score_threshold", 0.01))
+    decode = bool(ctx.attr("decode", True))
+
+    b, m, c = scores.shape
+    boxes = decode_center_size(loc, prior, prior_var) if decode else loc
+    nms_k = min(nms_top_k, m)
+    keep_k = min(keep_top_k, nms_k * c)
+
+    def per_image(boxes_i, scores_i):
+        # (M, 4), (M, C)
+        def per_class(cls_scores):
+            s = jnp.where(cls_scores >= score_threshold, cls_scores, -jnp.inf)
+            top_s, top_i = lax.top_k(s, nms_k)
+            top_boxes = boxes_i[top_i]
+            keep = _nms_keep(top_boxes, top_s, nms_threshold)
+            return jnp.where(keep, top_s, -jnp.inf), top_boxes
+
+        cls_ids = jnp.arange(c)
+        all_s, all_b = jax.vmap(per_class, in_axes=1)(scores_i)  # (C, nms_k)
+        if 0 <= background < c:
+            all_s = all_s.at[background].set(-jnp.inf)
+        labels = jnp.broadcast_to(cls_ids[:, None], (c, nms_k))
+        flat_s = all_s.reshape(-1)
+        flat_b = all_b.reshape(-1, 4)
+        flat_l = labels.reshape(-1)
+        top_s, top_i = lax.top_k(flat_s, keep_k)
+        sel_b = flat_b[top_i]
+        sel_l = flat_l[top_i]
+        ok = top_s > -jnp.inf / 2
+        row = jnp.concatenate([
+            jnp.where(ok, sel_l, -1).astype(jnp.float32)[:, None],
+            jnp.where(ok, top_s, -1.0)[:, None],
+            jnp.where(ok[:, None], sel_b, -1.0),
+        ], axis=1)
+        return row, jnp.sum(ok.astype(jnp.int32))
+
+    out, count = jax.vmap(per_image)(boxes, scores)
+    return {"Out": out, "OutCount": count}
+
+
+@register_op("detection_map")
+def _detection_map(ctx):
+    """mAP over dense detections (detection_map_op.h, ap_type integral or
+    11point). DetectRes (B, K, 6) rows [label, score, x1,y1,x2,y2] (-1 pad);
+    Label (B, G, 5) rows [label, x1,y1,x2,y2] (+ optional difficult col),
+    GtCount (B,)."""
+    det = ctx.input("DetectRes")
+    gt = ctx.input("Label")
+    gt_count = ctx.input("GtCount")
+    class_num = int(ctx.attr("class_num"))
+    overlap_threshold = float(ctx.attr("overlap_threshold", 0.5))
+    ap_version = ctx.attr("ap_version", "integral")
+    evaluate_difficult = bool(ctx.attr("evaluate_difficult", True))
+
+    b, k, _ = det.shape
+    g = gt.shape[1]
+    gt_label = gt[:, :, 0].astype(jnp.int32)
+    gt_box = gt[:, :, 1:5]
+    has_difficult = gt.shape[2] > 5
+    difficult = (gt[:, :, 5] > 0) if has_difficult else jnp.zeros((b, g), bool)
+    gt_valid = jnp.arange(g)[None, :] < (
+        gt_count.reshape(-1)[:, None] if gt_count is not None
+        else jnp.full((b, 1), g))
+    if not evaluate_difficult:
+        gt_eval = gt_valid & ~difficult
+    else:
+        gt_eval = gt_valid
+
+    det_label = det[:, :, 0].astype(jnp.int32)
+    det_score = det[:, :, 1]
+    det_box = det[:, :, 2:6]
+    det_valid = det_label >= 0
+
+    iou = jax.vmap(iou_matrix)(det_box, gt_box)  # (B, K, G)
+
+    def ap_for_class(c):
+        gt_c = gt_eval & (gt_label == c)  # (B, G)
+        npos = jnp.sum(gt_c)
+        det_c = det_valid & (det_label == c)  # (B, K)
+        score = jnp.where(det_c, det_score, -jnp.inf).reshape(-1)  # (B*K,)
+        order = jnp.argsort(-score)  # global desc across batch
+
+        def step(t, state):
+            tp, fp, used = state  # used: (B, G) gt already matched
+            flat = order[t]
+            bi, ki = flat // k, flat % k
+            valid_det = det_c[bi, ki]
+            ious = jnp.where(gt_c[bi] & ~used[bi], iou[bi, ki], -1.0)
+            gj = jnp.argmax(ious)
+            best = ious[gj]
+            hit = valid_det & (best >= overlap_threshold)
+            miss = valid_det & ~hit
+            tp = tp.at[t].set(hit.astype(jnp.float32))
+            fp = fp.at[t].set(miss.astype(jnp.float32))
+            used = jnp.where(hit, used.at[bi, gj].set(True), used)
+            return tp, fp, used
+
+        n = b * k
+        tp, fp, _ = lax.fori_loop(
+            0, n, step,
+            (jnp.zeros((n,)), jnp.zeros((n,)), jnp.zeros((b, g), bool)))
+        ctp = jnp.cumsum(tp)
+        cfp = jnp.cumsum(fp)
+        recall = ctp / jnp.maximum(npos, 1)
+        precision = ctp / jnp.maximum(ctp + cfp, 1e-10)
+        if ap_version == "11point":
+            pts = jnp.arange(11) / 10.0
+            best_p = jax.vmap(
+                lambda r: jnp.max(jnp.where(recall >= r, precision, 0.0))
+            )(pts)
+            ap = jnp.sum(best_p) / 11.0
+        else:  # integral
+            prev_r = jnp.concatenate([jnp.zeros((1,)), recall[:-1]])
+            ap = jnp.sum((recall - prev_r) * precision)
+        return jnp.where(npos > 0, ap, -1.0)  # -1 = class absent
+
+    aps = jax.vmap(ap_for_class)(jnp.arange(class_num))
+    present = aps >= 0
+    m_ap = jnp.sum(jnp.where(present, aps, 0.0)) / jnp.maximum(
+        jnp.sum(present.astype(jnp.float32)), 1.0)
+    return {"MAP": m_ap}
+
+
+@register_op("prior_box")
+def _prior_box(ctx):
+    """SSD prior boxes for one feature map (prior_box_op.cc). Emits
+    (H, W, num_priors, 4) boxes + matching variances."""
+    import numpy as np
+
+    inp = ctx.input("Input")  # (B, C, H, W) feature map
+    image = ctx.input("Image")  # (B, C, IH, IW)
+    min_sizes = [float(s) for s in ctx.attr("min_sizes")]
+    max_sizes = [float(s) for s in (ctx.attr("max_sizes") or [])]
+    aspect_ratios = [float(a) for a in ctx.attr("aspect_ratios", [1.0])]
+    variances = [float(v) for v in ctx.attr("variances",
+                                            [0.1, 0.1, 0.2, 0.2])]
+    flip = bool(ctx.attr("flip", False))
+    clip = bool(ctx.attr("clip", False))
+    step_w = float(ctx.attr("step_w", 0.0))
+    step_h = float(ctx.attr("step_h", 0.0))
+    offset = float(ctx.attr("offset", 0.5))
+
+    h, w = int(inp.shape[2]), int(inp.shape[3])
+    ih, iw = int(image.shape[2]), int(image.shape[3])
+    sw = step_w or iw / w
+    sh = step_h or ih / h
+
+    # expanded aspect ratios: 1.0 first, then each ar (+ 1/ar when flip),
+    # skipping near-duplicates (prior_box_op ExpandAspectRatios)
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if any(abs(ar - e) < 1e-6 for e in ars):
+            continue
+        ars.append(ar)
+        if flip:
+            ars.append(1.0 / ar)
+
+    widths, heights = [], []
+    for ms in min_sizes:
+        for ar in ars:
+            widths.append(ms * np.sqrt(ar))
+            heights.append(ms / np.sqrt(ar))
+        if max_sizes:
+            mx = max_sizes[min_sizes.index(ms)]
+            widths.append(np.sqrt(ms * mx))
+            heights.append(np.sqrt(ms * mx))
+    num_priors = len(widths)
+    widths = np.asarray(widths, np.float32) / iw
+    heights = np.asarray(heights, np.float32) / ih
+
+    cx = (np.arange(w, dtype=np.float32) + offset) * sw / iw  # (W,)
+    cy = (np.arange(h, dtype=np.float32) + offset) * sh / ih  # (H,)
+    cxg, cyg = np.meshgrid(cx, cy)  # (H, W)
+    boxes = np.stack([
+        cxg[:, :, None] - widths / 2, cyg[:, :, None] - heights / 2,
+        cxg[:, :, None] + widths / 2, cyg[:, :, None] + heights / 2,
+    ], axis=-1).astype(np.float32)  # (H, W, P, 4)
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    var = np.broadcast_to(
+        np.asarray(variances, np.float32), boxes.shape).copy()
+    return {"Boxes": jnp.asarray(boxes), "Variances": jnp.asarray(var)}
+
+
+@register_op("polygon_box_transform")
+def _polygon_box_transform(ctx):
+    """(B, 2n, H, W) per-pixel quad offsets -> absolute coordinates
+    (polygon_box_transform_op.cc): x-channels add 4*w, y-channels 4*h."""
+    x = ctx.input("Input")
+    b, c, h, w = x.shape
+    col = jnp.arange(w, dtype=x.dtype)[None, None, None, :] * 4.0
+    row = jnp.arange(h, dtype=x.dtype)[None, None, :, None] * 4.0
+    is_x = (jnp.arange(c) % 2 == 0)[None, :, None, None]
+    return {"Output": jnp.where(is_x, col - x, row - x)}

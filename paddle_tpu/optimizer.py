@@ -86,6 +86,10 @@ class Optimizer:
     def _create_param_lr(self, param_and_grad) -> Variable:
         param = param_and_grad[0]
         param_lr = (param.optimize_attr or {}).get("learning_rate", 1.0)
+        if isinstance(param_lr, Variable):
+            # append_LARS-style schedulers store a per-param lr VARIABLE
+            # (already scaled from the global lr)
+            return param_lr
         base = self._global_learning_rate()
         if param_lr == 1.0:
             return base

@@ -1,0 +1,198 @@
+"""Detection stack tests (SSD): IoU, box coder, matching, NMS, ssd_loss,
+detection_map — vs manual numpy references."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _run(feeds, fetch_list):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    return exe.run(feed=feeds, fetch_list=fetch_list)
+
+
+def _iou(a, b):
+    ix1, iy1 = max(a[0], b[0]), max(a[1], b[1])
+    ix2, iy2 = min(a[2], b[2]), min(a[3], b[3])
+    inter = max(ix2 - ix1, 0) * max(iy2 - iy1, 0)
+    ua = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - inter
+    return inter / ua if ua > 0 else 0.0
+
+
+def test_iou_similarity():
+    x = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32)
+    y = np.array([[0, 0, 2, 2], [2, 2, 4, 4], [0, 0, 1, 1]], np.float32)
+    xv = layers.data(name="x", shape=[2, 4], append_batch_size=False)
+    yv = layers.data(name="y", shape=[3, 4], append_batch_size=False)
+    out = layers.iou_similarity(xv, yv)
+    o, = _run({"x": x, "y": y}, [out])
+    for i in range(2):
+        for j in range(3):
+            np.testing.assert_allclose(o[i, j], _iou(x[i], y[j]), rtol=1e-5)
+
+
+def test_box_coder_roundtrip():
+    r = np.random.RandomState(0)
+    prior = np.array([[0.1, 0.1, 0.5, 0.5], [0.3, 0.2, 0.9, 0.8]], np.float32)
+    var = np.full((2, 4), 0.1, np.float32)
+    gt = np.array([[[0.15, 0.12, 0.55, 0.5], [0.3, 0.3, 0.8, 0.9]]], np.float32)
+    pv = layers.data(name="p", shape=[2, 4], append_batch_size=False)
+    vv = layers.data(name="v", shape=[2, 4], append_batch_size=False)
+    gv = layers.data(name="g", shape=[1, 2, 4], append_batch_size=False)
+    enc = layers.box_coder(pv, vv, gv, code_type="encode_center_size")
+    dec = layers.box_coder(pv, vv, enc, code_type="decode_center_size")
+    d, = _run({"p": prior, "v": var, "g": gt}, [dec])
+    np.testing.assert_allclose(d, gt, rtol=1e-4, atol=1e-5)
+
+
+def test_bipartite_match_greedy():
+    # dist 2x3: row0 best with col1 (0.9), then row1 with col0 (0.6)
+    dist = np.array([[[0.5, 0.9, 0.1], [0.6, 0.7, 0.2]]], np.float32)
+    dv = layers.data(name="d", shape=[1, 2, 3], append_batch_size=False)
+    idx, val = layers.bipartite_match(dv)
+    iv, vv = _run({"d": dist}, [idx, val])
+    np.testing.assert_array_equal(iv[0], [1, 0, -1])
+    np.testing.assert_allclose(vv[0], [0.6, 0.9, 0.0], rtol=1e-6)
+
+
+def test_bipartite_match_per_prediction():
+    dist = np.array([[[0.5, 0.9, 0.6], [0.6, 0.7, 0.2]]], np.float32)
+    dv = layers.data(name="d", shape=[1, 2, 3], append_batch_size=False)
+    idx, _ = layers.bipartite_match(dv, match_type="per_prediction",
+                                    dist_threshold=0.55)
+    iv, = _run({"d": dist}, [idx])
+    # col2 unmatched by bipartite but row0 dist 0.6 >= 0.55 -> extra match
+    np.testing.assert_array_equal(iv[0], [1, 0, 0])
+
+
+def test_detection_output_nms():
+    # 2 priors, 2 classes (0 = background); identical boxes suppress
+    prior = np.array([[0.1, 0.1, 0.5, 0.5], [0.1, 0.1, 0.5, 0.5]], np.float32)
+    loc = np.zeros((1, 2, 4), np.float32)  # decode -> prior boxes themselves
+    scores = np.array([[[0.1, 0.9], [0.2, 0.8]]], np.float32)
+    pv = layers.data(name="p", shape=[2, 4], append_batch_size=False)
+    lv = layers.data(name="l", shape=[1, 2, 4], append_batch_size=False)
+    sv = layers.data(name="s", shape=[1, 2, 2], append_batch_size=False)
+    out, count = layers.detection_output(
+        lv, sv, pv, None, background_label=0, nms_threshold=0.5,
+        nms_top_k=2, keep_top_k=2, score_threshold=0.01)
+    o, c = _run({"p": prior, "l": loc, "s": scores}, [out, count])
+    assert int(c[0]) == 1  # overlapping duplicate suppressed
+    assert o[0, 0, 0] == 1.0 and abs(o[0, 0, 1] - 0.9) < 1e-6
+    np.testing.assert_allclose(o[0, 0, 2:], prior[0], atol=1e-5)
+    assert (o[0, 1] == -1).all()
+
+
+def test_ssd_loss_runs_and_trains():
+    r = np.random.RandomState(0)
+    B, NP, C, G = 2, 8, 4, 3
+
+    def boxes(*shape):
+        x1 = (r.rand(*shape, 2) * 0.5).astype(np.float32)
+        wh = (0.2 + r.rand(*shape, 2) * 0.3).astype(np.float32)
+        return np.concatenate([x1, x1 + wh], axis=-1)
+
+    prior = boxes(NP)
+    var = np.full((NP, 4), 0.1, np.float32)
+    gt_box = boxes(B, G)
+    gt_label = r.randint(1, C, (B, G, 1)).astype(np.int64)
+    gt_count = np.array([3, 2], np.int32)
+    feats = r.randn(B, NP, 16).astype(np.float32)
+
+    x = layers.data(name="x", shape=[B, NP, 16], append_batch_size=False)
+    gb = layers.data(name="gb", shape=[B, G, 4], append_batch_size=False)
+    gl = layers.data(name="gl", shape=[B, G, 1], dtype="int64",
+                     append_batch_size=False)
+    gc = layers.data(name="gc", shape=[B], dtype="int32",
+                     append_batch_size=False)
+    pv = layers.data(name="pv", shape=[NP, 4], append_batch_size=False)
+    vv = layers.data(name="vv", shape=[NP, 4], append_batch_size=False)
+    loc = layers.fc(x, 4, num_flatten_dims=2)
+    conf = layers.fc(x, C, num_flatten_dims=2)
+    loss = layers.reduce_sum(layers.ssd_loss(
+        loc, conf, gb, gl, pv, vv, gt_count=gc))
+    fluid.optimizer.Adam(1e-2).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    feed = {"x": feats, "gb": gt_box, "gl": gt_label, "gc": gt_count,
+            "pv": prior, "vv": var}
+    vals = [float(exe.run(feed=feed, fetch_list=[loss])[0]) for _ in range(10)]
+    assert np.isfinite(vals).all() and vals[-1] < vals[0]
+
+
+def test_detection_map_perfect_and_half():
+    # one image, 2 gt of class 1; detections: one perfect hit + one miss
+    det = np.array([[[1, 0.9, 0.1, 0.1, 0.5, 0.5],
+                     [1, 0.8, 0.6, 0.6, 0.9, 0.9]]], np.float32)
+    gt = np.array([[[1, 0.1, 0.1, 0.5, 0.5],
+                    [1, 0.0, 0.0, 0.05, 0.05]]], np.float32)
+    dv = layers.data(name="d", shape=[1, 2, 6], append_batch_size=False)
+    gv = layers.data(name="g", shape=[1, 2, 5], append_batch_size=False)
+    m = layers.detection_map(dv, gv, class_num=2, overlap_threshold=0.5)
+    mv, = _run({"d": det, "g": gt}, [m])
+    # precision at rank1 = 1 (recall .5), rank2 = .5 (no recall gain)
+    np.testing.assert_allclose(float(mv), 0.5, rtol=1e-5)
+
+
+def test_prior_box_shapes_and_range():
+    img = layers.data(name="img", shape=[1, 3, 64, 64], append_batch_size=False)
+    feat = layers.data(name="f", shape=[1, 8, 8, 8], append_batch_size=False)
+    boxes, variances = layers.prior_box(
+        feat, img, min_sizes=[16.0], max_sizes=[32.0],
+        aspect_ratios=[2.0], flip=True, clip=True)
+    b, v = _run({"img": np.zeros((1, 3, 64, 64), np.float32),
+                 "f": np.zeros((1, 8, 8, 8), np.float32)}, [boxes, variances])
+    assert b.shape == (8, 8, 4, 4)  # ar {1,2,1/2} + max box
+    assert (b >= 0).all() and (b <= 1).all()
+    np.testing.assert_allclose(v[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_multi_box_head_and_ssd_pipeline():
+    B = 1
+    img = layers.data(name="img", shape=[B, 3, 32, 32], append_batch_size=False)
+    c1 = layers.conv2d(img, num_filters=8, filter_size=3, stride=4, padding=1)
+    c2 = layers.conv2d(c1, num_filters=8, filter_size=3, stride=2, padding=1)
+    locs, confs, boxes, variances = layers.multi_box_head(
+        inputs=[c1, c2], image=img, base_size=32, num_classes=3,
+        aspect_ratios=[[2.0], [2.0]], min_ratio=20, max_ratio=90,
+        flip=True)
+    assert locs.shape[2] == 4 and confs.shape[2] == 3
+    assert boxes.shape[0] == locs.shape[1] == confs.shape[1]
+    o = _run({"img": np.random.RandomState(0).rand(B, 3, 32, 32).astype(np.float32)},
+             [locs, confs, boxes, variances])
+    assert np.isfinite(o[0]).all() and np.isfinite(o[1]).all()
+
+
+def test_se_resnext_forward():
+    from paddle_tpu import models
+
+    avg_cost, acc, (img, label) = models.se_resnext.get_model(
+        batch_size=2, image_shape=(3, 64, 64), class_dim=10)
+    r = np.random.RandomState(0)
+    feed = {"data": r.rand(2, 3, 64, 64).astype(np.float32),
+            "label": r.randint(0, 10, (2, 1)).astype(np.int64)}
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    lv, = exe.run(feed=feed, fetch_list=[avg_cost])
+    assert np.isfinite(float(lv))
+
+
+def test_append_lars():
+    r = np.random.RandomState(0)
+    x = layers.data(name="x", shape=[16])
+    y = layers.data(name="y", shape=[1])
+    pred = layers.fc(x, 1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    opt = fluid.optimizer.SGD(learning_rate=0.1)
+    params_grads = opt.backward(loss)
+    lr = fluid.layers.tensor.fill_constant((), "float32", 0.1)
+    layers.append_LARS(params_grads, lr, weight_decay=0.01)
+    opt.apply_gradients(params_grads)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    feed = {"x": r.rand(8, 16).astype(np.float32),
+            "y": r.rand(8, 1).astype(np.float32)}
+    vals = [float(exe.run(feed=feed, fetch_list=[loss])[0]) for _ in range(10)]
+    assert vals[-1] < vals[0]
