@@ -1,21 +1,24 @@
-"""Headline benchmark: decoder-only transformer LM training throughput.
+"""Headline benchmarks: transformer LM + ResNet-50 training throughput.
 
-Prints ONE JSON line: {"metric", "value" (tokens/sec/chip), "unit",
-"vs_baseline"} where vs_baseline = achieved_MFU / 0.50 (the north-star 50%
-MFU target from BASELINE.json; the reference publishes no numbers).
+Prints ONE JSON line. Primary metric: transformer LM tokens/sec/chip with
+"vs_baseline" = achieved_MFU / 0.50 (the north-star 50% MFU target from
+BASELINE.json; the reference publishes no numbers). The same line carries
+a "resnet50" object with images/sec/chip + conv MFU (BASELINE.json
+configs[1]: "ResNet-50 ImageNet on single TPU",
+reference benchmark/fluid/resnet.py:1). Set BENCH_RESNET=0 to skip it.
 
-The whole training step (fwd + bwd + Adam) is one donated jax.jit XLA
-computation produced by tracing the Program — see executor.py.
+The whole training step (fwd + bwd + optimizer) is one donated jax.jit
+XLA computation produced by tracing the Program — see executor.py.
 """
 from __future__ import annotations
 
 import json
+import os as _os
 import time
 
 import numpy as np
 
-# model config (fits a single v5e chip with Adam state in fp32)
-import os as _os
+# LM config (fits a single v5e chip with Adam state in fp32)
 BATCH = int(_os.environ.get("BENCH_BATCH", 8))
 SEQ = int(_os.environ.get("BENCH_SEQ", 1024))
 VOCAB = int(_os.environ.get("BENCH_VOCAB", 32768))
@@ -23,6 +26,13 @@ N_LAYER = int(_os.environ.get("BENCH_LAYERS", 12))
 N_HEAD, D_MODEL, D_INNER = 16, 1024, 4096
 WARMUP, STEPS = int(_os.environ.get("BENCH_WARMUP", 3)), int(_os.environ.get("BENCH_STEPS", 12))
 AMP = _os.environ.get("BENCH_AMP", "1") == "1"
+
+# ResNet-50 config
+RN_BATCH = int(_os.environ.get("BENCH_RN_BATCH", 64))
+RN_STEPS = int(_os.environ.get("BENCH_RN_STEPS", 10))
+RN_WARMUP = int(_os.environ.get("BENCH_RN_WARMUP", 2))
+# fwd matmul+conv FLOPs for ResNet-50 @224 (4.09 GMACs, fvcore-style count)
+RN_FWD_FLOPS_PER_IMG = 2 * 4.089e9
 
 _PEAK_FLOPS = {
     # bf16 peak matmul FLOP/s per chip
@@ -53,13 +63,9 @@ def _train_flops_per_step() -> float:
     return 3.0 * fwd
 
 
-def main():
-    import jax
-
+def bench_lm(dev):
     import paddle_tpu as fluid
     from paddle_tpu import layers, models, optimizer
-
-    dev = jax.devices()[0]
 
     main_p, startup = fluid.Program(), fluid.Program()
     main_p.random_seed = startup.random_seed = 1
@@ -98,20 +104,82 @@ def main():
         out = exe.run(main_p, feed=feed, fetch_list=[loss])
         dt = (time.perf_counter() - t0) / STEPS
 
-    tokens_per_sec = BATCH * SEQ / dt
     mfu = _train_flops_per_step() / dt / _peak_flops(dev)
-    print(json.dumps({
-        "metric": "transformer_lm_train_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec, 1),
-        "unit": "tokens/s",
-        "vs_baseline": round(mfu / 0.50, 4),
+    return {
+        "value": round(BATCH * SEQ / dt, 1),
         "mfu": round(mfu, 4),
         "step_ms": round(dt * 1e3, 2),
         "loss": float(np.asarray(out[0]).reshape(-1)[0]),
+    }
+
+
+def bench_resnet(dev):
+    import paddle_tpu as fluid
+    from paddle_tpu import models, optimizer
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup.random_seed = 1
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(main_p, startup):
+        with fluid.unique_name.guard():
+            avg_cost, acc, feeds = models.resnet.get_model(
+                dataset="imagenet", depth=50)
+            optimizer.Momentum(learning_rate=0.1, momentum=0.9).minimize(
+                avg_cost)
+        if AMP:
+            main_p.enable_mixed_precision()
+
+        exe = fluid.Executor(fluid.TPUPlace() if dev.platform != "cpu"
+                             else fluid.CPUPlace())
+        exe.run(startup)
+
+        r = np.random.RandomState(0)
+        feed = {
+            "data": r.randn(RN_BATCH, 3, 224, 224).astype(np.float32),
+            "label": r.randint(0, 1000, (RN_BATCH, 1)).astype(np.int64),
+        }
+        exe.run(main_p, feed=feed, fetch_list=[])
+        for _ in range(RN_WARMUP):
+            exe.run(main_p, feed=feed, fetch_list=[avg_cost])
+        t0 = time.perf_counter()
+        for _ in range(RN_STEPS - 1):
+            exe.run(main_p, feed=feed, fetch_list=[])
+        out = exe.run(main_p, feed=feed, fetch_list=[avg_cost])
+        dt = (time.perf_counter() - t0) / RN_STEPS
+
+    mfu = 3.0 * RN_FWD_FLOPS_PER_IMG * RN_BATCH / dt / _peak_flops(dev)
+    return {
+        "images_per_sec": round(RN_BATCH / dt, 1),
+        "mfu": round(mfu, 4),
+        "step_ms": round(dt * 1e3, 2),
+        "batch": RN_BATCH,
+        "loss": float(np.asarray(out[0]).reshape(-1)[0]),
+    }
+
+
+def main():
+    import jax
+
+    dev = jax.devices()[0]
+    lm = bench_lm(dev)
+    result = {
+        "metric": "transformer_lm_train_tokens_per_sec_per_chip",
+        "value": lm["value"],
+        "unit": "tokens/s",
+        "vs_baseline": round(lm["mfu"] / 0.50, 4),
+        "mfu": lm["mfu"],
+        "step_ms": lm["step_ms"],
+        "loss": lm["loss"],
         "device": getattr(dev, "device_kind", dev.platform),
         "config": {"batch": BATCH, "seq": SEQ, "vocab": VOCAB,
                    "layers": N_LAYER, "d_model": D_MODEL},
-    }))
+    }
+    if _os.environ.get("BENCH_RESNET", "1") == "1":
+        try:
+            result["resnet50"] = bench_resnet(dev)
+        except Exception as e:  # keep the primary metric even if rn fails
+            result["resnet50"] = {"error": repr(e)[:200]}
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

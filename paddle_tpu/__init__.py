@@ -31,6 +31,7 @@ from .ops import metric as _k_metric  # noqa: F401
 from .ops import control_flow as _k_control_flow  # noqa: F401
 from .ops import decode as _k_decode  # noqa: F401
 from .ops import attention as _k_attention  # noqa: F401
+from .ops import fused_loss as _k_fused_loss  # noqa: F401
 from .ops import detection as _k_detection  # noqa: F401
 
 from .framework import (  # noqa: F401

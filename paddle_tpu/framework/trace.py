@@ -41,6 +41,7 @@ _SKIP_OPS = {"feed", "fetch"}
 _AMP_BF16_OPS = {
     "mul", "matmul", "conv2d", "conv3d", "conv2d_transpose",
     "conv3d_transpose", "sequence_conv", "fused_attention",
+    "fused_lm_head_loss",
 }
 _AMP_FP32_OPS = {
     "softmax_with_cross_entropy", "cross_entropy", "layer_norm",
@@ -183,18 +184,26 @@ def trace_block(block: Block, env: Dict, rng: RngStream) -> Dict:
     # autodiff: ops after it (optimizer/clip/regularizer updates, metrics)
     # are not part of any loss's forward graph. In fluid programs every
     # forward op precedes the first minimize(), so all losses are covered.
+    #
+    # Ops BEFORE the first autodiff are not traced eagerly: they are traced
+    # exactly once, inside the first autodiff's jax.vjp, and their outputs
+    # reach `env` through the vjp's aux (`fenv`). Tracing them both eagerly
+    # and in the vjp would double the HLO (and with a remat policy set the
+    # two copies are not CSE-able — one is checkpointed).
     forward_ops: List[tuple] = []
-    saw_autodiff = False
+    first_ad = next(
+        (i for i, o in enumerate(block.ops) if o.type == "autodiff"), None
+    )
 
     for op_idx, op in enumerate(block.ops):
         if op.type in _SKIP_OPS:
             continue
         if op.type != "autodiff":
+            if first_ad is not None and op_idx < first_ad:
+                forward_ops.append((op, op_idx))  # deferred to the vjp
+                continue
             trace_op(op, block, env, rng.for_op(block.idx, op_idx), subblock_fn)
-            if not saw_autodiff:
-                forward_ops.append((op, op_idx))
             continue
-        saw_autodiff = True
 
         # -- autodiff: differentiate loss wrt params over the full forward
         # prefix (all non-autodiff ops so far), replayed under jax.vjp.
@@ -216,14 +225,22 @@ def trace_block(block: Block, env: Dict, rng: RngStream) -> Dict:
             loss = fenv[loss_name]
             return jnp.sum(loss), fenv
 
+        # gradients are taken at the values the forward pass actually saw
+        # (env_start — the block's entry state), matching the reference's
+        # sequential semantics: backward ops read the activations stored by
+        # the one forward execution, so a second minimize()'s grads are
+        # NOT affected by the first optimizer's in-between param updates.
         pvals = {}
         for name in param_names:
-            if name not in env:
+            if name in env_start:
+                pvals[name] = env_start[name]
+            elif name in env:
+                pvals[name] = env[name]
+            else:
                 raise TraceError(
                     "parameter %r has no value in scope — run the startup "
                     "program first" % name
                 )
-            pvals[name] = env[name]
 
         # memory_optimize() (transpiler/memory_optimizer.py) sets a remat
         # policy: the replayed forward is checkpointed so the backward
@@ -237,12 +254,17 @@ def trace_block(block: Block, env: Dict, rng: RngStream) -> Dict:
         loss_val, vjp_fn, fenv = jax.vjp(fwd_fn, pvals, has_aux=True)
         (grads,) = vjp_fn(jnp.ones_like(loss_val))
 
-        # fenv is the authoritative post-forward env; keep grad vars and
-        # any state written by earlier autodiff sections.
-        merged = dict(env)
-        merged.update(fenv)
-        env.clear()
-        env.update(merged)
+        # adopt from fenv only what the replayed forward PRODUCED: copying
+        # all of fenv would revert state a previous autodiff section's
+        # optimizer ops already updated (fenv's params are env_start
+        # values), silently un-training earlier losses in multi-minimize
+        # (e.g. GAN-style) programs.
+        produced = set()
+        for fop, _ in replay:
+            produced.update(fop.output_arg_names)
+        for name in produced:
+            if name in fenv:
+                env[name] = fenv[name]
         for name in param_names:
             env[grad_var_name(name)] = grads[name]
 

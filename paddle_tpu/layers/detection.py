@@ -187,7 +187,11 @@ def detection_output(loc, scores, prior_box, prior_box_var,
     [label, score, x1, y1, x2, y2]], out_count (B,))."""
     helper = LayerHelper("detection_output")
     b = loc.shape[0]
-    keep = min(int(keep_top_k), int(nms_top_k) * int(scores.shape[-1]))
+    # the kernel keeps min(nms_top_k, M) boxes per class before the global
+    # top-keep_top_k; mirror that here so static shape == traced shape when
+    # the prior count M < nms_top_k
+    keep = min(int(keep_top_k),
+               min(int(nms_top_k), int(loc.shape[1])) * int(scores.shape[-1]))
     out = helper.create_variable_for_type_inference(
         "float32", shape=(b, keep, 6))
     out_count = helper.create_variable_for_type_inference(
@@ -289,6 +293,8 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
         ar = ar if isinstance(ar, (list, tuple)) else [ar]
         st = steps[i] if steps else (
             (step_w[i] if step_w else 0.0, step_h[i] if step_h else 0.0))
+        if not isinstance(st, (list, tuple)):
+            st = (st, st)  # reference accepts per-layer scalar steps
         box, var = prior_box(inp, image, mins, maxs, ar, list(variance),
                              flip, clip, st, offset)
         h, w, p = box.shape[0], box.shape[1], box.shape[2]

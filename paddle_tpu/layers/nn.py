@@ -102,6 +102,7 @@ __all__ = [
     "beam_search",
     "beam_search_decode",
     "fused_attention",
+    "fused_lm_head_loss",
 ]
 
 from .ops import elementwise_add  # re-export for parity
@@ -1984,3 +1985,37 @@ def fused_attention(q, k, v, causal=False, scale=None, sequence_length=None,
                "block_k": block_k or _DEFAULT_ATTN_BLOCK_K},
     )
     return out
+
+
+def fused_lm_head_loss(input, label, size, param_attr=None, bias_attr=None,
+                       block_v=4096, name=None):
+    """Fused vocabulary projection + softmax-cross-entropy: computes the
+    per-token loss of `fc(input, size)` vs `label` WITHOUT materializing
+    the (N, vocab) logits (kernel: ops/fused_loss.py, chunked online
+    logsumexp with a custom backward). Replaces the reference's fc +
+    softmax_with_cross_entropy chain (reference layers/nn.py:fc +
+    operators/softmax_with_cross_entropy_op.cc) for large vocabularies.
+
+    input: (..., D) features; label: (...,) or (..., 1) int ids;
+    returns (N, 1) fp32 loss, N = prod of input's leading dims."""
+    helper = LayerHelper("fused_lm_head_loss", **locals())
+    dtype = helper.input_dtype()
+    d = input.shape[-1]
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=[d, size], dtype=dtype, is_bias=False)
+    inputs = {"X": [input], "W": [w], "Label": [label]}
+    bias_attr = helper.bias_attr
+    if bias_attr is not False:
+        b = helper.create_parameter(
+            attr=bias_attr, shape=[size], dtype=dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    lead = input.shape[:-1]
+    n = -1 if any(s < 0 for s in lead) else _prod(lead)
+    loss = helper.create_variable_for_type_inference("float32", shape=(n, 1))
+    helper.append_op(
+        type="fused_lm_head_loss",
+        inputs=inputs,
+        outputs={"Loss": [loss]},
+        attrs={"block_v": block_v},
+    )
+    return loss

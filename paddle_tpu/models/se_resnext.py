@@ -82,7 +82,8 @@ def SE_ResNeXt(input, class_dim=1000, layers_num=50, reduction_ratio=16,
 
     pool = layers.pool2d(input=conv, pool_type="avg", global_pooling=True)
     pool = layers.reshape(pool, shape=[pool.shape[0], pool.shape[1]])
-    drop = layers.dropout(pool, dropout_prob=0.5)
+    # reference model uses 0.2 (dist_se_resnext.py)
+    drop = layers.dropout(pool, dropout_prob=0.2)
     return layers.fc(input=drop, size=class_dim, act="softmax")
 
 

@@ -196,16 +196,29 @@ def transformer_nmt(
 
 def transformer_lm(
     ids, labels, vocab_size, n_layer=4, n_head=8, d_model=512, d_inner=2048,
-    dropout_rate=0.0, max_len=2048,
+    dropout_rate=0.0, max_len=2048, fused_head=True,
 ):
-    """Decoder-only causal LM (flagship). Returns (avg_cost, logits)."""
+    """Decoder-only causal LM (flagship). Returns (avg_cost, logits).
+
+    fused_head=True (default) computes the vocab projection + loss through
+    `layers.fused_lm_head_loss` — the (B*T, vocab) logits never hit HBM —
+    and returns logits=None. Pass fused_head=False when the logits tensor
+    itself is needed (e.g. decoding/inspection)."""
     x = _embed(ids, vocab_size, d_model, max_len, "lm")
     for i in range(n_layer):
         x = decoder_layer(x, None, n_head, d_model, d_inner, dropout_rate,
                           None, None, "lm.l%d" % i)
     x = _pre_norm(x)
-    logits = _linear(x, vocab_size, "lm.head")
     B, T = ids.shape
+    if fused_head:
+        loss = layers.fused_lm_head_loss(
+            x, labels, vocab_size,
+            param_attr=ParamAttr(name="lm.head.w",
+                                 initializer=NormalInitializer(0.0, 0.02)),
+            bias_attr=ParamAttr(name="lm.head.b"),
+        )
+        return layers.mean(loss), None
+    logits = _linear(x, vocab_size, "lm.head")
     loss = layers.softmax_with_cross_entropy(
         layers.reshape(logits, shape=[B * T, vocab_size]),
         layers.reshape(labels, shape=[B * T, 1]),

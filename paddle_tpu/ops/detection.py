@@ -186,11 +186,15 @@ def _mine_hard_examples(ctx):
 
     is_pos = match >= 0
     num_pos = jnp.sum(is_pos, axis=1)  # (B,)
+    num_neg = jnp.minimum(
+        (num_pos.astype(jnp.float32) * neg_pos_ratio).astype(jnp.int32), m)
     if sample_size:
-        num_neg = jnp.minimum(jnp.full_like(num_pos, int(sample_size)), m)
-    else:
-        num_neg = jnp.minimum(
-            (num_pos.astype(jnp.float32) * neg_pos_ratio).astype(jnp.int32), m)
+        # deliberate divergence: the reference ignores sample_size for
+        # max_negative mining (it only applies to its unsupported
+        # 'hard_example' type); here a caller-provided sample_size acts as
+        # an upper bound on the ratio-derived count so passing it is not
+        # silently meaningless
+        num_neg = jnp.minimum(num_neg, int(sample_size))
     cand = (~is_pos) & (match_dist < neg_overlap)
     neg_loss = jnp.where(cand, cls_loss, -jnp.inf)
     order = jnp.argsort(-neg_loss, axis=1)  # desc

@@ -1,0 +1,142 @@
+"""Fused LM-head softmax-cross-entropy: projection + loss without ever
+materializing the (N, V) logits tensor.
+
+Replaces the reference's `mul` (lm head fc, reference
+python/paddle/fluid/layers/nn.py:fc) + `softmax_with_cross_entropy`
+(reference paddle/fluid/operators/softmax_with_cross_entropy_op.cc) chain
+for large vocabularies. On TPU the unfused chain writes the full (N, V)
+logits to HBM in fp32 (batch 8 x seq 1024 x vocab 32768 = 1 GiB), reads it
+back for the log-softmax, and materializes a same-sized gradient in the
+backward — pure HBM-bandwidth burn on what is otherwise a matmul-bound op.
+
+Here the vocab axis is processed in chunks with an online logsumexp
+(flash-attention-style): the forward saves only X, W, b and the per-row
+logsumexp; the backward recomputes each chunk's logits, forms
+(softmax - onehot) per chunk, and accumulates dX / dW / db — never more
+than one (N, block_v) tile live at a time. Chunks are read from W in
+place via dynamic slices (no transposed copy of the weight). All matmuls
+run on the MXU with fp32 accumulation (`preferred_element_type`), so bf16
+inputs under mixed precision keep full-precision loss/grads.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+_NEG = -1e30
+
+
+def _pad_wb(w, b, block_v):
+    """Pad (D, V) / (V,) up to a multiple of block_v. Padded bias is -1e30
+    so padded logits vanish from the logsumexp (exp(-1e30 - lse) == 0).
+    No copy when V is already aligned (the usual case)."""
+    v = w.shape[1]
+    nblk = -(-v // block_v)
+    pv = nblk * block_v
+    if pv != v:
+        w = jnp.pad(w, ((0, 0), (0, pv - v)))
+        b = jnp.pad(b, (0, pv - v), constant_values=_NEG)
+    return w, b, nblk
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def lm_head_loss(block_v, x, w, b, labels):
+    """x: (N, D); w: (D, V); b: (V,); labels: (N,) int -> loss (N, 1) fp32.
+
+    loss_i = logsumexp_v(x_i @ w + b) - (x_i @ w + b)[labels_i]
+    """
+    loss, _ = _lm_head_fwd(block_v, x, w, b, labels)
+    return loss
+
+
+def _lm_head_fwd(block_v, x, w, b, labels):
+    n = x.shape[0]
+    labels = labels.reshape(n).astype(jnp.int32)
+    wp, bp, nblk = _pad_wb(w, b, block_v)
+    xdt = x.dtype
+
+    def body(j, carry):
+        m, s, picked = carry
+        wb = lax.dynamic_slice_in_dim(wp, j * block_v, block_v, 1)
+        bb = lax.dynamic_slice_in_dim(bp, j * block_v, block_v, 0)
+        logits = jnp.dot(x, wb.astype(xdt),
+                         preferred_element_type=jnp.float32) + bb
+        col = j * block_v + jnp.arange(block_v)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=-1)
+        hit = labels[:, None] == col[None, :]
+        picked = picked + jnp.sum(jnp.where(hit, logits, 0.0), axis=-1)
+        return m_new, s, picked
+
+    m, s, picked = lax.fori_loop(
+        0, nblk, body,
+        (jnp.full((n,), _NEG, jnp.float32),
+         jnp.zeros((n,), jnp.float32),
+         jnp.zeros((n,), jnp.float32)))
+    lse = m + jnp.log(s)
+    loss = (lse - picked)[:, None]
+    return loss, (x, w, b, labels, lse)
+
+
+def _lm_head_bwd(block_v, res, g):
+    x, w, b, labels, lse = res
+    n, d = x.shape
+    v = w.shape[1]
+    gl = g.reshape(n, 1).astype(jnp.float32)
+    wp, bp, nblk = _pad_wb(w, b, block_v)
+    pv = nblk * block_v
+    xdt = x.dtype
+
+    def body(j, carry):
+        dx, dw, db = carry
+        wb = lax.dynamic_slice_in_dim(wp, j * block_v, block_v, 1)
+        bb = lax.dynamic_slice_in_dim(bp, j * block_v, block_v, 0)
+        wbx = wb.astype(xdt)
+        logits = jnp.dot(x, wbx, preferred_element_type=jnp.float32) + bb
+        p = jnp.exp(logits - lse[:, None])  # padded cols: exp(-1e30-lse)=0
+        col = j * block_v + jnp.arange(block_v)
+        hit = labels[:, None] == col[None, :]
+        gch = (p - hit.astype(jnp.float32)) * gl  # (N, BV) fp32
+        gchx = gch.astype(xdt)
+        dwb = jnp.dot(x.T, gchx, preferred_element_type=jnp.float32)
+        dbb = jnp.sum(gch, axis=0)
+        dx = dx + jnp.dot(gchx, wbx.T, preferred_element_type=jnp.float32)
+        dw = lax.dynamic_update_slice_in_dim(dw, dwb, j * block_v, 1)
+        db = lax.dynamic_update_slice_in_dim(db, dbb, j * block_v, 0)
+        return dx, dw, db
+
+    dx, dw, db = lax.fori_loop(
+        0, nblk, body,
+        (jnp.zeros((n, d), jnp.float32),
+         jnp.zeros((d, pv), jnp.float32),
+         jnp.zeros((pv,), jnp.float32)))
+    return (dx.astype(x.dtype), dw[:, :v].astype(w.dtype),
+            db[:v].astype(b.dtype), None)
+
+
+lm_head_loss.defvjp(_lm_head_fwd, _lm_head_bwd)
+
+
+@register_op("fused_lm_head_loss")
+def _fused_lm_head_loss(ctx):
+    """Inputs X: (..., D), W: (D, V), Bias: (V,) optional, Label: (..., 1)
+    or (...,) int. Output Loss: (N, 1) fp32 per-token loss, N = prod of
+    X's leading dims. Attr block_v: vocab chunk size (multiple of 128)."""
+    x = ctx.input("X")
+    w = ctx.input("W")
+    labels = ctx.input("Label")
+    block_v = int(ctx.attr("block_v", 4096))
+    d = x.shape[-1]
+    xf = x.reshape(-1, d)
+    b = ctx.input("Bias")
+    if b is None:
+        b = jnp.zeros((w.shape[1],), jnp.float32)
+    loss = lm_head_loss(block_v, xf, w, b.astype(jnp.float32),
+                        labels.reshape(-1))
+    return {"Loss": loss}

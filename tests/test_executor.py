@@ -119,3 +119,38 @@ def test_program_json_roundtrip():
     ]
     assert clone.global_block().var(y.name).shape == y.shape
     assert len(clone.all_parameters()) == len(prog.all_parameters())
+
+
+def test_two_optimizers_both_train():
+    """GAN-style program: two minimize() calls on disjoint params — BOTH
+    parameter sets must be updated (regression: a later autodiff's forward
+    replay must not revert earlier optimizer updates)."""
+    from paddle_tpu import optimizer
+
+    main, start = fluid.Program(), fluid.Program()
+    main.random_seed = start.random_seed = 3
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(main, start):
+        with fluid.unique_name.guard():
+            x = fluid.layers.data(name="x", shape=[2, 4],
+                                  append_batch_size=False)
+            h1 = fluid.layers.fc(x, 3, param_attr=fluid.ParamAttr(name="w1"),
+                                 bias_attr=False)
+            loss1 = fluid.layers.reduce_mean(fluid.layers.square(h1))
+            h2 = fluid.layers.fc(x, 3, param_attr=fluid.ParamAttr(name="w2"),
+                                 bias_attr=False)
+            loss2 = fluid.layers.reduce_mean(fluid.layers.square(h2))
+            optimizer.SGD(learning_rate=0.1).minimize(
+                loss1, parameter_list=["w1"])
+            optimizer.SGD(learning_rate=0.1).minimize(
+                loss2, parameter_list=["w2"])
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(start)
+        w1_0 = np.array(scope.find_var("w1"))
+        w2_0 = np.array(scope.find_var("w2"))
+        exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                fetch_list=[loss1, loss2])
+        w1_1 = np.array(scope.find_var("w1"))
+        w2_1 = np.array(scope.find_var("w2"))
+    assert not np.allclose(w1_0, w1_1), "first optimizer's update was lost"
+    assert not np.allclose(w2_0, w2_1), "second optimizer's update was lost"

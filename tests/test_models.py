@@ -106,7 +106,8 @@ def test_transformer_lm_causality():
     ids = fluid.layers.data(name="ids", shape=[B, T], dtype="int64", append_batch_size=False)
     lbl = fluid.layers.data(name="lbl", shape=[B, T], dtype="int64", append_batch_size=False)
     _, logits = models.transformer.transformer_lm(
-        ids, lbl, V, n_layer=1, n_head=2, d_model=16, d_inner=32, max_len=T
+        ids, lbl, V, n_layer=1, n_head=2, d_model=16, d_inner=32, max_len=T,
+        fused_head=False,
     )
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(fluid.default_startup_program())

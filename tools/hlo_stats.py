@@ -1,0 +1,19 @@
+from xprof.convert import raw_to_tool_data as rtd
+import glob, json
+fs = glob.glob("/tmp/jaxprof/**/*.xplane.pb", recursive=True)
+data, _ = rtd.xspace_to_tool_data(fs, "hlo_stats", {})
+d = json.loads(data)
+cols = [c["id"] if isinstance(c, dict) else c for c in d["cols"]]
+print(cols)
+rows = []
+for r in d["rows"]:
+    vals = [c.get("v") if isinstance(c, dict) else c for c in (r["c"] if isinstance(r, dict) else r)]
+    rows.append(dict(zip(cols, vals)))
+# sort by total time
+key_time = [c for c in cols if "total" in c.lower() or "time" in c.lower()]
+print(key_time[:6])
+import sys
+tt = "total_time" if "total_time" in cols else key_time[0]
+rows.sort(key=lambda x: -(x.get(tt) or 0))
+for r in rows[:25]:
+    print(json.dumps(r)[:400])

@@ -1,0 +1,20 @@
+from xprof.convert import raw_to_tool_data as rtd
+import glob
+fs = glob.glob("/tmp/jaxprof/**/*.xplane.pb", recursive=True)
+data, _ = rtd.xspace_to_tool_data(fs, "op_profile", {})
+import json
+d = json.loads(data)
+def walk(node, depth=0, path=""):
+    m = node.get("metrics", {})
+    name = node.get("name","")
+    out = []
+    t = m.get("rawTime", 0)
+    out.append((t, depth, name))
+    for c in node.get("children", []):
+        out.extend(walk(c, depth+1, path+"/"+name))
+    return out
+root = d.get("byProgram") or d.get("byCategory")
+rows = walk(root)
+rows.sort(reverse=True)
+for t, depth, name in rows[:45]:
+    print(f"{t/1e9:10.3f}ms  d{depth}  {name[:110]}")

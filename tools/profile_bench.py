@@ -1,0 +1,29 @@
+import os, glob
+import numpy as np, jax
+import paddle_tpu as fluid
+from paddle_tpu import layers, models, optimizer
+
+B,S,V,L,D,F,H = 8,1024,32768,12,1024,4096,16
+main_p, startup = fluid.Program(), fluid.Program()
+main_p.random_seed = startup.random_seed = 1
+scope = fluid.Scope()
+with fluid.scope_guard(scope), fluid.program_guard(main_p, startup):
+    with fluid.unique_name.guard():
+        ids = layers.data(name="ids", shape=[B,S], dtype="int64", append_batch_size=False)
+        lbl = layers.data(name="labels", shape=[B,S], dtype="int64", append_batch_size=False)
+        loss, _ = models.transformer.transformer_lm(ids, lbl, vocab_size=V, n_layer=L, n_head=H, d_model=D, d_inner=F, max_len=S)
+        optimizer.Adam(learning_rate=1e-4).minimize(loss)
+    main_p.enable_mixed_precision()
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+    r = np.random.RandomState(0)
+    feed = {"ids": r.randint(0,V,(B,S)).astype(np.int64),
+            "labels": r.randint(0,V,(B,S)).astype(np.int64)}
+    for _ in range(3):
+        exe.run(main_p, feed=feed, fetch_list=[])
+    with jax.profiler.trace("/tmp/jaxprof"):
+        for _ in range(3):
+            exe.run(main_p, feed=feed, fetch_list=[])
+        import jax.numpy as jnp
+        jax.block_until_ready(scope.find_var("lm.head.w"))
+print(glob.glob("/tmp/jaxprof/**/*.xplane.pb", recursive=True))
