@@ -37,6 +37,22 @@ __all__ = ["Executor"]
 
 
 def _as_feed_array(value, var: Optional[Variable]):
+    if isinstance(value, jax.Array):
+        # device-resident feed: pass through untouched — np.asarray would
+        # round-trip it to host and re-upload every step, which through a
+        # remote-tunneled TPU costs orders of magnitude more than the step
+        # itself (the reference's double_buffer ops exist for the same
+        # reason: keep steady-state batches off the feed path)
+        if var is not None:
+            want = as_numpy_dtype(var.dtype)
+            # with x64 disabled JAX cannot hold an int64 array, so an int32
+            # device array IS the canonical form of an int64 feed; only then
+            # is skipping the cast correct
+            exempt = (np.dtype(want) == np.int64 and value.dtype == jnp.int32
+                      and not jax.config.jax_enable_x64)
+            if np.dtype(value.dtype) != np.dtype(want) and not exempt:
+                value = value.astype(want)
+        return value
     arr = np.asarray(value)
     if var is not None:
         want = as_numpy_dtype(var.dtype)

@@ -1,19 +1,40 @@
+"""Dump the top HLO ops by self time from the newest /tmp/jaxprof capture,
+plus a per-category rollup. Companion to tools/profile_bench.py."""
+import glob
+import json
+import sys
+from collections import defaultdict
+
 from xprof.convert import raw_to_tool_data as rtd
-import glob, json
-fs = glob.glob("/tmp/jaxprof/**/*.xplane.pb", recursive=True)
-data, _ = rtd.xspace_to_tool_data(fs, "hlo_stats", {})
+
+fs = sorted(glob.glob("/tmp/jaxprof/**/*.xplane.pb", recursive=True))
+if not fs:
+    sys.exit("no /tmp/jaxprof/**/*.xplane.pb captures found")
+data, _ = rtd.xspace_to_tool_data(fs[-1:], "hlo_stats", {})
 d = json.loads(data)
 cols = [c["id"] if isinstance(c, dict) else c for c in d["cols"]]
-print(cols)
 rows = []
 for r in d["rows"]:
-    vals = [c.get("v") if isinstance(c, dict) else c for c in (r["c"] if isinstance(r, dict) else r)]
+    vals = [c.get("v") if isinstance(c, dict) else c
+            for c in (r["c"] if isinstance(r, dict) else r)]
     rows.append(dict(zip(cols, vals)))
-# sort by total time
-key_time = [c for c in cols if "total" in c.lower() or "time" in c.lower()]
-print(key_time[:6])
-import sys
-tt = "total_time" if "total_time" in cols else key_time[0]
+
+tt = "total_self_time" if "total_self_time" in cols else "total_time"
+if tt not in cols:
+    sys.exit("no time column in hlo_stats table; columns were: %s" % cols)
+
+cat = defaultdict(float)
+total = 0.0
+for r in rows:
+    t = r.get(tt) or 0
+    cat[r.get("category", "?")] += t
+    total += t
+for k, v in sorted(cat.items(), key=lambda kv: -kv[1]):
+    print("%6.1f%%  %s" % (100 * v / total, k))
+print()
 rows.sort(key=lambda x: -(x.get(tt) or 0))
 for r in rows[:25]:
-    print(json.dumps(r)[:400])
+    expr = (r.get("hlo_op_expression") or "")[:140]
+    print("%5.2f%%  %-22s bound=%-7s %s"
+          % (100 * (r.get(tt) or 0) / total, r.get("category", "?"),
+             r.get("bound_by"), expr))
