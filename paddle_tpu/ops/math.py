@@ -388,8 +388,8 @@ def _dice_loss(ctx):
     eps = ctx.attr("epsilon", 1e-5)
     label_f = label.astype(x.dtype)
     if label_f.shape != x.shape and label_f.shape[-1] == 1:
-        label_f = label_f.reshape(label_f.shape[:-1] + (1,) * 0)[..., 0]
-        label_f = jax.nn.one_hot(label_f.astype(jnp.int32), x.shape[-1], dtype=x.dtype)
+        label_f = jax.nn.one_hot(
+            label_f[..., 0].astype(jnp.int32), x.shape[-1], dtype=x.dtype)
     reduce_dims = tuple(range(1, x.ndim))
     inter = jnp.sum(x * label_f, axis=reduce_dims)
     union = jnp.sum(x, axis=reduce_dims) + jnp.sum(label_f, axis=reduce_dims)

@@ -191,33 +191,37 @@ def _roi_pool(ctx):
     scale = ctx.attr("spatial_scale", 1.0)
     h, w = x.shape[2], x.shape[3]
 
+    def _round_half_away(v):
+        # reference uses C round(): half away from zero (jnp.round is
+        # half-to-even, which shifts regions for coords landing on .5)
+        return jnp.sign(v) * jnp.floor(jnp.abs(v) + 0.5)
+
     def one_roi(roi):
         b = roi[0].astype(jnp.int32)
-        x1 = jnp.round(roi[1] * scale).astype(jnp.int32)
-        y1 = jnp.round(roi[2] * scale).astype(jnp.int32)
-        x2 = jnp.maximum(jnp.round(roi[3] * scale).astype(jnp.int32), x1 + 1)
-        y2 = jnp.maximum(jnp.round(roi[4] * scale).astype(jnp.int32), y1 + 1)
+        # reference roi_pool_op.h: end coordinates are INCLUSIVE
+        # (region width = end - start + 1, min 1)
+        x1 = _round_half_away(roi[1] * scale).astype(jnp.int32)
+        y1 = _round_half_away(roi[2] * scale).astype(jnp.int32)
+        x2 = jnp.maximum(_round_half_away(roi[3] * scale).astype(jnp.int32) + 1, x1 + 1)
+        y2 = jnp.maximum(_round_half_away(roi[4] * scale).astype(jnp.int32) + 1, y1 + 1)
         img = x[b]  # (C, H, W)
         ys = jnp.arange(h)
         xs = jnp.arange(w)
         bin_h = (y2 - y1).astype(jnp.float32) / pooled_h
         bin_w = (x2 - x1).astype(jnp.float32) / pooled_w
-        ybin = jnp.clip(((ys - y1) / jnp.maximum(bin_h, 1e-6)).astype(jnp.int32), 0, pooled_h - 1)
-        xbin = jnp.clip(((xs - x1) / jnp.maximum(bin_w, 1e-6)).astype(jnp.int32), 0, pooled_w - 1)
-        valid_y = (ys >= y1) & (ys < y2)
-        valid_x = (xs >= x1) & (xs < x2)
-        mask = valid_y[:, None] & valid_x[None, :]
-        neg = jnp.full_like(img, -jnp.inf)
-        masked = jnp.where(mask[None], img, neg)
-        onehot_y = jax.nn.one_hot(ybin, pooled_h).T  # (ph, H)
-        onehot_x = jax.nn.one_hot(xbin, pooled_w).T  # (pw, W)
-        # gather-max: iterate bins statically (pooled sizes are small, static)
+        # reference bins OVERLAP: bin i spans [floor(i*bin), ceil((i+1)*bin))
+        # relative to the roi start, so boundary rows/cols belong to both
+        # neighbours; iterate bins statically (pooled sizes are small)
         outs = []
         for i in range(pooled_h):
-            row_mask = onehot_y[i].astype(bool)
-            rows = jnp.where(row_mask[None, :, None], masked, -jnp.inf)
+            hstart = y1 + jnp.floor(i * bin_h).astype(jnp.int32)
+            hend = y1 + jnp.ceil((i + 1) * bin_h).astype(jnp.int32)
+            row_mask = (ys >= jnp.clip(hstart, 0, h)) & (ys < jnp.clip(hend, 0, h)) & (ys < y2)
+            rows = jnp.where(row_mask[None, :, None], img, -jnp.inf)
             for j in range(pooled_w):
-                col_mask = onehot_x[j].astype(bool)
+                wstart = x1 + jnp.floor(j * bin_w).astype(jnp.int32)
+                wend = x1 + jnp.ceil((j + 1) * bin_w).astype(jnp.int32)
+                col_mask = (xs >= jnp.clip(wstart, 0, w)) & (xs < jnp.clip(wend, 0, w)) & (xs < x2)
                 cell = jnp.where(col_mask[None, None, :], rows, -jnp.inf)
                 outs.append(cell.max(axis=(1, 2)))
         out = jnp.stack(outs, axis=1).reshape(img.shape[0], pooled_h, pooled_w)

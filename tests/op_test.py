@@ -1,0 +1,103 @@
+"""OpTest harness: per-op numeric forward + gradient checks.
+
+Modeled on the reference's unittests/op_test.py: every registered kernel is
+exercised directly (one-op Program, traced eagerly without jit) and compared
+against a numpy reference; differentiable ops additionally check
+``jax.grad`` of ``sum(out)`` against central finite differences.
+
+Forward tolerance fp32: 1e-5 (SURVEY §4). Gradient tolerance is relative
+(default 1e-2) because the finite difference itself is fp32.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.framework.core import Program
+from paddle_tpu.framework.trace import RngStream, trace_block
+
+
+def run_op(op_type, inputs, attrs=None, outs=("Out",), env_overrides=None,
+           rng_seed=0):
+    """Build a one-op Program and trace it eagerly. `inputs` maps slot ->
+    array | list of arrays (jnp arrays pass through, so this is jax-
+    differentiable). Returns {slot: value} for `outs`."""
+    prog = Program()
+    block = prog.global_block()
+    env = {}
+    in_map = {}
+    for slot, val in inputs.items():
+        vals = val if isinstance(val, (list, tuple)) else [val]
+        names = []
+        for i, v in enumerate(vals):
+            name = "%s_%d" % (slot.lower(), i)
+            arr = v if isinstance(v, jnp.ndarray) else np.asarray(v)
+            block.create_var(name=name, shape=list(arr.shape),
+                             dtype=str(np.asarray(arr).dtype) if not isinstance(v, jnp.ndarray) else str(arr.dtype))
+            env[name] = jnp.asarray(arr)
+            names.append(name)
+        in_map[slot] = names
+    out_map = {}
+    for slot in outs:
+        name = "out_%s" % slot.lower()
+        block.create_var(name=name, shape=None, dtype="float32")
+        out_map[slot] = [name]
+    block.append_op(type=op_type, inputs=in_map, outputs=out_map,
+                    attrs=dict(attrs or {}))
+    if env_overrides:
+        env.update(env_overrides)
+    rng = RngStream(jax.random.PRNGKey(rng_seed))
+    trace_block(block, env, rng)
+    return {slot: env[out_map[slot][0]] for slot in outs}
+
+
+def check_forward(op_type, inputs, ref, attrs=None, outs=("Out",),
+                  rtol=1e-5, atol=1e-5, **kw):
+    """`ref` returns an array (compared against outs[0]) or a tuple aligned
+    with `outs`."""
+    got = run_op(op_type, inputs, attrs, outs, **kw)
+    want = ref()
+    if not isinstance(want, tuple):
+        want = (want,)
+    for slot, w in zip(outs, want):
+        if w is None:
+            continue
+        g = np.asarray(got[slot], dtype=np.float64) \
+            if np.asarray(got[slot]).dtype.kind == "f" else np.asarray(got[slot])
+        np.testing.assert_allclose(
+            g, np.asarray(w), rtol=rtol, atol=atol,
+            err_msg="%s forward mismatch on slot %s" % (op_type, slot))
+    return got
+
+
+def check_grad(op_type, inputs, wrt, attrs=None, outs=("Out",),
+               eps=1e-3, rtol=1e-2, atol=1e-3, reduce_fn=None):
+    """Compare jax.grad of sum(outs[0]) wrt `inputs[wrt]` against central
+    finite differences. `wrt` is a slot name holding a single float array."""
+    base = {k: v for k, v in inputs.items()}
+    x0 = np.asarray(base[wrt], dtype=np.float32)
+    reduce_fn = reduce_fn or (lambda o: jnp.sum(o))
+
+    def f(x):
+        ins = dict(base)
+        ins[wrt] = x
+        out = run_op(op_type, ins, attrs, outs)[outs[0]]
+        return reduce_fn(out)
+
+    analytic = np.asarray(jax.grad(f)(jnp.asarray(x0)), dtype=np.float64)
+
+    flat = x0.reshape(-1)
+    numeric = np.zeros_like(flat, dtype=np.float64)
+    for i in range(flat.size):
+        xp = flat.copy(); xp[i] += eps
+        xm = flat.copy(); xm[i] -= eps
+        fp = float(f(jnp.asarray(xp.reshape(x0.shape))))
+        fm = float(f(jnp.asarray(xm.reshape(x0.shape))))
+        numeric[i] = (fp - fm) / (2 * eps)
+    numeric = numeric.reshape(x0.shape)
+    scale = max(1.0, np.abs(numeric).max())
+    np.testing.assert_allclose(
+        analytic / scale, numeric / scale, rtol=rtol, atol=atol,
+        err_msg="%s gradient mismatch wrt %s" % (op_type, wrt))

@@ -1,0 +1,156 @@
+"""Numeric checks for the 9 optimizer op kernels vs numpy re-derivations.
+Reference: paddle/fluid/operators/*_op.cc optimizer math (also covered by
+unittests/test_{sgd,momentum,adam,...}_op.py in the reference)."""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from op_test import run_op
+
+
+def rs(seed):
+    return np.random.RandomState(seed)
+
+
+P = rs(0).randn(3, 4).astype(np.float32)
+G = rs(1).randn(3, 4).astype(np.float32)
+LR = np.array([0.1], np.float32)
+
+
+def _got(op, inputs, attrs, outs):
+    r = run_op(op, inputs, attrs, outs=outs)
+    return {k: np.asarray(v, dtype=np.float64) for k, v in r.items()}
+
+
+def test_sgd():
+    out = _got("sgd", {"Param": P, "Grad": G, "LearningRate": LR}, {},
+               ("ParamOut",))
+    np.testing.assert_allclose(out["ParamOut"], P - 0.1 * G, rtol=1e-6)
+
+
+@pytest.mark.parametrize("nesterov", [False, True])
+def test_momentum(nesterov):
+    v = rs(2).randn(3, 4).astype(np.float32)
+    out = _got("momentum",
+               {"Param": P, "Grad": G, "Velocity": v, "LearningRate": LR},
+               {"mu": 0.9, "use_nesterov": nesterov},
+               ("ParamOut", "VelocityOut"))
+    v_new = 0.9 * v + G
+    p_new = P - (G + 0.9 * v_new) * 0.1 if nesterov else P - 0.1 * v_new
+    np.testing.assert_allclose(out["VelocityOut"], v_new, rtol=1e-6)
+    np.testing.assert_allclose(out["ParamOut"], p_new, rtol=1e-6)
+
+
+def test_adam():
+    m = rs(3).randn(3, 4).astype(np.float32)
+    v = np.abs(rs(4).randn(3, 4)).astype(np.float32)
+    b1p = np.array([0.9 ** 3], np.float32)
+    b2p = np.array([0.999 ** 3], np.float32)
+    out = _got("adam", {"Param": P, "Grad": G, "Moment1": m, "Moment2": v,
+                        "LearningRate": LR, "Beta1Pow": b1p, "Beta2Pow": b2p},
+               {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8},
+               ("ParamOut", "Moment1Out", "Moment2Out", "Beta1PowOut",
+                "Beta2PowOut"))
+    m_new = 0.9 * m + 0.1 * G
+    v_new = 0.999 * v + 0.001 * G * G
+    lr_t = 0.1 * np.sqrt(1 - b2p) / (1 - b1p)
+    p_new = P - lr_t * m_new / (np.sqrt(v_new) + 1e-8)
+    np.testing.assert_allclose(out["Moment1Out"], m_new, rtol=1e-6)
+    np.testing.assert_allclose(out["Moment2Out"], v_new, rtol=1e-5)
+    np.testing.assert_allclose(out["ParamOut"], p_new, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(out["Beta1PowOut"], b1p * 0.9, rtol=1e-6)
+    np.testing.assert_allclose(out["Beta2PowOut"], b2p * 0.999, rtol=1e-6)
+
+
+def test_adamax():
+    m = rs(5).randn(3, 4).astype(np.float32)
+    inf = np.abs(rs(6).randn(3, 4)).astype(np.float32)
+    b1p = np.array([0.9 ** 2], np.float32)
+    out = _got("adamax", {"Param": P, "Grad": G, "Moment": m, "InfNorm": inf,
+                          "LearningRate": LR, "Beta1Pow": b1p},
+               {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8},
+               ("ParamOut", "MomentOut", "InfNormOut"))
+    m_new = 0.9 * m + 0.1 * G
+    inf_new = np.maximum(0.999 * inf, np.abs(G))
+    p_new = P - (0.1 / (1 - b1p)) * m_new / (inf_new + 1e-8)
+    np.testing.assert_allclose(out["MomentOut"], m_new, rtol=1e-6)
+    np.testing.assert_allclose(out["InfNormOut"], inf_new, rtol=1e-6)
+    np.testing.assert_allclose(out["ParamOut"], p_new, rtol=1e-5, atol=1e-6)
+
+
+def test_adagrad():
+    m = np.abs(rs(7).randn(3, 4)).astype(np.float32)
+    out = _got("adagrad", {"Param": P, "Grad": G, "Moment": m,
+                           "LearningRate": LR},
+               {"epsilon": 1e-6}, ("ParamOut", "MomentOut"))
+    m_new = m + G * G
+    p_new = P - 0.1 * G / (np.sqrt(m_new) + 1e-6)
+    np.testing.assert_allclose(out["MomentOut"], m_new, rtol=1e-6)
+    np.testing.assert_allclose(out["ParamOut"], p_new, rtol=1e-5, atol=1e-6)
+
+
+def test_decayed_adagrad():
+    m = np.abs(rs(8).randn(3, 4)).astype(np.float32)
+    out = _got("decayed_adagrad",
+               {"Param": P, "Grad": G, "Moment": m, "LearningRate": LR},
+               {"decay": 0.95, "epsilon": 1e-6}, ("ParamOut", "MomentOut"))
+    m_new = 0.95 * m + 0.05 * G * G
+    p_new = P - 0.1 * G / (np.sqrt(m_new) + 1e-6)
+    np.testing.assert_allclose(out["MomentOut"], m_new, rtol=1e-6)
+    np.testing.assert_allclose(out["ParamOut"], p_new, rtol=1e-5, atol=1e-6)
+
+
+def test_adadelta():
+    asg = np.abs(rs(9).randn(3, 4)).astype(np.float32)
+    asu = np.abs(rs(10).randn(3, 4)).astype(np.float32)
+    out = _got("adadelta",
+               {"Param": P, "Grad": G, "AvgSquaredGrad": asg,
+                "AvgSquaredUpdate": asu},
+               {"rho": 0.95, "epsilon": 1e-6},
+               ("ParamOut", "AvgSquaredGradOut", "AvgSquaredUpdateOut"))
+    asg_new = 0.95 * asg + 0.05 * G * G
+    upd = -np.sqrt((asu + 1e-6) / (asg_new + 1e-6)) * G
+    asu_new = 0.95 * asu + 0.05 * upd * upd
+    np.testing.assert_allclose(out["AvgSquaredGradOut"], asg_new, rtol=1e-6)
+    np.testing.assert_allclose(out["AvgSquaredUpdateOut"], asu_new,
+                               rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(out["ParamOut"], P + upd, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_rmsprop():
+    ms = np.abs(rs(11).randn(3, 4)).astype(np.float32)
+    mom = rs(12).randn(3, 4).astype(np.float32)
+    out = _got("rmsprop",
+               {"Param": P, "Grad": G, "MeanSquare": ms, "Moment": mom,
+                "LearningRate": LR},
+               {"decay": 0.9, "momentum": 0.8, "epsilon": 1e-10},
+               ("ParamOut", "MeanSquareOut", "MomentOut"))
+    ms_new = 0.9 * ms + 0.1 * G * G
+    mom_new = 0.8 * mom + 0.1 * G / np.sqrt(ms_new + 1e-10)
+    np.testing.assert_allclose(out["MeanSquareOut"], ms_new, rtol=1e-6)
+    np.testing.assert_allclose(out["MomentOut"], mom_new, rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(out["ParamOut"], P - mom_new, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_ftrl():
+    sq = np.abs(rs(13).randn(3, 4)).astype(np.float32) + 0.1
+    lin = rs(14).randn(3, 4).astype(np.float32)
+    l1, l2, power = 0.1, 0.2, -0.5
+    out = _got("ftrl",
+               {"Param": P, "Grad": G, "SquaredAccumulator": sq,
+                "LinearAccumulator": lin, "LearningRate": LR},
+               {"l1": l1, "l2": l2, "lr_power": power},
+               ("ParamOut", "SquaredAccumOut", "LinearAccumOut"))
+    new_accum = sq + G * G
+    lin_new = lin + G - (np.sqrt(new_accum) - np.sqrt(sq)) / 0.1 * P
+    x = l1 * np.sign(lin_new) - lin_new
+    y = np.sqrt(new_accum) / 0.1 + 2 * l2
+    p_new = np.where(np.abs(lin_new) > l1, x / y, 0.0)
+    np.testing.assert_allclose(out["SquaredAccumOut"], new_accum, rtol=1e-6)
+    np.testing.assert_allclose(out["LinearAccumOut"], lin_new, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(out["ParamOut"], p_new, rtol=1e-4, atol=1e-5)

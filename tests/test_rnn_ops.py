@@ -1,0 +1,196 @@
+"""Numeric checks for the recurrent kernels (lstm/gru/lstmp/lstm_unit/
+gru_unit) against step-by-step numpy recurrences.
+Reference: paddle/fluid/operators/{lstm,gru,lstmp,lstm_unit,gru_unit}_op.cc.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from op_test import check_grad, run_op
+
+
+def rs(seed):
+    return np.random.RandomState(seed)
+
+
+def _sig(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+B, T, H = 2, 4, 3
+
+
+def _np_lstm(x, w, b, lengths=None, peephole=False, reverse=False,
+             h0=None, c0=None):
+    hid = w.shape[0]
+    bg = b[:4 * hid] if b is not None else np.zeros(4 * hid)
+    if peephole:
+        w_ic, w_fc, w_oc = (b[4 * hid:5 * hid], b[5 * hid:6 * hid],
+                            b[6 * hid:7 * hid])
+    h = np.zeros((x.shape[0], hid)) if h0 is None else h0.copy()
+    c = np.zeros((x.shape[0], hid)) if c0 is None else c0.copy()
+    hs = np.zeros((x.shape[0], x.shape[1], hid))
+    cs = np.zeros_like(hs)
+    order = range(x.shape[1] - 1, -1, -1) if reverse else range(x.shape[1])
+    for t in order:
+        gates = x[:, t] + h @ w + bg
+        gi, gf, gc, go = np.split(gates, 4, axis=-1)
+        if peephole:
+            gi = gi + c * w_ic
+            gf = gf + c * w_fc
+        i, f = _sig(gi), _sig(gf)
+        c_new = f * c + i * np.tanh(gc)
+        if peephole:
+            go = go + c_new * w_oc
+        o = _sig(go)
+        h_new = o * np.tanh(c_new)
+        if lengths is not None:
+            valid = (t < lengths)[:, None]
+            h_new = np.where(valid, h_new, h)
+            c_new = np.where(valid, c_new, c)
+        h, c = h_new, c_new
+        hs[:, t], cs[:, t] = h, c
+    return hs, cs
+
+
+def test_lstm_basic():
+    x = rs(0).randn(B, T, 4 * H).astype(np.float32)
+    w = (rs(1).randn(H, 4 * H) * 0.5).astype(np.float32)
+    b = (rs(2).randn(4 * H) * 0.5).astype(np.float32)
+    got = run_op("lstm", {"Input": x, "Weight": w, "Bias": b},
+                 outs=("Hidden", "Cell"))
+    hs, cs = _np_lstm(x.astype(np.float64), w.astype(np.float64),
+                      b.astype(np.float64))
+    np.testing.assert_allclose(np.asarray(got["Hidden"]), hs, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got["Cell"]), cs, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_lstm_lengths_peephole_reverse():
+    x = rs(3).randn(B, T, 4 * H).astype(np.float32)
+    w = (rs(4).randn(H, 4 * H) * 0.5).astype(np.float32)
+    b = (rs(5).randn(7 * H) * 0.5).astype(np.float32)
+    lengths = np.array([3, 2], np.int32)
+    got = run_op("lstm",
+                 {"Input": x, "Weight": w, "Bias": b, "Lengths": lengths},
+                 attrs={"use_peepholes": True}, outs=("Hidden",))
+    hs, _ = _np_lstm(x.astype(np.float64), w.astype(np.float64),
+                     b.astype(np.float64), lengths=lengths, peephole=True)
+    np.testing.assert_allclose(np.asarray(got["Hidden"]), hs, rtol=1e-4,
+                               atol=1e-5)
+    got = run_op("lstm", {"Input": x, "Weight": w, "Bias": b[:4 * H]},
+                 attrs={"is_reverse": True}, outs=("Hidden",))
+    hs, _ = _np_lstm(x.astype(np.float64), w.astype(np.float64),
+                     b[:4 * H].astype(np.float64), reverse=True)
+    np.testing.assert_allclose(np.asarray(got["Hidden"]), hs, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_lstm_grad():
+    x = rs(6).randn(1, 3, 4 * 2).astype(np.float32)
+    w = (rs(7).randn(2, 4 * 2) * 0.5).astype(np.float32)
+    check_grad("lstm", {"Input": x, "Weight": w}, "Input",
+               outs=("Hidden",), rtol=2e-2, atol=2e-3)
+    check_grad("lstm", {"Input": x, "Weight": w}, "Weight",
+               outs=("Hidden",), rtol=2e-2, atol=2e-3)
+
+
+def _np_gru(x, w, b, lengths=None, h0=None):
+    hid = w.shape[0]
+    b = b if b is not None else np.zeros(3 * hid)
+    w_zr, w_c = w[:, :2 * hid], w[:, 2 * hid:]
+    h = np.zeros((x.shape[0], hid)) if h0 is None else h0.copy()
+    hs = np.zeros((x.shape[0], x.shape[1], hid))
+    for t in range(x.shape[1]):
+        xb = x[:, t] + b
+        xz, xr, xc = np.split(xb, 3, axis=-1)
+        zr = _sig(np.concatenate([xz, xr], -1) + h @ w_zr)
+        z, r = np.split(zr, 2, axis=-1)
+        c = np.tanh(xc + (r * h) @ w_c)
+        h_new = (1 - z) * h + z * c
+        if lengths is not None:
+            valid = (t < lengths)[:, None]
+            h_new = np.where(valid, h_new, h)
+        h = h_new
+        hs[:, t] = h
+    return hs
+
+
+def test_gru():
+    x = rs(8).randn(B, T, 3 * H).astype(np.float32)
+    w = (rs(9).randn(H, 3 * H) * 0.5).astype(np.float32)
+    b = (rs(10).randn(3 * H) * 0.5).astype(np.float32)
+    lengths = np.array([4, 2], np.int32)
+    got = run_op("gru",
+                 {"Input": x, "Weight": w, "Bias": b, "Lengths": lengths},
+                 outs=("Hidden",))
+    hs = _np_gru(x.astype(np.float64), w.astype(np.float64),
+                 b.astype(np.float64), lengths=lengths)
+    np.testing.assert_allclose(np.asarray(got["Hidden"]), hs, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_gru_grad():
+    x = rs(11).randn(1, 3, 3 * 2).astype(np.float32)
+    w = (rs(12).randn(2, 3 * 2) * 0.5).astype(np.float32)
+    check_grad("gru", {"Input": x, "Weight": w}, "Input",
+               outs=("Hidden",), rtol=2e-2, atol=2e-3)
+
+
+def test_lstmp():
+    P = 2
+    x = rs(13).randn(B, T, 4 * H).astype(np.float32)
+    w = (rs(14).randn(P, 4 * H) * 0.5).astype(np.float32)
+    wp = (rs(15).randn(H, P) * 0.5).astype(np.float32)
+    got = run_op("lstmp", {"Input": x, "Weight": w, "ProjWeight": wp},
+                 outs=("Projection", "Cell"))
+    # numpy: lstm with projected recurrence
+    r = np.zeros((B, P))
+    c = np.zeros((B, H))
+    rsq = np.zeros((B, T, P))
+    for t in range(T):
+        gates = x[:, t].astype(np.float64) + r @ w.astype(np.float64)
+        gi, gf, gc, go = np.split(gates, 4, axis=-1)
+        i, f = _sig(gi), _sig(gf)
+        c = f * c + i * np.tanh(gc)
+        h = _sig(go) * np.tanh(c)
+        r = np.tanh(h @ wp.astype(np.float64))
+        rsq[:, t] = r
+    np.testing.assert_allclose(np.asarray(got["Projection"]), rsq,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_unit():
+    x = rs(16).randn(B, 4 * H).astype(np.float32)
+    c_prev = rs(17).randn(B, H).astype(np.float32)
+    got = run_op("lstm_unit", {"X": x, "C_prev": c_prev},
+                 attrs={"forget_bias": 1.0}, outs=("C", "H"))
+    i, f, c, o = np.split(x.astype(np.float64), 4, axis=-1)
+    new_c = c_prev * _sig(f + 1.0) + _sig(i) * np.tanh(c)
+    new_h = np.tanh(new_c) * _sig(o)
+    np.testing.assert_allclose(np.asarray(got["C"]), new_c, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got["H"]), new_h, rtol=1e-4,
+                               atol=1e-5)
+    check_grad("lstm_unit", {"X": x[:1, :4], "C_prev": c_prev[:1, :1]}, "X",
+               outs=("H",))
+
+
+def test_gru_unit():
+    x = rs(18).randn(B, 3 * H).astype(np.float32)
+    h_prev = rs(19).randn(B, H).astype(np.float32)
+    w = (rs(20).randn(H, 3 * H) * 0.5).astype(np.float32)
+    got = run_op("gru_unit", {"Input": x, "HiddenPrev": h_prev, "Weight": w},
+                 outs=("Hidden",))
+    hid = H
+    xz, xr, xc = (x[:, :hid].astype(np.float64),
+                  x[:, hid:2 * hid].astype(np.float64),
+                  x[:, 2 * hid:].astype(np.float64))
+    w_zr, w_c = w[:, :2 * hid].astype(np.float64), w[:, 2 * hid:].astype(np.float64)
+    zr = _sig(np.concatenate([xz, xr], -1) + h_prev @ w_zr)
+    z, r = zr[:, :hid], zr[:, hid:]
+    c = np.tanh(xc + (r * h_prev) @ w_c)
+    want = (1 - z) * h_prev + z * c
+    np.testing.assert_allclose(np.asarray(got["Hidden"]), want, rtol=1e-4,
+                               atol=1e-5)

@@ -1,0 +1,114 @@
+"""Numeric checks for the dense+lengths sequence kernels.
+Reference LoD semantics: paddle/fluid/operators/sequence_*.cc; here every
+sequence is a padded (batch, time, ...) block with an int32 Lengths vector.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from op_test import check_grad, run_op
+
+
+def rs(seed):
+    return np.random.RandomState(seed)
+
+
+B, T, D = 3, 5, 2
+X = rs(0).randn(B, T, D).astype(np.float32)
+LEN = np.array([5, 3, 1], np.int32)
+MASK = (np.arange(T)[None, :] < LEN[:, None])
+
+
+@pytest.mark.parametrize("ptype,ref", [
+    ("SUM", lambda: (X * MASK[..., None]).sum(1)),
+    ("AVERAGE", lambda: (X * MASK[..., None]).sum(1) / LEN[:, None]),
+    ("SQRT", lambda: (X * MASK[..., None]).sum(1) / np.sqrt(LEN[:, None])),
+    ("MAX", lambda: np.where(MASK[..., None], X, -np.inf).max(1)),
+    ("LAST", lambda: X[np.arange(B), LEN - 1]),
+    ("FIRST", lambda: X[:, 0]),
+])
+def test_sequence_pool(ptype, ref):
+    got = run_op("sequence_pool", {"X": X, "Lengths": LEN},
+                 attrs={"pooltype": ptype})["Out"]
+    np.testing.assert_allclose(np.asarray(got), ref(), rtol=1e-5, atol=1e-6)
+
+
+def test_sequence_pool_grad():
+    check_grad("sequence_pool", {"X": X[:2, :3], "Lengths": LEN[:2]}, "X",
+               attrs={"pooltype": "AVERAGE"})
+
+
+def test_sequence_softmax():
+    x = rs(1).randn(B, T).astype(np.float32)
+    got = np.asarray(run_op("sequence_softmax",
+                            {"X": x, "Lengths": LEN})["Out"])
+    for b in range(B):
+        n = LEN[b]
+        e = np.exp(x[b, :n] - x[b, :n].max())
+        np.testing.assert_allclose(got[b, :n], e / e.sum(), rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_allclose(got[b, n:], 0.0)
+
+
+def test_sequence_mask():
+    got = np.asarray(run_op("sequence_mask", {"X": LEN}, outs=("Y",),
+                            attrs={"maxlen": 6, "out_dtype": "int32"})["Y"])
+    want = (np.arange(6)[None, :] < LEN[:, None]).astype(np.int32)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_sequence_expand():
+    x = rs(2).randn(B, D).astype(np.float32)
+    y = rs(3).randn(B, T, D).astype(np.float32)
+    got = np.asarray(run_op("sequence_expand", {"X": x, "Y": y})["Out"])
+    np.testing.assert_allclose(got, np.broadcast_to(x[:, None], (B, T, D)))
+    got = np.asarray(run_op("sequence_expand_as", {"X": x, "Y": y})["Out"])
+    np.testing.assert_allclose(got, np.broadcast_to(x[:, None], (B, T, D)))
+
+
+def test_sequence_conv():
+    clen = 3
+    filt = (rs(4).randn(clen * D, 4) * 0.5).astype(np.float32)
+    got = np.asarray(run_op(
+        "sequence_conv", {"X": X, "Lengths": LEN, "Filter": filt},
+        attrs={"contextLength": clen, "contextStart": -1})["Out"])
+    xm = X * MASK[..., None]
+    want = np.zeros((B, T, 4))
+    for b in range(B):
+        for t in range(T):
+            ctx = []
+            for off in (-1, 0, 1):
+                tt = t + off
+                ctx.append(xm[b, tt] if 0 <= tt < T else np.zeros(D))
+            want[b, t] = np.concatenate(ctx) @ filt
+    want *= MASK[..., None]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_sequence_reshape():
+    got = np.asarray(run_op("sequence_reshape", {"X": X},
+                            attrs={"new_dim": 1})["Out"])
+    np.testing.assert_allclose(got, X.reshape(B, T * D, 1))
+
+
+def test_sequence_pad_unpad():
+    got = run_op("sequence_pad", {"X": X, "Lengths": LEN},
+                 outs=("Out", "Length"))
+    np.testing.assert_allclose(np.asarray(got["Out"]), X)
+    np.testing.assert_array_equal(np.asarray(got["Length"]), LEN)
+    got = np.asarray(run_op("sequence_unpad", {"X": X})["Out"])
+    np.testing.assert_allclose(got, X)
+
+
+def test_sequence_slice_concat_erase():
+    got = np.asarray(run_op("sequence_slice", {"X": X},
+                            attrs={"offset": 1, "length": 3})["Out"])
+    np.testing.assert_allclose(got, X[:, 1:4])
+    y = rs(5).randn(B, 2, D).astype(np.float32)
+    got = np.asarray(run_op("sequence_concat", {"X": [X, y]})["Out"])
+    np.testing.assert_allclose(got, np.concatenate([X, y], axis=1))
+    ids = np.array([[1, 2, 3, 0, 2]], np.int64)
+    got = np.asarray(run_op("sequence_erase", {"X": ids},
+                            attrs={"tokens": [2, 0]})["Out"])
+    np.testing.assert_array_equal(got, [[1, 0, 3, 0, 0]])
