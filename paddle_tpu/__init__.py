@@ -56,6 +56,7 @@ from .framework import (  # noqa: F401
     unique_name,
 )
 from .executor import Executor  # noqa: F401
+from .io.reader import EOFException  # noqa: F401  (reference: core.EOFException)
 from .backward import append_backward  # noqa: F401
 from . import layers  # noqa: F401
 from . import nets  # noqa: F401
@@ -96,5 +97,14 @@ from .trainer import (  # noqa: F401
     Inferencer,
     Trainer,
 )
+
+from . import lod_tensor  # noqa: F401
+from .lod_tensor import create_lod_tensor, create_random_int_lodtensor  # noqa: F401
+
+# operator sugar on Variable (x + y, x * 0.5, ...) — reference
+# layers/math_op_patch.py applies this at fluid import time too
+from .framework.math_op_patch import monkey_patch_variable as _mpv
+
+_mpv()
 
 __version__ = "0.1.0"

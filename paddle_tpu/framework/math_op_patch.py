@@ -1,0 +1,92 @@
+"""Arithmetic operator sugar on Variable (``x + y``, ``x * 0.5``, ...).
+
+Reference: python/paddle/fluid/layers/math_op_patch.py:22
+(monkey_patch_variable). Binary arithmetic with another Variable appends the
+matching elementwise op; with a python scalar it appends `scale` (for the
+linear cases, one fused multiply-add instead of materializing a constant
+tensor) or a broadcast constant + elementwise op (for pow/rdiv, which are
+not affine). Comparison and __eq__ are deliberately NOT patched (Variables
+are used as dict keys / in fetch lists; identity semantics must survive).
+"""
+from __future__ import annotations
+
+from .core import Variable
+
+_PATCHED = False
+
+
+def _scalar_scale(var, scale, bias):
+    from ..layers import ops as ops_layers
+
+    return ops_layers.scale(var, scale=float(scale), bias=float(bias))
+
+
+def _const_like(var, value):
+    """A constant tensor broadcastable against `var` (batch-size aware)."""
+    from ..layers import tensor as tensor_layers
+
+    shape = list(var.shape)
+    if any(s < 0 for s in shape):
+        return tensor_layers.fill_constant_batch_size_like(
+            input=var, shape=shape, dtype=var.dtype, value=float(value))
+    return tensor_layers.fill_constant(
+        shape=shape or [1], dtype=var.dtype, value=float(value))
+
+
+def _elementwise(op_type, x, y):
+    from ..layer_helper import LayerHelper
+
+    helper = LayerHelper(op_type)
+    shape = y.shape if len(y.shape) > len(x.shape) else x.shape
+    out = helper.create_variable_for_type_inference(dtype=x.dtype,
+                                                    shape=shape)
+    helper.append_op(type=op_type, inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]}, attrs={"axis": -1})
+    return out
+
+
+def _binary(op_type, scalar_fn=None, reverse=False):
+    def method(self, other):
+        if isinstance(other, (int, float)):
+            if scalar_fn is not None:
+                return scalar_fn(self, other)
+            other = _const_like(self, other)
+        elif not isinstance(other, Variable):
+            return NotImplemented
+        x, y = (other, self) if reverse else (self, other)
+        return _elementwise(op_type, x, y)
+
+    method.__name__ = ("__r" if reverse else "__") + op_type.split("_")[-1] + "__"
+    return method
+
+
+def monkey_patch_variable():
+    """Install the operator methods on Variable (idempotent)."""
+    global _PATCHED
+    if _PATCHED:
+        return
+    _PATCHED = True
+
+    Variable.__add__ = _binary(
+        "elementwise_add", lambda v, s: _scalar_scale(v, 1.0, s))
+    Variable.__radd__ = _binary(
+        "elementwise_add", lambda v, s: _scalar_scale(v, 1.0, s),
+        reverse=True)
+    Variable.__sub__ = _binary(
+        "elementwise_sub", lambda v, s: _scalar_scale(v, 1.0, -s))
+    Variable.__rsub__ = _binary(
+        "elementwise_sub", lambda v, s: _scalar_scale(v, -1.0, s),
+        reverse=True)
+    Variable.__mul__ = _binary(
+        "elementwise_mul", lambda v, s: _scalar_scale(v, s, 0.0))
+    Variable.__rmul__ = _binary(
+        "elementwise_mul", lambda v, s: _scalar_scale(v, s, 0.0),
+        reverse=True)
+    Variable.__truediv__ = _binary(
+        "elementwise_div", lambda v, s: _scalar_scale(v, 1.0 / s, 0.0))
+    Variable.__rtruediv__ = _binary("elementwise_div", reverse=True)
+    Variable.__div__ = Variable.__truediv__
+    Variable.__rdiv__ = Variable.__rtruediv__
+    Variable.__pow__ = _binary("elementwise_pow")
+    Variable.__rpow__ = _binary("elementwise_pow", reverse=True)
+    Variable.__neg__ = lambda self: _scalar_scale(self, -1.0, 0.0)

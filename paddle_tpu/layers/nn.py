@@ -83,6 +83,8 @@ __all__ = [
     "sequence_last_step",
     "sequence_expand",
     "sequence_reshape",
+    "sequence_pad",
+    "lod_reset",
     "shape",
     "mean",
     "mul",
@@ -1593,6 +1595,55 @@ def sequence_pool(input, pool_type, sequence_length=None):
         attrs={"pooltype": pool_type.upper()},
     )
     return out
+
+
+def sequence_pad(x, pad_value=None, maxlen=None, sequence_length=None,
+                 name=None):
+    """reference nn.py:sequence_pad (sequence_pad_op.cc). Under the dense +
+    lengths convention the data is already a padded block; this re-pads:
+    positions past each row's length become `pad_value` (a scalar Variable,
+    like the reference) and the time axis is sliced/extended to the static
+    `maxlen`. Returns (out, length) like the reference."""
+    helper = LayerHelper("sequence_pad", name=name)
+    t = maxlen if maxlen and maxlen > 0 else (
+        x.shape[1] if len(x.shape) > 1 else -1)
+    out_shape = (x.shape[0], t) + tuple(x.shape[2:])
+    out = helper.create_variable_for_type_inference(x.dtype, shape=out_shape)
+    length = helper.create_variable_for_type_inference(
+        "int64", shape=(x.shape[0],))
+    inputs = _seq_inputs(x, sequence_length)
+    if pad_value is not None:
+        inputs["PadValue"] = [pad_value]
+    helper.append_op(
+        type="sequence_pad",
+        inputs=inputs,
+        outputs={"Out": [out], "Length": [length]},
+        attrs={"padded_length": int(maxlen) if maxlen else -1},
+    )
+    return out, length
+
+
+def lod_reset(x, y=None, target_lod=None, name=None):
+    """reference nn.py:lod_reset (lod_reset_op.cc). Dense analog: the data
+    passes through and the Lengths companion is replaced by `y` (a lengths
+    Variable) or the static `target_lod` list. Returns (out, out_lengths)."""
+    helper = LayerHelper("lod_reset", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, shape=x.shape)
+    out_len = helper.create_variable_for_type_inference(
+        "int32", shape=(x.shape[0],))
+    inputs = {"X": [x]}
+    attrs = {}
+    if y is not None:
+        inputs["Y"] = [y]
+    elif target_lod is not None:
+        attrs["target_lod"] = list(target_lod)
+    else:
+        raise ValueError("lod_reset: provide y or target_lod")
+    helper.append_op(
+        type="lod_reset", inputs=inputs,
+        outputs={"Out": [out], "OutLengths": [out_len]}, attrs=attrs,
+    )
+    return out, out_len
 
 
 def sequence_first_step(input, sequence_length=None):

@@ -57,6 +57,10 @@ __all__ = list(_UNARY_OPS) + [
     "logical_or",
     "logical_xor",
     "maxout",
+    "slice",
+    "sigmoid_cross_entropy_with_logits",
+    "uniform_random_batch_size_like",
+    "gaussian_random_batch_size_like",
 ]
 
 
@@ -193,5 +197,79 @@ def maxout(x, groups, name=None):
     out = helper.create_variable_for_type_inference(dtype=x.dtype, shape=(n, c // groups, h, w))
     helper.append_op(
         type="maxout", inputs={"X": [x]}, outputs={"Out": [out]}, attrs={"groups": groups}
+    )
+    return out
+
+
+def slice(input, axes, starts, ends, name=None):
+    """reference layers/ops.py:slice (slice_op.cc)."""
+    helper = LayerHelper("slice", name=name)
+    shape = list(input.shape)
+    for ax, st, en in zip(axes, starts, ends):
+        if 0 <= shape[ax]:
+            lo = st if st >= 0 else max(shape[ax] + st, 0)
+            hi = min(en if en >= 0 else shape[ax] + en, shape[ax])
+            shape[ax] = max(hi - lo, 0)
+    out = helper.create_variable_for_type_inference(
+        dtype=input.dtype, shape=tuple(shape))
+    helper.append_op(
+        type="slice",
+        inputs={"Input": [input]},
+        outputs={"Out": [out]},
+        attrs={"axes": list(axes), "starts": list(starts), "ends": list(ends)},
+    )
+    return out
+
+
+def sigmoid_cross_entropy_with_logits(x, label, name=None):
+    """reference layers/ops.py (sigmoid_cross_entropy_with_logits_op.cc)."""
+    helper = LayerHelper("sigmoid_cross_entropy_with_logits", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype, shape=x.shape)
+    helper.append_op(
+        type="sigmoid_cross_entropy_with_logits",
+        inputs={"X": [x], "Label": [label]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32",
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0, name=None):
+    """reference layers/ops.py (uniform_random_batch_size_like_op.cc)."""
+    helper = LayerHelper("uniform_random_batch_size_like", name=name)
+    out_shape = list(shape)
+    out_shape[output_dim_idx] = input.shape[input_dim_idx] if input.ndim else -1
+    out = helper.create_variable_for_type_inference(
+        dtype=dtype, shape=tuple(out_shape))
+    helper.append_op(
+        type="uniform_random_batch_size_like",
+        inputs={"Input": [input]},
+        outputs={"Out": [out]},
+        attrs={"shape": list(shape), "dtype": convert_dtype(dtype),
+               "input_dim_idx": input_dim_idx,
+               "output_dim_idx": output_dim_idx,
+               "min": float(min), "max": float(max), "seed": seed},
+    )
+    return out
+
+
+def gaussian_random_batch_size_like(input, shape, dtype="float32",
+                                    input_dim_idx=0, output_dim_idx=0,
+                                    mean=0.0, std=1.0, seed=0, name=None):
+    """reference layers/ops.py (gaussian_random_batch_size_like_op.cc)."""
+    helper = LayerHelper("gaussian_random_batch_size_like", name=name)
+    out_shape = list(shape)
+    out_shape[output_dim_idx] = input.shape[input_dim_idx] if input.ndim else -1
+    out = helper.create_variable_for_type_inference(
+        dtype=dtype, shape=tuple(out_shape))
+    helper.append_op(
+        type="gaussian_random_batch_size_like",
+        inputs={"Input": [input]},
+        outputs={"Out": [out]},
+        attrs={"shape": list(shape), "dtype": convert_dtype(dtype),
+               "input_dim_idx": input_dim_idx,
+               "output_dim_idx": output_dim_idx,
+               "mean": float(mean), "std": float(std), "seed": seed},
     )
     return out

@@ -1,0 +1,72 @@
+"""LoD-tensor utilities re-expressed for the dense + lengths convention.
+
+Reference: python/paddle/fluid/lod_tensor.py (create_lod_tensor:97,
+create_random_int_lodtensor:152). The reference packs ragged sequences into
+one flattened (sum_len, ...) LoDTensor with offset tables; TPU kernels need
+static shapes, so here a "LoD tensor" is a `SequenceTensor`: a dense padded
+(batch, max_len, ...) array plus an int32 per-row ``lengths`` vector — the
+exact layout every `sequence_*` op and `DataFeeder` sequence slot consumes.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import numpy as np
+
+__all__ = ["SequenceTensor", "create_lod_tensor",
+           "create_random_int_lodtensor"]
+
+
+class SequenceTensor(NamedTuple):
+    """Dense padded data + per-sequence lengths (the LoDTensor analog)."""
+
+    data: np.ndarray      # (batch, max_len, *feature_dims)
+    lengths: np.ndarray   # (batch,) int32
+
+    def recursive_sequence_lengths(self):
+        """Reference LoDTensor.recursive_sequence_lengths() parity."""
+        return [self.lengths.tolist()]
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None) -> SequenceTensor:
+    """Build a SequenceTensor from `data` + one-level sequence lengths.
+
+    `data` may be (a) a list of per-sequence numpy arrays / lists, or (b) a
+    flattened (sum_len, ...) array exactly like the reference accepts, with
+    `recursive_seq_lens` = [[len0, len1, ...]]. `place` is accepted for API
+    parity and ignored (arrays are host staging; the executor moves them).
+    """
+    if len(recursive_seq_lens) != 1:
+        raise NotImplementedError(
+            "only one LoD level is supported in the dense+lengths layout "
+            "(got %d levels)" % len(recursive_seq_lens))
+    lens = np.asarray(recursive_seq_lens[0], np.int32)
+    if isinstance(data, (list, tuple)):
+        # list of per-sequence arrays: concatenate along the time axis
+        flat = np.concatenate([np.asarray(d) for d in data], axis=0)
+    else:
+        flat = np.asarray(data)
+    if flat.shape[0] != int(lens.sum()):
+        raise ValueError(
+            "data rows (%d) != sum of sequence lengths (%d)"
+            % (flat.shape[0], int(lens.sum())))
+    batch = len(lens)
+    max_len = int(lens.max()) if batch else 0
+    feature = flat.shape[1:]
+    out = np.zeros((batch, max_len) + tuple(feature), flat.dtype)
+    off = 0
+    for i, n in enumerate(lens):
+        out[i, :n] = flat[off:off + n]
+        off += n
+    return SequenceTensor(out, lens)
+
+
+def create_random_int_lodtensor(recursive_seq_lens: Sequence[Sequence[int]],
+                                base_shape, place=None, low=0,
+                                high=1) -> SequenceTensor:
+    """Reference lod_tensor.py:152 parity: random ints in [low, high]."""
+    lens = np.asarray(recursive_seq_lens[0], np.int32)
+    total = int(lens.sum())
+    flat = np.random.randint(low, high + 1,
+                             (total,) + tuple(base_shape)).astype(np.int64)
+    return create_lod_tensor(flat, recursive_seq_lens, place)

@@ -397,6 +397,16 @@ def _dice_loss(ctx):
     return {"Out": jnp.mean(1 - dice)}
 
 
+@register_op("sigmoid_cross_entropy_with_logits")
+def _sigmoid_cross_entropy_with_logits(ctx):
+    """reference: sigmoid_cross_entropy_with_logits_op.cc. Numerically
+    stable form: max(x,0) - x*label + log(1+exp(-|x|))."""
+    x = ctx.input("X")
+    label = ctx.input("Label").astype(x.dtype)
+    out = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return {"Out": out}
+
+
 @register_op("huber_loss")
 def _huber_loss(ctx):
     x, y = ctx.input("X"), ctx.input("Y")

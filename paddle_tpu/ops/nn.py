@@ -380,6 +380,43 @@ def _uniform_random(ctx):
     return {"Out": jax.random.uniform(key, tuple(shape), minval=lo, maxval=hi).astype(dtype)}
 
 
+@register_op("uniform_random_batch_size_like")
+def _uniform_random_batch_size_like(ctx):
+    """reference: uniform_random_batch_size_like_op.cc — like uniform_random
+    but the output's batch dim is copied from Input's."""
+    from ..framework.dtypes import as_numpy_dtype
+
+    ref = ctx.input("Input")
+    shape = list(ctx.attr("shape"))
+    in_idx = ctx.attr("input_dim_idx", 0)
+    out_idx = ctx.attr("output_dim_idx", 0)
+    shape[out_idx] = ref.shape[in_idx]
+    lo = ctx.attr("min", -1.0)
+    hi = ctx.attr("max", 1.0)
+    dtype = as_numpy_dtype(ctx.attr("dtype", "float32"))
+    key = ctx.rng()
+    return {"Out": jax.random.uniform(
+        key, tuple(shape), minval=lo, maxval=hi).astype(dtype)}
+
+
+@register_op("gaussian_random_batch_size_like")
+def _gaussian_random_batch_size_like(ctx):
+    """reference: gaussian_random_batch_size_like_op.cc."""
+    from ..framework.dtypes import as_numpy_dtype
+
+    ref = ctx.input("Input")
+    shape = list(ctx.attr("shape"))
+    in_idx = ctx.attr("input_dim_idx", 0)
+    out_idx = ctx.attr("output_dim_idx", 0)
+    shape[out_idx] = ref.shape[in_idx]
+    mean = ctx.attr("mean", 0.0)
+    std = ctx.attr("std", 1.0)
+    dtype = as_numpy_dtype(ctx.attr("dtype", "float32"))
+    key = ctx.rng()
+    return {"Out": (mean + std * jax.random.normal(
+        key, tuple(shape))).astype(dtype)}
+
+
 @register_op("truncated_gaussian_random")
 def _truncated_gaussian_random(ctx):
     from ..framework.dtypes import as_numpy_dtype

@@ -132,11 +132,32 @@ def _sequence_reshape(ctx):
 
 @register_op("sequence_pad")
 def _sequence_pad(ctx):
-    # dense convention: input already padded; just forward with lengths out
+    """Dense analog of sequence_pad (reference: sequence_pad_op.cc). The
+    input is already a padded (B, T, ...) block; this re-pads: positions at
+    or past each row's length are set to PadValue, and the time axis is
+    sliced/extended to the static `padded_length` attr when given."""
     x = ctx.input("X")
     lengths = ctx.input("Lengths")
+    pad_value = ctx.input("PadValue")
+    padded_len = ctx.attr("padded_length", -1)
+    b, t = x.shape[0], x.shape[1]
     if lengths is None:
-        lengths = jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+        lengths = jnp.full((b,), t, jnp.int32)
+    if padded_len is not None and padded_len > 0 and padded_len != t:
+        if padded_len < t:
+            x = x[:, :padded_len]
+        else:
+            cfg = [(0, 0)] * x.ndim
+            cfg[1] = (0, padded_len - t)
+            x = jnp.pad(x, cfg)
+        t = padded_len
+        lengths = jnp.minimum(lengths, t)
+    if pad_value is not None:
+        pv = pad_value.reshape(()).astype(x.dtype) if pad_value.size == 1 \
+            else pad_value.astype(x.dtype)
+        mask = jnp.arange(t)[None, :] < lengths[:, None]  # (B, T)
+        mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+        x = jnp.where(mask, x, pv)
     return {"Out": x, "Length": lengths.astype(jnp.int64)}
 
 
@@ -161,6 +182,22 @@ def _sequence_slice(ctx):
 @register_op("sequence_concat")
 def _sequence_concat(ctx):
     return {"Out": jnp.concatenate(ctx.inputs("X"), axis=1)}
+
+
+@register_op("lod_reset")
+def _lod_reset(ctx):
+    """Dense analog of lod_reset (reference: lod_reset_op.cc): data is
+    untouched; the sequence structure companion is replaced. With dense
+    padded tensors the "LoD" is the Lengths vector, so Out is X and
+    OutLengths is Y (or the static target_lengths attr)."""
+    x = ctx.input("X")
+    y = ctx.input("Y")
+    if y is None:
+        target = ctx.attr("target_lod", None)
+        if target is None:
+            raise ValueError("lod_reset needs Y (lengths) or target_lod")
+        y = jnp.asarray(target, jnp.int32)
+    return {"Out": x, "OutLengths": y.astype(jnp.int32)}
 
 
 @register_op("sequence_erase")

@@ -658,6 +658,9 @@ COVERED_ELSEWHERE = {
     # optimizers: tests/test_optim_ops.py
     "sgd", "momentum", "adam", "adamax", "adagrad", "decayed_adagrad",
     "adadelta", "rmsprop", "ftrl",
+    # round-2 small-op sweep: tests/test_small_ops.py
+    "sigmoid_cross_entropy_with_logits", "uniform_random_batch_size_like",
+    "gaussian_random_batch_size_like", "lod_reset",
 }
 
 # covered directly in this file

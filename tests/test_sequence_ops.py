@@ -101,6 +101,24 @@ def test_sequence_pad_unpad():
     np.testing.assert_allclose(got, X)
 
 
+def test_sequence_pad_value_and_maxlen():
+    pv = np.array([-1.0], np.float32)
+    got = run_op("sequence_pad",
+                 {"X": X, "Lengths": LEN, "PadValue": pv},
+                 attrs={"padded_length": 7}, outs=("Out", "Length"))
+    out = np.asarray(got["Out"])
+    assert out.shape == (B, 7, D)
+    for b in range(B):
+        np.testing.assert_allclose(out[b, :LEN[b]], X[b, :LEN[b]])
+        np.testing.assert_allclose(out[b, LEN[b]:], -1.0)
+    # truncating pad length clamps lengths
+    got = run_op("sequence_pad", {"X": X, "Lengths": LEN, "PadValue": pv},
+                 attrs={"padded_length": 2}, outs=("Out", "Length"))
+    assert np.asarray(got["Out"]).shape == (B, 2, D)
+    np.testing.assert_array_equal(np.asarray(got["Length"]),
+                                  np.minimum(LEN, 2))
+
+
 def test_sequence_slice_concat_erase():
     got = np.asarray(run_op("sequence_slice", {"X": X},
                             attrs={"offset": 1, "length": 3})["Out"])

@@ -1,0 +1,146 @@
+"""Numeric tests for the round-2 small-op sweep: slice layer,
+sigmoid_cross_entropy_with_logits, *_random_batch_size_like, lod_reset,
+sequence_pad layer, lod_tensor utilities, and the Variable operator patch.
+Reference: layers/ops.py, layers/nn.py, lod_tensor.py, math_op_patch.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from op_test import run_op
+
+
+def rs(seed):
+    return np.random.RandomState(seed)
+
+
+def _run_layer(build, feeds):
+    mp, sp = fluid.Program(), fluid.Program()
+    mp.random_seed = sp.random_seed = 11
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(mp, sp):
+        with fluid.unique_name.guard():
+            fetches = build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(sp)
+        return exe.run(mp, feed=feeds, fetch_list=list(fetches))
+
+
+def test_sigmoid_cross_entropy_with_logits():
+    x = rs(0).randn(3, 4).astype(np.float32)
+    lbl = rs(1).rand(3, 4).astype(np.float32)
+    got = np.asarray(run_op("sigmoid_cross_entropy_with_logits",
+                            {"X": x, "Label": lbl})["Out"])
+    want = np.maximum(x, 0) - x * lbl + np.log1p(np.exp(-np.abs(x)))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def build():
+        xv = layers.data(name="x", shape=[4])
+        lv = layers.data(name="l", shape=[4])
+        return [layers.sigmoid_cross_entropy_with_logits(xv, lv)]
+
+    out, = _run_layer(build, {"x": x, "l": lbl})
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5, atol=1e-6)
+
+
+def test_slice_layer():
+    x = rs(2).randn(3, 5, 4).astype(np.float32)
+
+    def build():
+        xv = layers.data(name="x", shape=[3, 5, 4], append_batch_size=False)
+        return [layers.slice(xv, axes=[1, 2], starts=[1, 0], ends=[4, 2])]
+
+    out, = _run_layer(build, {"x": x})
+    np.testing.assert_allclose(np.asarray(out), x[:, 1:4, 0:2], rtol=1e-6)
+
+
+def test_random_batch_size_like():
+    x = rs(3).randn(7, 4).astype(np.float32)
+    got = np.asarray(run_op("uniform_random_batch_size_like", {"Input": x},
+                            attrs={"shape": [-1, 100], "min": 0.0,
+                                   "max": 2.0, "dtype": "float32"})["Out"])
+    assert got.shape == (7, 100)
+    assert got.min() >= 0.0 and got.max() <= 2.0
+    got = np.asarray(run_op("gaussian_random_batch_size_like", {"Input": x},
+                            attrs={"shape": [-1, 2000], "mean": 1.0,
+                                   "std": 0.25, "dtype": "float32"})["Out"])
+    assert got.shape == (7, 2000)
+    assert abs(got.mean() - 1.0) < 0.05 and abs(got.std() - 0.25) < 0.05
+
+    def build():
+        xv = layers.data(name="x", shape=[4])
+        u = layers.uniform_random_batch_size_like(xv, shape=[-1, 6])
+        g = layers.gaussian_random_batch_size_like(xv, shape=[-1, 6])
+        return [u, g]
+
+    u, g = _run_layer(build, {"x": x})
+    assert np.asarray(u).shape == (7, 6) and np.asarray(g).shape == (7, 6)
+
+
+def test_lod_reset():
+    x = rs(4).randn(3, 5, 2).astype(np.float32)
+    lens = np.array([2, 5, 1], np.int32)
+    got = run_op("lod_reset", {"X": x, "Y": lens},
+                 outs=("Out", "OutLengths"))
+    np.testing.assert_allclose(np.asarray(got["Out"]), x)
+    np.testing.assert_array_equal(np.asarray(got["OutLengths"]), lens)
+    got = run_op("lod_reset", {"X": x}, attrs={"target_lod": [1, 2, 3]},
+                 outs=("OutLengths",))
+    np.testing.assert_array_equal(np.asarray(got["OutLengths"]), [1, 2, 3])
+
+
+def test_sequence_pad_layer():
+    x = rs(5).randn(2, 4, 3).astype(np.float32)
+    lens = np.array([4, 2], np.int64)
+
+    def build():
+        xv = layers.data(name="x", shape=[2, 4, 3], append_batch_size=False)
+        lv = layers.data(name="lens", shape=[2], dtype="int64",
+                         append_batch_size=False)
+        out, length = layers.sequence_pad(xv, sequence_length=lv)
+        return [out, length]
+
+    out, length = _run_layer(build, {"x": x, "lens": lens})
+    np.testing.assert_allclose(np.asarray(out), x)
+    np.testing.assert_array_equal(np.asarray(length), lens)
+
+
+def test_create_lod_tensor():
+    t = fluid.create_lod_tensor(
+        [np.array([[1., 2.], [3., 4.]]), np.array([[5., 6.]])], [[2, 1]])
+    assert t.data.shape == (2, 2, 2)
+    np.testing.assert_allclose(t.data[0], [[1, 2], [3, 4]])
+    np.testing.assert_allclose(t.data[1], [[5, 6], [0, 0]])
+    np.testing.assert_array_equal(t.lengths, [2, 1])
+    assert t.recursive_sequence_lengths() == [[2, 1]]
+    # flattened-input form
+    t2 = fluid.create_lod_tensor(np.arange(6).reshape(6, 1), [[4, 2]])
+    assert t2.data.shape == (2, 4, 1)
+    np.testing.assert_array_equal(t2.data[1, :2, 0], [4, 5])
+    t3 = fluid.create_random_int_lodtensor([[3, 1, 2]], [1], low=0, high=9)
+    assert t3.data.shape == (3, 3, 1)
+    assert t3.data.min() >= 0 and t3.data.max() <= 9
+
+
+def test_math_op_patch():
+    a = rs(6).randn(3, 4).astype(np.float32)
+    b = rs(7).rand(3, 4).astype(np.float32) + 0.5
+
+    def build():
+        av = layers.data(name="a", shape=[4])
+        bv = layers.data(name="b", shape=[4])
+        return [
+            av + bv, av - bv, av * bv, av / bv,     # Variable ops
+            av + 1.5, 2.0 - av, av * 0.5, av / 2.0, 3.0 * av,  # scalar
+            -av, bv ** 2.0, 1.0 / bv,
+        ]
+
+    outs = _run_layer(build, {"a": a, "b": b})
+    wants = [a + b, a - b, a * b, a / b,
+             a + 1.5, 2.0 - a, a * 0.5, a / 2.0, 3.0 * a,
+             -a, b ** 2.0, 1.0 / b]
+    for got, want in zip(outs, wants):
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5,
+                                   atol=1e-6)
