@@ -143,6 +143,7 @@ class Executor:
             check_nan_inf = os.environ.get("PADDLE_TPU_CHECK_NAN_INF", "0") == "1"
         self.check_nan_inf = check_nan_inf
         self._cache: Dict = {}
+        self._read_ops: Dict = {}
         self._step = 0
         self._seed = 0
         self._base_keys: Dict = {}
@@ -218,6 +219,32 @@ class Executor:
         for name, value in feed.items():
             var = gb._find_var_recursive(name)
             feed_arrays[name] = _as_feed_array(value, var)
+        # reader-op pipeline: pull the next staged batch for every `read`
+        # op and inject its outputs as this step's feeds (reference:
+        # operators/reader/read_op.cc pulling from the ReaderHolder).
+        # Raises io.reader.EOFException when the pipeline is exhausted.
+        # The (static) read-op list is cached per program version so the
+        # hot path does not rescan every op each step.
+        rkey = (id(program), program._version)
+        read_ops = self._read_ops.get(rkey)
+        if read_ops is None:
+            read_ops = [op for op in gb.ops if op.type == "read"]
+            self._read_ops[rkey] = read_ops  # grows like _cache: per version
+        for op in read_ops:
+            rvar = gb._find_var_recursive(op.input("Reader")[0])
+            holder = getattr(rvar, "_reader_holder", None)
+            if holder is None:
+                raise RuntimeError(
+                    "reader variable %r has no bound pipeline; build it "
+                    "with fluid.layers.py_reader/open_recordio_file"
+                    % op.input("Reader")[0])
+            # note: the executor does NOT auto-start the pipeline. File
+            # readers lazy-start on first next(); py_reader requires the
+            # explicit reader.start() per epoch (reference semantics).
+            batch = holder.next()
+            for out_name in op.output("Out"):
+                var = gb._find_var_recursive(out_name)
+                feed_arrays[out_name] = _as_feed_array(batch[out_name], var)
         feed_sig = tuple(
             (name, arr.shape, str(arr.dtype)) for name, arr in sorted(feed_arrays.items())
         )

@@ -29,8 +29,10 @@ from .core import Block, Operator, grad_var_name
 # op types the tracer interprets (or skips) itself rather than via a kernel:
 # autodiff is expanded into a vjp; feed/fetch (present in reference-style
 # serialized programs) are no-ops because the executor feeds/fetches
-# directly.
-_SKIP_OPS = {"feed", "fetch"}
+# directly. `read` ops are resolved by the executor too: it pulls the next
+# batch from the reader pipeline and injects the op's outputs as feeds
+# before tracing (the jitted step stays pure).
+_SKIP_OPS = {"feed", "fetch", "read"}
 
 # Mixed precision (program.enable_mixed_precision()): matmul-class ops run
 # their float inputs in bf16 — MXU native, half the HBM traffic — while

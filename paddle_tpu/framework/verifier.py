@@ -34,6 +34,12 @@ def _verify_block(block, defined: set, issues: List[str], feed_names: set,
             for name in op.output_arg_names:
                 local_defined.add(name)
             continue
+        if op.type == "read":
+            # reader handle is bound host-side (layers/io.py reader
+            # pipeline); outputs are injected as feeds by the executor
+            for name in op.output_arg_names:
+                local_defined.add(name)
+            continue
         for name in op.input_arg_names:
             if name in local_defined or name in feed_names:
                 continue

@@ -412,3 +412,8 @@ def load_sharded_checkpoint(
     for name, val in state.items():
         scope.set_var(name, val)
     return sorted(state)
+
+
+# reader-op pipeline (py_reader / double_buffer / recordio readers)
+from . import reader  # noqa: E402,F401
+from .reader import EOFException  # noqa: E402,F401

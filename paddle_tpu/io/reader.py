@@ -1,0 +1,381 @@
+"""Reader-op data pipeline: Program-pulled batches (no Python feed dicts).
+
+Reference surface: python/paddle/fluid/layers/io.py — py_reader(:474),
+double_buffer(:891), open_files(:724), open_recordio_file(:345) — backed by
+paddle/fluid/operators/reader/* (BlockingQueue, BufferedReader, recordio
+readers). TPU-native redesign:
+
+- A *reader* is a host-side pipeline stage (`ReaderBase.next()` →
+  {var_name: array}); file readers pull pickled samples through the C++
+  PrefetchReader/Channel (runtime/runtime.cc), batch assembly lands in the
+  C++ StagingArena so the numpy batch is built once in aligned memory, and
+  `double_buffer` stages batches onto the device from a background thread
+  one step ahead of compute.
+- In the Program a reader appears as a reader Variable + a `read` op whose
+  outputs are the data Variables. `Executor.run` pops the next staged batch
+  and injects it as the step's feed arrays (the jitted step stays pure);
+  exhaustion raises `EOFException` exactly like the reference's
+  fluid.core.EOFException protocol (catch → reader.reset()).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["EOFException", "ReaderBase", "PyReader", "BatchReader",
+           "RecordIOFilesReader", "DoubleBufferReader"]
+
+
+class EOFException(Exception):
+    """Raised by Executor.run / reader.next() when the pipeline is
+    exhausted (reference: fluid.core.EOFException)."""
+
+
+_EOF = object()
+
+
+class ReaderBase:
+    """A pull stage: next() -> {var_name: np.ndarray | jax.Array}."""
+
+    def __init__(self, var_names: Sequence[str]):
+        self.var_names = list(var_names)
+        self.shapes: Optional[List] = None
+        self.dtypes: Optional[List] = None
+
+    def next(self) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def start(self):
+        """Idempotent pipeline (re)start."""
+
+    def reset(self):
+        """Rewind after EOF so the next epoch can start."""
+
+    def close(self):
+        pass
+
+
+class _PumpedReader(ReaderBase):
+    """Shared queue-pump machinery: a daemon thread runs `_produce(gen)`
+    (a generator of feed dicts) into a bounded queue. Items are tagged
+    with an epoch *generation* so a batch or EOF left over from a previous
+    epoch's pump can never be mistaken for the current epoch's (races
+    otherwise arise when a pump respawns while an old _EOF is queued).
+    The consumer polls with a short timeout instead of blocking, so a
+    mid-epoch reset() can never strand it on an empty queue."""
+
+    _eof_msg = "reader exhausted"
+
+    def __init__(self, var_names, capacity: int):
+        super().__init__(var_names)
+        self.capacity = capacity
+        self._queue: queue.Queue = queue.Queue(capacity)
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._gen = 0
+
+    def _produce(self, gen):
+        raise NotImplementedError
+
+    def _pump(self, gen):
+        try:
+            for feed in self._produce(gen):
+                if self._stop.is_set() or gen != self._gen:
+                    return
+                self._queue.put((gen, feed))
+        finally:
+            self._queue.put((gen, _EOF))
+
+    def _spawn(self):
+        if self._thread is not None:
+            if self._thread.is_alive():
+                return
+            self._thread.join()
+        self._stop.clear()
+        self._gen += 1
+        self._thread = threading.Thread(target=self._pump,
+                                        args=(self._gen,), daemon=True)
+        self._thread.start()
+
+    def _next_item(self):
+        while True:
+            t = self._thread  # may be nulled by a concurrent reset()
+            dead = t is None or not t.is_alive()
+            try:
+                gen, item = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                if dead:
+                    # pump finished and everything it produced was
+                    # consumed: repeated next() without reset() re-raises
+                    # EOF instead of blocking forever
+                    raise EOFException(self._eof_msg)
+                continue
+            if gen != self._gen:
+                continue  # stale leftover from a previous epoch's pump
+            if item is _EOF:
+                raise EOFException(self._eof_msg)
+            return item
+
+    def _teardown(self):
+        self._stop.set()
+        self._gen += 1  # everything queued or in flight is now stale
+        t = self._thread
+        while t is not None and t.is_alive():
+            # drain so a producer blocked on put() can observe the stop flag
+            try:
+                self._queue.get_nowait()
+            except queue.Empty:
+                t.join(timeout=0.05)
+        self._thread = None
+        while True:
+            try:
+                self._queue.get_nowait()
+            except queue.Empty:
+                break
+
+
+class PyReader(_PumpedReader):
+    """Capacity-bounded queue fed from a decorated python reader in a
+    background thread (reference py_reader + its BlockingQueue)."""
+
+    _eof_msg = "py_reader exhausted"
+
+    def __init__(self, var_names, shapes, dtypes, capacity: int = 64,
+                 feeder=None):
+        super().__init__(var_names, capacity)
+        self.shapes = shapes
+        self.dtypes = dtypes
+        self._feeder = feeder  # DataFeeder for sample-tuple assembly
+        self._source: Optional[Callable] = None
+        self._tensor_source = False
+
+    # -- decoration (reference py_reader API) ---------------------------
+    def decorate_paddle_reader(self, reader: Callable):
+        """`reader()` yields batches as lists of per-sample tuples (the
+        paddle.batch convention)."""
+        self._source = reader
+        self._tensor_source = False
+
+    def decorate_tensor_provider(self, reader: Callable):
+        """`reader()` yields tuples of ready batch arrays per slot."""
+        self._source = reader
+        self._tensor_source = True
+
+    def _assemble(self, item):
+        if self._tensor_source:
+            return {n: np.asarray(a) for n, a in zip(self.var_names, item)}
+        if self._feeder is not None:
+            return self._feeder.feed(item)
+        # paddle.batch convention: item is a list of per-sample tuples;
+        # stack each slot into one batch array, cast to the declared dtype
+        feed = {}
+        for j, n in enumerate(self.var_names):
+            arr = np.stack([np.asarray(sample[j]) for sample in item])
+            if self.dtypes:
+                arr = arr.astype(self.dtypes[j], copy=False)
+            want = [s for s in (self.shapes[j] if self.shapes else [])
+                    if s and s > 0]
+            if want and list(arr.shape[1:]) != want and \
+                    arr.size == len(item) * int(np.prod(want)):
+                arr = arr.reshape([len(item)] + want)
+            feed[n] = arr
+        return feed
+
+    def _produce(self, gen):
+        for item in self._source():
+            yield self._assemble(item)
+
+    def start(self):
+        if self._source is None:
+            raise RuntimeError(
+                "py_reader has no source; call decorate_paddle_reader or "
+                "decorate_tensor_provider first")
+        self._spawn()
+
+    def next(self):
+        if self._thread is None:
+            raise RuntimeError("py_reader not started; call reader.start()")
+        return self._next_item()
+
+    def reset(self):
+        self._teardown()
+
+
+class RecordIOFilesReader(ReaderBase):
+    """Sample-level reader over recordio files through the C++
+    PrefetchReader (reference open_recordio_file / open_files +
+    operators/reader/create_recordio_file_reader_op.cc)."""
+
+    def __init__(self, filenames, var_names, shapes, dtypes,
+                 prefetch_capacity: int = 256):
+        super().__init__(var_names)
+        self.shapes = [list(s) for s in shapes]
+        self.dtypes = list(dtypes)
+        from ..runtime import recordio as rio
+
+        self._rio = rio
+        self.filenames = ([filenames] if isinstance(filenames, str)
+                          else list(filenames))
+        self.capacity = prefetch_capacity
+        self._iter = None
+        # after EOF the reader stays exhausted until reset() — next() must
+        # NOT silently begin a new pass (the executor polls next() per
+        # step; auto-restart would turn one epoch into an endless stream)
+        self._exhausted = False
+
+    def _make_iter(self):
+        import pickle
+
+        def it():
+            for path in self.filenames:
+                src = self._rio.PrefetchReader(path, self.capacity)
+                try:
+                    for rec in src:
+                        yield pickle.loads(rec)
+                finally:
+                    src.close()
+
+        return it()
+
+    def start(self):
+        if self._iter is None and not self._exhausted:
+            self._iter = self._make_iter()
+
+    def next(self):
+        if self._exhausted:
+            raise EOFException("recordio files exhausted (call reset())")
+        if self._iter is None:
+            self.start()
+        try:
+            sample = next(self._iter)
+        except StopIteration:
+            self._iter = None
+            self._exhausted = True
+            raise EOFException("recordio files exhausted")
+        return {n: np.asarray(a) for n, a in zip(self.var_names, sample)}
+
+    def reset(self):
+        self._iter = None
+        self._exhausted = False
+
+
+class BatchReader(ReaderBase):
+    """Assemble per-sample dicts from an inner reader into batches
+    (reference layers/io.py:batch → create_batch_reader op). Batch arrays
+    are built in the C++ StagingArena when available."""
+
+    def __init__(self, inner: ReaderBase, batch_size: int, drop_last=True,
+                 use_arena: bool = True, n_arenas: int = 4):
+        super().__init__(inner.var_names)
+        self.inner = inner
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        if inner.shapes is not None:
+            # sample-level shapes gain a leading (dynamic) batch dim
+            self.shapes = [[-1] + list(s) for s in inner.shapes]
+        self.dtypes = inner.dtypes
+        # rotating arena pool: a bump arena is reset only after n_arenas-1
+        # further batches, giving in-flight batches (double-buffer queue +
+        # the one the executor holds; jax may alias host memory zero-copy
+        # on CPU) time to drain before their pages are reused
+        self._arenas: List = []
+        self._arena_idx = 0
+        if use_arena:
+            from ..runtime.recordio import StagingArena, native_available
+
+            if native_available():
+                self._arenas = [StagingArena() for _ in range(n_arenas)]
+
+    def _stack(self, rows: List[Dict[str, np.ndarray]]):
+        arena = None
+        if self._arenas:
+            arena = self._arenas[self._arena_idx % len(self._arenas)]
+            self._arena_idx += 1
+            arena.reset()
+        out = {}
+        for name in rows[0]:
+            first = np.asarray(rows[0][name])
+            shape = (len(rows),) + first.shape
+            if arena is not None:
+                dst = arena.alloc_array(shape, first.dtype)
+            else:
+                dst = np.empty(shape, first.dtype)
+            for i, r in enumerate(rows):
+                dst[i] = r[name]
+            out[name] = dst
+        return out
+
+    def start(self):
+        self.inner.start()
+
+    def next(self):
+        rows = []
+        for _ in range(self.batch_size):
+            try:
+                rows.append(self.inner.next())
+            except EOFException:
+                if rows and not self.drop_last:
+                    return self._stack(rows)
+                raise
+        return self._stack(rows)
+
+    def reset(self):
+        self.inner.reset()
+
+
+class DoubleBufferReader(_PumpedReader):
+    """Device-staging stage: a background thread transfers upcoming batches
+    to the device so the executor receives device-resident arrays
+    (reference double_buffer → operators/reader/buffered_reader; on TPU the
+    payoff is hiding the host→device copy behind compute)."""
+
+    _eof_msg = "double_buffer inner reader exhausted"
+
+    def __init__(self, inner: ReaderBase, place=None, capacity: int = 2):
+        super().__init__(inner.var_names, capacity)
+        self.inner = inner
+        self.place = place
+        self.shapes = inner.shapes
+        self.dtypes = inner.dtypes
+
+    def _device(self):
+        import jax
+
+        from ..framework.scope import CPUPlace
+
+        if self.place is None or not isinstance(self.place, CPUPlace):
+            devs = jax.devices()
+            return devs[0]
+        return jax.devices("cpu")[0]
+
+    def _produce(self, gen):
+        import jax
+
+        dev = self._device()
+        while True:
+            try:
+                feed = self.inner.next()
+            except EOFException:
+                return
+            staged = {k: jax.device_put(v, dev) for k, v in feed.items()}
+            jax.block_until_ready(tuple(staged.values()))
+            yield staged
+
+    def start(self):
+        self.inner.start()
+        self._spawn()
+
+    def next(self):
+        if self._thread is None:
+            self.start()
+        return self._next_item()
+
+    def reset(self):
+        # reset the inner stage FIRST: if the pump thread is blocked inside
+        # inner.next() (e.g. a stalled py_reader source), the inner reset
+        # unblocks it so the teardown join below can complete
+        self.inner.reset()
+        self._teardown()

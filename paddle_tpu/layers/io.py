@@ -1,15 +1,22 @@
 """layers.io (reference: python/paddle/fluid/layers/io.py).
 
-`data` declares feed variables. The reference's py_reader / double_buffer /
-open_recordio_file pipeline is provided in paddle_tpu.io.reader backed by
-the C++ prefetch runtime; here we expose the layer-level API surface.
+`data` declares feed variables. The reader-op pipeline — py_reader
+(reference io.py:474), double_buffer (:891), open_files (:724),
+open_recordio_file (:345), batch, read_file — is backed by
+paddle_tpu.io.reader (C++ prefetch/channel/arena underneath): a reader is a
+Variable carrying a host-side pipeline stage, the `read` op marks where its
+batches enter the Program, and the Executor pulls + injects them per step
+so no Python feed dict is needed.
 """
 from __future__ import annotations
 
+from ..framework import unique_name
 from ..framework.core import default_main_program, default_startup_program
 from ..framework.dtypes import convert_dtype
+from ..io import reader as reader_mod
 
-__all__ = ["data"]
+__all__ = ["data", "py_reader", "read_file", "open_recordio_file",
+           "open_files", "batch", "double_buffer"]
 
 
 def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0, type=None, stop_gradient=True):
@@ -37,3 +44,123 @@ def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0, type
             is_data=True,
         )
     return var
+
+
+# ---------------------------------------------------------------------------
+# reader ops
+# ---------------------------------------------------------------------------
+
+
+def _make_reader_var(holder, name=None):
+    """A reader Variable carrying its host-side pipeline stage, with the
+    reference's start()/reset() methods attached (reference py_reader
+    returns a Variable patched the same way)."""
+    block = default_main_program().current_block()
+    var = block.create_var(
+        name=name or unique_name.generate("_reader"),
+        shape=(),
+        dtype="float32",
+        stop_gradient=True,
+    )
+    var._reader_holder = holder
+    var.start = holder.start
+    var.reset = holder.reset
+    return var
+
+
+def _slot_names(base, n):
+    return ["%s.slot%d" % (base, i) for i in range(n)]
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """reference io.py:474. Returns a reader Variable; feed it with
+    reader.decorate_paddle_reader(batched_reader) or
+    reader.decorate_tensor_provider(gen), then reader.start(); get the data
+    Variables with fluid.layers.read_file(reader)."""
+    if lod_levels and any(l > 0 for l in lod_levels):
+        raise NotImplementedError(
+            "py_reader with lod_levels>0: feed dense padded arrays + a "
+            "lengths slot instead (dense+lengths convention)")
+    base = name or unique_name.generate("py_reader")
+    names = _slot_names(base, len(shapes))
+    holder = reader_mod.PyReader(names, [list(s) for s in shapes],
+                                 [convert_dtype(d) for d in dtypes],
+                                 capacity=capacity)
+    var = _make_reader_var(holder, name=base)
+    var.decorate_paddle_reader = holder.decorate_paddle_reader
+    var.decorate_tensor_provider = holder.decorate_tensor_provider
+    if use_double_buffer:
+        return double_buffer(var, keep_decoration=True)
+    return var
+
+
+def open_recordio_file(filename, shapes, dtypes, lod_levels=None,
+                       pass_num=1):
+    """reference io.py:345 — a sample-level reader over a recordio file
+    written by fluid.recordio_convert (pickled sample tuples). Chain with
+    fluid.layers.batch(...) + read_file."""
+    base = unique_name.generate("recordio_reader")
+    names = _slot_names(base, len(shapes))
+    files = [filename] * pass_num
+    holder = reader_mod.RecordIOFilesReader(
+        files, names, [list(s) for s in shapes],
+        [convert_dtype(d) for d in dtypes])
+    return _make_reader_var(holder, name=base)
+
+
+def open_files(filenames, shapes, dtypes, lod_levels=None, pass_num=1,
+               thread_num=None, buffer_size=None):
+    """reference io.py:724 — like open_recordio_file over a file list.
+    thread_num/buffer_size are accepted for parity (the C++ PrefetchReader
+    runs one prefetch thread per file with a bounded channel)."""
+    base = unique_name.generate("files_reader")
+    names = _slot_names(base, len(shapes))
+    files = list(filenames) * pass_num
+    holder = reader_mod.RecordIOFilesReader(
+        files, names, [list(s) for s in shapes],
+        [convert_dtype(d) for d in dtypes],
+        prefetch_capacity=buffer_size or 256)
+    return _make_reader_var(holder, name=base)
+
+
+def batch(reader, batch_size, drop_last=True):
+    """reference io.py:batch — batch a sample-level reader."""
+    holder = reader_mod.BatchReader(reader._reader_holder, batch_size,
+                                    drop_last=drop_last)
+    return _make_reader_var(holder)
+
+
+def double_buffer(reader, place=None, name=None, keep_decoration=False):
+    """reference io.py:891 — stage upcoming batches on the device from a
+    background thread so the host->device copy hides behind compute."""
+    inner = reader._reader_holder
+    holder = reader_mod.DoubleBufferReader(inner, place=place)
+    var = _make_reader_var(holder, name=name)
+    if keep_decoration:
+        # decorating the outer reader decorates the wrapped py_reader
+        var.decorate_paddle_reader = inner.decorate_paddle_reader
+        var.decorate_tensor_provider = inner.decorate_tensor_provider
+    return var
+
+
+def read_file(reader):
+    """reference io.py:read_file — materialize the reader's slots as data
+    Variables via a `read` op (the Executor pulls a batch per step)."""
+    block = default_main_program().current_block()
+    holder = reader._reader_holder
+    outs = []
+    for name, shape, dtype in zip(holder.var_names,
+                                  getattr(holder, "shapes", None)
+                                  or [()] * len(holder.var_names),
+                                  getattr(holder, "dtypes", None)
+                                  or ["float32"] * len(holder.var_names)):
+        outs.append(block.create_var(
+            name=name, shape=tuple(shape), dtype=dtype,
+            stop_gradient=True, is_data=True))
+    block.append_op(
+        type="read",
+        inputs={"Reader": [reader]},
+        outputs={"Out": outs},
+    )
+    return outs
