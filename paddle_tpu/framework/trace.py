@@ -57,6 +57,28 @@ _AMP_FP32_OPS = {
 # activation through HBM between bf16 convs (profiled on ResNet-50).
 
 
+import contextlib
+
+# Mesh the step is being traced under (set by ParallelExecutor around the
+# first call of its jitted step). Kernels that have a distributed
+# implementation (ring_attention) consult this to decide between the
+# collective path and the single-device fallback.
+_TRACE_MESH = []
+
+
+@contextlib.contextmanager
+def mesh_context(mesh):
+    _TRACE_MESH.append(mesh)
+    try:
+        yield
+    finally:
+        _TRACE_MESH.pop()
+
+
+def current_trace_mesh():
+    return _TRACE_MESH[-1] if _TRACE_MESH else None
+
+
 class RngStream:
     """Deterministic PRNG stream keyed on (block idx, op position, draw #):
     replaying an op (e.g. inside an autodiff vjp) yields the same bits, and

@@ -104,6 +104,7 @@ __all__ = [
     "beam_search",
     "beam_search_decode",
     "fused_attention",
+    "ring_attention",
     "fused_lm_head_loss",
 ]
 
@@ -2034,6 +2035,25 @@ def fused_attention(q, k, v, causal=False, scale=None, sequence_length=None,
         attrs={"causal": causal, "scale": scale,
                "dropout_rate": dropout_rate,
                "block_k": block_k or _DEFAULT_ATTN_BLOCK_K},
+    )
+    return out
+
+
+def ring_attention(q, k, v, causal=False, scale=None, sp_axis="sp",
+                   name=None):
+    """Sequence-parallel exact attention over (B, H, T, Dh) tensors: under
+    a ParallelExecutor whose mesh has `sp_axis`, K/V blocks rotate on the
+    ICI ring (lax.ppermute) so each chip keeps an O(T/N) sequence shard —
+    the long-context path (kernel: ops/attention.py ring_attention; math:
+    parallel/ring_attention.py). Falls back to exact full attention on a
+    single device, so the Program is portable."""
+    helper = LayerHelper("ring_attention", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype, shape=q.shape)
+    helper.append_op(
+        type="ring_attention",
+        inputs={"Q": [q], "K": [k], "V": [v]},
+        outputs={"Out": [out]},
+        attrs={"causal": causal, "scale": scale, "sp_axis": sp_axis},
     )
     return out
 

@@ -32,14 +32,17 @@ def _linear(x, size, name=None, num_flatten_dims=2, act=None):
 
 def multi_head_attention(
     q_in, kv_in, n_head, d_model, dropout_rate=0.0, causal=False,
-    kv_lengths=None, name=None, use_fused=True,
+    kv_lengths=None, name=None, use_fused=True, use_ring=False,
+    sp_axis="sp",
 ):
     """(B, Tq, D) x (B, Tk, D) -> (B, Tq, D).
 
     use_fused=True routes through the flash-attention op (ops/attention.py):
     no (Tq, Tk) score tensor ever hits HBM, which is what lets seq-1024
-    training batches fit a single v5e. The unfused path is kept for
-    numerics debugging."""
+    training batches fit a single v5e. use_ring=True routes through the
+    ring_attention op instead — sequence-parallel over the mesh's
+    `sp_axis` (long-context path). The unfused path is kept for numerics
+    debugging."""
     B, Tq, _ = q_in.shape
     Tk = kv_in.shape[1]
     d_head = d_model // n_head
@@ -56,7 +59,14 @@ def multi_head_attention(
     k = split_heads(k, Tk)
     v = split_heads(v, Tk)
 
-    if use_fused:
+    if use_ring:
+        if kv_lengths is not None or dropout_rate:
+            raise NotImplementedError(
+                "ring attention path supports neither KV padding masks nor "
+                "attention dropout yet; pad to full length / move dropout "
+                "outside attention")
+        ctx = layers.ring_attention(q, k, v, causal=causal, sp_axis=sp_axis)
+    elif use_fused:
         ctx = layers.fused_attention(
             q, k, v, causal=causal, sequence_length=kv_lengths,
             dropout_rate=dropout_rate)
@@ -120,12 +130,14 @@ def encoder_layer(x, n_head, d_model, d_inner, dropout_rate, lengths, name):
 
 
 def decoder_layer(x, enc, n_head, d_model, d_inner, dropout_rate,
-                  src_lengths, tgt_lengths, name):
+                  src_lengths, tgt_lengths, name, use_ring=False,
+                  sp_axis="sp"):
     """`enc` must already be normalized (transformer_encoder output)."""
     h = _pre_norm(x)
     self_attn = multi_head_attention(
         h, h, n_head, d_model, dropout_rate,
         causal=True, kv_lengths=tgt_lengths, name=name + ".self",
+        use_ring=use_ring, sp_axis=sp_axis,
     )
     x = layers.elementwise_add(x, self_attn)
     if enc is not None:
@@ -197,17 +209,25 @@ def transformer_nmt(
 def transformer_lm(
     ids, labels, vocab_size, n_layer=4, n_head=8, d_model=512, d_inner=2048,
     dropout_rate=0.0, max_len=2048, fused_head=True,
+    use_ring_attention=False, sp_axis="sp",
 ):
     """Decoder-only causal LM (flagship). Returns (avg_cost, logits).
 
     fused_head=True (default) computes the vocab projection + loss through
     `layers.fused_lm_head_loss` — the (B*T, vocab) logits never hit HBM —
     and returns logits=None. Pass fused_head=False when the logits tensor
-    itself is needed (e.g. decoding/inspection)."""
+    itself is needed (e.g. decoding/inspection).
+
+    use_ring_attention=True is the LONG-CONTEXT path: every self-attention
+    runs the sequence-parallel ring (layers.ring_attention), so compiling
+    under a ParallelExecutor whose mesh has `sp_axis` shards the sequence
+    dim across chips — seq lengths far beyond one chip's HBM. The same
+    Program still runs on one device (exact-attention fallback)."""
     x = _embed(ids, vocab_size, d_model, max_len, "lm")
     for i in range(n_layer):
         x = decoder_layer(x, None, n_head, d_model, d_inner, dropout_rate,
-                          None, None, "lm.l%d" % i)
+                          None, None, "lm.l%d" % i,
+                          use_ring=use_ring_attention, sp_axis=sp_axis)
     x = _pre_norm(x)
     B, T = ids.shape
     if fused_head:

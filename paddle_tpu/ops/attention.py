@@ -431,3 +431,27 @@ def _use_pallas(q, k, lengths, dropout_rate) -> bool:
     # 128 matches _fit_block's floor so the dispatch gate and the kernel
     # entry can never disagree; tiny sequences stay on the XLA path
     return t % 128 == 0 and tk % 128 == 0 and t >= 256 and tk >= 256
+
+
+@register_op("ring_attention")
+def _ring_attention_op(ctx):
+    """Sequence-parallel exact attention (SURVEY §2 long-context
+    commitment; no reference twin). Inputs Q,K,V: (B, H, T, Dh). When the
+    step is traced under a mesh whose `sp_axis` exists and is >1 wide
+    (ParallelExecutor sets framework.trace.mesh_context), the kernel runs
+    the ppermute ring (parallel/ring_attention.py) so each device holds an
+    O(T/N) sequence shard; otherwise it falls back to exact full
+    attention, so the same Program runs unchanged on one chip."""
+    from ..framework.trace import current_trace_mesh
+    from ..parallel.ring_attention import full_attention, ring_self_attention
+
+    q, k, v = ctx.input("Q"), ctx.input("K"), ctx.input("V")
+    causal = bool(ctx.attr("causal", False))
+    scale = ctx.attr("scale", None)
+    sp_axis = ctx.attr("sp_axis", "sp")
+    mesh = current_trace_mesh()
+    if (mesh is not None and sp_axis in mesh.axis_names
+            and mesh.shape[sp_axis] > 1):
+        return {"Out": ring_self_attention(q, k, v, mesh, sp_axis=sp_axis,
+                                           causal=causal, scale=scale)}
+    return {"Out": full_attention(q, k, v, causal=causal, scale=scale)}

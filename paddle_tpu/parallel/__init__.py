@@ -27,6 +27,8 @@ from .sharding import (  # noqa: F401
     PartitionSpec,
     ShardingPlan,
     megatron_transformer_plan,
+    seq_parallel_plan,
+    zero_plan,
 )
 from .parallel_executor import (  # noqa: F401
     BuildStrategy,
@@ -37,4 +39,9 @@ from .ring_attention import (  # noqa: F401
     full_attention,
     ring_attention,
     ring_self_attention,
+)
+from .pipeline import (  # noqa: F401
+    num_pipeline_ticks,
+    pipeline_apply,
+    stack_stage_params,
 )

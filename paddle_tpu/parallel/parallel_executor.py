@@ -26,6 +26,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..executor import analyze_state, build_step_fn, _as_feed_array, _fetch_name
+from ..framework import trace as trace_mod
 from ..framework.core import Program, default_main_program
 from ..framework.scope import Scope, global_scope
 from .mesh import default_mesh
@@ -163,8 +164,9 @@ class ParallelExecutor:
             state_aval[n] = jax.ShapeDtypeStruct(tuple(arr.shape), arr.dtype)
         key_aval = jax.eval_shape(lambda: jax.random.PRNGKey(0))
         step_aval = jax.ShapeDtypeStruct((), np.uint32)
-        _, out_state_aval = jax.eval_shape(stepfn, feeds_aval, state_aval, key_aval,
-                                           step_aval)
+        with trace_mod.mesh_context(self._mesh):
+            _, out_state_aval = jax.eval_shape(stepfn, feeds_aval, state_aval,
+                                               key_aval, step_aval)
 
         plan = self._plan
         feed_shardings = {
@@ -262,7 +264,11 @@ class ParallelExecutor:
         step = np.uint32(self._step)
         self._step += 1
 
-        fetches, new_state = compiled.fn(feeds, state, self._base_keys[seed], step)
+        # jit traces lazily inside the first call: distributed-capable
+        # kernels (ring_attention) read the mesh from this context
+        with trace_mod.mesh_context(self._mesh):
+            fetches, new_state = compiled.fn(feeds, state,
+                                             self._base_keys[seed], step)
         for name, val in new_state.items():
             self._scope.set_var(name, val)
 

@@ -1,0 +1,124 @@
+"""Pipeline parallelism: GPipe-style fill-drain over a ``pp`` mesh axis.
+
+The reference's pipeline story is graph partitioning over trainers
+(transpiler/distribute_transpiler.py splits the ProgramDesc and wires
+send/recv ops). TPU-native redesign: all pipeline stages share one traced
+stage function; per-stage parameters are STACKED with a leading stage axis
+and sharded over the ``pp`` mesh axis, activations hop stage→stage with
+``lax.ppermute`` on the ICI ring, and a ``lax.scan`` over
+(microbatches + stages - 1) ticks implements the fill/drain schedule inside
+``shard_map``. The whole schedule is one differentiable XLA computation —
+``jax.grad`` through it yields the reverse pipeline automatically, so a
+training step is just grad(loss ∘ pipeline).
+
+Garbage circulates through bubble slots (every device computes every tick —
+that is the SPMD way; masking, not control flow) but is zeroed before
+collection and never reaches a valid microbatch's data path.
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax>=0.6 top level; older: experimental
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+
+def _pvary(x, axes):
+    """Mark x varying over manual axes; lax.pvary is deprecated in favor of
+    lax.pcast(x, axis_name, to='varying') on newer jax."""
+    pcast = getattr(lax, "pcast", None)
+    if pcast is not None:
+        return pcast(x, axes, to="varying")
+    return lax.pvary(x, axes)
+
+__all__ = ["pipeline_apply", "stack_stage_params", "num_pipeline_ticks"]
+
+
+def stack_stage_params(stage_params: Sequence):
+    """Stack a list of per-stage parameter pytrees along a new leading
+    stage axis (shard that axis over ``pp``)."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *stage_params)
+
+
+def num_pipeline_ticks(n_microbatches: int, n_stages: int) -> int:
+    return n_microbatches + n_stages - 1
+
+
+def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: Mesh,
+                   axis: str = "pp", batch_axis: str = None):
+    """Run ``x`` through all pipeline stages.
+
+    stage_fn: ``(params_of_one_stage, act) -> act`` with act shapes equal
+        in and out (the stage-homogeneous condition pipelining needs).
+    stacked_params: pytree whose leaves have a leading stage axis of size
+        S == mesh.shape[axis] (see stack_stage_params).
+    x: (M, mb, ...) microbatched input (M = number of microbatches).
+    batch_axis: optional mesh axis name to shard the microbatch (second)
+        dim over — combines dp×pp on one mesh.
+
+    Returns (M, mb, ...) outputs, replicated over ``axis`` (sharded over
+    ``batch_axis`` if given). Differentiable.
+    """
+    n_stages = mesh.shape[axis]
+    n_micro = x.shape[0]
+    ticks = num_pipeline_ticks(n_micro, n_stages)
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    bspec = P(None, batch_axis) if batch_axis else P(None)
+    param_spec = jax.tree_util.tree_map(lambda _: P(axis), stacked_params)
+
+    def device_fn(params_stacked, x_local):
+        # params_stacked leaf: (1, ...) — this device's stage slice
+        params = jax.tree_util.tree_map(
+            lambda p: jnp.squeeze(p, axis=0), params_stacked)
+        stage = lax.axis_index(axis)
+        mb_shape = x_local.shape[1:]
+
+        def tick(carry, t):
+            state, outs = carry
+            # stage 0 injects microbatch t while filling; everyone else
+            # consumes what the previous stage sent last tick
+            inj = lax.dynamic_index_in_dim(
+                x_local, jnp.minimum(t, n_micro - 1), axis=0,
+                keepdims=False)
+            inj = jnp.where(t < n_micro, inj, jnp.zeros_like(inj))
+            inp = jnp.where(stage == 0, inj, state)
+            y = stage_fn(params, inp)
+            # last stage emits microbatch m = t - (S-1)
+            m = t - (n_stages - 1)
+            emit = jnp.where(
+                (stage == n_stages - 1) & (m >= 0), y, jnp.zeros_like(y))
+            # fill ticks (m<0) clip to slot 0 and write zeros there; the
+            # real m=0 write happens later, so the final slot is correct
+            outs = lax.dynamic_update_index_in_dim(
+                outs, emit, jnp.clip(m, 0, n_micro - 1), axis=0)
+            state = lax.ppermute(y, axis, perm)
+            return (state, outs), None
+
+        # the carry is device-varying (it depends on axis_index/ppermute);
+        # mark the zero initializers varying too or the scan carry types
+        # disagree under the VMA type system
+        vary = (axis,) + ((batch_axis,) if batch_axis else ())
+        outs0 = _pvary(jnp.zeros((n_micro,) + mb_shape, x_local.dtype),
+                       vary)
+        state0 = _pvary(jnp.zeros(mb_shape, x_local.dtype), vary)
+        (state, outs), _ = lax.scan(tick, (state0, outs0),
+                                    jnp.arange(ticks))
+        # outputs live on the last stage; replicate over the pp axis
+        outs = lax.psum(jnp.where(stage == n_stages - 1, outs,
+                                  jnp.zeros_like(outs)), axis)
+        return outs
+
+    return shard_map(
+        device_fn, mesh=mesh,
+        in_specs=(param_spec, bspec),
+        out_specs=bspec,
+    )(stacked_params, x)

@@ -23,7 +23,7 @@ from typing import Dict, Optional, Sequence
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["ShardingPlan", "PartitionSpec", "megatron_transformer_plan",
-           "zero_plan"]
+           "zero_plan", "seq_parallel_plan"]
 
 PartitionSpec = P
 
@@ -43,6 +43,8 @@ class ShardingPlan:
         self.default = default
         # feed arrays get their leading (batch) dim split over these axes
         self.batch_axes = tuple(a for a in batch_axes if a in mesh.axis_names)
+        # sequence-parallel plans shard feed dim 1 (time) over this axis
+        self.seq_axis: Optional[str] = None
         self._exact: Dict[str, P] = {}
         self._regex: list = []
 
@@ -99,11 +101,19 @@ class ShardingPlan:
         return NamedSharding(self.mesh, self.spec(name, ndim, shape))
 
     def feed_sharding(self, ndim: int) -> NamedSharding:
-        """Feeds: batch dim split over the data axes, rest replicated."""
-        if not self.batch_axes or ndim == 0:
+        """Feeds: batch dim split over the data axes, dim 1 split over the
+        sequence axis when the plan is sequence-parallel, rest replicated."""
+        if ndim == 0 or (not self.batch_axes and not self.seq_axis):
             return NamedSharding(self.mesh, P())
-        axes = self.batch_axes[0] if len(self.batch_axes) == 1 else self.batch_axes
-        return NamedSharding(self.mesh, P(axes, *([None] * (ndim - 1))))
+        if not self.batch_axes:
+            axes = None
+        else:
+            axes = (self.batch_axes[0] if len(self.batch_axes) == 1
+                    else self.batch_axes)
+        dims = [axes] + [None] * (ndim - 1)
+        if self.seq_axis and ndim >= 2:
+            dims[1] = self.seq_axis
+        return NamedSharding(self.mesh, P(*dims))
 
     def replicated(self) -> NamedSharding:
         return NamedSharding(self.mesh, P())
@@ -159,4 +169,21 @@ def megatron_transformer_plan(
         (r"\.head\.b", col_b),
     ]:
         plan.set_regex(pat, spec)
+    return plan
+
+
+def seq_parallel_plan(
+    mesh: Mesh,
+    sp_axis: str = "sp",
+    batch_axes: Sequence[str] = ("dp",),
+) -> ShardingPlan:
+    """Sequence/context-parallel plan for the long-context LM
+    (models/transformer.py transformer_lm(use_ring_attention=True)): feeds
+    and activations carry the time dim sharded over `sp_axis`, parameters
+    stay replicated, and the ring_attention op exchanges K/V blocks over
+    the same axis with ppermute. GSPMD keeps every elementwise / matmul op
+    local to its sequence shard; only attention communicates.
+    """
+    plan = ShardingPlan(mesh, batch_axes=batch_axes)
+    plan.seq_axis = sp_axis if sp_axis in mesh.axis_names else None
     return plan

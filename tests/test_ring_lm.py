@@ -1,0 +1,83 @@
+"""Flagship long-context LM: transformer_lm(use_ring_attention=True) on a
+sequence-parallel mesh matches the single-device model exactly (same seed),
+and trains. SURVEY §2 models commitment; VERDICT r1 item 6."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, models, optimizer
+from paddle_tpu.parallel import ParallelExecutor, make_mesh, seq_parallel_plan
+
+
+def _build(use_ring, seed=13, batch=2, seq=32, vocab=64):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            ids = layers.data(name="ids", shape=[batch, seq], dtype="int64",
+                              append_batch_size=False)
+            labels = layers.data(name="labels", shape=[batch, seq],
+                                 dtype="int64", append_batch_size=False)
+            loss, _ = models.transformer.transformer_lm(
+                ids, labels, vocab_size=vocab, n_layer=2, n_head=2,
+                d_model=16, d_inner=32, max_len=seq,
+                use_ring_attention=use_ring)
+            optimizer.SGD(0.1).minimize(loss)
+    return main, startup, scope, loss
+
+
+def _feed(batch=2, seq=32, vocab=64, seed=0):
+    r = np.random.RandomState(seed)
+    return {"ids": r.randint(0, vocab, (batch, seq)).astype(np.int64),
+            "labels": r.randint(0, vocab, (batch, seq)).astype(np.int64)}
+
+
+def test_ring_lm_matches_single_device():
+    feed = _feed()
+
+    # single-device reference (ring op falls back to full attention)
+    main, startup, scope, loss = _build(use_ring=True)
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        ref = [float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+               for _ in range(3)]
+
+    # sp mesh: sequence sharded over 4 devices, ring attention active
+    mesh = make_mesh([4], ("sp",), devices=jax.devices()[:4])
+    main, startup, scope, loss = _build(use_ring=True)
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+        pexe = ParallelExecutor(
+            loss_name=loss.name, main_program=main, scope=scope, mesh=mesh,
+            plan=seq_parallel_plan(mesh, sp_axis="sp", batch_axes=()))
+        got = [float(pexe.run(feed=feed, fetch_list=[loss])[0])
+               for _ in range(3)]
+
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+    assert ref[2] < ref[0]  # it actually trains
+
+
+def test_ring_lm_dp_x_sp():
+    feed = _feed(batch=4)
+    main, startup, scope, loss = _build(use_ring=True, batch=4)
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        ref = [float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+               for _ in range(2)]
+
+    mesh = make_mesh([2, 4], ("dp", "sp"), devices=jax.devices()[:8])
+    main, startup, scope, loss = _build(use_ring=True, batch=4)
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+        pexe = ParallelExecutor(
+            loss_name=loss.name, main_program=main, scope=scope, mesh=mesh,
+            plan=seq_parallel_plan(mesh, sp_axis="sp", batch_axes=("dp",)))
+        got = [float(pexe.run(feed=feed, fetch_list=[loss])[0])
+               for _ in range(2)]
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
