@@ -98,6 +98,7 @@ from .trainer import (  # noqa: F401
     Trainer,
 )
 
+from . import inference  # noqa: F401
 from . import lod_tensor  # noqa: F401
 from .lod_tensor import create_lod_tensor, create_random_int_lodtensor  # noqa: F401
 

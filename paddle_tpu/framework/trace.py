@@ -58,25 +58,32 @@ _AMP_FP32_OPS = {
 
 
 import contextlib
+import threading
 
 # Mesh the step is being traced under (set by ParallelExecutor around the
 # first call of its jitted step). Kernels that have a distributed
 # implementation (ring_attention) consult this to decide between the
-# collective path and the single-device fallback.
-_TRACE_MESH = []
+# collective path and the single-device fallback. Thread-local: two
+# ParallelExecutors first-running on different threads must not see each
+# other's mesh.
+_TRACE_MESH = threading.local()
 
 
 @contextlib.contextmanager
 def mesh_context(mesh):
-    _TRACE_MESH.append(mesh)
+    stack = getattr(_TRACE_MESH, "stack", None)
+    if stack is None:
+        stack = _TRACE_MESH.stack = []
+    stack.append(mesh)
     try:
         yield
     finally:
-        _TRACE_MESH.pop()
+        stack.pop()
 
 
 def current_trace_mesh():
-    return _TRACE_MESH[-1] if _TRACE_MESH else None
+    stack = getattr(_TRACE_MESH, "stack", None)
+    return stack[-1] if stack else None
 
 
 class RngStream:

@@ -21,7 +21,8 @@ from . import tensor as tensor_layers
 __all__ = [
     "prior_box", "multi_box_head", "bipartite_match", "target_assign",
     "box_coder", "iou_similarity", "ssd_loss", "detection_output",
-    "detection_map", "polygon_box_transform",
+    "detection_map", "polygon_box_transform", "anchor_generator",
+    "rpn_target_assign", "generate_proposals",
 ]
 
 
@@ -329,3 +330,166 @@ def polygon_box_transform(input, name=None):
         type="polygon_box_transform", inputs={"Input": [input]},
         outputs={"Output": [out]})
     return out
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=[0.1, 0.1, 0.2, 0.2], stride=None, offset=0.5,
+                     name=None):
+    """reference detection.py:1167 anchor_generator — anchors for every
+    position of an (N, C, H, W) feature map; returns (Anchors, Variances)
+    each (H, W, A, 4), A = len(aspect_ratios) * len(anchor_sizes)."""
+    helper = LayerHelper("anchor_generator", name=name)
+    sizes = list(anchor_sizes) if isinstance(
+        anchor_sizes, (list, tuple)) else [anchor_sizes]
+    ratios = list(aspect_ratios) if isinstance(
+        aspect_ratios, (list, tuple)) else [aspect_ratios]
+    if stride is None or len(stride) != 2:
+        raise ValueError("anchor_generator requires stride [sw, sh]")
+    a = len(sizes) * len(ratios)
+    h, w = input.shape[2], input.shape[3]
+    anchors = helper.create_variable_for_type_inference(
+        "float32", shape=(h, w, a, 4))
+    variances = helper.create_variable_for_type_inference(
+        "float32", shape=(h, w, a, 4))
+    helper.append_op(
+        type="anchor_generator",
+        inputs={"Input": [input]},
+        outputs={"Anchors": [anchors], "Variances": [variances]},
+        attrs={"anchor_sizes": [float(s) for s in sizes],
+               "aspect_ratios": [float(r) for r in ratios],
+               "variances": [float(v) for v in variance],
+               "stride": [float(s) for s in stride],
+               "offset": float(offset)},
+    )
+    anchors.stop_gradient = True
+    variances.stop_gradient = True
+    return anchors, variances
+
+
+def rpn_target_assign(loc, scores, anchor_box, gt_box,
+                      rpn_batch_size_per_im=256, fg_fraction=0.25,
+                      rpn_positive_overlap=0.7, rpn_negative_overlap=0.3):
+    """reference detection.py:57 rpn_target_assign — label + sample RPN
+    anchors against ground truth.
+
+    Dense redesign (static shapes): returns
+    (predicted_scores (rpn_batch, 1), predicted_location (F, 4),
+    target_label (rpn_batch, 1), target_bbox (F, 4)) with
+    F = rpn_batch_size_per_im * fg_fraction; rows past the sampled counts
+    are zero (the reference returns ragged gathers instead)."""
+    helper = LayerHelper("rpn_target_assign")
+    iou = iou_similarity(gt_box, anchor_box, box_normalized=False)
+    batch = int(rpn_batch_size_per_im)
+    fg_cap = max(int(batch * fg_fraction), 1)
+    na = anchor_box.shape[0]
+
+    loc_index = helper.create_variable_for_type_inference(
+        "int32", shape=(fg_cap,))
+    score_index = helper.create_variable_for_type_inference(
+        "int32", shape=(batch,))
+    target_label_all = helper.create_variable_for_type_inference(
+        "int64", shape=(na,))
+    matched_gt = helper.create_variable_for_type_inference(
+        "int32", shape=(na,))
+    fg_num = helper.create_variable_for_type_inference(
+        "int32", shape=(1,))
+    helper.append_op(
+        type="rpn_target_assign",
+        inputs={"DistMat": [iou]},
+        outputs={"LocationIndex": [loc_index], "ScoreIndex": [score_index],
+                 "TargetLabel": [target_label_all],
+                 "MatchedGt": [matched_gt], "FgNum": [fg_num]},
+        attrs={"rpn_batch_size_per_im": batch,
+               "fg_fraction": float(fg_fraction),
+               "rpn_positive_overlap": float(rpn_positive_overlap),
+               "rpn_negative_overlap": float(rpn_negative_overlap)},
+    )
+    for v in (loc_index, score_index, target_label_all, matched_gt, fg_num):
+        v.stop_gradient = True
+
+    from . import nn as nn_layers
+    from . import tensor as tensor_layers
+
+    def _nonpad_mask(index):
+        # 1.0 where index >= 0, else 0.0 (padded slots)
+        zero = tensor_layers.fill_constant(shape=[1], dtype="int32", value=0)
+        cond = helper.create_variable_for_type_inference(
+            "bool", shape=index.shape)
+        helper.append_op(type="greater_equal",
+                         inputs={"X": [index], "Y": [zero]},
+                         outputs={"Out": [cond]})
+        return tensor_layers.cast(cond, "float32")
+
+    # gather with -1 padding: clamp to 0 and zero the padded rows
+    def masked_gather(x, index):
+        clamped = nn_layers.relu(tensor_layers.cast(index, "int32"))
+        g = nn_layers.gather(x, clamped)
+        mask = _nonpad_mask(index)
+        return g * nn_layers.reshape(
+            mask, shape=[index.shape[0]] + [1] * (len(x.shape) - 1))
+
+    # predicted loc/scores for the sampled anchors
+    loc2 = nn_layers.reshape(loc, shape=[-1, 4])
+    score2 = nn_layers.reshape(scores, shape=[-1, 1])
+    predicted_location = masked_gather(loc2, loc_index)
+    predicted_scores = masked_gather(score2, score_index)
+    # regression target: encode the matched gt against each fg anchor
+    enc = box_coder(prior_box=anchor_box, prior_box_var=None,
+                    target_box=gt_box, code_type="encode_center_size",
+                    box_normalized=False)  # (Ng, A, 4)
+    ng = gt_box.shape[0]
+    enc_flat = nn_layers.reshape(
+        nn_layers.transpose(enc, perm=[1, 0, 2]), shape=[-1, 4])  # (A*Ng,4)
+    gt_of_anchor = masked_gather(
+        nn_layers.reshape(matched_gt, shape=[-1, 1]), loc_index)
+    # flat index = anchor * Ng + matched_gt
+    anchor_ids = nn_layers.relu(loc_index)
+    flat = anchor_ids * ng + nn_layers.reshape(
+        tensor_layers.cast(gt_of_anchor, "int32"), shape=[fg_cap])
+    target_bbox = masked_gather(enc_flat, flat)
+    # zero rows where loc_index was padding
+    pad_mask = _nonpad_mask(loc_index)
+    target_bbox = target_bbox * nn_layers.reshape(pad_mask,
+                                                  shape=[fg_cap, 1])
+    target_label = masked_gather(
+        nn_layers.reshape(
+            tensor_layers.cast(target_label_all, "float32"),
+            shape=[-1, 1]),
+        score_index)
+    return predicted_scores, predicted_location, target_label, target_bbox
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None):
+    """reference detection.py:1259 generate_proposals — decode RPN deltas,
+    clip, filter, NMS. Dense output: (rpn_rois (N, post_nms_top_n, 4),
+    rpn_roi_probs (N, post_nms_top_n, 1)), zero-padded per image (the
+    reference emits LoD rows instead)."""
+    helper = LayerHelper("generate_proposals", name=name)
+    if eta != 1.0:
+        raise NotImplementedError(
+            "generate_proposals: adaptive NMS (eta != 1.0) is not "
+            "implemented; greedy NMS at the fixed nms_thresh only")
+    n = scores.shape[0]
+    rois = helper.create_variable_for_type_inference(
+        bbox_deltas.dtype, shape=(n, post_nms_top_n, 4))
+    probs = helper.create_variable_for_type_inference(
+        scores.dtype, shape=(n, post_nms_top_n, 1))
+    counts = helper.create_variable_for_type_inference(
+        "int32", shape=(n,))
+    helper.append_op(
+        type="generate_proposals",
+        inputs={"Scores": [scores], "BboxDeltas": [bbox_deltas],
+                "ImInfo": [im_info], "Anchors": [anchors],
+                "Variances": [variances]},
+        outputs={"RpnRois": [rois], "RpnRoiProbs": [probs],
+                 "RpnRoisNum": [counts]},
+        attrs={"pre_nms_topN": int(pre_nms_top_n),
+               "post_nms_topN": int(post_nms_top_n),
+               "nms_thresh": float(nms_thresh),
+               "min_size": float(min_size), "eta": float(eta)},
+    )
+    rois.stop_gradient = True
+    probs.stop_gradient = True
+    return rois, probs

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from .registry import register_op
@@ -49,14 +50,17 @@ def _iou_similarity(ctx):
     return {"Out": iou_matrix(x, y, box_normalized)}
 
 
-def encode_center_size(target, prior, prior_var):
-    """target (..., 4) gt vs prior (..., 4) -> offsets (..., 4)."""
-    pw = prior[..., 2] - prior[..., 0]
-    ph = prior[..., 3] - prior[..., 1]
+def encode_center_size(target, prior, prior_var, box_normalized=True):
+    """target (..., 4) gt vs prior (..., 4) -> offsets (..., 4).
+    box_normalized=False uses the reference's legacy +1 pixel extents
+    (box_coder_op.cc), matching generate_proposals' decode."""
+    one = 0.0 if box_normalized else 1.0
+    pw = prior[..., 2] - prior[..., 0] + one
+    ph = prior[..., 3] - prior[..., 1] + one
     pcx = prior[..., 0] + 0.5 * pw
     pcy = prior[..., 1] + 0.5 * ph
-    gw = target[..., 2] - target[..., 0]
-    gh = target[..., 3] - target[..., 1]
+    gw = target[..., 2] - target[..., 0] + one
+    gh = target[..., 3] - target[..., 1] + one
     gcx = target[..., 0] + 0.5 * gw
     gcy = target[..., 1] + 0.5 * gh
     out = jnp.stack([
@@ -70,9 +74,10 @@ def encode_center_size(target, prior, prior_var):
     return out
 
 
-def decode_center_size(code, prior, prior_var):
-    pw = prior[..., 2] - prior[..., 0]
-    ph = prior[..., 3] - prior[..., 1]
+def decode_center_size(code, prior, prior_var, box_normalized=True):
+    one = 0.0 if box_normalized else 1.0
+    pw = prior[..., 2] - prior[..., 0] + one
+    ph = prior[..., 3] - prior[..., 1] + one
     pcx = prior[..., 0] + 0.5 * pw
     pcy = prior[..., 1] + 0.5 * ph
     if prior_var is not None:
@@ -81,8 +86,8 @@ def decode_center_size(code, prior, prior_var):
     cy = code[..., 1] * ph + pcy
     w = jnp.exp(code[..., 2]) * pw
     h = jnp.exp(code[..., 3]) * ph
-    return jnp.stack([cx - 0.5 * w, cy - 0.5 * h, cx + 0.5 * w, cy + 0.5 * h],
-                     axis=-1)
+    return jnp.stack([cx - 0.5 * w, cy - 0.5 * h,
+                      cx + 0.5 * w - one, cy + 0.5 * h - one], axis=-1)
 
 
 @register_op("box_coder")
@@ -91,19 +96,22 @@ def _box_coder(ctx):
     prior_var = ctx.input("PriorBoxVar")  # (M, 4) or None
     target = ctx.input("TargetBox")
     code_type = ctx.attr("code_type", "encode_center_size")
+    norm = bool(ctx.attr("box_normalized", True))
     if code_type == "encode_center_size":
         if target.ndim == 3 and target.shape[1] == prior.shape[0]:
             # matched layout (B, M, 4): encode each box against ITS prior
             out = encode_center_size(target, prior[None], (
-                None if prior_var is None else prior_var[None]))
+                None if prior_var is None else prior_var[None]),
+                box_normalized=norm)
         else:
             # reference layout: target (N, 4) vs every prior -> (N, M, 4)
             out = encode_center_size(
                 target[..., :, None, :], prior[None, :, :],
-                None if prior_var is None else prior_var[None, :, :])
+                None if prior_var is None else prior_var[None, :, :],
+                box_normalized=norm)
     else:  # decode: target (..., M, 4) offsets against the M priors
         out = decode_center_size(
-            target, prior, prior_var)
+            target, prior, prior_var, box_normalized=norm)
     return {"OutputBox": out}
 
 
@@ -432,3 +440,183 @@ def _polygon_box_transform(ctx):
     row = jnp.arange(h, dtype=x.dtype)[None, None, :, None] * 4.0
     is_x = (jnp.arange(c) % 2 == 0)[None, :, None, None]
     return {"Output": jnp.where(is_x, col - x, row - x)}
+
+
+# ---------------------------------------------------------------------------
+# RPN / Faster-RCNN proposal ops (reference: operators/detection/
+# anchor_generator_op.h, rpn_target_assign_op.cc, generate_proposals_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register_op("anchor_generator")
+def _anchor_generator(ctx):
+    """Anchors for every feature-map position (reference:
+    anchor_generator_op.h). Input (N, C, H, W); outputs Anchors /
+    Variances, each (H, W, A, 4), A = len(aspect_ratios)*len(anchor_sizes)
+    with the reference's ratio-major ordering and legacy (size-1) extents.
+    """
+    x = ctx.input("Input")
+    sizes = [float(s) for s in ctx.attr("anchor_sizes")]
+    ratios = [float(r) for r in ctx.attr("aspect_ratios")]
+    variances = [float(v) for v in ctx.attr("variances",
+                                            [0.1, 0.1, 0.2, 0.2])]
+    sw, sh = (float(s) for s in ctx.attr("stride"))
+    offset = float(ctx.attr("offset", 0.5))
+    h, w = x.shape[2], x.shape[3]
+
+    xs = jnp.arange(w, dtype=jnp.float32) * sw + offset * (sw - 1)
+    ys = jnp.arange(h, dtype=jnp.float32) * sh + offset * (sh - 1)
+    cx, cy = jnp.meshgrid(xs, ys)  # (H, W)
+
+    whs = []
+    area = sw * sh
+    for ar in ratios:
+        base_w = np.round(np.sqrt(area / ar))
+        base_h = np.round(base_w * ar)
+        for size in sizes:
+            whs.append((size / sw * base_w, size / sh * base_h))
+    aw = jnp.asarray([p[0] for p in whs], jnp.float32)  # (A,)
+    ah = jnp.asarray([p[1] for p in whs], jnp.float32)
+    cx = cx[..., None]
+    cy = cy[..., None]
+    anchors = jnp.stack([
+        cx - 0.5 * (aw - 1), cy - 0.5 * (ah - 1),
+        cx + 0.5 * (aw - 1), cy + 0.5 * (ah - 1)], axis=-1)  # (H, W, A, 4)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           anchors.shape)
+    return {"Anchors": anchors, "Variances": var}
+
+
+@register_op("rpn_target_assign")
+def _rpn_target_assign(ctx):
+    """Faster-RCNN RPN anchor labeling + minibatch sampling (reference:
+    rpn_target_assign_op.cc). Input DistMat: (Ng, A) anchor/gt IoU.
+
+    Dense redesign (static shapes; the reference emits ragged index
+    vectors): LocationIndex is (F,) and ScoreIndex (rpn_batch,) padded
+    with -1 past the valid counts; TargetLabel is (A,) with 1 fg / 0 bg /
+    -1 ignore for EVERY anchor. Sampling is a random ranking (jax PRNG
+    from the op's deterministic stream) instead of reservoir sampling —
+    the same uniform-without-replacement distribution.
+    """
+    dist = ctx.input("DistMat")  # (Ng, A)
+    batch = int(ctx.attr("rpn_batch_size_per_im", 256))
+    fg_frac = float(ctx.attr("fg_fraction", 0.25))
+    pos_th = float(ctx.attr("rpn_positive_overlap", 0.7))
+    neg_th = float(ctx.attr("rpn_negative_overlap", 0.3))
+    ng, na = dist.shape
+    fg_cap = max(int(batch * fg_frac), 1)
+
+    anchor_max = dist.max(axis=0)  # (A,)
+    # per-gt argmax anchors are positive regardless of threshold; an
+    # all-zero gt row (ragged gt lists are zero-padded) must not vote or
+    # it would match its own row_max of 0 at EVERY anchor
+    row_max = dist.max(axis=1, keepdims=True)
+    is_rowmax = ((dist == row_max) & (row_max > 0)).any(axis=0)
+    label = jnp.where(anchor_max > pos_th, 1,
+                      jnp.where(anchor_max < neg_th, 0, -1))
+    label = jnp.where(is_rowmax, 1, label)
+    matched_gt = dist.argmax(axis=0).astype(jnp.int32)  # (A,)
+
+    key = ctx.rng()
+    rnd = jax.random.uniform(key, (na,))
+    fg = label == 1
+    bg = label == 0
+    # rank fg anchors randomly; keep the first fg_cap
+    fg_rank = jnp.argsort(jnp.argsort(jnp.where(fg, rnd, 2.0)))
+    sel_fg = fg & (fg_rank < fg_cap)
+    n_fg = jnp.minimum(fg.sum(), fg_cap)
+    bg_cap = batch - n_fg
+    bg_rank = jnp.argsort(jnp.argsort(jnp.where(bg, rnd, 2.0)))
+    sel_bg = bg & (bg_rank < bg_cap)
+    n_bg = jnp.minimum(bg.sum(), bg_cap)
+
+    # LocationIndex: selected fg anchor ids, -1 padded to fg_cap
+    prio_fg = jnp.where(sel_fg, fg_rank, na + 1)
+    loc_order = jnp.argsort(prio_fg)[:fg_cap]
+    loc_index = jnp.where(jnp.arange(fg_cap) < n_fg,
+                          loc_order.astype(jnp.int32), -1)
+    # ScoreIndex: selected fg then selected bg, -1 padded to batch
+    prio = jnp.where(sel_fg, fg_rank.astype(jnp.float32),
+                     jnp.where(sel_bg, na + bg_rank.astype(jnp.float32),
+                               jnp.inf))
+    score_order = jnp.argsort(prio)[:batch]
+    score_index = jnp.where(jnp.arange(batch) < n_fg + n_bg,
+                            score_order.astype(jnp.int32), -1)
+    return {
+        "LocationIndex": loc_index,
+        "ScoreIndex": score_index,
+        "TargetLabel": label.astype(jnp.int64),
+        "MatchedGt": matched_gt,
+        "FgNum": n_fg.astype(jnp.int32).reshape(1),
+    }
+
+
+@register_op("generate_proposals")
+def _generate_proposals(ctx):
+    """RPN proposal generation (reference: generate_proposals_op.cc):
+    decode bbox deltas against anchors (legacy +1 extents, exp clipped at
+    log(1000/16)), clip to the image, drop boxes under min_size (scaled by
+    im_info), take pre_nms_top_n by score, greedy NMS, keep
+    post_nms_top_n. Dense output: RpnRois (N, post_n, 4) / RpnRoiProbs
+    (N, post_n, 1), zero-padded past each image's proposal count
+    (RpnRoisNum carries the counts; the reference uses LoD instead)."""
+    scores = ctx.input("Scores")        # (N, A, H, W)
+    deltas = ctx.input("BboxDeltas")    # (N, 4A, H, W)
+    im_info = ctx.input("ImInfo")       # (N, 3) h, w, scale
+    anchors = ctx.input("Anchors")      # (H, W, A, 4)
+    variances = ctx.input("Variances")  # (H, W, A, 4)
+    pre_n = int(ctx.attr("pre_nms_topN", 6000))
+    post_n = int(ctx.attr("post_nms_topN", 1000))
+    nms_th = float(ctx.attr("nms_thresh", 0.5))
+    min_size = float(ctx.attr("min_size", 0.1))
+
+    n, a, h, w = scores.shape
+    total = h * w * a
+    pre_n = min(pre_n, total)
+    anchors_f = anchors.reshape(total, 4)
+    var_f = variances.reshape(total, 4)
+
+    def decode(delta, anchor, var):
+        # legacy +1 extents and 1000/16 exp clip (generate_proposals_op.cc)
+        aw = anchor[..., 2] - anchor[..., 0] + 1.0
+        ah = anchor[..., 3] - anchor[..., 1] + 1.0
+        acx = anchor[..., 0] + 0.5 * aw
+        acy = anchor[..., 1] + 0.5 * ah
+        d = delta * var
+        clip = np.log(1000.0 / 16.0)
+        cx = d[..., 0] * aw + acx
+        cy = d[..., 1] * ah + acy
+        bw = jnp.exp(jnp.minimum(d[..., 2], clip)) * aw
+        bh = jnp.exp(jnp.minimum(d[..., 3], clip)) * ah
+        return jnp.stack([cx - 0.5 * bw, cy - 0.5 * bh,
+                          cx + 0.5 * bw - 1.0, cy + 0.5 * bh - 1.0], -1)
+
+    def per_image(score_i, delta_i, info_i):
+        # (A, H, W) -> (H, W, A) -> flat; (4A, H, W) -> (H, W, A, 4)
+        s = score_i.transpose(1, 2, 0).reshape(total)
+        d = delta_i.reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(
+            total, 4)
+        boxes = decode(d, anchors_f, var_f)
+        ih, iw, iscale = info_i[0], info_i[1], info_i[2]
+        boxes = jnp.stack([
+            jnp.clip(boxes[..., 0], 0, iw - 1),
+            jnp.clip(boxes[..., 1], 0, ih - 1),
+            jnp.clip(boxes[..., 2], 0, iw - 1),
+            jnp.clip(boxes[..., 3], 0, ih - 1)], -1)
+        bw = boxes[..., 2] - boxes[..., 0] + 1.0
+        bh = boxes[..., 3] - boxes[..., 1] + 1.0
+        keep_sz = (bw >= min_size * iscale) & (bh >= min_size * iscale)
+        s = jnp.where(keep_sz, s, -jnp.inf)
+        top_s, top_i = lax.top_k(s, pre_n)
+        top_boxes = boxes[top_i]
+        keep = _nms_keep(top_boxes, top_s, nms_th, box_normalized=False)
+        # stable-compact the kept boxes to the front, pad with zeros
+        order = jnp.argsort(~keep, stable=True)[:post_n]
+        kept = keep[order]
+        rois = jnp.where(kept[:, None], top_boxes[order], 0.0)
+        probs = jnp.where(kept, top_s[order], 0.0)
+        return rois, probs[:, None], kept.sum().astype(jnp.int32)
+
+    rois, probs, counts = jax.vmap(per_image)(scores, deltas, im_info)
+    return {"RpnRois": rois, "RpnRoiProbs": probs, "RpnRoisNum": counts}

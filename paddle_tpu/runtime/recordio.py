@@ -69,6 +69,11 @@ def _load():
     lib.ptrt_chan_send.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
     lib.ptrt_chan_recv.restype = ctypes.c_int64
     lib.ptrt_chan_recv.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_char))]
+    lib.ptrt_chan_recv_batch.restype = ctypes.c_int64
+    lib.ptrt_chan_recv_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
+        ctypes.POINTER(ctypes.c_int64)]
     lib.ptrt_chan_size.restype = ctypes.c_int64
     lib.ptrt_chan_size.argtypes = [ctypes.c_void_p]
     lib.ptrt_chan_close.argtypes = [ctypes.c_void_p]
@@ -320,6 +325,29 @@ class Channel:
         if n < 0:
             return None
         return _take(self._lib, buf, n)
+
+    def recv_batch(self, max_n: int) -> Optional[list]:
+        """Block for the first record, then drain whatever else is queued
+        (up to max_n) without waiting — the C++ dynamic-batching pull
+        (ptrt_chan_recv_batch) behind the predictor serving loop. Returns
+        None once closed and drained."""
+        if self._lib is None:
+            with self._cv:
+                while not self._dq and not self._closed:
+                    self._cv.wait()
+                if not self._dq:
+                    return None
+                out = []
+                while self._dq and len(out) < max_n:
+                    out.append(self._dq.popleft())
+                self._cv.notify_all()
+                return out
+        bufs = (ctypes.POINTER(ctypes.c_char) * max_n)()
+        lens = (ctypes.c_int64 * max_n)()
+        n = self._lib.ptrt_chan_recv_batch(self._h, max_n, bufs, lens)
+        if n <= 0:
+            return None
+        return [_take(self._lib, bufs[i], lens[i]) for i in range(n)]
 
     def qsize(self) -> int:
         if self._lib is None:

@@ -91,6 +91,29 @@ int64_t ptrt_chan_recv(void* ch, char** out) {
   return b.len;
 }
 
+// batch pull for the predictor serving loop (reference: the C++
+// NativePredictor's request loop, api/api_impl.cc): blocks for the FIRST
+// record, then drains whatever else is queued up to max_n without
+// waiting — dynamic batching. Returns the number of records (0 when the
+// channel is closed and drained); outs[i] own malloc'd bytes
+// (ptrt_free), lens[i] their lengths.
+int64_t ptrt_chan_recv_batch(void* ch, int64_t max_n, char** outs,
+                             int64_t* lens) {
+  Channel* c = (Channel*)ch;
+  std::unique_lock<std::mutex> lk(c->mu);
+  c->not_empty.wait(lk, [c] { return !c->q.empty() || c->closed; });
+  int64_t n = 0;
+  while (n < max_n && !c->q.empty()) {
+    Buf b = c->q.front();
+    c->q.pop_front();
+    outs[n] = b.data;
+    lens[n] = b.len;
+    ++n;
+  }
+  if (n > 0) c->not_full.notify_all();
+  return n;
+}
+
 int64_t ptrt_chan_size(void* ch) {
   Channel* c = (Channel*)ch;
   std::lock_guard<std::mutex> lk(c->mu);

@@ -1,0 +1,82 @@
+"""Inference serving tests: AOT predictor cold start (no re-trace) + the
+C++-batched PredictorServer loop. Reference: inference/api/api_impl.cc."""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.inference import Predictor, PredictorServer
+
+
+def _save_model(tmp_path, seed=5):
+    mp, sp = fluid.Program(), fluid.Program()
+    mp.random_seed = sp.random_seed = seed
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(mp, sp):
+        with fluid.unique_name.guard():
+            x = layers.data(name="x", shape=[4])
+            h = layers.fc(x, 8, act="relu")
+            out = layers.fc(h, 3, act="softmax")
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(sp)
+        fluid.io.save_inference_model(str(tmp_path), ["x"], [out], exe,
+                                      main_program=mp, scope=scope)
+        # reference output for a fixed batch
+        feed = np.linspace(-1, 1, 12).reshape(3, 4).astype(np.float32)
+        want, = exe.run(mp, feed={"x": feed}, fetch_list=[out])
+    return feed, np.asarray(want)
+
+
+def test_predictor_matches_executor(tmp_path):
+    feed, want = _save_model(tmp_path)
+    p = Predictor(str(tmp_path))
+    got, = p.run({"x": feed})
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    got, = p.run([feed])  # positional feed
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    assert p.traces >= 1
+
+
+def test_predictor_aot_cold_start_no_retrace(tmp_path):
+    feed, want = _save_model(tmp_path)
+    p1 = Predictor(str(tmp_path))
+    out1, = p1.run({"x": feed})
+    assert p1.traces >= 1  # first predictor traced + compiled + cached
+
+    # fresh predictor = cold start: the serialized executable is loaded,
+    # the program is NEVER traced again
+    p2 = Predictor(str(tmp_path))
+    out2, = p2.run({"x": feed})
+    assert p2.traces == 0, "cold start re-traced the program"
+    np.testing.assert_allclose(out2, out1, rtol=1e-6)
+    # second signature still works (compiles fresh)
+    other = np.zeros((5, 4), np.float32)
+    o, = p2.run({"x": other})
+    assert o.shape == (5, 3)
+
+
+def test_predictor_aot_cache_disabled(tmp_path):
+    feed, want = _save_model(tmp_path)
+    p1 = Predictor(str(tmp_path), aot_cache=False)
+    p1.run({"x": feed})
+    p2 = Predictor(str(tmp_path), aot_cache=False)
+    p2.run({"x": feed})
+    assert p2.traces >= 1  # without the cache a fresh process re-traces
+
+
+def test_predictor_server_batching(tmp_path):
+    feed, want = _save_model(tmp_path)
+    p = Predictor(str(tmp_path))
+    p.run({"x": feed})  # warm the executable for batch sizes below
+    server = PredictorServer(p, max_batch=4)
+    server.start()
+    futs = [server.submit((feed[i % 3],)) for i in range(9)]
+    for i, fut in enumerate(futs):
+        row = fut.result(timeout=60)
+        np.testing.assert_allclose(row[0], want[i % 3], rtol=1e-4,
+                                   atol=1e-5)
+    server.stop()
+    with pytest.raises(RuntimeError):
+        server.submit((feed[0],))

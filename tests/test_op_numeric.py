@@ -638,6 +638,8 @@ COVERED_ELSEWHERE = {
     "iou_similarity", "box_coder", "bipartite_match", "target_assign",
     "mine_hard_examples", "multiclass_nms", "detection_map", "prior_box",
     "polygon_box_transform",
+    # RPN: tests/test_rpn.py
+    "anchor_generator", "rpn_target_assign", "generate_proposals",
     # attention/fused: tests/test_attention.py, tests/test_fused_loss.py
     "fused_attention", "fused_lm_head_loss",
     # metrics: tests/test_aux.py

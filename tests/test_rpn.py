@@ -1,0 +1,215 @@
+"""RPN detection ops: anchor_generator / rpn_target_assign /
+generate_proposals numeric tests vs numpy references on small fixtures.
+Reference: layers/detection.py:57,1167,1259 + operators/detection/*."""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from op_test import run_op
+
+
+def rs(seed):
+    return np.random.RandomState(seed)
+
+
+def _np_anchors(h, w, sizes, ratios, sw, sh, offset=0.5):
+    out = np.zeros((h, w, len(ratios) * len(sizes), 4), np.float32)
+    for hi in range(h):
+        for wi in range(w):
+            cx = wi * sw + offset * (sw - 1)
+            cy = hi * sh + offset * (sh - 1)
+            idx = 0
+            for ar in ratios:
+                base_w = np.round(np.sqrt(sw * sh / ar))
+                base_h = np.round(base_w * ar)
+                for size in sizes:
+                    aw = size / sw * base_w
+                    ah = size / sh * base_h
+                    out[hi, wi, idx] = [cx - 0.5 * (aw - 1),
+                                        cy - 0.5 * (ah - 1),
+                                        cx + 0.5 * (aw - 1),
+                                        cy + 0.5 * (ah - 1)]
+                    idx += 1
+    return out
+
+
+def test_anchor_generator():
+    x = rs(0).randn(1, 8, 3, 4).astype(np.float32)
+    sizes, ratios = [32.0, 64.0], [0.5, 1.0, 2.0]
+    got = run_op("anchor_generator", {"Input": x},
+                 attrs={"anchor_sizes": sizes, "aspect_ratios": ratios,
+                        "variances": [0.1, 0.1, 0.2, 0.2],
+                        "stride": [16.0, 16.0], "offset": 0.5},
+                 outs=("Anchors", "Variances"))
+    want = _np_anchors(3, 4, sizes, ratios, 16.0, 16.0)
+    np.testing.assert_allclose(np.asarray(got["Anchors"]), want, rtol=1e-5,
+                               atol=1e-4)
+    v = np.asarray(got["Variances"])
+    assert v.shape == (3, 4, 6, 4)
+    np.testing.assert_allclose(v[1, 2, 3], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_rpn_target_assign_op():
+    # 3 gt x 8 anchors IoU fixture
+    dist = np.array([
+        [0.9, 0.1, 0.0, 0.5, 0.0, 0.2, 0.0, 0.1],
+        [0.1, 0.8, 0.0, 0.1, 0.0, 0.2, 0.0, 0.1],
+        [0.0, 0.1, 0.4, 0.0, 0.0, 0.2, 0.0, 0.1],
+    ], np.float32)
+    got = run_op("rpn_target_assign", {"DistMat": dist},
+                 attrs={"rpn_batch_size_per_im": 6, "fg_fraction": 0.5,
+                        "rpn_positive_overlap": 0.7,
+                        "rpn_negative_overlap": 0.3},
+                 outs=("LocationIndex", "ScoreIndex", "TargetLabel",
+                       "MatchedGt", "FgNum"))
+    label = np.asarray(got["TargetLabel"])
+    # anchors 0,1 exceed 0.7; anchor 2 is gt-2's argmax -> fg
+    assert label[0] == 1 and label[1] == 1 and label[2] == 1
+    # anchor 3: max IoU 0.5 -> ignore (-1); anchors 4,6: 0 -> bg
+    assert label[3] == -1 and label[4] == 0 and label[6] == 0
+    # anchor 5 (0.2) and 7 (0.1) are bg
+    assert label[5] == 0 and label[7] == 0
+    np.testing.assert_array_equal(np.asarray(got["MatchedGt"])[:3],
+                                  [0, 1, 2])
+    fg_num = int(np.asarray(got["FgNum"])[0])
+    assert fg_num == 3  # fg_cap = 3, three fg anchors
+    loc = np.asarray(got["LocationIndex"])
+    assert sorted(loc.tolist()) == [0, 1, 2]
+    si = np.asarray(got["ScoreIndex"])
+    valid = si[si >= 0]
+    # fg first, then sampled bg, all distinct
+    assert set(valid[:3]) == {0, 1, 2}
+    assert len(set(valid.tolist())) == len(valid)
+    for b in valid[3:]:
+        assert label[b] == 0
+
+
+def test_rpn_target_assign_padded_gt_row():
+    # a zero-padded gt row must not promote every anchor to foreground
+    dist = np.array([
+        [0.9, 0.1, 0.05, 0.5],
+        [0.0, 0.0, 0.0, 0.0],   # padding row
+    ], np.float32)
+    got = run_op("rpn_target_assign", {"DistMat": dist},
+                 attrs={"rpn_batch_size_per_im": 4, "fg_fraction": 0.5,
+                        "rpn_positive_overlap": 0.7,
+                        "rpn_negative_overlap": 0.3},
+                 outs=("TargetLabel",))
+    label = np.asarray(got["TargetLabel"])
+    np.testing.assert_array_equal(label, [1, 0, 0, -1])
+
+
+def test_rpn_target_assign_layer():
+    r = rs(1)
+    na, ng = 12, 2
+    anchors = np.abs(r.randn(na, 4)).astype(np.float32)
+    anchors[:, 2:] = anchors[:, :2] + 4.0 + np.abs(r.randn(na, 2))
+    gt = anchors[[2, 7]] + 0.5  # overlaps anchors 2 and 7 strongly
+    loc = r.randn(1, na, 4).astype(np.float32)
+    scores = r.randn(1, na, 1).astype(np.float32)
+
+    mp, sp = fluid.Program(), fluid.Program()
+    mp.random_seed = sp.random_seed = 3
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(mp, sp):
+        lv = layers.data(name="loc", shape=[1, na, 4],
+                         append_batch_size=False)
+        sv = layers.data(name="scores", shape=[1, na, 1],
+                         append_batch_size=False)
+        av = layers.data(name="anchors", shape=[na, 4],
+                         append_batch_size=False)
+        gv = layers.data(name="gt", shape=[ng, 4], append_batch_size=False)
+        ps, pl, tl, tb = layers.rpn_target_assign(
+            lv, sv, av, gv, rpn_batch_size_per_im=8, fg_fraction=0.25)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(sp)
+        out = exe.run(mp, feed={"loc": loc, "scores": scores,
+                                "anchors": anchors, "gt": gt},
+                      fetch_list=[ps, pl, tl, tb])
+    ps_v, pl_v, tl_v, tb_v = (np.asarray(o) for o in out)
+    assert ps_v.shape == (8, 1) and tl_v.shape == (8, 1)
+    assert pl_v.shape == (2, 4) and tb_v.shape == (2, 4)
+    assert np.isfinite(ps_v).all() and np.isfinite(tb_v).all()
+    # the sampled fg labels lead the score batch
+    assert tl_v[0, 0] == 1.0
+
+
+def test_generate_proposals():
+    h, w, a = 2, 2, 2
+    anchors = _np_anchors(h, w, [16.0], [0.5, 1.0], 8.0, 8.0)
+    var = np.full((h, w, a, 4), 1.0, np.float32)
+    scores = rs(2).rand(1, a, h, w).astype(np.float32)
+    deltas = (0.1 * rs(3).randn(1, 4 * a, h, w)).astype(np.float32)
+    im_info = np.array([[32.0, 32.0, 1.0]], np.float32)
+    got = run_op("generate_proposals",
+                 {"Scores": scores, "BboxDeltas": deltas,
+                  "ImInfo": im_info, "Anchors": anchors, "Variances": var},
+                 attrs={"pre_nms_topN": 8, "post_nms_topN": 4,
+                        "nms_thresh": 0.5, "min_size": 0.1},
+                 outs=("RpnRois", "RpnRoiProbs", "RpnRoisNum"))
+    rois = np.asarray(got["RpnRois"])
+    probs = np.asarray(got["RpnRoiProbs"])
+    cnt = int(np.asarray(got["RpnRoisNum"])[0])
+    assert rois.shape == (1, 4, 4) and probs.shape == (1, 4, 1)
+    assert 1 <= cnt <= 4
+    # valid rois are inside the image and properly ordered corners
+    val = rois[0, :cnt]
+    assert (val[:, 0] <= val[:, 2]).all() and (val[:, 1] <= val[:, 3]).all()
+    assert val.min() >= 0 and val.max() <= 31.0
+    # probs sorted descending over the valid rows
+    pv = probs[0, :cnt, 0]
+    assert (np.diff(pv) <= 1e-6).all()
+
+    # numpy reference for the TOP-scoring proposal (survives NMS first)
+    s_flat = scores[0].transpose(1, 2, 0).reshape(-1)
+    d_flat = deltas[0].reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+    a_flat = anchors.reshape(-1, 4)
+    i0 = int(np.argmax(s_flat))
+    aw = a_flat[i0, 2] - a_flat[i0, 0] + 1
+    ah = a_flat[i0, 3] - a_flat[i0, 1] + 1
+    acx = a_flat[i0, 0] + 0.5 * aw
+    acy = a_flat[i0, 1] + 0.5 * ah
+    d = d_flat[i0]
+    cx, cy = d[0] * aw + acx, d[1] * ah + acy
+    bw, bh = np.exp(d[2]) * aw, np.exp(d[3]) * ah
+    box = np.array([cx - 0.5 * bw, cy - 0.5 * bh,
+                    cx + 0.5 * bw - 1, cy + 0.5 * bh - 1])
+    box = np.clip(box, 0, 31)
+    np.testing.assert_allclose(rois[0, 0], box, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(probs[0, 0, 0], s_flat[i0], rtol=1e-5)
+
+
+def test_generate_proposals_layer():
+    h, w, a = 3, 3, 2
+    scores = rs(4).rand(2, a, h, w).astype(np.float32)
+    deltas = (0.05 * rs(5).randn(2, 4 * a, h, w)).astype(np.float32)
+    im_info = np.array([[48, 48, 1.0], [48, 48, 1.0]], np.float32)
+
+    mp, sp = fluid.Program(), fluid.Program()
+    mp.random_seed = sp.random_seed = 9
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(mp, sp):
+        fm = layers.data(name="fm", shape=[2, 8, h, w],
+                         append_batch_size=False)
+        sv = layers.data(name="scores", shape=[2, a, h, w],
+                         append_batch_size=False)
+        dv = layers.data(name="deltas", shape=[2, 4 * a, h, w],
+                         append_batch_size=False)
+        iv = layers.data(name="im_info", shape=[2, 3],
+                         append_batch_size=False)
+        anc, var = layers.anchor_generator(
+            fm, anchor_sizes=[16.0], aspect_ratios=[0.5, 1.0],
+            stride=[16.0, 16.0])
+        rois, probs = layers.generate_proposals(
+            sv, dv, iv, anc, var, pre_nms_top_n=12, post_nms_top_n=5)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(sp)
+        rv, pv = exe.run(mp, feed={
+            "fm": rs(6).randn(2, 8, h, w).astype(np.float32),
+            "scores": scores, "deltas": deltas, "im_info": im_info},
+            fetch_list=[rois, probs])
+    assert np.asarray(rv).shape == (2, 5, 4)
+    assert np.asarray(pv).shape == (2, 5, 1)
+    assert np.isfinite(np.asarray(rv)).all()
