@@ -205,11 +205,15 @@ class PredictorServer:
     """
 
     def __init__(self, predictor: Predictor, max_batch: int = 8,
-                 capacity: int = 256):
+                 capacity: int = 256, pad_batches: bool = True):
         from .runtime.recordio import Channel
 
         self.predictor = predictor
         self.max_batch = max_batch
+        # pad every dynamic batch up to max_batch (zero rows, sliced off
+        # after predict): ONE compiled signature instead of one XLA
+        # compile per distinct batch size the traffic happens to produce
+        self.pad_batches = pad_batches
         self._chan = Channel(capacity)
         self._thread: Optional[threading.Thread] = None
         self._results: Dict[int, "_Future"] = {}
@@ -248,6 +252,11 @@ class PredictorServer:
                 rows = [r[1] for r in reqs]
                 feed = [np.stack([row[j] for row in rows])
                         for j in range(len(rows[0]))]
+                if self.pad_batches and len(rows) < self.max_batch:
+                    pad = self.max_batch - len(rows)
+                    feed = [np.concatenate(
+                        [f, np.zeros((pad,) + f.shape[1:], f.dtype)])
+                        for f in feed]
                 outs = self.predictor.run(feed)
                 for i, (rid, _) in enumerate(reqs):
                     fut = self._pop(rid)

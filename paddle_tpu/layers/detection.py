@@ -376,7 +376,15 @@ def rpn_target_assign(loc, scores, anchor_box, gt_box,
     (predicted_scores (rpn_batch, 1), predicted_location (F, 4),
     target_label (rpn_batch, 1), target_bbox (F, 4)) with
     F = rpn_batch_size_per_im * fg_fraction; rows past the sampled counts
-    are zero (the reference returns ragged gathers instead)."""
+    are zero (the reference returns ragged gathers instead).
+
+    Single-image only (like the reference, which walks the gt LoD per
+    image): loc/scores must have batch dim 1; call per image."""
+    if len(loc.shape) == 3 and loc.shape[0] not in (1, -1):
+        raise ValueError(
+            "rpn_target_assign handles one image at a time (got batch %d); "
+            "call it per image like the reference walks the gt LoD"
+            % loc.shape[0])
     helper = LayerHelper("rpn_target_assign")
     iou = iou_similarity(gt_box, anchor_box, box_normalized=False)
     batch = int(rpn_batch_size_per_im)
@@ -433,20 +441,20 @@ def rpn_target_assign(loc, scores, anchor_box, gt_box,
     score2 = nn_layers.reshape(scores, shape=[-1, 1])
     predicted_location = masked_gather(loc2, loc_index)
     predicted_scores = masked_gather(score2, score_index)
-    # regression target: encode the matched gt against each fg anchor
-    enc = box_coder(prior_box=anchor_box, prior_box_var=None,
-                    target_box=gt_box, code_type="encode_center_size",
-                    box_normalized=False)  # (Ng, A, 4)
-    ng = gt_box.shape[0]
-    enc_flat = nn_layers.reshape(
-        nn_layers.transpose(enc, perm=[1, 0, 2]), shape=[-1, 4])  # (A*Ng,4)
-    gt_of_anchor = masked_gather(
-        nn_layers.reshape(matched_gt, shape=[-1, 1]), loc_index)
-    # flat index = anchor * Ng + matched_gt
-    anchor_ids = nn_layers.relu(loc_index)
-    flat = anchor_ids * ng + nn_layers.reshape(
-        tensor_layers.cast(gt_of_anchor, "int32"), shape=[fg_cap])
-    target_bbox = masked_gather(enc_flat, flat)
+    # regression target: gather the fg anchors and their matched gts FIRST,
+    # then encode only those F pairs (a dense (Ng, A, 4) encode would build
+    # tens of millions of floats at real RPN scale)
+    anchor_ids = nn_layers.relu(tensor_layers.cast(loc_index, "int32"))
+    anchors_fg = nn_layers.gather(anchor_box, anchor_ids)      # (F, 4)
+    gt_ids = nn_layers.gather(matched_gt, anchor_ids)          # (F,)
+    gts_fg = nn_layers.gather(gt_box,
+                              tensor_layers.cast(gt_ids, "int32"))
+    enc = box_coder(prior_box=anchors_fg, prior_box_var=None,
+                    target_box=nn_layers.reshape(gts_fg,
+                                                 shape=[1, fg_cap, 4]),
+                    code_type="encode_center_size",
+                    box_normalized=False)  # matched layout (1, F, 4)
+    target_bbox = nn_layers.reshape(enc, shape=[fg_cap, 4])
     # zero rows where loc_index was padding
     pad_mask = _nonpad_mask(loc_index)
     target_bbox = target_bbox * nn_layers.reshape(pad_mask,
@@ -461,7 +469,8 @@ def rpn_target_assign(loc, scores, anchor_box, gt_box,
 
 def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
                        pre_nms_top_n=6000, post_nms_top_n=1000,
-                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None):
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None,
+                       return_rois_num=False):
     """reference detection.py:1259 generate_proposals — decode RPN deltas,
     clip, filter, NMS. Dense output: (rpn_rois (N, post_nms_top_n, 4),
     rpn_roi_probs (N, post_nms_top_n, 1)), zero-padded per image (the
@@ -492,4 +501,9 @@ def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
     )
     rois.stop_gradient = True
     probs.stop_gradient = True
+    counts.stop_gradient = True
+    if return_rois_num:
+        # dense-layout extra: per-image valid-proposal counts, so callers
+        # can mask the zero-padded rows (the reference conveys this via LoD)
+        return rois, probs, counts
     return rois, probs

@@ -531,18 +531,25 @@ def _rpn_target_assign(ctx):
     sel_bg = bg & (bg_rank < bg_cap)
     n_bg = jnp.minimum(bg.sum(), bg_cap)
 
+    def _order_padded(prio, length):
+        # argsort yields (na,); the output is a fixed `length` regardless
+        # of the anchor count (pad when batch/fg_cap exceed na)
+        order = jnp.argsort(prio).astype(jnp.int32)
+        if length > na:
+            order = jnp.pad(order, (0, length - na), constant_values=-1)
+        return order[:length]
+
     # LocationIndex: selected fg anchor ids, -1 padded to fg_cap
     prio_fg = jnp.where(sel_fg, fg_rank, na + 1)
-    loc_order = jnp.argsort(prio_fg)[:fg_cap]
-    loc_index = jnp.where(jnp.arange(fg_cap) < n_fg,
-                          loc_order.astype(jnp.int32), -1)
+    loc_order = _order_padded(prio_fg, fg_cap)
+    loc_index = jnp.where(jnp.arange(fg_cap) < n_fg, loc_order, -1)
     # ScoreIndex: selected fg then selected bg, -1 padded to batch
     prio = jnp.where(sel_fg, fg_rank.astype(jnp.float32),
                      jnp.where(sel_bg, na + bg_rank.astype(jnp.float32),
                                jnp.inf))
-    score_order = jnp.argsort(prio)[:batch]
+    score_order = _order_padded(prio, batch)
     score_index = jnp.where(jnp.arange(batch) < n_fg + n_bg,
-                            score_order.astype(jnp.int32), -1)
+                            score_order, -1)
     return {
         "LocationIndex": loc_index,
         "ScoreIndex": score_index,

@@ -45,3 +45,9 @@ from .pipeline import (  # noqa: F401
     pipeline_apply,
     stack_stage_params,
 )
+from .moe import (  # noqa: F401
+    MoEParams,
+    expert_parallel_ffn,
+    init_moe_params,
+    moe_ffn_local,
+)

@@ -1,0 +1,110 @@
+"""Expert-parallel MoE tests: the all_to_all path matches the
+single-device reference exactly when nothing overflows capacity, capacity
+dropping behaves as specified, and gradients flow. SURVEY §2 parallel
+commitment (expert parallel for MoE)."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.parallel import make_mesh
+from paddle_tpu.parallel.moe import (MoEParams, expert_parallel_ffn,
+                                     init_moe_params, moe_capacity,
+                                     moe_ffn_local)
+
+
+def rs(seed):
+    return np.random.RandomState(seed)
+
+
+def test_moe_local_routes_and_mixes():
+    params = init_moe_params(jax.random.PRNGKey(0), d_model=8, d_ff=16,
+                             num_experts=4)
+    x = jnp.asarray(rs(1).randn(2, 6, 8), jnp.float32)
+    out = moe_ffn_local(x, params, capacity_factor=4.0, k=2)
+    assert out.shape == x.shape
+    assert np.isfinite(np.asarray(out)).all()
+    # with k=2 and ample capacity every token gets a nonzero output
+    assert (np.abs(np.asarray(out)).sum(-1) > 0).all()
+
+
+def test_moe_capacity_drops():
+    # all tokens forced to expert 0 (gate column 0 huge): only `cap`
+    # tokens fit, later ones are dropped (zero rows)
+    d, e = 4, 2
+    params = init_moe_params(jax.random.PRNGKey(1), d, 8, e)
+    gate = np.zeros((d, e), np.float32)
+    gate[:, 0] = 0.0
+    params = params._replace(gate_w=jnp.asarray(gate))
+    x = jnp.ones((1, 6, d), jnp.float32)  # identical tokens -> same expert
+    cap = moe_capacity(6, e, 0.5)  # = 2
+    out = np.asarray(moe_ffn_local(x, params, capacity_factor=0.5, k=1))
+    nz = (np.abs(out[0]).sum(-1) > 1e-9).sum()
+    assert nz == cap, (nz, cap)
+
+
+def test_expert_parallel_matches_local():
+    n_dev = 4
+    mesh = make_mesh([n_dev], ("ep",), devices=jax.devices()[:n_dev])
+    params = init_moe_params(jax.random.PRNGKey(2), d_model=8, d_ff=16,
+                             num_experts=8)
+    x = jnp.asarray(rs(3).randn(8, 5, 8), jnp.float32)
+    # ample capacity: both paths route identically with zero drops
+    want = moe_ffn_local(x, params, capacity_factor=8.0, k=2)
+    got = expert_parallel_ffn(x, params, mesh, axis="ep",
+                              capacity_factor=8.0 * n_dev, k=2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_expert_parallel_gradients():
+    n_dev = 2
+    mesh = make_mesh([n_dev], ("ep",), devices=jax.devices()[:n_dev])
+    params = init_moe_params(jax.random.PRNGKey(4), d_model=4, d_ff=8,
+                             num_experts=4)
+    x = jnp.asarray(rs(5).randn(2, 3, 4), jnp.float32)
+
+    def loss_ep(p, x):
+        return jnp.sum(expert_parallel_ffn(
+            x, p, mesh, capacity_factor=16.0, k=2) ** 2)
+
+    def loss_local(p, x):
+        return jnp.sum(moe_ffn_local(x, p, capacity_factor=8.0, k=2) ** 2)
+
+    gp, gx = jax.grad(loss_ep, argnums=(0, 1))(params, x)
+    rp, rx = jax.grad(loss_local, argnums=(0, 1))(params, x)
+    for a, b in zip(jax.tree_util.tree_leaves(gp),
+                    jax.tree_util.tree_leaves(rp)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(rx), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_expert_parallel_with_dp_training_step():
+    # dp x ep on one mesh: a full jitted SGD step decreases the loss
+    mesh = make_mesh([2, 4], ("dp", "ep"), devices=jax.devices()[:8])
+    params = init_moe_params(jax.random.PRNGKey(6), d_model=8, d_ff=16,
+                             num_experts=4)
+    x = jnp.asarray(rs(7).randn(8, 4, 8), jnp.float32)
+    tgt = jnp.asarray(rs(8).randn(8, 4, 8), jnp.float32)
+
+    # batch sharded over dp; experts over ep: run the ep ffn under a mesh
+    # whose ep axis is the expert one (tokens replicated across ep via
+    # batch_dim_sharded=False on the inner call is the simple layout here)
+    def loss(p, x):
+        out = expert_parallel_ffn(x, p, mesh, axis="ep",
+                                  capacity_factor=16.0, k=2,
+                                  batch_dim_sharded=False)
+        return jnp.mean((out - tgt) ** 2)
+
+    @jax.jit
+    def step(p, x):
+        l, g = jax.value_and_grad(loss)(p, x)
+        return l, jax.tree_util.tree_map(lambda a, b: a - 0.1 * b, p, g)
+
+    l0, params = step(params, x)
+    l1, _ = step(params, x)
+    assert float(l1) < float(l0)
