@@ -26,7 +26,8 @@ from typing import Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 __all__ = ["EOFException", "ReaderBase", "PyReader", "BatchReader",
-           "RecordIOFilesReader", "DoubleBufferReader"]
+           "RecordIOFilesReader", "DoubleBufferReader", "ShuffleReader",
+           "RandomDataGenerator", "PreprocessReader"]
 
 
 class EOFException(Exception):
@@ -379,3 +380,110 @@ class DoubleBufferReader(_PumpedReader):
         # unblocks it so the teardown join below can complete
         self.inner.reset()
         self._teardown()
+
+
+class ShuffleReader(ReaderBase):
+    """Buffered shuffling stage (reference layers/io.py:shuffle →
+    create_shuffle_reader op): fills a buffer_size window from the inner
+    reader and emits it in random order; deterministic per (seed, epoch)."""
+
+    def __init__(self, inner: ReaderBase, buffer_size: int, seed: int = 0):
+        super().__init__(inner.var_names)
+        self.inner = inner
+        self.buffer_size = max(int(buffer_size), 1)
+        self.seed = seed
+        self.shapes = inner.shapes
+        self.dtypes = inner.dtypes
+        self._epoch = 0
+        self._buf: List = []
+        self._rng = None
+
+    def start(self):
+        self.inner.start()
+        if self._rng is None:
+            import random
+
+            self._rng = random.Random(self.seed * 1000003 + self._epoch)
+
+    def next(self):
+        if self._rng is None:
+            self.start()
+        if not self._buf:
+            try:
+                while len(self._buf) < self.buffer_size:
+                    self._buf.append(self.inner.next())
+            except EOFException:
+                if not self._buf:
+                    # epoch bookkeeping belongs to reset(): repeated
+                    # post-EOF polls must not perturb the shuffle stream
+                    raise
+            self._rng.shuffle(self._buf)
+        return self._buf.pop()
+
+    def reset(self):
+        self._buf = []
+        self._rng = None
+        self._epoch += 1
+        self.inner.reset()
+
+
+class RandomDataGenerator(ReaderBase):
+    """Uniform random batches (reference layers/io.py:
+    random_data_generator → create_random_data_generator_op): an infinite
+    source of float32 uniforms in [low, high) with the given shapes."""
+
+    def __init__(self, low, high, shapes, var_names, seed: int = 0):
+        super().__init__(var_names)
+        self.low = float(low)
+        self.high = float(high)
+        self.shapes = [list(s) for s in shapes]
+        self.dtypes = ["float32"] * len(shapes)
+        self.seed = seed
+        self._rng = np.random.RandomState(seed)
+
+    def next(self):
+        return {
+            n: self._rng.uniform(self.low, self.high,
+                                 [1 if d in (-1, None) else d
+                                  for d in shape]).astype(np.float32)
+            for n, shape in zip(self.var_names, self.shapes)}
+
+    def reset(self):
+        self._rng = np.random.RandomState(self.seed)
+
+
+class PreprocessReader(ReaderBase):
+    """Applies a preprocessing sub-Program to every batch the inner reader
+    yields (reference layers/io.py:Preprocessor): the block's ops run
+    host-side through a dedicated Executor before the batch reaches the
+    training step."""
+
+    def __init__(self, inner: ReaderBase, program, in_names, out_names):
+        super().__init__(list(out_names))
+        self.inner = inner
+        self._program = program
+        self._in_names = list(in_names)
+        self._out_names = list(out_names)
+        self._exe = None
+
+    def start(self):
+        self.inner.start()
+
+    def next(self):
+        from ..executor import Executor
+        from ..framework.scope import CPUPlace, Scope, scope_guard
+
+        feed = self.inner.next()
+        if self._exe is None:
+            self._exe = Executor(CPUPlace())
+            self._scope = Scope()
+        with scope_guard(self._scope):
+            outs = self._exe.run(
+                self._program,
+                feed={n: feed[src] for n, src in
+                      zip(self._in_names, self.inner.var_names)},
+                fetch_list=self._out_names)
+        return dict(zip(self._out_names, outs))
+
+    def reset(self):
+        self.inner.reset()

@@ -35,6 +35,8 @@ __all__ = [
     "is_empty",
     "Print",
     "BlockGuard",
+    "reorder_lod_tensor_by_rank",
+    "ParallelDo",
 ]
 
 
@@ -672,3 +674,37 @@ def Print(
         },
     )
     return input
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """reference control_flow.py:reorder_lod_tensor_by_rank. Dense
+    convention: `rank_table` is the lengths Variable (the lod_rank_table
+    equivalent); rows reorder longest-first. Returns (out, out_lengths,
+    order)."""
+    helper = LayerHelper("reorder_lod_tensor_by_rank")
+    out = helper.create_variable_for_type_inference(x.dtype, shape=x.shape)
+    out_len = helper.create_variable_for_type_inference(
+        "int32", shape=(x.shape[0],))
+    order = helper.create_variable_for_type_inference(
+        "int32", shape=(x.shape[0],))
+    helper.append_op(
+        type="reorder_lod_tensor_by_rank",
+        inputs={"X": [x.name], "RankTable": [rank_table.name]},
+        outputs={"Out": [out.name], "OutLengths": [out_len.name],
+                 "Order": [order.name]},
+    )
+    return out, out_len, order
+
+
+class ParallelDo:
+    """reference control_flow.py:ParallelDo — per-device block execution.
+    Deprecated upstream in favor of ParallelExecutor; on TPU there is no
+    per-device graph at all (one pjit program spans the mesh), so this
+    shim exists only to route reference code to the supported path."""
+
+    def __init__(self, places, use_nccl=False, name=None):
+        raise NotImplementedError(
+            "ParallelDo has no TPU equivalent (it was deprecated upstream "
+            "too): build the model normally and run it with "
+            "paddle_tpu.ParallelExecutor over a Mesh — the XLA partitioner "
+            "produces the per-device program ParallelDo hand-built.")

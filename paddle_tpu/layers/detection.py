@@ -385,6 +385,7 @@ def rpn_target_assign(loc, scores, anchor_box, gt_box,
             "rpn_target_assign handles one image at a time (got batch %d); "
             "call it per image like the reference walks the gt LoD"
             % loc.shape[0])
+    na_static = anchor_box.shape[0]
     helper = LayerHelper("rpn_target_assign")
     iou = iou_similarity(gt_box, anchor_box, box_normalized=False)
     batch = int(rpn_batch_size_per_im)
@@ -436,9 +437,11 @@ def rpn_target_assign(loc, scores, anchor_box, gt_box,
         return g * nn_layers.reshape(
             mask, shape=[index.shape[0]] + [1] * (len(x.shape) - 1))
 
-    # predicted loc/scores for the sampled anchors
-    loc2 = nn_layers.reshape(loc, shape=[-1, 4])
-    score2 = nn_layers.reshape(scores, shape=[-1, 1])
+    # predicted loc/scores for the sampled anchors; the STATIC (na, ...)
+    # reshape makes a batch>1 feed fail loudly at trace time instead of
+    # silently gathering only image 0 (the batch dim may be -1 statically)
+    loc2 = nn_layers.reshape(loc, shape=[na_static, 4])
+    score2 = nn_layers.reshape(scores, shape=[na_static, 1])
     predicted_location = masked_gather(loc2, loc_index)
     predicted_scores = masked_gather(score2, score_index)
     # regression target: gather the fg anchors and their matched gts FIRST,

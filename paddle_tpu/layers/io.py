@@ -16,7 +16,8 @@ from ..framework.dtypes import convert_dtype
 from ..io import reader as reader_mod
 
 __all__ = ["data", "py_reader", "read_file", "open_recordio_file",
-           "open_files", "batch", "double_buffer"]
+           "open_files", "batch", "double_buffer", "shuffle",
+           "random_data_generator", "Preprocessor", "load"]
 
 
 def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0, type=None, stop_gradient=True):
@@ -164,3 +165,101 @@ def read_file(reader):
         outputs={"Out": outs},
     )
     return outs
+
+
+def shuffle(reader, buffer_size):
+    """reference io.py:shuffle — buffered shuffling reader transform."""
+    holder = reader_mod.ShuffleReader(reader._reader_holder, buffer_size)
+    return _make_reader_var(holder)
+
+
+def random_data_generator(low, high, shapes, lod_levels=None,
+                          for_parallel=False):
+    """reference io.py:random_data_generator — an infinite uniform-random
+    source (float32), mostly for pipeline benchmarking."""
+    base = unique_name.generate("random_reader")
+    names = _slot_names(base, len(shapes))
+    holder = reader_mod.RandomDataGenerator(low, high, shapes, names)
+    return _make_reader_var(holder, name=base)
+
+
+class Preprocessor:
+    """reference io.py:Preprocessor — build a preprocessing sub-Program
+    applied to every batch a reader yields (host-side, before the batch
+    enters the jitted step)::
+
+        p = fluid.layers.Preprocessor(reader)
+        with p.block():
+            img, lbl = p.inputs()
+            p.outputs(img / 255.0, lbl)
+        img, lbl = fluid.layers.read_file(p.reader)
+    """
+
+    def __init__(self, reader, name=None):
+        from ..framework.core import Program
+
+        self._source = reader
+        self._program = Program()
+        self.reader = None
+        self._in_vars = None
+        self._out_names = None
+
+    def block(self):
+        import contextlib
+
+        from ..framework.core import program_guard
+
+        @contextlib.contextmanager
+        def _ctx():
+            from ..framework.core import Program
+
+            startup = Program()
+            with program_guard(self._program, startup):
+                yield
+            self._finalize()
+
+        return _ctx()
+
+    def inputs(self):
+        inner = self._source._reader_holder
+        if inner.shapes is None or inner.dtypes is None:
+            raise RuntimeError(
+                "Preprocessor needs the source reader's shapes/dtypes")
+        block = self._program.current_block()
+        self._in_vars = [
+            block.create_var(name="_pp_in_%d" % i, shape=tuple(s),
+                             dtype=d, is_data=True)
+            for i, (s, d) in enumerate(zip(inner.shapes, inner.dtypes))]
+        return list(self._in_vars)
+
+    def outputs(self, *outs):
+        self._out_names = [o.name for o in outs]
+        self._out_shapes = [tuple(o.shape) for o in outs]
+        self._out_dtypes = [o.dtype for o in outs]
+
+    def _finalize(self):
+        if self._in_vars is None or self._out_names is None:
+            raise RuntimeError(
+                "Preprocessor.block() needs inputs() and outputs() calls")
+        holder = reader_mod.PreprocessReader(
+            self._source._reader_holder, self._program,
+            [v.name for v in self._in_vars], self._out_names)
+        holder.shapes = [list(s) for s in self._out_shapes]
+        holder.dtypes = [str(d) for d in self._out_dtypes]
+        self.reader = _make_reader_var(holder)
+
+
+def load(out, file_path, load_as_fp16=None):
+    """reference io.py:load (load_op.cc) — load a saved tensor from disk
+    into `out`. Dense divergence: the file is read at trace/compile time
+    (a host-side constant), not per step; accepts the `.npy` files
+    save_vars writes."""
+    block = default_main_program().current_block()
+    block.append_op(
+        type="load_file",
+        inputs={},
+        outputs={"Out": [out]},
+        attrs={"file_path": str(file_path),
+               "load_as_fp16": bool(load_as_fp16)},
+    )
+    return out

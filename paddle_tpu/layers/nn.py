@@ -85,6 +85,7 @@ __all__ = [
     "sequence_reshape",
     "sequence_pad",
     "lod_reset",
+    "image_resize_short",
     "shape",
     "mean",
     "mul",
@@ -1482,6 +1483,25 @@ def maxout(x, groups, name=None):
 # ---------------------------------------------------------------------------
 # image
 # ---------------------------------------------------------------------------
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """reference nn.py:image_resize_short — resize so the SHORT edge equals
+    out_short_len, keeping aspect ratio."""
+    in_shape = input.shape
+    if len(in_shape) != 4:
+        raise ValueError(
+            "image_resize_short expects NCHW input, got rank %d"
+            % len(in_shape))
+    hw = list(in_shape[2:4])
+    short_idx = hw.index(min(hw))
+    long_idx = 1 - short_idx
+    out_shape = list(hw)
+    out_shape[short_idx] = out_short_len
+    out_shape[long_idx] = int(
+        float(out_shape[long_idx])
+        * (float(out_short_len) / float(hw[short_idx])) + 0.5)
+    return image_resize(input=input, out_shape=out_shape, resample=resample)
 
 
 def image_resize(input, out_shape=None, scale=None, name=None, resample="BILINEAR"):

@@ -799,3 +799,17 @@ def _maxout(ctx):
     groups = ctx.attr("groups")
     n, c, h, w = x.shape
     return {"Out": x.reshape(n, c // groups, groups, h, w).max(axis=2)}
+
+
+@register_op("load_file")
+def _load_file(ctx):
+    """reference: load_op.cc — load a saved tensor into a variable. The
+    file (a ``.npy`` written by io.save_vars) is read at trace time and
+    enters the computation as a host constant."""
+    import numpy as np
+
+    path = ctx.attr("file_path")
+    arr = np.load(path)
+    if ctx.attr("load_as_fp16", False):
+        arr = arr.astype(np.float16)
+    return {"Out": jnp.asarray(arr)}

@@ -210,3 +210,18 @@ def _sequence_erase(ctx):
     for tok in tokens:
         keep = keep & (x != tok)
     return {"Out": jnp.where(keep, x, 0)}
+
+
+@register_op("reorder_lod_tensor_by_rank")
+def _reorder_lod_tensor_by_rank(ctx):
+    """Dense analog of reorder_lod_tensor_by_rank (reference:
+    reorder_lod_tensor_by_rank_op.cc): reorder batch rows by sequence
+    length, longest first (the rank-table order the reference's RNN
+    machinery wants). RankTable is the lengths vector; also emits the
+    permutation so callers can restore the original order."""
+    x = ctx.input("X")
+    lengths = ctx.input("RankTable").reshape(-1)
+    order = jnp.argsort(-lengths.astype(jnp.int32), stable=True)
+    return {"Out": jnp.take(x, order, axis=0),
+            "OutLengths": jnp.take(lengths, order).astype(jnp.int32),
+            "Order": order.astype(jnp.int32)}

@@ -1,0 +1,11 @@
+"""Version-compat shims shared by the parallel modules."""
+from __future__ import annotations
+
+import jax
+
+try:  # jax>=0.6 top level; older: experimental
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
+__all__ = ["shard_map"]

@@ -23,10 +23,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # jax>=0.6 top level; older: experimental
-    shard_map = jax.shard_map
-except AttributeError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from ._compat import shard_map
 
 __all__ = ["MoEParams", "init_moe_params", "moe_ffn_local",
            "expert_parallel_ffn", "moe_capacity"]

@@ -53,6 +53,30 @@ class PServerShard:
     def __repr__(self):
         return "PServerShard(%s, params=%s)" % (self.endpoint, self.param_names)
 
+    def _not_a_program(self):
+        raise TypeError(
+            "this is a PServerShard manifest, not a runnable Program: on "
+            "TPU there is no separate parameter-server process — the "
+            "optimizer state for these params is a SHARD of the one mesh-"
+            "wide program. Migrate `exe.run(t.get_pserver_program(ep))` "
+            "to `ParallelExecutor(..., plan=t.sharding_plan(mesh))`, "
+            "which gives each device this shard's update work via GSPMD.")
+
+    # reference-API call sites treat the pserver program like a Program;
+    # fail with a migration message instead of an AttributeError
+    def global_block(self):
+        self._not_a_program()
+
+    def block(self, idx):
+        self._not_a_program()
+
+    def clone(self, for_test=False):
+        self._not_a_program()
+
+    @property
+    def blocks(self):
+        self._not_a_program()
+
 
 class DistributeTranspiler:
     def __init__(self, config: Optional[DistributeTranspilerConfig] = None):
@@ -78,6 +102,15 @@ class DistributeTranspiler:
         self.trainer_id = trainer_id
         self.trainers = trainers
         self.sync_mode = sync_mode
+        if not sync_mode:
+            import warnings
+
+            warnings.warn(
+                "DistributeTranspiler(sync_mode=False): async SGD has no "
+                "TPU equivalent — every step is one global XLA program, so "
+                "training runs SYNCHRONOUSLY (gradients all-reduced each "
+                "step). Remove sync_mode=False to silence this warning.",
+                stacklevel=2)
         endpoints = [e.strip() for e in pservers.split(",") if e.strip()]
         self._shards = [PServerShard(ep, i) for i, ep in enumerate(endpoints)]
 

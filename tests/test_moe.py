@@ -36,7 +36,7 @@ def test_moe_capacity_drops():
     d, e = 4, 2
     params = init_moe_params(jax.random.PRNGKey(1), d, 8, e)
     gate = np.zeros((d, e), np.float32)
-    gate[:, 0] = 0.0
+    gate[:, 0] = 100.0  # force every token to expert 0
     params = params._replace(gate_w=jnp.asarray(gate))
     x = jnp.ones((1, 6, d), jnp.float32)  # identical tokens -> same expert
     cap = moe_capacity(6, e, 0.5)  # = 2

@@ -78,3 +78,27 @@ def test_inference_transpiler_bn_fold(rng):
     assert not any(op.type == "batch_norm" for op in infer.global_block().ops)
     (after,) = exe.run(infer, feed={"x": xs}, fetch_list=[out])
     np.testing.assert_allclose(after, before, rtol=1e-4, atol=1e-5)
+
+
+def test_pserver_shard_program_use_raises_migration_error():
+    import pytest
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers, optimizer
+    from paddle_tpu.transpiler import DistributeTranspiler
+
+    mp, sp = fluid.Program(), fluid.Program()
+    with fluid.program_guard(mp, sp):
+        x = layers.data(name="x", shape=[4])
+        loss = layers.mean(layers.fc(x, 1))
+        optimizer.SGD(0.1).minimize(loss)
+        t = DistributeTranspiler()
+        with pytest.warns(UserWarning, match="SYNCHRONOUSLY"):
+            t.transpile(trainer_id=0, program=mp,
+                        pservers="h1:6170,h2:6170", trainers=2,
+                        sync_mode=False)
+        shard = t.get_pserver_program("h1:6170")
+        # reference-style use of the pserver program must route users to
+        # sharding_plan(), not die with an AttributeError
+        with pytest.raises(TypeError, match="sharding_plan"):
+            fluid.Executor(fluid.CPUPlace()).run(shard)
