@@ -458,10 +458,12 @@ class PreprocessReader(ReaderBase):
     host-side through a dedicated Executor before the batch reaches the
     training step."""
 
-    def __init__(self, inner: ReaderBase, program, in_names, out_names):
+    def __init__(self, inner: ReaderBase, program, in_names, out_names,
+                 startup_program=None):
         super().__init__(list(out_names))
         self.inner = inner
         self._program = program
+        self._startup = startup_program
         self._in_names = list(in_names)
         self._out_names = list(out_names)
         self._exe = None
@@ -477,6 +479,11 @@ class PreprocessReader(ReaderBase):
         if self._exe is None:
             self._exe = Executor(CPUPlace())
             self._scope = Scope()
+            if self._startup is not None:
+                # parameters created inside the Preprocessor block get
+                # their init ops here
+                with scope_guard(self._scope):
+                    self._exe.run(self._startup)
         with scope_guard(self._scope):
             outs = self._exe.run(
                 self._program,

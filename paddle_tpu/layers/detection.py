@@ -385,12 +385,11 @@ def rpn_target_assign(loc, scores, anchor_box, gt_box,
             "rpn_target_assign handles one image at a time (got batch %d); "
             "call it per image like the reference walks the gt LoD"
             % loc.shape[0])
-    na_static = anchor_box.shape[0]
     helper = LayerHelper("rpn_target_assign")
+    na = anchor_box.shape[0]
     iou = iou_similarity(gt_box, anchor_box, box_normalized=False)
     batch = int(rpn_batch_size_per_im)
     fg_cap = max(int(batch * fg_fraction), 1)
-    na = anchor_box.shape[0]
 
     loc_index = helper.create_variable_for_type_inference(
         "int32", shape=(fg_cap,))
@@ -440,8 +439,8 @@ def rpn_target_assign(loc, scores, anchor_box, gt_box,
     # predicted loc/scores for the sampled anchors; the STATIC (na, ...)
     # reshape makes a batch>1 feed fail loudly at trace time instead of
     # silently gathering only image 0 (the batch dim may be -1 statically)
-    loc2 = nn_layers.reshape(loc, shape=[na_static, 4])
-    score2 = nn_layers.reshape(scores, shape=[na_static, 1])
+    loc2 = nn_layers.reshape(loc, shape=[na, 4])
+    score2 = nn_layers.reshape(scores, shape=[na, 1])
     predicted_location = masked_gather(loc2, loc_index)
     predicted_scores = masked_gather(score2, score_index)
     # regression target: gather the fg anchors and their matched gts FIRST,

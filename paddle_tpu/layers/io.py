@@ -213,8 +213,8 @@ class Preprocessor:
         def _ctx():
             from ..framework.core import Program
 
-            startup = Program()
-            with program_guard(self._program, startup):
+            self._startup = Program()
+            with program_guard(self._program, self._startup):
                 yield
             self._finalize()
 
@@ -243,7 +243,8 @@ class Preprocessor:
                 "Preprocessor.block() needs inputs() and outputs() calls")
         holder = reader_mod.PreprocessReader(
             self._source._reader_holder, self._program,
-            [v.name for v in self._in_vars], self._out_names)
+            [v.name for v in self._in_vars], self._out_names,
+            startup_program=self._startup)
         holder.shapes = [list(s) for s in self._out_shapes]
         holder.dtypes = [str(d) for d in self._out_dtypes]
         self.reader = _make_reader_var(holder)

@@ -106,6 +106,7 @@ __all__ = [
     "beam_search_decode",
     "fused_attention",
     "ring_attention",
+    "moe_ffn",
     "fused_lm_head_loss",
 ]
 
@@ -2074,6 +2075,49 @@ def ring_attention(q, k, v, causal=False, scale=None, sp_axis="sp",
         inputs={"Q": [q], "K": [k], "V": [v]},
         outputs={"Out": [out]},
         attrs={"causal": causal, "scale": scale, "sp_axis": sp_axis},
+    )
+    return out
+
+
+def moe_ffn(x, num_experts, d_ff, capacity_factor=2.0, k=2, ep_axis="ep",
+            param_attr=None, name=None):
+    """Mixture-of-experts FFN block (kernel: ops/attention.py moe_ffn;
+    math: parallel/moe.py — GShard top-k routing with per-expert capacity).
+    Under a ParallelExecutor whose mesh has `ep_axis`, experts shard
+    across devices with one all_to_all each way; single-device falls back
+    to the identical-math local path."""
+    helper = LayerHelper("moe_ffn", name=name)
+    d = x.shape[-1]
+    base = name or helper.name
+
+    def mk(shape, suffix, is_bias=False):
+        import copy
+
+        from ..param_attr import ParamAttr
+
+        if param_attr:
+            # clone per parameter: a shared attr object would get its name
+            # fixed on first use and alias all five params to one variable
+            attr = copy.deepcopy(ParamAttr._to_attr(param_attr))
+            attr.name = "%s.%s" % (attr.name or base, suffix)
+        else:
+            attr = ParamAttr(name="%s.%s" % (base, suffix))
+        return helper.create_parameter(attr=attr, shape=shape,
+                                       dtype=x.dtype, is_bias=is_bias)
+
+    gate_w = mk((d, num_experts), "gate_w")
+    w1 = mk((num_experts, d, d_ff), "w1")
+    b1 = mk((num_experts, d_ff), "b1", is_bias=True)
+    w2 = mk((num_experts, d_ff, d), "w2")
+    b2 = mk((num_experts, d), "b2", is_bias=True)
+    out = helper.create_variable_for_type_inference(x.dtype, shape=x.shape)
+    helper.append_op(
+        type="moe_ffn",
+        inputs={"X": [x], "GateW": [gate_w], "W1": [w1], "B1": [b1],
+                "W2": [w2], "B2": [b2]},
+        outputs={"Out": [out]},
+        attrs={"capacity_factor": float(capacity_factor), "k": int(k),
+               "ep_axis": ep_axis},
     )
     return out
 

@@ -131,8 +131,10 @@ def encoder_layer(x, n_head, d_model, d_inner, dropout_rate, lengths, name):
 
 def decoder_layer(x, enc, n_head, d_model, d_inner, dropout_rate,
                   src_lengths, tgt_lengths, name, use_ring=False,
-                  sp_axis="sp"):
-    """`enc` must already be normalized (transformer_encoder output)."""
+                  sp_axis="sp", moe_experts=0):
+    """`enc` must already be normalized (transformer_encoder output).
+    moe_experts>0 swaps the dense FFN for a mixture-of-experts block
+    (layers.moe_ffn) — expert-parallel under an ep mesh."""
     h = _pre_norm(x)
     self_attn = multi_head_attention(
         h, h, n_head, d_model, dropout_rate,
@@ -146,8 +148,12 @@ def decoder_layer(x, enc, n_head, d_model, d_inner, dropout_rate,
             kv_lengths=src_lengths, name=name + ".cross",
         )
         x = layers.elementwise_add(x, cross)
-    ffn = positionwise_ffn(_pre_norm(x), d_inner, d_model, dropout_rate,
-                           name=name + ".ffn")
+    if moe_experts:
+        ffn = layers.moe_ffn(_pre_norm(x), num_experts=moe_experts,
+                             d_ff=d_inner, name=name + ".moe")
+    else:
+        ffn = positionwise_ffn(_pre_norm(x), d_inner, d_model, dropout_rate,
+                               name=name + ".ffn")
     return layers.elementwise_add(x, ffn)
 
 
@@ -209,7 +215,7 @@ def transformer_nmt(
 def transformer_lm(
     ids, labels, vocab_size, n_layer=4, n_head=8, d_model=512, d_inner=2048,
     dropout_rate=0.0, max_len=2048, fused_head=True,
-    use_ring_attention=False, sp_axis="sp",
+    use_ring_attention=False, sp_axis="sp", moe_experts=0,
 ):
     """Decoder-only causal LM (flagship). Returns (avg_cost, logits).
 
@@ -227,7 +233,8 @@ def transformer_lm(
     for i in range(n_layer):
         x = decoder_layer(x, None, n_head, d_model, d_inner, dropout_rate,
                           None, None, "lm.l%d" % i,
-                          use_ring=use_ring_attention, sp_axis=sp_axis)
+                          use_ring=use_ring_attention, sp_axis=sp_axis,
+                          moe_experts=moe_experts)
     x = _pre_norm(x)
     B, T = ids.shape
     if fused_head:

@@ -455,3 +455,37 @@ def _ring_attention_op(ctx):
         return {"Out": ring_self_attention(q, k, v, mesh, sp_axis=sp_axis,
                                            causal=causal, scale=scale)}
     return {"Out": full_attention(q, k, v, causal=causal, scale=scale)}
+
+
+@register_op("moe_ffn")
+def _moe_ffn_op(ctx):
+    """Mixture-of-experts FFN (SURVEY §2 expert-parallel commitment; no
+    reference twin). Inputs X (B,T,D), GateW (D,E), W1 (E,D,F), B1 (E,F),
+    W2 (E,F,D), B2 (E,D). Under a mesh with the `ep_axis` (ParallelExecutor
+    mesh context) experts shard across devices with all_to_all dispatch
+    (parallel/moe.py); otherwise the identical-math single-device path
+    runs, so one Program serves both worlds."""
+    from ..framework.trace import current_trace_mesh
+    from ..parallel.moe import MoEParams, expert_parallel_ffn, moe_ffn_local
+
+    params = MoEParams(
+        gate_w=ctx.input("GateW"), w1=ctx.input("W1"), b1=ctx.input("B1"),
+        w2=ctx.input("W2"), b2=ctx.input("B2"))
+    x = ctx.input("X")
+    cf = float(ctx.attr("capacity_factor", 2.0))
+    k = int(ctx.attr("k", 2))
+    ep_axis = ctx.attr("ep_axis", "ep")
+    mesh = current_trace_mesh()
+    if (mesh is not None and ep_axis in mesh.axis_names
+            and mesh.shape[ep_axis] > 1
+            and params.gate_w.shape[-1] % mesh.shape[ep_axis] == 0):
+        # tokens replicated over ep (the executor's GSPMD feeds aren't
+        # ep-sharded): every device routes the same N tokens, so the
+        # capacity factor carries over 1:1 and drops match the
+        # single-device path exactly
+        out = expert_parallel_ffn(x, params, mesh, axis=ep_axis,
+                                  capacity_factor=cf, k=k,
+                                  batch_dim_sharded=False)
+    else:
+        out = moe_ffn_local(x, params, capacity_factor=cf, k=k)
+    return {"Out": out}

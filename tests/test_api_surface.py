@@ -174,3 +174,42 @@ def test_reference_all_coverage(mod, ours):
     missing = [n for n in names
                if not hasattr(target, n) and not hasattr(fluid, n)]
     assert not missing, "%s missing: %s" % (mod, missing)
+
+
+def test_preprocessor_with_parameter():
+    # a parameter created INSIDE the block must be initialized by the
+    # preprocessor's own startup program
+    mp, sp = fluid.Program(), fluid.Program()
+    mp.random_seed = sp.random_seed = 7
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(mp, sp):
+        reader = layers.py_reader(capacity=4, shapes=[(-1, 3)],
+                                  dtypes=["float32"],
+                                  use_double_buffer=False)
+        pre = layers.Preprocessor(reader)
+        with pre.block():
+            (x,) = pre.inputs()
+            pre.outputs(layers.fc(x, 2, bias_attr=False))
+        xv, = layers.read_file(pre.reader)
+        out = layers.reduce_sum(xv)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(sp)
+        reader.decorate_tensor_provider(
+            lambda: iter([(np.ones((2, 3), np.float32),)]))
+        reader.start()
+        v, = exe.run(mp, fetch_list=[out])
+    assert np.isfinite(np.asarray(v)).all()
+
+
+def test_moe_ffn_explicit_param_attr():
+    from paddle_tpu.param_attr import ParamAttr
+
+    mp, sp = fluid.Program(), fluid.Program()
+    with fluid.program_guard(mp, sp):
+        x = layers.data(name="x", shape=[2, 4, 8], append_batch_size=False)
+        layers.moe_ffn(x, num_experts=4, d_ff=16,
+                       param_attr=ParamAttr(name="myexp"))
+        names = [v for v in mp.global_block().vars
+                 if v.startswith("myexp")]
+    # five DISTINCT parameters, not one aliased variable
+    assert len(names) == 5, names

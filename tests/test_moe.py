@@ -108,3 +108,50 @@ def test_expert_parallel_with_dp_training_step():
     l0, params = step(params, x)
     l1, _ = step(params, x)
     assert float(l1) < float(l0)
+
+
+def test_moe_lm_program_api():
+    """transformer_lm(moe_experts=4): the moe_ffn op trains single-device
+    and matches itself under an ep ParallelExecutor mesh."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers, models, optimizer
+    from paddle_tpu.parallel import ParallelExecutor, ShardingPlan
+    from jax.sharding import PartitionSpec as P
+
+    def build(seed=21):
+        mp, sp = fluid.Program(), fluid.Program()
+        mp.random_seed = sp.random_seed = seed
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope), fluid.program_guard(mp, sp):
+            with fluid.unique_name.guard():
+                ids = layers.data(name="ids", shape=[2, 8], dtype="int64",
+                                  append_batch_size=False)
+                lbl = layers.data(name="labels", shape=[2, 8],
+                                  dtype="int64", append_batch_size=False)
+                loss, _ = models.transformer.transformer_lm(
+                    ids, lbl, vocab_size=32, n_layer=1, n_head=2,
+                    d_model=8, d_inner=16, max_len=8, moe_experts=4)
+                optimizer.SGD(0.1).minimize(loss)
+        return mp, sp, scope, loss
+
+    feed = {"ids": rs(9).randint(0, 32, (2, 8)).astype(np.int64),
+            "labels": rs(10).randint(0, 32, (2, 8)).astype(np.int64)}
+    mp, sp, scope, loss = build()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(sp)
+        ref = [float(exe.run(mp, feed=feed, fetch_list=[loss])[0])
+               for _ in range(3)]
+    assert ref[2] < ref[0]
+
+    mesh = make_mesh([4], ("ep",), devices=jax.devices()[:4])
+    mp, sp, scope, loss = build()
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(sp)
+        plan = ShardingPlan(mesh, batch_axes=())
+        plan.set_regex(r"\.moe\.(w1|b1|w2|b2)", P("ep"))
+        pexe = ParallelExecutor(loss_name=loss.name, main_program=mp,
+                                scope=scope, mesh=mesh, plan=plan)
+        got = [float(pexe.run(feed=feed, fetch_list=[loss])[0])
+               for _ in range(3)]
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
