@@ -151,6 +151,10 @@ def decoder_layer(x, enc, n_head, d_model, d_inner, dropout_rate,
     if moe_experts:
         ffn = layers.moe_ffn(_pre_norm(x), num_experts=moe_experts,
                              d_ff=d_inner, name=name + ".moe")
+        if dropout_rate:
+            # the dense path drops inside positionwise_ffn; keep the MoE
+            # branch equivalently regularized
+            ffn = layers.dropout(ffn, dropout_prob=dropout_rate)
     else:
         ffn = positionwise_ffn(_pre_norm(x), d_inner, d_model, dropout_rate,
                                name=name + ".ffn")

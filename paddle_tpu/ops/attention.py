@@ -477,8 +477,14 @@ def _moe_ffn_op(ctx):
     ep_axis = ctx.attr("ep_axis", "ep")
     mesh = current_trace_mesh()
     if (mesh is not None and ep_axis in mesh.axis_names
-            and mesh.shape[ep_axis] > 1
-            and params.gate_w.shape[-1] % mesh.shape[ep_axis] == 0):
+            and mesh.shape[ep_axis] > 1):
+        if params.gate_w.shape[-1] % mesh.shape[ep_axis] != 0:
+            # fail loudly: a silent local fallback would replicate every
+            # expert on every device with no parallelism
+            raise ValueError(
+                "moe_ffn: num_experts %d must divide over the %d-way "
+                "'%s' mesh axis" % (params.gate_w.shape[-1],
+                                    mesh.shape[ep_axis], ep_axis))
         # tokens replicated over ep (the executor's GSPMD feeds aren't
         # ep-sharded): every device routes the same N tokens, so the
         # capacity factor carries over 1:1 and drops match the

@@ -694,3 +694,109 @@ def test_registry_coverage():
     assert frac >= 0.90, (
         "numeric coverage %.0f%% below 90%%; uncovered: %s"
         % (100 * frac, missing))
+
+
+# ---------------------------------------------------------------------------
+# extended gradient sweep (round 2): every differentiable op family gets a
+# finite-difference check beyond the core set above
+# ---------------------------------------------------------------------------
+
+_GX = rs(70).uniform(0.5, 1.5, (2, 3)).astype(np.float32)
+
+
+def test_grad_losses():
+    x = rs(71).randn(2, 3).astype(np.float32)
+    y = rs(72).randn(2, 3).astype(np.float32)
+    check_grad("huber_loss", {"X": x, "Y": y}, "X", attrs={"delta": 5.0})
+    check_grad("square_error_cost", {"X": x, "Y": y}, "X")
+    p = _np_softmax(rs(73).randn(2, 4)).astype(np.float32)
+    lbl = np.array([[1], [3]], np.int64)
+    check_grad("cross_entropy", {"X": p, "Label": lbl}, "X", outs=("Y",))
+    check_grad("label_smooth", {"X": p}, "X", attrs={"epsilon": 0.1})
+    check_grad("dice_loss", {"X": p, "Label": lbl}, "X")
+    lg = rs(74).randn(2, 3).astype(np.float32)
+    sl = rs(75).rand(2, 3).astype(np.float32)
+    check_grad("sigmoid_cross_entropy_with_logits",
+               {"X": lg, "Label": sl}, "X")
+
+
+def test_grad_normalization():
+    check_grad("l2_normalize", {"X": _GX}, "X",
+               attrs={"axis": 1, "epsilon": 1e-10})
+    check_grad("norm", {"X": _GX}, "X", attrs={"axis": 1})
+    x = rs(76).rand(1, 4, 2, 2).astype(np.float32) + 0.5
+    check_grad("lrn", {"X": x}, "X", attrs={"n": 3}, rtol=2e-2, atol=2e-3)
+    a = np.array([0.3], np.float32)
+    xs = away(rs(77).randn(2, 3).astype(np.float32), [0.0])
+    check_grad("prelu", {"X": xs, "Alpha": a}, "X", attrs={"mode": "all"})
+    check_grad("prelu", {"X": xs, "Alpha": a}, "Alpha",
+               attrs={"mode": "all"})
+
+
+def test_grad_tensor_manip():
+    x = rs(78).randn(2, 3).astype(np.float32)
+    check_grad("pad", {"X": x}, "X",
+               attrs={"paddings": [1, 0, 0, 1], "pad_value": 0.0})
+    check_grad("expand", {"X": x}, "X", attrs={"expand_times": [2, 2]})
+    check_grad("slice", {"Input": x}, "Input",
+               attrs={"axes": [1], "starts": [1], "ends": [3]})
+    check_grad("cumsum", {"X": x}, "X", attrs={"axis": 1})
+    check_grad("gather", {"X": x, "Index": np.array([1, 0, 1], np.int64)},
+               "X")
+    w = rs(79).randn(5, 3).astype(np.float32)
+    ids = np.array([[1], [4]], np.int64)
+    check_grad("lookup_table", {"W": w, "Ids": ids}, "W")
+    check_grad("scale", {"X": x}, "X", attrs={"scale": 2.0, "bias": 1.0})
+    xc = away(x, [-0.5, 0.5])
+    check_grad("clip", {"X": xc}, "X", attrs={"min": -0.5, "max": 0.5})
+
+
+def test_grad_misc_math():
+    x = rs(80).uniform(0.5, 1.5, (2, 3)).astype(np.float32)
+    y = rs(81).uniform(0.5, 1.5, (2, 3)).astype(np.float32)
+    check_grad("elementwise_pow", {"X": x, "Y": y}, "X")
+    check_grad("cos_sim", {"X": x, "Y": y}, "X", rtol=2e-2, atol=2e-3)
+    w = (0.3 * rs(82).randn(2, 3, 3)).astype(np.float32)
+    b = (0.1 * rs(83).randn(1, 2)).astype(np.float32)
+    check_grad("bilinear_tensor_product",
+               {"X": x, "Y": y, "Weight": w, "Bias": b}, "X",
+               rtol=2e-2, atol=2e-3)
+    cs_x = rs(84).randn(1, 4).astype(np.float32)
+    cs_y = (0.4 * rs(85).randn(1, 3)).astype(np.float32)
+    check_grad("conv_shift", {"X": cs_x, "Y": cs_y}, "X")
+    rx = rs(86).randn(1, 4, 2).astype(np.float32)
+    rf = (0.4 * rs(87).randn(2, 2)).astype(np.float32)
+    check_grad("row_conv", {"X": rx, "Filter": rf}, "X")
+    mx = (np.arange(12).reshape(1, 4, 1, 3) * 0.37 + 0.1).astype(np.float32)
+    check_grad("maxout", {"X": mx}, "X", attrs={"groups": 2})
+
+
+def test_grad_conv_variants():
+    x = rs(88).randn(1, 2, 3, 3).astype(np.float32)
+    w = (0.4 * rs(89).randn(2, 3, 2, 2)).astype(np.float32)  # IOHW
+    check_grad("conv2d_transpose", {"Input": x, "Filter": w}, "Input",
+               outs=("Output",))
+    check_grad("conv2d_transpose", {"Input": x, "Filter": w}, "Filter",
+               outs=("Output",))
+    x3 = rs(90).randn(1, 1, 3, 3, 3).astype(np.float32)
+    w3 = (0.4 * rs(91).randn(2, 1, 2, 2, 2)).astype(np.float32)
+    check_grad("conv3d", {"Input": x3, "Filter": w3}, "Input",
+               outs=("Output",))
+    check_grad("bilinear_interp", {"X": x}, "X",
+               attrs={"out_h": 5, "out_w": 5})
+
+
+def test_grad_sequence_family():
+    x = rs(92).randn(2, 4, 2).astype(np.float32)
+    lens = np.array([4, 2], np.int32)
+    check_grad("sequence_softmax",
+               {"X": x[:, :, 0], "Lengths": lens}, "X")
+    f = (0.4 * rs(93).randn(6, 3)).astype(np.float32)
+    check_grad("sequence_conv", {"X": x, "Lengths": lens, "Filter": f},
+               "X", attrs={"contextLength": 3, "contextStart": -1})
+    check_grad("sequence_conv", {"X": x, "Lengths": lens, "Filter": f},
+               "Filter", attrs={"contextLength": 3, "contextStart": -1})
+    # max pool over distinct values (stable argmax)
+    xm = (np.arange(16).reshape(2, 4, 2) * 0.31 + 0.05).astype(np.float32)
+    check_grad("sequence_pool", {"X": xm, "Lengths": lens}, "X",
+               attrs={"pooltype": "MAX"})
