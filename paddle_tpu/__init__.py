@@ -102,6 +102,27 @@ from . import inference  # noqa: F401
 from . import lod_tensor  # noqa: F401
 from .lod_tensor import create_lod_tensor, create_random_int_lodtensor  # noqa: F401
 
+from . import annotations  # noqa: F401
+from . import average  # noqa: F401
+from .framework.scope import CUDAPinnedPlace  # noqa: F401  (pinned host mem -> plain host mem on TPU)
+from .lod_tensor import SequenceTensor as LoDTensor  # noqa: F401  (dense+lengths stand-in)
+from .layers import learning_rate_scheduler as learning_rate_decay  # noqa: F401
+from . import concurrency  # noqa: F401
+from .concurrency import (  # noqa: F401
+    Go,
+    Select,
+    channel_close,
+    channel_recv,
+    channel_send,
+    make_channel,
+)
+from . import contrib  # noqa: F401
+from . import default_scope_funcs  # noqa: F401
+from . import graphviz  # noqa: F401
+from . import net_drawer  # noqa: F401
+from . import op  # noqa: F401
+from . import recordio_writer  # noqa: F401
+
 # operator sugar on Variable (x + y, x * 0.5, ...) — reference
 # layers/math_op_patch.py applies this at fluid import time too
 from .framework.math_op_patch import monkey_patch_variable as _mpv

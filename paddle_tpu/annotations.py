@@ -1,0 +1,31 @@
+"""API deprecation decorator (reference:
+python/paddle/fluid/annotations.py).
+"""
+from __future__ import annotations
+
+import functools
+import sys
+
+__all__ = ["deprecated"]
+
+
+def deprecated(since, instead, extra_message=""):
+    """Mark an API deprecated since version ``since``; point callers at
+    ``instead``. Prints the notice once per call site like the reference
+    (which writes to stderr on every call)."""
+
+    def decorator(func):
+        err_msg = "API {0} is deprecated since {1}. Please use {2} instead.".format(
+            func.__name__, since, instead)
+        if extra_message:
+            err_msg += "\n" + extra_message
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            print(err_msg, file=sys.stderr)
+            return func(*args, **kwargs)
+
+        wrapper.__doc__ = (err_msg + "\n\n" + (func.__doc__ or ""))
+        return wrapper
+
+    return decorator

@@ -1,0 +1,58 @@
+"""Python-side weighted averaging (reference:
+python/paddle/fluid/average.py).
+
+Pure host-side bookkeeping: does not touch the Program or any device
+state, exactly like the reference (which deprecates it in favor of
+``metrics``). Kept for API parity with fluid scripts that still use it.
+"""
+from __future__ import annotations
+
+import warnings
+
+import numpy as np
+
+__all__ = ["WeightedAverage"]
+
+
+def _is_number(v) -> bool:
+    return isinstance(v, (int, float)) or (
+        isinstance(v, np.ndarray) and v.shape == (1,))
+
+
+def _is_number_or_matrix(v) -> bool:
+    return _is_number(v) or isinstance(v, np.ndarray)
+
+
+class WeightedAverage:
+    """Accumulate ``value``s with scalar ``weight``s; ``eval()`` returns
+    sum(value * weight) / sum(weight). Accepts numbers or numpy arrays
+    (e.g. fetched loss tensors)."""
+
+    def __init__(self):
+        warnings.warn(
+            "WeightedAverage is deprecated; use paddle_tpu.metrics instead.",
+            Warning)
+        self.reset()
+
+    def reset(self):
+        self.numerator = None
+        self.denominator = None
+
+    def add(self, value, weight):
+        if not _is_number_or_matrix(value):
+            raise ValueError(
+                "The 'value' must be a number (int, float) or a numpy ndarray.")
+        if not _is_number(weight):
+            raise ValueError("The 'weight' must be a number (int, float).")
+        if self.numerator is None or self.denominator is None:
+            self.numerator = value * weight
+            self.denominator = weight
+        else:
+            self.numerator += value * weight
+            self.denominator += weight
+
+    def eval(self):
+        if self.numerator is None or self.denominator is None:
+            raise ValueError(
+                "There is no data to be averaged in WeightedAverage.")
+        return self.numerator / self.denominator

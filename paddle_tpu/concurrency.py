@@ -1,0 +1,196 @@
+"""CSP-style concurrency primitives (reference:
+python/paddle/fluid/concurrency.py — Go / Channel / Select ops).
+
+TPU-native divergence: the reference compiles Go blocks and channel ops
+INTO the Program (C++ threads run sub-blocks against the scope). Under
+XLA the device computation is one compiled function, so CSP belongs on
+the HOST side of the pipeline: ``Go`` runs a Python callable on a daemon
+thread, channels are the C++ runtime's bounded blocking channel
+(runtime/runtime.cc Channel — the same one behind the reader pipeline)
+carrying pickled Python values, and ``Select`` polls cases like the
+reference's fluid.Select. Typical use is producer/consumer structure
+around ``Executor.run`` (e.g. feeding a py_reader from several workers).
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+from typing import Any, Callable, Optional, Tuple
+
+from .runtime.recordio import Channel as _ByteChannel
+
+__all__ = ["Go", "make_channel", "channel_send", "channel_recv",
+           "channel_close", "Select"]
+
+
+class _Channel:
+    """Typed channel over the runtime byte channel. ``capacity=0``
+    (unbuffered in the reference/Go sense) is approximated with a
+    1-slot buffer."""
+
+    def __init__(self, dtype=None, capacity: int = 0):
+        self.dtype = dtype
+        self.capacity = max(1, int(capacity))
+        self.closed = False
+        self._ch = _ByteChannel(self.capacity)
+
+    def send(self, value) -> bool:
+        return self._ch.send(pickle.dumps(value, protocol=4))
+
+    def recv(self) -> Tuple[Any, bool]:
+        data = self._ch.recv()
+        if data is None:
+            return None, False
+        return pickle.loads(data), True
+
+    def qsize(self) -> int:
+        return self._ch.qsize()
+
+    def close(self):
+        self.closed = True
+        self._ch.close()
+
+
+def make_channel(dtype=None, capacity: int = 0) -> _Channel:
+    """reference concurrency.py:make_channel."""
+    return _Channel(dtype, capacity)
+
+
+def channel_send(channel: _Channel, value, is_copy: bool = False) -> bool:
+    """Blocking send; returns False once the channel is closed. `is_copy`
+    is accepted for parity (values are serialized, always a copy)."""
+    return channel.send(value)
+
+
+def channel_recv(channel: _Channel, return_value=None) -> Tuple[Any, bool]:
+    """Blocking receive -> (value, ok); ok=False once closed and drained
+    (then `return_value` is returned as the value)."""
+    val, ok = channel.recv()
+    return (val if ok else return_value), ok
+
+
+def channel_close(channel: _Channel):
+    channel.close()
+
+
+class Go:
+    """Run work concurrently (reference concurrency.py:Go). Two forms:
+
+    - ``Go(fn, *args)`` — start `fn` immediately on a daemon thread.
+    - ``with Go() as g: g.run(fn, *args)`` — the reference's block-guard
+      shape; every `run` inside the block is launched on exit.
+
+    ``join()`` waits; the callable's return value is at ``.result`` (or
+    its exception re-raised)."""
+
+    def __init__(self, fn: Optional[Callable] = None, *args, **kwargs):
+        self._pending = []
+        self._threads = []
+        self._results = []
+        self._errors = []
+        if fn is not None:
+            self._spawn(fn, args, kwargs)
+
+    def _spawn(self, fn, args, kwargs):
+        idx = len(self._results)
+        self._results.append(None)
+
+        def body():
+            try:
+                self._results[idx] = fn(*args, **kwargs)
+            except BaseException as e:  # surfaced on join()
+                self._errors.append(e)
+
+        t = threading.Thread(target=body, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def run(self, fn: Callable, *args, **kwargs):
+        self._pending.append((fn, args, kwargs))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            for fn, args, kwargs in self._pending:
+                self._spawn(fn, args, kwargs)
+            self._pending = []
+        return False
+
+    def join(self, timeout: Optional[float] = None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for t in self._threads:
+            t.join(timeout if deadline is None
+                   else max(0.0, deadline - time.monotonic()))
+            if t.is_alive():
+                raise TimeoutError(
+                    "Go.join timed out after %.3fs with work still running"
+                    % timeout)
+        if self._errors:
+            raise self._errors[0]
+        return self.result
+
+    @property
+    def result(self):
+        return self._results[0] if len(self._results) == 1 else list(self._results)
+
+
+class Select:
+    """Wait on multiple channel operations; runs the callback of the first
+    ready case (reference concurrency.py:Select)::
+
+        sel = Select()
+        sel.case_recv(ch_a, lambda v: ...)
+        sel.case_send(ch_b, value, lambda: ...)
+        sel.default(lambda: ...)   # optional: makes run() non-blocking
+        sel.run()
+    """
+
+    def __init__(self):
+        self._cases = []
+        self._default = None
+
+    def case_recv(self, channel: _Channel, callback: Callable[[Any], Any]):
+        self._cases.append(("recv", channel, None, callback))
+        return self
+
+    def case_send(self, channel: _Channel, value, callback: Callable[[], Any]):
+        self._cases.append(("send", channel, value, callback))
+        return self
+
+    def default(self, callback: Callable[[], Any]):
+        self._default = callback
+        return self
+
+    def run(self, poll_interval: float = 0.001, timeout: Optional[float] = None):
+        """Poll cases until one fires; returns its callback's result.
+        recv fires when a value (or close) is available; send fires when
+        buffer space is free."""
+        if not self._cases and self._default is None:
+            raise ValueError("Select has no cases")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            for kind, ch, value, cb in self._cases:
+                if kind == "recv":
+                    # ready when a value is queued, or the channel was
+                    # closed (then recv returns (None, ok=False) at once)
+                    if ch.qsize() > 0 or ch.closed:
+                        val, ok = ch.recv()
+                        return cb(val if ok else None)
+                else:
+                    # ready when buffer space is free (single-selector
+                    # assumption: nobody else fills the gap between the
+                    # check and the send); a closed channel rejects the
+                    # send — fire the callback only on actual delivery
+                    if ch.closed or ch.qsize() < ch.capacity:
+                        if ch.send(value):
+                            return cb()
+                        raise RuntimeError(
+                            "Select: send on closed channel")
+            if self._default is not None:
+                return self._default()
+            if deadline is not None and time.monotonic() >= deadline:
+                raise TimeoutError("Select timed out")
+            time.sleep(poll_interval)

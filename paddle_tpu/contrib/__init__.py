@@ -1,0 +1,7 @@
+"""Contrib namespace (reference: python/paddle/fluid/contrib)."""
+from . import decoder  # noqa: F401
+from .decoder import BeamSearchDecoder, InitState, StateCell, TrainingDecoder  # noqa: F401
+from .memory_usage_calc import memory_usage  # noqa: F401
+
+__all__ = ["decoder", "memory_usage", "InitState", "StateCell",
+           "TrainingDecoder", "BeamSearchDecoder"]

@@ -1,0 +1,464 @@
+"""General RNN decoder API: training + beam-search inference (reference:
+python/paddle/fluid/contrib/decoder/beam_search_decoder.py).
+
+``StateCell`` names the hidden states / step inputs of a custom RNN cell
+and holds the user's update function; ``TrainingDecoder`` runs the cell
+over a target sequence (teacher forcing); ``BeamSearchDecoder`` runs it
+step-by-step with a beam.
+
+TPU-native divergences from the reference:
+
+- The reference's beam loop is a ``While`` over LoD TensorArrays whose
+  batch shrinks as hypotheses finish and whose states reorder through LoD
+  lineage (``sequence_expand`` on prev scores). Here the loop is a
+  fixed-trip ``StaticRNN`` (one ``lax.scan``) over dense (B, K) beams:
+  finished beams keep proposing only ``end_id`` at frozen score (the
+  ``beam_search`` op's contract), and state rows reorder with the
+  ``beam_gather`` op driven by the step's parent pointers — same results,
+  static shapes.
+- ``InitState(need_reorder=...)`` is accepted but has nothing to do:
+  dense batches have no LoD rank order.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ... import layers
+from ...framework.core import Variable
+from ...layer_helper import LayerHelper
+
+__all__ = ["InitState", "StateCell", "TrainingDecoder", "BeamSearchDecoder"]
+
+
+class _DecoderType:
+    TRAINING = 1
+    BEAM_SEARCH = 2
+
+
+class InitState:
+    """Initial hidden state: wraps `init`, or builds a constant tensor
+    batch-shaped like `init_boot` (reference beam_search_decoder.py:43)."""
+
+    def __init__(self, init=None, shape=None, value=0.0, init_boot=None,
+                 need_reorder=False, dtype="float32"):
+        if init is not None:
+            self._init = init
+        elif init_boot is None:
+            raise ValueError(
+                "init_boot must be provided to infer the shape of InitState.")
+        else:
+            self._init = layers.fill_constant_batch_size_like(
+                input=init_boot, value=value, shape=shape, dtype=dtype)
+        self._shape = shape
+        self._value = value
+        self._need_reorder = need_reorder  # no-op on dense batches
+        self._dtype = dtype
+
+    @property
+    def value(self):
+        return self._init
+
+    @property
+    def need_reorder(self):
+        return self._need_reorder
+
+
+class _MemoryState:
+    """A state bound to a decoder loop memory (reference _MemoryState /
+    _ArrayState collapse into one here: both decoders are scan loops)."""
+
+    def __init__(self, rnn, init_value):
+        self._rnn = rnn
+        self._mem = rnn.memory(init=init_value)
+        self.pending = None
+
+    def get_state(self):
+        return self._mem
+
+    def update_state(self, state):
+        self.pending = state
+
+
+class StateCell:
+    """Named states + step inputs + a user update function (reference
+    beam_search_decoder.py:159). The updater reads inputs with
+    ``get_input``, reads/writes states with ``get_state``/``set_state``;
+    ``out_state`` names the state the decoder scores."""
+
+    def __init__(self, inputs, states, out_state, name=None):
+        self._cur_states = {}
+        self._state_names = []
+        for state_name, state in states.items():
+            if not isinstance(state, InitState):
+                raise ValueError("state must be an InitState object.")
+            self._cur_states[state_name] = state
+            self._state_names.append(state_name)
+        self._inputs = dict(inputs)
+        self._cur_decoder_obj = None
+        self._in_decoder = False
+        self._states_holder = {}
+        self._switched_decoder = False
+        self._state_updater = None
+        self._out_state = out_state
+        if self._out_state not in self._cur_states:
+            raise ValueError("out_state must be one state in states")
+
+    def _enter_decoder(self, decoder_obj):
+        if self._in_decoder or self._cur_decoder_obj is not None:
+            raise ValueError("StateCell has already entered a decoder.")
+        self._in_decoder = True
+        self._cur_decoder_obj = decoder_obj
+        self._switched_decoder = False
+
+    def _leave_decoder(self, decoder_obj):
+        if not self._in_decoder:
+            raise ValueError("StateCell not in decoder, invalid leave.")
+        if self._cur_decoder_obj is not decoder_obj:
+            raise ValueError("Inconsistent decoder object in StateCell.")
+        self._in_decoder = False
+        self._cur_decoder_obj = None
+        self._switched_decoder = False
+
+    def _switch_decoder(self):
+        """Bind each InitState to a loop memory of the current decoder
+        (lazily, on first state access inside the decoder block)."""
+        if not self._in_decoder:
+            raise ValueError("StateCell must enter a decoder first.")
+        if self._switched_decoder:
+            raise ValueError("StateCell already done switching.")
+        holder = self._states_holder.setdefault(id(self._cur_decoder_obj), {})
+        for state_name in self._state_names:
+            state = self._cur_states[state_name]
+            if not isinstance(state, InitState):
+                raise ValueError(
+                    "state %r was already consumed by another decoder; "
+                    "build a fresh StateCell per decoder pair" % state_name)
+            init_value = self._cur_decoder_obj._prepare_init(state)
+            holder[state_name] = _MemoryState(
+                self._cur_decoder_obj._loop, init_value)
+            self._cur_states[state_name] = holder[state_name].get_state()
+        self._switched_decoder = True
+
+    def _holders(self):
+        return self._states_holder[id(self._cur_decoder_obj)]
+
+    def get_state(self, state_name):
+        if self._in_decoder and not self._switched_decoder:
+            self._switch_decoder()
+        if state_name not in self._cur_states:
+            raise ValueError("Unknown state %s." % state_name)
+        return self._cur_states[state_name]
+
+    def get_input(self, input_name):
+        if input_name not in self._inputs or self._inputs[input_name] is None:
+            raise ValueError("Invalid input %s." % input_name)
+        return self._inputs[input_name]
+
+    def set_state(self, state_name, state_value):
+        self._cur_states[state_name] = state_value
+
+    def state_updater(self, updater):
+        """Decorator registering the per-step update function (takes this
+        StateCell, reads inputs, set_state's the new states)."""
+        self._state_updater = updater
+        return updater
+
+    def compute_state(self, inputs):
+        """Feed this step's inputs and run the updater."""
+        if self._in_decoder and not self._switched_decoder:
+            self._switch_decoder()
+        for input_name, input_value in inputs.items():
+            if input_name not in self._inputs:
+                raise ValueError(
+                    "Unknown input %s: not an input placeholder" % input_name)
+            self._inputs[input_name] = input_value
+        if self._state_updater is None:
+            raise ValueError("state_updater not set on StateCell")
+        self._state_updater(self)
+
+    def update_states(self):
+        """Record this step's new state values into the loop memories."""
+        if self._in_decoder and not self._switched_decoder:
+            self._switch_decoder()
+        for state_name, holder in self._holders().items():
+            holder.update_state(self._cur_states[state_name])
+        self._cur_decoder_obj._commit_states(self._holders())
+
+    def out_state(self):
+        return self._cur_states[self._out_state]
+
+
+class TrainingDecoder:
+    """Teacher-forced decoder over a target sequence (reference
+    beam_search_decoder.py:384)::
+
+        decoder = TrainingDecoder(state_cell)
+        with decoder.block():
+            current_word = decoder.step_input(trg_embedding)
+            decoder.state_cell.compute_state(inputs={'x': current_word})
+            out = layers.fc(decoder.state_cell.get_state('h'), size=V,
+                            act='softmax')
+            decoder.state_cell.update_states()
+            decoder.output(out)
+        rnn_out = decoder()
+    """
+
+    BEFORE_DECODER = 0
+    IN_DECODER = 1
+    AFTER_DECODER = 2
+
+    def __init__(self, state_cell, name=None):
+        self._helper = LayerHelper("training_decoder", name=name)
+        self._status = TrainingDecoder.BEFORE_DECODER
+        self._loop = layers.DynamicRNN()
+        self._type = _DecoderType.TRAINING
+        self._state_cell = state_cell
+        self._state_cell._enter_decoder(self)
+
+    @contextlib.contextmanager
+    def block(self):
+        if self._status != TrainingDecoder.BEFORE_DECODER:
+            raise ValueError("decoder.block() can only be invoked once")
+        self._status = TrainingDecoder.IN_DECODER
+        with self._loop.block():
+            yield
+        self._status = TrainingDecoder.AFTER_DECODER
+        self._state_cell._leave_decoder(self)
+
+    @property
+    def state_cell(self):
+        self._assert_in_decoder_block("state_cell")
+        return self._state_cell
+
+    @property
+    def dynamic_rnn(self):
+        return self._loop
+
+    @property
+    def type(self):
+        return self._type
+
+    def _prepare_init(self, init_state):
+        return init_state.value
+
+    def _commit_states(self, holders):
+        for holder in holders.values():
+            if holder.pending is not None:
+                self._loop.update_memory(holder.get_state(), holder.pending)
+                holder.pending = None
+
+    def step_input(self, x, lengths=None):
+        self._assert_in_decoder_block("step_input")
+        return self._loop.step_input(x, lengths=lengths)
+
+    def static_input(self, x):
+        """A variable used whole in every step (not sliced over time)."""
+        self._assert_in_decoder_block("static_input")
+        return x  # dense scan bodies close over outer vars directly
+
+    def __call__(self):
+        if self._status != TrainingDecoder.AFTER_DECODER:
+            raise ValueError(
+                "Training decoder outputs are only visible after its block.")
+        return self._loop()
+
+    def output(self, *outputs):
+        self._assert_in_decoder_block("output")
+        self._loop.output(*outputs)
+
+    def _assert_in_decoder_block(self, method):
+        if self._status != TrainingDecoder.IN_DECODER:
+            raise ValueError(
+                "%s must be invoked inside the TrainingDecoder block" % method)
+
+
+def _beam_gather(x, parent, name=None):
+    """Layer over the beam_gather op: reorder (B*K, ...) state rows by
+    (B, K) parent pointers."""
+    helper = LayerHelper("beam_gather", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, shape=x.shape)
+    helper.append_op(
+        type="beam_gather",
+        inputs={"X": [x.name], "Parent": [parent.name]},
+        outputs={"Out": [out.name]},
+    )
+    return out
+
+
+def _tile_rows(x, k):
+    """(B, D) -> (B*K, D): each row repeated K times (beam expansion)."""
+    if k == 1:
+        return x
+    d = x.shape[-1]
+    un = layers.reshape(x, shape=[-1, 1, d])
+    rep = layers.concat([un] * k, axis=1)
+    return layers.reshape(rep, shape=[-1, d])
+
+
+class BeamSearchDecoder:
+    """Beam-search inference decoder (reference
+    beam_search_decoder.py:523)::
+
+        decoder = BeamSearchDecoder(state_cell, init_ids, init_scores,
+                                    target_dict_dim, word_dim,
+                                    beam_size=4, end_id=1, max_len=32)
+        decoder.decode()
+        translation_ids, translation_scores = decoder()
+
+    ``init_ids``/``init_scores`` are (B, 1); beams 1..K-1 start at score
+    -1e9 so the search leaves beam 0 (the reference achieves the same by
+    starting with a single-hypothesis LoD level).
+    """
+
+    BEFORE_BEAM_SEARCH_DECODER = 0
+    IN_BEAM_SEARCH_DECODER = 1
+    AFTER_BEAM_SEARCH_DECODER = 2
+
+    def __init__(self, state_cell, init_ids, init_scores, target_dict_dim,
+                 word_dim, input_var_dict=None, topk_size=50, sparse_emb=True,
+                 max_len=100, beam_size=1, end_id=1, name=None):
+        self._helper = LayerHelper("beam_search_decoder", name=name)
+        self._type = _DecoderType.BEAM_SEARCH
+        self._status = BeamSearchDecoder.BEFORE_BEAM_SEARCH_DECODER
+        self._loop = layers.StaticRNN()
+        self._state_cell = state_cell
+        self._state_cell._enter_decoder(self)
+        self._max_len = int(max_len)
+        self._beam_size = int(beam_size)
+        self._end_id = int(end_id)
+        self._init_ids = init_ids
+        self._init_scores = init_scores
+        self._target_dict_dim = int(target_dict_dim)
+        self._topk_size = min(int(topk_size), int(target_dict_dim))
+        self._sparse_emb = sparse_emb
+        self._word_dim = int(word_dim)
+        self._input_var_dict = dict(input_var_dict or {})
+        self._outputs = None
+
+    @property
+    def state_cell(self):
+        return self._state_cell
+
+    @property
+    def type(self):
+        return self._type
+
+    def _prepare_init(self, init_state):
+        """Beam states live as (B*K, D): repeat each batch row K times.
+        The tiling ops must sit in the parent block (loop boot values),
+        so decode() pre-tiles before entering the scan and this just
+        looks the result up."""
+        pre = getattr(self, "_pretiled", {})
+        if id(init_state) in pre:
+            return pre[id(init_state)]
+        return _tile_rows(init_state.value, self._beam_size)
+
+    def _commit_states(self, holders):
+        # actual reorder-by-parent + memory update happens in decode()
+        # once the step's parent pointers exist
+        pass
+
+    @contextlib.contextmanager
+    def block(self):
+        """The per-step block. decode() drives it; override decode() for a
+        custom cell wiring (reference contract)."""
+        if self._status != BeamSearchDecoder.BEFORE_BEAM_SEARCH_DECODER:
+            raise ValueError("block() can only be invoked once.")
+        self._status = BeamSearchDecoder.IN_BEAM_SEARCH_DECODER
+        with self._loop.step():
+            yield
+        self._status = BeamSearchDecoder.AFTER_BEAM_SEARCH_DECODER
+        self._state_cell._leave_decoder(self)
+
+    def early_stop(self):
+        """No-op on the fixed-trip dense loop: finished beams freeze via
+        the beam_search op, extra steps are pure end_id padding (masked
+        out by beam_search_decode's lengths)."""
+
+    def decode(self):
+        k = self._beam_size
+        # beam-expanded initial ids/scores in the parent block
+        ids0 = layers.concat([self._init_ids] * k, axis=1) if k > 1 \
+            else self._init_ids
+        if k > 1:
+            dead = layers.fill_constant_batch_size_like(
+                input=self._init_scores, shape=[-1, k - 1], value=-1e9,
+                dtype="float32")
+            scores0 = layers.concat([self._init_scores, dead], axis=1)
+        else:
+            scores0 = self._init_scores
+        # beam-expand any static feed variables once, outside the loop
+        expanded_feeds = {}
+        for name, var in self._input_var_dict.items():
+            if name not in self._state_cell._inputs:
+                raise ValueError("Variable %s not found in StateCell" % name)
+            expanded_feeds[name] = _tile_rows(var, k)
+        # beam-expand the initial states in the parent block too: they
+        # become the scan's boot values (see _prepare_init)
+        self._pretiled = {
+            id(state): _tile_rows(state.value, k)
+            for state in self._state_cell._cur_states.values()
+            if isinstance(state, InitState)}
+
+        # fixed trip count: a (max_len, 1) dummy sequence drives the scan
+        ticks = layers.fill_constant(
+            shape=[self._max_len, 1], dtype="float32", value=0.0)
+
+        with self.block():
+            self._loop.step_input(ticks)
+            prev_ids = self._loop.memory(init=ids0)        # (B, K) int
+            prev_scores = self._loop.memory(init=scores0)  # (B, K) f32
+
+            flat_ids = layers.reshape(prev_ids, shape=[-1, 1])
+            prev_emb = layers.embedding(
+                flat_ids, size=[self._target_dict_dim, self._word_dim],
+                dtype="float32", is_sparse=self._sparse_emb)
+
+            feed_dict = dict(expanded_feeds)
+            for input_name in self._state_cell._inputs:
+                if input_name not in feed_dict:
+                    feed_dict[input_name] = prev_emb
+            self._state_cell.compute_state(inputs=feed_dict)
+
+            current_state = self._state_cell.out_state()  # (B*K, D)
+            scores = layers.fc(input=current_state,
+                               size=self._target_dict_dim, act="softmax")
+            topk_scores, topk_indices = layers.topk(scores, k=self._topk_size)
+            accu_scores = layers.elementwise_add(
+                x=layers.log(topk_scores),
+                y=layers.reshape(prev_scores, shape=[-1, 1]))
+            sel_ids, sel_scores, parent = layers.beam_search(
+                prev_ids, prev_scores,
+                layers.reshape(topk_indices, shape=[-1, k, self._topk_size]),
+                layers.reshape(accu_scores, shape=[-1, k, self._topk_size]),
+                self._beam_size, end_id=self._end_id)
+
+            # reorder every state by this step's winning parents, then
+            # store for the next step
+            self._state_cell.update_states()
+            for holder in self._state_cell._holders().values():
+                new = holder.pending if holder.pending is not None \
+                    else holder.get_state()
+                holder.pending = None
+                self._loop.update_memory(holder.get_state(),
+                                         _beam_gather(new, parent))
+            self._loop.update_memory(
+                prev_ids, layers.cast(sel_ids, self._init_ids.dtype))
+            self._loop.update_memory(prev_scores, sel_scores)
+            self._loop.output(sel_ids, sel_scores, parent)
+
+    def read_array(self, init, is_ids=False, is_scores=False):
+        raise NotImplementedError(
+            "read_array/update_array are LoD TensorArray plumbing of the "
+            "reference While loop; the dense decoder manages beam state "
+            "through StaticRNN memories — override decode() instead.")
+
+    update_array = read_array
+
+    def __call__(self):
+        if self._status != BeamSearchDecoder.AFTER_BEAM_SEARCH_DECODER:
+            raise ValueError("decode() must run before reading outputs.")
+        ids_stack, scores_stack, parent_stack = self._loop()
+        return layers.beam_search_decode(
+            ids_stack, scores_stack, beam_size=self._beam_size,
+            end_id=self._end_id, parent_idx=parent_stack)

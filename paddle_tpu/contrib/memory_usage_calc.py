@@ -1,0 +1,47 @@
+"""Estimate a Program's variable memory at a batch size (reference:
+python/paddle/fluid/contrib/memory_usage_calc.py).
+
+The estimate sums the global block's variable sizes with -1 dims bound to
+``batch_size``. On TPU the true footprint is decided by XLA (fusion keeps
+most intermediates out of HBM; donation reuses parameter buffers), so this
+is an upper-bound-style planning number, same spirit as the reference.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.core import Program
+from ..framework.dtypes import as_numpy_dtype
+
+__all__ = ["memory_usage"]
+
+
+def memory_usage(program, batch_size):
+    """Returns (min_estimate, max_estimate, unit_str) like the reference:
+    the raw sum plus the reference's 5%..10% slack band, scaled to
+    B/KB/MB."""
+    if not isinstance(program, Program):
+        raise TypeError(
+            "Calculating memory usage requires a Program, got %s"
+            % type(program))
+    if batch_size <= 0:
+        raise ValueError("The batch size must be positive.")
+
+    total = 0.0
+    for var in program.global_block().vars.values():
+        shape = getattr(var, "shape", None)
+        if shape is None:
+            continue
+        count = 1
+        for x in shape:
+            count *= batch_size if x in (-1, None) else int(x)
+        total += count * np.dtype(as_numpy_dtype(var.dtype)).itemsize
+
+    unit = "B"
+    if total > 1024:
+        total /= 1024.0
+        unit = "KB"
+        if total > 1024:
+            total /= 1024.0
+            unit = "MB"
+    return total * 1.05, total * 1.1, unit

@@ -7,17 +7,21 @@ modules pick up real files from DATA_HOME when present.
 from . import cifar  # noqa: F401
 from . import common  # noqa: F401
 from . import conll05  # noqa: F401
+from . import flowers  # noqa: F401
 from . import image  # noqa: F401
 from . import imdb  # noqa: F401
 from . import imikolov  # noqa: F401
 from . import mnist  # noqa: F401
 from . import movielens  # noqa: F401
+from . import mq2007  # noqa: F401
 from . import sentiment  # noqa: F401
 from . import uci_housing  # noqa: F401
+from . import voc2012  # noqa: F401
 from . import wmt14  # noqa: F401
 from . import wmt16  # noqa: F401
 
 __all__ = [
     "mnist", "cifar", "uci_housing", "imdb", "imikolov", "movielens",
     "conll05", "sentiment", "wmt14", "wmt16", "image", "common",
+    "flowers", "mq2007", "voc2012",
 ]

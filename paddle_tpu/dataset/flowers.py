@@ -1,0 +1,60 @@
+"""Flowers-102 (reference: python/paddle/dataset/flowers.py).
+
+Samples: (image float32[3, 224, 224] in [0, 1] CHW, label int in [0, 102)).
+Synthetic source: per-class hue template + blob texture (see common.py
+rationale). The reference pipeline decodes jpegs and applies
+``train_mapper``/``test_mapper`` (resize/crop/flip); synthetic samples are
+generated already-transformed, so custom ``mapper``/``use_xmap`` arguments
+are accepted for API parity but not applied.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import rng_for, synthetic_size
+
+__all__ = ["train", "test", "valid"]
+
+N_CLASSES = 102
+_SHAPE = (3, 224, 224)
+
+
+def _templates():
+    rng = rng_for("flowers", "templates")
+    # low-frequency color fields: start from coarse 8x8 noise, upsample
+    coarse = rng.rand(N_CLASSES, 3, 8, 8).astype(np.float32)
+    return coarse
+
+
+def _upsample(t):
+    return np.repeat(np.repeat(t, 28, axis=-2), 28, axis=-1)
+
+
+def _reader(split: str, n: int, cycle: bool = False):
+    coarse = _templates()
+
+    def reader():
+        while True:
+            rng = rng_for("flowers", split)
+            for _ in range(n):
+                label = int(rng.randint(N_CLASSES))
+                img = _upsample(coarse[label])
+                img = img + rng.randn(*_SHAPE).astype(np.float32) * 0.1
+                yield np.clip(img, 0.0, 1.0), label
+            if not cycle:
+                break
+
+    return reader
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
+    """Reference: flowers.py:train."""
+    return _reader("train", synthetic_size("flowers_train", 2048), cycle)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
+    return _reader("test", synthetic_size("flowers_test", 256), cycle)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True):
+    return _reader("valid", synthetic_size("flowers_valid", 256))

@@ -1,0 +1,78 @@
+"""MQ2007 LETOR learning-to-rank (reference: python/paddle/dataset/mq2007.py).
+
+Query groups of (relevance in {0,1,2}, feature float32[46]) documents,
+yielded in the reference's four formats:
+
+- "pointwise": (score float, features (46,)) per document
+- "pairwise":  (label [1], better_doc (46,), worse_doc (46,)) per ordered pair
+- "listwise":  (scores (n,1), features (n,46)) per query
+- "plain_txt": (query_id, score, features (46,)) per document
+
+Synthetic source: a hidden per-query weight vector scores documents, so
+rankers genuinely learn (see common.py rationale). Queries whose documents
+all have relevance 0 are filtered like the reference's ``query_filter``.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from .common import rng_for, synthetic_size
+
+__all__ = ["train", "test"]
+
+FEATURE_DIM = 46
+_DOCS_PER_QUERY = (8, 24)
+
+
+def _query_group(rng):
+    n = int(rng.randint(*_DOCS_PER_QUERY))
+    feats = rng.rand(n, FEATURE_DIM).astype(np.float32)
+    w = rng.randn(FEATURE_DIM).astype(np.float32)
+    raw = feats @ w
+    # bucket the latent score into relevance grades 0..2
+    cut = np.percentile(raw, [60, 85])
+    rel = np.digitize(raw, cut).astype(np.float64)
+    return rel, feats
+
+
+def _gen_pairwise(rel, feats):
+    n = len(rel)
+    for i in range(n):
+        for j in range(i + 1, n):
+            if rel[i] > rel[j]:
+                yield np.array([1.0]), feats[i], feats[j]
+            elif rel[i] < rel[j]:
+                yield np.array([1.0]), feats[j], feats[i]
+
+
+def _reader(split: str, format: str = "pairwise", shuffle: bool = False,
+            fill_missing: int = -1):
+    n_queries = synthetic_size("mq2007_" + split, 128)
+
+    def reader():
+        rng = rng_for("mq2007", split)
+        for qid in range(n_queries):
+            rel, feats = _query_group(rng)
+            if rel.sum() == 0.0:  # reference query_filter
+                continue
+            if format == "pointwise":
+                for r, f in zip(rel, feats):
+                    yield float(r), f
+            elif format == "pairwise":
+                for pair in _gen_pairwise(rel, feats):
+                    yield pair
+            elif format == "listwise":
+                yield rel.reshape(-1, 1), feats
+            elif format == "plain_txt":
+                for r, f in zip(rel, feats):
+                    yield qid, float(r), f
+            else:
+                raise ValueError("unknown format %r" % format)
+
+    return reader
+
+
+train = functools.partial(_reader, "train")
+test = functools.partial(_reader, "test")

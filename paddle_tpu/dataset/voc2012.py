@@ -1,0 +1,56 @@
+"""Pascal VOC2012 segmentation (reference: python/paddle/dataset/voc2012.py).
+
+Samples: (image uint8[H, W, 3] HWC, label uint8[H, W]) with 21 classes
+(0 = background) plus 255 border pixels, like the reference's decoded
+png pairs. Synthetic source: rectangular object blobs whose class id
+paints both the image hue and the label map, so segmentation models learn
+a real (color -> class) mapping.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import rng_for, synthetic_size
+
+__all__ = ["train", "test", "val"]
+
+_H = _W = 224
+N_CLASSES = 21
+
+
+def _sample(rng):
+    img = (rng.rand(_H, _W, 3) * 40).astype(np.uint8)  # dark noise floor
+    label = np.zeros((_H, _W), np.uint8)
+    for _ in range(int(rng.randint(1, 4))):
+        cls = int(rng.randint(1, N_CLASSES))
+        h, w = int(rng.randint(40, 140)), int(rng.randint(40, 140))
+        y, x = int(rng.randint(0, _H - h)), int(rng.randint(0, _W - w))
+        color = ((cls * 11) % 256, (cls * 47) % 256, (cls * 83) % 256)
+        img[y:y + h, x:x + w] = np.asarray(color, np.uint8)
+        label[y:y + h, x:x + w] = cls
+        # 2px border ring marked 255 (the reference's "void" pixels)
+        label[y:y + h, x:min(x + 2, _W)] = 255
+        label[y:min(y + 2, _H), x:x + w] = 255
+    return img, label
+
+
+def _reader(split: str, n: int):
+    def reader():
+        rng = rng_for("voc2012", split)
+        for _ in range(n):
+            yield _sample(rng)
+
+    return reader
+
+
+def train():
+    """Reference: voc2012.py:train (trainval split)."""
+    return _reader("trainval", synthetic_size("voc_train", 512))
+
+
+def test():
+    return _reader("train", synthetic_size("voc_test", 128))
+
+
+def val():
+    return _reader("val", synthetic_size("voc_val", 128))

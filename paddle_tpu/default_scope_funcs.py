@@ -1,0 +1,66 @@
+"""Thread-local default-scope stack (reference:
+python/paddle/fluid/default_scope_funcs.py).
+
+The reference keeps a thread-local stack of C++ ``Scope``s; here the same
+API manages our Python ``framework.Scope`` (names -> live ``jax.Array``s).
+``var``/``find_var`` act on the top of the stack; ``scoped_function`` runs
+a callable inside a fresh child scope that is dropped afterwards.
+"""
+from __future__ import annotations
+
+import threading
+
+from .framework.scope import Scope
+
+__all__ = [
+    "get_cur_scope",
+    "enter_local_scope",
+    "leave_local_scope",
+    "var",
+    "find_var",
+    "scoped_function",
+]
+
+_tl = threading.local()
+
+
+def get_cur_scope() -> Scope:
+    """Current (top-of-stack) scope for this thread."""
+    stack = getattr(_tl, "cur_scope", None)
+    if stack is None:
+        stack = _tl.cur_scope = []
+    if not stack:
+        stack.append(Scope())
+    return stack[-1]
+
+
+def enter_local_scope() -> Scope:
+    """Push a new child of the current scope."""
+    kid = get_cur_scope().new_scope()
+    _tl.cur_scope.append(kid)
+    return kid
+
+
+def leave_local_scope():
+    """Pop the current scope and free its (and its siblings') children."""
+    _tl.cur_scope.pop()
+    get_cur_scope().drop_kids()
+
+
+def var(name: str):
+    """Find-or-create a variable slot in the current scope."""
+    return get_cur_scope().var(name)
+
+
+def find_var(name: str):
+    """Look a variable up through the current scope chain."""
+    return get_cur_scope().find_var(name)
+
+
+def scoped_function(func):
+    """Invoke ``func`` inside a new local scope (dropped on exit)."""
+    enter_local_scope()
+    try:
+        return func()
+    finally:
+        leave_local_scope()

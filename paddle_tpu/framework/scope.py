@@ -12,7 +12,8 @@ from __future__ import annotations
 
 from typing import Dict, Optional
 
-__all__ = ["Scope", "CPUPlace", "TPUPlace", "CUDAPlace", "global_scope", "scope_guard"]
+__all__ = ["Scope", "CPUPlace", "TPUPlace", "CUDAPlace", "CUDAPinnedPlace",
+           "global_scope", "scope_guard"]
 
 
 class Scope:
@@ -47,6 +48,11 @@ class Scope:
         kid = Scope(self)
         self.kids.append(kid)
         return kid
+
+    def drop_kids(self):
+        """Release all child scopes (reference Scope::DropKids); their
+        arrays are freed once no fetched value references them."""
+        self.kids = []
 
     def local_var_names(self):
         return list(self.vars.keys())
@@ -89,6 +95,12 @@ class TPUPlace(Place):
 # The reference's CUDAPlace; maps to the accelerator (TPU) so that reference
 # scripts using CUDAPlace run unchanged.
 class CUDAPlace(TPUPlace):
+    pass
+
+
+# Pinned (page-locked) host memory is a CUDA transfer optimization; on TPU
+# feeds stage through the C++ arena instead, so this is plain host memory.
+class CUDAPinnedPlace(CPUPlace):
     pass
 
 

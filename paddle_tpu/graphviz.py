@@ -1,0 +1,206 @@
+"""Minimal Graphviz dot-text builder (reference:
+python/paddle/fluid/graphviz.py).
+
+Pure text generation — no external ``graphviz`` package needed; ``save``
+writes the .gv/.dot source and ``show`` additionally shells out to ``dot``
+when the binary exists (same contract as the reference, which compiles to
+an image via ``dot -Tpdf``).
+"""
+from __future__ import annotations
+
+import os
+import random
+import subprocess
+
+__all__ = ["Digraph", "Graph", "Node", "Edge", "GraphPreviewGenerator"]
+
+
+def _attr_repr(v) -> str:
+    s = str(v)
+    return '"%s"' % s.replace('"', '\\"')
+
+
+def _attrs(d) -> str:
+    if not d:
+        return ""
+    return "[" + ", ".join("%s=%s" % (k, _attr_repr(v))
+                           for k, v in sorted(d.items())) + "]"
+
+
+class Node:
+    _next_id = [0]
+
+    def __init__(self, label, prefix, **attrs):
+        self.label = label
+        Node._next_id[0] += 1
+        self.name = "%s_%d" % (prefix, Node._next_id[0])
+        self.attrs = attrs
+
+    def __str__(self):
+        a = dict(self.attrs)
+        a.setdefault("label", self.label)
+        return "%s %s" % (self.name, _attrs(a))
+
+
+class Edge:
+    def __init__(self, source: Node, target: Node, **attrs):
+        self.source = source
+        self.target = target
+        self.attrs = attrs
+
+    def __str__(self):
+        return "%s -> %s %s" % (self.source.name, self.target.name,
+                                _attrs(self.attrs))
+
+
+class Graph:
+    rank_counter = 0
+
+    def __init__(self, title, **attrs):
+        self.title = title
+        self.attrs = attrs
+        self.nodes = []
+        self.edges = []
+        self.rank_groups = {}
+
+    def add_node(self, label, prefix, **attrs) -> Node:
+        node = Node(label, prefix, **attrs)
+        self.nodes.append(node)
+        return node
+
+    def add_edge(self, source, target, **attrs) -> Edge:
+        edge = Edge(source, target, **attrs)
+        self.edges.append(edge)
+        return edge
+
+    def rank_group(self, kind, priority):
+        name = "r%d" % Graph.rank_counter
+        Graph.rank_counter += 1
+        self.rank_groups[name] = (kind, [])
+        return name
+
+    def node_group(self, name, node):
+        self.rank_groups[name][1].append(node)
+
+    def _rank_repr(self):
+        lines = []
+        for kind, nodes in self.rank_groups.values():
+            if nodes:
+                lines.append("{rank=%s; %s}" % (
+                    kind, "; ".join(n.name for n in nodes)))
+        return lines
+
+    def __str__(self):
+        lines = ["digraph G {"]
+        for k, v in sorted(self.attrs.items()):
+            lines.append("  %s=%s;" % (k, _attr_repr(v)))
+        if self.title:
+            lines.append("  label=%s;" % _attr_repr(self.title))
+        for n in self.nodes:
+            lines.append("  " + str(n))
+        for e in self.edges:
+            lines.append("  " + str(e))
+        for r in self._rank_repr():
+            lines.append("  " + r)
+        lines.append("}")
+        return "\n".join(lines)
+
+    def save(self, path) -> str:
+        with open(path, "w") as f:
+            f.write(str(self))
+        return path
+
+    def compile(self, dot_path, target_path=None, fmt="pdf"):
+        """Run the system `dot` on a saved source; returns the output path
+        or None when graphviz is not installed."""
+        target_path = target_path or os.path.splitext(dot_path)[0] + "." + fmt
+        try:
+            subprocess.run(["dot", "-T" + fmt, dot_path, "-o", target_path],
+                           check=True, capture_output=True)
+        except (OSError, subprocess.CalledProcessError):
+            return None
+        return target_path
+
+    def show(self, path) -> str:
+        self.save(path)
+        return self.compile(path)
+
+
+class Digraph(Graph):
+    """graphviz.Digraph-alike shim used by net_drawer: node()/edge() with
+    keyword styles, save() writes `filename`."""
+
+    def __init__(self, name="G", filename=None, graph_attr=None,
+                 node_attr=None, edge_attr=None, **kwargs):
+        super().__init__(name, **(graph_attr or {}))
+        self.filename = filename or name + ".gv"
+        self.default_node_attr = dict(node_attr or {})
+        self.default_edge_attr = dict(edge_attr or {})
+        self._by_name = {}
+
+    def node(self, name=None, label=None, **attrs):
+        a = dict(self.default_node_attr)
+        a.update(attrs)
+        n = self.add_node(label or name, "n", **a)
+        if name:
+            n.name = _sanitize(name)
+            self._by_name[name] = n
+        return n
+
+    def edge(self, tail_name, head_name, label=None, **attrs):
+        a = dict(self.default_edge_attr)
+        a.update(attrs)
+        if label is not None:
+            a["label"] = label
+        src = self._by_name.get(tail_name) or self.node(tail_name)
+        dst = self._by_name.get(head_name) or self.node(head_name)
+        return self.add_edge(src, dst, **a)
+
+    def save(self, path=None):
+        return super().save(path or self.filename)
+
+
+def _sanitize(name: str) -> str:
+    return '"%s"' % name.replace('"', "_")
+
+
+class GraphPreviewGenerator:
+    """Build a (var + op)-styled preview graph programmatically (reference
+    graphviz.py:GraphPreviewGenerator): ops are rectangles, vars ovals,
+    parameters highlighted."""
+
+    def __init__(self, title):
+        self.graph = Graph(title, layout="dot", concentrate="true",
+                           rankdir="TB")
+
+    def add_param(self, name, data_type, highlight=False):
+        label = "%s\\n%s" % (name, data_type)
+        return self.graph.add_node(
+            label, prefix="param", shape="note",
+            style="rounded,filled,bold",
+            fillcolor="yellow" if highlight else "gray",
+            color="gray" if not highlight else "orange")
+
+    def add_op(self, opType, **kwargs):
+        highlight = kwargs.pop("highlight", False)
+        return self.graph.add_node(
+            opType, prefix="op", shape="box",
+            style="rounded, filled, bold",
+            color="#303A3A" if not highlight else "maroon",
+            fillcolor="#E4E4E4", width="1.3", height="0.84")
+
+    def add_arg(self, name, highlight=False):
+        return self.graph.add_node(
+            name, prefix="arg", shape="box",
+            style="rounded,filled,bold",
+            fillcolor="lightgrey" if not highlight else "orange",
+            color="lightgrey" if not highlight else "orange")
+
+    def add_edge(self, source, target, **kwargs):
+        return self.graph.add_edge(source, target, **kwargs)
+
+    def __call__(self, path, show=False):
+        self.graph.save(path)
+        if show:
+            return self.graph.compile(path)
+        return path

@@ -74,7 +74,7 @@ def concat(input, axis=0, name=None):
     ax = axis % len(out_shape)
     out_shape[ax] = sum(s[ax] for s in shapes) if all(s[ax] >= 0 for s in shapes) else -1
     out = helper.create_variable_for_type_inference(
-        dtype=helper.input_dtype(), shape=tuple(out_shape)
+        dtype=input[0].dtype, shape=tuple(out_shape)
     )
     helper.append_op(
         type="concat", inputs={"X": input}, outputs={"Out": [out]}, attrs={"axis": axis}

@@ -1,0 +1,70 @@
+"""Draw a Program as a Graphviz network (reference:
+python/paddle/fluid/net_drawer.py).
+
+Walks our Program IR directly (the reference round-trips through the
+ProgramDesc protobuf); ops become styled nodes and each def->use of a
+variable becomes an edge labeled ``slot(var_name)``. Only block 0 is
+plotted, like the reference. See also ``debugger.draw_block_graphviz`` for
+the var-and-op bipartite rendering.
+"""
+from __future__ import annotations
+
+import itertools
+
+from .graphviz import Digraph
+
+__all__ = ["draw_graph"]
+
+OP_STYLE = {
+    "shape": "oval",
+    "color": "#0F9D58",
+    "style": "filled",
+    "fontcolor": "#FFFFFF",
+}
+
+VAR_STYLE = {}
+
+GRAPH_STYLE = {"rankdir": "TB"}
+
+_graph_ids = itertools.count()
+
+
+def _parse_graph(program, graph, var_dict, counter):
+    """Add block-0 ops of `program` to `graph`; `var_dict` maps a variable
+    name to the node name of the op that (last) wrote it."""
+    block = program.global_block()
+    for name in block.vars:
+        var_dict.setdefault(name, "Feed")
+    for op in block.ops:
+        node_name = "%s_%d" % (op.type, next(counter))
+        graph.node(name=node_name, label=op.type)
+        for slot, args in op.inputs.items():
+            for arg in args:
+                name = arg if isinstance(arg, str) else arg.name
+                if name in var_dict:
+                    graph.edge(var_dict[name], node_name,
+                               label="%s(%s)" % (slot, name))
+        for slot, args in op.outputs.items():
+            for arg in args:
+                var_dict[arg if isinstance(arg, str) else arg.name] = node_name
+
+
+def draw_graph(startup_program, main_program, **kwargs):
+    """Render startup+main programs into one digraph; writes `filename`
+    (default `<id>.gv`) and returns the Digraph."""
+    graph_style = dict(GRAPH_STYLE, **kwargs.pop("graph_attr", {}))
+    op_style = dict(OP_STYLE, **kwargs.pop("node_attr", {}))
+    var_style = dict(VAR_STYLE, **kwargs.pop("edge_attr", {}))
+
+    graph_id = next(_graph_ids)
+    filename = kwargs.pop("filename", None) or str(graph_id) + ".gv"
+    g = Digraph(name=str(graph_id), filename=filename,
+                graph_attr=graph_style, node_attr=op_style,
+                edge_attr=var_style)
+
+    var_dict = {}
+    counter = itertools.count()
+    _parse_graph(startup_program, g, var_dict, counter)
+    _parse_graph(main_program, g, var_dict, counter)
+    g.save()
+    return g

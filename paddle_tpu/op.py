@@ -1,0 +1,129 @@
+"""Eager single-operator factory (reference: python/paddle/fluid/op.py).
+
+The reference's ``OperatorFactory`` assembles OpDesc protos so unit tests
+can run one C++ operator against a Scope. The TPU-native equivalent runs
+one registered JAX kernel eagerly: slots are bound to arrays (or to scope
+variable names), the op is traced as a one-op Program (no jit), and
+outputs land back in the Scope::
+
+    scope.set_var("x", np.ones(4))
+    Operator("scale", X="x", Out="y", scale=2.0).run(scope=scope)
+    # scope.find_var("y") == 2.0 * ones(4)
+
+Slot classification (the reference reads op protos; our registry carries
+no slot schemas, so it is value-driven): uppercase keywords are tensor
+slots, lowercase are attributes. An uppercase keyword holding an array
+(or list of arrays) is an input; one holding a string is resolved at
+``run`` time — an input if the scope has data under that name, otherwise
+the name of an output variable.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+__all__ = ["get_all_op_protos", "Operator", "OperatorFactory"]
+
+
+def get_all_op_protos():
+    """All registered kernel protos (reference core.get_all_op_protos)."""
+    from .ops.registry import OpProtoHolder
+
+    return OpProtoHolder.instance().get_all_op_protos()
+
+
+class _EagerOp:
+    """A bound (type, inputs, named-slots, attrs) ready to run eagerly."""
+
+    def __init__(self, type: str, inputs: Dict[str, Any],
+                 named: Dict[str, str], attrs: Dict[str, Any]):
+        self.type = type
+        self.inputs = inputs
+        self.named = named  # slot -> scope var name (input OR output)
+        self.attrs = attrs
+
+    def _split_named(self, scope):
+        """String-bound slots: data in the scope means input, else the
+        slot names an output variable to create."""
+        ins, outs = {}, {}
+        for slot, name in self.named.items():
+            if scope is not None and scope.has_var(name) \
+                    and scope.find_var(name) is not None:
+                ins[slot] = scope.find_var(name)
+            else:
+                outs[slot] = name
+        return ins, outs
+
+    def run(self, scope=None, place=None, rng_seed: int = 0):
+        """Execute the kernel; returns {out_slot: np.ndarray} and writes
+        each output into `scope` under its given name when provided."""
+        import jax
+        import jax.numpy as jnp
+
+        from .framework.core import Program
+        from .framework.trace import RngStream, trace_block
+
+        named_ins, named_outs = self._split_named(scope)
+        if not named_outs:
+            named_outs = {"Out": "Out"}
+
+        prog = Program()
+        block = prog.global_block()
+        env = {}
+        in_map = {}
+        all_inputs = dict(self.inputs)
+        all_inputs.update(named_ins)
+        for slot, val in all_inputs.items():
+            vals = val if isinstance(val, (list, tuple)) else [val]
+            names = []
+            for i, v in enumerate(vals):
+                name = "%s_in_%s_%d" % (self.type, slot.lower(), i)
+                arr = jnp.asarray(np.asarray(v))
+                block.create_var(name=name, shape=list(arr.shape),
+                                 dtype=str(arr.dtype))
+                env[name] = arr
+                names.append(name)
+            in_map[slot] = names
+        out_map = {}
+        for slot, out_name in named_outs.items():
+            block.create_var(name=out_name, shape=None, dtype="float32")
+            out_map[slot] = [out_name]
+        block.append_op(type=self.type, inputs=in_map, outputs=out_map,
+                        attrs=dict(self.attrs))
+        trace_block(block, env, RngStream(jax.random.PRNGKey(rng_seed)))
+        result = {}
+        for slot, names in out_map.items():
+            val = env.get(names[0])
+            result[slot] = None if val is None else np.asarray(val)
+            if scope is not None and val is not None:
+                scope.set_var(names[0], val)
+        return result
+
+    # reference Operator exposes type()/inputs/outputs accessors
+    def type_name(self) -> str:
+        return self.type
+
+
+class OperatorFactory:
+    """``Operator(type, **kwargs)`` — see module docstring for the slot
+    classification rules."""
+
+    def __call__(self, type: str, **kwargs) -> _EagerOp:
+        from .ops.registry import op_support_tpu
+
+        if not op_support_tpu(type):
+            raise ValueError("Operator %r has no registered TPU kernel" % type)
+        inputs, named, attrs = {}, {}, {}
+        for key, val in kwargs.items():
+            if key[:1].isupper():
+                if isinstance(val, str):
+                    named[key] = val
+                else:
+                    inputs[key] = val
+            else:
+                attrs[key] = val
+        return _EagerOp(type, inputs, named, attrs)
+
+
+Operator = OperatorFactory()

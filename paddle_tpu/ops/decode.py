@@ -553,3 +553,61 @@ def _beam_search_decode(ctx):
     if scores is not None:
         out["SentenceScores"] = scores[-1]
     return out
+
+
+@register_op("beam_gather")
+def _beam_gather(ctx):
+    """Reorder per-beam state rows by the parent pointers one beam_search
+    step emitted: X (B*K, ...) or (B, K, ...), Parent (B, K) -> same shape
+    with row (b, k) = X[b, Parent[b, k]]. Dense replacement for the
+    reference's LoD lineage (sequence_expand on prev states,
+    contrib/decoder/beam_search_decoder.py:688); differentiable, so it also
+    serves trainable beam-style decoders."""
+    x = ctx.input("X")
+    parent = ctx.input("Parent").astype(jnp.int32)  # (B, K)
+    b, k = parent.shape
+    if x.shape[0] == b * k:  # flat (B*K, ...) rows
+        xs = x.reshape((b, k) + x.shape[1:])
+    elif x.shape[:2] == (b, k):
+        xs = x
+    else:
+        raise ValueError(
+            "beam_gather: X shape %s matches neither (B*K, ...) nor "
+            "(B, K, ...) for Parent %s" % (x.shape, parent.shape))
+    idx = parent.reshape((b, k) + (1,) * (xs.ndim - 2))
+    out = jnp.take_along_axis(xs, jnp.broadcast_to(idx, (b, k) + xs.shape[2:]),
+                              axis=1)
+    return {"Out": out.reshape(x.shape)}
+
+
+@register_op("ctc_align")
+def _ctc_align(ctx):
+    """reference ctc_align_op.cc: collapse a raw token stream CTC-style —
+    drop `blank` tokens and (with merge_repeated) runs of equal tokens.
+    Dense layout: Input (B, T) + optional Lengths; kept tokens compact to
+    the left via a cumsum-position scatter (no per-sequence loops), output
+    padded with `blank` like the reference pads its shrunken LoD rows,
+    plus OutLengths with the per-row kept counts."""
+    x = ctx.input("Input").astype(jnp.int32)
+    if x.ndim > 2:
+        x = x[..., 0]
+    blank = int(ctx.attr("blank", 0))
+    merge = bool(ctx.attr("merge_repeated", True))
+    b, t = x.shape
+    lengths = ctx.input("Lengths")
+    valid = (jnp.arange(t)[None, :]
+             < _lengths_or_full(lengths, b, t)[:, None])
+    keep = (x != blank) & valid
+    if merge:
+        # drop repeats of the previous RAW token (blanks included), like
+        # the reference's prev_token comparison; -1 sentinel keeps t=0
+        prev = jnp.concatenate(
+            [jnp.full((b, 1), -1, x.dtype), x[:, :-1]], axis=1)
+        keep = keep & (x != prev)
+    pos = jnp.cumsum(keep, axis=1) - 1  # target slot per kept token
+    out = jnp.full((b, t), blank, x.dtype)
+    rows = jnp.broadcast_to(jnp.arange(b)[:, None], (b, t))
+    # non-kept tokens aim at slot t (out of bounds) and are dropped
+    out = out.at[rows, jnp.where(keep, pos, t)].set(x, mode="drop")
+    out_len = jnp.sum(keep, axis=1).astype(jnp.int32)
+    return {"Output": out, "OutLengths": out_len}

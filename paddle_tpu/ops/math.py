@@ -813,3 +813,87 @@ def _load_file(ctx):
     if ctx.attr("load_as_fp16", False):
         arr = arr.astype(np.float16)
     return {"Out": jnp.asarray(arr)}
+
+
+# ---------------------------------------------------------------------------
+# small loss / norm ops (reference C++-only operators, reachable through the
+# reference's Operator factory and exercised by its unittests)
+# ---------------------------------------------------------------------------
+
+
+@register_op("minus")
+def _minus(ctx):
+    """reference minus_op.cc: Out = X - Y."""
+    return {"Out": ctx.input("X") - ctx.input("Y")}
+
+
+@register_op("hinge_loss")
+def _hinge_loss(ctx):
+    """reference hinge_loss_op.cc: labels in {0,1} -> Loss =
+    max(0, 1 - (2*label - 1) * logit), elementwise."""
+    logits = ctx.input("Logits")
+    labels = ctx.input("Labels")
+    return {"Loss": jnp.maximum(
+        0.0, 1.0 - (2.0 * labels - 1.0) * logits)}
+
+
+@register_op("log_loss")
+def _log_loss(ctx):
+    """reference log_loss_op.cc: negative log likelihood of a Bernoulli
+    prediction, stabilized with attr epsilon."""
+    p = ctx.input("Predicted")
+    y = ctx.input("Labels")
+    eps = float(ctx.attr("epsilon", 1e-4))
+    return {"Loss": -y * jnp.log(p + eps) - (1.0 - y) * jnp.log(1.0 - p + eps)}
+
+
+@register_op("margin_rank_loss")
+def _margin_rank_loss(ctx):
+    """reference margin_rank_loss_op.cc: label in {+1,-1} says whether X1
+    should rank above X2; Out = max(0, margin - label*(X1 - X2)).
+    Activated marks the rows inside the margin (the reference saves it for
+    its backward; emitted for parity)."""
+    x1, x2 = ctx.input("X1"), ctx.input("X2")
+    label = ctx.input("Label")
+    margin = float(ctx.attr("margin", 0.0))
+    raw = margin - label * (x1 - x2)
+    return {"Out": jnp.maximum(0.0, raw),
+            "Activated": (raw > 0).astype(x1.dtype)}
+
+
+@register_op("modified_huber_loss")
+def _modified_huber_loss(ctx):
+    """reference modified_huber_loss_op.h: with z = (2y-1)*x,
+    loss = -4z for z < -1, (1-z)^2 for -1 <= z < 1, else 0."""
+    x = ctx.input("X")
+    y = ctx.input("Y")
+    z = (2.0 * y - 1.0) * x
+    loss = jnp.where(z < -1.0, -4.0 * z,
+                     jnp.where(z < 1.0, (1.0 - z) ** 2, 0.0))
+    return {"Out": loss, "IntermediateVal": z}
+
+
+@register_op("squared_l2_distance")
+def _squared_l2_distance(ctx):
+    """reference squared_l2_distance_op.cc: row-wise ||x - y||^2; Y may
+    have one row (broadcast). sub_result is saved for the backward in the
+    reference; emitted for parity."""
+    x = ctx.input("X")
+    y = ctx.input("Y")
+    sub = x - y  # broadcasts when y has one row
+    n = sub.shape[0]
+    out = jnp.sum(sub.reshape(n, -1) ** 2, axis=1, keepdims=True)  # (N, 1)
+    return {"Out": out, "sub_result": sub}
+
+
+@register_op("squared_l2_norm")
+def _squared_l2_norm(ctx):
+    """reference squared_l2_norm_op.cc: scalar sum of squares."""
+    x = ctx.input("X")
+    return {"Out": jnp.sum(x * x).reshape(1)}
+
+
+@register_op("l1_norm")
+def _l1_norm(ctx):
+    """reference l1_norm_op.cc: scalar sum of absolute values."""
+    return {"Out": jnp.sum(jnp.abs(ctx.input("X"))).reshape(1)}

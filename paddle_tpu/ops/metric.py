@@ -59,3 +59,98 @@ def _mean_iou(ctx):
     iou = jnp.where(valid, inter / jnp.maximum(union, 1e-9), 0.0)
     mean_iou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
     return {"OutMeanIou": mean_iou, "OutWrong": (union - inter).astype(jnp.int32), "OutCorrect": inter.astype(jnp.int32)}
+
+
+@register_op("positive_negative_pair")
+def _positive_negative_pair(ctx):
+    """reference positive_negative_pair_op.h: for every same-query pair of
+    rows with different labels, count positive when score order matches
+    label order, otherwise negative; equal scores additionally count as
+    neutral (and as negative — the reference ternary has no else-skip, a
+    quirk kept for parity). Weights average pairwise. Accumulate* inputs
+    carry running totals. Vectorized as (N, N) pair masks instead of the
+    reference's per-query hash map."""
+    score = ctx.input("Score")
+    label = ctx.input("Label").reshape(-1)
+    query = ctx.input("QueryID").reshape(-1)
+    weight = ctx.input("Weight")
+    col = int(ctx.attr("column", -1))
+    s = score[:, col % score.shape[1]] if score.ndim > 1 else score.reshape(-1)
+    n = s.shape[0]
+    w = weight.reshape(-1) if weight is not None else jnp.ones((n,), s.dtype)
+
+    upper = jnp.triu(jnp.ones((n, n), bool), k=1)
+    same_q = query[:, None] == query[None, :]
+    diff_l = label[:, None] != label[None, :]
+    mask = upper & same_q & diff_l
+    pw = (w[:, None] + w[None, :]) * 0.5
+    ds = s[:, None] - s[None, :]
+    dl = label[:, None] - label[None, :]
+    agree = (ds * dl) > 0
+    pos = jnp.sum(jnp.where(mask & agree, pw, 0.0))
+    neg = jnp.sum(jnp.where(mask & ~agree, pw, 0.0))
+    neu = jnp.sum(jnp.where(mask & (ds == 0), pw, 0.0))
+
+    acc_p = ctx.input("AccumulatePositivePair")
+    acc_n = ctx.input("AccumulateNegativePair")
+    acc_u = ctx.input("AccumulateNeutralPair")
+    accs = (acc_p, acc_n, acc_u)
+    if any(a is not None for a in accs):
+        if any(a is None for a in accs):
+            raise ValueError(
+                "positive_negative_pair: Accumulate{Positive,Negative,"
+                "Neutral}Pair must be provided together")
+        pos = pos + acc_p.reshape(())
+        neg = neg + acc_n.reshape(())
+        neu = neu + acc_u.reshape(())
+    one = lambda v: v.reshape(1).astype(s.dtype)
+    return {"PositivePair": one(pos), "NegativePair": one(neg),
+            "NeutralPair": one(neu)}
+
+
+def _pr_metrics(states):
+    """(C, 4) TP/FP/TN/FN -> the 6 reference metrics
+    (precision_recall_op.h:ComputeMetrics)."""
+    tp, fp, fn = states[:, 0], states[:, 1], states[:, 3]
+    prec = jnp.where(tp + fp > 0, tp / jnp.maximum(tp + fp, 1e-30), 1.0)
+    rec = jnp.where(tp + fn > 0, tp / jnp.maximum(tp + fn, 1e-30), 1.0)
+    macro_p, macro_r = jnp.mean(prec), jnp.mean(rec)
+    f1 = lambda p, r: jnp.where(p + r > 0, 2 * p * r / jnp.maximum(p + r, 1e-30), 0.0)
+    ttp, tfp, tfn = jnp.sum(tp), jnp.sum(fp), jnp.sum(fn)
+    micro_p = jnp.where(ttp + tfp > 0, ttp / jnp.maximum(ttp + tfp, 1e-30), 1.0)
+    micro_r = jnp.where(ttp + tfn > 0, ttp / jnp.maximum(ttp + tfn, 1e-30), 1.0)
+    return jnp.stack([macro_p, macro_r, f1(macro_p, macro_r),
+                      micro_p, micro_r, f1(micro_p, micro_r)])
+
+
+@register_op("precision_recall")
+def _precision_recall(ctx):
+    """reference precision_recall_op.h: per-class TP/FP/TN/FN accumulation
+    from (predicted idx, label) pairs + macro/micro precision/recall/F1.
+    The per-sample loop becomes one-hot scatter adds."""
+    ids = ctx.input("Indices").reshape(-1).astype(jnp.int32)
+    labels = ctx.input("Labels").reshape(-1).astype(jnp.int32)
+    weights = ctx.input("Weights")
+    states_in = ctx.input("StatesInfo")
+    c = int(ctx.attr("class_number"))
+    n = ids.shape[0]
+    w = weights.reshape(-1) if weights is not None else jnp.ones((n,), jnp.float32)
+
+    correct = ids == labels
+    oh_id = jax.nn.one_hot(ids, c, dtype=w.dtype)
+    oh_lb = jax.nn.one_hot(labels, c, dtype=w.dtype)
+    tp = jnp.sum(jnp.where(correct, w, 0.0)[:, None] * oh_id, 0)
+    fp = jnp.sum(jnp.where(~correct, w, 0.0)[:, None] * oh_id, 0)
+    fn = jnp.sum(jnp.where(~correct, w, 0.0)[:, None] * oh_lb, 0)
+    # every sample adds w to every class's TN, minus its own id column,
+    # and (when wrong) minus its label column (precision_recall_op.h:68)
+    tn = (jnp.sum(w) - jnp.sum(w[:, None] * oh_id, 0)
+          - jnp.sum(jnp.where(~correct, w, 0.0)[:, None] * oh_lb, 0))
+    batch_states = jnp.stack([tp, fp, tn, fn], axis=1)  # (C, 4)
+
+    batch_metrics = _pr_metrics(batch_states)
+    accum_states = batch_states if states_in is None \
+        else batch_states + states_in
+    return {"BatchMetrics": batch_metrics,
+            "AccumMetrics": _pr_metrics(accum_states),
+            "AccumStatesInfo": accum_states}

@@ -501,3 +501,102 @@ def _nearest_interp(ctx):
     ys = jnp.minimum(jnp.round(jnp.arange(out_h) * (h / out_h)).astype(jnp.int32), h - 1)
     xs = jnp.minimum(jnp.round(jnp.arange(out_w) * (w / out_w)).astype(jnp.int32), w - 1)
     return {"Out": x[:, :, ys][:, :, :, xs]}
+
+
+@register_op("max_pool2d_with_index")
+def _max_pool2d_with_index(ctx):
+    """reference pool_with_index_op.cc: max pool that also emits Mask, the
+    argmax position of each window as a flat index into the (H*W) input
+    map. Windows are unrolled (ksize is small and static) and argmaxed —
+    no data-dependent control flow, so it jits to one fused XLA op."""
+    x = ctx.input("X")  # NCHW
+    kh, kw = ctx.attr("ksize")
+    sh, sw = ctx.attr("strides", [1, 1])
+    ph, pw = ctx.attr("paddings", [0, 0])
+    if ctx.attr("global_pooling", False):
+        kh, kw = x.shape[2], x.shape[3]
+        ph = pw = 0
+    n, c, h, w = x.shape
+    oh = (h - kh + 2 * ph) // sh + 1
+    ow = (w - kw + 2 * pw) // sw + 1
+    neg = jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating) \
+        else jnp.iinfo(x.dtype).min
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+                 constant_values=neg)
+    vals, idxs = [], []
+    for i in range(kh):
+        for j in range(kw):
+            window = lax.slice(
+                xp, (0, 0, i, j),
+                (n, c, i + (oh - 1) * sh + 1, j + (ow - 1) * sw + 1),
+                (1, 1, sh, sw))
+            vals.append(window)
+            row = jnp.arange(oh) * sh - ph + i  # input-space coordinates
+            col = jnp.arange(ow) * sw - pw + j
+            idxs.append(row[:, None] * w + col[None, :])
+    stack_v = jnp.stack(vals)                       # (KH*KW, N, C, OH, OW)
+    stack_i = jnp.stack(idxs)                       # (KH*KW, OH, OW)
+    best = jnp.argmax(stack_v, axis=0)              # (N, C, OH, OW)
+    out = jnp.max(stack_v, axis=0)
+    mask = jnp.take_along_axis(
+        jnp.broadcast_to(stack_i[:, None, None], stack_v.shape),
+        best[None], axis=0)[0]
+    return {"Out": out, "Mask": mask.astype(jnp.int32)}
+
+
+@register_op("unpool")
+def _unpool(ctx):
+    """reference unpool_op.cc ("max" unpooling): scatter each pooled value
+    back to the input-map position recorded in Indices by
+    max_pool2d_with_index; everything else is zero.
+
+    Contract (same as the reference kernel): the pooling geometry must
+    tile the original map exactly — the output dims are recomputed as
+    (o-1)*stride - 2*pad + ksize and Indices are interpreted in that
+    coordinate system. When the original pool truncated a remainder the
+    reference indexes out of bounds (UB); here out-of-range scatters are
+    dropped (mode="drop")."""
+    x = ctx.input("X")            # (N, C, OH, OW)
+    indices = ctx.input("Indices")
+    kh, kw = ctx.attr("ksize")
+    sh, sw = ctx.attr("strides", [1, 1])
+    ph, pw = ctx.attr("paddings", [0, 0])
+    n, c, oh, ow = x.shape
+    h = (oh - 1) * sh - 2 * ph + kh
+    w = (ow - 1) * sw - 2 * pw + kw
+    flat_v = x.reshape(n * c, oh * ow)
+    flat_i = indices.reshape(n * c, oh * ow).astype(jnp.int32)
+    out = jnp.zeros((n * c, h * w), x.dtype)
+    out = out.at[jnp.arange(n * c)[:, None], flat_i].set(flat_v)
+    return {"Out": out.reshape(n, c, h, w)}
+
+
+@register_op("spp")
+def _spp(ctx):
+    """reference spp_op.h (spatial pyramid pooling): levels p=0..P-1 pool
+    onto a 2^p x 2^p grid (kernel=ceil(dim/bins), stride=kernel,
+    pad=(kernel*bins-dim+1)//2), flatten, concat -> (N, C*sum(4^p))."""
+    x = ctx.input("X")  # NCHW
+    height = int(ctx.attr("pyramid_height"))
+    ptype = ctx.attr("pooling_type", "max")
+    n, c, h, w = x.shape
+    pieces = []
+    for p in range(height):
+        bins = 2 ** p
+        kh = -(-h // bins)
+        kw = -(-w // bins)
+        ph = (kh * bins - h + 1) // 2
+        pw = (kw * bins - w + 1) // 2
+        window = (1, 1, kh, kw)
+        strides = (1, 1, kh, kw)
+        padding = ((0, 0), (0, 0), (ph, ph), (pw, pw))
+        if ptype == "max":
+            neg = jnp.finfo(x.dtype).min
+            lvl = lax.reduce_window(x, neg, lax.max, window, strides, padding)
+        else:
+            s = lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
+            cnt = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, window,
+                                    strides, padding)
+            lvl = s / cnt
+        pieces.append(lvl[:, :, :bins, :bins].reshape(n, c * bins * bins))
+    return {"Out": jnp.concatenate(pieces, axis=1)}

@@ -161,3 +161,36 @@ def _ftrl(ctx):
         y = jnp.power(new_accum, -power) / lr + 2 * l2
     p_new = jnp.where(jnp.abs(lin_new) > l1, x / y, jnp.zeros_like(p))
     return {"ParamOut": p_new, "SquaredAccumOut": new_accum, "LinearAccumOut": lin_new}
+
+
+@register_op("proximal_gd")
+def _proximal_gd(ctx):
+    """reference proximal_gd_op.cc: gradient step followed by the proximal
+    operator of l1 + l2 regularization:
+    prox = p - lr*g; p' = sign(prox) * max(0, |prox| - lr*l1) / (1 + lr*l2)."""
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    lr = ctx.input("LearningRate").reshape(())
+    l1 = float(ctx.attr("l1", 0.0))
+    l2 = float(ctx.attr("l2", 0.0))
+    prox = p - lr * g
+    p_new = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+             / (1.0 + lr * l2))
+    return {"ParamOut": p_new}
+
+
+@register_op("proximal_adagrad")
+def _proximal_adagrad(ctx):
+    """reference proximal_adagrad_op.h: the gradient step is scaled
+    per-element by lr/sqrt(moment), but the l1 shrinkage and l2 shrink
+    factor use the plain scalar lr (lr*l1 / lr*l2 in the reference's
+    Eigen expression)."""
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    m = ctx.input("Moment")
+    lr = ctx.input("LearningRate").reshape(())
+    l1 = float(ctx.attr("l1", 0.0))
+    l2 = float(ctx.attr("l2", 0.0))
+    m_new = m + jnp.square(g)
+    prox = p - lr * g / jnp.sqrt(m_new)
+    p_new = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+             / (1.0 + lr * l2))
+    return {"ParamOut": p_new, "MomentOut": m_new}

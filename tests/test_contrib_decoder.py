@@ -1,0 +1,212 @@
+"""Tests for contrib.decoder: InitState / StateCell / TrainingDecoder /
+BeamSearchDecoder (reference: fluid/contrib/decoder/beam_search_decoder.py,
+unittests test_beam_search_decoder.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.contrib import BeamSearchDecoder, InitState, StateCell, TrainingDecoder
+
+B, T, D, V, WD = 2, 5, 8, 11, 6  # WD != D so param shapes are unambiguous
+
+
+def _make_cell(init_h):
+    state_cell = StateCell(
+        inputs={"x": None}, states={"h": InitState(init=init_h)},
+        out_state="h")
+
+    @state_cell.state_updater
+    def updater(cell):
+        x = cell.get_input("x")
+        h = cell.get_state("h")
+        new_h = layers.fc(input=[x, h], size=D, act="tanh",
+                          bias_attr=False)
+        cell.set_state("h", new_h)
+
+    return state_cell
+
+
+def _run(prog, startup, feed, fetch):
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        return exe.run(prog, feed=feed, fetch_list=fetch), scope
+
+
+def test_training_decoder_matches_manual_rnn():
+    """The TrainingDecoder must compute exactly what a hand-built
+    DynamicRNN with the same cell computes (same seed => same params)."""
+    r = np.random.RandomState(0)
+    emb_in = r.randn(B, T, WD).astype(np.float32)
+    h0_in = r.randn(B, D).astype(np.float32)
+
+    def build(use_decoder):
+        prog, startup = fluid.Program(), fluid.Program()
+        prog.random_seed = startup.random_seed = 7
+        with fluid.program_guard(prog, startup):
+            with fluid.unique_name.guard():
+                emb = layers.data(name="emb", shape=[T, WD])
+                h0 = layers.data(name="h0", shape=[D])
+                if use_decoder:
+                    cell = _make_cell(h0)
+                    decoder = TrainingDecoder(cell)
+                    with decoder.block():
+                        w = decoder.step_input(emb)
+                        decoder.state_cell.compute_state(inputs={"x": w})
+                        out = layers.fc(
+                            input=decoder.state_cell.get_state("h"),
+                            size=V, act="softmax")
+                        decoder.state_cell.update_states()
+                        decoder.output(out)
+                    seq = decoder()
+                else:
+                    rnn = layers.DynamicRNN()
+                    with rnn.block():
+                        w = rnn.step_input(emb)
+                        h = rnn.memory(init=h0)
+                        new_h = layers.fc(input=[w, h], size=D, act="tanh",
+                                          bias_attr=False)
+                        out = layers.fc(input=new_h, size=V, act="softmax")
+                        rnn.update_memory(h, new_h)
+                        rnn.output(out)
+                    seq = rnn()
+                loss = layers.mean(seq)
+        return prog, startup, seq, loss
+
+    feeds = {"emb": emb_in, "h0": h0_in}
+    pa, sa, seq_a, _ = build(True)
+    (out_a,), _ = _run(pa, sa, feeds, [seq_a])
+    pb, sb, seq_b, _ = build(False)
+    (out_b,), _ = _run(pb, sb, feeds, [seq_b])
+    assert np.asarray(out_a).shape == (B, T, V)
+    np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_training_decoder_api_guards():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        h0 = layers.data(name="h0", shape=[D])
+        cell = _make_cell(h0)
+        decoder = TrainingDecoder(cell)
+        with pytest.raises(ValueError):
+            decoder.step_input(h0)  # outside block
+        with pytest.raises(ValueError):
+            decoder()  # before block ran
+        # a second decoder cannot steal the cell
+        with pytest.raises(ValueError):
+            TrainingDecoder(cell)
+
+
+def test_init_state_from_boot():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        boot = layers.data(name="boot", shape=[D])
+        st = InitState(init_boot=boot, shape=[-1, 4], value=1.5)
+        assert tuple(st.value.shape)[-1] == 4
+        with pytest.raises(ValueError):
+            InitState(shape=[4])  # neither init nor init_boot
+
+
+def _decode(beam_size, max_len=6, seed=3):
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = startup.random_seed = seed
+    with fluid.program_guard(prog, startup):
+        with fluid.unique_name.guard():
+            enc = layers.data(name="enc", shape=[D])
+            init_ids = layers.data(name="init_ids", shape=[1], dtype="int64")
+            init_scores = layers.data(name="init_scores", shape=[1])
+            cell = _make_cell(enc)
+            decoder = BeamSearchDecoder(
+                cell, init_ids, init_scores, target_dict_dim=V, word_dim=WD,
+                topk_size=V, sparse_emb=False, max_len=max_len,
+                beam_size=beam_size, end_id=1)
+            decoder.decode()
+            ids, scores = decoder()
+    r = np.random.RandomState(11)
+    feed = {
+        "enc": r.randn(B, D).astype(np.float32),
+        "init_ids": np.zeros((B, 1), np.int64),
+        "init_scores": np.zeros((B, 1), np.float32),
+    }
+    (ids_v, scores_v), _ = _run(prog, startup, feed, [ids, scores])
+    return np.asarray(ids_v), np.asarray(scores_v)
+
+
+def test_beam_search_decoder_shapes_and_validity():
+    K, L = 3, 6
+    ids, scores = _decode(beam_size=K, max_len=L)
+    assert ids.shape == (B, K, L)
+    assert scores.shape == (B, K)
+    assert ids.min() >= 0 and ids.max() < V
+    # beams come back best-first
+    for b in range(B):
+        assert all(scores[b, i] >= scores[b, i + 1] - 1e-6
+                   for i in range(K - 1))
+    # deterministic
+    ids2, scores2 = _decode(beam_size=K, max_len=L)
+    np.testing.assert_array_equal(ids, ids2)
+    np.testing.assert_allclose(scores, scores2, rtol=1e-6)
+
+
+def test_beam_size_one_is_greedy():
+    """With K=1 the decode must equal an explicit greedy rollout through
+    the same parameters (fetched from the trained scope)."""
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = startup.random_seed = 5
+    with fluid.program_guard(prog, startup):
+        with fluid.unique_name.guard():
+            enc = layers.data(name="enc", shape=[D])
+            init_ids = layers.data(name="init_ids", shape=[1], dtype="int64")
+            init_scores = layers.data(name="init_scores", shape=[1])
+            cell = _make_cell(enc)
+            decoder = BeamSearchDecoder(
+                cell, init_ids, init_scores, target_dict_dim=V, word_dim=WD,
+                topk_size=V, sparse_emb=False, max_len=4, beam_size=1,
+                end_id=10_000)  # end id outside vocab: no early finish
+            decoder.decode()
+            ids, scores = decoder()
+
+    r = np.random.RandomState(1)
+    enc_v = r.randn(B, D).astype(np.float32)
+    feed = {"enc": enc_v, "init_ids": np.zeros((B, 1), np.int64),
+            "init_scores": np.zeros((B, 1), np.float32)}
+    (ids_v, scores_v), scope = _run(prog, startup, feed, [ids, scores])
+    ids_v = np.asarray(ids_v)
+
+    # numpy greedy replay with the scope's parameters
+    params = {n: np.asarray(scope.find_var(n))
+              for n in prog.global_block().vars
+              if scope.find_var(n) is not None
+              and getattr(prog.global_block().vars[n], "persistable", False)}
+    emb_w = next(v for n, v in params.items() if v.shape == (V, WD))
+    x_w = next(v for n, v in params.items() if v.shape == (WD, D))
+    h_w = next(v for n, v in params.items() if v.shape == (D, D))
+    score_w = next(v for n, v in params.items() if v.shape == (D, V))
+    score_b = next(v for n, v in params.items() if v.shape == (V,))
+
+    h = enc_v.copy()
+    tok = np.zeros(B, np.int64)
+    want = []
+    for _ in range(4):
+        x = emb_w[tok]
+        h = np.tanh(x @ x_w + h @ h_w)
+        logits = h @ score_w + score_b
+        p = np.exp(logits - logits.max(1, keepdims=True))
+        p /= p.sum(1, keepdims=True)
+        tok = np.argmax(np.log(p), axis=1)
+        want.append(tok.copy())
+    want = np.stack(want, 1)  # (B, L)
+    np.testing.assert_array_equal(ids_v[:, 0, :], want)
+
+
+def test_beam_gather_op():
+    from tests.op_test import run_op
+
+    x = np.arange(12, dtype=np.float32).reshape(6, 2)  # B=2, K=3 flat
+    parent = np.array([[2, 0, 0], [1, 1, 2]], np.int32)
+    out = np.asarray(run_op("beam_gather", {"X": x, "Parent": parent})["Out"])
+    want = np.stack([x[2], x[0], x[0], x[4], x[4], x[5]])
+    np.testing.assert_array_equal(out, want)

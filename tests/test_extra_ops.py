@@ -1,0 +1,309 @@
+"""Numeric tests for the round-2 extra kernels: small losses/norms,
+proximal optimizers, ranking/precision-recall metrics, pooling-with-index /
+unpool / spp, and ctc_align (reference C++-only operators)."""
+import numpy as np
+import pytest
+
+from tests.op_test import check_forward, check_grad, run_op
+
+R = np.random.RandomState(42)
+
+
+def test_minus():
+    x = R.randn(3, 4).astype(np.float32)
+    y = R.randn(3, 4).astype(np.float32)
+    check_forward("minus", {"X": x, "Y": y}, lambda: x - y)
+    check_grad("minus", {"X": x, "Y": y}, "X")
+
+
+def test_hinge_loss():
+    logits = R.randn(8, 1).astype(np.float32)
+    labels = (R.rand(8, 1) > 0.5).astype(np.float32)
+    want = np.maximum(0.0, 1.0 - (2.0 * labels - 1.0) * logits)
+    check_forward("hinge_loss", {"Logits": logits, "Labels": labels},
+                  lambda: want, outs=("Loss",))
+
+
+def test_log_loss():
+    p = R.rand(8, 1).astype(np.float32) * 0.9 + 0.05
+    y = (R.rand(8, 1) > 0.5).astype(np.float32)
+    eps = 1e-4
+    want = -y * np.log(p + eps) - (1 - y) * np.log(1 - p + eps)
+    check_forward("log_loss", {"Predicted": p, "Labels": y},
+                  lambda: want, attrs={"epsilon": eps}, outs=("Loss",))
+    check_grad("log_loss", {"Predicted": p, "Labels": y}, "Predicted",
+               attrs={"epsilon": eps}, outs=("Loss",))
+
+
+def test_margin_rank_loss():
+    x1 = R.randn(6, 1).astype(np.float32)
+    x2 = R.randn(6, 1).astype(np.float32)
+    lbl = np.where(R.rand(6, 1) > 0.5, 1.0, -1.0).astype(np.float32)
+    margin = 0.1
+    raw = margin - lbl * (x1 - x2)
+    check_forward("margin_rank_loss", {"X1": x1, "X2": x2, "Label": lbl},
+                  lambda: (np.maximum(0, raw), (raw > 0).astype(np.float32)),
+                  attrs={"margin": margin}, outs=("Out", "Activated"))
+
+
+def test_modified_huber_loss():
+    x = np.linspace(-3, 3, 13).astype(np.float32).reshape(-1, 1)
+    y = (R.rand(13, 1) > 0.5).astype(np.float32)
+    z = (2 * y - 1) * x
+    want = np.where(z < -1, -4 * z, np.where(z < 1, (1 - z) ** 2, 0.0))
+    check_forward("modified_huber_loss", {"X": x, "Y": y},
+                  lambda: (want, z), outs=("Out", "IntermediateVal"))
+
+
+def test_squared_l2_distance_and_norms():
+    x = R.randn(4, 5).astype(np.float32)
+    y = R.randn(4, 5).astype(np.float32)
+    check_forward("squared_l2_distance", {"X": x, "Y": y},
+                  lambda: ((x - y) ** 2).sum(1, keepdims=True))
+    # broadcast row
+    y1 = R.randn(1, 5).astype(np.float32)
+    check_forward("squared_l2_distance", {"X": x, "Y": y1},
+                  lambda: ((x - y1) ** 2).sum(1, keepdims=True))
+    # rank-3 input still reduces to the reference's (N, 1)
+    x3 = R.randn(4, 2, 3).astype(np.float32)
+    y3 = R.randn(4, 2, 3).astype(np.float32)
+    check_forward("squared_l2_distance", {"X": x3, "Y": y3},
+                  lambda: ((x3 - y3) ** 2).reshape(4, -1).sum(
+                      1, keepdims=True))
+    check_forward("squared_l2_norm", {"X": x},
+                  lambda: np.array([(x ** 2).sum()]))
+    check_forward("l1_norm", {"X": x}, lambda: np.array([np.abs(x).sum()]))
+    check_grad("squared_l2_norm", {"X": x}, "X")
+
+
+def _prox(p, l1, l2, lr):
+    return np.sign(p) * np.maximum(np.abs(p) - lr * l1, 0.0) / (1 + lr * l2)
+
+
+def test_proximal_gd():
+    p = R.randn(6).astype(np.float32)
+    g = R.randn(6).astype(np.float32)
+    lr = np.array([0.1], np.float32)
+    out = run_op("proximal_gd",
+                 {"Param": p, "Grad": g, "LearningRate": lr},
+                 attrs={"l1": 0.05, "l2": 0.01}, outs=("ParamOut",))
+    want = _prox(p - 0.1 * g, 0.05, 0.01, 0.1)
+    np.testing.assert_allclose(np.asarray(out["ParamOut"]), want, rtol=1e-5)
+
+
+def test_proximal_adagrad():
+    p = R.randn(6).astype(np.float32)
+    g = R.randn(6).astype(np.float32)
+    m = np.abs(R.randn(6)).astype(np.float32)
+    lr = np.array([0.1], np.float32)
+    out = run_op("proximal_adagrad",
+                 {"Param": p, "Grad": g, "Moment": m, "LearningRate": lr},
+                 attrs={"l1": 0.05, "l2": 0.01},
+                 outs=("ParamOut", "MomentOut"))
+    m_new = m + g ** 2
+    # per-element lr only scales the gradient step; the l1/l2 proximal
+    # factors use the scalar lr (reference proximal_adagrad_op.h)
+    prox = p - 0.1 * g / np.sqrt(m_new)
+    want = (np.sign(prox) * np.maximum(np.abs(prox) - 0.1 * 0.05, 0.0)
+            / (1 + 0.1 * 0.01))
+    np.testing.assert_allclose(np.asarray(out["MomentOut"]), m_new, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out["ParamOut"]), want, rtol=1e-4)
+
+
+def _pnpair_ref(score, label, query, weight=None, acc=(0.0, 0.0, 0.0)):
+    n = len(score)
+    w = weight if weight is not None else np.ones(n)
+    pos, neg, neu = acc
+    for i in range(n):
+        for j in range(i + 1, n):
+            if query[i] != query[j] or label[i] == label[j]:
+                continue
+            pw = (w[i] + w[j]) * 0.5
+            if score[i] == score[j]:
+                neu += pw
+            if (score[i] - score[j]) * (label[i] - label[j]) > 0:
+                pos += pw
+            else:
+                neg += pw
+    return pos, neg, neu
+
+
+def test_positive_negative_pair():
+    n = 12
+    score = R.randint(0, 4, (n, 1)).astype(np.float32)  # ties likely
+    label = R.randint(0, 3, (n, 1)).astype(np.float32)
+    query = np.repeat(np.arange(3), 4).reshape(n, 1).astype(np.int64)
+    out = run_op("positive_negative_pair",
+                 {"Score": score, "Label": label, "QueryID": query},
+                 outs=("PositivePair", "NegativePair", "NeutralPair"))
+    pos, neg, neu = _pnpair_ref(score[:, 0], label[:, 0], query[:, 0])
+    np.testing.assert_allclose(np.asarray(out["PositivePair"]), [pos])
+    np.testing.assert_allclose(np.asarray(out["NegativePair"]), [neg])
+    np.testing.assert_allclose(np.asarray(out["NeutralPair"]), [neu])
+    # accumulation + weights
+    wgt = R.rand(n, 1).astype(np.float32)
+    out2 = run_op("positive_negative_pair",
+                  {"Score": score, "Label": label, "QueryID": query,
+                   "Weight": wgt,
+                   "AccumulatePositivePair": np.array([10.0], np.float32),
+                   "AccumulateNegativePair": np.array([5.0], np.float32),
+                   "AccumulateNeutralPair": np.array([1.0], np.float32)},
+                  outs=("PositivePair", "NegativePair", "NeutralPair"))
+    pos2, neg2, neu2 = _pnpair_ref(score[:, 0], label[:, 0], query[:, 0],
+                                   wgt[:, 0], (10.0, 5.0, 1.0))
+    np.testing.assert_allclose(np.asarray(out2["PositivePair"]), [pos2],
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out2["NegativePair"]), [neg2],
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out2["NeutralPair"]), [neu2],
+                               rtol=1e-5)
+
+
+def _pr_states_ref(ids, labels, w, c):
+    st = np.zeros((c, 4))  # TP FP TN FN
+    for i in range(len(ids)):
+        idx, lbl, wi = ids[i], labels[i], w[i]
+        if idx == lbl:
+            st[idx, 0] += wi
+            st[:, 2] += wi
+            st[idx, 2] -= wi
+        else:
+            st[lbl, 3] += wi
+            st[idx, 1] += wi
+            st[:, 2] += wi
+            st[idx, 2] -= wi
+            st[lbl, 2] -= wi
+    return st
+
+
+def _pr_metrics_ref(st):
+    def prec(tp, fp):
+        return tp / (tp + fp) if tp > 0 or fp > 0 else 1.0
+
+    def rec(tp, fn):
+        return tp / (tp + fn) if tp > 0 or fn > 0 else 1.0
+
+    def f1(p, r):
+        return 2 * p * r / (p + r) if p > 0 or r > 0 else 0.0
+
+    c = st.shape[0]
+    mp = np.mean([prec(st[i, 0], st[i, 1]) for i in range(c)])
+    mr = np.mean([rec(st[i, 0], st[i, 3]) for i in range(c)])
+    tp, fp, fn = st[:, 0].sum(), st[:, 1].sum(), st[:, 3].sum()
+    up, ur = prec(tp, fp), rec(tp, fn)
+    return np.array([mp, mr, f1(mp, mr), up, ur, f1(up, ur)])
+
+
+def test_precision_recall():
+    c, n = 4, 20
+    ids = R.randint(0, c, n).astype(np.int32)
+    labels = R.randint(0, c, n).astype(np.int32)
+    w = R.rand(n).astype(np.float32)
+    states = np.abs(R.rand(c, 4)).astype(np.float32) * 3
+    out = run_op("precision_recall",
+                 {"Indices": ids.reshape(-1, 1),
+                  "Labels": labels.reshape(-1, 1),
+                  "Weights": w.reshape(-1, 1), "StatesInfo": states},
+                 attrs={"class_number": c},
+                 outs=("BatchMetrics", "AccumMetrics", "AccumStatesInfo"))
+    st = _pr_states_ref(ids, labels, w, c)
+    np.testing.assert_allclose(np.asarray(out["BatchMetrics"]),
+                               _pr_metrics_ref(st), rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(out["AccumStatesInfo"]),
+                               st + states, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out["AccumMetrics"]),
+                               _pr_metrics_ref(st + states.astype(np.float64)),
+                               rtol=1e-4, atol=1e-6)
+
+
+def _ref_pool_with_index(x, k, s, p):
+    n, c, h, w = x.shape
+    oh = (h - k + 2 * p) // s + 1
+    ow = (w - k + 2 * p) // s + 1
+    out = np.zeros((n, c, oh, ow), x.dtype)
+    mask = np.zeros((n, c, oh, ow), np.int32)
+    for ni in range(n):
+        for ci in range(c):
+            for i in range(oh):
+                for j in range(ow):
+                    best, bidx = -np.inf, -1
+                    for di in range(k):
+                        for dj in range(k):
+                            r, cc = i * s - p + di, j * s - p + dj
+                            if 0 <= r < h and 0 <= cc < w \
+                                    and x[ni, ci, r, cc] > best:
+                                best = x[ni, ci, r, cc]
+                                bidx = r * w + cc
+                    out[ni, ci, i, j] = best
+                    mask[ni, ci, i, j] = bidx
+    return out, mask
+
+
+def test_max_pool2d_with_index_and_unpool():
+    x = R.randn(2, 3, 6, 6).astype(np.float32)
+    attrs = {"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0]}
+    got = run_op("max_pool2d_with_index", {"X": x}, attrs=attrs,
+                 outs=("Out", "Mask"))
+    want_out, want_mask = _ref_pool_with_index(x, 2, 2, 0)
+    np.testing.assert_allclose(np.asarray(got["Out"]), want_out, rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(got["Mask"]), want_mask)
+
+    up = run_op("unpool", {"X": np.asarray(got["Out"]),
+                           "Indices": np.asarray(got["Mask"])},
+                attrs=attrs)["Out"]
+    up = np.asarray(up)
+    assert up.shape == x.shape
+    # every pooled max lands back at its original position
+    flat_x, flat_up = x.reshape(6, 36), up.reshape(6, 36)
+    flat_m = want_mask.reshape(6, -1)
+    for r in range(6):
+        np.testing.assert_allclose(flat_up[r, flat_m[r]],
+                                   flat_x[r, flat_m[r]], rtol=1e-6)
+        zero_pos = np.setdiff1d(np.arange(36), flat_m[r])
+        assert np.all(flat_up[r, zero_pos] == 0)
+
+
+def test_spp():
+    x = R.randn(2, 3, 7, 9).astype(np.float32)
+    out = np.asarray(run_op("spp", {"X": x},
+                            attrs={"pyramid_height": 3,
+                                   "pooling_type": "max"})["Out"])
+    assert out.shape == (2, 3 * (1 + 4 + 16))
+    # level 0 is global max pooling
+    np.testing.assert_allclose(out[:, :3], x.max(axis=(2, 3)), rtol=1e-6)
+    # avg level 0 is the global mean (exclusive padding)
+    out_avg = np.asarray(run_op("spp", {"X": x},
+                                attrs={"pyramid_height": 1,
+                                       "pooling_type": "avg"})["Out"])
+    np.testing.assert_allclose(out_avg, x.mean(axis=(2, 3)), rtol=1e-5)
+
+
+def test_ctc_align():
+    inp = np.array([[0, 1, 1, 0, 2, 2, 2, 0, 3],
+                    [4, 4, 0, 5, 5, 5, 6, 0, 0]], np.int32)
+    got = run_op("ctc_align", {"Input": inp},
+                 attrs={"blank": 0, "merge_repeated": True},
+                 outs=("Output", "OutLengths"))
+    out = np.asarray(got["Output"])
+    lens = np.asarray(got["OutLengths"])
+    np.testing.assert_array_equal(lens, [3, 3])
+    np.testing.assert_array_equal(out[0, :3], [1, 2, 3])
+    np.testing.assert_array_equal(out[1, :3], [4, 5, 6])
+    assert np.all(out[0, 3:] == 0) and np.all(out[1, 3:] == 0)
+
+    # no merge: repeats survive, blanks still dropped
+    got2 = run_op("ctc_align", {"Input": inp},
+                  attrs={"blank": 0, "merge_repeated": False},
+                  outs=("Output", "OutLengths"))
+    np.testing.assert_array_equal(np.asarray(got2["OutLengths"]), [6, 6])
+    np.testing.assert_array_equal(np.asarray(got2["Output"])[0, :6],
+                                  [1, 1, 2, 2, 2, 3])
+
+    # lengths mask the tail
+    lens_in = np.array([4, 2], np.int32)
+    got3 = run_op("ctc_align", {"Input": inp, "Lengths": lens_in},
+                  attrs={"blank": 0, "merge_repeated": True},
+                  outs=("Output", "OutLengths"))
+    np.testing.assert_array_equal(np.asarray(got3["OutLengths"]), [1, 1])
+    np.testing.assert_array_equal(np.asarray(got3["Output"])[0, 0], 1)
+    np.testing.assert_array_equal(np.asarray(got3["Output"])[1, 0], 4)

@@ -663,6 +663,14 @@ COVERED_ELSEWHERE = {
     # round-2 small-op sweep: tests/test_small_ops.py
     "sigmoid_cross_entropy_with_logits", "uniform_random_batch_size_like",
     "gaussian_random_batch_size_like", "lod_reset",
+    # round-2 extra kernels: tests/test_extra_ops.py
+    "minus", "hinge_loss", "log_loss", "margin_rank_loss",
+    "modified_huber_loss", "squared_l2_distance", "squared_l2_norm",
+    "l1_norm", "proximal_gd", "proximal_adagrad", "positive_negative_pair",
+    "precision_recall", "max_pool2d_with_index", "unpool", "spp",
+    "ctc_align",
+    # beam_gather: tests/test_contrib_decoder.py
+    "beam_gather",
 }
 
 # covered directly in this file

@@ -1,6 +1,5 @@
 """Dump the top HLO ops by self time from the newest /tmp/jaxprof capture,
 plus a per-category rollup. Companion to tools/profile_bench.py."""
-import os, sys; sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import glob
 import json
 import sys

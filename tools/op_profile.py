@@ -1,4 +1,3 @@
-import os, sys; sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from xprof.convert import raw_to_tool_data as rtd
 import glob
 fs = glob.glob("/tmp/jaxprof/**/*.xplane.pb", recursive=True)
