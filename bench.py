@@ -189,6 +189,10 @@ def main():
                    "layers": N_LAYER, "d_model": D_MODEL},
     }
     if _os.environ.get("BENCH_RESNET", "1") == "1":
+        # flush the primary metric first: if the ResNet phase is killed
+        # (timeout through the TPU tunnel), the LM line is still the last
+        # complete JSON line on stdout for the driver to parse
+        print(json.dumps(result), flush=True)
         try:
             result["resnet50"] = bench_resnet(dev)
         except Exception as e:  # keep the primary metric even if rn fails

@@ -11,8 +11,8 @@ __all__ = ["deprecated"]
 
 def deprecated(since, instead, extra_message=""):
     """Mark an API deprecated since version ``since``; point callers at
-    ``instead``. Prints the notice once per call site like the reference
-    (which writes to stderr on every call)."""
+    ``instead``. The notice goes to stderr on every call, matching the
+    reference's behavior."""
 
     def decorator(func):
         err_msg = "API {0} is deprecated since {1}. Please use {2} instead.".format(

@@ -89,6 +89,7 @@ class Go:
         self._threads = []
         self._results = []
         self._errors = []
+        self._exited = False
         if fn is not None:
             self._spawn(fn, args, kwargs)
 
@@ -107,12 +108,17 @@ class Go:
         self._threads.append(t)
 
     def run(self, fn: Callable, *args, **kwargs):
+        if self._exited:
+            raise RuntimeError(
+                "Go.run() after the with-block exited: work queued here "
+                "would never start; call run() inside the block")
         self._pending.append((fn, args, kwargs))
 
     def __enter__(self):
         return self
 
     def __exit__(self, exc_type, exc, tb):
+        self._exited = True
         if exc_type is None:
             for fn, args, kwargs in self._pending:
                 self._spawn(fn, args, kwargs)
@@ -167,7 +173,13 @@ class Select:
     def run(self, poll_interval: float = 0.001, timeout: Optional[float] = None):
         """Poll cases until one fires; returns its callback's result.
         recv fires when a value (or close) is available; send fires when
-        buffer space is free."""
+        buffer space is free.
+
+        Single-selector assumption (both directions): this Select must be
+        the only consumer (for recv cases) / producer (for send cases) of
+        its channels. A competitor draining or filling a channel between
+        the readiness check and the blocking call makes that call block
+        past `timeout` (the underlying channel has no timed recv/send)."""
         if not self._cases and self._default is None:
             raise ValueError("Select has no cases")
         deadline = None if timeout is None else time.monotonic() + timeout

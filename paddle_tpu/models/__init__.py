@@ -14,3 +14,6 @@ from . import transformer  # noqa: F401
 from . import word2vec  # noqa: F401
 from . import deepfm  # noqa: F401
 from . import se_resnext  # noqa: F401
+from . import srl  # noqa: F401
+from . import seq2seq  # noqa: F401
+from . import recommender  # noqa: F401

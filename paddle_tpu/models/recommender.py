@@ -1,0 +1,102 @@
+"""Personalized recommendation on MovieLens (reference:
+python/paddle/fluid/tests/book/test_recommender_system.py).
+
+User tower: id/gender/age/job embeddings -> per-feature fc -> concat ->
+fc(tanh, 200). Movie tower: id embedding + category sum-pool + title
+conv-pool -> concat -> fc(tanh, 200). Rating prediction = 5 * cos_sim of
+the towers, squared-error loss. Dense divergence: the variable-length
+category and title sequences feed as padded (B, T) ids + lengths.
+"""
+from __future__ import annotations
+
+from .. import layers, nets
+from ..dataset import movielens
+
+EMB_SIZE = 32
+IS_SPARSE = True
+
+
+def get_usr_combined_features():
+    usr_dict_size = movielens.max_user_id() + 1
+    uid = layers.data(name="user_id", shape=[1], dtype="int64")
+    usr_emb = layers.embedding(
+        input=uid, dtype="float32", size=[usr_dict_size, EMB_SIZE],
+        param_attr="user_table", is_sparse=IS_SPARSE)
+    usr_fc = layers.fc(input=usr_emb, size=32)
+
+    usr_gender_id = layers.data(name="gender_id", shape=[1], dtype="int64")
+    usr_gender_emb = layers.embedding(
+        input=usr_gender_id, size=[2, 16], param_attr="gender_table",
+        is_sparse=IS_SPARSE)
+    usr_gender_fc = layers.fc(input=usr_gender_emb, size=16)
+
+    usr_age_id = layers.data(name="age_id", shape=[1], dtype="int64")
+    usr_age_emb = layers.embedding(
+        input=usr_age_id, size=[len(movielens.age_table), 16],
+        is_sparse=IS_SPARSE, param_attr="age_table")
+    usr_age_fc = layers.fc(input=usr_age_emb, size=16)
+
+    usr_job_id = layers.data(name="job_id", shape=[1], dtype="int64")
+    usr_job_emb = layers.embedding(
+        input=usr_job_id, size=[movielens.max_job_id() + 1, 16],
+        param_attr="job_table", is_sparse=IS_SPARSE)
+    usr_job_fc = layers.fc(input=usr_job_emb, size=16)
+
+    concat_embed = layers.concat(
+        input=[usr_fc, usr_gender_fc, usr_age_fc, usr_job_fc], axis=1)
+    return layers.fc(input=concat_embed, size=200, act="tanh")
+
+
+def get_mov_combined_features(category_len=8, title_len=12):
+    mov_dict_size = movielens.max_movie_id() + 1
+    mov_id = layers.data(name="movie_id", shape=[1], dtype="int64")
+    mov_emb = layers.embedding(
+        input=mov_id, dtype="float32", size=[mov_dict_size, EMB_SIZE],
+        param_attr="movie_table", is_sparse=IS_SPARSE)
+    mov_fc = layers.fc(input=mov_emb, size=32)
+
+    category_id = layers.data(name="category_id", shape=[category_len],
+                              dtype="int64")
+    category_lens = layers.data(name="category_lens", shape=[],
+                                dtype="int32")
+    mov_categories_emb = layers.embedding(
+        input=category_id, size=[len(movielens.movie_categories()), 32],
+        is_sparse=IS_SPARSE)
+    mov_categories_hidden = layers.sequence_pool(
+        input=mov_categories_emb, pool_type="sum",
+        sequence_length=category_lens)
+
+    mov_title_id = layers.data(name="movie_title", shape=[title_len],
+                               dtype="int64")
+    title_lens = layers.data(name="title_lens", shape=[], dtype="int32")
+    mov_title_emb = layers.embedding(
+        input=mov_title_id, size=[len(movielens.get_movie_title_dict()), 32],
+        is_sparse=IS_SPARSE)
+    mov_title_conv = nets.sequence_conv_pool(
+        input=mov_title_emb, num_filters=32, filter_size=3, act="tanh",
+        pool_type="sum", sequence_length=title_lens)
+
+    concat_embed = layers.concat(
+        input=[mov_fc, mov_categories_hidden, mov_title_conv], axis=1)
+    return layers.fc(input=concat_embed, size=200, act="tanh")
+
+
+def inference_program(category_len=8, title_len=12):
+    usr = get_usr_combined_features()
+    mov = get_mov_combined_features(category_len, title_len)
+    inference = layers.cos_sim(X=usr, Y=mov)
+    return layers.scale(x=inference, scale=5.0)
+
+
+def get_model(category_len=8, title_len=12):
+    """(avg_cost, scale_infer, feed_vars); feeds align with
+    dataset.movielens samples (categories/title padded + lengths)."""
+    scale_infer = inference_program(category_len, title_len)
+    label = layers.data(name="score", shape=[1], dtype="float32")
+    square_cost = layers.square_error_cost(input=scale_infer, label=label)
+    avg_cost = layers.mean(square_cost)
+    prog = avg_cost.block.program
+    feeds = [prog.global_block().var(n) for n in
+             ("user_id", "gender_id", "age_id", "job_id", "movie_id",
+              "category_id", "category_lens", "movie_title", "title_lens")]
+    return avg_cost, scale_infer, feeds + [label]

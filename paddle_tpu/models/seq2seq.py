@@ -1,0 +1,106 @@
+"""RNN encoder-decoder machine translation (reference:
+python/paddle/fluid/tests/book/test_machine_translation.py and
+test_rnn_encoder_decoder.py).
+
+Encoder: embedding -> fc(tanh) -> dynamic LSTM, last state as context.
+Training decoder: teacher-forced DynamicRNN through the contrib
+StateCell/TrainingDecoder API (the book's rnn.block() inlined loop and
+the contrib decoder express the same cell; building on contrib here
+exercises that surface end-to-end). Inference: contrib BeamSearchDecoder
+over dense (B, K) beams.
+
+The source/target embedding table is shared through the 'vemb' ParamAttr
+like the reference.
+"""
+from __future__ import annotations
+
+from .. import layers
+from ..contrib import BeamSearchDecoder, InitState, StateCell, TrainingDecoder
+from ..param_attr import ParamAttr
+
+
+def encoder(src_word_id, lengths, dict_size, word_dim=32, hidden_dim=32,
+            is_sparse=True):
+    """(B, T) source ids -> (B, hidden_dim) context: the LSTM runs at
+    gate width hidden_dim*4 (so its hidden state is hidden_dim wide) and
+    the last valid step is returned."""
+    src_embedding = layers.embedding(
+        input=src_word_id, size=[dict_size, word_dim], dtype="float32",
+        is_sparse=is_sparse, param_attr=ParamAttr(name="vemb"))
+    fc1 = layers.fc(input=src_embedding, size=hidden_dim * 4, act="tanh",
+                    num_flatten_dims=2)
+    lstm_hidden0, _ = layers.dynamic_lstm(
+        input=fc1, size=hidden_dim * 4, sequence_length=lengths)
+    return layers.sequence_last_step(input=lstm_hidden0,
+                                     sequence_length=lengths)
+
+
+def _make_cell(context, decoder_size):
+    """The book's decoder cell: state' = tanh(fc([word_emb, state]))."""
+    cell = StateCell(inputs={"x": None},
+                     states={"h": InitState(init=context)}, out_state="h")
+
+    @cell.state_updater
+    def updater(c):
+        c.set_state("h", layers.fc(
+            input=[c.get_input("x"), c.get_state("h")],
+            size=decoder_size, act="tanh"))
+
+    return cell
+
+
+def decoder_train(context, trg_word_id, dict_size, word_dim=32,
+                  decoder_size=32, is_sparse=True):
+    """Teacher-forced decode -> (B, T, dict_size) softmax scores."""
+    trg_embedding = layers.embedding(
+        input=trg_word_id, size=[dict_size, word_dim], dtype="float32",
+        is_sparse=is_sparse, param_attr=ParamAttr(name="vemb"))
+    decoder = TrainingDecoder(_make_cell(context, decoder_size))
+    with decoder.block():
+        current_word = decoder.step_input(trg_embedding)
+        decoder.state_cell.compute_state(inputs={"x": current_word})
+        current_score = layers.fc(
+            input=decoder.state_cell.get_state("h"),
+            size=dict_size, act="softmax")
+        decoder.state_cell.update_states()
+        decoder.output(current_score)
+    return decoder()
+
+
+def decoder_decode(context, init_ids, init_scores, dict_size, word_dim=32,
+                   decoder_size=32, beam_size=2, max_length=8, end_id=1,
+                   is_sparse=True):
+    """Beam-search decode -> (translation_ids (B,K,S), scores (B,K))."""
+    decoder = BeamSearchDecoder(
+        _make_cell(context, decoder_size), init_ids, init_scores,
+        target_dict_dim=dict_size, word_dim=word_dim,
+        topk_size=min(50, dict_size), sparse_emb=is_sparse,
+        max_len=max_length, beam_size=beam_size, end_id=end_id)
+    decoder.decode()
+    return decoder()
+
+
+def get_model(dict_size=30000, seq_len=16, word_dim=32, hidden_dim=32,
+              is_sparse=True):
+    """(avg_cost, None, feed_vars): training graph over dense padded
+    source/target batches (reference train_main)."""
+    src = layers.data(name="src_word_id", shape=[seq_len], dtype="int64")
+    src_len = layers.data(name="src_len", shape=[], dtype="int32")
+    trg = layers.data(name="target_language_word", shape=[seq_len],
+                      dtype="int64")
+    trg_len = layers.data(name="trg_len", shape=[], dtype="int32")
+    label = layers.data(name="target_language_next_word", shape=[seq_len],
+                        dtype="int64")
+
+    context = encoder(src, src_len, dict_size, word_dim, hidden_dim,
+                      is_sparse)
+    rnn_out = decoder_train(context, trg, dict_size, word_dim, hidden_dim,
+                            is_sparse)
+    cost = layers.reshape(
+        layers.cross_entropy(input=rnn_out, label=label, soft_label=False),
+        shape=[-1, seq_len])
+    # mask padded target positions before averaging
+    mask = layers.cast(layers.sequence_mask(trg_len, maxlen=seq_len),
+                       "float32")
+    avg_cost = layers.reduce_sum(cost * mask) / layers.reduce_sum(mask)
+    return avg_cost, None, [src, src_len, trg, trg_len, label]

@@ -1,0 +1,100 @@
+"""Semantic role labeling: the db_lstm model (reference:
+python/paddle/fluid/tests/book/test_label_semantic_roles.py:db_lstm).
+
+Eight feature streams (word, 5 context windows, predicate, predicate
+mark) embed, project, and sum into a `depth`-deep stack of alternating
+forward/backward LSTMs with direct edges; a linear-chain CRF scores the
+tag sequence. Dense (B, T) ids + a shared lengths tensor replace LoD;
+the word embedding is shared across the 6 word-derived streams through a
+named ParamAttr like the reference's 'emb' table.
+"""
+from __future__ import annotations
+
+from .. import layers
+from ..param_attr import ParamAttr
+
+WORD_SLOTS = ("word_data", "ctx_n2_data", "ctx_n1_data", "ctx_0_data",
+              "ctx_p1_data", "ctx_p2_data")
+
+
+def db_lstm(word, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, predicate, mark,
+            lengths, word_dict_len, pred_dict_len, label_dict_len,
+            mark_dict_len=2, word_dim=32, mark_dim=5, hidden_dim=512,
+            depth=8, embedding_name="emb", is_sparse=True):
+    """Returns (B, T, label_dict_len) emission scores."""
+    predicate_embedding = layers.embedding(
+        input=predicate, size=[pred_dict_len, word_dim], dtype="float32",
+        is_sparse=is_sparse, param_attr="vemb")
+    mark_embedding = layers.embedding(
+        input=mark, size=[mark_dict_len, mark_dim], dtype="float32",
+        is_sparse=is_sparse)
+
+    word_input = [word, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2]
+    emb_layers = [
+        layers.embedding(
+            input=x, size=[word_dict_len, word_dim],
+            param_attr=ParamAttr(name=embedding_name, trainable=False))
+        for x in word_input
+    ]
+    emb_layers.append(predicate_embedding)
+    emb_layers.append(mark_embedding)
+
+    hidden_0 = layers.sums(input=[
+        layers.fc(input=emb, size=hidden_dim, num_flatten_dims=2)
+        for emb in emb_layers
+    ])
+    lstm_0, _ = layers.dynamic_lstm(
+        input=hidden_0, size=hidden_dim, candidate_activation="relu",
+        gate_activation="sigmoid", cell_activation="sigmoid",
+        sequence_length=lengths)
+
+    # stack L-LSTM and R-LSTM with direct edges
+    input_tmp = [hidden_0, lstm_0]
+    for i in range(1, depth):
+        mix_hidden = layers.sums(input=[
+            layers.fc(input=input_tmp[0], size=hidden_dim,
+                      num_flatten_dims=2),
+            layers.fc(input=input_tmp[1], size=hidden_dim,
+                      num_flatten_dims=2),
+        ])
+        lstm, _ = layers.dynamic_lstm(
+            input=mix_hidden, size=hidden_dim, candidate_activation="relu",
+            gate_activation="sigmoid", cell_activation="sigmoid",
+            is_reverse=((i % 2) == 1), sequence_length=lengths)
+        input_tmp = [mix_hidden, lstm]
+
+    feature_out = layers.sums(input=[
+        layers.fc(input=input_tmp[0], size=label_dict_len, act="tanh",
+                  num_flatten_dims=2),
+        layers.fc(input=input_tmp[1], size=label_dict_len, act="tanh",
+                  num_flatten_dims=2),
+    ])
+    return feature_out
+
+
+def get_model(word_dict_len=4000, pred_dict_len=300, label_dict_len=59,
+              seq_len=40, word_dim=32, mark_dim=5, hidden_dim=512, depth=8):
+    """(avg_cost, crf_decode_path, feed_vars) for training scripts;
+    feed order matches dataset.conll05 samples + lengths + label."""
+    feeds = []
+    for name in WORD_SLOTS:
+        feeds.append(layers.data(name=name, shape=[seq_len], dtype="int64"))
+    predicate = layers.data(name="verb_data", shape=[seq_len], dtype="int64")
+    mark = layers.data(name="mark_data", shape=[seq_len], dtype="int64")
+    lengths = layers.data(name="lengths", shape=[], dtype="int32")
+    label = layers.data(name="target", shape=[seq_len], dtype="int64")
+
+    feature_out = db_lstm(
+        feeds[0], feeds[1], feeds[2], feeds[3], feeds[4], feeds[5],
+        predicate, mark, lengths, word_dict_len, pred_dict_len,
+        label_dict_len, word_dim=word_dim, mark_dim=mark_dim,
+        hidden_dim=hidden_dim, depth=depth)
+    crf_cost = layers.linear_chain_crf(
+        input=feature_out, label=label,
+        param_attr=ParamAttr(name="crfw", learning_rate=1.0),
+        sequence_length=lengths)
+    avg_cost = layers.mean(crf_cost)
+    crf_decode = layers.crf_decoding(
+        input=feature_out, param_attr=ParamAttr(name="crfw"),
+        sequence_length=lengths)
+    return avg_cost, crf_decode, feeds + [predicate, mark, lengths, label]

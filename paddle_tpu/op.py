@@ -42,17 +42,27 @@ class _EagerOp:
         self.inputs = inputs
         self.named = named  # slot -> scope var name (input OR output)
         self.attrs = attrs
+        self._out_slots = None  # fixed on first run
 
     def _split_named(self, scope):
         """String-bound slots: data in the scope means input, else the
-        slot names an output variable to create."""
+        slot names an output variable to create. The classification is
+        fixed on the first run — re-running the op against the same scope
+        must not reclassify its own (now data-holding) outputs as
+        inputs."""
         ins, outs = {}, {}
         for slot, name in self.named.items():
-            if scope is not None and scope.has_var(name) \
-                    and scope.find_var(name) is not None:
-                ins[slot] = scope.find_var(name)
+            if self._out_slots is not None:
+                is_out = slot in self._out_slots
             else:
+                is_out = not (scope is not None and scope.has_var(name)
+                              and scope.find_var(name) is not None)
+            if is_out:
                 outs[slot] = name
+            else:
+                ins[slot] = scope.find_var(name)
+        if self._out_slots is None:
+            self._out_slots = frozenset(outs)
         return ins, outs
 
     def run(self, scope=None, place=None, rng_seed: int = 0):

@@ -1,0 +1,150 @@
+"""Training-smoke tests for the remaining book-example models: SRL
+(db_lstm + CRF), RNN encoder-decoder seq2seq (contrib decoder), and the
+MovieLens recommender (reference tests/book/test_label_semantic_roles.py,
+test_machine_translation.py, test_recommender_system.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, optimizer
+from paddle_tpu.dataset import conll05, movielens
+
+
+def _pad(seqs, maxlen, pad=0):
+    out = np.full((len(seqs), maxlen), pad, np.int64)
+    lens = np.zeros(len(seqs), np.int32)
+    for i, s in enumerate(seqs):
+        s = list(s)[:maxlen]
+        out[i, :len(s)] = s
+        lens[i] = len(s)
+    return out, lens
+
+
+def _run_steps(prog, startup, feed, fetch, steps=4, seed=1):
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        return [float(np.asarray(exe.run(prog, feed=feed,
+                                         fetch_list=fetch)[0]))
+                for _ in range(steps)]
+
+
+def test_srl_model_trains():
+    from paddle_tpu.models import srl
+
+    seq_len = 12
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = startup.random_seed = 3
+    with fluid.program_guard(prog, startup):
+        with fluid.unique_name.guard():
+            avg_cost, crf_decode, feeds = srl.get_model(
+                word_dict_len=200, pred_dict_len=30, label_dict_len=9,
+                seq_len=seq_len, word_dim=8, mark_dim=4, hidden_dim=16,
+                depth=4)
+            optimizer.SGD(learning_rate=0.01).minimize(avg_cost)
+
+    # batch from the conll05 synthetic schema, padded
+    samples = []
+    for s in conll05.test()():
+        samples.append(s)
+        if len(samples) == 4:
+            break
+    feed = {}
+    names = ["word_data", "ctx_n2_data", "ctx_n1_data", "ctx_0_data",
+             "ctx_p1_data", "ctx_p2_data", "verb_data", "mark_data",
+             "target"]
+    for slot, name in zip(range(9), [*names[:6], names[6], names[7],
+                                     names[8]]):
+        vals = [np.asarray(s[slot]) % (200 if slot < 6 else
+                                       (30 if slot == 6 else
+                                        (2 if slot == 7 else 9)))
+                for s in samples]
+        arr, lens = _pad(vals, seq_len)
+        feed[name] = arr
+    feed["lengths"] = lens
+    losses = _run_steps(prog, startup, feed, [avg_cost], steps=5)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], "CRF cost did not decrease: %s" % losses
+
+
+def test_seq2seq_trains_and_decodes():
+    from paddle_tpu.models import seq2seq
+
+    V, T = 50, 8
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = startup.random_seed = 5
+    with fluid.program_guard(prog, startup):
+        with fluid.unique_name.guard():
+            avg_cost, _, feeds = seq2seq.get_model(
+                dict_size=V, seq_len=T, word_dim=12, hidden_dim=12)
+            optimizer.Adam(learning_rate=0.01).minimize(avg_cost)
+
+    r = np.random.RandomState(0)
+    src = r.randint(2, V, (4, T)).astype(np.int64)
+    trg = r.randint(2, V, (4, T)).astype(np.int64)
+    feed = {"src_word_id": src, "src_len": np.full(4, T, np.int32),
+            "target_language_word": trg,
+            "trg_len": np.array([T, T - 2, T, 5], np.int32),
+            "target_language_next_word": np.roll(trg, -1, axis=1)}
+    losses = _run_steps(prog, startup, feed, [avg_cost], steps=8)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+    # inference graph: encoder context -> beam decode
+    iprog, istartup = fluid.Program(), fluid.Program()
+    iprog.random_seed = istartup.random_seed = 5
+    with fluid.program_guard(iprog, istartup):
+        with fluid.unique_name.guard():
+            src_v = layers.data(name="src_word_id", shape=[T], dtype="int64")
+            len_v = layers.data(name="src_len", shape=[], dtype="int32")
+            init_ids = layers.data(name="init_ids", shape=[1], dtype="int64")
+            init_scores = layers.data(name="init_scores", shape=[1])
+            context = seq2seq.encoder(src_v, len_v, V, 12, 12)
+            ids, scores = seq2seq.decoder_decode(
+                context, init_ids, init_scores, V, word_dim=12,
+                decoder_size=12, beam_size=3, max_length=6)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(istartup)
+        ids_v, scores_v = exe.run(iprog, feed={
+            "src_word_id": src, "src_len": np.full(4, T, np.int32),
+            "init_ids": np.zeros((4, 1), np.int64),
+            "init_scores": np.zeros((4, 1), np.float32)},
+            fetch_list=[ids, scores])
+    assert np.asarray(ids_v).shape == (4, 3, 6)
+    assert np.asarray(scores_v).shape == (4, 3)
+
+
+def test_recommender_trains():
+    from paddle_tpu.models import recommender
+
+    CL, TL = 4, 6
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = startup.random_seed = 7
+    with fluid.program_guard(prog, startup):
+        with fluid.unique_name.guard():
+            avg_cost, scale_infer, feeds = recommender.get_model(
+                category_len=CL, title_len=TL)
+            optimizer.SGD(learning_rate=0.05).minimize(avg_cost)
+
+    batch = []
+    for s in movielens.train()():
+        batch.append(s)
+        if len(batch) == 8:
+            break
+    cat, cat_lens = _pad([s[5] for s in batch], CL)
+    tit, tit_lens = _pad([s[6] for s in batch], TL)
+    feed = {
+        "user_id": np.array([[s[0]] for s in batch], np.int64),
+        "gender_id": np.array([[s[1]] for s in batch], np.int64),
+        "age_id": np.array([[s[2]] for s in batch], np.int64),
+        "job_id": np.array([[s[3]] for s in batch], np.int64),
+        "movie_id": np.array([[s[4]] for s in batch], np.int64),
+        "category_id": cat, "category_lens": cat_lens,
+        "movie_title": tit, "title_lens": tit_lens,
+        "score": np.array([[s[7]] for s in batch], np.float32),
+    }
+    losses = _run_steps(prog, startup, feed, [avg_cost], steps=6)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]

@@ -125,9 +125,16 @@ def test_operator_factory():
     # reference-style scope-name inputs: X names a var holding data,
     # Out names a fresh output var
     scope.set_var("xin", np.arange(3, dtype=np.float32))
-    Operator("scale", X="xin", Out="yout", scale=3.0).run(scope=scope)
+    op3 = Operator("scale", X="xin", Out="yout", scale=3.0)
+    op3.run(scope=scope)
     np.testing.assert_allclose(np.asarray(scope.find_var("yout")),
                                np.arange(3) * 3.0)
+    # re-running keeps 'yout' classified as the output (it now holds
+    # data, which must not flip it into an input)
+    scope.set_var("xin", np.arange(3, dtype=np.float32) + 1)
+    op3.run(scope=scope)
+    np.testing.assert_allclose(np.asarray(scope.find_var("yout")),
+                               (np.arange(3) + 1) * 3.0)
     with pytest.raises(ValueError):
         Operator("not_a_real_op", X=np.ones(1))
 
@@ -163,6 +170,9 @@ def test_concurrency_go_block_and_select():
     with fluid.Go() as g:
         g.run(lambda: fluid.channel_send(ch, 42))
     g.join(timeout=10)
+    # queuing work after the block exited would never run: refuse it
+    with pytest.raises(RuntimeError):
+        g.run(lambda: None)
 
     hits = []
     sel = fluid.Select()
