@@ -104,6 +104,9 @@ from .lod_tensor import create_lod_tensor, create_random_int_lodtensor  # noqa: 
 
 from . import annotations  # noqa: F401
 from . import average  # noqa: F401
+from . import core  # noqa: F401  (fluid.core compat shim)
+from . import inferencer  # noqa: F401
+from . import parallel_executor  # noqa: F401
 from .framework.scope import CUDAPinnedPlace  # noqa: F401  (pinned host mem -> plain host mem on TPU)
 from .lod_tensor import SequenceTensor as LoDTensor  # noqa: F401  (dense+lengths stand-in)
 from .layers import learning_rate_scheduler as learning_rate_decay  # noqa: F401
@@ -122,6 +125,7 @@ from . import graphviz  # noqa: F401
 from . import net_drawer  # noqa: F401
 from . import op  # noqa: F401
 from . import recordio_writer  # noqa: F401
+from .runtime.recordio import recordio_convert, recordio_sample_reader  # noqa: F401
 
 # operator sugar on Variable (x + y, x * 0.5, ...) — reference
 # layers/math_op_patch.py applies this at fluid import time too

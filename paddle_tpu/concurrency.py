@@ -89,7 +89,7 @@ class Go:
         self._threads = []
         self._results = []
         self._errors = []
-        self._exited = False
+        self._in_block = False
         if fn is not None:
             self._spawn(fn, args, kwargs)
 
@@ -108,17 +108,21 @@ class Go:
         self._threads.append(t)
 
     def run(self, fn: Callable, *args, **kwargs):
-        if self._exited:
-            raise RuntimeError(
-                "Go.run() after the with-block exited: work queued here "
-                "would never start; call run() inside the block")
-        self._pending.append((fn, args, kwargs))
+        """Inside the with-block: queue `fn`, launched together on block
+        exit (the reference's Go-block shape). Outside any block: launch
+        immediately (a bare go statement) — nothing is ever silently
+        queued without a block exit to drain it."""
+        if self._in_block:
+            self._pending.append((fn, args, kwargs))
+        else:
+            self._spawn(fn, args, kwargs)
 
     def __enter__(self):
+        self._in_block = True
         return self
 
     def __exit__(self, exc_type, exc, tb):
-        self._exited = True
+        self._in_block = False
         if exc_type is None:
             for fn, args, kwargs in self._pending:
                 self._spawn(fn, args, kwargs)

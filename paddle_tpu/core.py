@@ -1,0 +1,63 @@
+"""Compatibility shim for ``fluid.core`` (reference: the pybind C++
+extension ``paddle.fluid.core``).
+
+There is no C++ graph core here — the IR is Python and the compute is
+XLA — but reference scripts routinely touch ``fluid.core`` for places,
+scopes, dtype enums, and op protos. This module maps those names onto
+their paddle_tpu equivalents. ``VarDesc.VarType`` members ARE the dtype
+strings the framework uses, so ``var.dtype == core.VarDesc.VarType.FP32``
+works both ways.
+"""
+from __future__ import annotations
+
+from .framework.scope import (  # noqa: F401
+    CPUPlace,
+    CUDAPinnedPlace,
+    CUDAPlace,
+    Scope,
+    TPUPlace,
+)
+from .io.reader import EOFException  # noqa: F401
+from .ops.registry import op_support_tpu  # noqa: F401
+from .runtime.recordio import Channel, RecordIOReader, RecordIOWriter  # noqa: F401
+
+__all__ = [
+    "CPUPlace", "TPUPlace", "CUDAPlace", "CUDAPinnedPlace", "Scope",
+    "EOFException", "VarDesc", "get_all_op_protos", "op_support_gpu",
+    "op_support_tpu", "RecordIOWriter", "RecordIOReader", "Channel",
+]
+
+
+class VarDesc:
+    """Reference framework.proto VarDesc enum shim. Members are the
+    framework's canonical dtype strings (dtypes.py), so equality against
+    ``Variable.dtype`` just works."""
+
+    class VarType:
+        BOOL = "bool"
+        INT8 = "int8"
+        UINT8 = "uint8"
+        INT16 = "int16"
+        INT32 = "int32"
+        INT64 = "int64"
+        FP16 = "float16"
+        BF16 = "bfloat16"
+        FP32 = "float32"
+        FP64 = "float64"
+        # container kinds (reference VarType also enumerates these)
+        LOD_TENSOR = "lod_tensor"
+        SELECTED_ROWS = "selected_rows"
+        LOD_TENSOR_ARRAY = "tensor_array"
+        READER = "reader"
+
+
+def get_all_op_protos():
+    from .op import get_all_op_protos as _g
+
+    return _g()
+
+
+def op_support_gpu(op_type: str) -> bool:
+    """The accelerator here is a TPU; reference scripts asking about GPU
+    support get the TPU answer (can this op run on the accelerator)."""
+    return op_support_tpu(op_type)

@@ -1,0 +1,6 @@
+"""Module alias for the high-level Inferencer (reference:
+python/paddle/fluid/inferencer.py; the class lives in trainer.py here,
+mirroring how the reference pairs them)."""
+from .trainer import Inferencer  # noqa: F401
+
+__all__ = ["Inferencer"]

@@ -49,13 +49,20 @@ class _EagerOp:
         slot names an output variable to create. The classification is
         fixed on the first run — re-running the op against the same scope
         must not reclassify its own (now data-holding) outputs as
-        inputs."""
+        inputs. Named slots require a scope: without one there is nothing
+        to resolve the names against (and a scope-less first run would
+        freeze every slot as an output)."""
+        if self.named and scope is None:
+            raise ValueError(
+                "Operator %r binds slots to scope variable names %s; "
+                "run(scope=...) is required"
+                % (self.type, sorted(self.named.values())))
         ins, outs = {}, {}
         for slot, name in self.named.items():
             if self._out_slots is not None:
                 is_out = slot in self._out_slots
             else:
-                is_out = not (scope is not None and scope.has_var(name)
+                is_out = not (scope.has_var(name)
                               and scope.find_var(name) is not None)
             if is_out:
                 outs[slot] = name

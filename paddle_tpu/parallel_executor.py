@@ -1,0 +1,6 @@
+"""Module alias for ParallelExecutor (reference:
+python/paddle/fluid/parallel_executor.py; the implementation lives in
+parallel/parallel_executor.py here)."""
+from .parallel import BuildStrategy, ExecutionStrategy, ParallelExecutor  # noqa: F401
+
+__all__ = ["ParallelExecutor", "ExecutionStrategy", "BuildStrategy"]

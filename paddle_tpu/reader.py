@@ -36,6 +36,7 @@ __all__ = [
     "ComposeNotAligned",
     "firstn",
     "xmap_readers",
+    "PipeReader",
     "cache",
     "batch",
 ]
@@ -288,3 +289,55 @@ def batch(reader, batch_size, drop_last=False):
             yield b
 
     return batch_reader
+
+
+class PipeReader:
+    """Stream records from a shell command's stdout (reference:
+    python/paddle/reader/decorator.py:PipeReader) — the escape hatch for
+    data living behind CLI tools (object stores, HDFS cat, curl). The
+    "gzip" file_type transparently inflates the stream."""
+
+    def __init__(self, command, bufsize: int = 8192, file_type: str = "plain"):
+        import subprocess
+        import zlib
+
+        if not isinstance(command, str):
+            raise TypeError("command must be a string")
+        if file_type not in ("plain", "gzip"):
+            raise TypeError("file_type %s is not allowed" % file_type)
+        if file_type == "gzip":
+            # wbits offset 32: auto-detect the gzip header
+            self.dec = zlib.decompressobj(32 + zlib.MAX_WBITS)
+        self.file_type = file_type
+        self.bufsize = bufsize
+        self.process = subprocess.Popen(
+            command.split(" "), bufsize=bufsize, stdout=subprocess.PIPE)
+
+    def get_line(self, cut_lines: bool = True, line_break: str = "\n"):
+        """Yield decoded lines (or raw buffers with cut_lines=False).
+        Decoding is incremental so a multi-byte UTF-8 character split
+        across read() chunks survives (the reference decodes chunkwise
+        and dies on that boundary)."""
+        import codecs
+
+        decoder = codecs.getincrementaldecoder("utf-8")()
+        remained = ""
+        while True:
+            buff = self.process.stdout.read(self.bufsize)
+            final = not buff
+            if self.file_type == "gzip":
+                raw = self.dec.decompress(buff) if buff else self.dec.flush()
+            else:
+                raw = buff or b""
+            decomp_buff = decoder.decode(raw, final)
+            if cut_lines:
+                lines = (remained + decomp_buff).split(line_break)
+                remained = lines.pop()  # tail without a terminator yet
+                for line in lines:
+                    yield line
+            elif decomp_buff:
+                yield decomp_buff
+            if final:
+                break
+        if remained:
+            yield remained

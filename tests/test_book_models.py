@@ -53,8 +53,7 @@ def test_srl_model_trains():
     names = ["word_data", "ctx_n2_data", "ctx_n1_data", "ctx_0_data",
              "ctx_p1_data", "ctx_p2_data", "verb_data", "mark_data",
              "target"]
-    for slot, name in zip(range(9), [*names[:6], names[6], names[7],
-                                     names[8]]):
+    for slot, name in enumerate(names):
         vals = [np.asarray(s[slot]) % (200 if slot < 6 else
                                        (30 if slot == 6 else
                                         (2 if slot == 7 else 9)))

@@ -170,9 +170,11 @@ def test_concurrency_go_block_and_select():
     with fluid.Go() as g:
         g.run(lambda: fluid.channel_send(ch, 42))
     g.join(timeout=10)
-    # queuing work after the block exited would never run: refuse it
-    with pytest.raises(RuntimeError):
-        g.run(lambda: None)
+    # run() outside a block launches immediately (never silently queued)
+    marker = []
+    g.run(lambda: marker.append(1))
+    g.join(timeout=10)
+    assert marker == [1]
 
     hits = []
     sel = fluid.Select()
@@ -240,3 +242,62 @@ def test_new_datasets():
     a = next(mq2007.train(format="pointwise")())[1]
     b = next(mq2007.train(format="pointwise")())[1]
     np.testing.assert_array_equal(a, b)
+
+
+def test_core_shim():
+    from paddle_tpu import core
+
+    assert core.VarDesc.VarType.FP32 == "float32"
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        v = layers.data(name="cv", shape=[4])
+    assert v.dtype == core.VarDesc.VarType.FP32
+    assert isinstance(core.CPUPlace(), fluid.CPUPlace)
+    assert core.op_support_gpu("matmul")
+    assert len(core.get_all_op_protos()) > 150
+    # module aliases mirror the reference layout
+    from paddle_tpu.inferencer import Inferencer
+    from paddle_tpu.parallel_executor import ParallelExecutor
+    assert Inferencer is fluid.Inferencer
+    assert ParallelExecutor is fluid.ParallelExecutor
+
+
+def test_pipe_reader(tmp_path):
+    import gzip
+
+    from paddle_tpu.reader import PipeReader
+
+    p = tmp_path / "data.txt"
+    p.write_text("a 1\nb 2\nc 3\n")
+    pr = PipeReader("cat %s" % p)
+    assert [l.split() for l in pr.get_line()] == [
+        ["a", "1"], ["b", "2"], ["c", "3"]]
+
+    gz = tmp_path / "data.gz"
+    with gzip.open(gz, "wt") as f:
+        f.write("x\ny\n")
+    pr2 = PipeReader("cat %s" % gz, file_type="gzip")
+    assert list(pr2.get_line()) == ["x", "y"]
+
+    with pytest.raises(TypeError):
+        PipeReader(["cat"])
+    with pytest.raises(TypeError):
+        PipeReader("cat x", file_type="bzip2")
+
+
+def test_pipe_reader_multibyte_boundary(tmp_path):
+    from paddle_tpu.reader import PipeReader
+
+    # é is 2 bytes in UTF-8; bufsize=3 forces a split mid-character
+    p = tmp_path / "uni.txt"
+    p.write_text("ééé\nzz\n", encoding="utf-8")
+    pr = PipeReader("cat %s" % p, bufsize=3)
+    assert list(pr.get_line()) == ["ééé", "zz"]
+
+
+def test_operator_factory_named_requires_scope():
+    from paddle_tpu.op import Operator
+
+    op = Operator("scale", X="xin", Out="yout", scale=2.0)
+    with pytest.raises(ValueError):
+        op.run()  # named slots without a scope

@@ -1,0 +1,315 @@
+"""Static-vs-runtime shape consistency sweep over the layers API.
+
+Every layer wrapper declares its output Variable's static shape by hand;
+a mismatch against the traced array breaks downstream shape-dependent
+layers (reshape, fc, detection chains — see the detection_output keep_k
+fix). This sweep builds a representative call of each shape-computing
+layer, runs it, and asserts that every non-dynamic (-1) static dim
+matches the runtime dim exactly.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, nets
+
+
+def _run_case(build):
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = startup.random_seed = 1
+    with fluid.program_guard(prog, startup):
+        with fluid.unique_name.guard():
+            outs, feed = build()
+    outs = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        vals = exe.run(prog, feed=feed, fetch_list=outs)
+    for var, val in zip(outs, vals):
+        static = tuple(var.shape or ())
+        actual = np.asarray(val).shape
+        assert len(static) == len(actual), (
+            "%s: static rank %s != runtime rank %s"
+            % (var.name, static, actual))
+        for s, a in zip(static, actual):
+            assert s in (-1, a), (
+                "%s: static shape %s vs runtime %s"
+                % (var.name, static, actual))
+
+
+def _img(name="x", b=2, c=3, h=8, w=8):
+    var = layers.data(name=name, shape=[b, c, h, w], append_batch_size=False)
+    feed = {name: np.random.RandomState(0).randn(b, c, h, w).astype(np.float32)}
+    return var, feed
+
+
+def _mat(name="x", b=4, d=6):
+    var = layers.data(name=name, shape=[b, d], append_batch_size=False)
+    feed = {name: np.random.RandomState(1).randn(b, d).astype(np.float32)}
+    return var, feed
+
+
+def _seq(name="s", b=2, t=6, d=4):
+    var = layers.data(name=name, shape=[b, t, d], append_batch_size=False)
+    feed = {name: np.random.RandomState(2).randn(b, t, d).astype(np.float32)}
+    return var, feed
+
+
+CASES = {}
+
+
+def case(fn):
+    CASES[fn.__name__[len("build_"):]] = fn
+    return fn
+
+
+@case
+def build_fc_flatten2():
+    x, feed = _seq()
+    return layers.fc(x, 10, num_flatten_dims=2), feed
+
+
+@case
+def build_conv2d_padded():
+    x, feed = _img()
+    return layers.conv2d(x, num_filters=5, filter_size=3, stride=2,
+                         padding=1), feed
+
+
+@case
+def build_conv2d_transpose():
+    x, feed = _img()
+    return layers.conv2d_transpose(x, num_filters=4, filter_size=4,
+                                   stride=2, padding=1), feed
+
+
+@case
+def build_conv3d():
+    x = layers.data(name="v", shape=[2, 3, 4, 6, 6], append_batch_size=False)
+    feed = {"v": np.zeros((2, 3, 4, 6, 6), np.float32)}
+    return layers.conv3d(x, num_filters=4, filter_size=3, padding=1), feed
+
+
+@case
+def build_pool2d_ceil():
+    x, feed = _img(h=7, w=7)
+    return layers.pool2d(x, pool_size=2, pool_stride=2, pool_type="avg"), feed
+
+
+@case
+def build_maxout():
+    x, feed = _img(c=6)
+    return layers.maxout(x, groups=3), feed
+
+
+@case
+def build_im2sequence():
+    x, feed = _img(c=1)
+    return layers.im2sequence(x, filter_size=2, stride=2), feed
+
+
+@case
+def build_roi_pool():
+    x, feed = _img(b=1)
+    rois = layers.data(name="rois", shape=[3, 5], append_batch_size=False)
+    feed["rois"] = np.array([[0, 0, 0, 4, 4], [0, 1, 1, 6, 6],
+                             [0, 2, 2, 7, 7]], np.float32)
+    return layers.roi_pool(x, rois, pooled_height=2, pooled_width=2), feed
+
+
+@case
+def build_image_resize():
+    x, feed = _img()
+    return layers.image_resize(x, out_shape=[12, 16]), feed
+
+
+@case
+def build_row_conv():
+    x, feed = _seq()
+    return layers.row_conv(x, future_context_size=2), feed
+
+
+@case
+def build_conv_shift():
+    x, feed = _mat(d=8)
+    y = layers.data(name="y", shape=[4, 3], append_batch_size=False)
+    feed["y"] = np.random.RandomState(3).randn(4, 3).astype(np.float32)
+    return layers.conv_shift(x, y), feed
+
+
+@case
+def build_bilinear_tensor_product():
+    x, feed = _mat(d=5)
+    y = layers.data(name="y2", shape=[4, 3], append_batch_size=False)
+    feed["y2"] = np.random.RandomState(4).randn(4, 3).astype(np.float32)
+    return layers.bilinear_tensor_product(x, y, size=7), feed
+
+
+@case
+def build_sequence_conv_pool():
+    x, feed = _seq()
+    return nets.sequence_conv_pool(x, num_filters=5, filter_size=3), feed
+
+
+@case
+def build_topk():
+    x, feed = _mat(d=9)
+    vals, idx = layers.topk(x, k=3)
+    return [vals, idx], feed
+
+
+@case
+def build_one_hot():
+    x = layers.data(name="ids", shape=[4, 1], dtype="int64",
+                    append_batch_size=False)
+    feed = {"ids": np.array([[0], [2], [1], [3]], np.int64)}
+    return layers.one_hot(x, depth=5), feed
+
+
+@case
+def build_multiplex():
+    a, feed = _mat(name="a")
+    bvar = layers.data(name="b", shape=[4, 6], append_batch_size=False)
+    feed["b"] = np.ones((4, 6), np.float32)
+    idx = layers.data(name="idx", shape=[4, 1], dtype="int32",
+                      append_batch_size=False)
+    feed["idx"] = np.array([[0], [1], [0], [1]], np.int32)
+    return layers.multiplex([a, bvar], idx), feed
+
+
+@case
+def build_reduce_keepdim():
+    x, feed = _seq()
+    return [layers.reduce_sum(x, dim=1, keep_dim=True),
+            layers.reduce_mean(x, dim=[1, 2]),
+            layers.reduce_max(x, dim=-1)], feed
+
+
+@case
+def build_split_stack_unstack():
+    x, feed = _seq(t=6)
+    parts = layers.split(x, num_or_sections=3, dim=1)
+    stacked = layers.stack(parts, axis=0)
+    return [parts[0], stacked] + layers.unstack(stacked, axis=0), feed
+
+
+@case
+def build_squeeze_unsqueeze_flatten():
+    x = layers.data(name="q", shape=[2, 1, 5], append_batch_size=False)
+    feed = {"q": np.zeros((2, 1, 5), np.float32)}
+    return [layers.squeeze(x, axes=[1]), layers.unsqueeze(x, axes=[0]),
+            layers.flatten(x, axis=2)], feed
+
+
+@case
+def build_crop_pad():
+    x, feed = _img()
+    crop = layers.crop(x, shape=[2, 3, 4, 4])
+    pad = layers.pad(x, paddings=[0, 0, 0, 0, 1, 1, 2, 2])
+    return [crop, pad], feed
+
+
+@case
+def build_lrn_norm():
+    x, feed = _img()
+    return [layers.lrn(x, n=3), layers.l2_normalize(x, axis=1)], feed
+
+
+@case
+def build_batch_and_layer_norm():
+    x, feed = _img()
+    return [layers.batch_norm(x), layers.layer_norm(x)], feed
+
+
+@case
+def build_matmul_transpose():
+    x, feed = _seq(d=4)
+    y = layers.data(name="m", shape=[2, 6, 5], append_batch_size=False)
+    feed["m"] = np.zeros((2, 6, 5), np.float32)
+    return layers.matmul(x, y, transpose_x=True), feed
+
+
+@case
+def build_sequence_ops():
+    x, feed = _seq()
+    lens = layers.data(name="lens", shape=[], dtype="int32")
+    feed["lens"] = np.array([6, 3], np.int32)
+    # sequence_softmax scores one scalar per timestep (reference takes a
+    # (sum_len, 1) LoD tensor), so it gets a (B, T) input
+    scores = layers.data(name="scores", shape=[2, 6],
+                         append_batch_size=False)
+    feed["scores"] = np.random.RandomState(7).randn(2, 6).astype(np.float32)
+    return [layers.sequence_pool(x, "max", sequence_length=lens),
+            layers.sequence_first_step(x, sequence_length=lens),
+            layers.sequence_softmax(scores, sequence_length=lens),
+            layers.sequence_reshape(x, new_dim=8)], feed
+
+
+@case
+def build_embedding_3d():
+    ids = layers.data(name="tok", shape=[2, 7], dtype="int64",
+                      append_batch_size=False)
+    feed = {"tok": np.zeros((2, 7), np.int64)}
+    return layers.embedding(ids, size=[11, 6]), feed
+
+
+@case
+def build_gru_lstm():
+    x, feed = _seq(d=12)
+    lens = layers.data(name="lens", shape=[], dtype="int32")
+    feed["lens"] = np.array([6, 4], np.int32)
+    h, c = layers.dynamic_lstm(x, size=12, sequence_length=lens)
+    g = layers.dynamic_gru(layers.fc(x, 9, num_flatten_dims=2), size=3,
+                           sequence_length=lens)
+    return [h, c, g], feed
+
+
+@case
+def build_prior_box():
+    img = layers.data(name="im", shape=[2, 3, 32, 32],
+                      append_batch_size=False)
+    x, feed = _img(name="fm", h=4, w=4)
+    feed["im"] = np.zeros((2, 3, 32, 32), np.float32)
+    box, var = layers.prior_box(x, img, min_sizes=[8.0], max_sizes=[16.0],
+                                aspect_ratios=[1.0, 2.0])
+    return [box, var], feed
+
+
+@case
+def build_box_coder():
+    pb = layers.data(name="pb", shape=[5, 4], append_batch_size=False)
+    pbv = layers.data(name="pbv", shape=[5, 4], append_batch_size=False)
+    tb = layers.data(name="tb", shape=[2, 5, 4], append_batch_size=False)
+    feed = {"pb": np.random.RandomState(5).rand(5, 4).astype(np.float32),
+            "pbv": np.full((5, 4), 0.1, np.float32),
+            "tb": np.random.RandomState(6).rand(2, 5, 4).astype(np.float32)}
+    return layers.box_coder(pb, pbv, tb,
+                            code_type="decode_center_size"), feed
+
+
+@case
+def build_anchor_generator():
+    x, feed = _img(h=4, w=4)
+    anchors, vars_ = layers.anchor_generator(
+        x, anchor_sizes=[32.0, 64.0], aspect_ratios=[0.5, 1.0],
+        stride=[8.0, 8.0])
+    return [anchors, vars_], feed
+
+
+@case
+def build_argmax_argsort():
+    x, feed = _mat()
+    s, idx = layers.argsort(x, axis=1)
+    return [layers.argmax(x, axis=1), layers.argmin(x, axis=0), s, idx], feed
+
+
+@case
+def build_shape_and_cast():
+    x, feed = _mat()
+    return [layers.shape(x), layers.cast(x, "int32")], feed
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_layer_shape_consistency(name):
+    _run_case(CASES[name])
