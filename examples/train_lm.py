@@ -1,0 +1,88 @@
+"""Train the flagship decoder-only LM (single chip or a multi-chip mesh).
+
+Run:
+  python examples/train_lm.py                       # single device
+  python examples/train_lm.py --mesh dp=2,mp=4      # 8-chip tensor parallel
+  python examples/train_lm.py --mesh dp=1,sp=8 --ring --seq 8192  # long ctx
+
+On CPU smoke-test with:
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/train_lm.py --mesh dp=2,mp=4 --layers 2 --d-model 128 \
+      --seq 256 --steps 3
+"""
+import argparse
+import time
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, models, optimizer
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--vocab", type=int, default=32768)
+    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--d-model", type=int, default=1024)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--mesh", type=str, default=None,
+                    help="axis=size pairs, e.g. dp=2,mp=4")
+    ap.add_argument("--ring", action="store_true",
+                    help="sequence-parallel ring attention")
+    ap.add_argument("--amp", action="store_true", default=True)
+    args = ap.parse_args()
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup.random_seed = 1
+    with fluid.program_guard(main_p, startup):
+        with fluid.unique_name.guard():
+            ids = layers.data(name="ids", shape=[args.batch, args.seq],
+                              dtype="int64", append_batch_size=False)
+            labels = layers.data(name="labels", shape=[args.batch, args.seq],
+                                 dtype="int64", append_batch_size=False)
+            loss, _ = models.transformer.transformer_lm(
+                ids, labels, vocab_size=args.vocab, n_layer=args.layers,
+                n_head=16, d_model=args.d_model, d_inner=4 * args.d_model,
+                max_len=args.seq, use_ring_attention=args.ring)
+            optimizer.Adam(learning_rate=1e-4).minimize(loss)
+        if args.amp:
+            main_p.enable_mixed_precision()
+
+    r = np.random.RandomState(0)
+    feed = {
+        "ids": r.randint(0, args.vocab, (args.batch, args.seq), np.int64),
+        "labels": r.randint(0, args.vocab, (args.batch, args.seq), np.int64),
+    }
+
+    fluid.Executor().run(startup)  # init params in the global scope
+    if args.mesh:
+        from paddle_tpu.parallel import (ParallelExecutor, make_mesh,
+                                         megatron_transformer_plan,
+                                         seq_parallel_plan)
+
+        axes = dict(kv.split("=") for kv in args.mesh.split(","))
+        mesh = make_mesh([int(v) for v in axes.values()], tuple(axes))
+        plan = seq_parallel_plan(mesh) if args.ring \
+            else megatron_transformer_plan(mesh)
+        pexe = ParallelExecutor(loss_name=loss.name, main_program=main_p,
+                                mesh=mesh, plan=plan)
+        run = lambda fetch: pexe.run(feed=feed, fetch_list=fetch)
+    else:
+        sexe = fluid.Executor(fluid.TPUPlace())
+        run = lambda fetch: sexe.run(main_p, feed=feed, fetch_list=fetch)
+
+    run([loss])  # compile + step 0
+    t0 = time.perf_counter()
+    for _ in range(args.steps - 1):
+        run([])
+    out = run([loss])
+    dt = (time.perf_counter() - t0) / args.steps
+    toks = args.batch * args.seq / dt
+    print("loss %.4f  |  %.0f tokens/s  |  %.1f ms/step"
+          % (float(np.asarray(out[0]).reshape(-1)[0]), toks, dt * 1e3))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""Train the MNIST conv net end-to-end (reference:
+python/paddle/fluid/tests/book/test_recognize_digits.py).
+
+Run: python examples/train_mnist.py [--epochs 1] [--batch-size 64]
+"""
+import argparse
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, optimizer
+from paddle_tpu.dataset import mnist
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    img = layers.data(name="img", shape=[1, 28, 28])
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    from paddle_tpu.models.mnist import cnn_model
+
+    predict = cnn_model(img)
+    loss = layers.mean(layers.cross_entropy(input=predict, label=label))
+    acc = layers.accuracy(input=predict, label=label)
+    test_program = fluid.default_main_program().clone(for_test=True)
+    optimizer.Adam(learning_rate=1e-3).minimize(loss)
+
+    place = fluid.CPUPlace() if args.cpu else fluid.TPUPlace()
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    feeder = fluid.DataFeeder(feed_list=[img, label], place=place)
+
+    train_reader = fluid.batch(mnist.train(), batch_size=args.batch_size,
+                               drop_last=True)
+    test_reader = fluid.batch(mnist.test(), batch_size=args.batch_size,
+                              drop_last=True)
+    for epoch in range(args.epochs):
+        for step, batch in enumerate(train_reader()):
+            l, a = exe.run(feed=feeder.feed(batch), fetch_list=[loss, acc])
+            if step % 50 == 0:
+                print("epoch %d step %d loss %.4f acc %.3f"
+                      % (epoch, step, float(np.asarray(l)),
+                         float(np.asarray(a))))
+        accs = [float(np.asarray(exe.run(test_program,
+                                         feed=feeder.feed(b),
+                                         fetch_list=[acc])[0]))
+                for b in test_reader()]
+        print("epoch %d test acc %.3f" % (epoch, float(np.mean(accs))))
+
+
+if __name__ == "__main__":
+    main()

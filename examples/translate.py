@@ -1,0 +1,98 @@
+"""Machine translation with the RNN encoder-decoder: train on synthetic
+WMT-style pairs, then beam-decode a batch (reference:
+python/paddle/fluid/tests/book/test_machine_translation.py).
+
+Run: python examples/translate.py [--steps 50] [--beam 3] [--cpu]
+"""
+import argparse
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, optimizer
+from paddle_tpu.models import seq2seq
+
+DICT, SEQ, WD, H = 50, 16, 32, 32
+BOS, EOS = 0, 1
+
+
+def synth_batch(r, n):
+    """Learnable toy language: the target counts up from the source's
+    LAST token (which the encoder's final state carries), so step t
+    depends on the context vector (t=0) and the previous target token
+    (t>0) — exactly what the encoder-decoder wiring provides."""
+    src = r.randint(2, DICT, (n, SEQ)).astype(np.int64)
+    t = np.arange(SEQ)
+    trg_out = (src[:, -1:] + 1 + t[None, :] - 2) % (DICT - 2) + 2
+    trg_in = np.concatenate([np.full((n, 1), BOS, np.int64),
+                             trg_out[:, :-1]], axis=1)
+    return src, trg_in, trg_out.astype(np.int64)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--beam", type=int, default=3)
+    ap.add_argument("--max-len", type=int, default=SEQ)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+    place = fluid.CPUPlace() if args.cpu else fluid.TPUPlace()
+
+    # training graph
+    train_p, startup = fluid.Program(), fluid.Program()
+    train_p.random_seed = startup.random_seed = 1
+    with fluid.program_guard(train_p, startup):
+        with fluid.unique_name.guard():
+            avg_cost, _, _ = seq2seq.get_model(
+                dict_size=DICT, seq_len=SEQ, word_dim=WD, hidden_dim=H)
+            optimizer.Adam(learning_rate=2e-3).minimize(avg_cost)
+
+    # inference graph sharing parameter names (same scope)
+    infer_p, infer_startup = fluid.Program(), fluid.Program()
+    infer_p.random_seed = infer_startup.random_seed = 1
+    with fluid.program_guard(infer_p, infer_startup):
+        with fluid.unique_name.guard():
+            src_v = layers.data(name="src_word_id", shape=[SEQ],
+                                dtype="int64")
+            len_v = layers.data(name="src_len", shape=[], dtype="int32")
+            init_ids = layers.data(name="init_ids", shape=[1], dtype="int64")
+            init_scores = layers.data(name="init_scores", shape=[1])
+            ctx = seq2seq.encoder(src_v, len_v, DICT, WD, H)
+            ids, scores = seq2seq.decoder_decode(
+                ctx, init_ids, init_scores, DICT, word_dim=WD,
+                decoder_size=H, beam_size=args.beam,
+                max_length=args.max_len, end_id=EOS)
+
+    exe = fluid.Executor(place)
+    scope = fluid.Scope()
+    r = np.random.RandomState(0)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for step in range(args.steps):
+            src, trg_in, trg_out = synth_batch(r, args.batch)
+            feed = {"src_word_id": src,
+                    "src_len": np.full(args.batch, SEQ, np.int32),
+                    "target_language_word": trg_in,
+                    "trg_len": np.full(args.batch, SEQ, np.int32),
+                    "target_language_next_word": trg_out}
+            loss_v, = exe.run(train_p, feed=feed, fetch_list=[avg_cost])
+            if step % 10 == 0:
+                print("step %d loss %.4f" % (step, float(np.asarray(loss_v))))
+
+        # beam decode a fresh batch with the trained parameters
+        src, _, trg_out = synth_batch(r, 4)
+        ids_v, scores_v = exe.run(infer_p, feed={
+            "src_word_id": src, "src_len": np.full(4, SEQ, np.int32),
+            "init_ids": np.full((4, 1), BOS, np.int64),
+            "init_scores": np.zeros((4, 1), np.float32)},
+            fetch_list=[ids, scores])
+    ids_v = np.asarray(ids_v)
+    for b in range(4):
+        hyp = ids_v[b, 0]
+        match = (hyp[:SEQ] == trg_out[b][:len(hyp)]).mean()
+        print("sent %d best-beam token match %.2f" % (b, match))
+
+
+if __name__ == "__main__":
+    main()

@@ -316,7 +316,8 @@ class BeamSearchDecoder:
 
     def __init__(self, state_cell, init_ids, init_scores, target_dict_dim,
                  word_dim, input_var_dict=None, topk_size=50, sparse_emb=True,
-                 max_len=100, beam_size=1, end_id=1, name=None):
+                 max_len=100, beam_size=1, end_id=1, name=None,
+                 emb_param_attr=None):
         self._helper = LayerHelper("beam_search_decoder", name=name)
         self._type = _DecoderType.BEAM_SEARCH
         self._status = BeamSearchDecoder.BEFORE_BEAM_SEARCH_DECODER
@@ -333,6 +334,9 @@ class BeamSearchDecoder:
         self._sparse_emb = sparse_emb
         self._word_dim = int(word_dim)
         self._input_var_dict = dict(input_var_dict or {})
+        # name the prev-token embedding (e.g. ParamAttr("vemb")) to share
+        # it with the training decoder's table across separate programs
+        self._emb_param_attr = emb_param_attr
         self._outputs = None
 
     @property
@@ -412,7 +416,8 @@ class BeamSearchDecoder:
             flat_ids = layers.reshape(prev_ids, shape=[-1, 1])
             prev_emb = layers.embedding(
                 flat_ids, size=[self._target_dict_dim, self._word_dim],
-                dtype="float32", is_sparse=self._sparse_emb)
+                dtype="float32", is_sparse=self._sparse_emb,
+                param_attr=self._emb_param_attr)
 
             feed_dict = dict(expanded_feeds)
             for input_name in self._state_cell._inputs:

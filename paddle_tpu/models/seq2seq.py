@@ -75,7 +75,8 @@ def decoder_decode(context, init_ids, init_scores, dict_size, word_dim=32,
         _make_cell(context, decoder_size), init_ids, init_scores,
         target_dict_dim=dict_size, word_dim=word_dim,
         topk_size=min(50, dict_size), sparse_emb=is_sparse,
-        max_len=max_length, beam_size=beam_size, end_id=end_id)
+        max_len=max_length, beam_size=beam_size, end_id=end_id,
+        emb_param_attr=ParamAttr(name="vemb"))
     decoder.decode()
     return decoder()
 

@@ -405,14 +405,36 @@ def _fused_attention(ctx):
         dropout_rate = 0.0
     block_k = int(ctx.attr("block_k", 512))
     if _use_pallas(q, k, lengths, dropout_rate):
+        # block sizes: env overrides (on-hardware sweeps) > op attr > 512
+        bq = _env_block("PADDLE_TPU_FLASH_BQ", 512)
+        bk = _env_block("PADDLE_TPU_FLASH_BK", block_k)
         return {"Out": pallas_flash_attention(q, k, v, causal=causal,
-                                              scale=scale)}
+                                              scale=scale, block_q=bq,
+                                              block_k=bk)}
     out = flash_attention(
         q, k, v, causal=causal, scale=scale, lengths=lengths,
         dropout_rate=dropout_rate,
         rng_key=ctx.rng() if dropout_rate else None,
         block_k=block_k)
     return {"Out": out}
+
+
+def _env_block(var: str, default: int) -> int:
+    """Env-tunable Pallas block size: must be a power-of-two >= 128
+    (TPU lane granularity; _fit_block halves from here). Fails fast with
+    the variable name so a bad sweep value doesn't surface as a cryptic
+    mid-trace error."""
+    raw = os.environ.get(var)
+    if raw is None:
+        return int(default)
+    try:
+        val = int(raw)
+    except ValueError:
+        raise ValueError("%s=%r is not an integer" % (var, raw))
+    if val < 128 or val & (val - 1):
+        raise ValueError(
+            "%s=%d must be a power of two >= 128" % (var, val))
+    return val
 
 
 def _use_pallas(q, k, lengths, dropout_rate) -> bool:
