@@ -171,7 +171,40 @@ def bench_resnet(dev):
     }
 
 
+def _probe_device(timeout_s: int):
+    """Check (in a subprocess, so a hang can be killed) that the backend
+    answers a trivial computation. The axon TPU tunnel can wedge on a
+    stale claim — better an honest error JSON than a silent driver hang.
+    Returns None when healthy, else a one-line diagnosis."""
+    import subprocess
+    import sys
+
+    code = ("import jax, jax.numpy as jnp; "
+            "(jnp.ones((128,128)) @ jnp.ones((128,128))).block_until_ready()")
+    try:
+        res = subprocess.run([sys.executable, "-c", code], timeout=timeout_s,
+                             capture_output=True)
+    except subprocess.TimeoutExpired:
+        return ("probe computation did not complete in %ds "
+                "(device tunnel wedged?)" % timeout_s)
+    if res.returncode != 0:
+        tail = res.stderr.decode(errors="replace").strip().splitlines()
+        return "probe crashed (rc %d): %s" % (
+            res.returncode, tail[-1] if tail else "no stderr")
+    return None
+
+
 def main():
+    probe_s = int(_os.environ.get("BENCH_PROBE_TIMEOUT", 240))
+    problem = _probe_device(probe_s) if probe_s > 0 else None
+    if problem is not None:
+        print(json.dumps({
+            "metric": "transformer_lm_train_tokens_per_sec_per_chip",
+            "value": None, "unit": "tokens/s", "vs_baseline": None,
+            "error": "device backend unreachable: " + problem,
+        }))
+        return
+
     import jax
 
     dev = jax.devices()[0]

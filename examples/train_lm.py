@@ -31,7 +31,8 @@ def main():
                     help="axis=size pairs, e.g. dp=2,mp=4")
     ap.add_argument("--ring", action="store_true",
                     help="sequence-parallel ring attention")
-    ap.add_argument("--amp", action="store_true", default=True)
+    ap.add_argument("--amp", action=argparse.BooleanOptionalAction,
+                    default=True, help="bf16 mixed precision (--no-amp off)")
     args = ap.parse_args()
 
     main_p, startup = fluid.Program(), fluid.Program()
@@ -73,7 +74,10 @@ def main():
         sexe = fluid.Executor(fluid.TPUPlace())
         run = lambda fetch: sexe.run(main_p, feed=feed, fetch_list=fetch)
 
-    run([loss])  # compile + step 0
+    # warm BOTH compiled variants (the cache keys on the fetch set): the
+    # timed loop mixes no-fetch steps with one final loss fetch
+    run([loss])
+    run([])
     t0 = time.perf_counter()
     for _ in range(args.steps - 1):
         run([])
