@@ -897,3 +897,110 @@ def _squared_l2_norm(ctx):
 def _l1_norm(ctx):
     """reference l1_norm_op.cc: scalar sum of absolute values."""
     return {"Out": jnp.sum(jnp.abs(ctx.input("X"))).reshape(1)}
+
+
+# ---------------------------------------------------------------------------
+# quantization-aware-training ops (reference fake_quantize_op.h /
+# fake_dequantize_op.h)
+# ---------------------------------------------------------------------------
+
+
+@jax.custom_vjp
+def _ste_quantize(x, scale, bin_cnt):
+    """round(bin_cnt/scale * clip(x, ±scale)) with the straight-through
+    estimator: the backward passes dOut through to dX unchanged (the
+    reference's fake_quantize grad op), otherwise round()'s zero gradient
+    would make QAT learn nothing. Rounds half away from zero like the
+    C++ std::round (jnp.round is half-to-even)."""
+    clipped = jnp.clip(x, -scale, scale)
+    v = bin_cnt / scale * clipped
+    return jnp.trunc(v + 0.5 * jnp.sign(v))
+
+
+def _ste_fwd(x, scale, bin_cnt):
+    return _ste_quantize(x, scale, bin_cnt), None
+
+
+def _ste_bwd(_res, g):
+    return g, None, None
+
+
+_ste_quantize.defvjp(_ste_fwd, _ste_bwd)
+
+
+@register_op("fake_quantize")
+def _fake_quantize(ctx):
+    """Simulated int-N quantization for QAT. Out = round(bin_cnt/scale *
+    clip(x, ±scale)) with bin_cnt = 2^(bits-1) - 1. The scale comes from
+    the chosen quantize_type:
+
+    - "abs_max": current batch's max |x|
+    - "range_abs_max": running max over a `window_size` window of batch
+      scales (InScales/InCurrentIter thread the window state through the
+      step; the reference indexes the window unguarded past its end — UB —
+      here the slot is iter % window_size)
+    - "moving_average_abs_max": 0.9*cur + 0.1*previous (the reference's
+      coefficient order)
+
+    At is_test the stored moving scale is used unchanged. All state is
+    functional (OutScales/OutMovingScale/OutCurrentIter), matching the
+    one-XLA-computation execution model."""
+    x = ctx.input("X")
+    qtype = ctx.attr("quantize_type", "abs_max")
+    window = int(ctx.attr("window_size", 10000))
+    bits = int(ctx.attr("bit_length", 8))
+    is_test = ctx.is_test
+    bin_cnt = float(2 ** (bits - 1) - 1)
+
+    cur = jnp.maximum(jnp.max(jnp.abs(x)), 1e-8)
+    outs = {}
+    if qtype == "abs_max":
+        scale = cur
+        outs["OutMovingScale"] = scale.reshape(1)
+    elif qtype == "range_abs_max":
+        moving = ctx.input("InMovingScale")
+        if is_test:
+            scale = moving.reshape(())
+        else:
+            scales = ctx.input("InScales").reshape(-1)
+            it = ctx.input("InCurrentIter").reshape(()).astype(jnp.int32)
+            slot = it % scales.shape[0]
+            removed = scales[slot]
+            scales = scales.at[slot].set(cur)
+            prev_max = moving.reshape(())
+            n_valid = jnp.minimum(it + 1, scales.shape[0])
+            windowed = jnp.where(jnp.arange(scales.shape[0]) < n_valid,
+                                 scales, 0.0)
+            # reference FindRangeAbsMax: grow immediately; full rescan
+            # only when the evicted slot WAS the max
+            scale = jnp.where(
+                prev_max < cur, cur,
+                jnp.where(jnp.abs(removed - prev_max) < 1e-6,
+                          jnp.max(windowed), prev_max))
+            outs["OutScales"] = scales
+            outs["OutCurrentIter"] = (it + 1).reshape(1)
+        outs["OutMovingScale"] = scale.reshape(1)
+    elif qtype == "moving_average_abs_max":
+        moving = ctx.input("InMovingScale")
+        if is_test:
+            scale = moving.reshape(())
+        else:
+            scale = 0.9 * cur + 0.1 * moving.reshape(())
+        outs["OutMovingScale"] = scale.reshape(1)
+    else:
+        raise ValueError("fake_quantize: unknown quantize_type %r" % qtype)
+
+    # floor protects the is_test branches too (an uninitialized stored
+    # scale of 0 must not emit inf/nan)
+    scale = jnp.maximum(scale, 1e-8)
+    outs["Out"] = _ste_quantize(x, scale, bin_cnt)
+    return outs
+
+
+@register_op("fake_dequantize_max_abs")
+def _fake_dequantize_max_abs(ctx):
+    """reference fake_dequantize_op.h: Out = X * Scale / max_range."""
+    x = ctx.input("X")
+    scale = ctx.input("Scale").reshape(())
+    max_range = float(ctx.attr("max_range"))
+    return {"Out": x.astype(jnp.float32) * scale / max_range}

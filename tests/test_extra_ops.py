@@ -307,3 +307,93 @@ def test_ctc_align():
     np.testing.assert_array_equal(np.asarray(got3["OutLengths"]), [1, 1])
     np.testing.assert_array_equal(np.asarray(got3["Output"])[0, 0], 1)
     np.testing.assert_array_equal(np.asarray(got3["Output"])[1, 0], 4)
+
+
+def test_fake_quantize_abs_max():
+    x = np.array([[0.5, -2.0], [1.0, 0.25]], np.float32)
+    got = run_op("fake_quantize", {"X": x},
+                 attrs={"quantize_type": "abs_max", "bit_length": 8},
+                 outs=("Out", "OutMovingScale"))
+    scale = 2.0
+    want = np.round(127.0 / scale * np.clip(x, -scale, scale))
+    np.testing.assert_allclose(np.asarray(got["Out"]), want)
+    np.testing.assert_allclose(np.asarray(got["OutMovingScale"]), [2.0])
+    # round-trip through dequantize recovers x up to quantization error
+    deq = run_op("fake_dequantize_max_abs",
+                 {"X": np.asarray(got["Out"]),
+                  "Scale": np.array([scale], np.float32)},
+                 attrs={"max_range": 127.0})["Out"]
+    np.testing.assert_allclose(np.asarray(deq), x, atol=scale / 127.0)
+
+
+def test_fake_quantize_moving_average():
+    x = np.array([3.0, -1.0], np.float32)
+    got = run_op("fake_quantize",
+                 {"X": x, "InMovingScale": np.array([1.0], np.float32)},
+                 attrs={"quantize_type": "moving_average_abs_max",
+                        "bit_length": 8},
+                 outs=("Out", "OutMovingScale"))
+    scale = 0.9 * 3.0 + 0.1 * 1.0  # reference coefficient order
+    np.testing.assert_allclose(np.asarray(got["OutMovingScale"]), [scale],
+                               rtol=1e-6)
+    want = np.round(127.0 / scale * np.clip(x, -scale, scale))
+    np.testing.assert_allclose(np.asarray(got["Out"]), want)
+    # is_test: the stored scale is used unchanged
+    got_t = run_op("fake_quantize",
+                   {"X": x, "InMovingScale": np.array([5.0], np.float32)},
+                   attrs={"quantize_type": "moving_average_abs_max",
+                          "is_test": True},
+                   outs=("Out", "OutMovingScale"))
+    np.testing.assert_allclose(np.asarray(got_t["OutMovingScale"]), [5.0])
+
+
+def test_fake_quantize_range_abs_max():
+    window = 4
+    scales = np.zeros(window, np.float32)
+    moving = np.array([0.0], np.float32)
+    it = np.array([0], np.int32)
+    seen = []
+    for step, mx in enumerate([1.0, 3.0, 2.0, 0.5, 0.25, 0.1]):
+        x = np.array([mx, -mx / 2], np.float32)
+        got = run_op("fake_quantize",
+                     {"X": x, "InScales": scales, "InMovingScale": moving,
+                      "InCurrentIter": it},
+                     attrs={"quantize_type": "range_abs_max",
+                            "window_size": window, "bit_length": 8},
+                     outs=("Out", "OutScales", "OutMovingScale",
+                           "OutCurrentIter"))
+        scales = np.asarray(got["OutScales"])
+        moving = np.asarray(got["OutMovingScale"])
+        it = np.asarray(got["OutCurrentIter"])
+        seen.append(float(moving[0]))
+    # running max grows to 3.0 and stays until 3.0 leaves the window
+    # (slot 1 is overwritten at step 5 -> rescan of [0.25, 0.1, 2.0, 0.5])
+    assert seen[:4] == [1.0, 3.0, 3.0, 3.0]
+    assert seen[4] == 3.0
+    assert abs(seen[5] - 2.0) < 1e-6
+    assert int(it[0]) == 6
+
+
+def test_fake_quantize_straight_through_grad_and_rounding():
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.math import _ste_quantize
+
+    # straight-through: d/dx sum(quantize(x)) == 1 everywhere
+    x = jnp.array([0.3, -1.7, 0.9], jnp.float32)
+    g = jax.grad(lambda v: jnp.sum(_ste_quantize(v, 2.0, 127.0)))(x)
+    np.testing.assert_allclose(np.asarray(g), np.ones(3))
+
+    # half-away-from-zero rounding (C++ std::round), not half-to-even
+    v = np.asarray(_ste_quantize(jnp.array([0.5, -0.5, 1.5], jnp.float32),
+                                 1.0, 1.0))
+    np.testing.assert_allclose(v, [1.0, -1.0, 1.0])
+
+    # is_test with an uninitialized (zero) scale must stay finite
+    out = run_op("fake_quantize",
+                 {"X": np.array([1.0, -1.0], np.float32),
+                  "InMovingScale": np.array([0.0], np.float32)},
+                 attrs={"quantize_type": "moving_average_abs_max",
+                        "is_test": True})["Out"]
+    assert np.isfinite(np.asarray(out)).all()

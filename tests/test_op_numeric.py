@@ -668,7 +668,7 @@ COVERED_ELSEWHERE = {
     "modified_huber_loss", "squared_l2_distance", "squared_l2_norm",
     "l1_norm", "proximal_gd", "proximal_adagrad", "positive_negative_pair",
     "precision_recall", "max_pool2d_with_index", "unpool", "spp",
-    "ctc_align",
+    "ctc_align", "fake_quantize", "fake_dequantize_max_abs",
     # beam_gather: tests/test_contrib_decoder.py
     "beam_gather",
 }
