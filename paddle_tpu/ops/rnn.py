@@ -26,23 +26,12 @@ _ACT = {
 }
 
 
-@register_op("lstm")
-def _lstm(ctx):
-    """Input: (batch, time, 4*hidden) pre-projected gates; Weight: (hidden,
-    4*hidden) recurrent weights; Bias: (4*hidden,) or (7*hidden,) with
-    peepholes. Optional Lengths: (batch,) int32."""
-    x = ctx.input("Input")
-    w = ctx.input("Weight")
-    bias = ctx.input("Bias")
-    lengths = ctx.input("Lengths")
+def _lstm_scan(xx, w, bias, use_peepholes, h0, c0, lengths, gate_act,
+               cell_act, cand_act, is_reverse):
+    """Shared LSTM recurrence over pre-projected gates xx (B, T, 4H);
+    used by the `lstm` kernel and the `fusion_lstm` composition."""
     hidden = w.shape[0]
-    gate_act = _ACT[ctx.attr("gate_activation", "sigmoid")]
-    cell_act = _ACT[ctx.attr("cell_activation", "tanh")]
-    cand_act = _ACT[ctx.attr("candidate_activation", "tanh")]
-    use_peepholes = ctx.attr("use_peepholes", False)
-    is_reverse = ctx.attr("is_reverse", False)
-
-    batch, time = x.shape[0], x.shape[1]
+    batch, time = xx.shape[0], xx.shape[1]
     if bias is not None:
         b_gates = bias[..., : 4 * hidden].reshape(4 * hidden)
         if use_peepholes:
@@ -50,16 +39,14 @@ def _lstm(ctx):
             w_fc = bias[..., 5 * hidden : 6 * hidden].reshape(hidden)
             w_oc = bias[..., 6 * hidden : 7 * hidden].reshape(hidden)
     else:
-        b_gates = jnp.zeros((4 * hidden,), x.dtype)
+        b_gates = jnp.zeros((4 * hidden,), xx.dtype)
 
-    h0 = ctx.input("H0")
-    c0 = ctx.input("C0")
     if h0 is None:
-        h0 = jnp.zeros((batch, hidden), x.dtype)
+        h0 = jnp.zeros((batch, hidden), xx.dtype)
     if c0 is None:
-        c0 = jnp.zeros((batch, hidden), x.dtype)
+        c0 = jnp.zeros((batch, hidden), xx.dtype)
 
-    xs = jnp.swapaxes(x, 0, 1)  # (time, batch, 4H)
+    xs = jnp.swapaxes(xx, 0, 1)  # (time, batch, 4H)
     if is_reverse:
         xs = jnp.flip(xs, 0)
     ts = jnp.arange(time)
@@ -90,37 +77,39 @@ def _lstm(ctx):
     (hT, cT), (hs, cs) = lax.scan(step, (h0, c0), (xs, ts))
     if is_reverse:
         hs, cs = jnp.flip(hs, 0), jnp.flip(cs, 0)
-    return {
-        "Hidden": jnp.swapaxes(hs, 0, 1),
-        "Cell": jnp.swapaxes(cs, 0, 1),
-        "LastHidden": hT,
-        "LastCell": cT,
-    }
+    return (jnp.swapaxes(hs, 0, 1), jnp.swapaxes(cs, 0, 1), hT, cT)
 
 
-@register_op("gru")
-def _gru(ctx):
-    """Input: (batch, time, 3*hidden) pre-projected; Weight: (hidden,
-    3*hidden) laid out [W_z | W_r | W_c]; optional Bias (3*hidden,)."""
-    x = ctx.input("Input")
-    w = ctx.input("Weight")
-    bias = ctx.input("Bias")
-    lengths = ctx.input("Lengths")
+@register_op("lstm")
+def _lstm(ctx):
+    """Input: (batch, time, 4*hidden) pre-projected gates; Weight: (hidden,
+    4*hidden) recurrent weights; Bias: (4*hidden,) or (7*hidden,) with
+    peepholes. Optional Lengths: (batch,) int32."""
+    hs, cs, hT, cT = _lstm_scan(
+        ctx.input("Input"), ctx.input("Weight"), ctx.input("Bias"),
+        ctx.attr("use_peepholes", False), ctx.input("H0"), ctx.input("C0"),
+        ctx.input("Lengths"),
+        _ACT[ctx.attr("gate_activation", "sigmoid")],
+        _ACT[ctx.attr("cell_activation", "tanh")],
+        _ACT[ctx.attr("candidate_activation", "tanh")],
+        ctx.attr("is_reverse", False))
+    return {"Hidden": hs, "Cell": cs, "LastHidden": hT, "LastCell": cT}
+
+
+def _gru_scan(xx, w, bias, h0, lengths, gate_act, cand_act, is_reverse):
+    """Shared GRU recurrence over pre-projected xx (B, T, 3H); used by the
+    `gru` kernel and the `fusion_gru` composition."""
     hidden = w.shape[0]
-    gate_act = _ACT[ctx.attr("gate_activation", "sigmoid")]
-    cand_act = _ACT[ctx.attr("activation", "tanh")]
-    is_reverse = ctx.attr("is_reverse", False)
-
-    batch, time = x.shape[0], x.shape[1]
-    b = bias.reshape(3 * hidden) if bias is not None else jnp.zeros((3 * hidden,), x.dtype)
+    batch, time = xx.shape[0], xx.shape[1]
+    b = bias.reshape(3 * hidden) if bias is not None \
+        else jnp.zeros((3 * hidden,), xx.dtype)
     w_zr = w[:, : 2 * hidden]
     w_c = w[:, 2 * hidden :]
 
-    h0 = ctx.input("H0")
     if h0 is None:
-        h0 = jnp.zeros((batch, hidden), x.dtype)
+        h0 = jnp.zeros((batch, hidden), xx.dtype)
 
-    xs = jnp.swapaxes(x, 0, 1)
+    xs = jnp.swapaxes(xx, 0, 1)
     if is_reverse:
         xs = jnp.flip(xs, 0)
     ts = jnp.arange(time)
@@ -142,7 +131,20 @@ def _gru(ctx):
     hT, hs = lax.scan(step, h0, (xs, ts))
     if is_reverse:
         hs = jnp.flip(hs, 0)
-    return {"Hidden": jnp.swapaxes(hs, 0, 1), "LastHidden": hT}
+    return jnp.swapaxes(hs, 0, 1), hT
+
+
+@register_op("gru")
+def _gru(ctx):
+    """Input: (batch, time, 3*hidden) pre-projected; Weight: (hidden,
+    3*hidden) laid out [W_z | W_r | W_c]; optional Bias (3*hidden,)."""
+    hs, hT = _gru_scan(
+        ctx.input("Input"), ctx.input("Weight"), ctx.input("Bias"),
+        ctx.input("H0"), ctx.input("Lengths"),
+        _ACT[ctx.attr("gate_activation", "sigmoid")],
+        _ACT[ctx.attr("activation", "tanh")],
+        ctx.attr("is_reverse", False))
+    return {"Hidden": hs, "LastHidden": hT}
 
 
 @register_op("lstmp")
@@ -243,3 +245,137 @@ def _gru_unit(ctx):
     c = act(xc + (r * h_prev) @ w_c)
     h = (1 - z) * h_prev + z * c
     return {"Hidden": h, "Gate": jnp.concatenate([zr, c], -1), "ResetHiddenPrev": r * h_prev}
+
+
+# ---------------------------------------------------------------------------
+# fused inference ops (reference fusion_lstm_op.cc, fusion_gru_op.cc,
+# attention_lstm_op.cc, fusion_seqexpand_concat_fc_op.cc). The reference
+# hand-fuses the input projection into its AVX CPU kernels; here the
+# composition is expressed directly and XLA fuses it, so these are thin
+# combinations of the shared scan cores. The primary outputs (Hidden/
+# Cell/XX/Out/FCOut) match the reference; its scratch-workspace outputs
+# (Batched*/Reordered*, AttentionFCOut, LSTMX, LSTMOUT — per-step CPU
+# buffers with no meaning in a fused XLA computation) are not emitted.
+# ---------------------------------------------------------------------------
+
+
+@register_op("fusion_lstm")
+def _fusion_lstm(ctx):
+    """X (B,T,M) @ WeightX (M,4D) -> gates, then the LSTM recurrence with
+    WeightH (D,4D). Emits the XX intermediate like the reference."""
+    x = ctx.input("X")
+    wx = ctx.input("WeightX")
+    wh = ctx.input("WeightH")
+    xx = jnp.einsum("btm,mg->btg", x, wx)
+    hs, cs, hT, cT = _lstm_scan(
+        xx, wh, ctx.input("Bias"), ctx.attr("use_peepholes", False),
+        ctx.input("H0"), ctx.input("C0"), ctx.input("Lengths"),
+        _ACT[ctx.attr("gate_activation", "sigmoid")],
+        _ACT[ctx.attr("cell_activation", "tanh")],
+        _ACT[ctx.attr("candidate_activation", "tanh")],
+        ctx.attr("is_reverse", False))
+    return {"Hidden": hs, "Cell": cs, "XX": xx,
+            "LastHidden": hT, "LastCell": cT}
+
+
+@register_op("fusion_gru")
+def _fusion_gru(ctx):
+    """X (B,T,M) @ WeightX (M,3D) -> pre-projected, then the GRU
+    recurrence with WeightH (D,3D)."""
+    x = ctx.input("X")
+    wx = ctx.input("WeightX")
+    wh = ctx.input("WeightH")
+    xx = jnp.einsum("btm,mg->btg", x, wx)
+    hs, hT = _gru_scan(
+        xx, wh, ctx.input("Bias"), ctx.input("H0"), ctx.input("Lengths"),
+        _ACT[ctx.attr("gate_activation", "sigmoid")],
+        _ACT[ctx.attr("activation", "tanh")],
+        ctx.attr("is_reverse", False))
+    return {"Hidden": hs, "XX": xx, "LastHidden": hT}
+
+
+@register_op("attention_lstm")
+def _attention_lstm(ctx):
+    """reference attention_lstm_op.cc: at every step, score each source
+    position with relu(fc([x_t'..., c_{t-1}])) (+ optional scalar
+    rescale), softmax over the sequence, sum-pool x by those weights into
+    lstm_x, and run one LSTM step on [lstm_x, h_{t-1}].
+
+    Gate layout follows the reference: LSTMWeight (D+M, 4D) rows are
+    [hidden | input], gate columns are [forget, input, output, tilde].
+    Dense (B, T, M) + Lengths replaces LoD; scores at padded positions
+    are masked out of the softmax."""
+    x = ctx.input("X")  # (B, T, M)
+    b_, t_, m = x.shape
+    c0 = ctx.input("C0")
+    h0 = ctx.input("H0")
+    aw = ctx.input("AttentionWeight")  # (M+D, 1)
+    ab = ctx.input("AttentionBias")
+    ascalar = ctx.input("AttentionScalar")
+    ascalar_b = ctx.input("AttentionScalarBias")
+    lw = ctx.input("LSTMWeight")  # (D+M, 4D)
+    lb = ctx.input("LSTMBias").reshape(-1)  # (4D,)
+    d = lw.shape[1] // 4
+    gate_act = _ACT[ctx.attr("gate_activation", "sigmoid")]
+    cell_act = _ACT[ctx.attr("cell_activation", "tanh")]
+    cand_act = _ACT[ctx.attr("candidate_activation", "tanh")]
+    lengths = ctx.input("Lengths")
+    valid = (jnp.arange(t_)[None, :] <
+             (jnp.full((b_,), t_, jnp.int32) if lengths is None
+              else lengths.reshape(-1).astype(jnp.int32))[:, None])
+
+    # x part of the attention fc, shared across steps: (B, T)
+    atted_x = jnp.einsum("btm,m->bt", x, aw[:m, 0])
+    if ab is not None:
+        atted_x = atted_x + ab.reshape(())
+    if h0 is None:
+        h0 = jnp.zeros((b_, d), x.dtype)
+
+    def step(carry, t):
+        h, c = carry
+        score = jax.nn.relu(atted_x + (c @ aw[m:, 0])[:, None])  # (B, T)
+        if ascalar is not None:
+            score = score * ascalar.reshape(())
+            if ascalar_b is not None:
+                score = score + ascalar_b.reshape(())
+            score = jax.nn.relu(score)
+        score = jnp.where(valid, score, -jnp.inf)
+        attn = jax.nn.softmax(score, axis=1)
+        lstm_x = jnp.einsum("bt,btm->bm", attn, x)
+        gates = (jnp.concatenate([h, lstm_x], axis=1) @ lw + lb)  # (B, 4D)
+        f = gate_act(gates[:, :d])
+        i = gate_act(gates[:, d:2 * d])
+        o = gate_act(gates[:, 2 * d:3 * d])
+        tilde = cand_act(gates[:, 3 * d:])
+        c_new = f * c + i * tilde
+        h_new = cell_act(c_new) * o
+        keep = valid[:, t][:, None]
+        h_new = jnp.where(keep, h_new, h)
+        c_new = jnp.where(keep, c_new, c)
+        return (h_new, c_new), (h_new, c_new)
+
+    (_, _), (hs, cs) = lax.scan(step, (h0, c0), jnp.arange(t_))
+    return {"Hidden": jnp.swapaxes(hs, 0, 1),
+            "Cell": jnp.swapaxes(cs, 0, 1),
+            "AttentionedX": atted_x[..., None]}
+
+
+@register_op("fusion_seqexpand_concat_fc")
+def _fusion_seqexpand_concat_fc(ctx):
+    """reference fusion_seqexpand_concat_fc_op.cc: X[0] is the (B, T, M0)
+    sequence, X[1:] are per-sequence (B, Mi) vectors broadcast over every
+    timestep; concat on features, then fc (+ activation)."""
+    xs = ctx.inputs("X")
+    w = ctx.input("FCWeight")
+    bias = ctx.input("FCBias")
+    act = _ACT[ctx.attr("fc_activation", "identity")]
+    seq = xs[0]
+    b_, t_ = seq.shape[0], seq.shape[1]
+    parts = [seq]
+    for v in xs[1:]:
+        parts.append(jnp.broadcast_to(v[:, None, :], (b_, t_, v.shape[-1])))
+    cat = jnp.concatenate(parts, axis=-1)
+    out = jnp.einsum("btm,md->btd", cat, w)
+    if bias is not None:
+        out = out + bias.reshape(-1)
+    return {"Out": act(out), "FCOut": out}

@@ -397,3 +397,143 @@ def test_fake_quantize_straight_through_grad_and_rounding():
                  attrs={"quantize_type": "moving_average_abs_max",
                         "is_test": True})["Out"]
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_fusion_lstm_matches_projection_plus_lstm():
+    B, T, M, D = 2, 5, 3, 4
+    x = R.randn(B, T, M).astype(np.float32)
+    wx = R.randn(M, 4 * D).astype(np.float32)
+    wh = R.randn(D, 4 * D).astype(np.float32) * 0.3
+    b = R.randn(1, 4 * D).astype(np.float32)
+    lens = np.array([5, 3], np.int32)
+    fused = run_op("fusion_lstm",
+                   {"X": x, "WeightX": wx, "WeightH": wh, "Bias": b,
+                    "Lengths": lens},
+                   outs=("Hidden", "Cell", "XX"))
+    xx = x.reshape(-1, M) @ wx
+    np.testing.assert_allclose(np.asarray(fused["XX"]).reshape(-1, 4 * D),
+                               xx, rtol=1e-5)
+    plain = run_op("lstm", {"Input": xx.reshape(B, T, 4 * D),
+                            "Weight": wh, "Bias": b, "Lengths": lens},
+                   outs=("Hidden", "Cell"))
+    np.testing.assert_allclose(np.asarray(fused["Hidden"]),
+                               np.asarray(plain["Hidden"]), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(fused["Cell"]),
+                               np.asarray(plain["Cell"]), rtol=1e-5)
+
+
+def test_fusion_gru_matches_projection_plus_gru():
+    B, T, M, D = 2, 4, 3, 5
+    x = R.randn(B, T, M).astype(np.float32)
+    wx = R.randn(M, 3 * D).astype(np.float32)
+    wh = R.randn(D, 3 * D).astype(np.float32) * 0.3
+    b = R.randn(1, 3 * D).astype(np.float32)
+    fused = run_op("fusion_gru", {"X": x, "WeightX": wx, "WeightH": wh,
+                                  "Bias": b}, outs=("Hidden", "XX"))
+    xx = (x.reshape(-1, M) @ wx).reshape(B, T, 3 * D)
+    plain = run_op("gru", {"Input": xx, "Weight": wh, "Bias": b},
+                   outs=("Hidden",))
+    np.testing.assert_allclose(np.asarray(fused["Hidden"]),
+                               np.asarray(plain["Hidden"]), rtol=1e-5)
+
+
+def _sigmoid(v):
+    return 1.0 / (1.0 + np.exp(-v))
+
+
+def test_attention_lstm():
+    B, T, M, D = 2, 4, 3, 2
+    x = R.randn(B, T, M).astype(np.float32)
+    c0 = R.randn(B, D).astype(np.float32) * 0.2
+    h0 = R.randn(B, D).astype(np.float32) * 0.2
+    aw = R.randn(M + D, 1).astype(np.float32)
+    ab = R.randn(1, 1).astype(np.float32)
+    lw = (R.randn(D + M, 4 * D) * 0.4).astype(np.float32)
+    lb = R.randn(1, 4 * D).astype(np.float32)
+    got = run_op("attention_lstm",
+                 {"X": x, "C0": c0, "H0": h0, "AttentionWeight": aw,
+                  "AttentionBias": ab, "LSTMWeight": lw, "LSTMBias": lb},
+                 outs=("Hidden", "Cell"))
+
+    # numpy replay (reference gate layout: [forget, input, output, tilde])
+    h, c = h0.copy(), c0.copy()
+    want_h = np.zeros((B, T, D))
+    want_c = np.zeros((B, T, D))
+    for t in range(T):
+        score = x.reshape(B, T, M) @ aw[:M, 0] + ab[0, 0] \
+            + (c @ aw[M:, 0])[:, None]
+        score = np.maximum(score, 0)
+        attn = np.exp(score - score.max(1, keepdims=True))
+        attn /= attn.sum(1, keepdims=True)
+        lstm_x = np.einsum("bt,btm->bm", attn, x)
+        gates = np.concatenate([h, lstm_x], 1) @ lw + lb[0]
+        f = _sigmoid(gates[:, :D])
+        i = _sigmoid(gates[:, D:2 * D])
+        o = _sigmoid(gates[:, 2 * D:3 * D])
+        tilde = np.tanh(gates[:, 3 * D:])
+        c = f * c + i * tilde
+        h = np.tanh(c) * o
+        want_h[:, t] = h
+        want_c[:, t] = c
+    np.testing.assert_allclose(np.asarray(got["Hidden"]), want_h, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got["Cell"]), want_c, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_fusion_seqexpand_concat_fc():
+    B, T, M0, M1, DD = 2, 3, 4, 2, 5
+    seq = R.randn(B, T, M0).astype(np.float32)
+    vec = R.randn(B, M1).astype(np.float32)
+    w = R.randn(M0 + M1, DD).astype(np.float32)
+    b = R.randn(DD).astype(np.float32)
+    got = run_op("fusion_seqexpand_concat_fc",
+                 {"X": [seq, vec], "FCWeight": w, "FCBias": b},
+                 attrs={"fc_activation": "relu"}, outs=("Out", "FCOut"))
+    cat = np.concatenate(
+        [seq, np.repeat(vec[:, None, :], T, axis=1)], axis=-1)
+    fcout = cat @ w + b
+    np.testing.assert_allclose(np.asarray(got["FCOut"]), fcout, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(got["Out"]),
+                               np.maximum(fcout, 0), rtol=1e-5)
+
+
+def test_attention_lstm_scalar_and_lengths():
+    B, T, M, D = 2, 4, 3, 2
+    x = R.randn(B, T, M).astype(np.float32)
+    c0 = R.randn(B, D).astype(np.float32) * 0.2
+    aw = R.randn(M + D, 1).astype(np.float32)
+    scal = np.array([[1.7]], np.float32)
+    scal_b = np.array([[-0.2]], np.float32)
+    lw = (R.randn(D + M, 4 * D) * 0.4).astype(np.float32)
+    lb = R.randn(1, 4 * D).astype(np.float32)
+    lens = np.array([4, 2], np.int32)
+    got = run_op("attention_lstm",
+                 {"X": x, "C0": c0, "AttentionWeight": aw,
+                  "AttentionScalar": scal, "AttentionScalarBias": scal_b,
+                  "LSTMWeight": lw, "LSTMBias": lb, "Lengths": lens},
+                 outs=("Hidden", "Cell"))
+
+    h, c = np.zeros((B, D), np.float32), c0.copy()
+    want_h = np.zeros((B, T, D))
+    for t in range(T):
+        score = x @ aw[:M, 0] + (c @ aw[M:, 0])[:, None]
+        score = np.maximum(score, 0)
+        score = np.maximum(score * scal[0, 0] + scal_b[0, 0], 0)
+        # padded positions leave the softmax entirely
+        score = np.where(np.arange(T)[None, :] < lens[:, None], score,
+                         -np.inf)
+        attn = np.exp(score - score.max(1, keepdims=True))
+        attn /= attn.sum(1, keepdims=True)
+        lstm_x = np.einsum("bt,btm->bm", attn, x)
+        gates = np.concatenate([h, lstm_x], 1) @ lw + lb[0]
+        f, i = _sigmoid(gates[:, :D]), _sigmoid(gates[:, D:2 * D])
+        o, tilde = _sigmoid(gates[:, 2 * D:3 * D]), np.tanh(gates[:, 3 * D:])
+        c_new = f * c + i * tilde
+        h_new = np.tanh(c_new) * o
+        keep = (t < lens)[:, None]
+        h = np.where(keep, h_new, h)
+        c = np.where(keep, c_new, c)
+        want_h[:, t] = h
+    np.testing.assert_allclose(np.asarray(got["Hidden"]), want_h,
+                               rtol=1e-4, atol=1e-5)

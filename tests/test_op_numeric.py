@@ -669,6 +669,8 @@ COVERED_ELSEWHERE = {
     "l1_norm", "proximal_gd", "proximal_adagrad", "positive_negative_pair",
     "precision_recall", "max_pool2d_with_index", "unpool", "spp",
     "ctc_align", "fake_quantize", "fake_dequantize_max_abs",
+    "fusion_lstm", "fusion_gru", "attention_lstm",
+    "fusion_seqexpand_concat_fc",
     # beam_gather: tests/test_contrib_decoder.py
     "beam_gather",
 }
