@@ -128,10 +128,15 @@ def _fused_lm_head_loss(ctx):
     """Inputs X: (..., D), W: (D, V), Bias: (V,) optional, Label: (..., 1)
     or (...,) int. Output Loss: (N, 1) fp32 per-token loss, N = prod of
     X's leading dims. Attr block_v: vocab chunk size (multiple of 128)."""
+    from .attention import _env_block
+
     x = ctx.input("X")
     w = ctx.input("W")
     labels = ctx.input("Label")
-    block_v = int(ctx.attr("block_v", 4096))
+    # env override for on-hardware sweeps (tools/sweep_bench.sh),
+    # validated like the flash-attention block knobs
+    block_v = _env_block("PADDLE_TPU_LMHEAD_BLOCK",
+                         ctx.attr("block_v", 4096))
     d = x.shape[-1]
     xf = x.reshape(-1, d)
     b = ctx.input("Bias")

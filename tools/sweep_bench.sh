@@ -1,0 +1,39 @@
+#!/bin/bash
+# On-TPU perf sweep: run after the device is reachable. Each line prints
+# the bench JSON for one configuration; compare mfu/step_ms across rows.
+#
+#   bash tools/sweep_bench.sh            # LM sweep (batch x flash blocks)
+#   RN=1 bash tools/sweep_bench.sh      # include ResNet batch sweep
+set -u
+cd "$(dirname "$0")/.."
+
+run() {
+  echo "=== $* ==="
+  env "$@" BENCH_RESNET=0 BENCH_PROBE_TIMEOUT=120 timeout 900 python bench.py 2>/dev/null | tail -1
+}
+
+# batch sweep at default blocks
+run BENCH_BATCH=8
+run BENCH_BATCH=16
+run BENCH_BATCH=24
+
+# flash-attention block sweep at the best-looking batch (edit as needed)
+for bq in 256 512 1024; do
+  for bk in 256 512 1024; do
+    run BENCH_BATCH=16 PADDLE_TPU_FLASH_BQ=$bq PADDLE_TPU_FLASH_BK=$bk
+  done
+done
+
+# fused LM-head vocab chunk sweep
+for bv in 2048 4096 8192; do
+  run BENCH_BATCH=16 PADDLE_TPU_LMHEAD_BLOCK=$bv
+done
+
+if [ "${RN:-0}" = "1" ]; then
+  for rb in 64 128 256; do
+    echo "=== resnet batch $rb ==="
+    env BENCH_RN_BATCH=$rb BENCH_PROBE_TIMEOUT=120 BENCH_STEPS=3 \
+        BENCH_WARMUP=1 BENCH_LAYERS=1 timeout 900 python bench.py \
+        2>/dev/null | tail -1
+  done
+fi
