@@ -72,9 +72,13 @@ class _EagerOp:
             self._out_slots = frozenset(outs)
         return ins, outs
 
-    def run(self, scope=None, place=None, rng_seed: int = 0):
+    def run(self, scope=None, place=None, rng_seed: int = 0, outs=None):
         """Execute the kernel; returns {out_slot: np.ndarray} and writes
-        each output into `scope` under its given name when provided."""
+        each output into `scope` under its given name when provided.
+        `outs` names additional output slots to materialize — needed for
+        kernels whose output slots are lowercase (indistinguishable from
+        attrs in the keyword call), e.g.
+        ``op.run(scope=s, outs=("out_sum_1", "out_num_updates"))``."""
         import jax
         import jax.numpy as jnp
 
@@ -82,6 +86,8 @@ class _EagerOp:
         from .framework.trace import RngStream, trace_block
 
         named_ins, named_outs = self._split_named(scope)
+        for slot in outs or ():
+            named_outs.setdefault(slot, slot)
         if not named_outs:
             named_outs = {"Out": "Out"}
 
@@ -127,13 +133,23 @@ class OperatorFactory:
     classification rules."""
 
     def __call__(self, type: str, **kwargs) -> _EagerOp:
+        import numpy as np
+
         from .ops.registry import op_support_tpu
 
         if not op_support_tpu(type):
             raise ValueError("Operator %r has no registered TPU kernel" % type)
         inputs, named, attrs = {}, {}, {}
         for key, val in kwargs.items():
-            if key[:1].isupper():
+            is_arr = isinstance(val, np.ndarray) or (
+                isinstance(val, (list, tuple)) and val
+                and all(isinstance(v, np.ndarray) for v in val))
+            if is_arr:
+                # arrays are always tensor inputs, whatever the key case
+                # (some reference ops use lowercase slots, e.g.
+                # average_accumulates' param/in_sum_1)
+                inputs[key] = val
+            elif key[:1].isupper():
                 if isinstance(val, str):
                     named[key] = val
                 else:

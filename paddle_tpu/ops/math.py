@@ -691,16 +691,20 @@ def _assign(ctx):
     return {"Out": ctx.input("X")}
 
 
-@register_op("assign_value")
-def _assign_value(ctx):
+def _attr_tensor(values, shape, dtype):
+    """Materialize attr-embedded data (shared by assign_value and fill)."""
     import numpy as np
 
     from ..framework.dtypes import as_numpy_dtype
 
-    values = ctx.attr("values")
-    dtype = as_numpy_dtype(ctx.attr("dtype", "float32"))
-    arr = np.asarray(values, dtype=dtype).reshape(ctx.attr("shape"))
-    return {"Out": jnp.asarray(arr)}
+    arr = np.asarray(values, dtype=as_numpy_dtype(dtype)).reshape(shape)
+    return jnp.asarray(arr)
+
+
+@register_op("assign_value")
+def _assign_value(ctx):
+    return {"Out": _attr_tensor(ctx.attr("values"), ctx.attr("shape"),
+                                ctx.attr("dtype", "float32"))}
 
 
 @register_op("fill_constant")
@@ -1004,3 +1008,53 @@ def _fake_dequantize_max_abs(ctx):
     scale = ctx.input("Scale").reshape(())
     max_range = float(ctx.attr("max_range"))
     return {"Out": x.astype(jnp.float32) * scale / max_range}
+
+
+@register_op("fill")
+def _fill(ctx):
+    """reference fill_op.cc: materialize a tensor from attr-embedded data.
+    Same computation as assign_value with the attr spelled `value`
+    instead of `values` (force_cpu is meaningless under XLA)."""
+    return {"Out": _attr_tensor(ctx.attr("value", []), ctx.attr("shape"),
+                                ctx.attr("dtype", "float32"))}
+
+
+_FEA_UNARY = {
+    "scale": lambda v, attr: v * attr,
+    "relu": lambda v, attr: jnp.maximum(v, 0.0),
+}
+_FEA_BINARY = {
+    "elementwise_add": jnp.add,
+    "elementwise_mul": jnp.multiply,
+}
+
+
+@register_op("fused_elemwise_activation")
+def _fused_elemwise_activation(ctx):
+    """reference fused_elemwise_activation_op.h: compose one binary and
+    one unary functor. functor_list ("binary,unary") computes
+    Out = Binary(X, Unary(Y)) with IntermediateOut = Unary(Y);
+    ("unary,binary") computes Out = Unary(Binary(X, Y)) with
+    IntermediateOut = Binary(X, Y). The unary `scale` reads attr scale.
+    XLA fuses the chain either way; the op exists for source parity."""
+    x = ctx.input("X")
+    y = ctx.input("Y")
+    functors = [f.strip() for f in ctx.attr("functor_list")]
+    scale = float(ctx.attr("scale", 1.0))
+    axis = ctx.attr("axis", -1)
+    if len(functors) != 2:
+        raise ValueError("functor_list must name exactly two functors")
+    yb = _broadcast_y(x, y, axis)
+    f0, f1 = functors
+    if f0 in _FEA_BINARY and f1 in _FEA_UNARY:
+        intermediate = _FEA_UNARY[f1](yb, scale)
+        out = _FEA_BINARY[f0](x, intermediate)
+    elif f0 in _FEA_UNARY and f1 in _FEA_BINARY:
+        intermediate = _FEA_BINARY[f1](x, yb)
+        out = _FEA_UNARY[f0](intermediate, scale)
+    else:
+        raise ValueError(
+            "fused_elemwise_activation: unsupported functor_list %r "
+            "(one of %s composed with one of %s)"
+            % (functors, sorted(_FEA_BINARY), sorted(_FEA_UNARY)))
+    return {"Out": out, "IntermediateOut": intermediate}

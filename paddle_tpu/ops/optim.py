@@ -194,3 +194,49 @@ def _proximal_adagrad(ctx):
     p_new = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
              / (1.0 + lr * l2))
     return {"ParamOut": p_new, "MomentOut": m_new}
+
+
+@register_op("average_accumulates")
+def _average_accumulates(ctx):
+    """reference average_accumulates_op.h (ModelAverage's accumulator):
+    sum_1 += param each step; every 16384 updates sum_1 spills into
+    sum_2; when the window fills (num_accumulates >= min_window and
+    >= min(max_window, num_updates*average_window)) everything rolls
+    into sum_3 and the window restarts. All branches are jnp.where
+    selects so the op stays a pure functional state update."""
+    k_max = 16384
+    p = ctx.input("param")
+    s1, s2, s3 = (ctx.input("in_sum_1"), ctx.input("in_sum_2"),
+                  ctx.input("in_sum_3"))
+    num_acc = ctx.input("in_num_accumulates").reshape(()).astype(jnp.int64)
+    old_acc = ctx.input("in_old_num_accumulates").reshape(()).astype(jnp.int64)
+    num_upd = ctx.input("in_num_updates").reshape(()).astype(jnp.int64)
+    avg_window = float(ctx.attr("average_window", 0.0))
+    # clamp to int32 range: with jax x64 off the counters are int32 and a
+    # larger Python default would overflow at trace time
+    max_w = min(int(ctx.attr("max_average_window", 2 ** 31 - 1)),
+                2 ** 31 - 1)
+    min_w = int(ctx.attr("min_average_window", 10000))
+    if min_w > max_w:
+        raise ValueError("min_average_window must be <= max_average_window")
+
+    num_upd = num_upd + 1
+    num_acc = num_acc + 1
+    s1 = s1 + p
+    spill = num_upd % k_max == 0
+    s2 = jnp.where(spill, s2 + s1, s2)
+    s1 = jnp.where(spill, jnp.zeros_like(s1), s1)
+
+    window_full = (num_acc >= min_w) & (
+        num_acc >= jnp.minimum(max_w, (num_upd * avg_window).astype(jnp.int64)))
+    s3 = jnp.where(window_full, s1 + s2, s3)
+    s1 = jnp.where(window_full, jnp.zeros_like(s1), s1)
+    s2 = jnp.where(window_full, jnp.zeros_like(s2), s2)
+    old_acc = jnp.where(window_full, num_acc, old_acc)
+    num_acc = jnp.where(window_full, 0, num_acc)
+
+    as1 = lambda v: v.reshape(1).astype(jnp.int64)
+    return {"out_sum_1": s1, "out_sum_2": s2, "out_sum_3": s3,
+            "out_num_accumulates": as1(num_acc),
+            "out_old_num_accumulates": as1(old_acc),
+            "out_num_updates": as1(num_upd)}

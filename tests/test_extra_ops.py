@@ -537,3 +537,93 @@ def test_attention_lstm_scalar_and_lengths():
         want_h[:, t] = h
     np.testing.assert_allclose(np.asarray(got["Hidden"]), want_h,
                                rtol=1e-4, atol=1e-5)
+
+
+def test_fill_op():
+    got = np.asarray(run_op("fill", {}, attrs={
+        "value": [1.0, 2.0, 3.0, 4.0], "shape": [2, 2],
+        "dtype": "float32"})["Out"])
+    np.testing.assert_allclose(got, [[1, 2], [3, 4]])
+    assert got.dtype == np.float32
+    got_i = np.asarray(run_op("fill", {}, attrs={
+        "value": [7, 8], "shape": [2], "dtype": "int32"})["Out"])
+    assert got_i.dtype == np.int32 and list(got_i) == [7, 8]
+
+
+def test_fused_elemwise_activation():
+    x = R.randn(3, 4).astype(np.float32)
+    y = R.randn(3, 4).astype(np.float32)
+    # Out = X + scale(Y)
+    got = run_op("fused_elemwise_activation", {"X": x, "Y": y},
+                 attrs={"functor_list": ["elementwise_add", "scale"],
+                        "scale": 0.5},
+                 outs=("Out", "IntermediateOut"))
+    np.testing.assert_allclose(np.asarray(got["IntermediateOut"]), y * 0.5,
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(got["Out"]), x + y * 0.5,
+                               rtol=1e-6)
+    # Out = relu(X + Y)
+    got2 = run_op("fused_elemwise_activation", {"X": x, "Y": y},
+                  attrs={"functor_list": ["relu", "elementwise_add"]},
+                  outs=("Out", "IntermediateOut"))
+    np.testing.assert_allclose(np.asarray(got2["Out"]),
+                               np.maximum(x + y, 0), rtol=1e-6)
+    # broadcast along axis like elementwise_add
+    y1 = R.randn(4).astype(np.float32)
+    got3 = run_op("fused_elemwise_activation", {"X": x, "Y": y1},
+                  attrs={"functor_list": ["elementwise_add", "relu"],
+                         "axis": 1})["Out"]
+    np.testing.assert_allclose(np.asarray(got3), x + np.maximum(y1, 0),
+                               rtol=1e-6)
+
+
+def test_average_accumulates():
+    shape = (3,)
+    p = np.full(shape, 2.0, np.float32)
+    s1 = np.zeros(shape, np.float32)
+    s2 = np.zeros(shape, np.float32)
+    s3 = np.zeros(shape, np.float32)
+    na = np.array([0], np.int64)
+    oa = np.array([0], np.int64)
+    nu = np.array([0], np.int64)
+    attrs = {"average_window": 0.5, "max_average_window": 4,
+             "min_average_window": 2}
+    outs = ("out_sum_1", "out_sum_2", "out_sum_3", "out_num_accumulates",
+            "out_old_num_accumulates", "out_num_updates")
+    for step in range(1, 6):
+        got = run_op("average_accumulates",
+                     {"param": p, "in_sum_1": s1, "in_sum_2": s2,
+                      "in_sum_3": s3, "in_num_accumulates": na,
+                      "in_old_num_accumulates": oa, "in_num_updates": nu},
+                     attrs=attrs, outs=outs)
+        s1 = np.asarray(got["out_sum_1"])
+        s2 = np.asarray(got["out_sum_2"])
+        s3 = np.asarray(got["out_sum_3"])
+        na = np.asarray(got["out_num_accumulates"])
+        oa = np.asarray(got["out_old_num_accumulates"])
+        nu = np.asarray(got["out_num_updates"])
+    # windows roll at steps 2 and 4 (num_acc >= min(4, updates*0.5) and
+    # >= min_window 2), so after 5 steps: one fresh accumulation in s1,
+    # s3 holds the 2-step window sum (2 params * 2.0 = 4.0 each)
+    assert int(nu[0]) == 5
+    assert int(oa[0]) == 2
+    assert int(na[0]) == 1
+    np.testing.assert_allclose(s3, np.full(shape, 4.0))
+    np.testing.assert_allclose(s1, np.full(shape, 2.0))
+
+
+def test_average_accumulates_default_window():
+    # the default max_average_window must not overflow int32 (x64 off)
+    shape = (2,)
+    got = run_op("average_accumulates",
+                 {"param": np.ones(shape, np.float32),
+                  "in_sum_1": np.zeros(shape, np.float32),
+                  "in_sum_2": np.zeros(shape, np.float32),
+                  "in_sum_3": np.zeros(shape, np.float32),
+                  "in_num_accumulates": np.array([0], np.int64),
+                  "in_old_num_accumulates": np.array([0], np.int64),
+                  "in_num_updates": np.array([0], np.int64)},
+                 attrs={"average_window": 0.1},
+                 outs=("out_sum_1", "out_num_updates"))
+    np.testing.assert_allclose(np.asarray(got["out_sum_1"]), np.ones(shape))
+    assert int(np.asarray(got["out_num_updates"])[0]) == 1

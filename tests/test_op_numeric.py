@@ -670,7 +670,8 @@ COVERED_ELSEWHERE = {
     "precision_recall", "max_pool2d_with_index", "unpool", "spp",
     "ctc_align", "fake_quantize", "fake_dequantize_max_abs",
     "fusion_lstm", "fusion_gru", "attention_lstm",
-    "fusion_seqexpand_concat_fc",
+    "fusion_seqexpand_concat_fc", "fill", "fused_elemwise_activation",
+    "average_accumulates",
     # beam_gather: tests/test_contrib_decoder.py
     "beam_gather",
 }
