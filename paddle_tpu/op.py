@@ -11,11 +11,13 @@ outputs land back in the Scope::
     # scope.find_var("y") == 2.0 * ones(4)
 
 Slot classification (the reference reads op protos; our registry carries
-no slot schemas, so it is value-driven): uppercase keywords are tensor
-slots, lowercase are attributes. An uppercase keyword holding an array
-(or list of arrays) is an input; one holding a string is resolved at
-``run`` time — an input if the scope has data under that name, otherwise
-the name of an output variable.
+no slot schemas, so it is value-driven): a keyword holding an array
+(numpy or jax, or a list of them) is a tensor input whatever its case
+(some reference ops use lowercase slots); an UPPERCASE keyword holding a
+string is resolved at ``run`` time — an input if the scope has data
+under that name, otherwise the name of an output variable; everything
+else is an attribute. Lowercase output slots are requested via
+``run(outs=...)``.
 """
 from __future__ import annotations
 
@@ -133,17 +135,20 @@ class OperatorFactory:
     classification rules."""
 
     def __call__(self, type: str, **kwargs) -> _EagerOp:
-        import numpy as np
-
         from .ops.registry import op_support_tpu
 
         if not op_support_tpu(type):
             raise ValueError("Operator %r has no registered TPU kernel" % type)
+
+        def _is_tensor(v):
+            # np.ndarray AND jax.Array (duck-typed: both carry shape+dtype)
+            return hasattr(v, "shape") and hasattr(v, "dtype")
+
         inputs, named, attrs = {}, {}, {}
         for key, val in kwargs.items():
-            is_arr = isinstance(val, np.ndarray) or (
+            is_arr = _is_tensor(val) or (
                 isinstance(val, (list, tuple)) and val
-                and all(isinstance(v, np.ndarray) for v in val))
+                and all(_is_tensor(v) for v in val))
             if is_arr:
                 # arrays are always tensor inputs, whatever the key case
                 # (some reference ops use lowercase slots, e.g.

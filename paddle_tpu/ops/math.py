@@ -1044,13 +1044,14 @@ def _fused_elemwise_activation(ctx):
     axis = ctx.attr("axis", -1)
     if len(functors) != 2:
         raise ValueError("functor_list must name exactly two functors")
-    yb = _broadcast_y(x, y, axis)
     f0, f1 = functors
     if f0 in _FEA_BINARY and f1 in _FEA_UNARY:
-        intermediate = _FEA_UNARY[f1](yb, scale)
-        out = _FEA_BINARY[f0](x, intermediate)
+        # IntermediateOut keeps Y's own shape (reference contract);
+        # broadcasting happens only inside the binary step
+        intermediate = _FEA_UNARY[f1](y, scale)
+        out = _FEA_BINARY[f0](x, _broadcast_y(x, intermediate, axis))
     elif f0 in _FEA_UNARY and f1 in _FEA_BINARY:
-        intermediate = _FEA_BINARY[f1](x, yb)
+        intermediate = _FEA_BINARY[f1](x, _broadcast_y(x, y, axis))
         out = _FEA_UNARY[f0](intermediate, scale)
     else:
         raise ValueError(

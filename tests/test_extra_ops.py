@@ -627,3 +627,24 @@ def test_average_accumulates_default_window():
                  outs=("out_sum_1", "out_num_updates"))
     np.testing.assert_allclose(np.asarray(got["out_sum_1"]), np.ones(shape))
     assert int(np.asarray(got["out_num_updates"])[0]) == 1
+
+
+def test_fea_intermediate_keeps_y_shape():
+    import jax.numpy as jnp
+
+    x = R.randn(3, 4).astype(np.float32)
+    y1 = R.randn(4).astype(np.float32)
+    got = run_op("fused_elemwise_activation", {"X": x, "Y": y1},
+                 attrs={"functor_list": ["elementwise_add", "scale"],
+                        "scale": 2.0, "axis": 1},
+                 outs=("Out", "IntermediateOut"))
+    assert np.asarray(got["IntermediateOut"]).shape == (4,)
+    np.testing.assert_allclose(np.asarray(got["Out"]), x + 2.0 * y1,
+                               rtol=1e-6)
+
+    # jax arrays bind as factory inputs too (lowercase slot)
+    from paddle_tpu.op import Operator
+
+    out = Operator("scale", X=jnp.arange(3, dtype=jnp.float32),
+                   scale=2.0).run()["Out"]
+    np.testing.assert_allclose(out, [0.0, 2.0, 4.0])

@@ -196,3 +196,57 @@ def test_sharding_plan_prefix_and_regex():
     assert plan.spec("other") == P()
     # ndim clamp
     assert plan.spec("fc_0.w_0_beta1_pow_acc", ndim=1) == P(None)
+
+
+def test_parallel_executor_rnn_model_parity():
+    """8-way dp on a scan-based RNN model (GRU over time) == single
+    device: exercises lax.scan + embedding + sequence masking under
+    GSPMD, not just dense fc stacks."""
+    rng = np.random.RandomState(3)
+    B, T, V, D = 16, 12, 50, 24
+    xs = rng.randint(0, V, (B, T)).astype(np.int64)
+    lens = rng.randint(3, T + 1, B).astype(np.int32)
+    ys = rng.randint(0, 2, (B, 1)).astype(np.int64)
+
+    def build():
+        words = layers.data(name="w", shape=[T], dtype="int64")
+        lengths = layers.data(name="lens", shape=[], dtype="int32")
+        label = layers.data(name="y", shape=[1], dtype="int64")
+        emb = layers.embedding(words, size=[V, D])
+        proj = layers.fc(emb, size=D * 3, num_flatten_dims=2)
+        h = layers.dynamic_gru(proj, size=D, sequence_length=lengths)
+        pooled = layers.sequence_pool(h, "last", sequence_length=lengths)
+        logits = layers.fc(pooled, size=2)
+        loss = fluid.layers.mean(
+            layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+        return loss
+
+    feed = {"w": xs, "lens": lens, "y": ys}
+
+    main_a, start_a = fluid.Program(), fluid.Program()
+    main_a.random_seed = start_a.random_seed = 11
+    scope_a = fluid.Scope()
+    with fluid.scope_guard(scope_a), fluid.program_guard(main_a, start_a):
+        with fluid.unique_name.guard():
+            loss_a = build()
+        exe = fluid.Executor()
+        exe.run(start_a)
+        single = [exe.run(main_a, feed=feed, fetch_list=[loss_a])[0]
+                  for _ in range(3)]
+
+    main_b, start_b = fluid.Program(), fluid.Program()
+    main_b.random_seed = start_b.random_seed = 11
+    scope_b = fluid.Scope()
+    with fluid.scope_guard(scope_b), fluid.program_guard(main_b, start_b):
+        with fluid.unique_name.guard():
+            loss_b = build()
+        fluid.Executor().run(start_b)
+        pexe = ParallelExecutor(loss_name=loss_b.name, main_program=main_b,
+                                scope=scope_b)
+        par = [pexe.run(feed=feed, fetch_list=[loss_b])[0]
+               for _ in range(3)]
+
+    for a, b in zip(single, par):
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6)
+    assert single[0] > single[-1]
