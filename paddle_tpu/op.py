@@ -12,11 +12,13 @@ outputs land back in the Scope::
 
 Slot classification (the reference reads op protos; our registry carries
 no slot schemas, so it is value-driven): a keyword holding an array
-(numpy or jax, or a list of them) is a tensor input whatever its case
-(some reference ops use lowercase slots); an UPPERCASE keyword holding a
-string is resolved at ``run`` time — an input if the scope has data
-under that name, otherwise the name of an output variable; everything
-else is an attribute. Lowercase output slots are requested via
+(numpy or jax, or a list of them — numpy scalars count as attributes) is
+a tensor input whatever its case (some reference ops use lowercase
+slots); an UPPERCASE keyword holding a string is resolved at ``run``
+time — an input if the scope has data under that name, otherwise the
+name of an output variable; any other UPPERCASE value (e.g. a plain
+Python list) is also bound as a tensor input; lowercase non-array values
+are attributes. Lowercase output slots are requested via
 ``run(outs=...)``.
 """
 from __future__ import annotations
@@ -141,8 +143,11 @@ class OperatorFactory:
             raise ValueError("Operator %r has no registered TPU kernel" % type)
 
         def _is_tensor(v):
-            # np.ndarray AND jax.Array (duck-typed: both carry shape+dtype)
-            return hasattr(v, "shape") and hasattr(v, "dtype")
+            # np.ndarray AND jax.Array (duck-typed: both carry
+            # shape+dtype), but not numpy scalars (np.float32(2.0) is an
+            # attribute value, not a tensor)
+            return (hasattr(v, "shape") and hasattr(v, "dtype")
+                    and not isinstance(v, np.generic))
 
         inputs, named, attrs = {}, {}, {}
         for key, val in kwargs.items():

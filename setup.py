@@ -1,0 +1,73 @@
+"""Package build for paddle_tpu (reference capability: the repo's own
+setup.py / cmake packaging, python/setup.py.in).
+
+The C++ runtime (recordio / channels / staging arena / serving loop,
+paddle_tpu/runtime/runtime.cc) is compiled as a plain shared library via
+a custom build step — it is loaded with ctypes, not as a Python
+extension module, so ABI tags don't apply. Environments without a
+toolchain still work: the ctypes layer falls back to the pure-Python
+implementation at import time.
+
+    pip install .          # builds runtime.cc if g++ is available
+    python setup.py bdist_wheel
+"""
+import os
+import subprocess
+
+from setuptools import Command, find_packages, setup
+from setuptools.command.build_py import build_py
+
+
+class BuildRuntime(Command):
+    """Compile runtime.cc into the package tree (best-effort)."""
+
+    description = "build the C++ runtime shared library"
+    user_options = []
+
+    def initialize_options(self):
+        pass
+
+    def finalize_options(self):
+        pass
+
+    def run(self):
+        here = os.path.dirname(os.path.abspath(__file__))
+        import sys
+
+        sys.path.insert(0, here)
+        try:
+            from paddle_tpu.runtime.build import build_error, lib_path
+
+            out = lib_path()
+            if out:
+                print("built C++ runtime:", out)
+            else:
+                print("C++ runtime not built (pure-python fallback "
+                      "will be used):", build_error())
+        finally:
+            sys.path.pop(0)
+
+
+class BuildPyWithRuntime(build_py):
+    def run(self):
+        self.run_command("build_runtime")
+        super().run()
+
+
+setup(
+    name="paddle_tpu",
+    version="0.1.0",
+    description=("TPU-native deep learning framework with PaddlePaddle "
+                 "Fluid's API and capabilities (JAX/XLA/Pallas compute, "
+                 "GSPMD distribution, C++ host runtime)"),
+    packages=find_packages(include=["paddle_tpu", "paddle_tpu.*"]),
+    package_data={"paddle_tpu.runtime": ["runtime.cc", "_ptrt_*.so"]},
+    python_requires=">=3.9",
+    install_requires=["jax", "numpy"],
+    extras_require={
+        "checkpoint": ["orbax-checkpoint"],
+        "test": ["pytest"],
+    },
+    cmdclass={"build_runtime": BuildRuntime,
+              "build_py": BuildPyWithRuntime},
+)

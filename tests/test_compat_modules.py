@@ -301,3 +301,12 @@ def test_operator_factory_named_requires_scope():
     op = Operator("scale", X="xin", Out="yout", scale=2.0)
     with pytest.raises(ValueError):
         op.run()  # named slots without a scope
+
+
+def test_operator_factory_numpy_scalar_attr():
+    from paddle_tpu.op import Operator
+
+    # numpy scalars are attribute values, never tensor inputs
+    out = Operator("scale", X=np.arange(3, dtype=np.float32),
+                   scale=np.float32(2.0)).run()["Out"]
+    np.testing.assert_allclose(out, [0.0, 2.0, 4.0])
