@@ -39,14 +39,25 @@ class RecordIOError(IOError):
 _lib = None
 
 
+_load_failed = False
+
+
 def _load():
-    global _lib
+    global _lib, _load_failed
     if _lib is not None:
         return _lib
+    if _load_failed:
+        return None
     path = lib_path()
     if path is None:
         return None
-    lib = ctypes.CDLL(path)
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError:
+        # a prebuilt .so from a wheel for another platform/ABI: fall back
+        # to the pure-Python implementation rather than crash
+        _load_failed = True
+        return None
     lib.ptrt_rio_writer_open.restype = ctypes.c_void_p
     lib.ptrt_rio_writer_open.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
     lib.ptrt_rio_writer_write.restype = ctypes.c_int

@@ -1,21 +1,31 @@
 """Package build for paddle_tpu (reference capability: the repo's own
 setup.py / cmake packaging, python/setup.py.in).
 
-The C++ runtime (recordio / channels / staging arena / serving loop,
-paddle_tpu/runtime/runtime.cc) is compiled as a plain shared library via
-a custom build step — it is loaded with ctypes, not as a Python
-extension module, so ABI tags don't apply. Environments without a
-toolchain still work: the ctypes layer falls back to the pure-Python
-implementation at import time.
-
-    pip install .          # builds runtime.cc if g++ is available
-    python setup.py bdist_wheel
+Metadata lives in pyproject.toml; this file only supplies what PEP 621
+cannot express: the custom build step that compiles the C++ runtime
+(paddle_tpu/runtime/runtime.cc) and the platform wheel tag. The runtime
+is loaded with ctypes (not a Python extension), and environments where
+it cannot build or load fall back to the pure-Python implementation.
 """
+import importlib.util
 import os
-import subprocess
+import sys
 
-from setuptools import Command, find_packages, setup
+from setuptools import Command, Distribution, setup
 from setuptools.command.build_py import build_py
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _load_build_module():
+    """Import runtime/build.py directly — it is stdlib-only. Importing it
+    through the package would execute paddle_tpu/__init__.py, which needs
+    jax and is unavailable in an isolated PEP 517 build env."""
+    path = os.path.join(_HERE, "paddle_tpu", "runtime", "build.py")
+    spec = importlib.util.spec_from_file_location("_ptrt_build", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 class BuildRuntime(Command):
@@ -31,21 +41,13 @@ class BuildRuntime(Command):
         pass
 
     def run(self):
-        here = os.path.dirname(os.path.abspath(__file__))
-        import sys
-
-        sys.path.insert(0, here)
-        try:
-            from paddle_tpu.runtime.build import build_error, lib_path
-
-            out = lib_path()
-            if out:
-                print("built C++ runtime:", out)
-            else:
-                print("C++ runtime not built (pure-python fallback "
-                      "will be used):", build_error())
-        finally:
-            sys.path.pop(0)
+        build = _load_build_module()
+        out = build.lib_path()
+        if out:
+            print("built C++ runtime:", out)
+        else:
+            print("C++ runtime not built (pure-python fallback will be "
+                  "used):", build.build_error(), file=sys.stderr)
 
 
 class BuildPyWithRuntime(build_py):
@@ -54,20 +56,16 @@ class BuildPyWithRuntime(build_py):
         super().run()
 
 
+class BinaryDistribution(Distribution):
+    """The bundled .so is platform-specific: force a platform wheel tag
+    so a linux-x86_64 wheel is never installed on another platform."""
+
+    def has_ext_modules(self):
+        return True
+
+
 setup(
-    name="paddle_tpu",
-    version="0.1.0",
-    description=("TPU-native deep learning framework with PaddlePaddle "
-                 "Fluid's API and capabilities (JAX/XLA/Pallas compute, "
-                 "GSPMD distribution, C++ host runtime)"),
-    packages=find_packages(include=["paddle_tpu", "paddle_tpu.*"]),
-    package_data={"paddle_tpu.runtime": ["runtime.cc", "_ptrt_*.so"]},
-    python_requires=">=3.9",
-    install_requires=["jax", "numpy"],
-    extras_require={
-        "checkpoint": ["orbax-checkpoint"],
-        "test": ["pytest"],
-    },
     cmdclass={"build_runtime": BuildRuntime,
               "build_py": BuildPyWithRuntime},
+    distclass=BinaryDistribution,
 )
