@@ -116,6 +116,9 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
         raise ValueError("only mining_type='max_negative' is supported")
     helper = LayerHelper("ssd_loss")
     b, np_, c = confidence.shape
+    # dynamic batch (data vars declare -1): flattened row counts must stay
+    # -1, not -1 * Np
+    bnp = b * np_ if b > 0 else -1
 
     # 1. match priors to ground truth by IoU
     iou = iou_similarity(gt_box, prior_box)  # (B, G, Np)
@@ -130,8 +133,8 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
         gt_label_f, matched, mismatch_value=background_label)
     target_label = tensor_layers.cast(target_label_f, "int64")  # (B, Np, 1)
 
-    conf_flat = nn.reshape(confidence, shape=[b * np_, c])
-    label_flat = nn.reshape(target_label, shape=[b * np_, 1])
+    conf_flat = nn.reshape(confidence, shape=[bnp, c])
+    label_flat = nn.reshape(target_label, shape=[bnp, 1])
     conf_loss = nn.softmax_with_cross_entropy(conf_flat, label_flat)
     conf_loss = nn.reshape(conf_loss, shape=[b, np_])
 
@@ -144,8 +147,8 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
     matched_gt_box, pos_weight = target_assign(gt_box, matched)
     loc_target = box_coder(prior_box, prior_box_var, matched_gt_box)
     loc_diff = nn.smooth_l1(
-        nn.reshape(location, shape=[b * np_, 4]),
-        nn.reshape(loc_target, shape=[b * np_, 4]))
+        nn.reshape(location, shape=[bnp, 4]),
+        nn.reshape(loc_target, shape=[bnp, 4]))
     loc_loss = nn.reshape(loc_diff, shape=[b, np_])
 
     # 5. weighted sum, normalized by matched-prior count
@@ -158,7 +161,8 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
                  scale=conf_loss_weight))
     if normalize:
         denom = nn.reduce_sum(pos_w)
-        denom = ops_layers.clip(denom, min=1.0, max=float(b * np_))
+        denom = ops_layers.clip(denom, min=1.0,
+                                max=float(b * np_) if b > 0 else 1e30)
         loss = ops_layers.elementwise_div(loss, denom)
     return nn.reshape(loss, shape=[b, np_, 1])
 

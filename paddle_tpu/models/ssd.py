@@ -1,0 +1,64 @@
+"""Compact SSD object detector (reference capability: the fluid SSD
+pipeline — layers/detection.py multi_box_head/ssd_loss/detection_output,
+exercised by the reference's object-detection tests).
+
+A small VGG-ish backbone feeds two detection scales into multi_box_head;
+training minimizes ssd_loss over dense padded ground truth
+(gt boxes/labels + gt_count replacing LoD), inference decodes with
+detection_output (decode + class-wise NMS). This assembles the whole
+detection surface into one trainable/decodable model.
+"""
+from __future__ import annotations
+
+from .. import layers
+
+__all__ = ["ssd_net", "get_model", "infer_outputs"]
+
+
+def _conv_block(x, ch, name):
+    x = layers.conv2d(x, num_filters=ch, filter_size=3, padding=1,
+                      act="relu")
+    return layers.pool2d(x, pool_size=2, pool_stride=2, pool_type="max")
+
+
+def ssd_net(image, num_classes=21, base_size=64):
+    """image (B, 3, S, S) -> (mbox_locs (B,P,4), mbox_confs (B,P,C),
+    boxes (P,4), variances (P,4)): two feature scales (S/8, S/16)."""
+    x = _conv_block(image, 16, "c1")    # S/2
+    x = _conv_block(x, 32, "c2")        # S/4
+    f1 = _conv_block(x, 64, "c3")       # S/8
+    f2 = _conv_block(f1, 64, "c4")      # S/16
+    return layers.multi_box_head(
+        inputs=[f1, f2], image=image, base_size=base_size,
+        num_classes=num_classes,
+        aspect_ratios=[[2.0], [2.0, 3.0]],
+        min_sizes=[base_size * 0.2, base_size * 0.4],
+        max_sizes=[base_size * 0.4, base_size * 0.7],
+        offset=0.5, flip=True, clip=True)
+
+
+def get_model(num_classes=21, image_size=64, max_gt=8):
+    """(avg_cost, (locs, confs, boxes, vars), feed_vars) training graph."""
+    image = layers.data(name="image", shape=[3, image_size, image_size])
+    gt_box = layers.data(name="gt_box", shape=[max_gt, 4])
+    gt_label = layers.data(name="gt_label", shape=[max_gt, 1], dtype="int64")
+    gt_count = layers.data(name="gt_count", shape=[], dtype="int32")
+
+    locs, confs, boxes, variances = ssd_net(image, num_classes, image_size)
+    loss = layers.ssd_loss(locs, confs, gt_box, gt_label, boxes, variances,
+                           gt_count=gt_count)
+    avg_cost = layers.reduce_mean(loss)
+    return avg_cost, (locs, confs, boxes, variances), [
+        image, gt_box, gt_label, gt_count]
+
+
+def infer_outputs(num_classes=21, image_size=64, nms_threshold=0.45,
+                  keep_top_k=50):
+    """Inference graph: image -> (detections (B, K, 6), counts (B,))."""
+    image = layers.data(name="image", shape=[3, image_size, image_size])
+    locs, confs, boxes, variances = ssd_net(image, num_classes, image_size)
+    probs = layers.softmax(confs)
+    out, count = layers.detection_output(
+        locs, probs, boxes, variances, nms_threshold=nms_threshold,
+        keep_top_k=keep_top_k)
+    return image, out, count

@@ -147,3 +147,59 @@ def test_recommender_trains():
     losses = _run_steps(prog, startup, feed, [avg_cost], steps=6)
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
+
+
+def test_ssd_trains_and_decodes():
+    """The full detection surface in one model: multi_box_head priors +
+    heads, ssd_loss training (loss decreases on a fixed batch), and
+    detection_output decoding with sane outputs."""
+    from paddle_tpu.models import ssd
+
+    B, S, C, G = 2, 64, 6, 4
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = startup.random_seed = 5
+    with fluid.program_guard(prog, startup):
+        with fluid.unique_name.guard():
+            avg_cost, _, feeds = ssd.get_model(
+                num_classes=C, image_size=S, max_gt=G)
+            optimizer.Adam(learning_rate=1e-3).minimize(avg_cost)
+
+    r = np.random.RandomState(0)
+    boxes = np.zeros((B, G, 4), np.float32)
+    for b in range(B):
+        for g in range(G):
+            x1, y1 = r.uniform(0, 0.6, 2)
+            boxes[b, g] = [x1, y1, x1 + r.uniform(0.15, 0.35),
+                           y1 + r.uniform(0.15, 0.35)]
+    feed = {
+        "image": r.randn(B, 3, S, S).astype(np.float32),
+        "gt_box": np.clip(boxes, 0, 1),
+        "gt_label": r.randint(1, C, (B, G, 1)).astype(np.int64),
+        "gt_count": np.array([G, G - 1], np.int32),
+    }
+    losses = _run_steps(prog, startup, feed, [avg_cost], steps=6)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+    # inference graph decodes without error and respects output contract
+    iprog, istartup = fluid.Program(), fluid.Program()
+    iprog.random_seed = istartup.random_seed = 5
+    with fluid.program_guard(iprog, istartup):
+        with fluid.unique_name.guard():
+            img_v, out_v, cnt_v = ssd.infer_outputs(
+                num_classes=C, image_size=S, keep_top_k=20)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(istartup)
+        dets, counts = exe.run(iprog, feed={"image": feed["image"]},
+                               fetch_list=[out_v, cnt_v])
+    dets, counts = np.asarray(dets), np.asarray(counts)
+    assert dets.shape[0] == B and dets.shape[2] == 6
+    for b in range(B):
+        n = int(counts[b])
+        assert 0 <= n <= dets.shape[1]
+        if n:
+            valid = dets[b, :n]
+            assert (valid[:, 0] >= 0).all() and (valid[:, 0] < C).all()
+            assert (valid[:, 1] >= 0).all() and (valid[:, 1] <= 1).all()
