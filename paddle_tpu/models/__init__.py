@@ -17,3 +17,4 @@ from . import se_resnext  # noqa: F401
 from . import srl  # noqa: F401
 from . import seq2seq  # noqa: F401
 from . import recommender  # noqa: F401
+from . import ssd  # noqa: F401

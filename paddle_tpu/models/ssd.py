@@ -15,7 +15,7 @@ from .. import layers
 __all__ = ["ssd_net", "get_model", "infer_outputs"]
 
 
-def _conv_block(x, ch, name):
+def _conv_block(x, ch):
     x = layers.conv2d(x, num_filters=ch, filter_size=3, padding=1,
                       act="relu")
     return layers.pool2d(x, pool_size=2, pool_stride=2, pool_type="max")
@@ -24,10 +24,10 @@ def _conv_block(x, ch, name):
 def ssd_net(image, num_classes=21, base_size=64):
     """image (B, 3, S, S) -> (mbox_locs (B,P,4), mbox_confs (B,P,C),
     boxes (P,4), variances (P,4)): two feature scales (S/8, S/16)."""
-    x = _conv_block(image, 16, "c1")    # S/2
-    x = _conv_block(x, 32, "c2")        # S/4
-    f1 = _conv_block(x, 64, "c3")       # S/8
-    f2 = _conv_block(f1, 64, "c4")      # S/16
+    x = _conv_block(image, 16)    # S/2
+    x = _conv_block(x, 32)        # S/4
+    f1 = _conv_block(x, 64)       # S/8
+    f2 = _conv_block(f1, 64)      # S/16
     return layers.multi_box_head(
         inputs=[f1, f2], image=image, base_size=base_size,
         num_classes=num_classes,

@@ -66,21 +66,23 @@ class BinaryDistribution(Distribution):
 
 _cmdclass = {"build_runtime": BuildRuntime, "build_py": BuildPyWithRuntime}
 
-try:
+try:  # setuptools >= 70.1 ships bdist_wheel; older needs the wheel pkg
+    from setuptools.command.bdist_wheel import bdist_wheel
+except ImportError:  # pragma: no cover
     from wheel.bdist_wheel import bdist_wheel
 
-    class PlatWheel(bdist_wheel):
-        """py3-none-<platform> tag: the .so is ctypes-loaded (no CPython
-        ABI dependence), so pinning the builder's cp-ABI would wrongly
-        reject other Python minors; only the platform must match."""
 
-        def get_tag(self):
-            _, _, plat = super().get_tag()
-            return "py3", "none", plat
+class PlatWheel(bdist_wheel):
+    """py3-none-<platform> tag: the .so is ctypes-loaded (no CPython
+    ABI dependence), so pinning the builder's cp-ABI would wrongly
+    reject other Python minors; only the platform must match."""
 
-    _cmdclass["bdist_wheel"] = PlatWheel
-except ImportError:  # wheel not installed: sdist-only builds still work
-    pass
+    def get_tag(self):
+        _, _, plat = super().get_tag()
+        return "py3", "none", plat
+
+
+_cmdclass["bdist_wheel"] = PlatWheel
 
 
 setup(cmdclass=_cmdclass, distclass=BinaryDistribution)

@@ -1,0 +1,155 @@
+"""Dynamic-batch sweep: the standard fluid idiom declares data vars with
+a -1 batch dim (append_batch_size=True). Layers that fold the batch size
+into shape arithmetic break on that idiom (ssd_loss did: reshape target
+[-352, 6]); this sweep builds representative graphs with dynamic batch
+and runs them at two different batch sizes through the same program."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, nets
+
+
+def _run(build, feeds_by_batch):
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = startup.random_seed = 1
+    with fluid.program_guard(prog, startup):
+        with fluid.unique_name.guard():
+            out = build()
+    outs = list(out) if isinstance(out, (list, tuple)) else [out]
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    results = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for feed in feeds_by_batch:
+            results.append(exe.run(prog, feed=feed, fetch_list=outs))
+    return results
+
+
+def _feeds(maker):
+    return [maker(3), maker(5)]  # same program, two batch sizes
+
+
+def test_mlp_loss_dynamic_batch():
+    def build():
+        x = layers.data(name="x", shape=[8])
+        y = layers.data(name="y", shape=[1], dtype="int64")
+        h = layers.fc(x, 16, act="relu")
+        return layers.mean(layers.softmax_with_cross_entropy(
+            layers.fc(h, 3), y))
+
+    r = np.random.RandomState(0)
+    res = _run(build, _feeds(lambda b: {
+        "x": r.randn(b, 8).astype(np.float32),
+        "y": r.randint(0, 3, (b, 1)).astype(np.int64)}))
+    for (v,) in res:
+        assert np.isfinite(np.asarray(v)).all()
+
+
+def test_conv_bn_pool_dynamic_batch():
+    def build():
+        img = layers.data(name="img", shape=[3, 16, 16])
+        c = layers.conv2d(img, num_filters=4, filter_size=3, padding=1)
+        c = layers.batch_norm(c)
+        p = layers.pool2d(c, pool_size=2, pool_stride=2)
+        return layers.fc(layers.flatten(p, axis=1), size=2)
+
+    r = np.random.RandomState(1)
+    res = _run(build, _feeds(lambda b: {
+        "img": r.randn(b, 3, 16, 16).astype(np.float32)}))
+    assert np.asarray(res[0][0]).shape[0] == 3
+    assert np.asarray(res[1][0]).shape[0] == 5
+
+
+def test_sequence_stack_dynamic_batch():
+    T, D = 6, 4
+
+    def build():
+        words = layers.data(name="w", shape=[T], dtype="int64")
+        lens = layers.data(name="lens", shape=[], dtype="int32")
+        emb = layers.embedding(words, size=[20, D])
+        conv = nets.sequence_conv_pool(emb, num_filters=D, filter_size=3,
+                                       sequence_length=lens)
+        gru = layers.dynamic_gru(
+            layers.fc(emb, D * 3, num_flatten_dims=2), size=D,
+            sequence_length=lens)
+        last = layers.sequence_last_step(gru, sequence_length=lens)
+        return layers.fc(layers.concat([conv, last], axis=1), size=2)
+
+    r = np.random.RandomState(2)
+    res = _run(build, _feeds(lambda b: {
+        "w": r.randint(0, 20, (b, T)).astype(np.int64),
+        "lens": r.randint(1, T + 1, b).astype(np.int32)}))
+    assert np.asarray(res[0][0]).shape == (3, 2)
+    assert np.asarray(res[1][0]).shape == (5, 2)
+
+
+def test_nce_hsigmoid_dynamic_batch():
+    def build():
+        x = layers.data(name="x", shape=[6])
+        y = layers.data(name="y", shape=[1], dtype="int64")
+        nce = layers.nce(input=x, label=y, num_total_classes=12,
+                         num_neg_samples=3)
+        hs = layers.hsigmoid(input=x, label=y, num_classes=12)
+        return [layers.mean(nce), layers.mean(hs)]
+
+    r = np.random.RandomState(3)
+    res = _run(build, _feeds(lambda b: {
+        "x": r.randn(b, 6).astype(np.float32),
+        "y": r.randint(0, 12, (b, 1)).astype(np.int64)}))
+    for vals in res:
+        for v in vals:
+            assert np.isfinite(np.asarray(v)).all()
+
+
+def test_crf_dynamic_batch():
+    T, N = 5, 4
+
+    def build():
+        emission = layers.data(name="em", shape=[T, N])
+        label = layers.data(name="lb", shape=[T], dtype="int64")
+        lens = layers.data(name="lens", shape=[], dtype="int32")
+        ll = layers.linear_chain_crf(emission, label,
+                                     param_attr=fluid.ParamAttr(name="crfw"),
+                                     sequence_length=lens)
+        return layers.mean(ll)
+
+    r = np.random.RandomState(4)
+    res = _run(build, _feeds(lambda b: {
+        "em": r.randn(b, T, N).astype(np.float32),
+        "lb": r.randint(0, N, (b, T)).astype(np.int64),
+        "lens": r.randint(1, T + 1, b).astype(np.int32)}))
+    for (v,) in res:
+        assert np.isfinite(np.asarray(v)).all()
+
+
+def test_detection_stack_dynamic_batch():
+    S, C, G = 32, 5, 3
+
+    def build():
+        img = layers.data(name="img", shape=[3, S, S])
+        gt_box = layers.data(name="gt_box", shape=[G, 4])
+        gt_label = layers.data(name="gt_label", shape=[G, 1], dtype="int64")
+        gt_count = layers.data(name="gt_count", shape=[], dtype="int32")
+        feat = layers.conv2d(img, num_filters=8, filter_size=3, padding=1,
+                             stride=4)
+        locs, confs, boxes, variances = layers.multi_box_head(
+            inputs=[feat], image=img, base_size=S, num_classes=C,
+            aspect_ratios=[[2.0]], min_sizes=[8.0], max_sizes=[16.0])
+        loss = layers.ssd_loss(locs, confs, gt_box, gt_label, boxes,
+                               variances, gt_count=gt_count)
+        return layers.reduce_mean(loss)
+
+    r = np.random.RandomState(5)
+
+    def mk(b):
+        bx = np.sort(r.uniform(0, 1, (b, G, 2, 2)), axis=2)
+        return {"img": r.randn(b, 3, S, S).astype(np.float32),
+                "gt_box": bx.reshape(b, G, 4).astype(np.float32),
+                "gt_label": r.randint(1, C, (b, G, 1)).astype(np.int64),
+                "gt_count": np.full(b, G, np.int32)}
+
+    res = _run(build, _feeds(mk))
+    for (v,) in res:
+        assert np.isfinite(np.asarray(v)).all()
