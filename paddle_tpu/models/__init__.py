@@ -18,3 +18,4 @@ from . import srl  # noqa: F401
 from . import seq2seq  # noqa: F401
 from . import recommender  # noqa: F401
 from . import ssd  # noqa: F401
+from . import fit_a_line  # noqa: F401

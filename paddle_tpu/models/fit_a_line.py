@@ -1,0 +1,18 @@
+"""Linear regression on UCI housing (reference:
+python/paddle/fluid/tests/book/test_fit_a_line.py — the first book
+chapter: one fc, square-error cost)."""
+from __future__ import annotations
+
+from .. import layers
+
+__all__ = ["get_model"]
+
+
+def get_model():
+    """(avg_cost, y_predict, feed_vars) — 13 UCI housing features -> price."""
+    x = layers.data(name="x", shape=[13])
+    y = layers.data(name="y", shape=[1])
+    y_predict = layers.fc(input=x, size=1, act=None)
+    cost = layers.square_error_cost(input=y_predict, label=y)
+    avg_cost = layers.mean(cost)
+    return avg_cost, y_predict, [x, y]

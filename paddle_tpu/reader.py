@@ -317,7 +317,8 @@ class PipeReader:
         """Yield decoded lines (or raw buffers with cut_lines=False).
         Decoding is incremental so a multi-byte UTF-8 character split
         across read() chunks survives (the reference decodes chunkwise
-        and dies on that boundary)."""
+        and dies on that boundary). The subprocess is reaped when the
+        stream ends."""
         import codecs
 
         decoder = codecs.getincrementaldecoder("utf-8")()
@@ -339,5 +340,30 @@ class PipeReader:
                 yield decomp_buff
             if final:
                 break
+        self.close()
         if remained:
             yield remained
+
+    def close(self):
+        """Close the pipe and reap the child (also called automatically
+        when get_line drains the stream). A child that ignores the closed
+        pipe (e.g. `tail -f` abandoned mid-stream) is terminated rather
+        than waited on forever."""
+        if self.process.stdout and not self.process.stdout.closed:
+            self.process.stdout.close()
+        try:
+            self.process.wait(timeout=1.0)
+        except Exception:
+            self.process.terminate()
+            try:
+                self.process.wait(timeout=1.0)
+            except Exception:
+                self.process.kill()
+                self.process.wait()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False

@@ -203,3 +203,25 @@ def test_ssd_trains_and_decodes():
             valid = dets[b, :n]
             assert (valid[:, 0] >= 0).all() and (valid[:, 0] < C).all()
             assert (valid[:, 1] >= 0).all() and (valid[:, 1] <= 1).all()
+
+
+def test_fit_a_line_converges_to_exact_fit():
+    """Linear data -> the linear model must drive the loss near zero and
+    recover the true coefficients (SURVEY §4's 'linear regression exact
+    fit' convergence check) using the uci_housing feature schema."""
+    from paddle_tpu.models import fit_a_line
+
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = startup.random_seed = 2
+    with fluid.program_guard(prog, startup):
+        with fluid.unique_name.guard():
+            avg_cost, y_pred, feeds = fit_a_line.get_model()
+            optimizer.SGD(learning_rate=0.05).minimize(avg_cost)
+
+    r = np.random.RandomState(0)
+    w_true = r.randn(13, 1).astype(np.float32)
+    xs = r.randn(64, 13).astype(np.float32)
+    ys = xs @ w_true + 0.5
+    feed = {"x": xs, "y": ys.astype(np.float32)}
+    losses = _run_steps(prog, startup, feed, [avg_cost], steps=200)
+    assert losses[-1] < 1e-3, losses[-1]

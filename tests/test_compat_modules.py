@@ -310,3 +310,15 @@ def test_operator_factory_numpy_scalar_attr():
     out = Operator("scale", X=np.arange(3, dtype=np.float32),
                    scale=np.float32(2.0)).run()["Out"]
     np.testing.assert_allclose(out, [0.0, 2.0, 4.0])
+
+
+def test_pipe_reader_abandoned_stream_terminates(tmp_path):
+    import time
+
+    from paddle_tpu.reader import PipeReader
+
+    t0 = time.monotonic()
+    with PipeReader("sleep 300") as pr:
+        pass  # abandon without reading: close() must not hang on wait()
+    assert time.monotonic() - t0 < 10
+    assert pr.process.poll() is not None  # child reaped

@@ -84,8 +84,10 @@ def module_all(path):
     if "__all__" not in lists:
         return None
     symbols = set(lists["__all__"])
-    # pull in list variables referenced on any __all__ line
-    for m in re.finditer(r"^__all__\s*\+?=\s*(.+?)(?=^\S)", src, re.S | re.M):
+    # pull in list variables referenced on any __all__ line (\Z: the
+    # statement may be the last thing in the file)
+    for m in re.finditer(r"^__all__\s*\+?=\s*(.+?)(?=^\S|\Z)", src,
+                         re.S | re.M):
         for ref in re.findall(r"\b(__\w+__|\w+)\b", m.group(1)):
             if ref != "__all__" and ref in lists:
                 symbols |= lists[ref]
