@@ -149,8 +149,36 @@ class Executor:
         self._base_keys: Dict = {}
 
     # -- compilation -----------------------------------------------------
-    def _compile(self, program: Program, feed_sig, fetch_names, scope: Scope) -> _Compiled:
+    @staticmethod
+    def _check_feed_shapes(program: Program, feed_sig, only_names=None):
+        """Fail fast with the variable name when a feed's shape can't
+        match its declaration (wrong rank, or a static dim mismatch);
+        otherwise the error surfaces deep inside some consuming op's
+        trace. Runs only on compile (a changed shape is a cache miss).
+        `only_names` restricts the check to user-supplied feeds —
+        reader-op injected batches may legitimately diverge from their
+        declared shape (a partial final batch just recompiles)."""
+        gb = program.global_block()
+        for name, shape, _dtype in feed_sig:
+            if only_names is not None and name not in only_names:
+                continue
+            var = gb._find_var_recursive(name)
+            declared = getattr(var, "shape", None) if var is not None else None
+            if not declared:
+                continue
+            declared = tuple(declared)
+            ok = len(declared) == len(shape) and all(
+                d in (-1, None) or d == s for d, s in zip(declared, shape))
+            if not ok:
+                raise ValueError(
+                    "feed %r has shape %s but the program declares %s "
+                    "(-1 = any); fix the feed or the layers.data "
+                    "declaration" % (name, tuple(shape), declared))
+
+    def _compile(self, program: Program, feed_sig, fetch_names, scope: Scope,
+                 user_feed_names=None) -> _Compiled:
         feed_names = tuple(n for n, _, _ in feed_sig)
+        self._check_feed_shapes(program, feed_sig, user_feed_names)
         # static pre-compile verification (SURVEY aux: race-detection
         # equivalent): hard errors raise here with op context; write-once
         # findings only warn
@@ -255,7 +283,8 @@ class Executor:
             profiler.record_cache(compiled is not None)
         first_run = compiled is None
         if compiled is None:
-            compiled = self._compile(program, feed_sig, fetch_names, scope)
+            compiled = self._compile(program, feed_sig, fetch_names, scope,
+                                     user_feed_names=frozenset(feed))
             if use_program_cache:
                 self._cache[key] = compiled
 

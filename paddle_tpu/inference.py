@@ -108,6 +108,10 @@ class Predictor:
                          for n, a in sorted(feed_arrays.items()))
         if feed_sig in self._compiled:
             return self._compiled[feed_sig]
+        from .executor import Executor
+
+        # fail fast with the variable name on an impossible feed shape
+        Executor._check_feed_shapes(self._program, feed_sig)
 
         loaded = None
         path = os.path.join(self._cache_dir, self._key(feed_sig) + ".xla")

@@ -138,8 +138,13 @@ class ParallelExecutor:
 
     # -- compilation -----------------------------------------------------
     def _compile(self, feed_sig, fetch_names) -> _ParCompiled:
+        from ..executor import Executor
+
         program = self._program
         feed_names = tuple(n for n, _, _ in feed_sig)
+        # same fail-fast shape validation as the single-device executor
+        # (all ParallelExecutor feeds are user-supplied)
+        Executor._check_feed_shapes(program, feed_sig)
         state_in, state_out = analyze_state(program, set(feed_names))
         missing = [n for n in state_in if self._scope.find_var(n) is None]
         if missing:

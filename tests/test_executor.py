@@ -154,3 +154,20 @@ def test_two_optimizers_both_train():
         w2_1 = np.array(scope.find_var("w2"))
     assert not np.allclose(w1_0, w1_1), "first optimizer's update was lost"
     assert not np.allclose(w2_0, w2_1), "second optimizer's update was lost"
+
+
+def test_feed_shape_mismatch_names_the_variable():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data(name="xval", shape=[8])
+        out = fluid.layers.fc(x, 4)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with pytest.raises(ValueError, match="xval.*declares"):
+            exe.run(prog, feed={"xval": np.zeros((2, 5), np.float32)},
+                    fetch_list=[out])
+        # correct shape still runs
+        exe.run(prog, feed={"xval": np.zeros((2, 8), np.float32)},
+                fetch_list=[out])

@@ -181,3 +181,28 @@ def test_py_reader_tensor_provider_and_reset():
                     break
                 vals.append(float(np.asarray(ov)[0, 0]))
             assert vals == [0.0, 2.0, 4.0]
+
+
+def test_partial_final_batch_recompiles_not_raises():
+    """A reader pipeline's last (smaller) batch may diverge from the
+    declared static batch size: the executor must recompile and run it,
+    not fail the user-feed shape validation (that check covers only
+    feed-dict entries)."""
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        reader = fluid.layers.py_reader(
+            capacity=4, shapes=[[4, 6]], dtypes=["float32"],
+            use_double_buffer=False)
+        (x,) = fluid.layers.read_file(reader)
+        out = fluid.layers.fc(x, 2)
+    batches = [np.ones((4, 6), np.float32), np.ones((2, 6), np.float32)]
+    reader.decorate_tensor_provider(lambda: iter([(b,) for b in batches]))
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        reader.start()
+        r1 = exe.run(prog, fetch_list=[out])
+        r2 = exe.run(prog, fetch_list=[out])
+    assert np.asarray(r1[0]).shape == (4, 2)
+    assert np.asarray(r2[0]).shape == (2, 2)  # partial batch ran
