@@ -27,10 +27,13 @@ _ACT = {
 
 
 def _lstm_scan(xx, w, bias, use_peepholes, h0, c0, lengths, gate_act,
-               cell_act, cand_act, is_reverse):
+               cell_act, cand_act, is_reverse, w_proj=None, proj_act=None):
     """Shared LSTM recurrence over pre-projected gates xx (B, T, 4H);
-    used by the `lstm` kernel and the `fusion_lstm` composition."""
-    hidden = w.shape[0]
+    used by the `lstm` kernel, the `fusion_lstm` composition, and (with
+    w_proj (H, P) + proj_act) the projected `lstmp` variant — there the
+    recurrent/emitted state is the P-dim projection of the hidden."""
+    hidden = w_proj.shape[0] if w_proj is not None else w.shape[0]
+    carry_dim = w.shape[0]  # P with projection, H without
     batch, time = xx.shape[0], xx.shape[1]
     if bias is not None:
         b_gates = bias[..., : 4 * hidden].reshape(4 * hidden)
@@ -42,7 +45,7 @@ def _lstm_scan(xx, w, bias, use_peepholes, h0, c0, lengths, gate_act,
         b_gates = jnp.zeros((4 * hidden,), xx.dtype)
 
     if h0 is None:
-        h0 = jnp.zeros((batch, hidden), xx.dtype)
+        h0 = jnp.zeros((batch, carry_dim), xx.dtype)
     if c0 is None:
         c0 = jnp.zeros((batch, hidden), xx.dtype)
 
@@ -68,6 +71,8 @@ def _lstm_scan(xx, w, bias, use_peepholes, h0, c0, lengths, gate_act,
             go = go + c_new * w_oc
         o = gate_act(go)
         h_new = o * cell_act(c_new)
+        if w_proj is not None:
+            h_new = proj_act(h_new @ w_proj)
         if lengths is not None:
             valid = (t < lengths)[:, None]
             h_new = jnp.where(valid, h_new, h)
@@ -150,66 +155,19 @@ def _gru(ctx):
 @register_op("lstmp")
 def _lstmp(ctx):
     """LSTM with recurrent projection (reference: lstmp_op.cc). Input:
-    (batch, time, 4H) pre-projected; Weight: (P, 4H); ProjWeight: (H, P)."""
-    x = ctx.input("Input")
-    w = ctx.input("Weight")
-    w_proj = ctx.input("ProjWeight")
-    bias = ctx.input("Bias")
-    lengths = ctx.input("Lengths")
-    hidden = w_proj.shape[0]
-    proj = w_proj.shape[1]
-    gate_act = _ACT[ctx.attr("gate_activation", "sigmoid")]
-    cell_act = _ACT[ctx.attr("cell_activation", "tanh")]
-    cand_act = _ACT[ctx.attr("candidate_activation", "tanh")]
-    proj_act = _ACT[ctx.attr("proj_activation", "tanh")]
-    use_peepholes = ctx.attr("use_peepholes", False)
-    is_reverse = ctx.attr("is_reverse", False)
-
-    batch, time = x.shape[0], x.shape[1]
-    if bias is not None:
-        b_gates = bias[..., : 4 * hidden].reshape(4 * hidden)
-        if use_peepholes:
-            w_ic = bias[..., 4 * hidden : 5 * hidden].reshape(hidden)
-            w_fc = bias[..., 5 * hidden : 6 * hidden].reshape(hidden)
-            w_oc = bias[..., 6 * hidden : 7 * hidden].reshape(hidden)
-    else:
-        b_gates = jnp.zeros((4 * hidden,), x.dtype)
-
-    r0 = jnp.zeros((batch, proj), x.dtype)
-    c0 = jnp.zeros((batch, hidden), x.dtype)
-    xs = jnp.swapaxes(x, 0, 1)
-    if is_reverse:
-        xs = jnp.flip(xs, 0)
-    ts = jnp.arange(time)
-    if is_reverse:
-        ts = jnp.flip(ts, 0)
-
-    def step(carry, inp):
-        r, c = carry
-        xt, t = inp
-        gates = xt + r @ w + b_gates
-        gi, gf, gc, go = jnp.split(gates, 4, axis=-1)
-        if use_peepholes:
-            gi = gi + c * w_ic
-            gf = gf + c * w_fc
-        i = gate_act(gi)
-        f = gate_act(gf)
-        c_new = f * c + i * cand_act(gc)
-        if use_peepholes:
-            go = go + c_new * w_oc
-        o = gate_act(go)
-        h_new = o * cell_act(c_new)
-        r_new = proj_act(h_new @ w_proj)
-        if lengths is not None:
-            valid = (t < lengths)[:, None]
-            r_new = jnp.where(valid, r_new, r)
-            c_new = jnp.where(valid, c_new, c)
-        return (r_new, c_new), (r_new, c_new)
-
-    (_, _), (rs, cs) = lax.scan(step, (r0, c0), (xs, ts))
-    if is_reverse:
-        rs, cs = jnp.flip(rs, 0), jnp.flip(cs, 0)
-    return {"Projection": jnp.swapaxes(rs, 0, 1), "Cell": jnp.swapaxes(cs, 0, 1)}
+    (batch, time, 4H) pre-projected; Weight: (P, 4H); ProjWeight: (H, P).
+    Same recurrence as `lstm` with the projection folded into the carry
+    (_lstm_scan's w_proj path)."""
+    rs, cs, _rT, _cT = _lstm_scan(
+        ctx.input("Input"), ctx.input("Weight"), ctx.input("Bias"),
+        ctx.attr("use_peepholes", False), None, None, ctx.input("Lengths"),
+        _ACT[ctx.attr("gate_activation", "sigmoid")],
+        _ACT[ctx.attr("cell_activation", "tanh")],
+        _ACT[ctx.attr("candidate_activation", "tanh")],
+        ctx.attr("is_reverse", False),
+        w_proj=ctx.input("ProjWeight"),
+        proj_act=_ACT[ctx.attr("proj_activation", "tanh")])
+    return {"Projection": rs, "Cell": cs}
 
 
 @register_op("lstm_unit")
