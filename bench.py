@@ -84,7 +84,8 @@ def bench_lm(dev):
                                  dtype="int64", append_batch_size=False)
             loss, _ = models.transformer.transformer_lm(
                 ids, labels, vocab_size=VOCAB, n_layer=N_LAYER, n_head=N_HEAD,
-                d_model=D_MODEL, d_inner=D_INNER, max_len=SEQ)
+                d_model=D_MODEL, d_inner=D_INNER, max_len=SEQ,
+                fused_qkv=_os.environ.get("PADDLE_TPU_FUSED_QKV", "0") == "1")
             optimizer.Adam(learning_rate=1e-4).minimize(loss)
         if AMP:
             main_p.enable_mixed_precision()  # bf16 matmuls, fp32 master weights

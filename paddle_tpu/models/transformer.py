@@ -33,7 +33,7 @@ def _linear(x, size, name=None, num_flatten_dims=2, act=None):
 def multi_head_attention(
     q_in, kv_in, n_head, d_model, dropout_rate=0.0, causal=False,
     kv_lengths=None, name=None, use_fused=True, use_ring=False,
-    sp_axis="sp",
+    sp_axis="sp", fused_qkv=False,
 ):
     """(B, Tq, D) x (B, Tk, D) -> (B, Tq, D).
 
@@ -42,22 +42,39 @@ def multi_head_attention(
     training batches fit a single v5e. use_ring=True routes through the
     ring_attention op instead — sequence-parallel over the mesh's
     `sp_axis` (long-context path). The unfused path is kept for numerics
-    debugging."""
+    debugging.
+
+    fused_qkv=True (self-attention only) computes q/k/v in ONE
+    (D, 3D) matmul whose output columns are grouped per head
+    [h0:q,k,v | h1:q,k,v | ...], so the Megatron column-parallel split
+    over `mp` keeps whole (q,k,v) head groups on each device — tp-safe.
+    Opt-in pending on-hardware measurement (tools/sweep_bench.sh)."""
     B, Tq, _ = q_in.shape
     Tk = kv_in.shape[1]
     d_head = d_model // n_head
-
-    q = _linear(q_in, d_model, name and name + ".q")
-    k = _linear(kv_in, d_model, name and name + ".k")
-    v = _linear(kv_in, d_model, name and name + ".v")
 
     def split_heads(x, T):
         x = layers.reshape(x, shape=[B, T, n_head, d_head])
         return layers.transpose(x, perm=[0, 2, 1, 3])  # (B, H, T, Dh)
 
-    q = split_heads(q, Tq)
-    k = split_heads(k, Tk)
-    v = split_heads(v, Tk)
+    if fused_qkv and q_in is not kv_in:
+        raise ValueError(
+            "fused_qkv packs q/k/v of SELF-attention into one matmul; "
+            "pass the same Variable as q_in and kv_in (cross-attention "
+            "must use separate projections)")
+    if fused_qkv:
+        qkv = _linear(q_in, 3 * d_model, name and name + ".qkv")
+        # (B, T, H, 3, Dh): dim 3 separates q/k/v within each head group
+        qkv = layers.reshape(qkv, shape=[B, Tq, n_head, 3, d_head])
+        qkv = layers.transpose(qkv, perm=[3, 0, 2, 1, 4])  # (3, B, H, T, Dh)
+        q, k, v = layers.unstack(qkv, axis=0)
+    else:
+        q = _linear(q_in, d_model, name and name + ".q")
+        k = _linear(kv_in, d_model, name and name + ".k")
+        v = _linear(kv_in, d_model, name and name + ".v")
+        q = split_heads(q, Tq)
+        k = split_heads(k, Tk)
+        v = split_heads(v, Tk)
 
     if use_ring:
         if kv_lengths is not None or dropout_rate:
@@ -131,7 +148,7 @@ def encoder_layer(x, n_head, d_model, d_inner, dropout_rate, lengths, name):
 
 def decoder_layer(x, enc, n_head, d_model, d_inner, dropout_rate,
                   src_lengths, tgt_lengths, name, use_ring=False,
-                  sp_axis="sp", moe_experts=0):
+                  sp_axis="sp", moe_experts=0, fused_qkv=False):
     """`enc` must already be normalized (transformer_encoder output).
     moe_experts>0 swaps the dense FFN for a mixture-of-experts block
     (layers.moe_ffn) — expert-parallel under an ep mesh."""
@@ -139,7 +156,7 @@ def decoder_layer(x, enc, n_head, d_model, d_inner, dropout_rate,
     self_attn = multi_head_attention(
         h, h, n_head, d_model, dropout_rate,
         causal=True, kv_lengths=tgt_lengths, name=name + ".self",
-        use_ring=use_ring, sp_axis=sp_axis,
+        use_ring=use_ring, sp_axis=sp_axis, fused_qkv=fused_qkv,
     )
     x = layers.elementwise_add(x, self_attn)
     if enc is not None:
@@ -220,6 +237,7 @@ def transformer_lm(
     ids, labels, vocab_size, n_layer=4, n_head=8, d_model=512, d_inner=2048,
     dropout_rate=0.0, max_len=2048, fused_head=True,
     use_ring_attention=False, sp_axis="sp", moe_experts=0,
+    fused_qkv=False,
 ):
     """Decoder-only causal LM (flagship). Returns (avg_cost, logits).
 
@@ -232,13 +250,18 @@ def transformer_lm(
     runs the sequence-parallel ring (layers.ring_attention), so compiling
     under a ParallelExecutor whose mesh has `sp_axis` shards the sequence
     dim across chips — seq lengths far beyond one chip's HBM. The same
-    Program still runs on one device (exact-attention fallback)."""
+    Program still runs on one device (exact-attention fallback).
+
+    fused_qkv=True packs each layer's self-attention q/k/v into one
+    (D, 3D) matmul (see multi_head_attention); bench.py flips it from
+    PADDLE_TPU_FUSED_QKV so Program construction itself stays
+    deterministic under a given argument list."""
     x = _embed(ids, vocab_size, d_model, max_len, "lm")
     for i in range(n_layer):
         x = decoder_layer(x, None, n_head, d_model, d_inner, dropout_rate,
                           None, None, "lm.l%d" % i,
                           use_ring=use_ring_attention, sp_axis=sp_axis,
-                          moe_experts=moe_experts)
+                          moe_experts=moe_experts, fused_qkv=fused_qkv)
     x = _pre_norm(x)
     B, T = ids.shape
     if fused_head:

@@ -160,8 +160,12 @@ def megatron_transformer_plan(
     row_w = P(mp_axis, None)  # (in, out) split on in
     col_b = P(mp_axis)
     for pat, spec in [
-        (r"\.(q|k|v|fc1)\.w", col_w),
-        (r"\.(q|k|v|fc1)\.b", col_b),
+        # .qkv: the fused projection's columns are grouped per head
+        # [h0:q,k,v | h1:q,k,v | ...], so a contiguous column split over
+        # mp keeps whole head groups local — same comm pattern as
+        # separate q/k/v columns
+        (r"\.(q|k|v|qkv|fc1)\.w", col_w),
+        (r"\.(q|k|v|qkv|fc1)\.b", col_b),
         (r"\.(out|fc2)\.w", row_w),
         (r"\.(out|fc2)\.b", P()),
         (r"(tok|pos)_emb", P(None, mp_axis)),

@@ -143,3 +143,82 @@ def test_deepfm_trains():
     }
     losses = _train(avg_cost, lambda: feed, steps=10, lr=1e-2)
     assert losses[-1] < losses[0]
+
+
+def test_fused_qkv_matches_separate_projections():
+    """fused_qkv packs [h: q,k,v] per head group into one (D, 3D) matmul;
+    with weights copied from the separate q/k/v parameters the attention
+    output must be identical, and the column grouping must be the one the
+    Megatron plan's contiguous mp split assumes."""
+    from paddle_tpu.models.transformer import multi_head_attention
+
+    B, T, H, D = 2, 8, 4, 16
+    dh = D // H
+    r = np.random.RandomState(0)
+    x_in = r.randn(B, T, D).astype(np.float32)
+
+    def build(fused):
+        prog, startup = fluid.Program(), fluid.Program()
+        prog.random_seed = startup.random_seed = 1
+        with fluid.program_guard(prog, startup):
+            with fluid.unique_name.guard():
+                x = fluid.layers.data(name="x", shape=[B, T, D],
+                                      append_batch_size=False)
+                out = multi_head_attention(
+                    x, x, H, D, causal=True, name="attn",
+                    use_fused=False, fused_qkv=fused)
+        return prog, startup, out
+
+    # run the separate-projection version
+    prog_a, start_a, out_a = build(False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope_a = fluid.Scope()
+    with fluid.scope_guard(scope_a):
+        exe.run(start_a)
+        ref, = exe.run(prog_a, feed={"x": x_in}, fetch_list=[out_a])
+        wq = np.asarray(scope_a.find_var("attn.q.w"))
+        wk = np.asarray(scope_a.find_var("attn.k.w"))
+        wv = np.asarray(scope_a.find_var("attn.v.w"))
+        bq = np.asarray(scope_a.find_var("attn.q.b"))
+        bk = np.asarray(scope_a.find_var("attn.k.b"))
+        bv = np.asarray(scope_a.find_var("attn.v.b"))
+        wo = np.asarray(scope_a.find_var("attn.out.w"))
+        bo = np.asarray(scope_a.find_var("attn.out.b"))
+
+    # pack into the head-grouped fused layout
+    w_qkv = np.zeros((D, 3 * D), np.float32)
+    b_qkv = np.zeros((3 * D,), np.float32)
+    for h in range(H):
+        base = h * 3 * dh
+        w_qkv[:, base:base + dh] = wq[:, h * dh:(h + 1) * dh]
+        w_qkv[:, base + dh:base + 2 * dh] = wk[:, h * dh:(h + 1) * dh]
+        w_qkv[:, base + 2 * dh:base + 3 * dh] = wv[:, h * dh:(h + 1) * dh]
+        b_qkv[base:base + dh] = bq[h * dh:(h + 1) * dh]
+        b_qkv[base + dh:base + 2 * dh] = bk[h * dh:(h + 1) * dh]
+        b_qkv[base + 2 * dh:base + 3 * dh] = bv[h * dh:(h + 1) * dh]
+
+    prog_b, start_b, out_b = build(True)
+    scope_b = fluid.Scope()
+    with fluid.scope_guard(scope_b):
+        exe.run(start_b)
+        scope_b.set_var("attn.qkv.w", w_qkv)
+        scope_b.set_var("attn.qkv.b", b_qkv)
+        scope_b.set_var("attn.out.w", wo)
+        scope_b.set_var("attn.out.b", bo)
+        got, = exe.run(prog_b, feed={"x": x_in}, fetch_list=[out_b])
+
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_fused_qkv_rejects_cross_attention():
+    from paddle_tpu.models.transformer import multi_head_attention
+
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        a = fluid.layers.data(name="a", shape=[2, 4, 8],
+                              append_batch_size=False)
+        b = fluid.layers.data(name="b", shape=[2, 4, 8],
+                              append_batch_size=False)
+        with pytest.raises(ValueError, match="SELF-attention"):
+            multi_head_attention(a, b, 2, 8, fused_qkv=True)

@@ -29,6 +29,10 @@ for bv in 2048 4096 8192; do
   run BENCH_BATCH=16 PADDLE_TPU_LMHEAD_BLOCK=$bv
 done
 
+# fused QKV projection (one (D,3D) matmul instead of three)
+run BENCH_BATCH=8 PADDLE_TPU_FUSED_QKV=1
+run BENCH_BATCH=16 PADDLE_TPU_FUSED_QKV=1
+
 if [ "${RN:-0}" = "1" ]; then
   for rb in 64 128 256; do
     echo "=== resnet batch $rb ==="
