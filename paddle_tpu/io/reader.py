@@ -25,6 +25,8 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..runtime.recordio import batch_assemble as _batch_assemble
+
 __all__ = ["EOFException", "ReaderBase", "PyReader", "BatchReader",
            "RecordIOFilesReader", "DoubleBufferReader", "ShuffleReader",
            "RandomDataGenerator", "PreprocessReader"]
@@ -298,14 +300,19 @@ class BatchReader(ReaderBase):
             arena.reset()
         out = {}
         for name in rows[0]:
-            first = np.asarray(rows[0][name])
+            cols = [np.asarray(r[name]) for r in rows]
+            first = cols[0]
             shape = (len(rows),) + first.shape
             if arena is not None:
                 dst = arena.alloc_array(shape, first.dtype)
             else:
                 dst = np.empty(shape, first.dtype)
-            for i, r in enumerate(rows):
-                dst[i] = r[name]
+            # C++ threaded gather; falls back to the Python row loop for
+            # small payloads, non-contiguous / mismatched rows, or a
+            # python-only runtime
+            if not _batch_assemble(cols, dst):
+                for i, c in enumerate(cols):
+                    dst[i] = c
             out[name] = dst
         return out
 

@@ -98,6 +98,10 @@ def _load():
     lib.ptrt_arena_used.restype = ctypes.c_int64
     lib.ptrt_arena_used.argtypes = [ctypes.c_void_p]
     lib.ptrt_arena_destroy.argtypes = [ctypes.c_void_p]
+    lib.ptrt_batch_assemble.restype = None
+    lib.ptrt_batch_assemble.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_void_p]
     _lib = lib
     return lib
 
@@ -449,3 +453,32 @@ def recordio_sample_reader(path: str, prefetch: bool = True, capacity: int = 256
             src.close()
 
     return reader
+
+
+def batch_assemble(rows, dst, min_bytes: int = 1 << 20):
+    """Gather equal-shape contiguous sample arrays into dst[i] = rows[i]
+    with the C++ threaded memcpy (ptrt_batch_assemble); returns False
+    when the native library is unavailable, a row is non-contiguous /
+    mismatched, or the payload is under `min_bytes` — measured on small
+    batches the ctypes pointer-array setup costs more than the copy, so
+    tiny batches stay on the caller's Python loop."""
+    lib = _load()
+    if lib is None or not rows:
+        return False
+    if dst.nbytes < min_bytes:
+        return False
+    if dst.shape[0] != len(rows) or not dst.flags["C_CONTIGUOUS"]:
+        return False
+    row_bytes = dst[0].nbytes
+    row_shape = dst.shape[1:]
+    ptrs = (ctypes.c_char_p * len(rows))()
+    for i, r in enumerate(rows):
+        # shape (not just nbytes) must match: same-size transposed rows
+        # would memcpy into a silently scrambled batch
+        if (not r.flags["C_CONTIGUOUS"] or r.dtype != dst.dtype
+                or r.shape != row_shape):
+            return False
+        ptrs[i] = ctypes.cast(r.ctypes.data, ctypes.c_char_p)
+    lib.ptrt_batch_assemble(ptrs, len(rows), row_bytes,
+                            dst.ctypes.data)
+    return True

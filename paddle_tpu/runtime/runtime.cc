@@ -388,4 +388,44 @@ void ptrt_arena_destroy(void* ap) {
   delete a;
 }
 
+
+// ---------------------------------------------------------------------------
+// batch assembly: gather n equal-size sample buffers into one contiguous
+// destination (the hot inner loop of reader batching — replaces a
+// Python-level per-row copy). Rows are split across threads when the
+// payload is large enough to amortize thread startup.
+// ---------------------------------------------------------------------------
+
+void ptrt_batch_assemble(const char** srcs, int64_t n, int64_t row_bytes,
+                         char* dst) {
+  const int64_t total = n * row_bytes;
+  const int64_t kParallelThreshold = 1 << 20;  // 1 MiB
+  int nthreads = 1;
+  if (total >= kParallelThreshold) {
+    nthreads = (int)std::thread::hardware_concurrency();
+    if (nthreads > 8) nthreads = 8;
+    if (nthreads > n) nthreads = (int)n;
+    if (nthreads < 1) nthreads = 1;
+  }
+  if (nthreads == 1) {
+    for (int64_t i = 0; i < n; ++i)
+      memcpy(dst + i * row_bytes, srcs[i], (size_t)row_bytes);
+    return;
+  }
+  std::vector<std::thread> ts;
+  ts.reserve(nthreads);
+  const int64_t per = (n + nthreads - 1) / nthreads;
+  for (int t = 0; t < nthreads; ++t) {
+    const int64_t lo = t * per;
+    const int64_t hi = lo + per < n ? lo + per : n;
+    if (lo >= hi) break;
+    ts.emplace_back([=]() {
+      for (int64_t i = lo; i < hi; ++i)
+        memcpy(dst + i * row_bytes, srcs[i], (size_t)row_bytes);
+    });
+  }
+  for (auto& t : ts) t.join();
+}
+
 }  // extern "C"
+

@@ -252,11 +252,14 @@ def test_parallel_executor_rnn_model_parity():
     assert single[0] > single[-1]
 
 
-def test_transformer_lm_dp_x_mp_parity():
+@pytest.mark.parametrize("fused_qkv", [False, True])
+def test_transformer_lm_dp_x_mp_parity(fused_qkv):
     """Flagship path: the transformer LM trained under a dp=2 x mp=4 mesh
     with the Megatron plan must match single-device training exactly
     (same seed/feeds) — embedding/attention/ffn/vocab-parallel-head
-    shardings change the partitioning, never the math."""
+    shardings change the partitioning, never the math. Covers both the
+    separate q/k/v projections and the fused head-grouped .qkv layout the
+    plan's column split was extended for."""
     from paddle_tpu import models
     from paddle_tpu.parallel import make_mesh, megatron_transformer_plan
 
@@ -273,7 +276,7 @@ def test_transformer_lm_dp_x_mp_parity():
                         append_batch_size=False)
         loss, _ = models.transformer.transformer_lm(
             i, l, vocab_size=V, n_layer=2, n_head=4, d_model=32,
-            d_inner=64, max_len=T)
+            d_inner=64, max_len=T, fused_qkv=fused_qkv)
         fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
         return loss
 

@@ -130,3 +130,28 @@ def test_sample_reader_roundtrip(tmp_path):
     ref_img, ref_lbl = next(mnist.train()())
     np.testing.assert_array_equal(img, ref_img)
     assert lbl == ref_lbl
+
+
+def test_batch_assemble_native_gather():
+    from paddle_tpu.runtime.recordio import batch_assemble, native_available
+
+    r = np.random.RandomState(0)
+    rows = [r.randn(33, 7).astype(np.float32) for _ in range(17)]
+    dst = np.empty((17, 33, 7), np.float32)
+    # under the size gate: tiny batches stay on the caller's loop
+    assert not batch_assemble(rows, dst)
+    ok = batch_assemble(rows, dst, min_bytes=0)
+    assert ok == native_available()
+    if ok:
+        np.testing.assert_array_equal(dst, np.stack(rows))
+    # large payload takes the threaded path (>1 MiB)
+    big = [r.randn(64, 1024).astype(np.float32) for _ in range(8)]
+    dstb = np.empty((8, 64, 1024), np.float32)
+    if batch_assemble(big, dstb):
+        np.testing.assert_array_equal(dstb, np.stack(big))
+    # mismatched rows are rejected -> caller falls back
+    assert not batch_assemble([rows[0], rows[1][:10]],
+                              np.empty((2, 33, 7), np.float32), min_bytes=0)
+    # non-contiguous rows are rejected
+    assert not batch_assemble([rows[0].T, rows[1].T],
+                              np.empty((2, 7, 33), np.float32), min_bytes=0)
