@@ -19,3 +19,4 @@ from . import seq2seq  # noqa: F401
 from . import recommender  # noqa: F401
 from . import ssd  # noqa: F401
 from . import fit_a_line  # noqa: F401
+from . import mobilenet  # noqa: F401

@@ -1,0 +1,58 @@
+"""MobileNet-V1 image classifier (reference capability: the fluid-era
+mobilenet configs used with Paddle's image-classification and SSD
+pipelines; exercises grouped/depthwise conv2d end to end).
+
+Depthwise-separable blocks: a groups=channels 3x3 conv (one filter per
+channel — the MXU-unfriendly part XLA lowers to a batched feature-group
+conv) followed by a 1x1 pointwise conv; both batch-normalized. The
+`scale` multiplier thins every layer like the paper.
+"""
+from __future__ import annotations
+
+from .. import layers
+
+__all__ = ["mobilenet_v1", "get_model"]
+
+
+def _conv_bn(x, filters, filter_size, stride, padding, groups=1, act="relu"):
+    conv = layers.conv2d(
+        input=x, num_filters=filters, filter_size=filter_size,
+        stride=stride, padding=padding, groups=groups, bias_attr=False)
+    return layers.batch_norm(conv, act=act)
+
+
+def _depthwise_separable(x, ch_in, ch_out, stride, scale):
+    dw = _conv_bn(x, int(ch_in * scale), 3, stride, 1,
+                  groups=int(ch_in * scale))
+    return _conv_bn(dw, int(ch_out * scale), 1, 1, 0)
+
+
+def mobilenet_v1(img, class_dim=1000, scale=1.0):
+    """img (B, 3, S, S) -> (B, class_dim) softmax."""
+    cfg = [
+        # ch_in, ch_out, stride
+        (32, 64, 1),
+        (64, 128, 2), (128, 128, 1),
+        (128, 256, 2), (256, 256, 1),
+        (256, 512, 2),
+        (512, 512, 1), (512, 512, 1), (512, 512, 1), (512, 512, 1),
+        (512, 512, 1),
+        (512, 1024, 2), (1024, 1024, 1),
+    ]
+    x = _conv_bn(img, int(32 * scale), 3, 2, 1)
+    for ch_in, ch_out, stride in cfg:
+        x = _depthwise_separable(x, ch_in, ch_out, stride, scale)
+    x = layers.pool2d(x, pool_type="avg", global_pooling=True)
+    x = layers.flatten(x, axis=1)
+    return layers.fc(x, class_dim, act="softmax")
+
+
+def get_model(class_dim=1000, image_size=224, scale=1.0):
+    """(avg_cost, accuracy, feed_vars) training graph."""
+    img = layers.data(name="image", shape=[3, image_size, image_size])
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    predict = mobilenet_v1(img, class_dim, scale)
+    cost = layers.cross_entropy(input=predict, label=label)
+    avg_cost = layers.mean(cost)
+    acc = layers.accuracy(input=predict, label=label)
+    return avg_cost, acc, [img, label]

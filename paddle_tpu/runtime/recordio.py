@@ -24,6 +24,7 @@ __all__ = [
     "StagingArena",
     "RecordIOError",
     "native_available",
+    "batch_assemble",
     "recordio_convert",
     "recordio_sample_reader",
 ]
