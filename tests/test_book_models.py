@@ -254,10 +254,25 @@ def test_mobilenet_trains():
     assert losses[-1] < losses[0], losses
 
 
+def _build_beam_decode():
+    from paddle_tpu.models import seq2seq
+
+    src_v = layers.data(name="src_word_id", shape=[6], dtype="int64")
+    len_v = layers.data(name="src_len", shape=[], dtype="int32")
+    init_ids = layers.data(name="init_ids", shape=[1], dtype="int64")
+    init_scores = layers.data(name="init_scores", shape=[1])
+    ctx = seq2seq.encoder(src_v, len_v, 20, 8, 8)
+    ids, _scores = seq2seq.decoder_decode(
+        ctx, init_ids, init_scores, 20, word_dim=8, decoder_size=8,
+        beam_size=2, max_length=4)
+    return ids
+
+
 def test_new_model_programs_roundtrip_json():
     """The IR serializer must round-trip the newest graphs losslessly:
     SSD (detection attrs: aspect ratio lists, variances), MobileNet
-    (grouped convs), seq2seq (nested scan blocks + beam search). The
+    (grouped convs), seq2seq training (nested DynamicRNN sub-blocks) and
+    the beam-search decode graph (StaticRNN loop + beam ops). The
     deserialized program must produce identical results."""
     from paddle_tpu.models import mobilenet, seq2seq, ssd
 
@@ -268,6 +283,7 @@ def test_new_model_programs_roundtrip_json():
                                                  scale=0.25)[0],
         "seq2seq": lambda: seq2seq.get_model(dict_size=20, seq_len=6,
                                              word_dim=8, hidden_dim=8)[0],
+        "beam_decode": _build_beam_decode,
     }
     feeds = {
         "ssd": {"image": np.zeros((2, 3, 32, 32), np.float32),
@@ -283,7 +299,15 @@ def test_new_model_programs_roundtrip_json():
                     "trg_len": np.full(2, 6, np.int32),
                     "target_language_next_word": np.full((2, 6), 5,
                                                          np.int64)},
+        "beam_decode": {"src_word_id": np.full((2, 6), 3, np.int64),
+                        "src_len": np.full(2, 6, np.int32),
+                        "init_ids": np.zeros((2, 1), np.int64),
+                        "init_scores": np.zeros((2, 1), np.float32)},
     }
+    rr = np.random.RandomState(7)
+    for f in feeds.values():
+        if "image" in f:  # non-degenerate activations make the check strict
+            f["image"] = rr.randn(*f["image"].shape).astype(np.float32)
     for name, build in builders.items():
         prog, startup = fluid.Program(), fluid.Program()
         prog.random_seed = startup.random_seed = 9
