@@ -1,0 +1,40 @@
+"""The examples/ scripts are user-facing entry points: run each as a
+subprocess with tiny parameters to keep them from rotting."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, timeout=420):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # no device tunnel in tests
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["PADDLE_TPU_SYNTH_MNIST_TRAIN"] = "256"
+    env["PADDLE_TPU_SYNTH_MNIST_TEST"] = "128"
+    res = subprocess.run([sys.executable] + args, cwd=_ROOT, env=env,
+                         capture_output=True, text=True, timeout=timeout)
+    assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
+    return res.stdout
+
+
+def test_train_mnist_example():
+    out = _run(["examples/train_mnist.py", "--cpu", "--epochs", "1",
+                "--batch-size", "32"])
+    assert "test acc" in out
+
+
+def test_translate_example():
+    out = _run(["examples/translate.py", "--cpu", "--steps", "40"])
+    assert "best-beam token match" in out
+
+
+def test_train_lm_example_single_device():
+    out = _run(["examples/train_lm.py", "--layers", "1", "--d-model", "64",
+                "--seq", "128", "--vocab", "256", "--batch", "2",
+                "--steps", "3", "--no-amp"])
+    assert "tokens/s" in out
