@@ -21,8 +21,13 @@ __all__ = [
 ]
 
 
-def _global_step_f32():
-    counter = autoincreased_step_counter(begin=1)
+def _global_step_f32(begin: int = 0):
+    """The decay step counter. The reference's _decay_step_counter starts
+    at 0 (the first step trains at the undecayed learning_rate);
+    noam_decay starts at 1 (step^-0.5 needs step >= 1), and
+    piecewise_decay's step>boundary comparison pairs with begin=1 to
+    reproduce the reference's begin-0 step<boundary banding."""
+    counter = autoincreased_step_counter(begin=begin)
     return tensor.cast(counter, "float32")
 
 
@@ -92,7 +97,7 @@ def piecewise_decay(boundaries, values):
     """lr = values[i] for step in (boundaries[i-1], boundaries[i]]."""
     if len(values) - len(boundaries) != 1:
         raise ValueError("len(values) must be len(boundaries) + 1")
-    step = _global_step_f32()
+    step = _global_step_f32(begin=1)
     lr = _const(float(values[0]))
     for b, v in zip(boundaries, values[1:]):
         past = _binary("greater_than", step, _const(float(b)))
@@ -109,7 +114,7 @@ def piecewise_decay(boundaries, values):
 def noam_decay(d_model, warmup_steps):
     """lr = d_model^-0.5 * min(step^-0.5, step * warmup^-1.5) (reference
     learning_rate_scheduler.py:noam_decay; used by Transformer)."""
-    step = _global_step_f32()
+    step = _global_step_f32(begin=1)
     a = _binary("elementwise_pow", step, _const(-0.5))
     b = ops.scale(step, scale=float(warmup_steps) ** -1.5)
     m = _binary("elementwise_min", a, b)

@@ -1,0 +1,126 @@
+"""Numeric tests for the learning-rate schedules: each is fetched per
+training step over several steps and compared against the closed-form
+formula (reference: learning_rate_scheduler.py and its unittest
+test_learning_rate_decay.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, optimizer
+
+N_STEPS = 7
+# decay schedules: the reference counter starts at 0 (step 1 of training
+# computes with exponent 0 — the undecayed lr); noam starts at 1
+STEPS0 = np.arange(0, N_STEPS, dtype=np.float64)
+STEPS1 = np.arange(1, N_STEPS + 1, dtype=np.float64)
+
+
+def _run_schedule(build_lr, steps=N_STEPS):
+    """Build an sgd-trained net with a scheduled lr; return the fetched
+    lr value per step (the in-graph step counter increments inside each
+    traced step)."""
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = startup.random_seed = 1
+    with fluid.program_guard(prog, startup):
+        with fluid.unique_name.guard():
+            x = layers.data(name="x", shape=[4])
+            loss = layers.mean(layers.fc(x, 3))
+            lr = build_lr()
+            optimizer.SGD(learning_rate=lr).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    feed = {"x": np.ones((2, 4), np.float32)}
+    out = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(steps):
+            v, = exe.run(prog, feed=feed, fetch_list=[lr.name])
+            out.append(float(np.asarray(v).reshape(-1)[0]))
+    return np.asarray(out)
+
+
+@pytest.mark.parametrize("staircase", [False, True])
+def test_exponential_decay(staircase):
+    got = _run_schedule(lambda: layers.exponential_decay(
+        0.1, decay_steps=3, decay_rate=0.5, staircase=staircase))
+    assert got[0] == pytest.approx(0.1)  # step 1 trains undecayed
+    div = STEPS0 / 3.0
+    if staircase:
+        div = np.floor(div)
+    np.testing.assert_allclose(got, 0.1 * 0.5 ** div, rtol=1e-5)
+
+
+def test_natural_exp_decay():
+    got = _run_schedule(lambda: layers.natural_exp_decay(
+        0.2, decay_steps=2, decay_rate=0.3))
+    np.testing.assert_allclose(got, 0.2 * np.exp(-0.3 * STEPS0 / 2.0),
+                               rtol=1e-5)
+
+
+def test_inverse_time_decay():
+    got = _run_schedule(lambda: layers.inverse_time_decay(
+        0.5, decay_steps=4, decay_rate=2.0))
+    np.testing.assert_allclose(got, 0.5 / (1.0 + 2.0 * STEPS0 / 4.0),
+                               rtol=1e-5)
+
+
+@pytest.mark.parametrize("cycle", [False, True])
+def test_polynomial_decay(cycle):
+    got = _run_schedule(lambda: layers.polynomial_decay(
+        0.3, decay_steps=4, end_learning_rate=0.01, power=2.0, cycle=cycle))
+    if cycle:
+        dsteps = 4.0 * np.maximum(np.ceil(STEPS0 / 4.0), 1.0)
+        ratio = STEPS0 / dsteps
+    else:
+        ratio = np.minimum(STEPS0, 4.0) / 4.0
+    want = (0.3 - 0.01) * (1.0 - ratio) ** 2.0 + 0.01
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_piecewise_decay():
+    got = _run_schedule(lambda: layers.piecewise_decay(
+        boundaries=[2, 5], values=[1.0, 0.5, 0.1]))
+    want = np.where(STEPS1 <= 2, 1.0, np.where(STEPS1 <= 5, 0.5, 0.1))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_noam_decay():
+    got = _run_schedule(lambda: layers.noam_decay(d_model=64,
+                                                  warmup_steps=4))
+    want = 64.0 ** -0.5 * np.minimum(STEPS1 ** -0.5, STEPS1 * 4.0 ** -1.5)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_append_lars_scales_update_by_trust_ratio():
+    """LARS: with one fc parameter, the first SGD update must equal
+    lr * ratio * grad with ratio = ||w|| / (||g|| + wd * ||w||)."""
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = startup.random_seed = 3
+    with fluid.program_guard(prog, startup):
+        with fluid.unique_name.guard():
+            x = layers.data(name="x", shape=[4])
+            out = layers.fc(x, 3, bias_attr=False)
+            loss = layers.mean(out)
+            base_lr = layers.tensor.fill_constant(
+                shape=[1], dtype="float32", value=0.1)
+            opt = optimizer.SGD(learning_rate=base_lr)
+            params_grads = fluid.append_backward(loss)
+            layers.append_LARS(params_grads, base_lr, weight_decay=0.01)
+            opt.apply_gradients(params_grads)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    xs = np.ones((2, 4), np.float32)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        w_name = params_grads[0][0].name
+        w0 = np.asarray(scope.find_var(w_name)).copy()
+        exe.run(prog, feed={"x": xs}, fetch_list=[])
+        w1 = np.asarray(scope.find_var(w_name))
+    # gradient of mean(x @ w) wrt w: each column j gets mean over batch of
+    # x / n_cols -> ones(4) * (2/ (2*3)) = 1/3
+    g = np.full((4, 3), 1.0 / 3.0, np.float64)
+    ratio = np.linalg.norm(w0) / (np.linalg.norm(g)
+                                  + 0.01 * np.linalg.norm(w0))
+    np.testing.assert_allclose(w1, w0 - 0.1 * ratio * g, rtol=1e-4,
+                               atol=1e-6)
