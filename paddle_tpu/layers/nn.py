@@ -558,7 +558,13 @@ def conv2d_transpose(
         ]
     else:
         fs = _to_list(filter_size, 2)
-    filter_shape = [c, num_filters] + fs
+    groups = groups or 1
+    if num_filters % groups or c % groups:
+        raise ValueError(
+            "conv2d_transpose: groups=%d must divide both the input "
+            "channels (%d) and num_filters (%d)" % (groups, c, num_filters))
+    # reference weight layout: (C_in, num_filters // groups, kh, kw)
+    filter_shape = [c, num_filters // groups] + fs
     w = helper.create_parameter(attr=param_attr, shape=filter_shape, dtype=dtype)
     out_h = (h - 1) * st[0] - 2 * pd[0] + dl[0] * (fs[0] - 1) + 1
     out_w = (w_dim - 1) * st[1] - 2 * pd[1] + dl[1] * (fs[1] - 1) + 1
@@ -569,7 +575,8 @@ def conv2d_transpose(
         type="conv2d_transpose",
         inputs={"Input": [input], "Filter": [w]},
         outputs={"Output": [pre_bias]},
-        attrs={"strides": st, "paddings": pd, "dilations": dl},
+        attrs={"strides": st, "paddings": pd, "dilations": dl,
+               "groups": groups},
     )
     pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
     return helper.append_activation(pre_act)

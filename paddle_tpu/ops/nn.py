@@ -72,10 +72,21 @@ def _conv3d(ctx):
 @register_op("conv2d_transpose")
 def _conv2d_transpose(ctx):
     x = ctx.input("Input")  # NCHW
-    w = ctx.input("Filter")  # IOHW in paddle transpose convention
+    w = ctx.input("Filter")  # (C_in, M // groups, kh, kw), paddle layout
     strides = _pair(ctx.attr("strides", [1, 1]))
     pads = _pair(ctx.attr("paddings", [0, 0]))
     dilations = _pair(ctx.attr("dilations", [1, 1]))
+    groups = int(ctx.attr("groups", 1) or 1)
+    if groups > 1:
+        # JAX grouped-conv IOHW layout wants (C/g, M, kh, kw) with the
+        # output dim blocked per group; paddle blocks the INPUT dim, so
+        # regroup: (g, C/g, M/g, ...) -> (C/g, g, M/g, ...) -> (C/g, M, ...)
+        c = w.shape[0]
+        cpg, mpg = c // groups, w.shape[1]
+        kh, kw = w.shape[2], w.shape[3]
+        w = (w.reshape(groups, cpg, mpg, kh, kw)
+             .transpose(1, 0, 2, 3, 4)
+             .reshape(cpg, groups * mpg, kh, kw))
     # deconv == gradient of conv: fractionally-strided conv via lhs_dilation
     out = lax.conv_general_dilated(
         x,
@@ -88,6 +99,7 @@ def _conv2d_transpose(ctx):
         lhs_dilation=strides,
         rhs_dilation=dilations,
         dimension_numbers=("NCHW", "IOHW", "NCHW"),
+        feature_group_count=groups,
     )
     return {"Output": out}
 

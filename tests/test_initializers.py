@@ -1,0 +1,117 @@
+"""Numeric/statistical tests for every initializer (reference:
+python/paddle/fluid/initializer.py + unittests/test_initializer.py):
+exact values for the deterministic ones, bounds + moments for the random
+ones, and seed determinism through the startup program."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import initializer, layers
+
+
+def _init_param(init, shape=(256, 128), seed=0):
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = startup.random_seed = seed
+    with fluid.program_guard(prog, startup):
+        with fluid.unique_name.guard():
+            x = layers.data(name="x", shape=[shape[0]])
+            layers.fc(x, shape[1],
+                      param_attr=fluid.ParamAttr(name="w", initializer=init),
+                      bias_attr=False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        return np.asarray(scope.find_var("w"))
+
+
+def test_constant():
+    w = _init_param(initializer.ConstantInitializer(2.5))
+    np.testing.assert_array_equal(w, np.full(w.shape, 2.5, np.float32))
+
+
+def test_uniform_bounds_and_mean():
+    w = _init_param(initializer.UniformInitializer(low=-0.3, high=0.7))
+    assert w.min() >= -0.3 and w.max() <= 0.7
+    assert abs(w.mean() - 0.2) < 0.02
+    # fills the range (not degenerate)
+    assert w.max() > 0.6 and w.min() < -0.2
+
+
+def test_normal_moments():
+    w = _init_param(initializer.NormalInitializer(loc=1.0, scale=0.5))
+    assert abs(w.mean() - 1.0) < 0.02
+    assert abs(w.std() - 0.5) < 0.02
+
+
+def test_truncated_normal_bounds():
+    w = _init_param(initializer.TruncatedNormalInitializer(loc=0.0,
+                                                           scale=1.0))
+    # truncated at two standard deviations
+    assert w.min() >= -2.0 - 1e-6 and w.max() <= 2.0 + 1e-6
+    assert abs(w.mean()) < 0.03
+    # std of a +-2-sigma truncated normal is ~0.88
+    assert 0.8 < w.std() < 0.95
+
+
+def test_xavier_uniform_bounds():
+    w = _init_param(initializer.XavierInitializer(uniform=True))
+    limit = np.sqrt(6.0 / (256 + 128))
+    assert w.min() >= -limit - 1e-6 and w.max() <= limit + 1e-6
+    assert w.max() > 0.9 * limit  # actually fills the range
+    # variance of U(-l, l) is l^2/3
+    assert abs(w.var() - limit ** 2 / 3.0) < 0.1 * limit ** 2
+
+
+def test_xavier_normal_variance():
+    w = _init_param(initializer.XavierInitializer(uniform=False))
+    want_std = np.sqrt(2.0 / (256 + 128))
+    assert abs(w.std() - want_std) < 0.1 * want_std
+
+
+def test_msra_bounds():
+    w = _init_param(initializer.MSRAInitializer(uniform=True))
+    limit = np.sqrt(6.0 / 256)  # fan_in for (in, out) fc weights
+    assert w.min() >= -limit - 1e-6 and w.max() <= limit + 1e-6
+    assert w.max() > 0.9 * limit
+
+
+def test_bilinear_kernel_exact():
+    """Bilinear init builds the exact upsampling kernel (reference
+    initializer.py:BilinearInitializer): with upsample factor
+    f = ceil(k / 2) = 2 for a 4x4 kernel,
+    weight[i,j] = (1-|i/f - c|)(1-|j/f - c|), c = (2f-1-f%2)/(2f)."""
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        with fluid.unique_name.guard():
+            x = layers.data(name="x", shape=[2, 8, 8])
+            layers.conv2d_transpose(
+                x, num_filters=2, filter_size=4, stride=2, padding=1,
+                groups=2, bias_attr=False,
+                param_attr=fluid.ParamAttr(
+                    name="up_w", initializer=initializer.BilinearInitializer()))
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        w = np.asarray(scope.find_var("up_w"))
+    # grouped transpose-conv weight layout: (C_in, M // groups, kh, kw)
+    assert w.shape == (2, 1, 4, 4)
+    f = np.ceil(4 / 2.0)
+    c = (2 * f - 1 - f % 2) / (2.0 * f)
+    want = np.zeros((4, 4), np.float32)
+    for i in range(4):
+        for j in range(4):
+            want[i, j] = (1 - abs(i / f - c)) * (1 - abs(j / f - c))
+    for ch in range(w.shape[0]):
+        for j in range(w.shape[1]):
+            np.testing.assert_allclose(w[ch, j], want, rtol=1e-5, atol=1e-6,
+                                       err_msg="slice %d,%d" % (ch, j))
+
+
+def test_seed_determinism():
+    a = _init_param(initializer.NormalInitializer(0.0, 1.0), seed=5)
+    b = _init_param(initializer.NormalInitializer(0.0, 1.0), seed=5)
+    c = _init_param(initializer.NormalInitializer(0.0, 1.0), seed=6)
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)

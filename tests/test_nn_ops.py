@@ -461,3 +461,31 @@ def test_mean_iou():
     want = (1.0 + 1 / 3 + 1 / 3) / 3
     np.testing.assert_allclose(float(np.asarray(got["OutMeanIou"])), want,
                                rtol=1e-5)
+
+
+def test_conv2d_transpose_groups_matches_per_group_composition():
+    """Grouped transpose conv == running each group's transpose conv
+    separately and concatenating the outputs (the reference semantic the
+    groups attr was previously silently dropping)."""
+    from tests.op_test import run_op
+
+    r = np.random.RandomState(0)
+    C, M, G, S = 4, 6, 2, 5
+    x = r.randn(2, C, S, S).astype(np.float32)
+    w = r.randn(C, M // G, 3, 3).astype(np.float32)
+    got = np.asarray(run_op(
+        "conv2d_transpose", {"Input": x, "Filter": w},
+        attrs={"strides": [2, 2], "paddings": [1, 1], "groups": G},
+        outs=("Output",))["Output"])
+
+    parts = []
+    for g in range(G):
+        xg = x[:, g * C // G:(g + 1) * C // G]
+        wg = w[g * C // G:(g + 1) * C // G]
+        parts.append(np.asarray(run_op(
+            "conv2d_transpose", {"Input": xg, "Filter": wg},
+            attrs={"strides": [2, 2], "paddings": [1, 1], "groups": 1},
+            outs=("Output",))["Output"]))
+    want = np.concatenate(parts, axis=1)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
