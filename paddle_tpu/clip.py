@@ -73,9 +73,9 @@ class GradientClipByNorm(BaseGradientClipAttr):
 
 class GradientClipByGlobalNorm(BaseGradientClipAttr):
     """Scales all gradients by clip_norm/max(global_norm, clip_norm)
-    (reference clip.py:GradientClipByGlobalNorm). Per-program state: the
-    instance may be reused across programs (set_gradient_clip stores it
-    globally), so sq-sums and the scale var are keyed by program."""
+    (reference clip.py:GradientClipByGlobalNorm). Per-program state: one
+    instance may be attached to the parameters of several programs, so
+    sq-sums and the scale var are keyed by program."""
 
     def __init__(self, clip_norm):
         self.clip_norm = float(clip_norm)
@@ -154,21 +154,21 @@ class GradientClipByGlobalNorm(BaseGradientClipAttr):
         return param, out
 
 
-_gradient_clip_attr = None
-
-
 def set_gradient_clip(clip, param_list=None, program=None):
-    global _gradient_clip_attr
-    if param_list is not None:
-        for p in param_list:
-            if isinstance(p, Variable):
-                p.gradient_clip_attr = clip
-            else:
-                from .framework.core import default_main_program
+    """Attach `clip` to parameters (reference clip.py:set_gradient_clip):
+    with no param_list, every parameter of `program` (default main) gets
+    it. Program-scoped like the reference — earlier versions stored a
+    process-global default that silently leaked into every later
+    program."""
+    from .framework.core import default_main_program
 
-                (program or default_main_program()).global_block().var(p).gradient_clip_attr = clip
-    else:
-        _gradient_clip_attr = clip
+    program = program or default_main_program()
+    if param_list is None:
+        param_list = program.all_parameters()
+    for p in param_list:
+        if not isinstance(p, Variable):
+            p = program.global_block().var(p)
+        p.gradient_clip_attr = clip
 
 
 def append_gradient_clip_ops(param_grads):
@@ -176,7 +176,7 @@ def append_gradient_clip_ops(param_grads):
     context = {}
     result = []
     for p, g in param_grads:
-        clip = getattr(p, "gradient_clip_attr", None) or _gradient_clip_attr
+        clip = getattr(p, "gradient_clip_attr", None)
         if clip is None:
             result.append((p, g))
             continue

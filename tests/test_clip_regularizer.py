@@ -1,0 +1,94 @@
+"""Numeric tests for gradient clipping and weight-decay regularization
+(reference: python/paddle/fluid/clip.py, regularizer.py and their
+unittests): each mechanism's effect on the actual SGD parameter update
+is compared against the closed-form result."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, optimizer
+
+LR = 0.5
+
+
+def _one_sgd_step(clip=None, regularization=None, param_reg=None):
+    """A single fc(4->3, no bias) trained one step on x=ones; returns
+    (w0, w1, g) with g the raw dLoss/dw = 1/3 everywhere (loss =
+    mean(x @ w), batch of ones)."""
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = startup.random_seed = 3
+    with fluid.program_guard(prog, startup):
+        with fluid.unique_name.guard():
+            x = layers.data(name="x", shape=[4])
+            attr = fluid.ParamAttr(name="w", regularizer=param_reg)
+            loss = layers.mean(layers.fc(x, 3, param_attr=attr,
+                                         bias_attr=False))
+            if clip is not None:
+                fluid.clip.set_gradient_clip(clip, program=prog)
+            optimizer.SGD(learning_rate=LR,
+                          regularization=regularization).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        w0 = np.asarray(scope.find_var("w")).astype(np.float64).copy()
+        exe.run(prog, feed={"x": np.ones((2, 4), np.float32)},
+                fetch_list=[])
+        w1 = np.asarray(scope.find_var("w")).astype(np.float64)
+    g = np.full((4, 3), 1.0 / 3.0)
+    return w0, w1, g
+
+
+def test_unclipped_baseline():
+    w0, w1, g = _one_sgd_step()
+    np.testing.assert_allclose(w1, w0 - LR * g, rtol=1e-5, atol=1e-7)
+
+
+def test_clip_by_value():
+    w0, w1, g = _one_sgd_step(
+        clip=fluid.clip.GradientClipByValue(max=0.1, min=-0.1))
+    np.testing.assert_allclose(w1, w0 - LR * np.clip(g, -0.1, 0.1),
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_clip_by_norm():
+    w0, w1, g = _one_sgd_step(clip=fluid.clip.GradientClipByNorm(0.2))
+    scale = 0.2 / np.linalg.norm(g)  # ||g|| = sqrt(12)/3 ~ 1.155 > 0.2
+    np.testing.assert_allclose(w1, w0 - LR * g * scale, rtol=1e-5,
+                               atol=1e-7)
+
+
+def test_clip_by_norm_noop_under_threshold():
+    w0, w1, g = _one_sgd_step(clip=fluid.clip.GradientClipByNorm(100.0))
+    np.testing.assert_allclose(w1, w0 - LR * g, rtol=1e-5, atol=1e-7)
+
+
+def test_clip_by_global_norm():
+    w0, w1, g = _one_sgd_step(
+        clip=fluid.clip.GradientClipByGlobalNorm(clip_norm=0.3))
+    # single parameter: global norm == its own norm
+    scale = 0.3 / np.linalg.norm(g)
+    np.testing.assert_allclose(w1, w0 - LR * g * scale, rtol=1e-5,
+                               atol=1e-7)
+
+
+def test_l2_decay_via_optimizer():
+    w0, w1, g = _one_sgd_step(regularization=fluid.regularizer.L2Decay(0.1))
+    np.testing.assert_allclose(w1, w0 - LR * (g + 0.1 * w0), rtol=1e-5,
+                               atol=1e-7)
+
+
+def test_l1_decay_via_optimizer():
+    w0, w1, g = _one_sgd_step(regularization=fluid.regularizer.L1Decay(0.05))
+    np.testing.assert_allclose(w1, w0 - LR * (g + 0.05 * np.sign(w0)),
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_per_param_regularizer_overrides_global():
+    """ParamAttr regularizer wins over the optimizer-level one
+    (reference regularizer.py:append_regularization_ops)."""
+    w0, w1, g = _one_sgd_step(
+        regularization=fluid.regularizer.L2Decay(10.0),
+        param_reg=fluid.regularizer.L2Decay(0.01))
+    np.testing.assert_allclose(w1, w0 - LR * (g + 0.01 * w0), rtol=1e-5,
+                               atol=1e-7)
