@@ -101,7 +101,8 @@ class Go:
             try:
                 self._results[idx] = fn(*args, **kwargs)
             except BaseException as e:  # surfaced on join()
-                self._errors.append(e)
+                self._results[idx] = e  # .result shows which task died
+                self._errors.append((idx, e))
 
         t = threading.Thread(target=body, daemon=True)
         t.start()
@@ -138,8 +139,15 @@ class Go:
                 raise TimeoutError(
                     "Go.join timed out after %.3fs with work still running"
                     % timeout)
+        if len(self._errors) == 1:
+            raise self._errors[0][1]
         if self._errors:
-            raise self._errors[0]
+            raise RuntimeError(
+                "%d Go tasks failed: %s" % (
+                    len(self._errors),
+                    "; ".join("task %d: %r" % (i, e)
+                              for i, e in self._errors))
+            ) from self._errors[0][1]
         return self.result
 
     @property

@@ -15,7 +15,10 @@ no slot schemas, so it is value-driven): a keyword holding an array
 (numpy or jax, or a list of them — numpy scalars count as attributes) is
 a tensor input whatever its case (some reference ops use lowercase
 slots); an UPPERCASE keyword holding a string is resolved at ``run``
-time — an input if the scope has data under that name, otherwise the
+time — an output-shaped slot name (``Out``/``Output``/``*Out``/``Out*``,
+the registry's output convention, minus the two Out*-named input slots)
+is always an output so in-place patterns like ``ParamOut='p'`` write
+back; otherwise an input if the scope has data under that name, else the
 name of an output variable; any other UPPERCASE value (e.g. a plain
 Python list) is also bound as a tensor input; lowercase non-array values
 are attributes. Lowercase output slots are requested via
@@ -28,6 +31,11 @@ from typing import Any, Dict
 import numpy as np
 
 __all__ = ["get_all_op_protos", "Operator", "OperatorFactory"]
+
+# The registry's only Out*-named INPUT slots (smooth_l1's OutsideWeight,
+# the interp ops' OutSize); every other Out-prefixed/-suffixed slot is an
+# output.
+_OUT_NAMED_INPUTS = frozenset({"OutsideWeight", "OutSize"})
 
 
 def get_all_op_protos():
@@ -49,13 +57,18 @@ class _EagerOp:
         self._out_slots = None  # fixed on first run
 
     def _split_named(self, scope):
-        """String-bound slots: data in the scope means input, else the
-        slot names an output variable to create. The classification is
-        fixed on the first run — re-running the op against the same scope
-        must not reclassify its own (now data-holding) outputs as
-        inputs. Named slots require a scope: without one there is nothing
-        to resolve the names against (and a scope-less first run would
-        freeze every slot as an output)."""
+        """String-bound slots: an output-shaped slot name (``Out``,
+        ``*Out``, ``Out*`` minus ``_OUT_NAMED_INPUTS`` — the registry's
+        output naming convention) is always an output, even when the
+        bound variable already holds data in the scope; that is what
+        makes in-place updates like
+        ``Operator('sgd', Param='p', ..., ParamOut='p')`` write back.
+        Remaining slots: data in the scope means input, else output. The
+        classification is fixed on the first run — re-running the op
+        against the same scope must not reclassify its own (now
+        data-holding) outputs as inputs. Named slots require a scope:
+        without one there is nothing to resolve the names against (and a
+        scope-less first run would freeze every slot as an output)."""
         if self.named and scope is None:
             raise ValueError(
                 "Operator %r binds slots to scope variable names %s; "
@@ -65,6 +78,9 @@ class _EagerOp:
         for slot, name in self.named.items():
             if self._out_slots is not None:
                 is_out = slot in self._out_slots
+            elif slot not in _OUT_NAMED_INPUTS and (
+                    slot.endswith("Out") or slot.startswith("Out")):
+                is_out = True
             else:
                 is_out = not (scope.has_var(name)
                               and scope.find_var(name) is not None)

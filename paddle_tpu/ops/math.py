@@ -961,6 +961,14 @@ def _fake_quantize(ctx):
     if qtype == "abs_max":
         scale = cur
         outs["OutMovingScale"] = scale.reshape(1)
+        # the reference kernel zero-fills the window state in abs_max mode
+        # so QAT graphs that declare the slots find them written
+        if ctx.has_input("InScales"):
+            outs["OutScales"] = jnp.zeros_like(
+                ctx.input("InScales").reshape(-1))
+        if ctx.has_input("InCurrentIter"):
+            outs["OutCurrentIter"] = jnp.zeros_like(
+                ctx.input("InCurrentIter").reshape(-1))
     elif qtype == "range_abs_max":
         moving = ctx.input("InMovingScale")
         if is_test:

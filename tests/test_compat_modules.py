@@ -322,3 +322,55 @@ def test_pipe_reader_abandoned_stream_terminates(tmp_path):
         pass  # abandon without reading: close() must not hang on wait()
     assert time.monotonic() - t0 < 10
     assert pr.process.poll() is not None  # child reaped
+
+
+def test_operator_factory_inplace_param_out():
+    # ADVICE r2: an UPPERCASE output slot bound to a var that already holds
+    # data (in-place update shape) must still be classified as an output.
+    import numpy as np
+
+    from paddle_tpu.core import Scope
+    from paddle_tpu.op import Operator
+
+    scope = Scope()
+    scope.set_var("p", np.array([1.0, 2.0], np.float32))
+    scope.set_var("g", np.array([0.5, 0.5], np.float32))
+    scope.set_var("lr", np.array([0.1], np.float32))
+    op = Operator("sgd", Param="p", Grad="g", LearningRate="lr",
+                  ParamOut="p")
+    op.run(scope=scope)
+    np.testing.assert_allclose(
+        np.asarray(scope.find_var("p")), [0.95, 1.95], rtol=1e-6)
+    # second run keeps the (now data-holding) output classified as output
+    op.run(scope=scope)
+    np.testing.assert_allclose(
+        np.asarray(scope.find_var("p")), [0.90, 1.90], rtol=1e-6)
+
+
+def test_go_multiple_failures_aggregate():
+    # ADVICE r2: with >1 concurrent failure, join() raises an aggregate
+    # naming every failed task instead of dropping all but the first.
+    import pytest
+
+    import paddle_tpu as fluid
+
+    def boom_a():
+        raise ValueError("a died")
+
+    def boom_b():
+        raise KeyError("b died")
+
+    with fluid.Go() as g:
+        g.run(boom_a)
+        g.run(boom_b)
+        g.run(lambda: 42)
+    with pytest.raises(RuntimeError, match="2 Go tasks failed"):
+        g.join()
+    # per-task results keep the surviving value and record each exception
+    assert g.result[2] == 42
+    assert isinstance(g.result[0], ValueError)
+    assert isinstance(g.result[1], KeyError)
+
+    single = fluid.Go(boom_a)
+    with pytest.raises(ValueError, match="a died"):
+        single.join()
