@@ -31,6 +31,46 @@ from .registry import register_op
 _NEG = -1e30
 
 
+def _vary_like(val, *refs):
+    """Inside shard_map, loop carries initialized from literals are
+    unvaried over the manual mesh axes while the loop body mixes in
+    device-varying operands (x, labels) — the VMA type system rejects
+    that. Promote ``val`` to vary over every axis any ref varies over
+    (no-op under plain jit)."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return val
+    try:
+        vma = set()
+        for r in refs:
+            vma |= set(getattr(typeof(r), "vma", ()) or ())
+        vma -= set(getattr(typeof(val), "vma", ()) or ())
+    except Exception:
+        return val
+    if not vma:
+        return val
+    pcast = getattr(lax, "pcast", None)
+    if pcast is not None:
+        return pcast(val, tuple(vma), to="varying")
+    return lax.pvary(val, tuple(vma))
+
+
+def _grad_vma_like(g, primal):
+    """The bwd rule's cotangent must carry the primal's varying axes: a
+    device-UNvaried primal (e.g. a replicated weight under dp shard_map)
+    gets the SUM of per-device contributions — exactly GSPMD's grad
+    all-reduce for replicated params."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return g
+    try:
+        extra = (set(getattr(typeof(g), "vma", ()) or ())
+                 - set(getattr(typeof(primal), "vma", ()) or ()))
+    except Exception:
+        return g
+    return lax.psum(g, tuple(extra)) if extra else g
+
+
 def _pad_wb(w, b, block_v):
     """Pad (D, V) / (V,) up to a multiple of block_v. Padded bias is -1e30
     so padded logits vanish from the logsumexp (exp(-1e30 - lse) == 0).
@@ -76,9 +116,10 @@ def _lm_head_fwd(block_v, x, w, b, labels):
 
     m, s, picked = lax.fori_loop(
         0, nblk, body,
-        (jnp.full((n,), _NEG, jnp.float32),
-         jnp.zeros((n,), jnp.float32),
-         jnp.zeros((n,), jnp.float32)))
+        tuple(_vary_like(c, x, labels, wp, bp) for c in
+              (jnp.full((n,), _NEG, jnp.float32),
+               jnp.zeros((n,), jnp.float32),
+               jnp.zeros((n,), jnp.float32))))
     lse = m + jnp.log(s)
     loss = (lse - picked)[:, None]
     return loss, (x, w, b, labels, lse)
@@ -113,11 +154,13 @@ def _lm_head_bwd(block_v, res, g):
 
     dx, dw, db = lax.fori_loop(
         0, nblk, body,
-        (jnp.zeros((n, d), jnp.float32),
-         jnp.zeros((d, pv), jnp.float32),
-         jnp.zeros((pv,), jnp.float32)))
-    return (dx.astype(x.dtype), dw[:, :v].astype(w.dtype),
-            db[:v].astype(b.dtype), None)
+        tuple(_vary_like(c, x, labels, g, wp, bp) for c in
+              (jnp.zeros((n, d), jnp.float32),
+               jnp.zeros((d, pv), jnp.float32),
+               jnp.zeros((pv,), jnp.float32))))
+    return (_grad_vma_like(dx.astype(x.dtype), x),
+            _grad_vma_like(dw[:, :v].astype(w.dtype), w),
+            _grad_vma_like(db[:v].astype(b.dtype), b), None)
 
 
 lm_head_loss.defvjp(_lm_head_fwd, _lm_head_bwd)

@@ -48,7 +48,17 @@ class ExecutionStrategy:
 
 class BuildStrategy:
     """Reference's graph-build knobs. reduce_strategy/gradient_scale map to
-    sharding choices; the rest are XLA's concern."""
+    sharding choices; the rest are XLA's concern.
+
+    TPU-native extension — pipeline parallelism from the SAME Program:
+    ``pipeline_stages=S`` (with a mesh carrying a ``pipeline_axis`` of
+    size S) slices the program's repeated-layer region into S stages via
+    ``parallel.pipeline_program.plan_pipeline`` and runs it GPipe-style;
+    feeds then carry ``pipeline_microbatches ×`` the declared batch in
+    dim 0. This is the graph-partitioning capability of the reference's
+    distribute/pipeline transpiler (reference:
+    transpiler/distribute_transpiler.py:159) done as a structural pass
+    instead of a ProgramDesc rewrite."""
 
     class ReduceStrategy:
         AllReduce = "AllReduce"
@@ -62,6 +72,9 @@ class BuildStrategy:
         self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
         self.gradient_scale_strategy = BuildStrategy.GradientScaleStrategy.CoeffNumDevice
         self.debug_graphviz_path = ""
+        self.pipeline_stages = 0
+        self.pipeline_microbatches = 1
+        self.pipeline_axis = "pp"
 
 
 class _ParCompiled:
@@ -142,9 +155,32 @@ class ParallelExecutor:
 
         program = self._program
         feed_names = tuple(n for n, _, _ in feed_sig)
-        # same fail-fast shape validation as the single-device executor
-        # (all ParallelExecutor feeds are user-supplied)
-        Executor._check_feed_shapes(program, feed_sig)
+        bs = self._build_strategy
+        pp_stages = int(getattr(bs, "pipeline_stages", 0) or 0)
+        if pp_stages < 2:
+            # same fail-fast shape validation as the single-device executor
+            # (all ParallelExecutor feeds are user-supplied)
+            Executor._check_feed_shapes(program, feed_sig)
+        else:
+            # pipelined feeds carry M x dp x the declared batch in dim 0;
+            # ranks and trailing dims still validate fail-fast
+            gb = program.global_block()
+            for name, shape, _dtype in feed_sig:
+                var = gb._find_var_recursive(name)
+                declared = getattr(var, "shape", None) if var is not None else None
+                if not declared:
+                    continue
+                declared = tuple(declared)
+                ok = len(declared) == len(shape) and all(
+                    d in (-1, None) or d == s
+                    for d, s in zip(declared[1:], shape[1:]))
+                if not ok:
+                    raise ValueError(
+                        "feed %r has shape %s but the program declares %s "
+                        "(dim 0 carries num_microbatches x dp x the "
+                        "declared per-device microbatch under pipeline "
+                        "parallelism; trailing dims must match)"
+                        % (name, tuple(shape), declared))
         state_in, state_out = analyze_state(program, set(feed_names))
         missing = [n for n in state_in if self._scope.find_var(n) is None]
         if missing:
@@ -152,7 +188,21 @@ class ParallelExecutor:
                 "persistable variables %s have no value in scope; run the "
                 "startup program first" % (missing,)
             )
-        stepfn = build_step_fn(program, fetch_names, state_in, state_out)
+        if pp_stages >= 2:
+            from .pipeline_program import (build_pipeline_step_fn,
+                                           plan_pipeline)
+
+            pplan = plan_pipeline(program, pp_stages)
+            batch_axis = next(
+                (a for a in self._plan.batch_axes
+                 if a != bs.pipeline_axis and self._mesh.shape[a] > 1),
+                None)
+            stepfn = build_pipeline_step_fn(
+                program, fetch_names, state_in, state_out, self._mesh,
+                pplan, int(bs.pipeline_microbatches),
+                pp_axis=bs.pipeline_axis, batch_axis=batch_axis)
+        else:
+            stepfn = build_step_fn(program, fetch_names, state_in, state_out)
 
         # the traced step may return fewer state vars than analyze_state
         # guesses (e.g. a persistable written only under a lax control-flow

@@ -7,10 +7,13 @@
   var reuse; XLA owns buffer assignment here, so this exposes the
   rematerialization policy knob instead (see memory_optimizer.py).
 - InferenceTranspiler: inference-time graph rewrites (BN fold).
+- PipelineTranspiler: structural stage-cut pass — the SAME Program that
+  runs dp/tp/sp runs pipelined under a pp mesh axis.
 """
 from .distribute_transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
 from .memory_optimizer import memory_optimize, release_memory  # noqa: F401
 from .inference_transpiler import InferenceTranspiler  # noqa: F401
+from .pipeline_transpiler import PipelineTranspiler  # noqa: F401
 
 __all__ = [
     "DistributeTranspiler",
@@ -18,4 +21,5 @@ __all__ = [
     "memory_optimize",
     "release_memory",
     "InferenceTranspiler",
+    "PipelineTranspiler",
 ]

@@ -1,0 +1,52 @@
+"""PipelineTranspiler: program-level pipeline-parallel planning.
+
+The reference's transpilers rewrite the ProgramDesc (reference:
+python/paddle/fluid/transpiler/distribute_transpiler.py:159 splits
+params/ops across workers and wires send/recv ops). TPU-native the
+Program stays untouched: ``transpile()`` runs the structural stage-cut
+pass (``parallel.pipeline_program.plan_pipeline``) and the result plugs
+into ParallelExecutor via ``build_strategy()``. The pass itself — not a
+hand-written ``stage_fn`` — decides where the stages cut, so the SAME
+Program that runs dp/tp/sp also runs pp.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..framework.core import Program, default_main_program
+from ..parallel.pipeline_program import PipelinePlan, plan_pipeline
+
+__all__ = ["PipelineTranspiler"]
+
+
+class PipelineTranspiler:
+    def __init__(self, num_stages: int, num_microbatches: int = 1,
+                 pipeline_axis: str = "pp"):
+        self.num_stages = int(num_stages)
+        self.num_microbatches = int(num_microbatches)
+        self.pipeline_axis = pipeline_axis
+        self._plan: Optional[PipelinePlan] = None
+
+    def transpile(self, program: Optional[Program] = None) -> PipelinePlan:
+        """Plan the stage cut; raises PipelineError with a diagnosis when
+        the program has no pipelineable layer structure."""
+        program = program if program is not None else default_main_program()
+        self._plan = plan_pipeline(program, self.num_stages)
+        return self._plan
+
+    @property
+    def plan(self) -> PipelinePlan:
+        if self._plan is None:
+            raise RuntimeError("call transpile() first")
+        return self._plan
+
+    def build_strategy(self):
+        """A BuildStrategy carrying this transpiler's pipeline config —
+        pass to ParallelExecutor(build_strategy=...)."""
+        from ..parallel.parallel_executor import BuildStrategy
+
+        bs = BuildStrategy()
+        bs.pipeline_stages = self.num_stages
+        bs.pipeline_microbatches = self.num_microbatches
+        bs.pipeline_axis = self.pipeline_axis
+        return bs
