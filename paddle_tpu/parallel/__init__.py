@@ -11,6 +11,7 @@ from .mesh import (  # noqa: F401
     device_count,
     get_places,
     init_distributed,
+    make_hybrid_mesh,
     make_mesh,
 )
 from .collective import (  # noqa: F401
@@ -44,6 +45,12 @@ from .pipeline import (  # noqa: F401
     num_pipeline_ticks,
     pipeline_apply,
     stack_stage_params,
+)
+from .pipeline_program import (  # noqa: F401
+    PipelineError,
+    PipelinePlan,
+    build_pipeline_step_fn,
+    plan_pipeline,
 )
 from .moe import (  # noqa: F401
     MoEParams,

@@ -18,6 +18,7 @@ from jax.sharding import Mesh
 
 __all__ = [
     "make_mesh",
+    "make_hybrid_mesh",
     "default_mesh",
     "device_count",
     "get_places",
@@ -52,6 +53,90 @@ def make_mesh(
         )
     arr = np.array(devices[:n]).reshape(shape)
     return Mesh(arr, tuple(axis_names))
+
+
+def make_hybrid_mesh(
+    axis_names: Sequence[str],
+    ici_shape: Sequence[int],
+    dcn_shape: Sequence[int],
+    devices=None,
+) -> Mesh:
+    """Hybrid ICI×DCN mesh: axis ``i`` has size ``dcn[i] * ici[i]`` with
+    the DCN (cross-host) factor slowest-varying, so collectives along an
+    axis whose dcn factor is 1 stay entirely on ICI and only the axes
+    that genuinely span hosts ride DCN.
+
+    The reference's multi-trainer layout splits work host-major the same
+    way (reference: transpiler/distribute_transpiler.py trainer split +
+    ParallelExecutor num_trainers/trainer_id NCCL bootstrap); here the
+    layout is a device permutation and XLA routes each collective over
+    the fastest fabric it spans.
+
+    Typical pod use: ``make_hybrid_mesh(("dp", "mp"), ici_shape=(1, 8),
+    dcn_shape=(n_hosts, 1))`` — data parallel across hosts over DCN,
+    tensor parallel inside each host over ICI.
+
+    Under ``jax.distributed`` this delegates to
+    ``mesh_utils.create_hybrid_device_mesh`` (groups by process). Single-
+    process (virtual-device tests), devices are arranged host-major with
+    ``prod(ici_shape)`` consecutive devices per emulated host — the same
+    ordering a real multi-process enumeration produces, which is what the
+    ordering tests pin down.
+    """
+    axis_names = tuple(axis_names)
+    ici_shape = tuple(int(s) for s in ici_shape)
+    dcn_shape = tuple(int(s) for s in dcn_shape)
+    if not (len(axis_names) == len(ici_shape) == len(dcn_shape)):
+        raise ValueError(
+            "axis_names %s, ici_shape %s and dcn_shape %s must align"
+            % (axis_names, ici_shape, dcn_shape))
+    devices = list(jax.devices()) if devices is None else list(devices)
+    n = int(np.prod(ici_shape)) * int(np.prod(dcn_shape))
+    if n > len(devices):
+        raise ValueError(
+            "hybrid mesh ici %s x dcn %s needs %d devices, only %d "
+            "available" % (ici_shape, dcn_shape, n, len(devices)))
+    devices = devices[:n]
+
+    if jax.process_count() > 1:
+        # TPU pods: prefer jax's topology-aware construction (it groups
+        # by pod slice); CPU/GPU jobs have one degenerate slice — group
+        # by process there instead
+        slices = {getattr(d, "slice_index", None) for d in devices}
+        if None not in slices and len(slices) == int(np.prod(dcn_shape)):
+            from jax.experimental import mesh_utils
+
+            arr = mesh_utils.create_hybrid_device_mesh(
+                ici_shape, dcn_shape, devices=devices)
+            return Mesh(arr, axis_names)
+        devices = sorted(devices, key=lambda d: (d.process_index, d.id))
+        # the host-major reshape below puts prod(ici) CONSECUTIVE devices
+        # on one emulated host; that only matches reality when each
+        # process contributes exactly prod(ici) devices — otherwise an
+        # "ICI" group would silently span processes (i.e. ride DCN)
+        per_proc: dict = {}
+        for d in devices:
+            per_proc[d.process_index] = per_proc.get(d.process_index, 0) + 1
+        ici_n = int(np.prod(ici_shape))
+        if set(per_proc.values()) != {ici_n}:
+            raise ValueError(
+                "hybrid mesh needs prod(ici_shape)=%d devices per "
+                "process, but processes contribute %s; pick an ici_shape "
+                "matching the per-host device count"
+                % (ici_n, sorted(per_proc.values())))
+        if len(per_proc) != int(np.prod(dcn_shape)):
+            raise ValueError(
+                "hybrid mesh dcn_shape %s implies %d hosts but the "
+                "devices span %d processes"
+                % (dcn_shape, int(np.prod(dcn_shape)), len(per_proc)))
+
+    # host-major enumeration: prod(ici) consecutive devices per host
+    k = len(axis_names)
+    arr = np.array(devices).reshape(dcn_shape + ici_shape)
+    # interleave (dcn_0, ici_0, dcn_1, ici_1, ...) then merge per axis
+    arr = arr.transpose([ax for i in range(k) for ax in (i, k + i)])
+    arr = arr.reshape([d * i for d, i in zip(dcn_shape, ici_shape)])
+    return Mesh(arr, axis_names)
 
 
 def default_mesh(axis_name: str = "dp") -> Mesh:

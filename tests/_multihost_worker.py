@@ -1,0 +1,83 @@
+"""Worker for the two-process multi-host test (run via subprocess).
+
+Usage: python _multihost_worker.py <proc_id> <n_proc> <port> <out.npz>
+
+Each process owns 2 virtual CPU devices; jax.distributed joins them into
+one 4-device job. The worker trains an MLP for 3 dp steps through
+ParallelExecutor(num_trainers=n, trainer_id=i) feeding only its LOCAL
+shard of each global batch, then process 0 writes losses + final params.
+"""
+import os
+import sys
+
+
+def main():
+    proc_id, n_proc, port, out_path = (int(sys.argv[1]), int(sys.argv[2]),
+                                       sys.argv[3], sys.argv[4])
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+    import numpy as np
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu as fluid
+    from paddle_tpu.parallel import (ParallelExecutor, init_distributed,
+                                     make_hybrid_mesh)
+
+    init_distributed("127.0.0.1:%s" % port, num_processes=n_proc,
+                     process_id=proc_id)
+    assert jax.process_count() == n_proc, jax.process_count()
+    assert jax.device_count() == 2 * n_proc, jax.device_count()
+    assert jax.local_device_count() == 2
+
+    # hybrid mesh: dp spans hosts over DCN; devices must enumerate
+    # host-major (process 0's devices first)
+    mesh = make_hybrid_mesh(("dp",), ici_shape=(2,), dcn_shape=(n_proc,))
+    flat = list(mesh.devices.flat)
+    assert [d.process_index for d in flat] == sorted(
+        d.process_index for d in flat), (
+        "hybrid mesh is not host-major: %s" % flat)
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    main_prog.random_seed = startup.random_seed = 13
+    with fluid.unique_name.guard(), fluid.program_guard(main_prog, startup):
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(x, 32, act="relu")
+        pred = fluid.layers.fc(h, 1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    scope = fluid.core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        pexe = ParallelExecutor(
+            loss_name=loss.name, main_program=main_prog, scope=scope,
+            mesh=mesh, num_trainers=n_proc, trainer_id=proc_id)
+        rs = np.random.RandomState(0)
+        losses = []
+        for step in range(3):
+            xb = rs.randn(8, 16).astype(np.float32)
+            yb = (xb[:, :1] * 0.5 + 0.1).astype(np.float32)
+            lo = 8 // n_proc * proc_id
+            hi = 8 // n_proc * (proc_id + 1)
+            lv, = pexe.run(feed={"x": xb[lo:hi], "y": yb[lo:hi]},
+                           fetch_list=[loss])
+            losses.append(float(np.squeeze(lv)))
+        params = {
+            p.name: np.asarray(scope.find_var(p.name))
+            for p in main_prog.all_parameters()
+        }
+    if proc_id == 0:
+        np.savez(out_path, losses=np.asarray(losses), **params)
+    jax.distributed.shutdown()
+
+
+if __name__ == "__main__":
+    main()
