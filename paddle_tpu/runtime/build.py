@@ -1,9 +1,10 @@
-"""Build the C++ runtime shared library on first import.
+"""Build the C++ runtime shared libraries on first use.
 
-g++ is part of the supported environment; the .so is cached next to the
-source keyed on a content hash, so rebuilds only happen when runtime.cc
+g++ is part of the supported environment; each .so is cached next to its
+source keyed on a content hash, so rebuilds only happen when the source
 changes. When no toolchain is available the Python fallback in
-recordio.py keeps everything working (same on-disk format).
+recordio.py keeps everything working (same on-disk format), and the C
+ABI reports its build error through capi_build_error().
 """
 from __future__ import annotations
 
@@ -11,8 +12,47 @@ import hashlib
 import os
 import subprocess
 import threading
+from typing import List, Optional, Tuple
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _build_cached(src: str, prefix: str,
+                  extra_args: List[str]) -> Tuple[Optional[str], Optional[str]]:
+    """Compile `src` into `<prefix><contenthash>.so` beside it (cached),
+    removing stale same-prefix builds. Returns (path, None) or
+    (None, error)."""
+    with open(src, "rb") as f:
+        digest = hashlib.sha1(f.read()).hexdigest()[:16]
+    out = os.path.join(_HERE, "%s%s.so" % (prefix, digest))
+    if not os.path.exists(out):
+        # per-process temp name: concurrent first-use builds (e.g.
+        # pytest workers) must not clobber each other's half-written .so
+        tmp = "%s.%d.tmp" % (out, os.getpid())
+        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+               src, "-o", tmp] + extra_args
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+            os.replace(tmp, out)
+        except (subprocess.CalledProcessError, OSError) as e:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return None, getattr(e, "stderr", None) or str(e)
+    # clean stale builds of THIS prefix only (the prefixes share a stem,
+    # so "starts with prefix" must also pin the hash-suffix shape)
+    for entry in os.listdir(_HERE):
+        if (entry.startswith(prefix) and entry.endswith(".so")
+                and entry != os.path.basename(out)
+                and len(entry) == len(os.path.basename(out))):
+            try:
+                os.remove(os.path.join(_HERE, entry))
+            except OSError:
+                pass
+    return out, None
+
+
 _SRC = os.path.join(_HERE, "runtime.cc")
 _lock = threading.Lock()
 _lib_path = None
@@ -20,43 +60,51 @@ _build_error = None
 
 
 def lib_path():
-    """Returns the built .so path, or None (with the error recorded) when
-    the toolchain is unavailable."""
+    """Returns the built runtime .so path, or None (with the error
+    recorded) when the toolchain is unavailable."""
     global _lib_path, _build_error
     with _lock:
-        if _lib_path is not None or _build_error is not None:
-            return _lib_path
-        with open(_SRC, "rb") as f:
-            digest = hashlib.sha1(f.read()).hexdigest()[:16]
-        out = os.path.join(_HERE, "_ptrt_%s.so" % digest)
-        if not os.path.exists(out):
-            # per-process temp name: concurrent first-use builds (e.g.
-            # pytest workers) must not clobber each other's half-written .so
-            tmp = "%s.%d.tmp" % (out, os.getpid())
-            cmd = [
-                "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-                _SRC, "-o", tmp, "-lz",
-            ]
-            try:
-                subprocess.run(cmd, check=True, capture_output=True, text=True)
-                os.replace(tmp, out)
-            except (subprocess.CalledProcessError, OSError) as e:
-                _build_error = getattr(e, "stderr", None) or str(e)
-                try:
-                    os.unlink(tmp)
-                except OSError:
-                    pass
-                return None
-        # clean stale builds
-        for entry in os.listdir(_HERE):
-            if entry.startswith("_ptrt_") and entry.endswith(".so") and entry != os.path.basename(out):
-                try:
-                    os.remove(os.path.join(_HERE, entry))
-                except OSError:
-                    pass
-        _lib_path = out
+        if _lib_path is None and _build_error is None:
+            _lib_path, _build_error = _build_cached(_SRC, "_ptrt_", ["-lz"])
         return _lib_path
 
 
 def build_error():
     return _build_error
+
+
+_CAPI_SRC = os.path.join(_HERE, "capi.cc")
+_capi_lock = threading.Lock()
+_capi_path = None
+_capi_error = None
+
+
+def capi_lib_path():
+    """Build (once) and return the embeddable-inference C ABI .so
+    (capi.cc / ptrt_capi.h): the predictor for C/C++ applications,
+    hosting the XLA runtime via an embedded interpreter. Returns None
+    with the error recorded when the toolchain or a shared libpython is
+    unavailable."""
+    global _capi_path, _capi_error
+    import sysconfig
+
+    with _capi_lock:
+        if _capi_path is not None or _capi_error is not None:
+            return _capi_path
+        ver = (sysconfig.get_config_var("LDVERSION")
+               or sysconfig.get_config_var("VERSION"))
+        if not sysconfig.get_config_var("Py_ENABLE_SHARED"):
+            _capi_error = ("no shared libpython: the C ABI hosts the "
+                           "runtime via libpython%s" % ver)
+            return None
+        inc = sysconfig.get_paths()["include"]
+        libdir = sysconfig.get_config_var("LIBDIR") or ""
+        _capi_path, _capi_error = _build_cached(
+            _CAPI_SRC, "_ptrt_capi_",
+            ["-I", inc, "-L", libdir, "-Wl,-rpath," + libdir,
+             "-lpython%s" % ver])
+        return _capi_path
+
+
+def capi_build_error():
+    return _capi_error

@@ -1,0 +1,296 @@
+// ptrt C ABI implementation: embeddable inference without writing Python.
+//
+// Reference counterpart: paddle/fluid/inference/api/api_impl.cc
+// (NativePaddlePredictor::Run — C++ executor over a loaded ProgramDesc)
+// and paddle/legacy/capi/main.h. The TPU-native predictor's compute path
+// is an AOT-serialized XLA executable; XLA's runtime is hosted via an
+// embedded CPython behind this ABI. The embedding application sees only
+// plain C (see ptrt_capi.h) — it does not link libpython, include any
+// Python header, or manage the interpreter.
+//
+// Threading: the hosted runtime is initialized once; every ABI call takes
+// the GIL via PyGILState_Ensure, so any thread may call.
+//
+// Build: runtime/build.py:capi_lib_path() — g++ -shared against the
+// interpreter's include/lib dirs discovered from sysconfig.
+
+#include <Python.h>
+
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+#include "ptrt_capi.h"
+
+namespace {
+
+thread_local std::string g_err;
+std::mutex g_init_mutex;
+
+void set_err(const char *where) {
+  g_err = where;
+  PyObject *ptype = nullptr, *pval = nullptr, *ptb = nullptr;
+  if (PyErr_Occurred()) {
+    PyErr_Fetch(&ptype, &pval, &ptb);
+    PyErr_NormalizeException(&ptype, &pval, &ptb);
+    if (pval) {
+      PyObject *s = PyObject_Str(pval);
+      if (s) {
+        const char *msg = PyUnicode_AsUTF8(s);
+        if (msg) {
+          g_err += ": ";
+          g_err += msg;
+        }
+        Py_DECREF(s);
+      }
+    }
+    Py_XDECREF(ptype);
+    Py_XDECREF(pval);
+    Py_XDECREF(ptb);
+    PyErr_Clear();
+  }
+}
+
+bool ensure_runtime() {
+  // serialize first-time init: two threads loading predictors
+  // concurrently in a fresh process must not both run Py_InitializeEx
+  std::lock_guard<std::mutex> lock(g_init_mutex);
+  if (Py_IsInitialized()) return true;
+  Py_InitializeEx(0);
+  if (!Py_IsInitialized()) {
+    g_err = "failed to initialize the hosted runtime";
+    return false;
+  }
+  // hand the GIL back so PyGILState_Ensure works from any thread
+  PyEval_SaveThread();
+  return true;
+}
+
+struct Guard {  // GIL scope
+  PyGILState_STATE st;
+  Guard() : st(PyGILState_Ensure()) {}
+  ~Guard() { PyGILState_Release(st); }
+};
+
+}  // namespace
+
+struct ptrt_predictor {
+  PyObject *pred = nullptr;     // paddle_tpu.inference.Predictor
+  PyObject *np = nullptr;       // numpy module
+  std::string *feed_names = nullptr;
+  std::string *fetch_names = nullptr;
+  int32_t n_feeds = 0;
+  int32_t n_fetches = 0;
+};
+
+extern "C" const char *ptrt_last_error(void) { return g_err.c_str(); }
+
+extern "C" ptrt_predictor *ptrt_predictor_load(const char *model_dir) {
+  if (!ensure_runtime()) return nullptr;
+  Guard gil;
+  PyObject *mod = PyImport_ImportModule("paddle_tpu.inference");
+  if (!mod) {
+    set_err("import paddle_tpu.inference failed (is PYTHONPATH set to the "
+            "paddle_tpu install and its site-packages?)");
+    return nullptr;
+  }
+  PyObject *pred = PyObject_CallMethod(mod, "Predictor", "s", model_dir);
+  Py_DECREF(mod);
+  if (!pred) {
+    set_err("Predictor(model_dir) failed");
+    return nullptr;
+  }
+  PyObject *np = PyImport_ImportModule("numpy");
+  if (!np) {
+    set_err("import numpy failed");
+    Py_DECREF(pred);
+    return nullptr;
+  }
+  PyObject *feeds = PyObject_GetAttrString(pred, "feed_names");
+  PyObject *fetches = PyObject_GetAttrString(pred, "fetch_names");
+  if (!feeds || !fetches) {
+    set_err("predictor introspection failed");
+    Py_XDECREF(feeds);
+    Py_XDECREF(fetches);
+    Py_DECREF(pred);
+    Py_DECREF(np);
+    return nullptr;
+  }
+  ptrt_predictor *p = new ptrt_predictor;
+  p->pred = pred;
+  p->np = np;
+  p->n_feeds = (int32_t)PyList_Size(feeds);
+  p->n_fetches = (int32_t)PyList_Size(fetches);
+  p->feed_names = new std::string[p->n_feeds];
+  for (int32_t i = 0; i < p->n_feeds; ++i)
+    p->feed_names[i] = PyUnicode_AsUTF8(PyList_GetItem(feeds, i));
+  p->fetch_names = new std::string[p->n_fetches];
+  for (int32_t i = 0; i < p->n_fetches; ++i)
+    p->fetch_names[i] = PyUnicode_AsUTF8(PyList_GetItem(fetches, i));
+  Py_DECREF(feeds);
+  Py_DECREF(fetches);
+  return p;
+}
+
+extern "C" int32_t ptrt_predictor_num_feeds(ptrt_predictor *p) {
+  return p ? p->n_feeds : 0;
+}
+
+extern "C" const char *ptrt_predictor_feed_name(ptrt_predictor *p,
+                                                int32_t i) {
+  if (!p || i < 0 || i >= p->n_feeds) return nullptr;
+  return p->feed_names[i].c_str();
+}
+
+extern "C" int32_t ptrt_predictor_num_fetches(ptrt_predictor *p) {
+  return p ? p->n_fetches : 0;
+}
+
+extern "C" const char *ptrt_predictor_fetch_name(ptrt_predictor *p,
+                                                 int32_t i) {
+  if (!p || i < 0 || i >= p->n_fetches) return nullptr;
+  return p->fetch_names[i].c_str();
+}
+
+namespace {
+
+// buffer -> numpy array: np.frombuffer(memoryview, dtype).reshape(dims)
+PyObject *tensor_to_array(ptrt_predictor *p, const ptrt_tensor &t) {
+  PyObject *mv = PyMemoryView_FromMemory(
+      reinterpret_cast<char *>(t.data), t.nbytes, PyBUF_READ);
+  if (!mv) return nullptr;
+  PyObject *flat =
+      PyObject_CallMethod(p->np, "frombuffer", "Os", mv, t.dtype);
+  Py_DECREF(mv);
+  if (!flat) return nullptr;
+  PyObject *shape = PyTuple_New(t.ndim);
+  for (int32_t d = 0; d < t.ndim; ++d)
+    PyTuple_SetItem(shape, d, PyLong_FromLongLong(t.dims[d]));
+  PyObject *arr = PyObject_CallMethod(flat, "reshape", "(O)", shape);
+  Py_DECREF(flat);
+  Py_DECREF(shape);
+  return arr;
+}
+
+// numpy array -> malloc'd ptrt_tensor copy
+bool array_to_tensor(ptrt_predictor *p, PyObject *arr_in, ptrt_tensor *out) {
+  std::memset(out, 0, sizeof(*out));
+  PyObject *arr =
+      PyObject_CallMethod(p->np, "ascontiguousarray", "O", arr_in);
+  if (!arr) return false;
+  PyObject *dt = PyObject_GetAttrString(arr, "dtype");
+  PyObject *dts = dt ? PyObject_Str(dt) : nullptr;
+  if (dts) {
+    std::snprintf(out->dtype, sizeof(out->dtype), "%s",
+                  PyUnicode_AsUTF8(dts));
+  }
+  Py_XDECREF(dts);
+  Py_XDECREF(dt);
+  PyObject *shape = PyObject_GetAttrString(arr, "shape");
+  if (!shape) {
+    Py_DECREF(arr);
+    return false;
+  }
+  out->ndim = (int32_t)PyTuple_Size(shape);
+  if (out->ndim > PTRT_MAX_DIMS) {
+    g_err = "fetch tensor exceeds PTRT_MAX_DIMS";
+    Py_DECREF(shape);
+    Py_DECREF(arr);
+    return false;
+  }
+  for (int32_t d = 0; d < out->ndim; ++d)
+    out->dims[d] = PyLong_AsLongLong(PyTuple_GetItem(shape, d));
+  Py_DECREF(shape);
+
+  Py_buffer view;
+  if (PyObject_GetBuffer(arr, &view, PyBUF_CONTIG_RO) != 0) {
+    Py_DECREF(arr);
+    return false;
+  }
+  out->nbytes = (int64_t)view.len;
+  out->data = std::malloc(view.len ? view.len : 1);
+  if (!out->data) {
+    g_err = "out of memory";
+    PyBuffer_Release(&view);
+    Py_DECREF(arr);
+    return false;
+  }
+  std::memcpy(out->data, view.buf, view.len);
+  PyBuffer_Release(&view);
+  Py_DECREF(arr);
+  return true;
+}
+
+}  // namespace
+
+extern "C" int ptrt_predictor_run(ptrt_predictor *p, const ptrt_tensor *ins,
+                                  int32_t n_in, ptrt_tensor **outs,
+                                  int32_t *n_out) {
+  if (!p || !p->pred) {
+    g_err = "null predictor";
+    return 1;
+  }
+  *outs = nullptr;
+  *n_out = 0;
+  for (int32_t i = 0; i < n_in; ++i) {
+    if (ins[i].ndim < 0 || ins[i].ndim > PTRT_MAX_DIMS) {
+      g_err = "feed tensor ndim out of range [0, PTRT_MAX_DIMS]";
+      return 1;
+    }
+  }
+  Guard gil;
+  PyObject *feed = PyDict_New();
+  for (int32_t i = 0; i < n_in; ++i) {
+    PyObject *arr = tensor_to_array(p, ins[i]);
+    if (!arr) {
+      set_err("building feed array failed");
+      Py_DECREF(feed);
+      return 1;
+    }
+    PyDict_SetItemString(feed, ins[i].name, arr);
+    Py_DECREF(arr);
+  }
+  PyObject *result = PyObject_CallMethod(p->pred, "run", "O", feed);
+  Py_DECREF(feed);
+  if (!result) {
+    set_err("predictor run failed");
+    return 1;
+  }
+  int32_t n = (int32_t)PyList_Size(result);
+  ptrt_tensor *ts =
+      static_cast<ptrt_tensor *>(std::calloc(n > 0 ? n : 1, sizeof(ptrt_tensor)));
+  for (int32_t i = 0; i < n; ++i) {
+    if (!array_to_tensor(p, PyList_GetItem(result, i), &ts[i])) {
+      set_err("extracting fetch tensor failed");
+      ptrt_tensors_free(ts, i);
+      Py_DECREF(result);
+      return 1;
+    }
+    if (i < p->n_fetches)
+      std::snprintf(ts[i].name, sizeof(ts[i].name), "%s",
+                    p->fetch_names[i].c_str());
+  }
+  Py_DECREF(result);
+  *outs = ts;
+  *n_out = n;
+  return 0;
+}
+
+extern "C" void ptrt_tensors_free(ptrt_tensor *ts, int32_t n) {
+  if (!ts) return;
+  for (int32_t i = 0; i < n; ++i) std::free(ts[i].data);
+  std::free(ts);
+}
+
+extern "C" void ptrt_predictor_free(ptrt_predictor *p) {
+  if (!p) return;
+  if (Py_IsInitialized()) {
+    Guard gil;
+    Py_XDECREF(p->pred);
+    Py_XDECREF(p->np);
+  }
+  delete[] p->feed_names;
+  delete[] p->fetch_names;
+  delete p;
+}
