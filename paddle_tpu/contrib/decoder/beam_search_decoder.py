@@ -1,12 +1,13 @@
-"""General RNN decoder API: training + beam-search inference (reference:
-python/paddle/fluid/contrib/decoder/beam_search_decoder.py).
+"""General RNN decoder API: training + beam-search inference (reference
+capability: python/paddle/fluid/contrib/decoder/beam_search_decoder.py —
+public classes InitState/StateCell/TrainingDecoder/BeamSearchDecoder).
 
 ``StateCell`` names the hidden states / step inputs of a custom RNN cell
 and holds the user's update function; ``TrainingDecoder`` runs the cell
 over a target sequence (teacher forcing); ``BeamSearchDecoder`` runs it
 step-by-step with a beam.
 
-TPU-native divergences from the reference:
+TPU-native design (a redesign, not a port of the reference's internals):
 
 - The reference's beam loop is a ``While`` over LoD TensorArrays whose
   batch shrinks as hypotheses finish and whose states reorder through LoD
@@ -16,6 +17,12 @@ TPU-native divergences from the reference:
   ``beam_search`` op's contract), and state rows reorder with the
   ``beam_gather`` op driven by the step's parent pointers — same results,
   static shapes.
+- The cell↔decoder wiring is a ``_LoopBinding`` created when the decoder
+  block is entered: boot values (beam-tiled for beam search) are emitted
+  into the PARENT block right before the loop opens, then each state gets
+  a loop memory. There is no deferred/lazy state migration — custom
+  ``decode()`` overrides get correct boot placement for free because
+  ``block()`` itself does it.
 - ``InitState(need_reorder=...)`` is accepted but has nothing to do:
   dense batches have no LoD rank order.
 """
@@ -24,7 +31,6 @@ from __future__ import annotations
 import contextlib
 
 from ... import layers
-from ...framework.core import Variable
 from ...layer_helper import LayerHelper
 
 __all__ = ["InitState", "StateCell", "TrainingDecoder", "BeamSearchDecoder"]
@@ -37,7 +43,8 @@ class _DecoderType:
 
 class InitState:
     """Initial hidden state: wraps `init`, or builds a constant tensor
-    batch-shaped like `init_boot` (reference beam_search_decoder.py:43)."""
+    batch-shaped like `init_boot` (reference capability:
+    beam_search_decoder.py:43)."""
 
     def __init__(self, init=None, shape=None, value=0.0, init_boot=None,
                  need_reorder=False, dtype="float32"):
@@ -45,10 +52,11 @@ class InitState:
             self._init = init
         elif init_boot is None:
             raise ValueError(
-                "init_boot must be provided to infer the shape of InitState.")
+                "InitState needs either `init` (a Variable) or `init_boot` "
+                "(a batch-shaped Variable to size a constant state from)")
         else:
             self._init = layers.fill_constant_batch_size_like(
-                input=init_boot, value=value, shape=shape, dtype=dtype)
+                shape=shape, dtype=dtype, input=init_boot, value=value)
         self._shape = shape
         self._value = value
         self._need_reorder = need_reorder  # no-op on dense batches
@@ -63,99 +71,93 @@ class InitState:
         return self._need_reorder
 
 
-class _MemoryState:
-    """A state bound to a decoder loop memory (reference _MemoryState /
-    _ArrayState collapse into one here: both decoders are scan loops)."""
+class _LoopBinding:
+    """Live connection between a StateCell and one decoder's scan loop.
 
-    def __init__(self, rnn, init_value):
-        self._rnn = rnn
-        self._mem = rnn.memory(init=init_value)
-        self.pending = None
+    Built at decoder-block entry: every named state gets a loop memory
+    booted from the (possibly beam-tiled) InitState value. During a step,
+    ``current`` tracks the in-flight value the updater produces;
+    ``update_states`` stamps those as the step's pending results for the
+    decoder to commit (directly, or reordered by beam parents)."""
 
-    def get_state(self):
-        return self._mem
+    def __init__(self, loop, boot_values):
+        self.loop = loop
+        self.memories = {n: loop.memory(init=v)
+                         for n, v in boot_values.items()}
+        self.current = dict(self.memories)
+        self.pending = {}
 
-    def update_state(self, state):
-        self.pending = state
+    def stage_updates(self, values):
+        self.pending = {n: values[n] for n in self.memories}
+
+    def take_pending(self, name):
+        return self.pending.pop(name, self.memories[name])
 
 
 class StateCell:
     """Named states + step inputs + a user update function (reference
-    beam_search_decoder.py:159). The updater reads inputs with
-    ``get_input``, reads/writes states with ``get_state``/``set_state``;
-    ``out_state`` names the state the decoder scores."""
+    capability: beam_search_decoder.py:159). The updater reads inputs
+    with ``get_input``, reads/writes states with ``get_state``/
+    ``set_state``; ``out_state`` names the state the decoder scores.
+
+    One cell drives one decoder: the decoder claims the cell when
+    constructed, and all state access happens inside its block."""
 
     def __init__(self, inputs, states, out_state, name=None):
-        self._cur_states = {}
-        self._state_names = []
+        self._init_states = {}
         for state_name, state in states.items():
             if not isinstance(state, InitState):
-                raise ValueError("state must be an InitState object.")
-            self._cur_states[state_name] = state
-            self._state_names.append(state_name)
-        self._inputs = dict(inputs)
-        self._cur_decoder_obj = None
-        self._in_decoder = False
-        self._states_holder = {}
-        self._switched_decoder = False
-        self._state_updater = None
-        self._out_state = out_state
-        if self._out_state not in self._cur_states:
-            raise ValueError("out_state must be one state in states")
-
-    def _enter_decoder(self, decoder_obj):
-        if self._in_decoder or self._cur_decoder_obj is not None:
-            raise ValueError("StateCell has already entered a decoder.")
-        self._in_decoder = True
-        self._cur_decoder_obj = decoder_obj
-        self._switched_decoder = False
-
-    def _leave_decoder(self, decoder_obj):
-        if not self._in_decoder:
-            raise ValueError("StateCell not in decoder, invalid leave.")
-        if self._cur_decoder_obj is not decoder_obj:
-            raise ValueError("Inconsistent decoder object in StateCell.")
-        self._in_decoder = False
-        self._cur_decoder_obj = None
-        self._switched_decoder = False
-
-    def _switch_decoder(self):
-        """Bind each InitState to a loop memory of the current decoder
-        (lazily, on first state access inside the decoder block)."""
-        if not self._in_decoder:
-            raise ValueError("StateCell must enter a decoder first.")
-        if self._switched_decoder:
-            raise ValueError("StateCell already done switching.")
-        holder = self._states_holder.setdefault(id(self._cur_decoder_obj), {})
-        for state_name in self._state_names:
-            state = self._cur_states[state_name]
-            if not isinstance(state, InitState):
                 raise ValueError(
-                    "state %r was already consumed by another decoder; "
-                    "build a fresh StateCell per decoder pair" % state_name)
-            init_value = self._cur_decoder_obj._prepare_init(state)
-            holder[state_name] = _MemoryState(
-                self._cur_decoder_obj._loop, init_value)
-            self._cur_states[state_name] = holder[state_name].get_state()
-        self._switched_decoder = True
+                    "states[%r] must be an InitState" % state_name)
+            self._init_states[state_name] = state
+        self._inputs = dict(inputs)
+        self._out_state = out_state
+        self._state_updater = None
+        self._owner = None      # the decoder this cell drives
+        self._binding = None    # _LoopBinding while its block is open
+        if self._out_state not in self._init_states:
+            raise ValueError("out_state %r is not one of the states %s"
+                             % (out_state, sorted(self._init_states)))
 
-    def _holders(self):
-        return self._states_holder[id(self._cur_decoder_obj)]
+    # -- decoder-side wiring --------------------------------------------
+    def _claim(self, decoder):
+        if self._owner is not None:
+            raise ValueError(
+                "this StateCell already drives a %s; build one StateCell "
+                "per decoder" % type(self._owner).__name__)
+        self._owner = decoder
 
+    def _bind(self, binding):
+        self._binding = binding
+
+    def _unbind(self):
+        self._binding = None
+
+    def _require_binding(self, what):
+        if self._binding is None:
+            raise ValueError(
+                "%s is only valid inside the decoder block (the states "
+                "live as loop memories there)" % what)
+        return self._binding
+
+    # -- user API --------------------------------------------------------
     def get_state(self, state_name):
-        if self._in_decoder and not self._switched_decoder:
-            self._switch_decoder()
-        if state_name not in self._cur_states:
-            raise ValueError("Unknown state %s." % state_name)
-        return self._cur_states[state_name]
+        binding = self._require_binding("get_state")
+        if state_name not in binding.current:
+            raise ValueError("unknown state %r; cell has %s"
+                             % (state_name, sorted(binding.current)))
+        return binding.current[state_name]
 
     def get_input(self, input_name):
         if input_name not in self._inputs or self._inputs[input_name] is None:
-            raise ValueError("Invalid input %s." % input_name)
+            raise ValueError(
+                "input %r has no value this step; feed it through "
+                "compute_state(inputs=...)" % input_name)
         return self._inputs[input_name]
 
     def set_state(self, state_name, state_value):
-        self._cur_states[state_name] = state_value
+        binding = self._require_binding("set_state")
+        binding.current[state_name] = state_value
 
     def state_updater(self, updater):
         """Decorator registering the per-step update function (takes this
@@ -165,70 +167,78 @@ class StateCell:
 
     def compute_state(self, inputs):
         """Feed this step's inputs and run the updater."""
-        if self._in_decoder and not self._switched_decoder:
-            self._switch_decoder()
+        self._require_binding("compute_state")
         for input_name, input_value in inputs.items():
             if input_name not in self._inputs:
                 raise ValueError(
-                    "Unknown input %s: not an input placeholder" % input_name)
+                    "unknown input %r: not declared in StateCell(inputs=...)"
+                    % input_name)
             self._inputs[input_name] = input_value
         if self._state_updater is None:
-            raise ValueError("state_updater not set on StateCell")
+            raise ValueError(
+                "no state updater registered; decorate one with "
+                "@cell.state_updater")
         self._state_updater(self)
 
     def update_states(self):
-        """Record this step's new state values into the loop memories."""
-        if self._in_decoder and not self._switched_decoder:
-            self._switch_decoder()
-        for state_name, holder in self._holders().items():
-            holder.update_state(self._cur_states[state_name])
-        self._cur_decoder_obj._commit_states(self._holders())
+        """Stamp this step's state values as the step result and hand
+        them to the decoder (committed directly in training; reordered by
+        beam parents in beam search)."""
+        binding = self._require_binding("update_states")
+        binding.stage_updates(binding.current)
+        self._owner._commit_states(binding)
 
     def out_state(self):
-        return self._cur_states[self._out_state]
+        binding = self._require_binding("out_state")
+        return binding.current[self._out_state]
 
 
 class TrainingDecoder:
     """Teacher-forced decoder over a target sequence (reference
-    beam_search_decoder.py:384)::
+    capability: beam_search_decoder.py:384)::
 
-        decoder = TrainingDecoder(state_cell)
-        with decoder.block():
-            current_word = decoder.step_input(trg_embedding)
-            decoder.state_cell.compute_state(inputs={'x': current_word})
-            out = layers.fc(decoder.state_cell.get_state('h'), size=V,
+        td = TrainingDecoder(cell)
+        with td.block():
+            word = td.step_input(trg_embedding)
+            td.state_cell.compute_state(inputs={'x': word})
+            out = layers.fc(td.state_cell.get_state('h'), size=V,
                             act='softmax')
-            decoder.state_cell.update_states()
-            decoder.output(out)
-        rnn_out = decoder()
+            td.state_cell.update_states()
+            td.output(out)
+        rnn_out = td()
     """
 
+    # phase constants kept for API parity; internally _phase is a string
     BEFORE_DECODER = 0
     IN_DECODER = 1
     AFTER_DECODER = 2
 
     def __init__(self, state_cell, name=None):
         self._helper = LayerHelper("training_decoder", name=name)
-        self._status = TrainingDecoder.BEFORE_DECODER
+        self._phase = "building"
         self._loop = layers.DynamicRNN()
         self._type = _DecoderType.TRAINING
-        self._state_cell = state_cell
-        self._state_cell._enter_decoder(self)
+        self._cell = state_cell
+        state_cell._claim(self)
 
     @contextlib.contextmanager
     def block(self):
-        if self._status != TrainingDecoder.BEFORE_DECODER:
-            raise ValueError("decoder.block() can only be invoked once")
-        self._status = TrainingDecoder.IN_DECODER
+        if self._phase != "building":
+            raise ValueError("decoder.block() can only be entered once")
+        self._phase = "in_block"
+        cell = self._cell
         with self._loop.block():
+            cell._bind(_LoopBinding(
+                self._loop,
+                {n: st.value for n, st in cell._init_states.items()}))
             yield
-        self._status = TrainingDecoder.AFTER_DECODER
-        self._state_cell._leave_decoder(self)
+        cell._unbind()
+        self._phase = "done"
 
     @property
     def state_cell(self):
-        self._assert_in_decoder_block("state_cell")
-        return self._state_cell
+        self._require_block("state_cell")
+        return self._cell
 
     @property
     def dynamic_rnn(self):
@@ -238,38 +248,33 @@ class TrainingDecoder:
     def type(self):
         return self._type
 
-    def _prepare_init(self, init_state):
-        return init_state.value
-
-    def _commit_states(self, holders):
-        for holder in holders.values():
-            if holder.pending is not None:
-                self._loop.update_memory(holder.get_state(), holder.pending)
-                holder.pending = None
+    def _commit_states(self, binding):
+        for name, mem in binding.memories.items():
+            self._loop.update_memory(mem, binding.take_pending(name))
 
     def step_input(self, x, lengths=None):
-        self._assert_in_decoder_block("step_input")
+        self._require_block("step_input")
         return self._loop.step_input(x, lengths=lengths)
 
     def static_input(self, x):
         """A variable used whole in every step (not sliced over time)."""
-        self._assert_in_decoder_block("static_input")
+        self._require_block("static_input")
         return x  # dense scan bodies close over outer vars directly
 
     def __call__(self):
-        if self._status != TrainingDecoder.AFTER_DECODER:
+        if self._phase != "done":
             raise ValueError(
-                "Training decoder outputs are only visible after its block.")
+                "training decoder outputs exist only after its block closes")
         return self._loop()
 
     def output(self, *outputs):
-        self._assert_in_decoder_block("output")
+        self._require_block("output")
         self._loop.output(*outputs)
 
-    def _assert_in_decoder_block(self, method):
-        if self._status != TrainingDecoder.IN_DECODER:
+    def _require_block(self, method):
+        if self._phase != "in_block":
             raise ValueError(
-                "%s must be invoked inside the TrainingDecoder block" % method)
+                "%s is only valid inside decoder.block()" % method)
 
 
 def _beam_gather(x, parent, name=None):
@@ -296,20 +301,21 @@ def _tile_rows(x, k):
 
 
 class BeamSearchDecoder:
-    """Beam-search inference decoder (reference
+    """Beam-search inference decoder (reference capability:
     beam_search_decoder.py:523)::
 
         decoder = BeamSearchDecoder(state_cell, init_ids, init_scores,
                                     target_dict_dim, word_dim,
                                     beam_size=4, end_id=1, max_len=32)
         decoder.decode()
-        translation_ids, translation_scores = decoder()
+        out_ids, out_scores = decoder()
 
     ``init_ids``/``init_scores`` are (B, 1); beams 1..K-1 start at score
     -1e9 so the search leaves beam 0 (the reference achieves the same by
     starting with a single-hypothesis LoD level).
     """
 
+    # phase constants kept for API parity; internally _phase is a string
     BEFORE_BEAM_SEARCH_DECODER = 0
     IN_BEAM_SEARCH_DECODER = 1
     AFTER_BEAM_SEARCH_DECODER = 2
@@ -320,19 +326,16 @@ class BeamSearchDecoder:
                  emb_param_attr=None):
         self._helper = LayerHelper("beam_search_decoder", name=name)
         self._type = _DecoderType.BEAM_SEARCH
-        self._status = BeamSearchDecoder.BEFORE_BEAM_SEARCH_DECODER
+        self._phase = "building"
         self._loop = layers.StaticRNN()
-        self._state_cell = state_cell
-        self._state_cell._enter_decoder(self)
-        self._max_len = int(max_len)
-        self._beam_size = int(beam_size)
+        self._cell = state_cell
+        state_cell._claim(self)
+        self._max_len, self._beam_size = int(max_len), int(beam_size)
         self._end_id = int(end_id)
-        self._init_ids = init_ids
-        self._init_scores = init_scores
+        self._init_ids, self._init_scores = init_ids, init_scores
         self._target_dict_dim = int(target_dict_dim)
         self._topk_size = min(int(topk_size), int(target_dict_dim))
-        self._sparse_emb = sparse_emb
-        self._word_dim = int(word_dim)
+        self._sparse_emb, self._word_dim = sparse_emb, int(word_dim)
         self._input_var_dict = dict(input_var_dict or {})
         # name the prev-token embedding (e.g. ParamAttr("vemb")) to share
         # it with the training decoder's table across separate programs
@@ -341,38 +344,35 @@ class BeamSearchDecoder:
 
     @property
     def state_cell(self):
-        return self._state_cell
+        return self._cell
 
     @property
     def type(self):
         return self._type
 
-    def _prepare_init(self, init_state):
-        """Beam states live as (B*K, D): repeat each batch row K times.
-        The tiling ops must sit in the parent block (loop boot values),
-        so decode() pre-tiles before entering the scan and this just
-        looks the result up."""
-        pre = getattr(self, "_pretiled", {})
-        if id(init_state) in pre:
-            return pre[id(init_state)]
-        return _tile_rows(init_state.value, self._beam_size)
-
-    def _commit_states(self, holders):
-        # actual reorder-by-parent + memory update happens in decode()
-        # once the step's parent pointers exist
+    def _commit_states(self, binding):
+        # the reorder-by-parent + memory update happens in decode() once
+        # the step's parent pointers exist; staged values wait in pending
         pass
 
     @contextlib.contextmanager
     def block(self):
-        """The per-step block. decode() drives it; override decode() for a
-        custom cell wiring (reference contract)."""
-        if self._status != BeamSearchDecoder.BEFORE_BEAM_SEARCH_DECODER:
-            raise ValueError("block() can only be invoked once.")
-        self._status = BeamSearchDecoder.IN_BEAM_SEARCH_DECODER
+        """The per-step block. decode() drives it; override decode() for
+        a custom cell wiring. Beam-tiled state boot values are emitted
+        into the parent block HERE, right before the loop opens — custom
+        decode() implementations get correct placement automatically."""
+        if self._phase != "building":
+            raise ValueError("block() can only be entered once")
+        cell = self._cell
+        # parent-block scope: beam-expand every initial state
+        boots = {n: _tile_rows(st.value, self._beam_size)
+                 for n, st in cell._init_states.items()}
+        self._phase = "in_block"
         with self._loop.step():
+            cell._bind(_LoopBinding(self._loop, boots))
             yield
-        self._status = BeamSearchDecoder.AFTER_BEAM_SEARCH_DECODER
-        self._state_cell._leave_decoder(self)
+        cell._unbind()
+        self._phase = "done"
 
     def early_stop(self):
         """No-op on the fixed-trip dense loop: finished beams freeze via
@@ -394,15 +394,10 @@ class BeamSearchDecoder:
         # beam-expand any static feed variables once, outside the loop
         expanded_feeds = {}
         for name, var in self._input_var_dict.items():
-            if name not in self._state_cell._inputs:
-                raise ValueError("Variable %s not found in StateCell" % name)
+            if name not in self._cell._inputs:
+                raise ValueError(
+                    "input_var_dict[%r] is not a StateCell input" % name)
             expanded_feeds[name] = _tile_rows(var, k)
-        # beam-expand the initial states in the parent block too: they
-        # become the scan's boot values (see _prepare_init)
-        self._pretiled = {
-            id(state): _tile_rows(state.value, k)
-            for state in self._state_cell._cur_states.values()
-            if isinstance(state, InitState)}
 
         # fixed trip count: a (max_len, 1) dummy sequence drives the scan
         ticks = layers.fill_constant(
@@ -420,33 +415,31 @@ class BeamSearchDecoder:
                 param_attr=self._emb_param_attr)
 
             feed_dict = dict(expanded_feeds)
-            for input_name in self._state_cell._inputs:
-                if input_name not in feed_dict:
-                    feed_dict[input_name] = prev_emb
-            self._state_cell.compute_state(inputs=feed_dict)
+            for input_name in self._cell._inputs:
+                feed_dict.setdefault(input_name, prev_emb)
+            self._cell.compute_state(inputs=feed_dict)
 
-            current_state = self._state_cell.out_state()  # (B*K, D)
-            scores = layers.fc(input=current_state,
-                               size=self._target_dict_dim, act="softmax")
-            topk_scores, topk_indices = layers.topk(scores, k=self._topk_size)
-            accu_scores = layers.elementwise_add(
-                x=layers.log(topk_scores),
+            word_probs = layers.fc(input=self._cell.out_state(),
+                                   size=self._target_dict_dim,
+                                   act="softmax")
+            cand_probs, cand_ids = layers.topk(word_probs,
+                                               k=self._topk_size)
+            cum = layers.elementwise_add(
+                x=layers.log(cand_probs),
                 y=layers.reshape(prev_scores, shape=[-1, 1]))
             sel_ids, sel_scores, parent = layers.beam_search(
                 prev_ids, prev_scores,
-                layers.reshape(topk_indices, shape=[-1, k, self._topk_size]),
-                layers.reshape(accu_scores, shape=[-1, k, self._topk_size]),
+                layers.reshape(cand_ids, shape=[-1, k, self._topk_size]),
+                layers.reshape(cum, shape=[-1, k, self._topk_size]),
                 self._beam_size, end_id=self._end_id)
 
             # reorder every state by this step's winning parents, then
             # store for the next step
-            self._state_cell.update_states()
-            for holder in self._state_cell._holders().values():
-                new = holder.pending if holder.pending is not None \
-                    else holder.get_state()
-                holder.pending = None
-                self._loop.update_memory(holder.get_state(),
-                                         _beam_gather(new, parent))
+            self._cell.update_states()
+            binding = self._cell._binding
+            for name, mem in binding.memories.items():
+                self._loop.update_memory(
+                    mem, _beam_gather(binding.take_pending(name), parent))
             self._loop.update_memory(
                 prev_ids, layers.cast(sel_ids, self._init_ids.dtype))
             self._loop.update_memory(prev_scores, sel_scores)
@@ -461,9 +454,10 @@ class BeamSearchDecoder:
     update_array = read_array
 
     def __call__(self):
-        if self._status != BeamSearchDecoder.AFTER_BEAM_SEARCH_DECODER:
-            raise ValueError("decode() must run before reading outputs.")
+        if self._phase != "done":
+            raise ValueError("decode() must run before reading outputs")
         ids_stack, scores_stack, parent_stack = self._loop()
-        return layers.beam_search_decode(
-            ids_stack, scores_stack, beam_size=self._beam_size,
-            end_id=self._end_id, parent_idx=parent_stack)
+        return layers.beam_search_decode(ids_stack, scores_stack,
+                                         beam_size=self._beam_size,
+                                         end_id=self._end_id,
+                                         parent_idx=parent_stack)

@@ -20,20 +20,7 @@
 #include <stdlib.h>
 #include <string.h>
 
-#define PTRT_MAX_DIMS 8
-#define PTRT_NAME_LEN 128
-#define PTRT_DTYPE_LEN 16
-
-typedef struct {
-  char name[PTRT_NAME_LEN];
-  char dtype[PTRT_DTYPE_LEN];
-  int32_t ndim;
-  int64_t dims[PTRT_MAX_DIMS];
-  void *data;
-  int64_t nbytes;
-} ptrt_tensor;
-
-typedef struct ptrt_predictor ptrt_predictor;
+#include "ptrt_capi.h"
 
 static void *load_file(const char *path, long *size) {
   FILE *f = fopen(path, "rb");
@@ -101,8 +88,14 @@ int main(int argc, char **argv) {
   snprintf(in.dtype, sizeof(in.dtype), "%s", argv[4]);
   in.ndim = 0;
   char *dims = strdup(argv[5]);
-  for (char *tok = strtok(dims, ","); tok; tok = strtok(NULL, ","))
+  for (char *tok = strtok(dims, ","); tok; tok = strtok(NULL, ",")) {
+    if (in.ndim >= PTRT_MAX_DIMS) {
+      fprintf(stderr, "too many dims (max %d)\n", PTRT_MAX_DIMS);
+      free(dims);
+      return 2;
+    }
     in.dims[in.ndim++] = atoll(tok);
+  }
   free(dims);
   long nbytes = 0;
   in.data = load_file(argv[6], &nbytes);

@@ -50,7 +50,8 @@ def capi_so():
 def c_driver(tmp_path_factory):
     out = str(tmp_path_factory.mktemp("capi") / "capi_test")
     src = os.path.join(_RUNTIME, "capi_test.c")
-    res = subprocess.run(["gcc", "-O2", src, "-o", out, "-ldl"],
+    res = subprocess.run(["gcc", "-O2", "-I", _RUNTIME, src, "-o", out,
+                          "-ldl"],
                          capture_output=True, text=True)
     if res.returncode != 0:
         pytest.skip("gcc unavailable for the C driver: %s" % res.stderr)
