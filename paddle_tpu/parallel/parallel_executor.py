@@ -75,6 +75,9 @@ class BuildStrategy:
         self.pipeline_stages = 0
         self.pipeline_microbatches = 1
         self.pipeline_axis = "pp"
+        # "gpipe" (fill-drain) or "interleaved" (circular: each device
+        # holds every S-th layer group, K x smaller pipeline bubble)
+        self.pipeline_schedule = "gpipe"
 
 
 class _ParCompiled:
@@ -200,7 +203,8 @@ class ParallelExecutor:
             stepfn = build_pipeline_step_fn(
                 program, fetch_names, state_in, state_out, self._mesh,
                 pplan, int(bs.pipeline_microbatches),
-                pp_axis=bs.pipeline_axis, batch_axis=batch_axis)
+                pp_axis=bs.pipeline_axis, batch_axis=batch_axis,
+                schedule=bs.pipeline_schedule)
         else:
             stepfn = build_step_fn(program, fetch_names, state_in, state_out)
 

@@ -390,20 +390,33 @@ def _consumed_feed_names(ops, feed_names):
 def build_pipeline_step_fn(program: Program, fetch_names, state_in,
                            state_out, mesh: Mesh, plan: PipelinePlan,
                            num_microbatches: int, pp_axis: str = "pp",
-                           batch_axis: Optional[str] = None):
+                           batch_axis: Optional[str] = None,
+                           schedule: str = "gpipe"):
     """The pipelined analog of executor.build_step_fn: same
     ``(feeds, state, rng_key, step) -> (fetches, new_state)`` signature,
     so ParallelExecutor's jit/sharding/donation path is unchanged.
 
-    The whole forward — prologue, GPipe tick loop, epilogue — runs inside
-    ONE ``shard_map`` over the (dp?, pp) mesh, so every op sees exactly
-    the Program's declared batch: the Program declares the PER-DEVICE
-    microbatch, and feeds carry ``num_microbatches × dp ×`` that in
-    dim 0. Prologue/epilogue compute replicated across the pp axis (their
-    cost is amortized by the pipelined middle); ``jax.vjp`` through the
-    tick loop yields the reverse pipeline, and the optimizer ops after
-    ``minimize()`` trace sequentially on the vjp's gradients.
+    The whole forward — prologue, pipelined tick loop, epilogue — runs
+    inside ONE ``shard_map`` over the (dp?, pp) mesh, so every op sees
+    exactly the Program's declared batch: the Program declares the
+    PER-DEVICE microbatch, and feeds carry ``num_microbatches × dp ×``
+    that in dim 0. Prologue/epilogue compute replicated across the pp
+    axis (their cost is amortized by the pipelined middle); ``jax.vjp``
+    through the tick loop yields the reverse pipeline, and the optimizer
+    ops after ``minimize()`` trace sequentially on the vjp's gradients.
     Mid-region activations cannot be fetched.
+
+    schedule:
+      "gpipe"       — fill-drain: device s runs its K repeats back to
+                      back each tick; M + S - 1 ticks; bubble fraction
+                      (S-1)/(M+S-1).
+      "interleaved" — circular: repeat r lives on device r mod S, one
+                      repeat per tick, activations ring through all R
+                      repeats (wrap-around buffered on device 0);
+                      K*M + S - 1 ticks; bubble fraction
+                      (S-1)/(K*M+S-1) — K× smaller. Needs M >= S
+                      (the wrapped activation must arrive before its
+                      next round starts).
     """
     from .pipeline import _pvary
 
@@ -415,6 +428,14 @@ def build_pipeline_step_fn(program: Program, fetch_names, state_in,
         raise PipelineError(
             "mesh axis %r has %d devices but pipeline_stages=%d"
             % (pp_axis, mesh.shape[pp_axis], S))
+    if schedule not in ("gpipe", "interleaved"):
+        raise PipelineError(
+            "unknown pipeline schedule %r (gpipe | interleaved)" % schedule)
+    if schedule == "interleaved" and M < S:
+        raise PipelineError(
+            "the interleaved schedule needs num_microbatches >= "
+            "pipeline_stages (%d < %d): a wrapped activation re-enters "
+            "stage 0 only after all microbatches pass it" % (M, S))
     dp_n = mesh.shape[batch_axis] if batch_axis else 1
     carry_shape = _var_shape(block, plan.carry_tpl_in)
     B_decl = carry_shape[0]
@@ -590,46 +611,104 @@ def build_pipeline_step_fn(program: Program, fetch_names, state_in,
                 const_env[n] = jax.tree_util.tree_map(
                     lambda a: a[0], pro_stack[n])
 
-            # -- GPipe fill-drain tick loop ------------------------------
-            def run_stage(x, tick):
-                mb_ix = (tick - stage).astype(jnp.uint32)
-                for j in range(K):
-                    renv = dict(const_env)
-                    for tname in tpl_param_names:
-                        renv[tname] = stage_params["r%d/%s" % (j, tname)]
-                    renv[plan.carry_tpl_in] = x
-                    srng = RngStream(key)
-                    srng.salts = [dp_ix, mb_ix, stage * K + j + 7]
-                    for op, idx in plan.template:
-                        trace_op(op, block, renv,
-                                 srng.for_op(block.idx, idx),
-                                 subblock_err)
-                    x = renv[plan.carry_tpl_out]
-                return x
+            # -- pipelined tick loop -------------------------------------
+            def run_repeat(x, params_j, mb_ix, rep_ix):
+                """Trace ONE template repeat with the given param set."""
+                renv = dict(const_env)
+                renv.update(params_j)
+                renv[plan.carry_tpl_in] = x
+                srng = RngStream(key)
+                srng.salts = [dp_ix, mb_ix, rep_ix]
+                for op, idx in plan.template:
+                    trace_op(op, block, renv,
+                             srng.for_op(block.idx, idx), subblock_err)
+                return renv[plan.carry_tpl_out]
 
             perm = [(i, (i + 1) % S) for i in range(S)]
             mb_shape = acts.shape[1:]
+            vary = (pp_axis,) + ((batch_axis,) if batch_axis else ())
 
-            def tick_fn(carry, t):
+            def gpipe_tick(carry, t):
+                # fill-drain: all K of this device's repeats per tick
                 state_c, outs_c = carry
                 inj = lax.dynamic_index_in_dim(
                     acts, jnp.minimum(t, M - 1), axis=0, keepdims=False)
                 inj = jnp.where(t < M, inj, jnp.zeros_like(inj))
-                inp = jnp.where(stage == 0, inj, state_c)
-                y = run_stage(inp, t)
+                x = jnp.where(stage == 0, inj, state_c)
+                mb_ix = (t - stage).astype(jnp.uint32)
+                for j in range(K):
+                    x = run_repeat(
+                        x,
+                        {tn: stage_params["r%d/%s" % (j, tn)]
+                         for tn in tpl_param_names},
+                        mb_ix, stage * K + j + 7)
                 m = t - (S - 1)
-                emit = jnp.where((stage == S - 1) & (m >= 0), y,
-                                 jnp.zeros_like(y))
+                emit = jnp.where((stage == S - 1) & (m >= 0), x,
+                                 jnp.zeros_like(x))
                 outs_c = lax.dynamic_update_index_in_dim(
                     outs_c, emit, jnp.clip(m, 0, M - 1), axis=0)
-                state_c = lax.ppermute(y, pp_axis, perm)
+                state_c = lax.ppermute(x, pp_axis, perm)
                 return (state_c, outs_c), None
 
-            vary = (pp_axis,) + ((batch_axis,) if batch_axis else ())
+            # interleaved: repeat r lives on device r mod S; this
+            # device's per-round parameter stacks select by round index
+            if schedule == "interleaved":
+                jstack = {
+                    tn: jnp.stack([stage_params["r%d/%s" % (j, tn)]
+                                   for j in range(K)])
+                    for tn in tpl_param_names}
+
+            def interleaved_tick(carry, t):
+                state_c, buf_c, outs_c = carry
+                off = t - stage  # this device's work-stream position
+                offc = jnp.clip(off, 0, K * M - 1)
+                k = offc // M          # round = which of my K repeats
+                m = offc - k * M       # microbatch
+                # device 0 banks the wrap-around activation arriving this
+                # tick (device S-1's output of round k_in, tick t-1) for
+                # round k_in + 1
+                off_in = jnp.clip(t - S, 0, K * M - 1)
+                k_in = off_in // M
+                m_in = off_in - k_in * M
+                wrap_ok = ((stage == 0) & (t - S >= 0)
+                           & (t - S < K * M) & (k_in < K - 1))
+                slot = lax.dynamic_index_in_dim(buf_c, m_in, axis=0,
+                                                keepdims=False)
+                buf_c = lax.dynamic_update_index_in_dim(
+                    buf_c, jnp.where(wrap_ok, state_c, slot), m_in,
+                    axis=0)
+
+                inj = lax.dynamic_index_in_dim(acts, m, axis=0,
+                                               keepdims=False)
+                banked = lax.dynamic_index_in_dim(buf_c, m, axis=0,
+                                                  keepdims=False)
+                x = jnp.where(stage == 0,
+                              jnp.where(k == 0, inj, banked), state_c)
+                params_k = {
+                    tn: lax.dynamic_index_in_dim(jstack[tn], k, axis=0,
+                                                 keepdims=False)
+                    for tn in tpl_param_names}
+                y = run_repeat(x, params_k, m.astype(jnp.uint32),
+                               k * S + stage + 7)
+                valid = (off >= 0) & (off < K * M)
+                emit = jnp.where((stage == S - 1) & (k == K - 1) & valid,
+                                 y, jnp.zeros_like(y))
+                outs_c = lax.dynamic_update_index_in_dim(
+                    outs_c, emit, m, axis=0)
+                state_c = lax.ppermute(y, pp_axis, perm)
+                return (state_c, buf_c, outs_c), None
+
             outs0 = _pvary(jnp.zeros((M,) + mb_shape, acts.dtype), vary)
             state0 = _pvary(jnp.zeros(mb_shape, acts.dtype), vary)
-            (_, outs), _ = lax.scan(tick_fn, (state0, outs0),
-                                    jnp.arange(M + S - 1))
+            if schedule == "interleaved":
+                buf0 = _pvary(jnp.zeros((M,) + mb_shape, acts.dtype),
+                              vary)
+                (_, _, outs), _ = lax.scan(
+                    interleaved_tick, (state0, buf0, outs0),
+                    jnp.arange(K * M + S - 1))
+            else:
+                (_, outs), _ = lax.scan(gpipe_tick, (state0, outs0),
+                                        jnp.arange(M + S - 1))
             # outputs live on the last stage; replicate over pp
             outs = lax.psum(jnp.where(stage == S - 1, outs,
                                       jnp.zeros_like(outs)), pp_axis)
@@ -677,7 +756,10 @@ def build_pipeline_step_fn(program: Program, fetch_names, state_in,
             for s in range(S):
                 tree = {}
                 for j in range(K):
-                    r = s * K + j
+                    # gpipe: device s owns the contiguous block of K
+                    # repeats; interleaved: it owns every S-th repeat
+                    r = (s * K + j if schedule == "gpipe"
+                         else j * S + s)
                     for tname in tpl_param_names:
                         tree["r%d/%s" % (j, tname)] = fenv[canon[r][tname]]
                 stage_trees.append(tree)

@@ -194,6 +194,106 @@ def test_pipeline_carry_fed_directly():
                                float(np.squeeze(lv_ref)), rtol=1e-5)
 
 
+@pytest.mark.parametrize("mesh_shape,axes", [
+    ((4,), ("pp",)),
+    ((2, 4), ("dp", "pp")),
+])
+def test_interleaved_schedule_parity(mesh_shape, axes):
+    """The circular schedule (each device holds every S-th layer group,
+    K x smaller bubble) computes exactly the same step as sequential
+    full-batch execution."""
+    n_layer, M, B_mb, lr = 12, 4, 2, 0.1
+    dp = dict(zip(axes, mesh_shape)).get("dp", 1)
+    B = M * dp * B_mb
+    rs = np.random.RandomState(13)
+    xs = rs.randint(0, VOCAB, (B, T)).astype(np.int64)
+    ys = rs.randint(0, VOCAB, (B, T)).astype(np.int64)
+
+    main, startup, loss = _build_lm(batch=B_mb, n_layer=n_layer, lr=lr)
+    scope = fluid.core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    p0 = {k: np.asarray(scope.find_var(k)) for k in _param_names(main)}
+
+    mesh = make_mesh(list(mesh_shape), axes,
+                     devices=jax.devices()[:int(np.prod(mesh_shape))])
+    bs = BuildStrategy()
+    bs.pipeline_stages = 4
+    bs.pipeline_microbatches = M
+    bs.pipeline_schedule = "interleaved"
+    pe = ParallelExecutor(loss_name=loss.name, main_program=main,
+                          build_strategy=bs, scope=scope, mesh=mesh)
+    lv_pp, = pe.run(feed={"ids": xs, "lbl": ys}, fetch_list=[loss])
+    p_pp = {k: np.asarray(scope.find_var(k)) for k in p0}
+
+    lv_ref, p_ref = _run_sequential_reference(n_layer, xs, ys, p0, lr)
+    np.testing.assert_allclose(float(np.squeeze(lv_pp)), lv_ref,
+                               rtol=2e-4)
+    for k in sorted(p0):
+        np.testing.assert_allclose(
+            p_pp[k], p_ref[k], rtol=2e-3, atol=2e-5,
+            err_msg="param %s diverged (interleaved vs sequential)" % k)
+
+
+def test_interleaved_needs_enough_microbatches():
+    from paddle_tpu.parallel.pipeline_program import (
+        build_pipeline_step_fn)
+
+    main, _, _ = _build_lm(batch=2, n_layer=8)
+    plan = plan_pipeline(main, num_stages=4)
+    mesh = make_mesh([4], ("pp",), devices=jax.devices()[:4])
+    with pytest.raises(PipelineError, match="num_microbatches >="):
+        build_pipeline_step_fn(main, (), [], [], mesh, plan,
+                               num_microbatches=2, schedule="interleaved")
+    with pytest.raises(PipelineError, match="unknown pipeline schedule"):
+        build_pipeline_step_fn(main, (), [], [], mesh, plan,
+                               num_microbatches=4, schedule="1f1b")
+
+
+def test_pipeline_amp_and_dropout_run():
+    """Mixed precision and dropout both work through the pipelined step:
+    bf16 carries hop stages, per-(microbatch, repeat) RNG keys draw
+    inside the tick loop. (Numeric parity with sequential execution is
+    not defined under dropout — different draw order — so this checks
+    training behavior: finite loss, params move.)"""
+    main, startup = Program(), Program()
+    main.random_seed = startup.random_seed = 9
+    with fluid.unique_name.guard(), program_guard(main, startup):
+        ids = fluid.layers.data(name="ids", shape=[2, T], dtype="int64",
+                                append_batch_size=False)
+        lbl = fluid.layers.data(name="lbl", shape=[2, T], dtype="int64",
+                                append_batch_size=False)
+        loss, _ = transformer_lm(
+            ids, lbl, VOCAB, n_layer=4, n_head=N_HEAD, d_model=D_MODEL,
+            d_inner=D_INNER, dropout_rate=0.1, max_len=T, fused_head=False)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    main.enable_mixed_precision()
+
+    scope = fluid.core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    p0 = {p.name: np.asarray(scope.find_var(p.name))
+          for p in main.all_parameters()}
+    mesh = make_mesh([4], ("pp",), devices=jax.devices()[:4])
+    bs = BuildStrategy()
+    bs.pipeline_stages = 4
+    bs.pipeline_microbatches = 2
+    pe = ParallelExecutor(loss_name=loss.name, main_program=main,
+                          build_strategy=bs, scope=scope, mesh=mesh)
+    rs = np.random.RandomState(21)
+    xs = rs.randint(0, VOCAB, (4, T)).astype(np.int64)
+    ys = rs.randint(0, VOCAB, (4, T)).astype(np.int64)
+    l0, = pe.run(feed={"ids": xs, "lbl": ys}, fetch_list=[loss])
+    l1, = pe.run(feed={"ids": xs, "lbl": ys}, fetch_list=[loss])
+    assert np.isfinite(float(np.squeeze(l0)))
+    assert np.isfinite(float(np.squeeze(l1)))
+    moved = sum(float(np.abs(np.asarray(scope.find_var(k)) - p0[k]).sum())
+                for k in p0)
+    assert moved > 0.0
+
+
 def test_pipeline_transpiler_api():
     from paddle_tpu.transpiler import PipelineTranspiler
 
