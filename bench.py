@@ -89,6 +89,10 @@ def bench_lm(dev):
             optimizer.Adam(learning_rate=1e-4).minimize(loss)
         if AMP:
             main_p.enable_mixed_precision()  # bf16 matmuls, fp32 master weights
+        if _os.environ.get("BENCH_REMAT", "0") == "1":
+            # rematerialize the backward: frees activation HBM so larger
+            # per-chip batches fit (sweep lever for batch 24/32)
+            fluid.memory_optimize(main_p)
 
         exe = fluid.Executor(fluid.TPUPlace() if dev.platform != "cpu"
                              else fluid.CPUPlace())

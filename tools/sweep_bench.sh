@@ -33,6 +33,11 @@ done
 run BENCH_BATCH=8 PADDLE_TPU_FUSED_QKV=1
 run BENCH_BATCH=16 PADDLE_TPU_FUSED_QKV=1
 
+# bigger per-chip batches with rematerialized backward (activation HBM
+# freed; MXU utilization usually rises until HBM bandwidth saturates)
+run BENCH_BATCH=24 BENCH_REMAT=1
+run BENCH_BATCH=32 BENCH_REMAT=1
+
 if [ "${RN:-0}" = "1" ]; then
   for rb in 64 128 256; do
     echo "=== resnet batch $rb ==="
