@@ -4,6 +4,10 @@ Run:
   python examples/train_lm.py                       # single device
   python examples/train_lm.py --mesh dp=2,mp=4      # 8-chip tensor parallel
   python examples/train_lm.py --mesh dp=1,sp=8 --ring --seq 8192  # long ctx
+  python examples/train_lm.py --mesh dp=2,pp=4 --pp-microbatches 4 \
+      --pp-schedule interleaved   # pipeline parallel from the same Program
+      # (--batch then declares the PER-DEVICE microbatch; the global batch
+      #  is batch * dp * microbatches)
 
 On CPU smoke-test with:
   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -31,6 +35,10 @@ def main():
                     help="axis=size pairs, e.g. dp=2,mp=4")
     ap.add_argument("--ring", action="store_true",
                     help="sequence-parallel ring attention")
+    ap.add_argument("--pp-microbatches", type=int, default=4,
+                    help="microbatches per step when the mesh has pp")
+    ap.add_argument("--pp-schedule", choices=["gpipe", "interleaved"],
+                    default="gpipe")
     ap.add_argument("--amp", action=argparse.BooleanOptionalAction,
                     default=True, help="bf16 mixed precision (--no-amp off)")
     args = ap.parse_args()
@@ -51,10 +59,17 @@ def main():
         if args.amp:
             main_p.enable_mixed_precision()
 
+    # pp mode: the Program declares the per-device microbatch and feeds
+    # carry microbatches x dp x that in dim 0 (rows = global batch)
+    mesh_axes = (dict(kv.split("=") for kv in args.mesh.split(","))
+                 if args.mesh else {})
+    scale = (args.pp_microbatches * int(mesh_axes.get("dp", 1))
+             if "pp" in mesh_axes else 1)
+    rows = scale * args.batch
     r = np.random.RandomState(0)
     feed = {
-        "ids": r.randint(0, args.vocab, (args.batch, args.seq), np.int64),
-        "labels": r.randint(0, args.vocab, (args.batch, args.seq), np.int64),
+        "ids": r.randint(0, args.vocab, (rows, args.seq), np.int64),
+        "labels": r.randint(0, args.vocab, (rows, args.seq), np.int64),
     }
 
     fluid.Executor().run(startup)  # init params in the global scope
@@ -63,12 +78,26 @@ def main():
                                          megatron_transformer_plan,
                                          seq_parallel_plan)
 
-        axes = dict(kv.split("=") for kv in args.mesh.split(","))
+        axes = mesh_axes
         mesh = make_mesh([int(v) for v in axes.values()], tuple(axes))
-        plan = seq_parallel_plan(mesh) if args.ring \
-            else megatron_transformer_plan(mesh)
+        kw = {}
+        if "pp" in axes:
+            if args.ring or "mp" in axes or "sp" in axes:
+                raise SystemExit(
+                    "pipeline parallelism composes with dp today; "
+                    "drop mp/sp/--ring from --mesh when using pp")
+            from paddle_tpu.parallel import BuildStrategy
+
+            bs = BuildStrategy()
+            bs.pipeline_stages = int(axes["pp"])
+            bs.pipeline_microbatches = args.pp_microbatches
+            bs.pipeline_schedule = args.pp_schedule
+            kw["build_strategy"] = bs
+        else:
+            kw["plan"] = (seq_parallel_plan(mesh) if args.ring
+                          else megatron_transformer_plan(mesh))
         pexe = ParallelExecutor(loss_name=loss.name, main_program=main_p,
-                                mesh=mesh, plan=plan)
+                                mesh=mesh, **kw)
         run = lambda fetch: pexe.run(feed=feed, fetch_list=fetch)
     else:
         sexe = fluid.Executor(fluid.TPUPlace())
@@ -83,7 +112,7 @@ def main():
         run([])
     out = run([loss])
     dt = (time.perf_counter() - t0) / args.steps
-    toks = args.batch * args.seq / dt
+    toks = rows * args.seq / dt
     print("loss %.4f  |  %.0f tokens/s  |  %.1f ms/step"
           % (float(np.asarray(out[0]).reshape(-1)[0]), toks, dt * 1e3))
 

@@ -303,3 +303,24 @@ def test_pipeline_transpiler_api():
     assert plan.repeats == 4
     bs = t.build_strategy()
     assert bs.pipeline_stages == 2 and bs.pipeline_microbatches == 4
+
+
+def test_plan_rejects_batch_dependent_side_inputs():
+    """Encoder layers read the per-batch lengths feed -> the planner must
+    name the offending variable and suggest the restructure."""
+    from paddle_tpu.models.transformer import transformer_encoder
+
+    main, startup = Program(), Program()
+    with fluid.unique_name.guard(), program_guard(main, startup):
+        src = fluid.layers.data(name="src", shape=[2, T], dtype="int64",
+                                append_batch_size=False)
+        lens = fluid.layers.data(name="lens", shape=[2], dtype="int32",
+                                 append_batch_size=False)
+        enc = transformer_encoder(src, lens, VOCAB, n_layer=4,
+                                  n_head=N_HEAD, d_model=D_MODEL,
+                                  d_inner=D_INNER, dropout_rate=0.0,
+                                  max_len=T)
+        loss = fluid.layers.mean(enc)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    with pytest.raises(PipelineError, match="batch-dependent side input"):
+        plan_pipeline(main, num_stages=2)
