@@ -78,18 +78,18 @@ def main():
                                          megatron_transformer_plan,
                                          seq_parallel_plan)
 
-        axes = mesh_axes
-        mesh = make_mesh([int(v) for v in axes.values()], tuple(axes))
+        mesh = make_mesh([int(v) for v in mesh_axes.values()],
+                         tuple(mesh_axes))
         kw = {}
-        if "pp" in axes:
-            if args.ring or "mp" in axes or "sp" in axes:
+        if "pp" in mesh_axes:
+            if args.ring or "mp" in mesh_axes or "sp" in mesh_axes:
                 raise SystemExit(
                     "pipeline parallelism composes with dp today; "
                     "drop mp/sp/--ring from --mesh when using pp")
             from paddle_tpu.parallel import BuildStrategy
 
             bs = BuildStrategy()
-            bs.pipeline_stages = int(axes["pp"])
+            bs.pipeline_stages = int(mesh_axes["pp"])
             bs.pipeline_microbatches = args.pp_microbatches
             bs.pipeline_schedule = args.pp_schedule
             kw["build_strategy"] = bs
