@@ -9,10 +9,11 @@ import pytest
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run(args, timeout=420):
+def _run(args, timeout=420, env_extra=None):
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)  # no device tunnel in tests
     env["JAX_PLATFORMS"] = "cpu"
+    env.update(env_extra or {})
     env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
     env["PADDLE_TPU_SYNTH_MNIST_TRAIN"] = "256"
     env["PADDLE_TPU_SYNTH_MNIST_TEST"] = "128"
@@ -37,4 +38,16 @@ def test_train_lm_example_single_device():
     out = _run(["examples/train_lm.py", "--layers", "1", "--d-model", "64",
                 "--seq", "128", "--vocab", "256", "--batch", "2",
                 "--steps", "3", "--no-amp"])
+    assert "tokens/s" in out
+
+
+def test_train_lm_example_pipeline():
+    flags = (os.environ.get("XLA_FLAGS", "")
+             + " --xla_force_host_platform_device_count=8").strip()
+    out = _run(["examples/train_lm.py", "--mesh", "dp=2,pp=4",
+                "--pp-microbatches", "4", "--pp-schedule", "interleaved",
+                "--layers", "4", "--d-model", "64", "--seq", "32",
+                "--vocab", "256", "--batch", "2", "--steps", "2",
+                "--no-amp"],
+               env_extra={"XLA_FLAGS": flags})
     assert "tokens/s" in out
