@@ -82,10 +82,10 @@ def main():
                          tuple(mesh_axes))
         kw = {}
         if "pp" in mesh_axes:
-            if args.ring or "mp" in mesh_axes or "sp" in mesh_axes:
+            if args.ring or "sp" in mesh_axes:
                 raise SystemExit(
-                    "pipeline parallelism composes with dp today; "
-                    "drop mp/sp/--ring from --mesh when using pp")
+                    "pipeline parallelism composes with dp and mp today; "
+                    "drop sp/--ring from --mesh when using pp")
             from paddle_tpu.parallel import BuildStrategy
 
             bs = BuildStrategy()
@@ -93,6 +93,12 @@ def main():
             bs.pipeline_microbatches = args.pp_microbatches
             bs.pipeline_schedule = args.pp_schedule
             kw["build_strategy"] = bs
+            if "mp" in mesh_axes:
+                # tensor parallelism rides the auto mp axis inside the
+                # pipeline's manual (dp, pp) region
+                kw["plan"] = megatron_transformer_plan(
+                    mesh, mp_axis="mp",
+                    batch_axes=("dp",) if "dp" in mesh_axes else ())
         else:
             kw["plan"] = (seq_parallel_plan(mesh) if args.ring
                           else megatron_transformer_plan(mesh))

@@ -8,4 +8,23 @@ try:  # jax>=0.6 top level; older: experimental
 except AttributeError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map  # noqa: F401
 
-__all__ = ["shard_map"]
+__all__ = ["shard_map", "shard_map_partial"]
+
+
+def shard_map_partial(f, mesh, in_specs, out_specs, manual_axes):
+    """shard_map manual over `manual_axes` only; any other mesh axes stay
+    automatic (GSPMD partitions over them inside the manual region —
+    e.g. the pipeline tick loop is manual over (dp, pp) while tensor
+    parallelism rides an auto mp axis). Newer jax spells this
+    ``axis_names=...``; older jax ``auto=<complement>``."""
+    manual = frozenset(manual_axes)
+    try:
+        return shard_map(f, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, axis_names=set(manual))
+    except TypeError:  # pragma: no cover — older jax
+        auto = frozenset(mesh.axis_names) - manual
+        if not auto:
+            return shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs)
+        return shard_map(f, mesh=mesh, in_specs=in_specs,
+                        out_specs=out_specs, auto=auto)

@@ -482,7 +482,12 @@ def build_pipeline_step_fn(program: Program, fetch_names, state_in,
 
     from jax.sharding import PartitionSpec as P
 
-    from ._compat import shard_map
+    from ._compat import shard_map_partial
+
+    # the tick loop is manual over (dp?, pp); any OTHER mesh axis (e.g.
+    # a Megatron mp axis) stays automatic — GSPMD partitions the template
+    # ops over it inside the manual region, so pp composes with tp
+    manual_axes = {pp_axis} | ({batch_axis} if batch_axis else set())
 
     # vars the outside world needs from prologue/epilogue: fetches and
     # post-op inputs
@@ -770,12 +775,13 @@ def build_pipeline_step_fn(program: Program, fetch_names, state_in,
 
             stacked_spec = jax.tree_util.tree_map(
                 lambda _: P(pp_axis), stacked)
-            loss, pro_stack, epi_stack = shard_map(
+            loss, pro_stack, epi_stack = shard_map_partial(
                 device_forward, mesh=mesh,
                 in_specs=(stacked_spec,
                           jax.tree_util.tree_map(lambda _: P(), repl_env),
                           feed_specs, P()),
                 out_specs=(P(), pro_specs, epi_specs),
+                manual_axes=manual_axes,
             )(stacked, repl_env, feeds_used, key)
             return loss, (pro_stack, epi_stack, loss)
 
