@@ -130,11 +130,14 @@ def _fingerprint(op, block):
 
 
 def _find_periodic_region(fps) -> Optional[Tuple[int, int, int]]:
-    """Longest (start, period, repeats) with fps[start:start+R*p] periodic
-    of period p, maximizing covered ops (ties: smaller period)."""
+    """Longest (start, period, match_run) with fps[i] == fps[i+p] for all
+    i in [start, start+match_run), maximizing covered ops (ties: smaller
+    period). ``match_run // p + 1`` repeats fit at ``start``; shifted
+    starts inside the run trade repeats for alignment (see
+    plan_pipeline)."""
     n = len(fps)
     hashes = [hash(f) for f in fps]
-    best = None  # (covered, -period, start, period, repeats)
+    best = None  # (covered, -period, start, period, run)
     for p in range(1, n // 2 + 1):
         i = 0
         while i < n - p:
@@ -148,14 +151,14 @@ def _find_periodic_region(fps) -> Optional[Tuple[int, int, int]]:
             run = i - a                  # matches in [a, a+run)
             reps = run // p + 1
             if reps >= 2:
-                cand = (reps * p, -p, a, p, reps)
+                cand = (reps * p, -p, a, p, run)
                 if best is None or cand > best:
                     best = cand
             i += 1
     if best is None:
         return None
-    _, _, start, period, reps = best
-    return start, period, reps
+    _, _, start, period, run = best
+    return start, period, run
 
 
 def _external_uses(ops, block):
@@ -218,10 +221,43 @@ def plan_pipeline(program: Program, num_stages: int,
             "no repeated layer structure found: pipeline parallelism "
             "needs a model built as `for i in range(L): layer(x)` with "
             "structurally identical layers")
-    start, period, reps = region
-    if period * reps < min_region_ops:
-        raise PipelineError("periodic region too small to pipeline")
+    start0, period, run = region
 
+    # The matching run fixes the period but NOT the alignment: a prologue
+    # op can fingerprint like an in-layer op (e.g. the embed's tok+pos
+    # add vs a residual add at batch 1), extending the run one-or-more
+    # ops early and putting the repeat boundary mid-layer. Try every
+    # intra-period shift (largest repeat count first) until the boundary
+    # analysis validates. When every shift fails, surface the error from
+    # the candidate that validated FURTHEST — the correctly-aligned cut
+    # fails late with an actionable message (e.g. batch-dependent side
+    # input), while misaligned cuts fail early and generically.
+    best_err, best_prog = None, -1
+    for shift in range(period):
+        start = start0 + shift
+        reps = (run - shift) // period + 1
+        if reps < 2:
+            break
+        if period * reps < min_region_ops:
+            break
+        progress = [0]
+        try:
+            return _analyze_region(block, fwd, start, period, reps,
+                                   num_stages, first_ad, progress)
+        except PipelineError as e:
+            if progress[0] > best_prog:
+                best_err, best_prog = e, progress[0]
+    if best_err is None:
+        raise PipelineError("periodic region too small to pipeline")
+    raise best_err
+
+
+def _analyze_region(block, fwd, start, period, reps, num_stages, first_ad,
+                    progress):
+    """Validate one candidate (start, period, reps) alignment and build
+    the plan; raises PipelineError when the cut is not stage-homogeneous.
+    ``progress[0]`` counts the validation phases passed, so the caller
+    can pick the most-aligned candidate's diagnostic."""
     # stages must divide the repeats; surplus leading repeats fold into
     # the prologue (they run sequentially there — correct, just unsplit)
     extra = reps % num_stages
@@ -232,6 +268,7 @@ def plan_pipeline(program: Program, num_stages: int,
             "found %d repeated layers but %d pipeline stages were "
             "requested; reduce pipeline_stages" % (reps + extra, num_stages))
 
+    progress[0] = 1
     repeat_ops = [fwd[start + r * period: start + (r + 1) * period]
                   for r in range(reps)]
     prologue = fwd[:start]
@@ -253,6 +290,7 @@ def plan_pipeline(program: Program, num_stages: int,
                 "structural positions than repeat 0 — layers are not "
                 "homogeneous" % r)
 
+    progress[0] = 2
     carry_pos, param_pos, const_pos = [], [], []
     for pk in positions:
         names = [ext_maps[r][pk] for r in range(reps)]
@@ -269,6 +307,7 @@ def plan_pipeline(program: Program, num_stages: int,
                 "the layer carry, nor a shared constant (names per "
                 "repeat: %s) — cannot pipeline" % (pk, sorted(set(names))))
 
+    progress[0] = 3
     if not carry_pos:
         raise PipelineError(
             "repeats do not feed one another (no carry variable found)")
@@ -282,6 +321,7 @@ def plan_pipeline(program: Program, num_stages: int,
                 "stage boundaries" % (r, len(names), sorted(names)))
         carry_in_names.append(names.pop())
 
+    progress[0] = 4
     # the carry's producing position (consistent across repeats) gives the
     # template's carry-out name
     out_pos_maps = [_produced_positions(ops) for ops in repeat_ops]
@@ -307,6 +347,7 @@ def plan_pipeline(program: Program, num_stages: int,
             "carried activation has inconsistent/unknown declared shapes "
             "%s across repeats" % sorted(shapes, key=repr))
 
+    progress[0] = 5
     # per-repeat parameter mapping, keyed by the template's names
     param_map = []
     for r in range(reps):
@@ -322,6 +363,7 @@ def plan_pipeline(program: Program, num_stages: int,
             m[tpl_name] = actual
         param_map.append(m)
 
+    progress[0] = 6
     # stage-invariant side inputs must not depend on feeds: they are
     # replicated to every stage, but each tick processes a DIFFERENT
     # microbatch, so batch-dependent values cannot be broadcast

@@ -364,3 +364,15 @@ def test_pipeline_composes_with_tensor_parallel():
         np.testing.assert_allclose(
             p_pp[k], p_ref[k], rtol=2e-3, atol=2e-5,
             err_msg="param %s diverged (pp x mp vs sequential)" % k)
+
+
+def test_plan_alignment_survives_ambiguous_prologue():
+    """At microbatch 1 the embed's tok+pos add fingerprints identically
+    to the layers' residual adds, so the periodic-run start lands one op
+    early; the planner must retry intra-period shifts until the carry
+    validates (stress-found regression)."""
+    main, _, _ = _build_lm(batch=1, n_layer=6)
+    plan = plan_pipeline(main, num_stages=3)
+    assert plan.repeats == 6 and plan.repeats_per_stage == 2
+    from paddle_tpu.parallel.pipeline_program import _var_shape
+    assert _var_shape(plan.block, plan.carry_tpl_in) == (1, T, D_MODEL)
