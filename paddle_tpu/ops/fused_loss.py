@@ -31,6 +31,19 @@ from .registry import register_op
 _NEG = -1e30
 
 
+def _unroll_chunks(nblk: int) -> bool:
+    """Sweep lever (tools/sweep_bench.sh): PADDLE_TPU_LMHEAD_UNROLL=N
+    unrolls the vocab-chunk loop when nblk <= N. Off by default — the
+    rolled loop compiles faster and the win is hardware-dependent."""
+    import os
+
+    try:
+        limit = int(os.environ.get("PADDLE_TPU_LMHEAD_UNROLL", "0"))
+    except ValueError:
+        limit = 0
+    return 0 < nblk <= limit
+
+
 def _vary_like(val, *refs):
     """Inside shard_map, loop carries initialized from literals are
     unvaried over the manual mesh axes while the loop body mixes in
@@ -114,12 +127,19 @@ def _lm_head_fwd(block_v, x, w, b, labels):
         picked = picked + jnp.sum(jnp.where(hit, logits, 0.0), axis=-1)
         return m_new, s, picked
 
-    m, s, picked = lax.fori_loop(
-        0, nblk, body,
-        tuple(_vary_like(c, x, labels, wp, bp) for c in
-              (jnp.full((n,), _NEG, jnp.float32),
-               jnp.zeros((n,), jnp.float32),
-               jnp.zeros((n,), jnp.float32))))
+    init = tuple(_vary_like(c, x, labels, wp, bp) for c in
+                 (jnp.full((n,), _NEG, jnp.float32),
+                  jnp.zeros((n,), jnp.float32),
+                  jnp.zeros((n,), jnp.float32)))
+    if _unroll_chunks(nblk):
+        # unrolled: XLA overlaps chunk matmuls with the next chunk's
+        # weight DMA instead of serializing through a while-loop barrier
+        carry = init
+        for j in range(nblk):
+            carry = body(j, carry)
+        m, s, picked = carry
+    else:
+        m, s, picked = lax.fori_loop(0, nblk, body, init)
     lse = m + jnp.log(s)
     loss = (lse - picked)[:, None]
     return loss, (x, w, b, labels, lse)
@@ -152,12 +172,17 @@ def _lm_head_bwd(block_v, res, g):
         db = lax.dynamic_update_slice_in_dim(db, dbb, j * block_v, 0)
         return dx, dw, db
 
-    dx, dw, db = lax.fori_loop(
-        0, nblk, body,
-        tuple(_vary_like(c, x, labels, g, wp, bp) for c in
-              (jnp.zeros((n, d), jnp.float32),
-               jnp.zeros((d, pv), jnp.float32),
-               jnp.zeros((pv,), jnp.float32))))
+    init = tuple(_vary_like(c, x, labels, g, wp, bp) for c in
+                 (jnp.zeros((n, d), jnp.float32),
+                  jnp.zeros((d, pv), jnp.float32),
+                  jnp.zeros((pv,), jnp.float32)))
+    if _unroll_chunks(nblk):
+        carry = init
+        for j in range(nblk):
+            carry = body(j, carry)
+        dx, dw, db = carry
+    else:
+        dx, dw, db = lax.fori_loop(0, nblk, body, init)
     return (_grad_vma_like(dx.astype(x.dtype), x),
             _grad_vma_like(dw[:, :v].astype(w.dtype), w),
             _grad_vma_like(db[:v].astype(b.dtype), b), None)

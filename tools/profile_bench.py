@@ -1,10 +1,13 @@
 import os, sys; sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import os, glob
 import numpy as np, jax
+from bench import _enable_compile_cache  # same cache dir/flags as bench.py
+_enable_compile_cache()
 import paddle_tpu as fluid
 from paddle_tpu import layers, models, optimizer
 
-B,S,V,L,D,F,H = 8,1024,32768,12,1024,4096,16
+B,S,V,L,D,F,H = (int(os.environ.get("BENCH_BATCH", 8)),1024,32768,12,1024,4096,
+                 int(os.environ.get("BENCH_HEADS", 16)))
 main_p, startup = fluid.Program(), fluid.Program()
 main_p.random_seed = startup.random_seed = 1
 scope = fluid.Scope()

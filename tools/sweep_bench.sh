@@ -1,48 +1,63 @@
 #!/bin/bash
-# On-TPU perf sweep: run after the device is reachable. Each line prints
-# the bench JSON for one configuration; compare mfu/step_ms across rows.
+# On-TPU perf sweep, PRIORITY ORDER: the most informative configs run
+# first so a short tunnel window still yields the key numbers. Each row
+# prints the bench JSON line and appends it to $OUT (default
+# /tmp/sweep_results.txt) tagged with its config.
 #
-#   bash tools/sweep_bench.sh            # LM sweep (batch x flash blocks)
-#   RN=1 bash tools/sweep_bench.sh      # include ResNet batch sweep
+#   bash tools/sweep_bench.sh            # LM sweep
+#   RN=1 bash tools/sweep_bench.sh      # append ResNet batch sweep
+#
+# The persistent XLA compile cache (bench.py, .xla_cache/) makes repeat
+# configs fast: only genuinely new HLO recompiles through the tunnel.
 set -u
 cd "$(dirname "$0")/.."
+OUT="${OUT:-/tmp/sweep_results.txt}"
 
 run() {
   echo "=== $* ==="
-  env "$@" BENCH_RESNET=0 BENCH_PROBE_TIMEOUT=120 timeout 900 python bench.py 2>/dev/null | tail -1
+  line=$(env "$@" BENCH_RESNET=0 BENCH_PROBE_TIMEOUT=150 timeout 1200 \
+         python bench.py 2>/dev/null | tail -1)
+  echo "$line"
+  echo "{\"cfg\": \"$*\", \"result\": $(json_or_null "$line")}" >> "$OUT"
 }
 
-# batch sweep at default blocks
-run BENCH_BATCH=8
+# keep $OUT valid JSON-lines even when a row dies mid-print
+json_or_null() {
+  python -c 'import json,sys
+try: print(json.dumps(json.loads(sys.argv[1])))
+except Exception: print("null")' "${1:-null}"
+}
+
+# 1. confirm the default config + prime the compile cache
 run BENCH_BATCH=16
-run BENCH_BATCH=24
-
-# flash-attention block sweep at the best-looking batch (edit as needed)
-for bq in 256 512 1024; do
-  for bk in 256 512 1024; do
-    run BENCH_BATCH=16 PADDLE_TPU_FLASH_BQ=$bq PADDLE_TPU_FLASH_BK=$bk
-  done
-done
-
-# fused LM-head vocab chunk sweep
-for bv in 2048 4096 8192; do
-  run BENCH_BATCH=16 PADDLE_TPU_LMHEAD_BLOCK=$bv
-done
-
-# fused QKV projection (one (D,3D) matmul instead of three)
-run BENCH_BATCH=8 PADDLE_TPU_FUSED_QKV=1
+# 2. same config with a profiler trace (cached compile; /tmp/jaxprof)
+run BENCH_BATCH=16 BENCH_PROFILE=1
+# 3. the r2 reference point
+run BENCH_BATCH=8
+# 4. fused QKV projection (one (D,3D) matmul instead of three)
 run BENCH_BATCH=16 PADDLE_TPU_FUSED_QKV=1
-
-# bigger per-chip batches with rematerialized backward (activation HBM
-# freed; MXU utilization usually rises until HBM bandwidth saturates)
+# 5. flash-attention block shapes
+run BENCH_BATCH=16 PADDLE_TPU_FLASH_BQ=1024 PADDLE_TPU_FLASH_BK=1024
+run BENCH_BATCH=16 PADDLE_TPU_FLASH_BQ=256 PADDLE_TPU_FLASH_BK=512
+run BENCH_BATCH=16 PADDLE_TPU_FLASH_BQ=512 PADDLE_TPU_FLASH_BK=256
+# 6. fused LM-head vocab chunk
+run BENCH_BATCH=16 PADDLE_TPU_LMHEAD_BLOCK=4096
+run BENCH_BATCH=16 PADDLE_TPU_LMHEAD_BLOCK=8192
+# 6b. unrolled LM-head chunk loop / wider heads (d_head 128 on the MXU)
+run BENCH_BATCH=16 PADDLE_TPU_LMHEAD_UNROLL=16
+run BENCH_BATCH=16 BENCH_HEADS=8
+# 7. bigger per-chip batches (straight, then rematerialized backward)
+run BENCH_BATCH=24
 run BENCH_BATCH=24 BENCH_REMAT=1
 run BENCH_BATCH=32 BENCH_REMAT=1
 
 if [ "${RN:-0}" = "1" ]; then
-  for rb in 64 128 256; do
+  for rb in 128 256 64; do
     echo "=== resnet batch $rb ==="
-    env BENCH_RN_BATCH=$rb BENCH_PROBE_TIMEOUT=120 BENCH_STEPS=3 \
-        BENCH_WARMUP=1 BENCH_LAYERS=1 timeout 900 python bench.py \
-        2>/dev/null | tail -1
+    line=$(env BENCH_RN_BATCH=$rb BENCH_PROBE_TIMEOUT=150 BENCH_STEPS=3 \
+        BENCH_WARMUP=1 BENCH_LAYERS=1 timeout 1200 python bench.py \
+        2>/dev/null | tail -1)
+    echo "$line"
+    echo "{\"cfg\": \"resnet rb=$rb\", \"result\": $(json_or_null "$line")}" >> "$OUT"
   done
 fi
