@@ -210,7 +210,7 @@ def detection_output(loc, scores, prior_box, prior_box_var,
         attrs={"background_label": background_label,
                "nms_threshold": nms_threshold, "nms_top_k": nms_top_k,
                "keep_top_k": keep_top_k, "score_threshold": score_threshold,
-               "decode": True})
+               "nms_eta": float(nms_eta), "decode": True})
     return out, out_count
 
 
@@ -482,10 +482,6 @@ def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
     rpn_roi_probs (N, post_nms_top_n, 1)), zero-padded per image (the
     reference emits LoD rows instead)."""
     helper = LayerHelper("generate_proposals", name=name)
-    if eta != 1.0:
-        raise NotImplementedError(
-            "generate_proposals: adaptive NMS (eta != 1.0) is not "
-            "implemented; greedy NMS at the fixed nms_thresh only")
     n = scores.shape[0]
     rois = helper.create_variable_for_type_inference(
         bbox_deltas.dtype, shape=(n, post_nms_top_n, 4))

@@ -212,23 +212,35 @@ def _mine_hard_examples(ctx):
             "NumNeg": num_neg.astype(jnp.int32)}
 
 
-def _nms_keep(boxes, scores, iou_threshold, box_normalized=True):
+def _nms_keep(boxes, scores, iou_threshold, box_normalized=True, eta=1.0):
     """boxes (K,4) sorted by score desc, scores (K,) (-inf = invalid) ->
-    keep mask (K,) via sequential greedy suppression."""
+    keep mask (K,) via sequential greedy suppression.
+
+    eta < 1 is the reference's adaptive NMS (NMSFast in
+    multiclass_nms_op.cc / generate_proposals_op.cc): after each KEPT box,
+    while the working threshold is still above 0.5 it is multiplied by
+    eta, so late (lower-scored) boxes are suppressed more aggressively."""
+    boxes = jnp.asarray(boxes)
+    scores = jnp.asarray(scores)
     k = boxes.shape[0]
     iou = iou_matrix(boxes, boxes, box_normalized)
     valid = scores > -jnp.inf / 2
+    adaptive = eta < 1.0  # static: eta == 1 skips the threshold update
 
     def step(i, state):
-        keep, suppressed = state
-        can = valid[i] & ~suppressed[i]
+        keep, th = state
+        # candidate i is examined against every box kept SO FAR under the
+        # threshold in effect NOW (reference NMSFast: the adaptive decay
+        # from earlier keeps applies to later candidates' checks)
+        over = jnp.max(jnp.where(keep, iou[i], 0.0))
+        can = valid[i] & (over <= th)
         keep = keep.at[i].set(can)
-        sup_new = can & (iou[i] > iou_threshold) & (
-            jnp.arange(k) > i)
-        return keep, suppressed | sup_new
+        if adaptive:
+            th = jnp.where(can & (th > 0.5), th * eta, th)
+        return keep, th
 
     keep, _ = lax.fori_loop(
-        0, k, step, (jnp.zeros((k,), bool), jnp.zeros((k,), bool)))
+        0, k, step, (jnp.zeros((k,), bool), jnp.float32(iou_threshold)))
     return keep
 
 
@@ -247,6 +259,7 @@ def _multiclass_nms(ctx):
     nms_top_k = int(ctx.attr("nms_top_k", 400))
     keep_top_k = int(ctx.attr("keep_top_k", 200))
     score_threshold = float(ctx.attr("score_threshold", 0.01))
+    nms_eta = float(ctx.attr("nms_eta", 1.0))
     decode = bool(ctx.attr("decode", True))
 
     b, m, c = scores.shape
@@ -260,7 +273,7 @@ def _multiclass_nms(ctx):
             s = jnp.where(cls_scores >= score_threshold, cls_scores, -jnp.inf)
             top_s, top_i = lax.top_k(s, nms_k)
             top_boxes = boxes_i[top_i]
-            keep = _nms_keep(top_boxes, top_s, nms_threshold)
+            keep = _nms_keep(top_boxes, top_s, nms_threshold, eta=nms_eta)
             return jnp.where(keep, top_s, -jnp.inf), top_boxes
 
         cls_ids = jnp.arange(c)
@@ -576,6 +589,7 @@ def _generate_proposals(ctx):
     pre_n = int(ctx.attr("pre_nms_topN", 6000))
     post_n = int(ctx.attr("post_nms_topN", 1000))
     nms_th = float(ctx.attr("nms_thresh", 0.5))
+    nms_eta = float(ctx.attr("eta", 1.0))
     min_size = float(ctx.attr("min_size", 0.1))
 
     n, a, h, w = scores.shape
@@ -617,7 +631,8 @@ def _generate_proposals(ctx):
         s = jnp.where(keep_sz, s, -jnp.inf)
         top_s, top_i = lax.top_k(s, pre_n)
         top_boxes = boxes[top_i]
-        keep = _nms_keep(top_boxes, top_s, nms_th, box_normalized=False)
+        keep = _nms_keep(top_boxes, top_s, nms_th, box_normalized=False,
+                         eta=nms_eta)
         # stable-compact the kept boxes to the front, pad with zeros
         order = jnp.argsort(~keep, stable=True)[:post_n]
         kept = keep[order]

@@ -85,6 +85,78 @@ def test_detection_output_nms():
     assert (o[0, 1] == -1).all()
 
 
+def _np_adaptive_nms_keep(boxes, scores, thresh, eta, box_normalized=True):
+    """Reference NMSFast semantics: candidates in score order; each is
+    kept iff its max IoU vs the boxes kept so far is <= the CURRENT
+    threshold; after a keep, a threshold still above 0.5 is scaled by eta."""
+    k = len(boxes)
+    off = 0.0 if box_normalized else 1.0
+
+    def iou(a, b):
+        ix1, iy1 = max(a[0], b[0]), max(a[1], b[1])
+        ix2, iy2 = min(a[2], b[2]), min(a[3], b[3])
+        inter = max(ix2 - ix1 + off, 0) * max(iy2 - iy1 + off, 0)
+        ua = ((a[2] - a[0] + off) * (a[3] - a[1] + off)
+              + (b[2] - b[0] + off) * (b[3] - b[1] + off) - inter)
+        return inter / ua if ua > 0 else 0.0
+
+    keep, th = [False] * k, thresh
+    for i in range(k):
+        if not np.isfinite(scores[i]):
+            continue
+        over = max([iou(boxes[j], boxes[i]) for j in range(k) if keep[j]],
+                   default=0.0)
+        if over <= th:
+            keep[i] = True
+            if th > 0.5:
+                th *= eta
+    return np.array(keep)
+
+
+@pytest.mark.parametrize("eta", [1.0, 0.9, 0.5])
+def test_nms_keep_adaptive_matches_numpy(eta):
+    from paddle_tpu.ops.detection import _nms_keep
+
+    r = np.random.RandomState(3)
+    k = 24
+    xy = r.rand(k, 2) * 4
+    wh = r.rand(k, 2) * 3 + 0.3
+    boxes = np.concatenate([xy, xy + wh], axis=1).astype(np.float32)
+    scores = np.sort(r.rand(k).astype(np.float32))[::-1].copy()
+    scores[-3:] = -np.inf  # invalid tail
+    got = np.asarray(_nms_keep(boxes, scores, 0.6, eta=eta))
+    want = _np_adaptive_nms_keep(boxes, scores, 0.6, eta)
+    np.testing.assert_array_equal(got, want)
+    if eta == 0.5:
+        # the adaptive threshold must actually change the outcome vs greedy
+        greedy = _np_adaptive_nms_keep(boxes, scores, 0.6, 1.0)
+        assert (want != greedy).any()
+
+
+def test_detection_output_adaptive_eta():
+    # staggered boxes with pairwise IoUs ~0.57 / ~0.31 — comfortably away
+    # from both thresholds (no float32 ties): greedy NMS at 0.6 keeps all
+    # three; eta=0.5 drops the threshold to 0.3 after the first keep,
+    # suppressing the other two
+    prior = np.array([[0.10, 0.1, 0.50, 0.5],
+                      [0.21, 0.1, 0.61, 0.5],
+                      [0.31, 0.1, 0.71, 0.5]], np.float32)
+    loc = np.zeros((1, 3, 4), np.float32)
+    scores = np.array([[[0.1, 0.9], [0.2, 0.8], [0.3, 0.7]]], np.float32)
+    pv = layers.data(name="p", shape=[3, 4], append_batch_size=False)
+    lv = layers.data(name="l", shape=[1, 3, 4], append_batch_size=False)
+    sv = layers.data(name="s", shape=[1, 3, 2], append_batch_size=False)
+    counts = {}
+    for eta in (1.0, 0.5):
+        out, count = layers.detection_output(
+            lv, sv, pv, None, background_label=0, nms_threshold=0.6,
+            nms_top_k=3, keep_top_k=3, score_threshold=0.01, nms_eta=eta)
+        _, c = _run({"p": prior, "l": loc, "s": scores}, [out, count])
+        counts[eta] = int(c[0])
+    assert counts[1.0] == 3  # greedy keeps all three
+    assert counts[0.5] == 1  # adaptive suppresses the rest
+
+
 def test_ssd_loss_runs_and_trains():
     r = np.random.RandomState(0)
     B, NP, C, G = 2, 8, 4, 3
