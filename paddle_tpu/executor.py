@@ -175,13 +175,15 @@ class Executor:
                     "(-1 = any); fix the feed or the layers.data "
                     "declaration" % (name, tuple(shape), declared))
 
-    def _compile(self, program: Program, feed_sig, fetch_names, scope: Scope,
-                 user_feed_names=None) -> _Compiled:
+    def _verify_and_analyze(self, program: Program, feed_sig, scope: Scope,
+                            user_feed_names=None):
+        """Shared pre-compile prologue for _compile/_compile_loop: feed
+        shape check, static program verification (SURVEY aux: race-
+        detection equivalent — hard errors raise with op context, write-
+        once findings only warn), state analysis, and the missing-
+        persistable check."""
         feed_names = tuple(n for n, _, _ in feed_sig)
         self._check_feed_shapes(program, feed_sig, user_feed_names)
-        # static pre-compile verification (SURVEY aux: race-detection
-        # equivalent): hard errors raise here with op context; write-once
-        # findings only warn
         for kind, msg in verify_program(program, feed_names):
             if kind == "write-once":
                 warnings.warn("program verifier: " + msg)
@@ -194,9 +196,64 @@ class Executor:
                 "persistable variables %s have no value in scope; run the "
                 "startup program first" % (missing,)
             )
+        return state_in, state_out
+
+    def _compile(self, program: Program, feed_sig, fetch_names, scope: Scope,
+                 user_feed_names=None) -> _Compiled:
+        state_in, state_out = self._verify_and_analyze(
+            program, feed_sig, scope, user_feed_names)
 
         stepfn = build_step_fn(program, fetch_names, state_in, state_out)
         fn = jax.jit(stepfn, donate_argnums=(1,))
+        return _Compiled(fn, state_in, state_out, fetch_names, program)
+
+    def _compile_loop(self, program: Program, feed_sig, fetch_names,
+                      scope: Scope, per_step_names: frozenset,
+                      user_feed_names=None) -> _Compiled:
+        """Like _compile, but the executable runs `n` training steps in ONE
+        XLA while-loop: (feeds, state, rng_key, step0, n) -> (last fetches,
+        final state). `n` is a traced int32, so one compilation serves any
+        step count for feed-only programs. Feeds named in `per_step_names`
+        carry a leading n-sized axis and are sliced per iteration (reader
+        batches); that leading dim is a static shape, so reader programs
+        compile once per distinct window length.
+
+        Host<->device interaction per call is one dispatch + one fetch no
+        matter how many steps run — on a remote-tunneled TPU this is the
+        difference between step time and round-trip time (the reference
+        gets the same effect from double_buffer readers + multi-iteration
+        C++ executor loops, e.g. ParallelExecutor::Run batches)."""
+        state_in, state_out = self._verify_and_analyze(
+            program,
+            # per-step feeds are validated against their per-iteration shape
+            [(n, s[1:] if n in per_step_names else s, d)
+             for n, s, d in feed_sig],
+            scope, user_feed_names)
+
+        stepfn = build_step_fn(program, fetch_names, state_in, state_out)
+
+        def slice_feeds(feeds, i):
+            return {
+                k: (jax.lax.dynamic_index_in_dim(v, i, keepdims=False)
+                    if k in per_step_names else v)
+                for k, v in feeds.items()
+            }
+
+        def loopfn(feeds, state, rng_key, step0, n):
+            step0 = jnp.asarray(step0, jnp.uint32)
+            # first step outside the loop fixes the carry structure
+            # (fetch shapes/dtypes) without a separate trace
+            fetches, state = stepfn(slice_feeds(feeds, 0), state, rng_key,
+                                    step0)
+
+            def body(i, carry):
+                _, st = carry
+                return stepfn(slice_feeds(feeds, i), st, rng_key,
+                              step0 + jnp.asarray(i, jnp.uint32))
+
+            return jax.lax.fori_loop(1, n, body, (fetches, state))
+
+        fn = jax.jit(loopfn, donate_argnums=(1,))
         return _Compiled(fn, state_in, state_out, fetch_names, program)
 
     @staticmethod
@@ -208,6 +265,22 @@ class Executor:
             # ml_dtypes extension floats are not np.floating subtypes
             return not np.isfinite(arr.astype(np.float32)).all()
         return False
+
+    @staticmethod
+    def _profiler_fence(fetches, new_state):
+        """Wait until the dispatched step has really executed.
+        jax.block_until_ready is the natural fence, but on the axon
+        (tunneled TPU) backend it returns without waiting; the only
+        reliable fence there is a device->host read, so pull one (small)
+        fetch — outputs of one executable become ready together. Falls
+        back to a one-element state read when there are no fetches."""
+        jax.block_until_ready((fetches, new_state))
+        for v in fetches:
+            np.asarray(v)
+            return
+        for v in new_state.values():
+            np.asarray(jnp.ravel(v)[:1])
+            return
 
     def _check_nan_inf(self, fetch_names, fetches, new_state):
         bad = []
@@ -221,6 +294,75 @@ class Executor:
             raise FloatingPointError(
                 "NaN/Inf detected after step %d in: %s (check_nan_inf mode)"
                 % (self._step - 1, ", ".join(bad)))
+
+    # -- shared run plumbing ---------------------------------------------
+    def _read_ops_for(self, program: Program, gb):
+        """(Static) read-op list, cached per program version so the hot
+        path does not rescan every op each step."""
+        rkey = (id(program), program._version)
+        read_ops = self._read_ops.get(rkey)
+        if read_ops is None:
+            read_ops = [op for op in gb.ops if op.type == "read"]
+            self._read_ops[rkey] = read_ops  # grows like _cache: per version
+        return read_ops
+
+    @staticmethod
+    def _holder_for(gb, op):
+        rvar = gb._find_var_recursive(op.input("Reader")[0])
+        holder = getattr(rvar, "_reader_holder", None)
+        if holder is None:
+            raise RuntimeError(
+                "reader variable %r has no bound pipeline; build it "
+                "with fluid.layers.py_reader/open_recordio_file"
+                % op.input("Reader")[0])
+        return holder
+
+    @staticmethod
+    def _next_batch(holder):
+        """Pull the next reader batch, honoring batches a previous
+        run_loop window pushed back (partial-shape boundary)."""
+        buf = getattr(holder, "_ptpu_pushback", None)
+        if buf:
+            return buf.pop(0)
+        # note: the executor does NOT auto-start the pipeline. File
+        # readers lazy-start on first next(); py_reader requires the
+        # explicit reader.start() per epoch (reference semantics).
+        return holder.next()
+
+    @staticmethod
+    def _push_back(holder, batch):
+        buf = getattr(holder, "_ptpu_pushback", None)
+        if buf is None:
+            buf = []
+            holder._ptpu_pushback = buf
+        buf.insert(0, batch)
+
+    def _gather_state(self, compiled, scope):
+        state = {}
+        for name in compiled.state_in_names:
+            val = scope.find_var(name)
+            if val is None:
+                raise RuntimeError(
+                    "persistable variable %r has no value in scope; run the "
+                    "startup program first" % name
+                )
+            state[name] = val
+        return state
+
+    def _rng_for(self, program):
+        seed = program.random_seed if program.random_seed else self._seed
+        if seed not in self._base_keys:
+            self._base_keys[seed] = jax.random.PRNGKey(seed)
+        return self._base_keys[seed]
+
+    def _finish(self, compiled, fetches, new_state, scope, return_numpy):
+        for name, val in new_state.items():
+            scope.set_var(name, val)
+        if self.check_nan_inf:
+            self._check_nan_inf(compiled.fetch_names, fetches, new_state)
+        if return_numpy:
+            return [np.asarray(v) for v in fetches]
+        return list(fetches)
 
     # -- public API ------------------------------------------------------
     def run(
@@ -251,25 +393,9 @@ class Executor:
         # op and inject its outputs as this step's feeds (reference:
         # operators/reader/read_op.cc pulling from the ReaderHolder).
         # Raises io.reader.EOFException when the pipeline is exhausted.
-        # The (static) read-op list is cached per program version so the
-        # hot path does not rescan every op each step.
-        rkey = (id(program), program._version)
-        read_ops = self._read_ops.get(rkey)
-        if read_ops is None:
-            read_ops = [op for op in gb.ops if op.type == "read"]
-            self._read_ops[rkey] = read_ops  # grows like _cache: per version
-        for op in read_ops:
-            rvar = gb._find_var_recursive(op.input("Reader")[0])
-            holder = getattr(rvar, "_reader_holder", None)
-            if holder is None:
-                raise RuntimeError(
-                    "reader variable %r has no bound pipeline; build it "
-                    "with fluid.layers.py_reader/open_recordio_file"
-                    % op.input("Reader")[0])
-            # note: the executor does NOT auto-start the pipeline. File
-            # readers lazy-start on first next(); py_reader requires the
-            # explicit reader.start() per epoch (reference semantics).
-            batch = holder.next()
+        for op in self._read_ops_for(program, gb):
+            holder = self._holder_for(gb, op)
+            batch = self._next_batch(holder)
             for out_name in op.output("Out"):
                 var = gb._find_var_recursive(out_name)
                 feed_arrays[out_name] = _as_feed_array(batch[out_name], var)
@@ -288,20 +414,8 @@ class Executor:
             if use_program_cache:
                 self._cache[key] = compiled
 
-        state = {}
-        for name in compiled.state_in_names:
-            val = scope.find_var(name)
-            if val is None:
-                raise RuntimeError(
-                    "persistable variable %r has no value in scope; run the "
-                    "startup program first" % name
-                )
-            state[name] = val
-
-        seed = program.random_seed if program.random_seed else self._seed
-        if seed not in self._base_keys:
-            self._base_keys[seed] = jax.random.PRNGKey(seed)
-        rng_key = self._base_keys[seed]
+        state = self._gather_state(compiled, scope)
+        rng_key = self._rng_for(program)
         step = np.uint32(self._step)
         self._step += 1
 
@@ -311,21 +425,142 @@ class Executor:
             label = ("trace+compile+run" if first_run else "run")
             t0 = time.perf_counter()
             fetches, new_state = compiled.fn(feed_arrays, state, rng_key, step)
-            jax.block_until_ready((fetches, new_state))
+            self._profiler_fence(fetches, new_state)
             profiler.record_event(
                 "%s/program_%x" % (label, id(program) & 0xFFFF),
                 time.perf_counter() - t0)
         else:
             fetches, new_state = compiled.fn(feed_arrays, state, rng_key, step)
-        for name, val in new_state.items():
-            scope.set_var(name, val)
+        return self._finish(compiled, fetches, new_state, scope, return_numpy)
 
-        if self.check_nan_inf:
-            self._check_nan_inf(compiled.fetch_names, fetches, new_state)
+    def run_loop(
+        self,
+        program: Optional[Program] = None,
+        feed: Optional[Dict] = None,
+        fetch_list: Optional[Sequence] = None,
+        steps: int = 1,
+        scope: Optional[Scope] = None,
+        return_numpy: bool = True,
+        use_program_cache: bool = True,
+    ) -> List:
+        """Run up to `steps` consecutive training steps as ONE device-side
+        XLA while-loop and return the LAST executed step's fetches.
 
-        if return_numpy:
-            return [np.asarray(v) for v in fetches]
-        return list(fetches)
+        Semantically equivalent to calling run() `steps` times — same RNG
+        sequence (the per-step seed folds the running step counter), same
+        final state — but with exactly one host->device dispatch and one
+        device->host fetch regardless of `steps`. On a remote/tunneled TPU
+        this removes the per-step round trip entirely; on local hardware it
+        removes per-step dispatch overhead (the reference achieves the same
+        with double_buffer readers feeding a C++ executor loop).
+
+        Feeds are loop-invariant (the same batch every step). Programs with
+        reader ops instead pull a window of batches up front, upload them as
+        one stacked (k, ...) array, and slice per iteration on device. The
+        window closes early (k < steps, still trained and returned) when the
+        pipeline hits EOF — the NEXT call then raises EOFException, so the
+        usual catch-and-reset epoch loop sees every batch — or when a batch
+        changes shape (partial final batch); the odd-shaped batch is pushed
+        back for the next call. Each distinct window length k compiles its
+        own executable (the stacked leading dim is a static shape); the
+        feed-only path compiles once for any `steps`.
+        """
+        if steps < 1:
+            raise ValueError("run_loop needs steps >= 1, got %d" % steps)
+        if program is None:
+            program = default_main_program()
+        if scope is None:
+            scope = global_scope()
+        feed = feed or {}
+        fetch_list = list(fetch_list or [])
+        fetch_names = tuple(_fetch_name(f) for f in fetch_list)
+
+        gb = program.global_block()
+        feed_arrays = {}
+        for name, value in feed.items():
+            var = gb._find_var_recursive(name)
+            feed_arrays[name] = _as_feed_array(value, var)
+
+        # reader ops: pull a window of up to `steps` batches per reader so
+        # the whole window uploads in one transfer and the loop body slices
+        # it on device
+        from .io.reader import EOFException  # local: io imports executor
+
+        read_ops = self._read_ops_for(program, gb)
+        op_windows = []
+        eof_exc = None
+        for op in read_ops:
+            holder = self._holder_for(gb, op)
+            out_names = op.output("Out")
+            batches = []
+            for _ in range(steps):
+                try:
+                    b = self._next_batch(holder)
+                except EOFException as e:
+                    eof_exc = e
+                    break
+                if batches and any(
+                        np.shape(b[o]) != np.shape(batches[0][o])
+                        for o in out_names):
+                    # shape boundary (e.g. partial final batch): close the
+                    # window here, keep the batch for the next call
+                    self._push_back(holder, b)
+                    break
+                batches.append(b)
+            op_windows.append((op, holder, batches))
+        per_step_names = set()
+        if read_ops:
+            k = min(len(b) for _, _, b in op_windows)
+            if k == 0:
+                raise eof_exc  # exhausted before the window started
+            for op, holder, batches in op_windows:
+                for b in reversed(batches[k:]):  # realign multi-reader skew
+                    self._push_back(holder, b)
+                for out_name in op.output("Out"):
+                    var = gb._find_var_recursive(out_name)
+                    feed_arrays[out_name] = np.stack(
+                        [np.asarray(_as_feed_array(b[out_name], var))
+                         for b in batches[:k]])
+                    per_step_names.add(out_name)
+            effective_steps = k
+        else:
+            effective_steps = steps
+        feed_sig = tuple(
+            (name, arr.shape, str(arr.dtype))
+            for name, arr in sorted(feed_arrays.items())
+        )
+
+        key = ("loop", id(program), program._version, feed_sig, fetch_names,
+               frozenset(per_step_names))
+        compiled = self._cache.get(key) if use_program_cache else None
+        if use_program_cache:
+            profiler.record_cache(compiled is not None)
+        first_run = compiled is None
+        if compiled is None:
+            compiled = self._compile_loop(
+                program, feed_sig, fetch_names, scope,
+                frozenset(per_step_names), user_feed_names=frozenset(feed))
+            if use_program_cache:
+                self._cache[key] = compiled
+
+        state = self._gather_state(compiled, scope)
+        rng_key = self._rng_for(program)
+        step0 = np.uint32(self._step)
+        self._step += effective_steps
+
+        if profiler.is_profiling():
+            label = ("trace+compile+run_loop" if first_run else "run_loop")
+            t0 = time.perf_counter()
+            fetches, new_state = compiled.fn(feed_arrays, state, rng_key,
+                                             step0, np.int32(effective_steps))
+            self._profiler_fence(fetches, new_state)
+            profiler.record_event(
+                "%s/program_%x" % (label, id(program) & 0xFFFF),
+                time.perf_counter() - t0)
+        else:
+            fetches, new_state = compiled.fn(feed_arrays, state, rng_key,
+                                             step0, np.int32(effective_steps))
+        return self._finish(compiled, fetches, new_state, scope, return_numpy)
 
     def close(self):
         self._cache.clear()

@@ -64,8 +64,18 @@ def _make_reader_var(holder, name=None):
         stop_gradient=True,
     )
     var._reader_holder = holder
-    var.start = holder.start
-    var.reset = holder.reset
+
+    # start()/reset() begin a fresh epoch: any batch a run_loop window
+    # pushed back (partial-shape boundary) belongs to the OLD epoch and
+    # must not replay into the new one
+    def _fresh_epoch(fn):
+        def wrapped():
+            holder._ptpu_pushback = []
+            return fn()
+        return wrapped
+
+    var.start = _fresh_epoch(holder.start)
+    var.reset = _fresh_epoch(holder.reset)
     return var
 
 

@@ -1,0 +1,216 @@
+"""Executor.run_loop: K training steps in one device-side XLA while-loop.
+
+Parity contract: run_loop(steps=K) must equal K successive run() calls —
+same final parameters, same last-step fetches, same RNG sequence (dropout).
+The reference gets multi-iteration device residency from double_buffer
+readers + the C++ executor loop (operators/reader/read_op.cc); here the
+loop itself is part of the one compiled XLA computation.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, optimizer
+
+
+def _build_lm_like(seed=7, dropout=False):
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup.random_seed = seed
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(main_p, startup):
+        with fluid.unique_name.guard():
+            x = layers.data(name="x", shape=[4, 8], dtype="float32",
+                            append_batch_size=False)
+            y = layers.data(name="y", shape=[4, 1], dtype="float32",
+                            append_batch_size=False)
+            h = layers.fc(x, 16, act="tanh")
+            if dropout:
+                h = layers.dropout(h, dropout_prob=0.3)
+            pred = layers.fc(h, 1)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            optimizer.Adam(learning_rate=1e-2).minimize(loss)
+    return main_p, startup, scope, loss
+
+
+def _feed(rs):
+    return {"x": rs.randn(4, 8).astype(np.float32),
+            "y": rs.randn(4, 1).astype(np.float32)}
+
+
+def _param_snapshot(scope, program):
+    out = {}
+    for p in program.all_parameters():
+        out[p.name] = np.asarray(scope.find_var(p.name))
+    return out
+
+
+@pytest.mark.parametrize("dropout", [False, True])
+def test_run_loop_matches_stepwise(dropout):
+    feed = _feed(np.random.RandomState(0))
+
+    main_a, start_a, scope_a, loss_a = _build_lm_like(dropout=dropout)
+    with fluid.scope_guard(scope_a):
+        exe_a = fluid.Executor(fluid.CPUPlace())
+        exe_a.run(start_a)
+        for _ in range(5):
+            (last_a,) = exe_a.run(main_a, feed=feed, fetch_list=[loss_a])
+
+    main_b, start_b, scope_b, loss_b = _build_lm_like(dropout=dropout)
+    with fluid.scope_guard(scope_b):
+        exe_b = fluid.Executor(fluid.CPUPlace())
+        exe_b.run(start_b)
+        (last_b,) = exe_b.run_loop(main_b, feed=feed, fetch_list=[loss_b],
+                                   steps=5)
+
+    np.testing.assert_allclose(last_a, last_b, rtol=1e-5, atol=1e-6)
+    pa = _param_snapshot(scope_a, main_a)
+    pb = _param_snapshot(scope_b, main_b)
+    assert pa.keys() == pb.keys()
+    for name in pa:
+        np.testing.assert_allclose(pa[name], pb[name], rtol=1e-5, atol=1e-6,
+                                   err_msg=name)
+
+
+def test_run_loop_single_step_and_validation():
+    feed = _feed(np.random.RandomState(1))
+    main_p, startup, scope, loss = _build_lm_like()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        (v1,) = exe.run_loop(main_p, feed=feed, fetch_list=[loss], steps=1)
+        assert np.isfinite(v1).all()
+        with pytest.raises(ValueError):
+            exe.run_loop(main_p, feed=feed, fetch_list=[loss], steps=0)
+
+
+def test_run_loop_traced_step_count_reuses_executable():
+    """Different `steps` values must hit the same compiled entry (the step
+    count is a traced argument, not a static shape)."""
+    feed = _feed(np.random.RandomState(2))
+    main_p, startup, scope, loss = _build_lm_like()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        exe.run_loop(main_p, feed=feed, fetch_list=[loss], steps=2)
+        n_entries = len(exe._cache)
+        exe.run_loop(main_p, feed=feed, fetch_list=[loss], steps=7)
+        assert len(exe._cache) == n_entries
+
+
+def test_run_loop_reader_pipeline_parity():
+    """Reader-op programs pull `steps` batches up front (one stacked
+    upload) and must match the same batches fed step-by-step."""
+    rs = np.random.RandomState(3)
+    batches = [rs.randn(4, 2).astype(np.float32) for _ in range(6)]
+
+    def build(use_loop):
+        main_p, startup = fluid.Program(), fluid.Program()
+        main_p.random_seed = startup.random_seed = 11
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope), fluid.program_guard(main_p, startup):
+            with fluid.unique_name.guard():
+                reader = layers.py_reader(
+                    capacity=8, shapes=[(-1, 2)], dtypes=["float32"],
+                    name="loop_r" + ("1" if use_loop else "0"))
+                (x,) = layers.read_file(reader)
+                pred = layers.fc(x, 1)
+                loss = layers.mean(pred * pred)
+                optimizer.SGD(learning_rate=0.1).minimize(loss)
+        reader.decorate_tensor_provider(lambda: iter([(b,) for b in batches]))
+        return main_p, startup, scope, loss, reader
+
+    main_a, start_a, scope_a, loss_a, rd_a = build(False)
+    with fluid.scope_guard(scope_a):
+        exe_a = fluid.Executor(fluid.CPUPlace())
+        exe_a.run(start_a)
+        rd_a.start()
+        for _ in range(6):
+            (last_a,) = exe_a.run(main_a, fetch_list=[loss_a])
+
+    main_b, start_b, scope_b, loss_b, rd_b = build(True)
+    with fluid.scope_guard(scope_b):
+        exe_b = fluid.Executor(fluid.CPUPlace())
+        exe_b.run(start_b)
+        rd_b.start()
+        (last_b,) = exe_b.run_loop(main_b, fetch_list=[loss_b], steps=6)
+
+    np.testing.assert_allclose(last_a, last_b, rtol=1e-5, atol=1e-6)
+    pa = _param_snapshot(scope_a, main_a)
+    pb = _param_snapshot(scope_b, main_b)
+    for name in pa:
+        np.testing.assert_allclose(pa[name], pb[name], rtol=1e-5, atol=1e-6,
+                                   err_msg=name)
+
+
+def _build_reader_prog(batches, name):
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup.random_seed = 11
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(main_p, startup):
+        with fluid.unique_name.guard():
+            reader = layers.py_reader(
+                capacity=16, shapes=[(-1, 2)], dtypes=["float32"], name=name)
+            (x,) = layers.read_file(reader)
+            pred = layers.fc(x, 1)
+            loss = layers.mean(pred * pred)
+            optimizer.SGD(learning_rate=0.1).minimize(loss)
+    reader.decorate_tensor_provider(lambda: iter([(b,) for b in batches]))
+    return main_p, startup, scope, loss, reader
+
+
+def test_run_loop_reader_eof_truncates_then_raises():
+    """A window that hits EOF trains on the batches it DID pull and
+    returns; only the next call raises — no tail batch is ever lost."""
+    rs = np.random.RandomState(4)
+    batches = [rs.randn(4, 2).astype(np.float32) for _ in range(5)]
+    main_p, startup, scope, loss, reader = _build_reader_prog(batches, "eof_r")
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        reader.start()
+        (l1,) = exe.run_loop(main_p, fetch_list=[loss], steps=3)
+        # 2 batches left; ask for 3 -> trains on 2, returns
+        (l2,) = exe.run_loop(main_p, fetch_list=[loss], steps=3)
+        assert np.isfinite(l2).all()
+        assert exe._step - 1 == 5  # startup + exactly 5 training steps
+        with pytest.raises(fluid.EOFException):
+            exe.run_loop(main_p, fetch_list=[loss], steps=3)
+
+
+def test_run_loop_reader_partial_batch_pushback():
+    """A shape-changing (partial final) batch closes the window and is
+    trained by the NEXT call instead of crashing np.stack."""
+    rs = np.random.RandomState(5)
+    batches = [rs.randn(4, 2).astype(np.float32) for _ in range(3)]
+    batches.append(rs.randn(2, 2).astype(np.float32))  # partial tail
+    main_p, startup, scope, loss, reader = _build_reader_prog(batches, "pb_r")
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        reader.start()
+        (l1,) = exe.run_loop(main_p, fetch_list=[loss], steps=4)  # 3 full
+        (l2,) = exe.run_loop(main_p, fetch_list=[loss], steps=4)  # the tail
+        assert np.isfinite(l2).all()
+        assert exe._step - 1 == 4  # startup + 3 + 1
+        with pytest.raises(fluid.EOFException):
+            exe.run_loop(main_p, fetch_list=[loss], steps=1)
+
+
+def test_reader_reset_discards_pushed_back_batch():
+    """start()/reset() begin a fresh epoch: a batch pushed back by an
+    earlier run_loop window must NOT replay into the new epoch."""
+    full = [np.zeros((4, 2), np.float32) for _ in range(3)]
+    tail = np.full((2, 2), 99.0, np.float32)  # distinctive partial batch
+    main_p, startup, scope, loss, reader = _build_reader_prog(
+        full + [tail], "reset_r")
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        reader.start()
+        exe.run_loop(main_p, fetch_list=[loss], steps=4)  # pushes back tail
+        reader.reset()
+        reader.start()
+        # a zero batch gives loss == bias^2 contribution only; the stale
+        # 99-batch would give a huge loss — detect by magnitude
+        (lv,) = exe.run(main_p, fetch_list=[loss])
+        assert float(lv) < 50.0, "stale pushed-back batch replayed: %r" % lv
