@@ -132,6 +132,31 @@ def build_step_fn(program: Program, fetch_names, state_in, state_out):
     return stepfn
 
 
+def make_loop_fn(stepfn, slice_feeds=None):
+    """First-step-unrolled fori_loop wrapper shared by Executor and
+    ParallelExecutor: (feeds, state, rng_key, step0, n) -> the LAST
+    step's (fetches, state), with n a traced int32. The first step runs
+    outside the loop to fix the carry structure (fetch shapes/dtypes)
+    without a separate trace; the per-step RNG folds step0+i exactly as
+    n successive single-step calls would. `slice_feeds(feeds, i)`
+    selects per-iteration feeds (reader windows); None = loop-invariant.
+    """
+    sf = slice_feeds if slice_feeds is not None else (lambda feeds, i: feeds)
+
+    def loopfn(feeds, state, rng_key, step0, n):
+        step0 = jnp.asarray(step0, jnp.uint32)
+        fetches, st = stepfn(sf(feeds, 0), state, rng_key, step0)
+
+        def body(i, carry):
+            _, s = carry
+            return stepfn(sf(feeds, i), s, rng_key,
+                          step0 + jnp.asarray(i, jnp.uint32))
+
+        return jax.lax.fori_loop(1, n, body, (fetches, st))
+
+    return loopfn
+
+
 class Executor:
     """check_nan_inf=True (or env PADDLE_TPU_CHECK_NAN_INF=1) validates
     every fetch and updated state var for NaN/Inf after each run — the
@@ -239,21 +264,7 @@ class Executor:
                 for k, v in feeds.items()
             }
 
-        def loopfn(feeds, state, rng_key, step0, n):
-            step0 = jnp.asarray(step0, jnp.uint32)
-            # first step outside the loop fixes the carry structure
-            # (fetch shapes/dtypes) without a separate trace
-            fetches, state = stepfn(slice_feeds(feeds, 0), state, rng_key,
-                                    step0)
-
-            def body(i, carry):
-                _, st = carry
-                return stepfn(slice_feeds(feeds, i), st, rng_key,
-                              step0 + jnp.asarray(i, jnp.uint32))
-
-            return jax.lax.fori_loop(1, n, body, (fetches, state))
-
-        fn = jax.jit(loopfn, donate_argnums=(1,))
+        fn = jax.jit(make_loop_fn(stepfn, slice_feeds), donate_argnums=(1,))
         return _Compiled(fn, state_in, state_out, fetch_names, program)
 
     @staticmethod
@@ -511,11 +522,15 @@ class Executor:
         per_step_names = set()
         if read_ops:
             k = min(len(b) for _, _, b in op_windows)
+            for op, holder, batches in op_windows:
+                # push everything beyond the common window back (multi-
+                # reader skew realignment; k == 0 pushes ALL pulls back so
+                # an EOF on one reader costs the others nothing)
+                for b in reversed(batches[k:]):
+                    self._push_back(holder, b)
             if k == 0:
                 raise eof_exc  # exhausted before the window started
             for op, holder, batches in op_windows:
-                for b in reversed(batches[k:]):  # realign multi-reader skew
-                    self._push_back(holder, b)
                 for out_name in op.output("Out"):
                     var = gb._find_var_recursive(out_name)
                     feed_arrays[out_name] = np.stack(

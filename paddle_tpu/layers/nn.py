@@ -2045,12 +2045,16 @@ def beam_search_decode(ids, scores, beam_size=None, end_id=0, parent_idx=None,
 
 
 def fused_attention(q, k, v, causal=False, scale=None, sequence_length=None,
-                    dropout_rate=0.0, block_k=None, name=None):
+                    dropout_rate=0.0, block_k=None, layout="bhtd",
+                    name=None):
     """Flash attention over (B, H, T, Dh) tensors — one fused op instead of
     the matmul/softmax/dropout/matmul chain (kernel: ops/attention.py).
     Exact attention, O(T) memory; `sequence_length` masks padded KV
     positions; TPU-native (no reference twin — the reference materializes
-    the (T, T) scores)."""
+    the (T, T) scores). layout="bthd" instead takes (B, T, H, Dh) — the
+    head-split projection's natural shape — and runs with zero head
+    transposes on the Pallas path (needs Dh %% 128 == 0; falls back to an
+    internal transpose otherwise, numerics identical)."""
     helper = LayerHelper("fused_attention", name=name)
     out = helper.create_variable_for_type_inference(q.dtype, shape=q.shape)
     inputs = {"Q": [q], "K": [k], "V": [v]}
@@ -2062,7 +2066,8 @@ def fused_attention(q, k, v, causal=False, scale=None, sequence_length=None,
         outputs={"Out": [out]},
         attrs={"causal": causal, "scale": scale,
                "dropout_rate": dropout_rate,
-               "block_k": block_k or _DEFAULT_ATTN_BLOCK_K},
+               "block_k": block_k or _DEFAULT_ATTN_BLOCK_K,
+               "layout": layout},
     )
     return out
 

@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..executor import analyze_state, build_step_fn, _as_feed_array, _fetch_name
@@ -153,7 +154,7 @@ class ParallelExecutor:
         return self._mesh.size
 
     # -- compilation -----------------------------------------------------
-    def _compile(self, feed_sig, fetch_names) -> _ParCompiled:
+    def _compile(self, feed_sig, fetch_names, loop=False) -> _ParCompiled:
         from ..executor import Executor
 
         program = self._program
@@ -240,15 +241,34 @@ class ParallelExecutor:
         }
         rep = plan.replicated()
 
-        fn = jax.jit(
-            stepfn,
-            in_shardings=(feed_shardings, in_state_shardings, rep, rep),
-            out_shardings=(
-                tuple(rep for _ in fetch_names),
-                out_state_shardings,
-            ),
-            donate_argnums=(1,),
-        )
+        if loop:
+            # device-side multi-step loop (see Executor.run_loop): the same
+            # stepfn — plain, or even the pipelined one — runs n times in
+            # ONE XLA while-loop, with a traced step count. Feeds are
+            # loop-invariant; the fold of step0+i keeps the RNG sequence
+            # identical to n successive run() calls.
+            from ..executor import make_loop_fn
+
+            fn = jax.jit(
+                make_loop_fn(stepfn),
+                in_shardings=(feed_shardings, in_state_shardings, rep, rep,
+                              rep),
+                out_shardings=(
+                    tuple(rep for _ in fetch_names),
+                    out_state_shardings,
+                ),
+                donate_argnums=(1,),
+            )
+        else:
+            fn = jax.jit(
+                stepfn,
+                in_shardings=(feed_shardings, in_state_shardings, rep, rep),
+                out_shardings=(
+                    tuple(rep for _ in fetch_names),
+                    out_state_shardings,
+                ),
+                donate_argnums=(1,),
+            )
         return _ParCompiled(fn, state_in, state_out, fetch_names)
 
     # -- feed assembly ---------------------------------------------------
@@ -288,16 +308,20 @@ class ParallelExecutor:
         return jax.device_put(arr, sharding)
 
     # -- public API ------------------------------------------------------
-    def run(self, fetch_list: Sequence, feed=None, feed_dict=None, return_numpy=True):
+    def run(self, fetch_list: Sequence, feed=None, feed_dict=None,
+            return_numpy=True, _steps=None):
+        loop = _steps is not None
+        steps = int(_steps or 1)
         fetch_names = tuple(_fetch_name(f) for f in fetch_list)
         feed_arrays = self._assemble_feed(feed, feed_dict)
         feed_sig = tuple(
             (name, arr.shape, str(arr.dtype)) for name, arr in sorted(feed_arrays.items())
         )
-        key = (id(self._program), self._program._version, feed_sig, fetch_names)
+        key = (id(self._program), self._program._version, feed_sig,
+               fetch_names, loop)
         compiled = self._cache.get(key)
         if compiled is None:
-            compiled = self._compile(feed_sig, fetch_names)
+            compiled = self._compile(feed_sig, fetch_names, loop=loop)
             self._cache[key] = compiled
 
         plan = self._plan
@@ -321,16 +345,34 @@ class ParallelExecutor:
         if seed not in self._base_keys:
             self._base_keys[seed] = jax.random.PRNGKey(seed)
         step = np.uint32(self._step)
-        self._step += 1
+        self._step += steps
 
         # jit traces lazily inside the first call: distributed-capable
         # kernels (ring_attention) read the mesh from this context
         with trace_mod.mesh_context(self._mesh):
-            fetches, new_state = compiled.fn(feeds, state,
-                                             self._base_keys[seed], step)
+            if loop:
+                fetches, new_state = compiled.fn(feeds, state,
+                                                 self._base_keys[seed], step,
+                                                 np.int32(steps))
+            else:
+                fetches, new_state = compiled.fn(feeds, state,
+                                                 self._base_keys[seed], step)
         for name, val in new_state.items():
             self._scope.set_var(name, val)
 
         if return_numpy:
             return [np.asarray(v) for v in fetches]
         return list(fetches)
+
+    def run_loop(self, fetch_list: Sequence, feed=None, steps: int = 1,
+                 return_numpy=True):
+        """Run `steps` consecutive steps as ONE device-side XLA while-loop
+        and return the LAST step's fetches — Executor.run_loop for the
+        mesh-parallel path (feeds are loop-invariant; same RNG sequence
+        and final state as `steps` successive run() calls). Composes with
+        every ShardingPlan, including pipeline parallelism: the whole
+        pp tick loop becomes the loop body."""
+        if steps < 1:
+            raise ValueError("run_loop needs steps >= 1, got %d" % steps)
+        return self.run(fetch_list, feed=feed, return_numpy=return_numpy,
+                        _steps=steps)

@@ -172,3 +172,115 @@ def test_pallas_bwd_interpret_matches_naive():
         for a, b in zip(gp, gn):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-4, atol=2e-4)
+
+
+def test_pallas_bthd_interpret_matches_naive():
+    """BTHD (transpose-free) pallas kernels vs naive attention — values
+    AND grads, causal and not, d_head=128 (the lane-aligned case the
+    layout requires)."""
+    from paddle_tpu.ops.attention import pallas_flash_attention_bthd
+
+    r = np.random.RandomState(9)
+    # (B, T, H, Dh) with Dh = 128
+    q, k, v = (jnp.asarray(r.randn(2, 256, 2, 128), jnp.float32) * 0.1
+               for _ in range(3))
+    for causal in (False, True):
+        out = pallas_flash_attention_bthd(q, k, v, causal=causal,
+                                          block_q=128, block_k=128,
+                                          interpret=True)
+        ref = _naive(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                     jnp.swapaxes(v, 1, 2), causal=causal)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(jnp.swapaxes(ref, 1, 2)),
+                                   rtol=2e-4, atol=2e-4)
+
+        def loss_p(q, k, v):
+            o = pallas_flash_attention_bthd(q, k, v, causal=causal,
+                                            block_q=128, block_k=128,
+                                            interpret=True)
+            return jnp.sum(jnp.sin(o))
+
+        def loss_n(q, k, v):
+            o = _naive(jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+                       jnp.swapaxes(v, 1, 2), causal=causal)
+            return jnp.sum(jnp.sin(jnp.swapaxes(o, 1, 2)))
+
+        gp = jax.grad(loss_p, argnums=(0, 1, 2))(q, k, v)
+        gn = jax.grad(loss_n, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gp, gn):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4)
+
+
+def test_pallas_bthd_rejects_unaligned_head_dim():
+    from paddle_tpu.ops.attention import pallas_flash_attention_bthd
+
+    r = np.random.RandomState(0)
+    q = jnp.asarray(r.randn(1, 256, 4, 64), jnp.float32)
+    with pytest.raises(ValueError, match="128"):
+        pallas_flash_attention_bthd(q, q, q, interpret=True)
+
+
+def test_fused_attention_bthd_layout_op_parity():
+    """layout="bthd" through the op (CPU: exercises the internal
+    transpose fallback) must equal layout="bhtd" on the same tensors."""
+    r = np.random.RandomState(3)
+    qh = r.randn(2, 4, 64, 16).astype(np.float32)  # (B, H, T, Dh)
+    kh = r.randn(2, 4, 64, 16).astype(np.float32)
+    vh = r.randn(2, 4, 64, 16).astype(np.float32)
+
+    def run(layout):
+        mp, sp = fluid.Program(), fluid.Program()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope), fluid.program_guard(mp, sp):
+            q = layers.data(name="q", shape=list(qh.shape), dtype="float32",
+                            append_batch_size=False)
+            k = layers.data(name="k", shape=list(kh.shape), dtype="float32",
+                            append_batch_size=False)
+            v = layers.data(name="v", shape=list(vh.shape), dtype="float32",
+                            append_batch_size=False)
+            if layout == "bthd":
+                q, k, v = (layers.transpose(x, perm=[0, 2, 1, 3])
+                           for x in (q, k, v))
+            out = layers.fused_attention(q, k, v, causal=True, layout=layout)
+            if layout == "bthd":
+                out = layers.transpose(out, perm=[0, 2, 1, 3])
+            exe = fluid.Executor(fluid.CPUPlace())
+            (res,) = exe.run(mp, feed={"q": qh, "k": kh, "v": vh},
+                             fetch_list=[out])
+        return res
+
+    np.testing.assert_allclose(run("bhtd"), run("bthd"), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_transformer_lm_bthd_env_parity(monkeypatch):
+    """The model builds transpose-free graphs under PADDLE_TPU_ATTN_BTHD=1
+    (default); both layouts must train to identical losses on CPU."""
+    from paddle_tpu import models, optimizer
+
+    def train(flag):
+        monkeypatch.setenv("PADDLE_TPU_ATTN_BTHD", flag)
+        mp, sp = fluid.Program(), fluid.Program()
+        mp.random_seed = sp.random_seed = 5
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope), fluid.program_guard(mp, sp):
+            with fluid.unique_name.guard():
+                ids = layers.data(name="ids", shape=[2, 64], dtype="int64",
+                                  append_batch_size=False)
+                labels = layers.data(name="labels", shape=[2, 64],
+                                     dtype="int64", append_batch_size=False)
+                loss, _ = models.transformer.transformer_lm(
+                    ids, labels, vocab_size=128, n_layer=2, n_head=2,
+                    d_model=32, d_inner=64, max_len=64)
+                optimizer.Adam(learning_rate=1e-3).minimize(loss)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(sp)
+            r = np.random.RandomState(0)
+            feed = {"ids": r.randint(0, 128, (2, 64)).astype(np.int64),
+                    "labels": r.randint(0, 128, (2, 64)).astype(np.int64)}
+            vals = [float(exe.run(mp, feed=feed, fetch_list=[loss])[0])
+                    for _ in range(3)]
+        return vals
+
+    np.testing.assert_allclose(train("0"), train("1"), rtol=1e-5, atol=1e-6)

@@ -376,3 +376,43 @@ def test_plan_alignment_survives_ambiguous_prologue():
     assert plan.repeats == 6 and plan.repeats_per_stage == 2
     from paddle_tpu.parallel.pipeline_program import _var_shape
     assert _var_shape(plan.block, plan.carry_tpl_in) == (1, T, D_MODEL)
+
+
+def test_pipeline_run_loop_matches_stepwise():
+    """ParallelExecutor.run_loop composes with pipeline parallelism: the
+    whole pp tick loop becomes the while-loop body. 2 loop steps == 2
+    stepwise run() calls."""
+    n_layer, M, B_mb, lr = 4, 2, 2, 0.1
+    B = M * B_mb
+    rs = np.random.RandomState(5)
+    xs = rs.randint(0, VOCAB, (B, T)).astype(np.int64)
+    ys = rs.randint(0, VOCAB, (B, T)).astype(np.int64)
+
+    def train(mode):
+        main, startup, loss = _build_lm(batch=B_mb, n_layer=n_layer, lr=lr)
+        scope = fluid.core.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+        mesh = make_mesh([2], ("pp",), devices=jax.devices()[:2])
+        bs = BuildStrategy()
+        bs.pipeline_stages = 2
+        bs.pipeline_microbatches = M
+        pe = ParallelExecutor(loss_name=loss.name, main_program=main,
+                              build_strategy=bs, scope=scope, mesh=mesh)
+        if mode == "step":
+            for _ in range(2):
+                lv, = pe.run(feed={"ids": xs, "lbl": ys}, fetch_list=[loss])
+        else:
+            lv, = pe.run_loop(fetch_list=[loss],
+                              feed={"ids": xs, "lbl": ys}, steps=2)
+        params = {k: np.asarray(scope.find_var(k))
+                  for k in _param_names(main)}
+        return float(np.squeeze(lv)), params
+
+    lv_s, p_s = train("step")
+    lv_l, p_l = train("loop")
+    np.testing.assert_allclose(lv_l, lv_s, rtol=2e-5)
+    for k in sorted(p_s):
+        np.testing.assert_allclose(p_l[k], p_s[k], rtol=2e-4, atol=2e-6,
+                                   err_msg=k)

@@ -196,6 +196,54 @@ def test_run_loop_reader_partial_batch_pushback():
             exe.run_loop(main_p, fetch_list=[loss], steps=1)
 
 
+def test_parallel_executor_run_loop_matches_stepwise():
+    """ParallelExecutor.run_loop(steps=4) on the 8-device dp mesh ==
+    4 stepwise run() calls (same seed, same feeds)."""
+    from paddle_tpu.parallel import ParallelExecutor
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(32, 16).astype(np.float32)
+    ys = (rng.randn(32, 1) > 0).astype(np.int64)
+
+    def build():
+        x = layers.data(name="x", shape=[16])
+        yv = layers.data(name="y", shape=[1], dtype="int64")
+        h = layers.fc(input=x, size=32, act="relu")
+        logits = layers.fc(input=h, size=2)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, yv))
+        optimizer.SGD(learning_rate=0.1).minimize(loss)
+        return loss
+
+    results = {}
+    for mode in ("step", "loop"):
+        main_p, start_p = fluid.Program(), fluid.Program()
+        main_p.random_seed = start_p.random_seed = 7
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope), fluid.program_guard(main_p, start_p):
+            with fluid.unique_name.guard():
+                loss = build()
+            fluid.Executor().run(start_p)
+            pexe = ParallelExecutor(loss_name=loss.name,
+                                    main_program=main_p, scope=scope)
+            if mode == "step":
+                for _ in range(4):
+                    (last,) = pexe.run(feed={"x": xs, "y": ys},
+                                       fetch_list=[loss])
+            else:
+                (last,) = pexe.run_loop(fetch_list=[loss],
+                                        feed={"x": xs, "y": ys}, steps=4)
+            params = {p.name: np.asarray(scope.find_var(p.name))
+                      for p in main_p.all_parameters()}
+        results[mode] = (last, params)
+
+    np.testing.assert_allclose(results["step"][0], results["loop"][0],
+                               rtol=2e-5, atol=2e-6)
+    for name in results["step"][1]:
+        np.testing.assert_allclose(results["step"][1][name],
+                                   results["loop"][1][name],
+                                   rtol=2e-5, atol=2e-6, err_msg=name)
+
+
 def test_reader_reset_discards_pushed_back_batch():
     """start()/reset() begin a fresh epoch: a batch pushed back by an
     earlier run_loop window must NOT replay into the new epoch."""
@@ -214,3 +262,47 @@ def test_reader_reset_discards_pushed_back_batch():
         # 99-batch would give a huge loss — detect by magnitude
         (lv,) = exe.run(main_p, fetch_list=[loss])
         assert float(lv) < 50.0, "stale pushed-back batch replayed: %r" % lv
+
+
+def test_run_loop_two_readers_eof_pushes_back_sibling_pulls():
+    """When one reader EOFs at the start of a window (k == 0), the other
+    reader's already-pulled batches are pushed back, not dropped."""
+    rs = np.random.RandomState(6)
+    a_batches = [rs.randn(4, 2).astype(np.float32) for _ in range(5)]
+    b_batches = [rs.randn(4, 3).astype(np.float32) for _ in range(3)]
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup.random_seed = 13
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(main_p, startup):
+        with fluid.unique_name.guard():
+            ra = layers.py_reader(capacity=8, shapes=[(-1, 2)],
+                                  dtypes=["float32"], name="two_ra")
+            rb = layers.py_reader(capacity=8, shapes=[(-1, 3)],
+                                  dtypes=["float32"], name="two_rb")
+            (xa,) = layers.read_file(ra)
+            (xb,) = layers.read_file(rb)
+            loss = layers.mean(layers.fc(xa, 1) ** 2) + layers.mean(
+                layers.fc(xb, 1) ** 2)
+            optimizer.SGD(learning_rate=0.1).minimize(loss)
+    ra.decorate_tensor_provider(lambda: iter([(b,) for b in a_batches]))
+    rb.decorate_tensor_provider(lambda: iter([(b,) for b in b_batches]))
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        ra.start()
+        rb.start()
+        exe.run_loop(main_p, fetch_list=[loss], steps=3)  # window of 3
+        with pytest.raises(fluid.EOFException):
+            # B is exhausted; A's pulls for this window must be returned
+            exe.run_loop(main_p, fetch_list=[loss], steps=3)
+        # the pushback lives on the holder the read op references (the
+        # double_buffer wrapper, not the inner PyReader)
+        gb = main_p.global_block()
+        holders = [
+            gb._find_var_recursive(op.input("Reader")[0])._reader_holder
+            for op in gb.ops if op.type == "read"
+        ]
+        counts = sorted(len(getattr(h, "_ptpu_pushback", []))
+                        for h in holders)
+        assert counts == [0, 2], counts  # B empty, A's 2 pulls returned

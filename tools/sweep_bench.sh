@@ -15,7 +15,7 @@ OUT="${OUT:-/tmp/sweep_results.txt}"
 
 run() {
   echo "=== $* ==="
-  line=$(env "$@" BENCH_RESNET=0 BENCH_PROBE_TIMEOUT=150 timeout 1200 \
+  line=$(env "$@" BENCH_RESNET=0 BENCH_PROBE_TIMEOUT=150 timeout 2400 \
          python bench.py 2>/dev/null | tail -1)
   echo "$line"
   echo "{\"cfg\": \"$*\", \"result\": $(json_or_null "$line")}" >> "$OUT"
@@ -46,6 +46,11 @@ run BENCH_BATCH=16 PADDLE_TPU_LMHEAD_BLOCK=8192
 # 6b. unrolled LM-head chunk loop / wider heads (d_head 128 on the MXU)
 run BENCH_BATCH=16 PADDLE_TPU_LMHEAD_UNROLL=16
 run BENCH_BATCH=16 BENCH_HEADS=8
+# 6c. d_head 128 activates the transpose-free BTHD pallas layout by
+# default; the =0 row isolates the layout's own contribution
+run BENCH_BATCH=16 BENCH_HEADS=8 PADDLE_TPU_ATTN_BTHD=0
+run BENCH_BATCH=16 BENCH_HEADS=8 PADDLE_TPU_FLASH_BQ=1024 PADDLE_TPU_FLASH_BK=1024
+run BENCH_BATCH=24 BENCH_HEADS=8 BENCH_REMAT=1
 # 7. bigger per-chip batches (straight, then rematerialized backward)
 run BENCH_BATCH=24
 run BENCH_BATCH=24 BENCH_REMAT=1
@@ -55,7 +60,7 @@ if [ "${RN:-0}" = "1" ]; then
   for rb in 128 256 64; do
     echo "=== resnet batch $rb ==="
     line=$(env BENCH_RN_BATCH=$rb BENCH_PROBE_TIMEOUT=150 BENCH_STEPS=3 \
-        BENCH_WARMUP=1 BENCH_LAYERS=1 timeout 1200 python bench.py \
+        BENCH_WARMUP=1 BENCH_LAYERS=1 timeout 2400 python bench.py \
         2>/dev/null | tail -1)
     echo "$line"
     echo "{\"cfg\": \"resnet rb=$rb\", \"result\": $(json_or_null "$line")}" >> "$OUT"
