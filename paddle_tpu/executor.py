@@ -453,6 +453,7 @@ class Executor:
         scope: Optional[Scope] = None,
         return_numpy: bool = True,
         use_program_cache: bool = True,
+        per_step_feeds: Optional[Sequence[str]] = None,
     ) -> List:
         """Run up to `steps` consecutive training steps as ONE device-side
         XLA while-loop and return the LAST executed step's fetches.
@@ -465,9 +466,12 @@ class Executor:
         removes per-step dispatch overhead (the reference achieves the same
         with double_buffer readers feeding a C++ executor loop).
 
-        Feeds are loop-invariant (the same batch every step). Programs with
-        reader ops instead pull a window of batches up front, upload them as
-        one stacked (k, ...) array, and slice per iteration on device. The
+        Feeds are loop-invariant (the same batch every step), except names
+        listed in `per_step_feeds`: those must carry a leading `steps`-sized
+        axis (one stacked upload) and are sliced per iteration on device —
+        the way to run a window of DIFFERENT batches per step. Programs with
+        reader ops get the same treatment automatically: a window of batches
+        is pulled up front, stacked, and sliced per iteration. The
         window closes early (k < steps, still trained and returned) when the
         pipeline hits EOF — the NEXT call then raises EOFException, so the
         usual catch-and-reset epoch loop sees every batch — or when a batch
@@ -486,11 +490,28 @@ class Executor:
         fetch_list = list(fetch_list or [])
         fetch_names = tuple(_fetch_name(f) for f in fetch_list)
 
+        per_step_names = set(per_step_feeds or ())
+        unknown = per_step_names - set(feed)
+        if unknown:
+            raise ValueError(
+                "per_step_feeds %s are not in the feed dict" % sorted(unknown))
         gb = program.global_block()
         feed_arrays = {}
         for name, value in feed.items():
             var = gb._find_var_recursive(name)
-            feed_arrays[name] = _as_feed_array(value, var)
+            if name in per_step_names:
+                arr = np.asarray(value)
+                if arr.ndim == 0 or arr.shape[0] != steps:
+                    raise ValueError(
+                        "per-step feed %r must carry a leading steps-sized "
+                        "axis (%d), got shape %s"
+                        % (name, steps, arr.shape))
+                # validate/cast each slice against the declared var like a
+                # normal feed, then restack
+                feed_arrays[name] = np.stack(
+                    [np.asarray(_as_feed_array(a, var)) for a in arr])
+            else:
+                feed_arrays[name] = _as_feed_array(value, var)
 
         # reader ops: pull a window of up to `steps` batches per reader so
         # the whole window uploads in one transfer and the loop body slices
@@ -498,6 +519,12 @@ class Executor:
         from .io.reader import EOFException  # local: io imports executor
 
         read_ops = self._read_ops_for(program, gb)
+        if read_ops and per_step_names:
+            # checked BEFORE any pull so a failed call consumes nothing
+            raise NotImplementedError(
+                "per_step_feeds cannot be combined with reader-op "
+                "programs (the reader window length may truncate below "
+                "`steps`, desynchronizing the stacked feeds)")
         op_windows = []
         eof_exc = None
         for op in read_ops:
@@ -519,7 +546,6 @@ class Executor:
                     break
                 batches.append(b)
             op_windows.append((op, holder, batches))
-        per_step_names = set()
         if read_ops:
             k = min(len(b) for _, _, b in op_windows)
             for op, holder, batches in op_windows:

@@ -306,3 +306,143 @@ def test_run_loop_two_readers_eof_pushes_back_sibling_pulls():
         counts = sorted(len(getattr(h, "_ptpu_pushback", []))
                         for h in holders)
         assert counts == [0, 2], counts  # B empty, A's 2 pulls returned
+
+
+def test_run_loop_per_step_user_feeds():
+    """per_step_feeds: stacked (K, ...) user feeds slice per iteration —
+    K different batches in one device loop == K stepwise calls."""
+    rs = np.random.RandomState(8)
+    xs = [rs.randn(4, 8).astype(np.float32) for _ in range(4)]
+    ys = [rs.randn(4, 1).astype(np.float32) for _ in range(4)]
+
+    main_a, start_a, scope_a, loss_a = _build_lm_like(seed=21)
+    with fluid.scope_guard(scope_a):
+        exe_a = fluid.Executor(fluid.CPUPlace())
+        exe_a.run(start_a)
+        for x, y in zip(xs, ys):
+            (last_a,) = exe_a.run(main_a, feed={"x": x, "y": y},
+                                  fetch_list=[loss_a])
+
+    main_b, start_b, scope_b, loss_b = _build_lm_like(seed=21)
+    with fluid.scope_guard(scope_b):
+        exe_b = fluid.Executor(fluid.CPUPlace())
+        exe_b.run(start_b)
+        (last_b,) = exe_b.run_loop(
+            main_b, feed={"x": np.stack(xs), "y": np.stack(ys)},
+            fetch_list=[loss_b], steps=4, per_step_feeds=["x", "y"])
+
+    np.testing.assert_allclose(last_a, last_b, rtol=1e-5, atol=1e-6)
+    pa = _param_snapshot(scope_a, main_a)
+    pb = _param_snapshot(scope_b, main_b)
+    for name in pa:
+        np.testing.assert_allclose(pa[name], pb[name], rtol=1e-5,
+                                   atol=1e-6, err_msg=name)
+
+
+def test_run_loop_per_step_feed_validation():
+    main_p, startup, scope, loss = _build_lm_like(seed=22)
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        x = np.zeros((3, 4, 8), np.float32)  # leading dim 3 != steps 4
+        y = np.zeros((4, 4, 1), np.float32)
+        with pytest.raises(ValueError, match="leading steps-sized"):
+            exe.run_loop(main_p, feed={"x": x, "y": y}, fetch_list=[loss],
+                         steps=4, per_step_feeds=["x", "y"])
+        with pytest.raises(ValueError, match="not in the feed"):
+            exe.run_loop(main_p, feed={"x": y, "y": y}, fetch_list=[loss],
+                         steps=4, per_step_feeds=["z"])
+
+
+def test_trainer_steps_per_loop():
+    """Trainer.train(steps_per_loop=3): same final params as stepwise,
+    events fire once per window."""
+    from paddle_tpu.trainer import Trainer, EndStepEvent
+
+    rs = np.random.RandomState(11)
+    data = [(rs.randn(4).astype(np.float32),
+             rs.randn(1).astype(np.float32)) for _ in range(8)]
+
+    def train_func():
+        x = layers.data(name="tx", shape=[4], dtype="float32")
+        y = layers.data(name="ty", shape=[1], dtype="float32")
+        pred = layers.fc(x, 1)
+        return layers.mean(layers.square_error_cost(pred, y))
+
+    def opt_func():
+        return optimizer.SGD(learning_rate=0.05)
+
+    def reader():
+        for i in range(0, len(data), 2):  # batches of 2 samples
+            yield data[i:i + 2]
+
+    def run(spl):
+        import paddle_tpu.trainer as trainer_mod
+        t = Trainer(train_func=train_func, optimizer_func=opt_func,
+                    place=fluid.CPUPlace())
+        steps = []
+        t.train(num_epochs=2,
+                event_handler=lambda ev: steps.append(ev.step)
+                if isinstance(ev, EndStepEvent) else None,
+                reader=reader, feed_order=["tx", "ty"],
+                steps_per_loop=spl)
+        params = {p.name: np.asarray(t.scope.find_var(p.name))
+                  for p in t.train_program.all_parameters()}
+        return steps, params
+
+    steps_1, params_1 = run(1)
+    steps_3, params_3 = run(3)
+    assert steps_1 == [0, 1, 2, 3] * 2
+    assert steps_3 == [0, 3] * 2  # windows of 3 then the 1-batch tail
+    for name in params_1:
+        np.testing.assert_allclose(params_3[name], params_1[name],
+                                   rtol=1e-5, atol=1e-6, err_msg=name)
+
+
+def test_trainer_steps_per_loop_ragged_tail():
+    """A short final batch must close its window instead of crashing the
+    per-step feed stack (9 samples / batch 2 / steps_per_loop 3)."""
+    from paddle_tpu.trainer import Trainer, EndStepEvent
+
+    rs = np.random.RandomState(12)
+    data = [(rs.randn(4).astype(np.float32),
+             rs.randn(1).astype(np.float32)) for _ in range(9)]
+
+    def train_func():
+        x = layers.data(name="rx", shape=[4], dtype="float32")
+        y = layers.data(name="ry", shape=[1], dtype="float32")
+        return layers.mean(layers.square_error_cost(layers.fc(x, 1), y))
+
+    def reader():
+        for i in range(0, len(data), 2):  # 4 full batches + 1-sample tail
+            yield data[i:i + 2]
+
+    t = Trainer(train_func=train_func,
+                optimizer_func=lambda: optimizer.SGD(learning_rate=0.05),
+                place=fluid.CPUPlace())
+    steps = []
+    t.train(num_epochs=1,
+            event_handler=lambda ev: steps.append(ev.step)
+            if isinstance(ev, EndStepEvent) else None,
+            reader=reader, feed_order=["rx", "ry"], steps_per_loop=3)
+    # windows: [0,1,2], [3] (shape boundary), [4] (tail)
+    assert steps == [0, 3, 4], steps
+
+
+def test_run_loop_per_step_feeds_with_reader_fails_before_pull():
+    """The per_step_feeds+reader rejection must consume nothing."""
+    rs = np.random.RandomState(13)
+    batches = [rs.randn(4, 2).astype(np.float32) for _ in range(6)]
+    main_p, startup, scope, loss, reader = _build_reader_prog(
+        batches, "mix_r")
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        reader.start()
+        with pytest.raises(NotImplementedError):
+            exe.run_loop(main_p, feed={"bogus": np.zeros((3, 1), np.float32)},
+                         fetch_list=[loss], steps=3,
+                         per_step_feeds=["bogus"])
+        # all 6 batches still trainable
+        exe.run_loop(main_p, fetch_list=[loss], steps=6)
+        assert exe._step - 1 == 6
