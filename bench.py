@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import json
 import os as _os
+import sys as _sys
 import time
 
 import numpy as np
@@ -206,26 +207,49 @@ def _timed_exec(exe, program, feed, fetch, warmup, steps):
 
 
 def bench_lm_ladder(dev):
-    """Default run (no BENCH_BATCH override): on device OOM retry down
-    the ladder so the driver always gets a number from a working config.
-    An EXPLICIT BENCH_BATCH runs exactly that batch and propagates OOM —
-    sweep rows must never silently measure a different config."""
-    if _os.environ.get("BENCH_BATCH") is not None:
-        return bench_lm(dev, BATCH)
-    err = None
-    for b in dict.fromkeys([BATCH, 16, 8]):
-        if b > BATCH:
-            continue
+    """Default run: try configs in order of expected MFU and report the
+    first that works, so the driver always gets the best available
+    number. Two fallback axes:
+    - head count: d_head 128 (8 heads at D_MODEL 1024) fills the MXU's
+      full 128-lane contraction AND activates the transpose-free BTHD
+      pallas layout; it falls back to the long-measured 16-head config
+      on ANY failure (e.g. a Mosaic rejection of the BTHD kernels on a
+      backend where they were never compiled). Same parameter count and
+      identical analytic FLOPs either way.
+    - per-chip batch: OOM retries down the ladder.
+    EXPLICIT BENCH_BATCH / BENCH_HEADS run exactly that config and
+    propagate failures — sweep rows must never silently measure a
+    different config."""
+    explicit_batch = _os.environ.get("BENCH_BATCH") is not None
+    explicit_heads = _os.environ.get("BENCH_HEADS") is not None
+    head_ladder = [N_HEAD] if explicit_heads else [8, 16]
+    head_err = None
+    for heads in head_ladder:
         try:
-            return bench_lm(dev, b)
-        except Exception as e:  # noqa: BLE001 — OOM shapes vary by backend
-            if not _looks_oom(e):
-                raise
-            err = e
-    raise err
+            if explicit_batch:
+                return bench_lm(dev, BATCH, heads)
+            oom_err = None
+            for b in dict.fromkeys([BATCH, 16, 8]):
+                if b > BATCH:
+                    continue
+                try:
+                    return bench_lm(dev, b, heads)
+                except Exception as e:  # noqa: BLE001 — OOM shapes vary
+                    if not _looks_oom(e):
+                        raise
+                    oom_err = e
+            raise oom_err
+        except Exception as e:  # noqa: BLE001 — fall to the next head cfg
+            if _looks_oom(e):
+                raise  # heads don't change memory; a retry would OOM too
+            if heads != head_ladder[-1]:
+                print("bench: %d-head config failed (%s); falling back"
+                      % (heads, repr(e)[:200]), file=_sys.stderr)
+            head_err = e
+    raise head_err
 
 
-def bench_lm(dev, batch):
+def bench_lm(dev, batch, n_head=None):
     import paddle_tpu as fluid
     from paddle_tpu import layers, models, optimizer
 
@@ -239,7 +263,8 @@ def bench_lm(dev, batch):
             labels = layers.data(name="labels", shape=[batch, SEQ],
                                  dtype="int64", append_batch_size=False)
             loss, _ = models.transformer.transformer_lm(
-                ids, labels, vocab_size=VOCAB, n_layer=N_LAYER, n_head=N_HEAD,
+                ids, labels, vocab_size=VOCAB, n_layer=N_LAYER,
+                n_head=n_head if n_head is not None else N_HEAD,
                 d_model=D_MODEL, d_inner=D_INNER, max_len=SEQ,
                 fused_qkv=_os.environ.get("PADDLE_TPU_FUSED_QKV", "0") == "1")
             optimizer.Adam(learning_rate=1e-4).minimize(loss)
@@ -270,6 +295,7 @@ def bench_lm(dev, batch):
         "step_ms": round(dt * 1e3, 2),
         "loss": loss_val,
         "batch": batch,
+        "n_head": n_head if n_head is not None else N_HEAD,
     }
 
 
@@ -375,7 +401,8 @@ def main():
         "loss": lm["loss"],
         "device": getattr(dev, "device_kind", dev.platform),
         "config": {"batch": lm["batch"], "seq": SEQ, "vocab": VOCAB,
-                   "layers": N_LAYER, "d_model": D_MODEL},
+                   "layers": N_LAYER, "d_model": D_MODEL,
+                   "n_head": lm["n_head"]},
     }
     if _os.environ.get("BENCH_RESNET", "1") == "1":
         # flush the primary metric first: if the ResNet phase is killed
