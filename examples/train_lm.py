@@ -31,6 +31,9 @@ def main():
     ap.add_argument("--layers", type=int, default=12)
     ap.add_argument("--d-model", type=int, default=1024)
     ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--loop", action="store_true",
+                    help="run the timed steps as ONE device-side XLA loop "
+                         "(Executor.run_loop) — one dispatch/fetch total")
     ap.add_argument("--mesh", type=str, default=None,
                     help="axis=size pairs, e.g. dp=2,mp=4")
     ap.add_argument("--ring", action="store_true",
@@ -99,9 +102,13 @@ def main():
                 kw["plan"] = megatron_transformer_plan(
                     mesh, mp_axis="mp",
                     batch_axes=("dp",) if "dp" in mesh_axes else ())
-        else:
-            kw["plan"] = (seq_parallel_plan(mesh) if args.ring
-                          else megatron_transformer_plan(mesh))
+        elif args.ring:
+            kw["plan"] = seq_parallel_plan(mesh)
+        elif "mp" in mesh_axes:
+            kw["plan"] = megatron_transformer_plan(mesh)
+        elif "sp" in mesh_axes:
+            kw["plan"] = seq_parallel_plan(mesh)
+        # pure-dp meshes use ParallelExecutor's default data-parallel plan
         pexe = ParallelExecutor(loss_name=loss.name, main_program=main_p,
                                 mesh=mesh, **kw)
         run = lambda fetch: pexe.run(feed=feed, fetch_list=fetch)
@@ -109,15 +116,27 @@ def main():
         sexe = fluid.Executor(fluid.TPUPlace())
         run = lambda fetch: sexe.run(main_p, feed=feed, fetch_list=fetch)
 
-    # warm BOTH compiled variants (the cache keys on the fetch set): the
-    # timed loop mixes no-fetch steps with one final loss fetch
-    run([loss])
-    run([])
-    t0 = time.perf_counter()
-    for _ in range(args.steps - 1):
+    if args.loop:
+        if args.mesh:
+            looper = lambda fetch_list, steps: pexe.run_loop(
+                fetch_list=fetch_list, feed=feed, steps=steps)
+        else:
+            looper = lambda fetch_list, steps: sexe.run_loop(
+                main_p, feed=feed, fetch_list=fetch_list, steps=steps)
+        looper([loss], 1)  # compile + warm
+        t0 = time.perf_counter()
+        out = looper([loss], args.steps)  # numpy return = synced
+        dt = (time.perf_counter() - t0) / args.steps
+    else:
+        # warm BOTH compiled variants (the cache keys on the fetch set):
+        # the timed loop mixes no-fetch steps with one final loss fetch
+        run([loss])
         run([])
-    out = run([loss])
-    dt = (time.perf_counter() - t0) / args.steps
+        t0 = time.perf_counter()
+        for _ in range(args.steps - 1):
+            run([])
+        out = run([loss])
+        dt = (time.perf_counter() - t0) / args.steps
     toks = rows * args.seq / dt
     print("loss %.4f  |  %.0f tokens/s  |  %.1f ms/step"
           % (float(np.asarray(out[0]).reshape(-1)[0]), toks, dt * 1e3))
