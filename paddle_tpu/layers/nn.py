@@ -429,6 +429,13 @@ def _to_list(v, n):
     return [v] * n
 
 
+def conv2d_default_std(filter_hw, c_in) -> float:
+    """MSRA/He std used for conv filters when no initializer is given —
+    shared so alternate stems (e.g. the ResNet space-to-depth stem)
+    initialize exactly like layers.conv2d."""
+    return (2.0 / (filter_hw[0] * filter_hw[1] * c_in)) ** 0.5
+
+
 def conv2d(
     input,
     num_filters,
@@ -455,9 +462,8 @@ def conv2d(
     pd = _to_list(padding, 2)
     dl = _to_list(dilation, 2)
     filter_shape = [num_filters, c // groups, fs[0], fs[1]]
-    import math as _m
 
-    std = (2.0 / (fs[0] * fs[1] * c)) ** 0.5
+    std = conv2d_default_std(fs, c)
     from ..initializer import NormalInitializer
 
     w = helper.create_parameter(

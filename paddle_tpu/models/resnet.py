@@ -49,7 +49,55 @@ def layer_warp(block_func, input, ch_out, count, stride):
     return res_out
 
 
-def resnet_imagenet(input, class_dim: int = 1000, depth: int = 50):
+def _stem_space_to_depth(input):
+    """MXU-friendly ImageNet stem. The canonical 7x7/stride-2 conv on a
+    3-channel image feeds only 3 of the MXU's 128 contraction lanes; a
+    2x2 space-to-depth rearrangement of the input turns it into a
+    mathematically IDENTICAL 4x4/stride-1 conv over 12 channels (the
+    standard TPU ResNet trick, cf. MLPerf TPU submissions).
+
+    Derivation: with y[n, c*4+dy*2+dx, i, j] = x[n, c, 2i+dy, 2j+dx] and
+    the 7x7 kernel W zero-padded by one leading row/col to W8 (8x8, so
+    the stride-2 taps split as p = 2a+dy), the original
+    o = sum W[k,c,p,q] x[n,c,2i+p-3,2j+q-3] becomes a VALID 4x4 conv
+    over y padded (2,1)x(2,1), with
+    W'[k, c*4+dy*2+dx, a, b] = W8[k, c, 2a+dy, 2b+dx].
+
+    The stored parameter keeps the canonical (64, C, 7, 7) shape —
+    checkpoints are interchangeable with the plain stem — and the kernel
+    rearrangement runs in-graph (a few KB; XLA folds it)."""
+    from ..initializer import NormalInitializer
+    from ..layer_helper import LayerHelper
+    from ..layers.nn import conv2d_default_std
+
+    N, C, H, Wd = input.shape
+    helper = LayerHelper("conv2d")
+    std = conv2d_default_std((7, 7), C)
+    w = helper.create_parameter(
+        attr=None, shape=[64, C, 7, 7], dtype=input.dtype,
+        default_initializer=NormalInitializer(0.0, std))
+    w8 = layers.pad(w, paddings=[0, 0, 0, 0, 1, 0, 1, 0])
+    wr = layers.reshape(w8, shape=[64, C, 4, 2, 4, 2])
+    wr = layers.transpose(wr, perm=[0, 1, 3, 5, 2, 4])  # (O, C, dy, dx, a, b)
+    wr = layers.reshape(wr, shape=[64, C * 4, 4, 4])
+    y = layers.reshape(input, shape=[N, C, H // 2, 2, Wd // 2, 2])
+    y = layers.transpose(y, perm=[0, 1, 3, 5, 2, 4])  # (N, C, dy, dx, i, j)
+    y = layers.reshape(y, shape=[N, C * 4, H // 2, Wd // 2])
+    y = layers.pad(y, paddings=[0, 0, 0, 0, 2, 1, 2, 1])
+    out = helper.create_variable_for_type_inference(
+        input.dtype, shape=(N, 64, H // 2, Wd // 2))
+    helper.append_op(
+        type="conv2d",
+        inputs={"Input": [y], "Filter": [wr]},
+        outputs={"Output": [out]},
+        attrs={"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1],
+               "groups": 1},
+    )
+    return layers.batch_norm(input=out, act="relu")
+
+
+def resnet_imagenet(input, class_dim: int = 1000, depth: int = 50,
+                    space_to_depth: bool = True):
     cfg = {
         18: ([2, 2, 2, 1], basicblock),
         34: ([3, 4, 6, 3], basicblock),
@@ -58,7 +106,13 @@ def resnet_imagenet(input, class_dim: int = 1000, depth: int = 50):
         152: ([3, 8, 36, 3], bottleneck),
     }
     stages, block_func = cfg[depth]
-    conv1 = conv_bn_layer(input, ch_out=64, filter_size=7, stride=2, padding=3)
+    h, w = input.shape[2], input.shape[3]
+    if space_to_depth and h is not None and h > 0 and h % 2 == 0 \
+            and w is not None and w > 0 and w % 2 == 0:
+        conv1 = _stem_space_to_depth(input)
+    else:
+        conv1 = conv_bn_layer(input, ch_out=64, filter_size=7, stride=2,
+                              padding=3)
     pool1 = layers.pool2d(
         input=conv1, pool_type="max", pool_size=3, pool_stride=2, pool_padding=1
     )

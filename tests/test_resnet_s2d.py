@@ -1,0 +1,50 @@
+"""Space-to-depth ResNet stem: mathematically identical to the canonical
+7x7/stride-2 conv (models/resnet.py:_stem_space_to_depth docstring has
+the derivation), with the parameter stored in the canonical (64, C, 7, 7)
+shape so checkpoints are interchangeable."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.models import resnet
+
+
+def _forward(space_to_depth, x, params_from=None):
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup.random_seed = 3
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(main_p, startup):
+        with fluid.unique_name.guard():
+            data = layers.data(name="img", shape=list(x.shape),
+                               dtype="float32", append_batch_size=False)
+            logits = resnet.resnet_imagenet(
+                data, class_dim=10, depth=18, space_to_depth=space_to_depth)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        names = [p.name for p in main_p.all_parameters()]
+        if params_from is not None:
+            src_vals, src_names = params_from
+            assert len(src_names) == len(names)
+            for dst, (sv, sn) in zip(names, zip(src_vals, src_names)):
+                dst_shape = np.asarray(scope.find_var(dst)).shape
+                assert dst_shape == sv.shape, (dst, sn, dst_shape, sv.shape)
+                scope.set_var(dst, sv)
+        vals = [np.asarray(scope.find_var(n)) for n in names]
+        (out,) = exe.run(main_p, feed={"img": x}, fetch_list=[logits])
+    return out, (vals, names)
+
+
+def test_s2d_stem_matches_plain_conv():
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 3, 64, 64).astype(np.float32)
+    out_plain, params = _forward(False, x)
+    out_s2d, _ = _forward(True, x, params_from=params)
+    np.testing.assert_allclose(out_s2d, out_plain, rtol=1e-4, atol=1e-5)
+
+
+def test_s2d_falls_back_on_odd_spatial():
+    """Odd spatial dims keep the plain stem (s2d needs 2x2 blocks)."""
+    rs = np.random.RandomState(1)
+    x = rs.randn(1, 3, 31, 31).astype(np.float32)
+    out, _ = _forward(True, x)
+    assert out.shape == (1, 10)
