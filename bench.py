@@ -269,7 +269,10 @@ def bench_lm(dev, batch, n_head=None):
                 fused_qkv=_os.environ.get("PADDLE_TPU_FUSED_QKV", "0") == "1")
             optimizer.Adam(learning_rate=1e-4).minimize(loss)
         if AMP:
-            main_p.enable_mixed_precision()  # bf16 matmuls, fp32 master weights
+            # bf16 matmuls, fp32 master weights; BENCH_AMP_LEVEL=O2 keeps
+            # the elementwise path (residual stream) in bf16 too
+            main_p.enable_mixed_precision(
+                level=_os.environ.get("BENCH_AMP_LEVEL", "O1"))
         if _os.environ.get("BENCH_REMAT", "0") == "1":
             # rematerialize the backward: frees activation HBM so larger
             # per-chip batches fit (sweep lever for batch 24/32)
@@ -313,7 +316,8 @@ def bench_resnet(dev):
             optimizer.Momentum(learning_rate=0.1, momentum=0.9).minimize(
                 avg_cost)
         if AMP:
-            main_p.enable_mixed_precision()
+            main_p.enable_mixed_precision(
+                level=_os.environ.get("BENCH_AMP_LEVEL", "O1"))
 
         exe = fluid.Executor(fluid.TPUPlace() if dev.platform != "cpu"
                              else fluid.CPUPlace())

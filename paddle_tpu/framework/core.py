@@ -320,6 +320,7 @@ class Program:
         self.random_seed = 0
         self._version = 0  # bumped on every mutation; part of the fingerprint
         self._amp = False  # mixed-precision trace mode (see trace.py)
+        self._amp_level = "O1"  # O1: matmul-class bf16; O2: + elementwise
 
     # -- block management ------------------------------------------------
     def global_block(self) -> Block:
@@ -353,11 +354,26 @@ class Program:
         payload = json.dumps(self.to_dict(), sort_keys=True).encode()
         return hashlib.sha1(payload).hexdigest()
 
-    def enable_mixed_precision(self, enabled: bool = True) -> "Program":
+    def enable_mixed_precision(self, enabled: bool = True,
+                               level: Optional[str] = None) -> "Program":
         """Run matmul-class ops in bf16 with fp32 master weights (TPU AMP;
         see trace.py _AMP_BF16_OPS / _AMP_FP32_OPS). No reference twin —
         fluid 0.14 predates AMP; exposed because bf16 is the TPU MXU's
-        native fast path."""
+        native fast path.
+
+        level="O2" additionally keeps the elementwise path (bias/residual
+        adds, activations, dropout, embedding lookup, layer_norm in/out)
+        in bf16, so activations stay bf16 BETWEEN ops instead of being
+        re-promoted to fp32 by every bias add — half the activation HBM
+        traffic. Softmax/losses/reductions stay fp32-pinned, and
+        layer_norm still computes its statistics in fp32 internally."""
+        if level is not None:
+            if level not in ("O1", "O2"):
+                raise ValueError("AMP level must be 'O1' or 'O2', got %r"
+                                 % (level,))
+            self._amp_level = level
+        # a no-level call (incl. enable_mixed_precision(False)) keeps the
+        # previously configured level instead of silently resetting to O1
         self._amp = bool(enabled)
         self._bump()
         return self
@@ -390,6 +406,7 @@ class Program:
             "version": 1,
             "random_seed": self.random_seed,
             "amp": self._amp,
+            "amp_level": getattr(self, "_amp_level", "O1"),
             "blocks": [b.to_dict() for b in self.blocks],
         }
 
@@ -401,6 +418,11 @@ class Program:
         p = Program()
         p.random_seed = d.get("random_seed", 0)
         p._amp = bool(d.get("amp", False))
+        lvl = str(d.get("amp_level", "O1"))
+        if lvl not in ("O1", "O2"):
+            raise ValueError(
+                "serialized program has invalid amp_level %r" % (lvl,))
+        p._amp_level = lvl
         # first pass: blocks
         p.blocks = []
         for bd in d["blocks"]:

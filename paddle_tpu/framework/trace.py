@@ -51,6 +51,37 @@ _AMP_FP32_OPS = {
     "reduce_sum", "mean", "exp", "log", "linear_chain_crf", "warpctc",
     "nce", "hierarchical_sigmoid", "l2_normalize",
 }
+# AMP level O2 (enable_mixed_precision(level="O2")): the elementwise path
+# joins the bf16 set, so activations stay bf16 BETWEEN matmuls instead of
+# being re-promoted to fp32 by every f32-bias add / residual add (under
+# O1 the profile shows f32 (tokens, d_inner) tensors streaming HBM).
+# layer_norm moves from the fp32 pin to bf16 in/out — its kernel computes
+# statistics in fp32 internally regardless of input dtype.
+# Only ACTIVATION-STREAM instances are cast: an op that names a @GRAD
+# var or writes a persistable var is gradient/optimizer-state plumbing
+# (regularizer decay adds, clip scaling, ModelAverage accumulation) and
+# must keep the fp32 master-weight contract — see _o2_eligible().
+_AMP_BF16_O2_OPS = {
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "relu", "tanh", "sigmoid", "swish", "leaky_relu", "relu6",
+    "brelu", "dropout", "lookup_table", "layer_norm",
+}
+
+
+def _o2_eligible(op, block) -> bool:
+    """True when an _AMP_BF16_O2_OPS instance sits on the activation
+    stream: no @GRAD input/output (gradient math stays fp32) and no
+    persistable output (optimizer/EMA state stays fp32)."""
+    for name in op.input_arg_names:
+        if name.endswith("@GRAD") or "@GRAD@" in name:
+            return False
+    for name in op.output_arg_names:
+        if name.endswith("@GRAD") or "@GRAD@" in name:
+            return False
+        var = block._find_var_recursive(name)
+        if var is not None and var.persistable:
+            return False
+    return True
 # batch_norm is deliberately NOT fp32-pinned: the kernel computes its
 # statistics in fp32 internally while keeping the (huge) activation tensors
 # in the incoming dtype — pinning it would stream fp32 copies of every
@@ -139,7 +170,10 @@ def trace_op(op: Operator, block: Block, env: Dict, rng_fn, subblock_fn=None):
     kernel = get_kernel(op.type)
     view = _EnvView(env, op)
     if getattr(block.program, "_amp", False):
-        if op.type in _AMP_BF16_OPS:
+        o2 = getattr(block.program, "_amp_level", "O1") == "O2"
+        if op.type in _AMP_BF16_OPS or (
+                o2 and op.type in _AMP_BF16_O2_OPS
+                and _o2_eligible(op, block)):
             view = _CastEnvView(env, op, jnp.bfloat16)
         elif op.type in _AMP_FP32_OPS:
             view = _CastEnvView(env, op, jnp.float32)

@@ -306,14 +306,20 @@ def _layer_norm(ctx):
     eps = ctx.attr("epsilon", 1e-5)
     begin = ctx.attr("begin_norm_axis", 1)
     axes = tuple(range(begin, x.ndim))
-    mean = jnp.mean(x, axis=axes, keepdims=True)
-    var = jnp.var(x, axis=axes, keepdims=True)
-    y = (x - mean) * lax.rsqrt(var + eps)
+    # statistics always in fp32 (a bf16 mean over thousands of elements
+    # loses ~2 decimal digits); the (huge) activation stays in the
+    # incoming dtype — same policy as batch_norm (AMP O2 relies on it)
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + eps)
     if ctx.has_input("Scale"):
-        y = y * ctx.input("Scale").reshape(x.shape[begin:])
+        y = y * ctx.input("Scale").reshape(x.shape[begin:]).astype(jnp.float32)
     if ctx.has_input("Bias"):
-        y = y + ctx.input("Bias").reshape(x.shape[begin:])
-    return {"Y": y, "Mean": mean.reshape(x.shape[:begin]), "Variance": var.reshape(x.shape[:begin])}
+        y = y + ctx.input("Bias").reshape(x.shape[begin:]).astype(jnp.float32)
+    return {"Y": y.astype(x.dtype),
+            "Mean": mean.reshape(x.shape[:begin]),
+            "Variance": var.reshape(x.shape[:begin])}
 
 
 @register_op("lrn")

@@ -1,0 +1,150 @@
+"""AMP level O2: bf16 elementwise path / residual stream.
+
+Under O1, every f32 bias or residual add re-promotes the activation
+stream to fp32 between bf16 matmuls; O2 keeps it bf16 (fp32 master
+weights and fp32-pinned softmax/losses unchanged). layer_norm computes
+statistics in fp32 regardless of input dtype."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, models, optimizer
+
+
+def test_layer_norm_bf16_uses_f32_stats():
+    """bf16 input, fp32 statistics: the kernel's Mean/Variance must match
+    fp32 stats of the (bf16-quantized) input to fp32 accuracy — a bf16
+    mean of 512 values offset by 8 would be off by ~0.03, three orders
+    of magnitude worse."""
+    from paddle_tpu.ops.registry import get_kernel
+    rs = np.random.RandomState(0)
+    x32 = (rs.randn(4, 512) + 8.0).astype(np.float32)
+    xq = np.asarray(jnp.asarray(x32, jnp.bfloat16), np.float32)  # what bf16 sees
+
+    class Ctx:
+        is_test = True
+        def __init__(self, x):
+            self._x = x
+        def input(self, name):
+            return self._x
+        def has_input(self, name):
+            return False
+        def attr(self, name, default=None):
+            return default
+
+    out = get_kernel("layer_norm")(Ctx(jnp.asarray(x32, jnp.bfloat16)))
+    assert out["Y"].dtype == jnp.bfloat16
+    assert out["Mean"].dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(out["Mean"]), xq.mean(axis=1),
+                               rtol=0, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(out["Variance"]), xq.var(axis=1),
+                               rtol=1e-3, atol=1e-4)
+    # and the normalized output tracks the f32 reference within input
+    # quantization noise
+    yref = (xq - xq.mean(axis=1, keepdims=True)) / np.sqrt(
+        xq.var(axis=1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(np.asarray(out["Y"], np.float32), yref,
+                               atol=0.05)
+
+
+def _train_lm(level, steps=6):
+    mp, sp = fluid.Program(), fluid.Program()
+    mp.random_seed = sp.random_seed = 7
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(mp, sp):
+        with fluid.unique_name.guard():
+            ids = layers.data(name="ids", shape=[2, 64], dtype="int64",
+                              append_batch_size=False)
+            lbl = layers.data(name="lbl", shape=[2, 64], dtype="int64",
+                              append_batch_size=False)
+            loss, _ = models.transformer.transformer_lm(
+                ids, labels=lbl, vocab_size=128, n_layer=2, n_head=2,
+                d_model=64, d_inner=128, max_len=64)
+            optimizer.Adam(learning_rate=3e-3).minimize(loss)
+        if level:
+            mp.enable_mixed_precision(level=level)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(sp)
+        rs = np.random.RandomState(1)
+        feed = {"ids": rs.randint(0, 128, (2, 64)).astype(np.int64),
+                "lbl": rs.randint(0, 128, (2, 64)).astype(np.int64)}
+        vals = [float(exe.run(mp, feed=feed, fetch_list=[loss])[0])
+                for _ in range(steps)]
+    return vals
+
+
+def test_o2_trains_and_tracks_o1():
+    v1 = _train_lm("O1")
+    v2 = _train_lm("O2")
+    assert v2[-1] < v2[0] * 0.9, v2  # training works
+    # same trajectory within bf16-activation noise
+    np.testing.assert_allclose(v2, v1, rtol=0.08, atol=0.05)
+
+
+def test_amp_level_validation_and_roundtrip():
+    p = fluid.Program()
+    with pytest.raises(ValueError):
+        p.enable_mixed_precision(level="O3")
+    p.enable_mixed_precision(level="O2")
+    q = fluid.Program.from_json(p.to_json())
+    assert q._amp and q._amp_level == "O2"
+
+
+def test_o2_keeps_gradient_path_and_state_fp32():
+    """Regularizer/clip/ModelAverage elementwise ops name @GRAD vars or
+    write persistable state — O2 must NOT cast them: the ModelAverage
+    accumulator must stay float32 in the scope, and training with L2
+    decay + global-norm clip must track O1 closely."""
+    def run(level):
+        mp, sp = fluid.Program(), fluid.Program()
+        mp.random_seed = sp.random_seed = 9
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope), fluid.program_guard(mp, sp):
+            with fluid.unique_name.guard():
+                x = layers.data(name="x", shape=[4, 8], dtype="float32",
+                                append_batch_size=False)
+                y = layers.data(name="y", shape=[4, 1], dtype="float32",
+                                append_batch_size=False)
+                h = layers.fc(x, 16, act="relu")
+                loss = layers.mean(
+                    layers.square_error_cost(layers.fc(h, 1), y))
+                fluid.clip.set_gradient_clip(
+                    fluid.clip.GradientClipByGlobalNorm(1.0), program=mp)
+                opt = optimizer.SGD(
+                    learning_rate=0.05,
+                    regularization=fluid.regularizer.L2Decay(1e-3))
+                opt.minimize(loss)
+                avg = optimizer.ModelAverage(0.15)
+            mp.enable_mixed_precision(level=level)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(sp)
+            rs = np.random.RandomState(2)
+            feed = {"x": rs.randn(4, 8).astype(np.float32),
+                    "y": rs.randn(4, 1).astype(np.float32)}
+            for _ in range(5):
+                (lv,) = exe.run(mp, feed=feed, fetch_list=[loss])
+            # every persistable accumulator must still be float32
+            for blk in mp.blocks:
+                for name, var in blk.vars.items():
+                    if not var.persistable:
+                        continue
+                    val = scope.find_var(name)
+                    if val is not None and hasattr(val, "dtype") \
+                            and "float" in str(val.dtype):
+                        assert str(val.dtype) == "float32", (name, val.dtype)
+        return float(lv)
+
+    l1, l2 = run("O1"), run("O2")
+    np.testing.assert_allclose(l2, l1, rtol=0.05, atol=0.02)
+
+
+def test_o2_level_survives_reenable():
+    p = fluid.Program()
+    p.enable_mixed_precision(level="O2")
+    p.enable_mixed_precision()          # no level: keep O2
+    assert p._amp_level == "O2"
+    p.enable_mixed_precision(False)     # disable: keep the level
+    p.enable_mixed_precision(True)
+    assert p._amp and p._amp_level == "O2"
