@@ -372,6 +372,57 @@ def _probe_device(timeout_s: int):
     return None
 
 
+def _bthd_smoke_gate():
+    """Crash-isolated smoke of the BTHD Pallas kernels (their first-ever
+    Mosaic compile happens on real hardware right here) with a REAL
+    device->host fence. Unless the smoke affirmatively passes, the BTHD
+    layout is disabled (PADDLE_TPU_ATTN_BTHD=0) and the model uses its
+    transposing fallback — a process-fatal kernel outcome can never take
+    the whole bench down with it. Skipped entirely when the user set
+    PADDLE_TPU_ATTN_BTHD themselves (their choice stands, and we must
+    not run a kernel they opted out of) or when the head config keeps
+    d_head off the 128-lane alignment BTHD needs. Returns None, or a
+    wedge diagnosis if the device stopped answering during the smoke."""
+    if "PADDLE_TPU_ATTN_BTHD" in _os.environ:
+        return None
+    heads_env = _os.environ.get("BENCH_HEADS")
+    if heads_env is not None and (D_MODEL // int(heads_env)) % 128 != 0:
+        return None  # BTHD cannot engage at this head config
+    import subprocess
+    import sys
+
+    plat = _os.environ.get("BENCH_PLATFORM")
+    code = (
+        "import jax, jax.numpy as jnp, numpy as np; "
+        + ("jax.config.update('jax_platforms', %r); " % plat if plat else "")
+        + ("jax.config.update('jax_compilation_cache_dir', %r); " % _CACHE_DIR)
+        + "from paddle_tpu.ops.attention import pallas_flash_attention_bthd as _f; "
+        "q = jnp.ones((1, 256, 1, 128), jnp.bfloat16); "
+        "o = _f(q, q, q, causal=True); "
+        "s = float(np.asarray(o.astype(jnp.float32)).sum()); "
+        "assert np.isfinite(s), s"
+    )
+    budget = int(_os.environ.get("BENCH_BTHD_SMOKE_TIMEOUT", 900))
+    try:
+        res = subprocess.run([sys.executable, "-c", code], timeout=budget,
+                             capture_output=True)
+    except subprocess.TimeoutExpired:
+        _os.environ["PADDLE_TPU_ATTN_BTHD"] = "0"
+        print("bench: BTHD kernel smoke timed out after %ds; disabling the "
+              "BTHD attention layout" % budget, file=_sys.stderr)
+        # a smoke timeout may ALSO mean the tunnel wedged mid-compile:
+        # re-probe so a dead device still yields the honest error JSON
+        return _probe_device(int(_os.environ.get("BENCH_PROBE_TIMEOUT", 150)))
+    if res.returncode != 0:
+        tail = res.stderr.decode(errors="replace").strip().splitlines()
+        _os.environ["PADDLE_TPU_ATTN_BTHD"] = "0"
+        print("bench: BTHD kernel smoke failed (rc %d): %s; disabling the "
+              "BTHD attention layout"
+              % (res.returncode, tail[-1][:160] if tail else "no stderr"),
+              file=_sys.stderr)
+    return None
+
+
 def main():
     probe_s = int(_os.environ.get("BENCH_PROBE_TIMEOUT", 150))
     attempts = int(_os.environ.get("BENCH_PROBE_ATTEMPTS", 2))
@@ -381,6 +432,8 @@ def main():
             problem = _probe_device(probe_s)
             if problem is None:
                 break
+    if problem is None and probe_s > 0:
+        problem = _bthd_smoke_gate()
     if problem is not None:
         print(json.dumps({
             "metric": "transformer_lm_train_tokens_per_sec_per_chip",
@@ -406,7 +459,9 @@ def main():
         "device": getattr(dev, "device_kind", dev.platform),
         "config": {"batch": lm["batch"], "seq": SEQ, "vocab": VOCAB,
                    "layers": N_LAYER, "d_model": D_MODEL,
-                   "n_head": lm["n_head"]},
+                   "n_head": lm["n_head"],
+                   "attn_bthd": _os.environ.get("PADDLE_TPU_ATTN_BTHD", "1"),
+                   "amp_level": _os.environ.get("BENCH_AMP_LEVEL", "O1")},
     }
     if _os.environ.get("BENCH_RESNET", "1") == "1":
         # flush the primary metric first: if the ResNet phase is killed
