@@ -148,3 +148,46 @@ def test_o2_level_survives_reenable():
     p.enable_mixed_precision(False)     # disable: keep the level
     p.enable_mixed_precision(True)
     assert p._amp and p._amp_level == "O2"
+
+
+def test_o2_dp_parity_on_mesh():
+    """O2 casts must commute with data-parallel sharding: the 8-way dp
+    step tracks the single-device step to fp32-reduction-order noise
+    (same seed/feeds)."""
+    from paddle_tpu.parallel import ParallelExecutor
+
+    rs = np.random.RandomState(3)
+    xs = rs.randint(0, 64, (16, 32)).astype(np.int64)
+    ys = rs.randint(0, 64, (16, 32)).astype(np.int64)
+
+    def train(parallel):
+        mp, sp = fluid.Program(), fluid.Program()
+        mp.random_seed = sp.random_seed = 13
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope), fluid.program_guard(mp, sp):
+            with fluid.unique_name.guard():
+                ids = layers.data(name="ids", shape=[-1, 32], dtype="int64",
+                                  append_batch_size=False)
+                lbl = layers.data(name="lbl", shape=[-1, 32], dtype="int64",
+                                  append_batch_size=False)
+                loss, _ = models.transformer.transformer_lm(
+                    ids, labels=lbl, vocab_size=64, n_layer=1, n_head=2,
+                    d_model=32, d_inner=64, max_len=32)
+                optimizer.Adam(learning_rate=1e-3).minimize(loss)
+            mp.enable_mixed_precision(level="O2")
+            fluid.Executor(fluid.CPUPlace()).run(sp)
+            if parallel:
+                pexe = ParallelExecutor(loss_name=loss.name,
+                                        main_program=mp, scope=scope)
+                vals = [float(np.squeeze(pexe.run(
+                    feed={"ids": xs, "lbl": ys}, fetch_list=[loss])[0]))
+                    for _ in range(3)]
+            else:
+                exe = fluid.Executor(fluid.CPUPlace())
+                vals = [float(exe.run(mp, feed={"ids": xs, "lbl": ys},
+                                      fetch_list=[loss])[0])
+                        for _ in range(3)]
+        return vals
+
+    np.testing.assert_allclose(train(True), train(False), rtol=2e-5,
+                               atol=2e-6)
