@@ -388,6 +388,31 @@ def _bthd_smoke_gate():
     heads_env = _os.environ.get("BENCH_HEADS")
     if heads_env is not None and (D_MODEL // int(heads_env)) % 128 != 0:
         return None  # BTHD cannot engage at this head config
+    # memoize the verdict across bench invocations (sweep rows, driver
+    # rerun) — one hardware truth per machine boot; without this a
+    # hanging kernel would cost every sweep row the full smoke budget
+    import hashlib
+
+    kern = _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                         "paddle_tpu", "ops", "attention.py")
+    try:
+        with open(kern, "rb") as f:
+            ktag = hashlib.md5(f.read()).hexdigest()[:10]
+    except OSError:
+        ktag = "nokern"
+    memo = "%s/ptpu_bthd_smoke_%d_%s_%s" % (
+        __import__("tempfile").gettempdir(), _os.getuid(),
+        _os.environ.get("BENCH_PLATFORM") or "device", ktag)
+    try:
+        with open(memo) as f:
+            verdict = f.read().strip()
+        if verdict == "ok":
+            return None
+        if verdict == "fail":
+            _os.environ["PADDLE_TPU_ATTN_BTHD"] = "0"
+            return None
+    except OSError:
+        pass
     import subprocess
     import sys
 
@@ -411,16 +436,33 @@ def _bthd_smoke_gate():
         print("bench: BTHD kernel smoke timed out after %ds; disabling the "
               "BTHD attention layout" % budget, file=_sys.stderr)
         # a smoke timeout may ALSO mean the tunnel wedged mid-compile:
-        # re-probe so a dead device still yields the honest error JSON
-        return _probe_device(int(_os.environ.get("BENCH_PROBE_TIMEOUT", 150)))
+        # re-probe so a dead device still yields the honest error JSON —
+        # and memoize 'fail' ONLY when the device is provably alive (a
+        # transient wedge must not poison later runs' verdict)
+        problem = _probe_device(int(_os.environ.get("BENCH_PROBE_TIMEOUT",
+                                                    150)))
+        if problem is None:
+            _write_quiet(memo, "fail")
+        return problem
     if res.returncode != 0:
         tail = res.stderr.decode(errors="replace").strip().splitlines()
         _os.environ["PADDLE_TPU_ATTN_BTHD"] = "0"
+        _write_quiet(memo, "fail")
         print("bench: BTHD kernel smoke failed (rc %d): %s; disabling the "
               "BTHD attention layout"
               % (res.returncode, tail[-1][:160] if tail else "no stderr"),
               file=_sys.stderr)
+    else:
+        _write_quiet(memo, "ok")
     return None
+
+
+def _write_quiet(path, text):
+    try:
+        with open(path, "w") as f:
+            f.write(text)
+    except OSError:
+        pass
 
 
 def main():
