@@ -54,6 +54,8 @@ run BENCH_BATCH=24 BENCH_HEADS=8 BENCH_REMAT=1
 # 6d. AMP O2: bf16 residual stream (elementwise path joins the bf16 set)
 run BENCH_BATCH=16 BENCH_AMP_LEVEL=O2
 run BENCH_BATCH=16 BENCH_HEADS=8 BENCH_AMP_LEVEL=O2
+# 6e. the plausible global optimum: all levers at once
+run BENCH_BATCH=24 BENCH_HEADS=8 BENCH_AMP_LEVEL=O2 BENCH_REMAT=1
 # 7. bigger per-chip batches (straight, then rematerialized backward)
 run BENCH_BATCH=24
 run BENCH_BATCH=24 BENCH_REMAT=1
