@@ -81,8 +81,20 @@ class InferenceTranspiler:
                 i += 1
                 continue
 
+            wvar = block._find_var_recursive(w_name)
+            if wvar is not None and not wvar.persistable:
+                # the Filter is a derived in-graph variable, not a stored
+                # parameter (e.g. the ResNet space-to-depth stem transforms
+                # its canonical 7x7 weight in-graph) — leave this BN unfused
+                i = bn_idx + 1
+                continue
+            wval = scope.find_var(w_name)
+            if wval is None:
+                raise RuntimeError(
+                    "conv filter %r has no value in scope; run the startup "
+                    "program before transpiling" % w_name)
             k, beta, mean = _bn_constants(bn)
-            w = np.asarray(scope.find_var(w_name))
+            w = np.asarray(wval)
             scope.set_var(w_name, (w * k[:, None, None, None]).astype(w.dtype))
             bn_out = bn.output("Y")[0]
 

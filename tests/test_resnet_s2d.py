@@ -48,3 +48,35 @@ def test_s2d_falls_back_on_odd_spatial():
     x = rs.randn(1, 3, 31, 31).astype(np.float32)
     out, _ = _forward(True, x)
     assert out.shape == (1, 10)
+
+
+def test_inference_transpiler_skips_s2d_stem():
+    """BN folding must skip the s2d stem (its conv Filter is a derived
+    variable, not a stored parameter) and still fold the other convs —
+    outputs unchanged."""
+    from paddle_tpu.transpiler import InferenceTranspiler
+
+    rs = np.random.RandomState(7)
+    x = rs.randn(2, 3, 64, 64).astype(np.float32)
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup.random_seed = 5
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(main_p, startup):
+        with fluid.unique_name.guard():
+            data = layers.data(name="img", shape=[2, 3, 64, 64],
+                               dtype="float32", append_batch_size=False)
+            logits = resnet.resnet_imagenet(data, class_dim=10, depth=18,
+                                            space_to_depth=True)
+        infer = main_p.clone(for_test=True)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        (before,) = exe.run(infer, feed={"img": x}, fetch_list=[logits])
+        n_bn_before = sum(op.type == "batch_norm"
+                          for op in infer.global_block().ops)
+        InferenceTranspiler().transpile(infer, scope=scope)
+        n_bn_after = sum(op.type == "batch_norm"
+                         for op in infer.global_block().ops)
+        assert n_bn_after < n_bn_before          # others folded
+        assert n_bn_after == 1                   # ONLY the stem's BN remains
+        (after,) = exe.run(infer, feed={"img": x}, fetch_list=[logits])
+    np.testing.assert_allclose(after, before, rtol=1e-4, atol=1e-5)
