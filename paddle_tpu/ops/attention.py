@@ -256,6 +256,62 @@ def _mha_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
+def _mha_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dq_ref, dk_ref, dv_ref, *, block_q, block_k,
+                          seq_k, causal, pid_axis=1):
+    """Single-pass flash backward: one sweep over (q-block, kv-block)
+    pairs computes dq (written per q-block) AND accumulates dk/dv in
+    VMEM — the dk/dv output blocks map to the same (batch, head) slice
+    for every q-block grid step, so Pallas keeps them resident and only
+    flushes when the grid moves to the next head. Versus the split
+    dq+dkv kernels this recomputes the probability tile ONCE instead of
+    twice (5 matmuls per tile instead of 7) and reads q/k/v/do once
+    instead of twice. dk/dv accumulate (and are emitted) in f32; the
+    caller casts to the primal dtype."""
+    qi = pl.program_id(pid_axis)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_ref[0] = jnp.zeros_like(dk_ref[0])
+        dv_ref[0] = jnp.zeros_like(dv_ref[0])
+
+    q = q_ref[0]       # (BQ, D), pre-scaled, input dtype (see fwd note)
+    do = do_ref[0]     # (BQ, D)
+    lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]      # (BQ,)
+    delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]  # (BQ,)
+    nkv = seq_k // block_k
+
+    def blk(j, dq):
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :]
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, qi * block_q, j * block_k)
+        p = jnp.exp(s - lse[:, None])
+        dv_ref[0, pl.ds(j * block_k, block_k), :] += jnp.dot(
+            p.T.astype(do.dtype), do, preferred_element_type=jnp.float32
+        ).astype(dv_ref.dtype)
+        dp = jnp.dot(do, vb.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dk_ref[0, pl.ds(j * block_k, block_k), :] += jnp.dot(
+            ds.T.astype(q.dtype), q, preferred_element_type=jnp.float32
+        ).astype(dk_ref.dtype)
+        return dq + jnp.dot(ds.astype(kb.dtype), kb,
+                            preferred_element_type=jnp.float32)
+
+    d = q.shape[-1]
+    if causal:
+        upper = lax.min(((qi + 1) * block_q + block_k - 1) // block_k, nkv)
+    else:
+        upper = nkv
+    dq = lax.fori_loop(0, upper, blk, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _fused_bwd_enabled() -> bool:
+    return os.environ.get("PADDLE_TPU_FLASH_FUSED_BWD", "0") == "1"
+
+
 def _mha_fwd_call(qs, k, v, causal, block_q, block_k, interpret):
     bh, t, d = qs.shape
     tk = k.shape[1]
@@ -300,6 +356,35 @@ def _pallas_mha_bwd(causal, block_q, block_k, interpret, res, do):
     tk = k.shape[1]
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)[:, None, :]  # (BH, 1, T) — see lse layout note
+
+    if _fused_bwd_enabled():
+        kernel = functools.partial(
+            _mha_bwd_fused_kernel, block_q=block_q, block_k=block_k,
+            seq_k=tk, causal=causal)
+        dq, dk, dv = pl.pallas_call(
+            kernel,
+            grid=(bh, t // block_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+                pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+                pl.BlockSpec((1, 1, t), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((1, 1, t), lambda i, j: (i, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+                pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((1, tk, d), lambda i, j: (i, 0, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, t, d), qs.dtype),
+                jax.ShapeDtypeStruct((bh, tk, d), jnp.float32),
+                jax.ShapeDtypeStruct((bh, tk, d), jnp.float32),
+            ],
+            interpret=interpret,
+        )(qs, k, v, do, lse, delta)
+        return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
     dq_kernel = functools.partial(
         _mha_dq_kernel, block_q=block_q, block_k=block_k, seq_k=tk,
@@ -415,6 +500,36 @@ def _pallas_mha_bthd_bwd(h, causal, block_q, block_k, interpret, res, do):
         do.astype(jnp.float32).reshape(b, t, h, d)
         * out.astype(jnp.float32).reshape(b, t, h, d),
         axis=-1).transpose(0, 2, 1)
+
+    if _fused_bwd_enabled():
+        kernel = functools.partial(
+            _mha_bwd_fused_kernel, block_q=block_q, block_k=block_k,
+            seq_k=tk, causal=causal, pid_axis=2)
+        dq, dk, dv = pl.pallas_call(
+            kernel,
+            grid=(b, h, t // block_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda bi, hi, qi: (bi, qi, hi)),
+                pl.BlockSpec((1, tk, d), lambda bi, hi, qi: (bi, 0, hi)),
+                pl.BlockSpec((1, tk, d), lambda bi, hi, qi: (bi, 0, hi)),
+                pl.BlockSpec((1, block_q, d), lambda bi, hi, qi: (bi, qi, hi)),
+                pl.BlockSpec((1, 1, t), lambda bi, hi, qi: (bi, hi, 0)),
+                pl.BlockSpec((1, 1, t), lambda bi, hi, qi: (bi, hi, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_q, d),
+                             lambda bi, hi, qi: (bi, qi, hi)),
+                pl.BlockSpec((1, tk, d), lambda bi, hi, qi: (bi, 0, hi)),
+                pl.BlockSpec((1, tk, d), lambda bi, hi, qi: (bi, 0, hi)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((b, t, hd), qs.dtype),
+                jax.ShapeDtypeStruct((b, tk, hd), jnp.float32),
+                jax.ShapeDtypeStruct((b, tk, hd), jnp.float32),
+            ],
+            interpret=interpret,
+        )(qs, k, v, do, lse, delta)
+        return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
     dq_kernel = functools.partial(
         _mha_dq_kernel, block_q=block_q, block_k=block_k, seq_k=tk,

@@ -284,3 +284,55 @@ def test_transformer_lm_bthd_env_parity(monkeypatch):
         return vals
 
     np.testing.assert_allclose(train("0"), train("1"), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_fused_bwd_matches_split_bwd_bhtd(causal, monkeypatch):
+    """Single-pass fused backward == split dq/dkv backward (BHTD)."""
+    from paddle_tpu.ops.attention import pallas_flash_attention
+
+    r = np.random.RandomState(11)
+    q, k, v = (jnp.asarray(r.randn(1, 2, 256, 16), jnp.float32) * 0.2
+               for _ in range(3))
+
+    def grads():
+        def loss(q, k, v):
+            o = pallas_flash_attention(q, k, v, causal=causal,
+                                       block_q=128, block_k=64,
+                                       interpret=True)
+            return jnp.sum(jnp.sin(o))
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    monkeypatch.delenv("PADDLE_TPU_FLASH_FUSED_BWD", raising=False)
+    g_split = grads()
+    monkeypatch.setenv("PADDLE_TPU_FLASH_FUSED_BWD", "1")
+    g_fused = grads()
+    for a, b in zip(g_fused, g_split):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_fused_bwd_matches_split_bwd_bthd(causal, monkeypatch):
+    """Single-pass fused backward == split backward (BTHD layout)."""
+    from paddle_tpu.ops.attention import pallas_flash_attention_bthd
+
+    r = np.random.RandomState(12)
+    q, k, v = (jnp.asarray(r.randn(2, 256, 2, 128), jnp.float32) * 0.1
+               for _ in range(3))
+
+    def grads():
+        def loss(q, k, v):
+            o = pallas_flash_attention_bthd(q, k, v, causal=causal,
+                                            block_q=128, block_k=128,
+                                            interpret=True)
+            return jnp.sum(jnp.sin(o))
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    monkeypatch.delenv("PADDLE_TPU_FLASH_FUSED_BWD", raising=False)
+    g_split = grads()
+    monkeypatch.setenv("PADDLE_TPU_FLASH_FUSED_BWD", "1")
+    g_fused = grads()
+    for a, b in zip(g_fused, g_split):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)

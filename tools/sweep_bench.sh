@@ -54,8 +54,12 @@ run BENCH_BATCH=24 BENCH_HEADS=8 BENCH_REMAT=1
 # 6d. AMP O2: bf16 residual stream (elementwise path joins the bf16 set)
 run BENCH_BATCH=16 BENCH_AMP_LEVEL=O2
 run BENCH_BATCH=16 BENCH_HEADS=8 BENCH_AMP_LEVEL=O2
-# 6e. the plausible global optimum: all levers at once
-run BENCH_BATCH=24 BENCH_HEADS=8 BENCH_AMP_LEVEL=O2 BENCH_REMAT=1
+# 6e. single-pass fused flash backward (5 matmuls/tile instead of 7,
+# one input read instead of two)
+run BENCH_BATCH=16 PADDLE_TPU_FLASH_FUSED_BWD=1
+run BENCH_BATCH=16 BENCH_HEADS=8 PADDLE_TPU_FLASH_FUSED_BWD=1
+# 6f. the plausible global optimum: all levers at once
+run BENCH_BATCH=24 BENCH_HEADS=8 BENCH_AMP_LEVEL=O2 BENCH_REMAT=1 PADDLE_TPU_FLASH_FUSED_BWD=1
 # 7. bigger per-chip batches (straight, then rematerialized backward)
 run BENCH_BATCH=24
 run BENCH_BATCH=24 BENCH_REMAT=1
