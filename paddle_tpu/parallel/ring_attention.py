@@ -59,7 +59,11 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     B, H, T, Dh = q.shape
     if scale is None:
         scale = Dh ** -0.5
-    qf = q.astype(jnp.float32) * scale
+    # fold the scale into q and KEEP the input dtype: under bf16 AMP the
+    # score einsum then runs bf16 x bf16 -> f32 on the MXU (full rate,
+    # f32 accumulation via preferred_element_type) — same recipe as the
+    # flash kernels; with f32 inputs this is numerically unchanged.
+    qs = (q * jnp.asarray(scale, q.dtype)).astype(q.dtype)
 
     # kv rotates "forward" (device i -> i+1), so at step s device i holds
     # the block originally resident on (i - s) mod size.
@@ -69,7 +73,8 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     def body(s, carry):
         kc, vc, m, num, den = carry
         kv_blk = (my_blk - s) % size
-        scores = jnp.einsum("bhqd,bhkd->bhqk", qf, kc.astype(jnp.float32))
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qs, kc,
+                            preferred_element_type=jnp.float32)
         if causal:
             k_pos = kv_blk * T + jnp.arange(T)
             keep = q_pos[:, None] >= k_pos[None, :]  # (T, T)
@@ -82,7 +87,8 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
             p = jnp.where(scores <= _NEG / 2, 0.0, p)
         corr = jnp.exp(m - m_new)
         num = num * corr[..., None] + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, vc.astype(jnp.float32))
+            "bhqk,bhkd->bhqd", p.astype(vc.dtype), vc,
+            preferred_element_type=jnp.float32)
         den = den * corr + p.sum(axis=-1)
         kc = lax.ppermute(kc, axis_name, perm=fwd)
         vc = lax.ppermute(vc, axis_name, perm=fwd)
