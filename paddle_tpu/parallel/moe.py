@@ -96,16 +96,27 @@ def moe_ffn_local(x, params: MoEParams, capacity_factor: float = 1.25,
     n = tokens.shape[0]
     e = params.gate_w.shape[-1]
     cap = moe_capacity(n, e, capacity_factor)
-    dispatch, combine = _dispatch_tensors(tokens @ params.gate_w, e, cap, k)
-    expert_in = jnp.einsum("nec,nd->ecd", dispatch,
-                           tokens.astype(jnp.float32))
+    # the ROUTER always runs f32 (GShard/Switch practice): a bf16 gate
+    # logit can flip a top-k selection near a decision boundary, which is
+    # a discrete output change, not rounding noise. The (N, E) matmul is
+    # negligible next to the expert FFNs.
+    dispatch, combine = _dispatch_tensors(
+        tokens.astype(jnp.float32) @ params.gate_w, e, cap, k)
+    # expert matmuls run in the input dtype with f32 accumulation (bf16 MXU full
+    # rate under AMP; no-op for f32 inputs); gating/softmax stays f32
+    xdt = tokens.dtype
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(xdt), tokens,
+                           preferred_element_type=jnp.float32).astype(xdt)
     h = activation(jnp.einsum("ecd,edf->ecf", expert_in,
-                              params.w1.astype(jnp.float32))
+                              params.w1.astype(xdt),
+                              preferred_element_type=jnp.float32)
                    + params.b1[:, None, :])
-    expert_out = jnp.einsum("ecf,efd->ecd", h,
-                            params.w2.astype(jnp.float32)) \
-        + params.b2[:, None, :]
-    out = jnp.einsum("nec,ecd->nd", combine, expert_out)
+    expert_out = (jnp.einsum("ecf,efd->ecd", h.astype(xdt),
+                             params.w2.astype(xdt),
+                             preferred_element_type=jnp.float32)
+                  + params.b2[:, None, :]).astype(xdt)
+    out = jnp.einsum("nec,ecd->nd", combine,  # combine is already f32
+                     expert_out.astype(jnp.float32))
     return out.astype(x.dtype).reshape(lead + (d,))
 
 
@@ -139,24 +150,34 @@ def expert_parallel_ffn(x, params: MoEParams, mesh: Mesh, axis: str = "ep",
         tokens = x_local.reshape(-1, d)
         n_loc = tokens.shape[0]
         cap = moe_capacity(n_loc, e, capacity_factor)
-        dispatch, combine = _dispatch_tensors(tokens @ p.gate_w, e, cap, k)
-        expert_in = jnp.einsum("nec,nd->ecd", dispatch,
-                               tokens.astype(jnp.float32))  # (E, C, D)
+        # router in f32 (see moe_ffn_local)
+        dispatch, combine = _dispatch_tensors(
+            tokens.astype(jnp.float32) @ p.gate_w, e, cap, k)
+        # expert buffers stay in the input dtype: the two all_to_alls move
+        # HALF the ICI bytes under bf16, and the matmuls run bf16 MXU with
+        # f32 accumulation (no-op for f32 inputs; gating stays f32)
+        xdt = tokens.dtype
+        expert_in = jnp.einsum(
+            "nec,nd->ecd", dispatch.astype(xdt), tokens,
+            preferred_element_type=jnp.float32).astype(xdt)  # (E, C, D)
         # exchange: split the expert dim across devices, concat the
         # gathered shards along capacity -> (E/n, n*C, D) on each device
         expert_in = lax.all_to_all(expert_in, axis, split_axis=0,
                                    concat_axis=1, tiled=True)
         h = activation(jnp.einsum("ecd,edf->ecf", expert_in,
-                                  p.w1.astype(jnp.float32))
+                                  p.w1.astype(xdt),
+                                  preferred_element_type=jnp.float32)
                        + p.b1[:, None, :])
-        expert_out = jnp.einsum("ecf,efd->ecd", h,
-                                p.w2.astype(jnp.float32)) \
-            + p.b2[:, None, :]
+        expert_out = (jnp.einsum("ecf,efd->ecd", h.astype(xdt),
+                                 p.w2.astype(xdt),
+                                 preferred_element_type=jnp.float32)
+                      + p.b2[:, None, :]).astype(xdt)
         # reverse exchange: back to (E, C, D) rows owned by this device's
         # tokens
         expert_out = lax.all_to_all(expert_out, axis, split_axis=1,
                                     concat_axis=0, tiled=True)
-        out = jnp.einsum("nec,ecd->nd", combine, expert_out)
+        out = jnp.einsum("nec,ecd->nd", combine,  # combine is already f32
+                         expert_out.astype(jnp.float32))
         return out.astype(x_local.dtype).reshape(lead + (d,))
 
     # the replication/VMA check is disabled: with replicated tokens
