@@ -46,6 +46,7 @@ def full_attention(q, k, v, causal: bool = False, scale: Optional[float] = None)
     return jnp.einsum("bhqk,bhkd->bhqd", weights.astype(v.dtype), v)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def ring_attention(q, k, v, axis_name: str, causal: bool = False,
                    scale: Optional[float] = None):
     """Blockwise-exact attention inside a shard_map body.
@@ -53,9 +54,41 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     q, k, v: (B, H, T_local, Dh) — the local sequence shard; the global
     sequence is the concatenation over `axis_name` in axis-index order.
     Accumulates in fp32 regardless of input dtype (bf16-safe).
+
+    Differentiable with O(T_local) residuals: the custom backward saves
+    only (q, k, v, out, lse) and RE-ROTATES K/V around the ring,
+    recomputing each block's probabilities from the logsumexp — dK/dV
+    accumulators travel with their blocks and arrive home after the
+    full cycle. Plain autodiff would instead save every rotation's
+    (T_local, T_local) probability tensor (O(size * T_local^2), i.e.
+    the full (T, T) ring attention exists to avoid).
     """
+    out, _ = _ring_fwd_impl(q, k, v, axis_name, causal, scale)
+    return out
+
+
+def _ring_steps(axis_name):
     size = lax.psum(1, axis_name)
     my_blk = lax.axis_index(axis_name)
+    fwd = [(i, (i + 1) % size) for i in range(size)]
+    return int(size), my_blk, fwd
+
+
+def _block_scores(qs, kc, kv_blk, q_pos, T, causal):
+    """(B, H, T, T) f32 scores of the local q shard against a visiting
+    K block, causal-masked by GLOBAL positions; bf16 inputs run on the
+    MXU at full rate (f32 accumulation)."""
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qs, kc,
+                        preferred_element_type=jnp.float32)
+    if causal:
+        k_pos = kv_blk * T + jnp.arange(T)
+        keep = q_pos[:, None] >= k_pos[None, :]  # (T, T)
+        scores = jnp.where(keep[None, None], scores, _NEG)
+    return scores
+
+
+def _ring_fwd_impl(q, k, v, axis_name, causal, scale):
+    size, my_blk, fwd = _ring_steps(axis_name)
     B, H, T, Dh = q.shape
     if scale is None:
         scale = Dh ** -0.5
@@ -64,21 +97,14 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     # f32 accumulation via preferred_element_type) — same recipe as the
     # flash kernels; with f32 inputs this is numerically unchanged.
     qs = (q * jnp.asarray(scale, q.dtype)).astype(q.dtype)
+    q_pos = my_blk * T + jnp.arange(T)  # global query positions
 
     # kv rotates "forward" (device i -> i+1), so at step s device i holds
     # the block originally resident on (i - s) mod size.
-    fwd = [(i, (i + 1) % size) for i in range(size)]
-    q_pos = my_blk * T + jnp.arange(T)  # global query positions
-
     def body(s, carry):
         kc, vc, m, num, den = carry
         kv_blk = (my_blk - s) % size
-        scores = jnp.einsum("bhqd,bhkd->bhqk", qs, kc,
-                            preferred_element_type=jnp.float32)
-        if causal:
-            k_pos = kv_blk * T + jnp.arange(T)
-            keep = q_pos[:, None] >= k_pos[None, :]  # (T, T)
-            scores = jnp.where(keep[None, None], scores, _NEG)
+        scores = _block_scores(qs, kc, kv_blk, q_pos, T, causal)
         m_new = jnp.maximum(m, scores.max(axis=-1))
         # rows where everything so far is masked keep m=_NEG; exp(score-m)
         # would be exp(0)=1 there, so zero masked terms explicitly.
@@ -103,10 +129,68 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     # unrolled python loop (size is static): lets XLA overlap each step's
     # einsums with the next ppermute's ICI transfer.
     kc, vc, m, num, den = init
-    for s in range(int(size)):
+    for s in range(size):
         kc, vc, m, num, den = body(s, (kc, vc, m, num, den))
-    out = num / jnp.maximum(den[..., None], 1e-30)
-    return out.astype(q.dtype)
+    den = jnp.maximum(den, 1e-30)
+    out = (num / den[..., None]).astype(q.dtype)
+    lse = m + jnp.log(den)  # (B, H, T) f32; fully-masked rows: ~_NEG
+    return out, lse
+
+
+def _ring_fwd(q, k, v, axis_name, causal, scale):
+    out, lse = _ring_fwd_impl(q, k, v, axis_name, causal, scale)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_bwd(axis_name, causal, scale, res, dout):
+    q, k, v, out, lse = res
+    size, my_blk, fwd = _ring_steps(axis_name)
+    B, H, T, Dh = q.shape
+    if scale is None:
+        scale = Dh ** -0.5
+    qs = (q * jnp.asarray(scale, q.dtype)).astype(q.dtype)
+    q_pos = my_blk * T + jnp.arange(T)
+    do = dout
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)  # (B, H, T)
+
+    def body(s, carry):
+        kc, vc, dkc, dvc, dq = carry
+        kv_blk = (my_blk - s) % size
+        scores = _block_scores(qs, kc, kv_blk, q_pos, T, causal)
+        # p = softmax weights reconstructed from the saved logsumexp;
+        # masked entries give exp(_NEG - lse) == 0 exactly
+        p = jnp.exp(scores - lse[..., None])
+        dv_step = jnp.einsum("bhqk,bhqd->bhkd", p.astype(do.dtype), do,
+                             preferred_element_type=jnp.float32)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", do, vc,
+                        preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[..., None])
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds.astype(kc.dtype), kc,
+                             preferred_element_type=jnp.float32)
+        dk_step = jnp.einsum("bhqk,bhqd->bhkd", ds.astype(qs.dtype), qs,
+                             preferred_element_type=jnp.float32)
+        # the dK/dV accumulators TRAVEL WITH their blocks: after the full
+        # cycle each block is home again carrying every device's
+        # contribution
+        dkc = lax.ppermute(dkc + dk_step, axis_name, perm=fwd)
+        dvc = lax.ppermute(dvc + dv_step, axis_name, perm=fwd)
+        kc = lax.ppermute(kc, axis_name, perm=fwd)
+        vc = lax.ppermute(vc, axis_name, perm=fwd)
+        return kc, vc, dkc, dvc, dq
+
+    zero_kv = jnp.zeros((B, H, T, Dh), jnp.float32)
+    carry = (k, v, zero_kv, zero_kv,
+             jnp.zeros((B, H, T, Dh), jnp.float32))
+    for s in range(size):
+        carry = body(s, carry)
+    _, _, dkc, dvc, dq = carry
+    # d(qs)/dq = scale (the fold at the top)
+    dq = dq * jnp.asarray(scale, jnp.float32)
+    return (dq.astype(q.dtype), dkc.astype(k.dtype), dvc.astype(v.dtype))
+
+
+ring_attention.defvjp(_ring_fwd, _ring_bwd)
 
 
 def ring_self_attention(q, k, v, mesh: Mesh, sp_axis: str = "sp",
