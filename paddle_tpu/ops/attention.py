@@ -35,8 +35,10 @@ from jax import lax
 
 try:  # pallas import kept optional: CPU-only environments still work
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 except ImportError:  # pragma: no cover
     pl = None
+    pltpu = None
 
 from .registry import register_op
 
@@ -134,6 +136,27 @@ def flash_attention(q, k, v, causal=False, scale=None, lengths=None,
 # VMEM: the forward saves only (out, logsumexp); the backward recomputes
 # score tiles blockwise, flash-attention style.
 # ---------------------------------------------------------------------------
+
+
+def _tpu_params(*dimension_semantics):
+    """compiler_params kwargs marking grid axes "parallel" (Mosaic may
+    split them across megacore on v4/v5p) or "arbitrary" (sequential —
+    REQUIRED for axes whose output blocks are revisited/accumulated:
+    the lse row in the fwd kernel, dk/dv in the fused backward). No-op
+    when the TPU pallas backend is unavailable (interpret-mode tests).
+    """
+    if pltpu is None:
+        return {}
+    if os.environ.get("PADDLE_TPU_DIM_SEMANTICS", "1") == "0":
+        return {}  # kill-switch: restores the pre-semantics kernels
+    # CompilerParams was TPUCompilerParams before jax 0.6.1; degrade to
+    # no semantics (not an error) on jax versions with neither
+    cp = getattr(pltpu, "CompilerParams",
+                 getattr(pltpu, "TPUCompilerParams", None))
+    if cp is None:
+        return {}
+    return {"compiler_params": cp(
+        dimension_semantics=tuple(dimension_semantics))}
 
 
 def _causal_mask(s, row0, col0):
@@ -335,6 +358,7 @@ def _mha_fwd_call(qs, k, v, causal, block_q, block_k, interpret):
             jax.ShapeDtypeStruct((bh, 1, t), jnp.float32),
         ],
         interpret=interpret,
+        **_tpu_params("parallel", "arbitrary"),
     )(qs, k, v)
 
 
@@ -383,6 +407,7 @@ def _pallas_mha_bwd(causal, block_q, block_k, interpret, res, do):
                 jax.ShapeDtypeStruct((bh, tk, d), jnp.float32),
             ],
             interpret=interpret,
+            **_tpu_params("parallel", "arbitrary"),
         )(qs, k, v, do, lse, delta)
         return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
@@ -403,6 +428,7 @@ def _pallas_mha_bwd(causal, block_q, block_k, interpret, res, do):
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), qs.dtype),
         interpret=interpret,
+        **_tpu_params("parallel", "parallel"),
     )(qs, k, v, do, lse, delta)
 
     dkv_kernel = functools.partial(
@@ -428,6 +454,7 @@ def _pallas_mha_bwd(causal, block_q, block_k, interpret, res, do):
             jax.ShapeDtypeStruct((bh, tk, d), v.dtype),
         ],
         interpret=interpret,
+        **_tpu_params("parallel", "parallel"),
     )(qs, k, v, do, lse, delta)
     return dq, dk, dv
 
@@ -472,6 +499,7 @@ def _mha_fwd_call_bthd(qs, k, v, h, causal, block_q, block_k, interpret):
             jax.ShapeDtypeStruct((b, h, t), jnp.float32),
         ],
         interpret=interpret,
+        **_tpu_params("parallel", "parallel", "arbitrary"),
     )(qs, k, v)
 
 
@@ -528,6 +556,7 @@ def _pallas_mha_bthd_bwd(h, causal, block_q, block_k, interpret, res, do):
                 jax.ShapeDtypeStruct((b, tk, hd), jnp.float32),
             ],
             interpret=interpret,
+            **_tpu_params("parallel", "parallel", "arbitrary"),
         )(qs, k, v, do, lse, delta)
         return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
@@ -549,6 +578,7 @@ def _pallas_mha_bthd_bwd(h, causal, block_q, block_k, interpret, res, do):
                                lambda bi, hi, qi: (bi, qi, hi)),
         out_shape=jax.ShapeDtypeStruct((b, t, hd), qs.dtype),
         interpret=interpret,
+        **_tpu_params("parallel", "parallel", "parallel"),
     )(qs, k, v, do, lse, delta)
 
     dkv_kernel = functools.partial(
@@ -574,6 +604,7 @@ def _pallas_mha_bthd_bwd(h, causal, block_q, block_k, interpret, res, do):
             jax.ShapeDtypeStruct((b, tk, hd), v.dtype),
         ],
         interpret=interpret,
+        **_tpu_params("parallel", "parallel", "parallel"),
     )(qs, k, v, do, lse, delta)
     return dq, dk, dv
 
