@@ -6,8 +6,11 @@ _enable_compile_cache()
 import paddle_tpu as fluid
 from paddle_tpu import layers, models, optimizer
 
-B,S,V,L,D,F,H = (int(os.environ.get("BENCH_BATCH", 8)),1024,32768,12,1024,4096,
-                 int(os.environ.get("BENCH_HEADS", 16)))
+_e = os.environ.get
+B,S,V,L,D,F,H = (int(_e("BENCH_BATCH", 8)), int(_e("BENCH_SEQ", 1024)),
+                 int(_e("BENCH_VOCAB", 32768)), int(_e("BENCH_LAYERS", 12)),
+                 int(_e("BENCH_DMODEL", 1024)), int(_e("BENCH_DINNER", 4096)),
+                 int(_e("BENCH_HEADS", 16)))
 main_p, startup = fluid.Program(), fluid.Program()
 main_p.random_seed = startup.random_seed = 1
 scope = fluid.Scope()
@@ -17,17 +20,22 @@ with fluid.scope_guard(scope), fluid.program_guard(main_p, startup):
         lbl = layers.data(name="labels", shape=[B,S], dtype="int64", append_batch_size=False)
         loss, _ = models.transformer.transformer_lm(ids, lbl, vocab_size=V, n_layer=L, n_head=H, d_model=D, d_inner=F, max_len=S)
         optimizer.Adam(learning_rate=1e-4).minimize(loss)
-    main_p.enable_mixed_precision()
+    if _e("BENCH_AMP", "1") == "1":
+        main_p.enable_mixed_precision(level=_e("BENCH_AMP_LEVEL", "O1"))
     exe = fluid.Executor(fluid.TPUPlace())
     exe.run(startup)
     r = np.random.RandomState(0)
     feed = {"ids": r.randint(0,V,(B,S)).astype(np.int64),
             "labels": r.randint(0,V,(B,S)).astype(np.int64)}
-    for _ in range(3):
-        exe.run(main_p, feed=feed, fetch_list=[])
+    # warm + compile the loop executable, then trace one 6-step window.
+    # The fence is a REAL device->host fetch: on the axon backend
+    # jax.block_until_ready returns without waiting, so fencing with it
+    # would stop the trace before the device executed anything.
+    out = exe.run_loop(main_p, feed=feed, fetch_list=[loss],
+                       steps=2, return_numpy=False)
+    float(np.asarray(out[0]).reshape(-1)[0])
     with jax.profiler.trace("/tmp/jaxprof"):
-        for _ in range(3):
-            exe.run(main_p, feed=feed, fetch_list=[])
-        import jax.numpy as jnp
-        jax.block_until_ready(scope.find_var("lm.head.w"))
+        out = exe.run_loop(main_p, feed=feed, fetch_list=[loss],
+                           steps=6, return_numpy=False)
+        float(np.asarray(out[0]).reshape(-1)[0])
 print(glob.glob("/tmp/jaxprof/**/*.xplane.pb", recursive=True))
