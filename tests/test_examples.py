@@ -51,3 +51,10 @@ def test_train_lm_example_pipeline():
                 "--no-amp"],
                env_extra={"XLA_FLAGS": flags})
     assert "tokens/s" in out
+
+
+def test_train_lm_example_loop_mode():
+    out = _run(["examples/train_lm.py", "--layers", "1", "--d-model", "64",
+                "--seq", "128", "--vocab", "256", "--batch", "2",
+                "--steps", "3", "--no-amp", "--loop"])
+    assert "tokens/s" in out
