@@ -1,0 +1,83 @@
+"""Unit tests for bench.py's measurement scaffolding: the slope-timing
+math, its degenerate-timing fallback, and the head-config ladder's
+fallback rules. The driver's headline number flows through these."""
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+import bench  # noqa: E402
+
+
+def _fake_clock(monkeypatch, times):
+    it = iter(times)
+    monkeypatch.setattr(bench.time, "perf_counter", lambda: next(it))
+
+
+def _runner(log):
+    def run_loop(k):
+        log.append(k)
+        return [np.asarray([1.5])]
+    return run_loop
+
+
+def test_timed_loop_slope(monkeypatch):
+    log = []
+    # timed windows: T(12) = 10s, T(24) = 16s -> slope (16-10)/12 = 0.5
+    _fake_clock(monkeypatch, [100.0, 110.0, 200.0, 216.0])
+    dt, loss = bench._timed_loop(_runner(log), warmup=3, steps=12)
+    assert log == [3, 12, 24]  # warmup window, then k and 2k
+    assert abs(dt - 0.5) < 1e-9
+    assert loss == 1.5
+
+
+def test_timed_loop_negative_slope_falls_back(monkeypatch):
+    log = []
+    # noise: T(12) = 10s but T(24) = 8s -> slope negative -> fall back
+    # to the conservative average t2 / (2 * steps)
+    _fake_clock(monkeypatch, [0.0, 10.0, 50.0, 58.0])
+    dt, _ = bench._timed_loop(_runner(log), warmup=1, steps=12)
+    assert abs(dt - 8.0 / 24.0) < 1e-9
+
+
+def test_head_ladder_falls_back_on_kernel_error(monkeypatch):
+    calls = []
+
+    def fake_bench_lm(dev, batch, n_head=None):
+        calls.append((batch, n_head))
+        if n_head == 8:
+            raise RuntimeError("Mosaic rejected the kernel")
+        return {"value": 1.0, "mfu": 0.4, "step_ms": 1.0, "loss": 1.0,
+                "batch": batch, "n_head": n_head}
+
+    monkeypatch.setattr(bench, "bench_lm", fake_bench_lm)
+    monkeypatch.delenv("BENCH_BATCH", raising=False)
+    monkeypatch.delenv("BENCH_HEADS", raising=False)
+    out = bench.bench_lm_ladder(dev=None)
+    assert out["n_head"] == 16
+    assert (16, 8) in calls  # tried the d_head-128 config first
+
+
+def test_head_ladder_propagates_oom(monkeypatch):
+    def fake_bench_lm(dev, batch, n_head=None):
+        raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+
+    monkeypatch.setattr(bench, "bench_lm", fake_bench_lm)
+    monkeypatch.delenv("BENCH_BATCH", raising=False)
+    monkeypatch.delenv("BENCH_HEADS", raising=False)
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        bench.bench_lm_ladder(dev=None)  # heads don't change memory
+
+
+def test_head_ladder_respects_explicit_heads(monkeypatch):
+    def fake_bench_lm(dev, batch, n_head=None):
+        return {"value": 1.0, "mfu": 0.4, "step_ms": 1.0, "loss": 1.0,
+                "batch": batch, "n_head": n_head}
+
+    monkeypatch.setattr(bench, "bench_lm", fake_bench_lm)
+    monkeypatch.setenv("BENCH_HEADS", "16")
+    monkeypatch.setattr(bench, "N_HEAD", 16)
+    monkeypatch.delenv("BENCH_BATCH", raising=False)
+    out = bench.bench_lm_ladder(dev=None)
+    assert out["n_head"] == 16
