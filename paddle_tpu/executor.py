@@ -169,7 +169,14 @@ class Executor:
         self.check_nan_inf = check_nan_inf
         self._cache: Dict = {}
         self._read_ops: Dict = {}
-        self._step = 0
+        # per-PROGRAM step counters (the RNG stream fold): running one
+        # program (e.g. startup) must not advance another program's
+        # stochastic-op stream, or the same training program draws
+        # different dropout masks depending on what else this Executor
+        # ran before — and can never be parity-tested against a
+        # ParallelExecutor, whose counter is program-bound from step 0
+        self._steps: Dict[int, int] = {}
+        self._last_step = 0  # most recent step index (error messages)
         self._seed = 0
         self._base_keys: Dict = {}
 
@@ -304,9 +311,17 @@ class Executor:
         if bad:
             raise FloatingPointError(
                 "NaN/Inf detected after step %d in: %s (check_nan_inf mode)"
-                % (self._step - 1, ", ".join(bad)))
+                % (self._last_step, ", ".join(bad)))
 
     # -- shared run plumbing ---------------------------------------------
+    def _next_steps(self, program: Program, n: int) -> int:
+        """Reserve `n` step indices on `program`'s OWN stream and return
+        the first; see the _steps comment in __init__."""
+        cur = self._steps.get(id(program), 0)
+        self._steps[id(program)] = cur + n
+        self._last_step = cur + n - 1
+        return cur
+
     def _read_ops_for(self, program: Program, gb):
         """(Static) read-op list, cached per program version so the hot
         path does not rescan every op each step."""
@@ -427,8 +442,7 @@ class Executor:
 
         state = self._gather_state(compiled, scope)
         rng_key = self._rng_for(program)
-        step = np.uint32(self._step)
-        self._step += 1
+        step = np.uint32(self._next_steps(program, 1))
 
         if profiler.is_profiling():
             # jax.jit is lazy: trace + XLA compile all happen inside the
@@ -586,8 +600,7 @@ class Executor:
 
         state = self._gather_state(compiled, scope)
         rng_key = self._rng_for(program)
-        step0 = np.uint32(self._step)
-        self._step += effective_steps
+        step0 = np.uint32(self._next_steps(program, effective_steps))
 
         if profiler.is_profiling():
             label = ("trace+compile+run_loop" if first_run else "run_loop")

@@ -465,6 +465,7 @@ _TRAIN_TEST_OPS = {
     "dropout": ("is_test",),
     "batch_norm": ("is_test",),
     "fused_attention": ("is_test",),  # attention dropout off at test time
+    "ring_attention": ("is_test",),  # same: dropout off at test time
 }
 
 # -- default programs ----------------------------------------------------

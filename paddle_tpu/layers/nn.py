@@ -2079,20 +2079,28 @@ def fused_attention(q, k, v, causal=False, scale=None, sequence_length=None,
 
 
 def ring_attention(q, k, v, causal=False, scale=None, sp_axis="sp",
-                   name=None):
+                   lengths=None, dropout_rate=0.0, name=None):
     """Sequence-parallel exact attention over (B, H, T, Dh) tensors: under
     a ParallelExecutor whose mesh has `sp_axis`, K/V blocks rotate on the
     ICI ring (lax.ppermute) so each chip keeps an O(T/N) sequence shard —
     the long-context path (kernel: ops/attention.py ring_attention; math:
     parallel/ring_attention.py). Falls back to exact full attention on a
-    single device, so the Program is portable."""
+    single device, so the Program is portable. `lengths` (B,) masks
+    padded KV positions; `dropout_rate` applies attention-probability
+    dropout with a sharding-independent mask (ring == single-device
+    exactly, matching the reference attention's dropout_rate at
+    /root/reference/python/paddle/fluid/nets.py:332)."""
     helper = LayerHelper("ring_attention", name=name)
     out = helper.create_variable_for_type_inference(q.dtype, shape=q.shape)
+    inputs = {"Q": [q], "K": [k], "V": [v]}
+    if lengths is not None:
+        inputs["Lengths"] = [lengths]
     helper.append_op(
         type="ring_attention",
-        inputs={"Q": [q], "K": [k], "V": [v]},
+        inputs=inputs,
         outputs={"Out": [out]},
-        attrs={"causal": causal, "scale": scale, "sp_axis": sp_axis},
+        attrs={"causal": causal, "scale": scale, "sp_axis": sp_axis,
+               "dropout_rate": dropout_rate},
     )
     return out
 

@@ -92,12 +92,9 @@ def multi_head_attention(
         v = split_heads(v, Tk)
 
     if use_ring:
-        if kv_lengths is not None or dropout_rate:
-            raise NotImplementedError(
-                "ring attention path supports neither KV padding masks nor "
-                "attention dropout yet; pad to full length / move dropout "
-                "outside attention")
-        ctx = layers.ring_attention(q, k, v, causal=causal, sp_axis=sp_axis)
+        ctx = layers.ring_attention(q, k, v, causal=causal, sp_axis=sp_axis,
+                                    lengths=kv_lengths,
+                                    dropout_rate=dropout_rate)
     elif use_fused:
         ctx = layers.fused_attention(
             q, k, v, causal=causal, sequence_length=kv_lengths,

@@ -773,25 +773,38 @@ def _use_pallas(t, tk, lengths, dropout_rate) -> bool:
 @register_op("ring_attention")
 def _ring_attention_op(ctx):
     """Sequence-parallel exact attention (SURVEY §2 long-context
-    commitment; no reference twin). Inputs Q,K,V: (B, H, T, Dh). When the
-    step is traced under a mesh whose `sp_axis` exists and is >1 wide
-    (ParallelExecutor sets framework.trace.mesh_context), the kernel runs
-    the ppermute ring (parallel/ring_attention.py) so each device holds an
-    O(T/N) sequence shard; otherwise it falls back to exact full
-    attention, so the same Program runs unchanged on one chip."""
+    commitment; no reference twin). Inputs Q,K,V: (B, H, T, Dh), optional
+    Lengths (B,) global KV lengths; attrs causal, scale, sp_axis,
+    dropout_rate. When the step is traced under a mesh whose `sp_axis`
+    exists and is >1 wide (ParallelExecutor sets
+    framework.trace.mesh_context), the kernel runs the ppermute ring
+    (parallel/ring_attention.py) so each device holds an O(T/N) sequence
+    shard; otherwise it falls back to exact full attention. Dropout masks
+    are position-stable (keyed on global coordinates), so the two
+    dispatches stay numerically identical — the same Program produces
+    the same losses on one chip and on an sp mesh."""
     from ..framework.trace import current_trace_mesh
     from ..parallel.ring_attention import full_attention, ring_self_attention
 
     q, k, v = ctx.input("Q"), ctx.input("K"), ctx.input("V")
+    lengths = ctx.input("Lengths")
     causal = bool(ctx.attr("causal", False))
     scale = ctx.attr("scale", None)
     sp_axis = ctx.attr("sp_axis", "sp")
+    dropout_rate = float(ctx.attr("dropout_rate", 0.0) or 0.0)
+    if ctx.is_test:
+        dropout_rate = 0.0
+    seed = (jax.random.key_data(ctx.rng()).astype(jnp.uint32)
+            if dropout_rate else None)
     mesh = current_trace_mesh()
     if (mesh is not None and sp_axis in mesh.axis_names
             and mesh.shape[sp_axis] > 1):
-        return {"Out": ring_self_attention(q, k, v, mesh, sp_axis=sp_axis,
-                                           causal=causal, scale=scale)}
-    return {"Out": full_attention(q, k, v, causal=causal, scale=scale)}
+        return {"Out": ring_self_attention(
+            q, k, v, mesh, sp_axis=sp_axis, causal=causal, scale=scale,
+            lengths=lengths, dropout_rate=dropout_rate, dropout_seed=seed)}
+    return {"Out": full_attention(
+        q, k, v, causal=causal, scale=scale, lengths=lengths,
+        dropout_rate=dropout_rate, dropout_seed=seed)}
 
 
 @register_op("moe_ffn")

@@ -317,9 +317,12 @@ def _layer_norm(ctx):
         y = y * ctx.input("Scale").reshape(x.shape[begin:]).astype(jnp.float32)
     if ctx.has_input("Bias"):
         y = y + ctx.input("Bias").reshape(x.shape[begin:]).astype(jnp.float32)
+    # stats are COMPUTED in f32 (above) but returned in the input dtype:
+    # the declared Mean/Variance output variables inherit X's dtype, and a
+    # consumer of those outputs must see the dtype the IR declares
     return {"Y": y.astype(x.dtype),
-            "Mean": mean.reshape(x.shape[:begin]),
-            "Variance": var.reshape(x.shape[:begin])}
+            "Mean": mean.reshape(x.shape[:begin]).astype(x.dtype),
+            "Variance": var.reshape(x.shape[:begin]).astype(x.dtype)}
 
 
 @register_op("lrn")

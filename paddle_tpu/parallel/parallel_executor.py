@@ -23,7 +23,6 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..executor import analyze_state, build_step_fn, _as_feed_array, _fetch_name

@@ -20,6 +20,8 @@ from __future__ import annotations
 import functools
 from typing import Optional
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -30,30 +32,98 @@ from ._compat import shard_map
 __all__ = ["ring_attention", "ring_self_attention", "full_attention"]
 
 _NEG = -1e30
+_U = np.uint32
 
 
-def full_attention(q, k, v, causal: bool = False, scale: Optional[float] = None):
+def _mix32(x):
+    """lowbias32 avalanche finalizer on uint32 lattices (public-domain
+    integer-hash constants); statistically fine for dropout bits."""
+    x = x ^ (x >> _U(16))
+    x = x * _U(0x7FEB352D)
+    x = x ^ (x >> _U(15))
+    x = x * _U(0x846CA68B)
+    x = x ^ (x >> _U(16))
+    return x
+
+
+def _dropout_keep_scale(seed, B, H, q_pos, k_pos, rate):
+    """(B, H, len(q_pos), len(k_pos)) f32 multiplicative dropout factor
+    keep/(1-rate), where `keep` is a pure function of (seed, batch, head,
+    GLOBAL query position, GLOBAL key position).
+
+    Position-stable by construction: the mask for any (q, k) score element
+    is independent of how the sequence is blocked or sharded, so the ring
+    path (any number of sp shards) and the single-device full-attention
+    fallback draw bit-identical masks — that is what makes ring-vs-full
+    parity hold WITH dropout. `seed` is a uint32 (2,) array
+    (jax.random.key_data of a PRNG key)."""
+    seed = jnp.asarray(seed, jnp.uint32).reshape(-1)
+    b = jnp.arange(B, dtype=jnp.uint32).reshape(B, 1, 1, 1)
+    h = jnp.arange(H, dtype=jnp.uint32).reshape(1, H, 1, 1)
+    qp = q_pos.astype(jnp.uint32).reshape(1, 1, -1, 1)
+    kp = k_pos.astype(jnp.uint32).reshape(1, 1, 1, -1)
+    x = _mix32(seed[0] ^ _mix32(seed[1]))
+    x = _mix32(x ^ (b * _U(0x9E3779B1)))
+    x = _mix32(x ^ (h * _U(0x85EBCA77)))
+    x = _mix32(x ^ (qp * _U(0xC2B2AE3D)))
+    x = _mix32(x ^ (kp * _U(0x27D4EB2F)))
+    # top 24 bits -> uniform [0, 1)
+    u = (x >> _U(8)).astype(jnp.float32) * (1.0 / (1 << 24))
+    return (u >= rate).astype(jnp.float32) / (1.0 - rate)
+
+
+def full_attention(q, k, v, causal: bool = False, scale: Optional[float] = None,
+                   lengths=None, dropout_rate: float = 0.0, dropout_seed=None):
     """Exact single-device attention, the numeric reference for the ring.
-    q,k,v: (B, H, T, Dh)."""
+    q,k,v: (B, H, T, Dh). `lengths` (B,) masks padded KV positions;
+    `dropout_rate`/`dropout_seed` apply the same position-stable dropout
+    as the ring path (see _dropout_keep_scale), so this stays its numeric
+    twin under both features."""
+    if dropout_rate and dropout_seed is None:
+        raise ValueError("dropout_rate > 0 requires dropout_seed "
+                         "(uint32 (2,) array, e.g. jax.random.key_data)")
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    B, H, Tq, _ = q.shape
+    Tk = k.shape[2]
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    masked = causal or lengths is not None
     if causal:
-        Tq, Tk = logits.shape[-2], logits.shape[-1]
         mask = jnp.tril(jnp.ones((Tq, Tk), bool), k=Tk - Tq)
         logits = jnp.where(mask, logits, _NEG)
+    if lengths is not None:
+        valid = jnp.arange(Tk)[None, :] < lengths.reshape(-1)[:, None]  # (B, Tk)
+        logits = jnp.where(valid[:, None, None, :], logits, _NEG)
     weights = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    if masked:
+        # a fully-masked row (e.g. lengths[b] == 0) must produce 0, not
+        # the softmax of a constant row (the mean of V) — mirrors the
+        # ring path's zeroed accumulators
+        weights = jnp.where(logits <= _NEG / 2, 0.0, weights)
+    if dropout_rate:
+        weights = weights * _dropout_keep_scale(
+            dropout_seed, B, H, jnp.arange(Tq), jnp.arange(Tk), dropout_rate)
     return jnp.einsum("bhqk,bhkd->bhqd", weights.astype(v.dtype), v)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def ring_attention(q, k, v, axis_name: str, causal: bool = False,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None, dropout_rate: float = 0.0,
+                   lengths=None, dropout_seed=None):
     """Blockwise-exact attention inside a shard_map body.
 
     q, k, v: (B, H, T_local, Dh) — the local sequence shard; the global
     sequence is the concatenation over `axis_name` in axis-index order.
     Accumulates in fp32 regardless of input dtype (bf16-safe).
+
+    `lengths` (B,) are GLOBAL KV lengths: keys at global position >=
+    lengths[b] are masked out of batch row b (the reference's sequence
+    padding semantics — /root/reference/python/paddle/fluid/nets.py:332's
+    attention over padded batches). `dropout_rate`/`dropout_seed` apply
+    attention-probability dropout with a position-stable mask
+    (_dropout_keep_scale), matching full_attention bit-for-bit. Both are
+    replicated inputs — every device sees the full (B,) lengths and the
+    same seed.
 
     Differentiable with O(T_local) residuals: the custom backward saves
     only (q, k, v, out, lse) and RE-ROTATES K/V around the ring,
@@ -63,7 +133,8 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     (T_local, T_local) probability tensor (O(size * T_local^2), i.e.
     the full (T, T) ring attention exists to avoid).
     """
-    out, _ = _ring_fwd_impl(q, k, v, axis_name, causal, scale)
+    out, _ = _ring_fwd_impl(q, k, v, lengths, dropout_seed, axis_name,
+                            causal, scale, dropout_rate)
     return out
 
 
@@ -74,20 +145,24 @@ def _ring_steps(axis_name):
     return int(size), my_blk, fwd
 
 
-def _block_scores(qs, kc, kv_blk, q_pos, T, causal):
+def _block_scores(qs, kc, kv_blk, q_pos, T, causal, lengths=None):
     """(B, H, T, T) f32 scores of the local q shard against a visiting
-    K block, causal-masked by GLOBAL positions; bf16 inputs run on the
-    MXU at full rate (f32 accumulation)."""
+    K block, causal- and padding-masked by GLOBAL positions; bf16 inputs
+    run on the MXU at full rate (f32 accumulation)."""
     scores = jnp.einsum("bhqd,bhkd->bhqk", qs, kc,
                         preferred_element_type=jnp.float32)
+    k_pos = kv_blk * T + jnp.arange(T)
     if causal:
-        k_pos = kv_blk * T + jnp.arange(T)
         keep = q_pos[:, None] >= k_pos[None, :]  # (T, T)
         scores = jnp.where(keep[None, None], scores, _NEG)
+    if lengths is not None:
+        valid = k_pos[None, :] < lengths.reshape(-1)[:, None]  # (B, T)
+        scores = jnp.where(valid[:, None, None, :], scores, _NEG)
     return scores
 
 
-def _ring_fwd_impl(q, k, v, axis_name, causal, scale):
+def _ring_fwd_impl(q, k, v, lengths, dropout_seed, axis_name, causal, scale,
+                   dropout_rate):
     size, my_blk, fwd = _ring_steps(axis_name)
     B, H, T, Dh = q.shape
     if scale is None:
@@ -98,22 +173,32 @@ def _ring_fwd_impl(q, k, v, axis_name, causal, scale):
     # flash kernels; with f32 inputs this is numerically unchanged.
     qs = (q * jnp.asarray(scale, q.dtype)).astype(q.dtype)
     q_pos = my_blk * T + jnp.arange(T)  # global query positions
+    masked = causal or lengths is not None
 
     # kv rotates "forward" (device i -> i+1), so at step s device i holds
     # the block originally resident on (i - s) mod size.
     def body(s, carry):
         kc, vc, m, num, den = carry
         kv_blk = (my_blk - s) % size
-        scores = _block_scores(qs, kc, kv_blk, q_pos, T, causal)
+        scores = _block_scores(qs, kc, kv_blk, q_pos, T, causal, lengths)
         m_new = jnp.maximum(m, scores.max(axis=-1))
         # rows where everything so far is masked keep m=_NEG; exp(score-m)
         # would be exp(0)=1 there, so zero masked terms explicitly.
         p = jnp.exp(scores - m_new[..., None])
-        if causal:
+        if masked:
             p = jnp.where(scores <= _NEG / 2, 0.0, p)
+        if dropout_rate:
+            # dropout applies to the normalized softmax weights, which
+            # factor as p / den: scale the numerator's p, keep den on the
+            # un-dropped p (normalization is over pre-dropout weights)
+            k_pos = kv_blk * T + jnp.arange(T)
+            p_num = p * _dropout_keep_scale(dropout_seed, B, H, q_pos,
+                                            k_pos, dropout_rate)
+        else:
+            p_num = p
         corr = jnp.exp(m - m_new)
         num = num * corr[..., None] + jnp.einsum(
-            "bhqk,bhkd->bhqd", p.astype(vc.dtype), vc,
+            "bhqk,bhkd->bhqd", p_num.astype(vc.dtype), vc,
             preferred_element_type=jnp.float32)
         den = den * corr + p.sum(axis=-1)
         kc = lax.ppermute(kc, axis_name, perm=fwd)
@@ -137,13 +222,15 @@ def _ring_fwd_impl(q, k, v, axis_name, causal, scale):
     return out, lse
 
 
-def _ring_fwd(q, k, v, axis_name, causal, scale):
-    out, lse = _ring_fwd_impl(q, k, v, axis_name, causal, scale)
-    return out, (q, k, v, out, lse)
+def _ring_fwd(q, k, v, axis_name, causal, scale, dropout_rate, lengths,
+              dropout_seed):
+    out, lse = _ring_fwd_impl(q, k, v, lengths, dropout_seed, axis_name,
+                              causal, scale, dropout_rate)
+    return out, (q, k, v, out, lse, lengths, dropout_seed)
 
 
-def _ring_bwd(axis_name, causal, scale, res, dout):
-    q, k, v, out, lse = res
+def _ring_bwd(axis_name, causal, scale, dropout_rate, res, dout):
+    q, k, v, out, lse, lengths, dropout_seed = res
     size, my_blk, fwd = _ring_steps(axis_name)
     B, H, T, Dh = q.shape
     if scale is None:
@@ -157,15 +244,28 @@ def _ring_bwd(axis_name, causal, scale, res, dout):
     def body(s, carry):
         kc, vc, dkc, dvc, dq = carry
         kv_blk = (my_blk - s) % size
-        scores = _block_scores(qs, kc, kv_blk, q_pos, T, causal)
+        scores = _block_scores(qs, kc, kv_blk, q_pos, T, causal, lengths)
         # p = softmax weights reconstructed from the saved logsumexp;
-        # masked entries give exp(_NEG - lse) == 0 exactly
+        # masked entries give exp(_NEG - lse) == 0 exactly — EXCEPT on a
+        # fully-masked row, where lse itself is ~_NEG and the subtraction
+        # would overflow toward +inf: zero those explicitly (the forward
+        # already outputs 0 there, so 0 gradient is exact)
         p = jnp.exp(scores - lse[..., None])
-        dv_step = jnp.einsum("bhqk,bhqd->bhkd", p.astype(do.dtype), do,
+        p = jnp.where(scores <= _NEG / 2, 0.0, p)
+        if dropout_rate:
+            # out = sum_k p_k * ks_k * v_k / den with den over un-dropped
+            # p (see forward): d s_i = p_i * (ks_i * (do . v_i) - delta)
+            k_pos = kv_blk * T + jnp.arange(T)
+            ks = _dropout_keep_scale(dropout_seed, B, H, q_pos, k_pos,
+                                     dropout_rate)
+            pd = p * ks
+        else:
+            pd = p
+        dv_step = jnp.einsum("bhqk,bhqd->bhkd", pd.astype(do.dtype), do,
                              preferred_element_type=jnp.float32)
         dp = jnp.einsum("bhqd,bhkd->bhqk", do, vc,
                         preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[..., None])
+        ds = pd * dp - p * delta[..., None]
         dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds.astype(kc.dtype), kc,
                              preferred_element_type=jnp.float32)
         dk_step = jnp.einsum("bhqk,bhqd->bhkd", ds.astype(qs.dtype), qs,
@@ -187,20 +287,34 @@ def _ring_bwd(axis_name, causal, scale, res, dout):
     _, _, dkc, dvc, dq = carry
     # d(qs)/dq = scale (the fold at the top)
     dq = dq * jnp.asarray(scale, jnp.float32)
-    return (dq.astype(q.dtype), dkc.astype(k.dtype), dvc.astype(v.dtype))
+    return (dq.astype(q.dtype), dkc.astype(k.dtype), dvc.astype(v.dtype),
+            None, None)
 
 
 ring_attention.defvjp(_ring_fwd, _ring_bwd)
 
 
 def ring_self_attention(q, k, v, mesh: Mesh, sp_axis: str = "sp",
-                        causal: bool = False, scale: Optional[float] = None):
+                        causal: bool = False, scale: Optional[float] = None,
+                        lengths=None, dropout_rate: float = 0.0,
+                        dropout_seed=None):
     """Standalone entry: q,k,v are global (B, H, T, Dh) arrays; the sequence
-    dim is sharded over mesh axis `sp_axis` and attention is exact."""
+    dim is sharded over mesh axis `sp_axis` and attention is exact.
+    `lengths` (global KV lengths) and the dropout seed are replicated."""
+    if dropout_rate and dropout_seed is None:
+        raise ValueError("dropout_rate > 0 requires dropout_seed "
+                         "(uint32 (2,) array, e.g. jax.random.key_data)")
     spec = P(None, None, sp_axis, None)
+
+    def body(q, k, v, lengths, seed):
+        return ring_attention(q, k, v, sp_axis, causal, scale,
+                              dropout_rate, lengths, seed)
+
     fn = shard_map(
-        functools.partial(ring_attention, axis_name=sp_axis, causal=causal,
-                          scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        body, mesh=mesh,
+        in_specs=(spec, spec, spec, P(), P()), out_specs=spec,
     )
-    return fn(q, k, v)
+    return fn(q, k, v,
+              None if lengths is None else jnp.asarray(lengths),
+              None if dropout_seed is None
+              else jnp.asarray(dropout_seed, jnp.uint32))

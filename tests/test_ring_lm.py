@@ -12,7 +12,7 @@ from paddle_tpu import layers, models, optimizer
 from paddle_tpu.parallel import ParallelExecutor, make_mesh, seq_parallel_plan
 
 
-def _build(use_ring, seed=13, batch=2, seq=32, vocab=64):
+def _build(use_ring, seed=13, batch=2, seq=32, vocab=64, dropout_rate=0.0):
     main, startup = fluid.Program(), fluid.Program()
     main.random_seed = startup.random_seed = seed
     scope = fluid.Scope()
@@ -25,7 +25,7 @@ def _build(use_ring, seed=13, batch=2, seq=32, vocab=64):
             loss, _ = models.transformer.transformer_lm(
                 ids, labels, vocab_size=vocab, n_layer=2, n_head=2,
                 d_model=16, d_inner=32, max_len=seq,
-                use_ring_attention=use_ring)
+                use_ring_attention=use_ring, dropout_rate=dropout_rate)
             optimizer.SGD(0.1).minimize(loss)
     return main, startup, scope, loss
 
@@ -81,3 +81,69 @@ def test_ring_lm_dp_x_sp():
         got = [float(pexe.run(feed=feed, fetch_list=[loss])[0])
                for _ in range(2)]
     np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_ring_lm_with_dropout_matches_single_device():
+    """VERDICT r3 item 4: the flagship long-context path must train the
+    SAME model as the single-device path even with attention dropout on.
+    The ring op's dropout mask is a pure function of (seed, global q,
+    global k) — independent of the sp shard count — and both executors
+    derive identical per-op RNG streams from program.random_seed, so the
+    losses must agree step for step."""
+    feed = _feed()
+
+    main, startup, scope, loss = _build(use_ring=True, dropout_rate=0.2)
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        ref = [float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+               for _ in range(3)]
+
+    mesh = make_mesh([4], ("sp",), devices=jax.devices()[:4])
+    main, startup, scope, loss = _build(use_ring=True, dropout_rate=0.2)
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+        pexe = ParallelExecutor(
+            loss_name=loss.name, main_program=main, scope=scope, mesh=mesh,
+            plan=seq_parallel_plan(mesh, sp_axis="sp", batch_axes=()))
+        got = [float(pexe.run(feed=feed, fetch_list=[loss])[0])
+               for _ in range(3)]
+
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+    assert ref[2] < ref[0]  # it actually trains under dropout
+
+
+def test_ring_lm_clone_for_test_disables_attention_dropout():
+    """clone(for_test=True) must flip is_test on ring_attention ops
+    (code-review regression: the op was missing from _TRAIN_TEST_OPS):
+    eval runs are deterministic while training draws fresh masks.
+    Reference idiom: clone BEFORE minimize (framework.py clone docs)."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 13
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            ids = layers.data(name="ids", shape=[2, 32], dtype="int64",
+                              append_batch_size=False)
+            labels = layers.data(name="labels", shape=[2, 32],
+                                 dtype="int64", append_batch_size=False)
+            loss, _ = models.transformer.transformer_lm(
+                ids, labels, vocab_size=64, n_layer=2, n_head=2,
+                d_model=16, d_inner=32, max_len=32,
+                use_ring_attention=True, dropout_rate=0.5)
+            test_prog = main.clone(for_test=True)
+            optimizer.SGD(0.1).minimize(loss)
+    ring_ops = [op for b in test_prog.blocks for op in b.ops
+                if op.type == "ring_attention"]
+    assert ring_ops and all(op.attr("is_test") for op in ring_ops)
+
+    feed = _feed()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        e1 = float(exe.run(test_prog, feed=feed, fetch_list=[loss])[0])
+        e2 = float(exe.run(test_prog, feed=feed, fetch_list=[loss])[0])
+        assert e1 == e2  # no stochastic op left in the eval graph
+        # training program DOES draw masks: same feed, different losses
+        t1 = float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+        assert t1 != e1
