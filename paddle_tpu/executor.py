@@ -174,8 +174,12 @@ class Executor:
         # stochastic-op stream, or the same training program draws
         # different dropout masks depending on what else this Executor
         # ran before — and can never be parity-tested against a
-        # ParallelExecutor, whose counter is program-bound from step 0
-        self._steps: Dict[int, int] = {}
+        # ParallelExecutor, whose counter is program-bound from step 0.
+        # Weak keys: a dead program's counter must die with it, never be
+        # inherited by a new program allocated at the same address
+        import weakref
+
+        self._steps = weakref.WeakKeyDictionary()
         self._last_step = 0  # most recent step index (error messages)
         self._seed = 0
         self._base_keys: Dict = {}
@@ -317,8 +321,8 @@ class Executor:
     def _next_steps(self, program: Program, n: int) -> int:
         """Reserve `n` step indices on `program`'s OWN stream and return
         the first; see the _steps comment in __init__."""
-        cur = self._steps.get(id(program), 0)
-        self._steps[id(program)] = cur + n
+        cur = self._steps.get(program, 0)
+        self._steps[program] = cur + n
         self._last_step = cur + n - 1
         return cur
 

