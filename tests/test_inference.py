@@ -80,3 +80,40 @@ def test_predictor_server_batching(tmp_path):
     server.stop()
     with pytest.raises(RuntimeError):
         server.submit((feed[0],))
+
+
+def test_predictor_preload_and_sig_backfill(tmp_path):
+    """Preload loads cached executables at construction (no first-call
+    deserialization), and a pre-sidecar cache (.xla without .sig) gets
+    its sidecar backfilled on the first lazy hit so the NEXT process
+    preloads it (code-review regression)."""
+    import glob
+    import os
+
+    feed, want = _save_model(tmp_path)
+    p1 = Predictor(str(tmp_path))
+    p1.run({"x": feed})
+    cache_dir = p1._cache_dir
+    sigs = glob.glob(os.path.join(cache_dir, "*.sig"))
+    assert len(sigs) == 1  # the compile wrote its sidecar
+
+    # preloaded: the executable is resident BEFORE any run() call
+    p2 = Predictor(str(tmp_path))
+    assert len(p2._compiled) == 1
+    out2, = p2.run({"x": feed})
+    np.testing.assert_allclose(out2, want, rtol=1e-5, atol=1e-6)
+
+    # simulate a pre-sidecar cache: drop the .sig -> preload finds
+    # nothing, the lazy hit backfills it, the next process preloads again
+    os.remove(sigs[0])
+    p3 = Predictor(str(tmp_path))
+    assert len(p3._compiled) == 0
+    p3.run({"x": feed})
+    assert p3.traces == 0  # still the cached executable, not a re-trace
+    assert glob.glob(os.path.join(cache_dir, "*.sig")), "sidecar not backfilled"
+    p4 = Predictor(str(tmp_path))
+    assert len(p4._compiled) == 1
+
+    # preload=False restores lazy behavior
+    p5 = Predictor(str(tmp_path), preload=False)
+    assert len(p5._compiled) == 0

@@ -1,0 +1,167 @@
+"""Serving-path measurements (VERDICT r3 weak #4): the numbers behind
+"usable in production", measured instead of asserted.
+
+Reference counterpart: paddle/fluid/inference/api/api_impl.cc — the
+NativePredictor whose cold-start/per-call costs this tool records for
+our AOT predictor, PredictorServer, and (via runtime/capi_test.c's
+bench mode) the pure-C ABI.
+
+Prints one JSON line per phase:
+  {"phase": "predictor_cold_start", ...}
+  {"phase": "predictor_latency", ...}
+  {"phase": "server_throughput", ...}
+
+Usage:
+  python tools/bench_serving.py            # CPU (forced)
+  BENCH_SERVING_PLATFORM=device python tools/bench_serving.py  # real chip
+
+The model is the MLP the C ABI test embeds (16->128->10 softmax) at
+SERVING_BATCH (default 8); adjust with SERVING_DIM / SERVING_HIDDEN.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+if os.environ.get("BENCH_SERVING_PLATFORM", "cpu") == "cpu":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+if os.environ.get("BENCH_SERVING_PLATFORM", "cpu") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu as fluid  # noqa: E402
+
+
+DIM = int(os.environ.get("SERVING_DIM", 16))
+HIDDEN = int(os.environ.get("SERVING_HIDDEN", 128))
+BATCH = int(os.environ.get("SERVING_BATCH", 8))
+
+
+def _save_model(model_dir):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 17
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[DIM], dtype="float32")
+        h = fluid.layers.fc(img, HIDDEN, act="relu")
+        prob = fluid.layers.softmax(fluid.layers.fc(h, 10))
+    scope = fluid.core.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_inference_model(model_dir, ["img"], [prob], exe,
+                                      main_program=main)
+
+
+def _emit(obj):
+    print(json.dumps(obj), flush=True)
+
+
+def main():
+    from paddle_tpu.inference import Predictor, PredictorServer
+
+    tmp = tempfile.mkdtemp(prefix="ptpu_serving_")
+    model_dir = os.path.join(tmp, "model")
+    _save_model(model_dir)
+    batch = np.random.RandomState(3).randn(BATCH, DIM).astype(np.float32)
+
+    # -- cold start: construction + first predict, cache-cold vs warm ----
+    t0 = time.perf_counter()
+    p = Predictor(model_dir)
+    t1 = time.perf_counter()
+    p.run({"img": batch})
+    t2 = time.perf_counter()
+    cold_construct_ms = (t1 - t0) * 1e3
+    cold_first_run_ms = (t2 - t1) * 1e3  # includes the XLA compile
+
+    # second process-equivalent: fresh Predictor over the now-warm AOT
+    # cache, preload on (default) vs off
+    t0 = time.perf_counter()
+    p2 = Predictor(model_dir)
+    t1 = time.perf_counter()
+    p2.run({"img": batch})
+    t2 = time.perf_counter()
+    warm_preload_construct_ms = (t1 - t0) * 1e3
+    warm_preload_first_run_ms = (t2 - t1) * 1e3
+
+    t0 = time.perf_counter()
+    p3 = Predictor(model_dir, preload=False)
+    t1 = time.perf_counter()
+    p3.run({"img": batch})
+    t2 = time.perf_counter()
+    warm_lazy_construct_ms = (t1 - t0) * 1e3
+    warm_lazy_first_run_ms = (t2 - t1) * 1e3
+
+    _emit({"phase": "predictor_cold_start",
+           "cold_construct_ms": round(cold_construct_ms, 1),
+           "cold_first_run_ms": round(cold_first_run_ms, 1),
+           "warm_preload_construct_ms": round(warm_preload_construct_ms, 1),
+           "warm_preload_first_run_ms": round(warm_preload_first_run_ms, 3),
+           "warm_lazy_construct_ms": round(warm_lazy_construct_ms, 1),
+           "warm_lazy_first_run_ms": round(warm_lazy_first_run_ms, 1),
+           "device": jax.devices()[0].device_kind})
+
+    # -- steady-state latency -------------------------------------------
+    iters = int(os.environ.get("SERVING_ITERS", 200))
+    for _ in range(10):
+        p2.run({"img": batch})
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out, = p2.run({"img": batch})  # return_numpy fences device->host
+        times.append((time.perf_counter() - t0) * 1e3)
+    times.sort()
+    _emit({"phase": "predictor_latency", "batch": BATCH,
+           "run_ms_min": round(times[0], 3),
+           "run_ms_p50": round(times[len(times) // 2], 3),
+           "run_ms_p99": round(times[int(len(times) * 0.99) - 1], 3),
+           "iters": iters})
+
+    # -- PredictorServer dynamic-batching throughput ---------------------
+    import threading
+
+    for max_batch in (8, 32):
+        server = PredictorServer(p2, max_batch=max_batch)
+        server.start()
+        n_req = int(os.environ.get("SERVING_REQUESTS", 2000))
+        rows = [np.random.RandomState(i % 7).randn(DIM).astype(np.float32)
+                for i in range(8)]
+        # warm the padded-batch signature (one XLA compile) off the clock
+        for f in [server.submit((rows[0],)) for _ in range(max_batch)]:
+            f.result()
+        futs = []
+        t0 = time.perf_counter()
+
+        def feed_requests(k0, k1):
+            local = []
+            for i in range(k0, k1):
+                local.append(server.submit((rows[i % 8],)))
+            futs.extend(local)
+
+        threads = [threading.Thread(target=feed_requests,
+                                    args=(k * n_req // 4,
+                                          (k + 1) * n_req // 4))
+                   for k in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for f in futs:
+            f.result()
+        dt = time.perf_counter() - t0
+        server.stop()
+        _emit({"phase": "server_throughput", "max_batch": max_batch,
+               "requests": n_req, "concurrency": 4,
+               "rows_per_sec": round(n_req / dt, 1),
+               "wall_s": round(dt, 3)})
+
+
+if __name__ == "__main__":
+    sys.exit(main())
