@@ -317,12 +317,18 @@ def _layer_norm(ctx):
         y = y * ctx.input("Scale").reshape(x.shape[begin:]).astype(jnp.float32)
     if ctx.has_input("Bias"):
         y = y + ctx.input("Bias").reshape(x.shape[begin:]).astype(jnp.float32)
-    # stats are COMPUTED in f32 (above) but returned in the input dtype:
-    # the declared Mean/Variance output variables inherit X's dtype, and a
-    # consumer of those outputs must see the dtype the IR declares
+    # stats are COMPUTED in f32 (above) and returned in the DECLARED
+    # output dtype — the IR contract a consumer sees. An explicitly-bf16
+    # program declares bf16 stats and gets them; under AMP the
+    # declaration stays f32 (the rewrite retypes the runtime values, not
+    # the program), so full-accuracy statistics ship, which O2 relies on
+    try:
+        mdt, vdt = ctx.out_dtype("Mean"), ctx.out_dtype("Variance")
+    except Exception:  # synthetic ctx without block metadata
+        mdt = vdt = jnp.float32
     return {"Y": y.astype(x.dtype),
-            "Mean": mean.reshape(x.shape[:begin]).astype(x.dtype),
-            "Variance": var.reshape(x.shape[:begin]).astype(x.dtype)}
+            "Mean": mean.reshape(x.shape[:begin]).astype(mdt),
+            "Variance": var.reshape(x.shape[:begin]).astype(vdt)}
 
 
 @register_op("lrn")

@@ -74,4 +74,13 @@ if [ "${RN:-0}" = "1" ]; then
     echo "$line"
     echo "{\"cfg\": \"resnet rb=$rb\", \"result\": $(json_or_null "$line")}" >> "$OUT"
   done
+  # input-pipeline proof (VERDICT r3 item 8): the same step fed through
+  # recordio -> C++ reader -> reader ops -> run_loop windows; the row's
+  # resnet50.reader object records step_ms vs synthetic + overhead pct
+  echo "=== resnet reader pipeline ==="
+  line=$(env BENCH_RESNET_INPUT=reader BENCH_PROBE_TIMEOUT=150 \
+      BENCH_STEPS=3 BENCH_WARMUP=1 BENCH_LAYERS=1 timeout 2400 \
+      python bench.py 2>/dev/null | tail -1)
+  echo "$line"
+  echo "{\"cfg\": \"resnet reader\", \"result\": $(json_or_null "$line")}" >> "$OUT"
 fi
