@@ -502,6 +502,9 @@ def _bthd_smoke_gate():
                 verdict = f.read().strip()
             if verdict == "ok":
                 return None
+            if verdict == "ok-nofused":
+                _disable_fused_bwd()
+                return None
             if verdict == "fail":
                 _os.environ["PADDLE_TPU_ATTN_BTHD"] = "0"
                 return None
@@ -560,12 +563,19 @@ def check_grads(tag, grads, rgrads):
 
 check_grads('bwd', grads, rgrads)
 # the opt-in single-pass fused backward (sweep rows enable it) must
-# match too; env is read at trace time, and these calls are un-jitted
-os.environ['PADDLE_TPU_FLASH_FUSED_BWD'] = '1'
-fval, fgrads = jax.value_and_grad(loss_bthd, argnums=(0, 1, 2))(q, k, v)
-assert abs(float(np.asarray(fval)) - rval) <= 2e-2 * max(1.0, abs(rval)), (
-    'Mosaic lowering numerics mismatch (fused-bwd fwd)')
-check_grads('fused-bwd', fgrads, rgrads)
+# match too; env is read at trace time, and these calls are un-jitted.
+# A fused-ONLY failure exits 3: the parent keeps the just-validated
+# plain BTHD layout and disables only the fused backward.
+import sys
+try:
+    os.environ['PADDLE_TPU_FLASH_FUSED_BWD'] = '1'
+    fval, fgrads = jax.value_and_grad(loss_bthd, argnums=(0, 1, 2))(q, k, v)
+    assert abs(float(np.asarray(fval)) - rval) <= 2e-2 * max(1.0, abs(rval)), (
+        'Mosaic lowering numerics mismatch (fused-bwd fwd)')
+    check_grads('fused-bwd', fgrads, rgrads)
+except Exception as e:
+    print('SMOKE_FUSED_BWD_FAIL: %r' % (e,), file=sys.stderr)
+    sys.exit(3)
 """
     )
     budget = int(_os.environ.get("BENCH_BTHD_SMOKE_TIMEOUT", 900))
@@ -585,19 +595,29 @@ check_grads('fused-bwd', fgrads, rgrads)
         if problem is None:
             _write_quiet(memo, "fail")
         return problem
-    if res.returncode != 0:
+    if res.returncode == 3:
+        # the PLAIN BTHD path just validated; only the opt-in fused
+        # backward mismatched — keep the layout, disable the one kernel
+        _write_quiet(memo, "ok-nofused")
+        _disable_fused_bwd()
+        tail = res.stderr.decode(errors="replace").strip().splitlines()
+        print("bench: fused flash backward failed its numeric smoke "
+              "(%s); BTHD stays ON, PADDLE_TPU_FLASH_FUSED_BWD forced 0"
+              % (tail[-1][:160] if tail else "no stderr"), file=_sys.stderr)
+    elif res.returncode != 0:
         err = res.stderr.decode(errors="replace").strip()
-        tail = err.splitlines()
-        _os.environ["PADDLE_TPU_ATTN_BTHD"] = "0"
         # memoize 'fail' only for DETERMINISTIC kernel rejections (Mosaic /
         # lowering / pallas errors reproduce every run); a one-off device
         # flake or unrelated import error must not poison later runs —
         # those retry next invocation (BENCH_BTHD_SMOKE=force also re-runs).
-        # Match the exception MESSAGE (the traceback's last few lines —
-        # JAX may append its frame-filtering notice after the exception),
-        # not the whole stderr: frame paths like
-        # .../pallas/mosaic/lowering.py would make any in-kernel flake
-        # look deterministic.
+        # Match against the exception MESSAGE lines: the traceback's last
+        # few lines (JAX may append its frame-filtering notice after the
+        # exception) with 'File "..."' frame lines dropped — a frame path
+        # like .../pallas/mosaic/lowering.py in an unfiltered traceback
+        # must not make a transient flake look deterministic.
+        tail = [l for l in err.splitlines()
+                if not l.lstrip().startswith('File "')]
+        _os.environ["PADDLE_TPU_ATTN_BTHD"] = "0"
         msg = "\n".join(tail[-5:])
         deterministic = any(s in msg for s in (
             "Mosaic", "mosaic", "pallas", "Pallas", "lowering",
@@ -613,6 +633,17 @@ check_grads('fused-bwd', fgrads, rgrads)
     else:
         _write_quiet(memo, "ok")
     return None
+
+
+def _disable_fused_bwd():
+    """Force the opt-in fused flash backward off for this process (and
+    warn if a sweep row explicitly asked for it — the row will measure
+    the plain backward instead of silently shipping bad numerics)."""
+    if _os.environ.get("PADDLE_TPU_FLASH_FUSED_BWD") == "1":
+        print("bench: overriding PADDLE_TPU_FLASH_FUSED_BWD=1 -> 0 "
+              "(kernel failed its numeric smoke on this backend)",
+              file=_sys.stderr)
+    _os.environ["PADDLE_TPU_FLASH_FUSED_BWD"] = "0"
 
 
 def _write_quiet(path, text):

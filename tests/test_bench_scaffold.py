@@ -81,3 +81,73 @@ def test_head_ladder_respects_explicit_heads(monkeypatch):
     monkeypatch.delenv("BENCH_BATCH", raising=False)
     out = bench.bench_lm_ladder(dev=None)
     assert out["n_head"] == 16
+
+
+class _FakeRes:
+    def __init__(self, returncode, stderr=b""):
+        self.returncode = returncode
+        self.stderr = stderr
+
+
+def _gate_env(monkeypatch, tmp_path, fake_res):
+    """Route the smoke gate's memo + subprocess to controllable fakes."""
+    import subprocess
+
+    monkeypatch.delenv("PADDLE_TPU_ATTN_BTHD", raising=False)
+    monkeypatch.delenv("PADDLE_TPU_FLASH_FUSED_BWD", raising=False)
+    monkeypatch.delenv("BENCH_HEADS", raising=False)
+    monkeypatch.setenv("BENCH_PLATFORM", "faketpu")
+    monkeypatch.setenv("TMPDIR", str(tmp_path))
+    import tempfile
+    monkeypatch.setattr(tempfile, "gettempdir", lambda: str(tmp_path))
+    monkeypatch.setattr(subprocess, "run",
+                        lambda *a, **k: fake_res)
+
+
+def _memo_files(tmp_path):
+    import glob
+    return {p: open(p).read()
+            for p in glob.glob(str(tmp_path / "ptpu_bthd_smoke_*"))}
+
+
+def test_smoke_gate_fused_only_failure_keeps_bthd(monkeypatch, tmp_path):
+    """rc 3 == the plain BTHD path validated, only the fused backward
+    mismatched: keep the layout, force the fused kernel off, memoize
+    'ok-nofused' so later runs skip the subprocess."""
+    import os
+
+    _gate_env(monkeypatch, tmp_path,
+              _FakeRes(3, b"SMOKE_FUSED_BWD_FAIL: AssertionError"))
+    assert bench._bthd_smoke_gate() is None
+    assert os.environ.get("PADDLE_TPU_ATTN_BTHD") is None  # layout alive
+    assert os.environ.get("PADDLE_TPU_FLASH_FUSED_BWD") == "0"
+    assert list(_memo_files(tmp_path).values()) == ["ok-nofused"]
+    # memoized path reproduces the same decisions without a subprocess
+    monkeypatch.delenv("PADDLE_TPU_FLASH_FUSED_BWD", raising=False)
+    assert bench._bthd_smoke_gate() is None
+    assert os.environ.get("PADDLE_TPU_FLASH_FUSED_BWD") == "0"
+
+
+def test_smoke_gate_frame_lines_not_deterministic(monkeypatch, tmp_path):
+    """A transient flake whose traceback FRAME paths mention pallas/
+    mosaic must NOT memoize a permanent fail; the same message in the
+    exception line itself must."""
+    import os
+
+    flake = (b'Traceback (most recent call last):\n'
+             b'  File "/x/jax/_src/pallas/mosaic/lowering.py", line 1\n'
+             b'XlaRuntimeError: transient device hiccup')
+    _gate_env(monkeypatch, tmp_path, _FakeRes(1, flake))
+    assert bench._bthd_smoke_gate() is None
+    assert os.environ.get("PADDLE_TPU_ATTN_BTHD") == "0"  # this run: off
+    assert _memo_files(tmp_path) == {}  # but NOT memoized
+
+    monkeypatch.setenv("PADDLE_TPU_ATTN_BTHD", "0")
+    monkeypatch.delenv("PADDLE_TPU_ATTN_BTHD", raising=False)
+    real = (b'Traceback (most recent call last):\n'
+            b'  File "/x/bench_smoke.py", line 9\n'
+            b'AssertionError: Mosaic lowering numerics mismatch (fwd)')
+    _gate_env(monkeypatch, tmp_path, _FakeRes(1, real))
+    assert bench._bthd_smoke_gate() is None
+    assert os.environ.get("PADDLE_TPU_ATTN_BTHD") == "0"
+    assert list(_memo_files(tmp_path).values()) == ["fail"]
