@@ -2079,7 +2079,7 @@ def fused_attention(q, k, v, causal=False, scale=None, sequence_length=None,
 
 
 def ring_attention(q, k, v, causal=False, scale=None, sp_axis="sp",
-                   lengths=None, dropout_rate=0.0, name=None):
+                   lengths=None, dropout_rate=0.0, chunk=None, name=None):
     """Sequence-parallel exact attention over (B, H, T, Dh) tensors: under
     a ParallelExecutor whose mesh has `sp_axis`, K/V blocks rotate on the
     ICI ring (lax.ppermute) so each chip keeps an O(T/N) sequence shard —
@@ -2100,7 +2100,7 @@ def ring_attention(q, k, v, causal=False, scale=None, sp_axis="sp",
         inputs=inputs,
         outputs={"Out": [out]},
         attrs={"causal": causal, "scale": scale, "sp_axis": sp_axis,
-               "dropout_rate": dropout_rate},
+               "dropout_rate": dropout_rate, "chunk": chunk},
     )
     return out
 

@@ -796,12 +796,24 @@ def _ring_attention_op(ctx):
         dropout_rate = 0.0
     seed = (jax.random.key_data(ctx.rng()).astype(jnp.uint32)
             if dropout_rate else None)
+    # per-rotation-step KV sub-chunking (transient-memory bound; see
+    # parallel/ring_attention.py): op attr, overridable per run for
+    # on-hardware sweeps; PADDLE_TPU_RING_CHUNK=0 means auto/whole-block
+    chunk = ctx.attr("chunk", None)
+    env_chunk = os.environ.get("PADDLE_TPU_RING_CHUNK")
+    if env_chunk:
+        try:
+            chunk = int(env_chunk) or None
+        except ValueError:
+            raise ValueError(
+                "PADDLE_TPU_RING_CHUNK=%r is not an integer" % env_chunk)
     mesh = current_trace_mesh()
     if (mesh is not None and sp_axis in mesh.axis_names
             and mesh.shape[sp_axis] > 1):
         return {"Out": ring_self_attention(
             q, k, v, mesh, sp_axis=sp_axis, causal=causal, scale=scale,
-            lengths=lengths, dropout_rate=dropout_rate, dropout_seed=seed)}
+            lengths=lengths, dropout_rate=dropout_rate, dropout_seed=seed,
+            chunk=chunk)}
     return {"Out": full_attention(
         q, k, v, causal=causal, scale=scale, lengths=lengths,
         dropout_rate=dropout_rate, dropout_seed=seed)}

@@ -62,10 +62,9 @@ def _vary_like(val, *refs):
         return val
     if not vma:
         return val
-    pcast = getattr(lax, "pcast", None)
-    if pcast is not None:
-        return pcast(val, tuple(vma), to="varying")
-    return lax.pvary(val, tuple(vma))
+    from ..parallel._compat import pvary
+
+    return pvary(val, tuple(vma))
 
 
 def _grad_vma_like(g, primal):

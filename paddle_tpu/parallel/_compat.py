@@ -8,7 +8,33 @@ try:  # jax>=0.6 top level; older: experimental
 except AttributeError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map  # noqa: F401
 
-__all__ = ["shard_map", "shard_map_partial"]
+__all__ = ["shard_map", "shard_map_partial", "pvary"]
+
+
+def pvary(x, axes):
+    """Mark x varying over manual mesh axes (shard_map vma typing);
+    lax.pvary is deprecated in favor of lax.pcast(..., to='varying') on
+    newer jax. `axes`: one axis name or a tuple. IDEMPOTENT: axes x
+    already varies over are skipped (pcast rejects varying->varying,
+    and callers often promote loop carries that are invariant only on
+    the first ring/pipeline step)."""
+    from jax import lax
+
+    if not isinstance(axes, tuple):
+        axes = (axes,)
+    typeof = getattr(jax, "typeof", None)
+    if typeof is not None:
+        try:
+            have = set(getattr(typeof(x), "vma", ()) or ())
+        except Exception:
+            have = set()
+        axes = tuple(a for a in axes if a not in have)
+    if not axes:
+        return x
+    pcast = getattr(lax, "pcast", None)
+    if pcast is not None:
+        return pcast(x, axes, to="varying")
+    return lax.pvary(x, axes)
 
 
 def shard_map_partial(f, mesh, in_specs, out_specs, manual_axes):

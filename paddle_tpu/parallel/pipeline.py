@@ -27,13 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ._compat import shard_map
 
 
-def _pvary(x, axes):
-    """Mark x varying over manual axes; lax.pvary is deprecated in favor of
-    lax.pcast(x, axis_name, to='varying') on newer jax."""
-    pcast = getattr(lax, "pcast", None)
-    if pcast is not None:
-        return pcast(x, axes, to="varying")
-    return lax.pvary(x, axes)
+from ._compat import pvary as _pvary  # shared vma-typing shim
 
 __all__ = ["pipeline_apply", "stack_stage_params", "num_pipeline_ticks"]
 

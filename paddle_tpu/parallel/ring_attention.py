@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ._compat import shard_map
+from ._compat import pvary as _compat_pvary, shard_map
 
 __all__ = ["ring_attention", "ring_self_attention", "full_attention"]
 
@@ -106,10 +106,11 @@ def full_attention(q, k, v, causal: bool = False, scale: Optional[float] = None,
     return jnp.einsum("bhqk,bhkd->bhqd", weights.astype(v.dtype), v)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 9))
 def ring_attention(q, k, v, axis_name: str, causal: bool = False,
                    scale: Optional[float] = None, dropout_rate: float = 0.0,
-                   lengths=None, dropout_seed=None):
+                   lengths=None, dropout_seed=None,
+                   chunk: Optional[int] = None):
     """Blockwise-exact attention inside a shard_map body.
 
     q, k, v: (B, H, T_local, Dh) — the local sequence shard; the global
@@ -125,6 +126,15 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     replicated inputs — every device sees the full (B,) lengths and the
     same seed.
 
+    `chunk` bounds per-rotation-step TRANSIENT memory: each visiting KV
+    block is consumed in sub-blocks of `chunk` keys (a lax.scan with an
+    online-softmax carry), so the largest live score tensor is
+    (B, H, T_local, chunk) instead of (B, H, T_local, T_local) — the
+    difference between seq ~64k and seq 1M+ fitting a chip. None picks
+    automatically: whole-block below _CHUNK_AUTO keys (best XLA fusion
+    at bench sizes), the largest lane-aligned divisor above it. The
+    position-stable masks/dropout make chunking invisible numerically.
+
     Differentiable with O(T_local) residuals: the custom backward saves
     only (q, k, v, out, lse) and RE-ROTATES K/V around the ring,
     recomputing each block's probabilities from the logsumexp — dK/dV
@@ -134,8 +144,32 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     the full (T, T) ring attention exists to avoid).
     """
     out, _ = _ring_fwd_impl(q, k, v, lengths, dropout_seed, axis_name,
-                            causal, scale, dropout_rate)
+                            causal, scale, dropout_rate, chunk)
     return out
+
+
+_CHUNK_AUTO = 2048  # auto-chunk threshold AND the auto chunk size
+
+
+def _pick_chunk(T: int, chunk: Optional[int]):
+    """(n_chunks, chunk_size) for a T-key block. Explicit chunk must
+    divide T; auto keeps small blocks whole and splits big ones at the
+    largest power-of-two divisor <= _CHUNK_AUTO."""
+    if chunk is not None:
+        chunk = int(chunk)
+        if chunk <= 0 or T % chunk:
+            raise ValueError(
+                "ring attention chunk=%d must positively divide the "
+                "local block length %d" % (chunk, T))
+        return T // chunk, chunk
+    if T <= _CHUNK_AUTO:
+        return 1, T
+    c = _CHUNK_AUTO
+    while c > 128 and T % c:
+        c //= 2
+    if T % c:
+        return 1, T  # odd length: stay whole rather than mis-split
+    return T // c, c
 
 
 def _ring_steps(axis_name):
@@ -145,28 +179,41 @@ def _ring_steps(axis_name):
     return int(size), my_blk, fwd
 
 
-def _block_scores(qs, kc, kv_blk, q_pos, T, causal, lengths=None):
-    """(B, H, T, T) f32 scores of the local q shard against a visiting
-    K block, causal- and padding-masked by GLOBAL positions; bf16 inputs
-    run on the MXU at full rate (f32 accumulation)."""
-    scores = jnp.einsum("bhqd,bhkd->bhqk", qs, kc,
+def _vary_like(x, axis_name):
+    """Mark x varying over the manual mesh axis (shard_map vma typing):
+    the chunk scans' initial carries are device-invariant zeros while
+    the body outputs mix in the varying q/kv shards."""
+    return _compat_pvary(x, axis_name)
+
+
+def _chunk_scores(qs, kcc, k_pos, q_pos, causal, lengths=None):
+    """(B, H, Tq, C) f32 scores of the local q shard against a visiting
+    KV sub-chunk at GLOBAL key positions k_pos, causal- and padding-
+    masked; bf16 inputs run on the MXU at full rate (f32 accumulation)."""
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qs, kcc,
                         preferred_element_type=jnp.float32)
-    k_pos = kv_blk * T + jnp.arange(T)
     if causal:
-        keep = q_pos[:, None] >= k_pos[None, :]  # (T, T)
+        keep = q_pos[:, None] >= k_pos[None, :]  # (Tq, C)
         scores = jnp.where(keep[None, None], scores, _NEG)
     if lengths is not None:
-        valid = k_pos[None, :] < lengths.reshape(-1)[:, None]  # (B, T)
+        valid = k_pos[None, :] < lengths.reshape(-1)[:, None]  # (B, C)
         scores = jnp.where(valid[:, None, None, :], scores, _NEG)
     return scores
 
 
+def _kv_chunk_axes(x, nc, C):
+    """(B, H, T, Dh) -> (nc, B, H, C, Dh) scan-ready sub-chunks."""
+    B, H, T, Dh = x.shape
+    return x.reshape(B, H, nc, C, Dh).transpose(2, 0, 1, 3, 4)
+
+
 def _ring_fwd_impl(q, k, v, lengths, dropout_seed, axis_name, causal, scale,
-                   dropout_rate):
+                   dropout_rate, chunk):
     size, my_blk, fwd = _ring_steps(axis_name)
     B, H, T, Dh = q.shape
     if scale is None:
         scale = Dh ** -0.5
+    nc, C = _pick_chunk(T, chunk)
     # fold the scale into q and KEEP the input dtype: under bf16 AMP the
     # score einsum then runs bf16 x bf16 -> f32 on the MXU (full rate,
     # f32 accumulation via preferred_element_type) — same recipe as the
@@ -175,12 +222,11 @@ def _ring_fwd_impl(q, k, v, lengths, dropout_seed, axis_name, causal, scale,
     q_pos = my_blk * T + jnp.arange(T)  # global query positions
     masked = causal or lengths is not None
 
-    # kv rotates "forward" (device i -> i+1), so at step s device i holds
-    # the block originally resident on (i - s) mod size.
-    def body(s, carry):
-        kc, vc, m, num, den = carry
-        kv_blk = (my_blk - s) % size
-        scores = _block_scores(qs, kc, kv_blk, q_pos, T, causal, lengths)
+    def fwd_chunk(carry, kcc, vcc, k_pos):
+        """Fold one visiting KV sub-chunk into the (m, num, den) online-
+        softmax carry."""
+        m, num, den = carry
+        scores = _chunk_scores(qs, kcc, k_pos, q_pos, causal, lengths)
         m_new = jnp.maximum(m, scores.max(axis=-1))
         # rows where everything so far is masked keep m=_NEG; exp(score-m)
         # would be exp(0)=1 there, so zero masked terms explicitly.
@@ -191,19 +237,42 @@ def _ring_fwd_impl(q, k, v, lengths, dropout_seed, axis_name, causal, scale,
             # dropout applies to the normalized softmax weights, which
             # factor as p / den: scale the numerator's p, keep den on the
             # un-dropped p (normalization is over pre-dropout weights)
-            k_pos = kv_blk * T + jnp.arange(T)
             p_num = p * _dropout_keep_scale(dropout_seed, B, H, q_pos,
                                             k_pos, dropout_rate)
         else:
             p_num = p
         corr = jnp.exp(m - m_new)
         num = num * corr[..., None] + jnp.einsum(
-            "bhqk,bhkd->bhqd", p_num.astype(vc.dtype), vc,
+            "bhqk,bhkd->bhqd", p_num.astype(vcc.dtype), vcc,
             preferred_element_type=jnp.float32)
         den = den * corr + p.sum(axis=-1)
+        return m_new, num, den
+
+    # kv rotates "forward" (device i -> i+1), so at step s device i holds
+    # the block originally resident on (i - s) mod size.
+    def body(s, carry):
+        kc, vc, m, num, den = carry
+        base = ((my_blk - s) % size) * T
+        if nc == 1:
+            m, num, den = fwd_chunk((m, num, den), kc, vc,
+                                    base + jnp.arange(T))
+        else:
+            def sub(c2, args):
+                kcc, vcc, j = args
+                return fwd_chunk(c2, kcc, vcc,
+                                 base + j * C + jnp.arange(C)), None
+
+            # the scan body's outputs vary over the manual sp axis (they
+            # mix in the varying q/kv shards), so the initial carry must
+            # be marked varying too (shard_map scan-vma typing)
+            init_c = tuple(_vary_like(x, axis_name) for x in (m, num, den))
+            (m, num, den), _ = lax.scan(
+                sub, init_c,
+                (_kv_chunk_axes(kc, nc, C), _kv_chunk_axes(vc, nc, C),
+                 jnp.arange(nc)))
         kc = lax.ppermute(kc, axis_name, perm=fwd)
         vc = lax.ppermute(vc, axis_name, perm=fwd)
-        return kc, vc, m_new, num, den
+        return kc, vc, m, num, den
 
     init = (
         k, v,
@@ -223,28 +292,29 @@ def _ring_fwd_impl(q, k, v, lengths, dropout_seed, axis_name, causal, scale,
 
 
 def _ring_fwd(q, k, v, axis_name, causal, scale, dropout_rate, lengths,
-              dropout_seed):
+              dropout_seed, chunk):
     out, lse = _ring_fwd_impl(q, k, v, lengths, dropout_seed, axis_name,
-                              causal, scale, dropout_rate)
+                              causal, scale, dropout_rate, chunk)
     return out, (q, k, v, out, lse, lengths, dropout_seed)
 
 
-def _ring_bwd(axis_name, causal, scale, dropout_rate, res, dout):
+def _ring_bwd(axis_name, causal, scale, dropout_rate, chunk, res, dout):
     q, k, v, out, lse, lengths, dropout_seed = res
     size, my_blk, fwd = _ring_steps(axis_name)
     B, H, T, Dh = q.shape
     if scale is None:
         scale = Dh ** -0.5
+    nc, C = _pick_chunk(T, chunk)
     qs = (q * jnp.asarray(scale, q.dtype)).astype(q.dtype)
     q_pos = my_blk * T + jnp.arange(T)
     do = dout
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)  # (B, H, T)
 
-    def body(s, carry):
-        kc, vc, dkc, dvc, dq = carry
-        kv_blk = (my_blk - s) % size
-        scores = _block_scores(qs, kc, kv_blk, q_pos, T, causal, lengths)
+    def bwd_chunk(dq, kcc, vcc, k_pos):
+        """One visiting KV sub-chunk's gradient contributions:
+        accumulates into dq, returns this chunk's (dk, dv)."""
+        scores = _chunk_scores(qs, kcc, k_pos, q_pos, causal, lengths)
         # p = softmax weights reconstructed from the saved logsumexp;
         # masked entries give exp(_NEG - lse) == 0 exactly — EXCEPT on a
         # fully-masked row, where lse itself is ~_NEG and the subtraction
@@ -255,21 +325,41 @@ def _ring_bwd(axis_name, causal, scale, dropout_rate, res, dout):
         if dropout_rate:
             # out = sum_k p_k * ks_k * v_k / den with den over un-dropped
             # p (see forward): d s_i = p_i * (ks_i * (do . v_i) - delta)
-            k_pos = kv_blk * T + jnp.arange(T)
-            ks = _dropout_keep_scale(dropout_seed, B, H, q_pos, k_pos,
-                                     dropout_rate)
-            pd = p * ks
+            pd = p * _dropout_keep_scale(dropout_seed, B, H, q_pos, k_pos,
+                                         dropout_rate)
         else:
             pd = p
-        dv_step = jnp.einsum("bhqk,bhqd->bhkd", pd.astype(do.dtype), do,
-                             preferred_element_type=jnp.float32)
-        dp = jnp.einsum("bhqd,bhkd->bhqk", do, vc,
+        dv_c = jnp.einsum("bhqk,bhqd->bhkd", pd.astype(do.dtype), do,
+                          preferred_element_type=jnp.float32)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", do, vcc,
                         preferred_element_type=jnp.float32)
         ds = pd * dp - p * delta[..., None]
-        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds.astype(kc.dtype), kc,
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds.astype(kcc.dtype), kcc,
                              preferred_element_type=jnp.float32)
-        dk_step = jnp.einsum("bhqk,bhqd->bhkd", ds.astype(qs.dtype), qs,
-                             preferred_element_type=jnp.float32)
+        dk_c = jnp.einsum("bhqk,bhqd->bhkd", ds.astype(qs.dtype), qs,
+                          preferred_element_type=jnp.float32)
+        return dq, dk_c, dv_c
+
+    def body(s, carry):
+        kc, vc, dkc, dvc, dq = carry
+        base = ((my_blk - s) % size) * T
+        if nc == 1:
+            dq, dk_step, dv_step = bwd_chunk(dq, kc, vc,
+                                             base + jnp.arange(T))
+        else:
+            def sub(dq2, args):
+                kcc, vcc, j = args
+                dq2, dk_c, dv_c = bwd_chunk(dq2, kcc, vcc,
+                                            base + j * C + jnp.arange(C))
+                return dq2, (dk_c, dv_c)
+
+            dq, (dks, dvs) = lax.scan(
+                sub, _vary_like(dq, axis_name),
+                (_kv_chunk_axes(kc, nc, C), _kv_chunk_axes(vc, nc, C),
+                 jnp.arange(nc)))
+            # (nc, B, H, C, Dh) stacked chunk grads -> (B, H, T, Dh)
+            dk_step = dks.transpose(1, 2, 0, 3, 4).reshape(B, H, T, Dh)
+            dv_step = dvs.transpose(1, 2, 0, 3, 4).reshape(B, H, T, Dh)
         # the dK/dV accumulators TRAVEL WITH their blocks: after the full
         # cycle each block is home again carrying every device's
         # contribution
@@ -297,7 +387,7 @@ ring_attention.defvjp(_ring_fwd, _ring_bwd)
 def ring_self_attention(q, k, v, mesh: Mesh, sp_axis: str = "sp",
                         causal: bool = False, scale: Optional[float] = None,
                         lengths=None, dropout_rate: float = 0.0,
-                        dropout_seed=None):
+                        dropout_seed=None, chunk: Optional[int] = None):
     """Standalone entry: q,k,v are global (B, H, T, Dh) arrays; the sequence
     dim is sharded over mesh axis `sp_axis` and attention is exact.
     `lengths` (global KV lengths) and the dropout seed are replicated."""
@@ -308,7 +398,7 @@ def ring_self_attention(q, k, v, mesh: Mesh, sp_axis: str = "sp",
 
     def body(q, k, v, lengths, seed):
         return ring_attention(q, k, v, sp_axis, causal, scale,
-                              dropout_rate, lengths, seed)
+                              dropout_rate, lengths, seed, chunk)
 
     fn = shard_map(
         body, mesh=mesh,

@@ -449,3 +449,52 @@ def test_ring_attention_dropout_mask_statistics():
     # kept entries carry the 1/(1-rate) inverted-dropout scale
     kept = ks[ks != 0.0]
     np.testing.assert_allclose(kept, 1.0 / (1 - rate), rtol=1e-6)
+
+
+@pytest.mark.parametrize("chunk", [8, 16, 32])
+def test_ring_attention_chunked_matches_unchunked(chunk):
+    """KV sub-chunking (the transient-memory bound for 100k+ sequences)
+    is numerically invisible: same values and grads as the whole-block
+    path, with causal + ragged lengths + dropout all on — the masks and
+    dropout are keyed on GLOBAL positions, so blocking can't shift them.
+    T_local = 32, so chunk=8/16 split each visiting block and chunk=32
+    degenerates to whole-block."""
+    mesh = default_mesh("sp")  # 8 shards
+    r = np.random.RandomState(29)
+    T = 256  # T_local = 32
+    q, k, v = (jnp.asarray(r.randn(2, 2, T, 16), jnp.float32) * 0.5
+               for _ in range(3))
+    lengths = jnp.asarray([T, 200], jnp.int32)
+    seed = jax.random.key_data(jax.random.PRNGKey(31)).astype(jnp.uint32)
+
+    def run(chunk_):
+        def loss(q, k, v):
+            o = ring_self_attention(
+                q, k, v, mesh, "sp", causal=True, lengths=lengths,
+                dropout_rate=0.25, dropout_seed=seed, chunk=chunk_)
+            return jnp.sum(jnp.sin(o)), o
+
+        (lv, o), grads = jax.value_and_grad(
+            loss, argnums=(0, 1, 2), has_aux=True)(q, k, v)
+        return np.asarray(o), [np.asarray(g) for g in grads]
+
+    o_ref, g_ref = run(None)  # T_local=32 < auto threshold: whole-block
+    o_c, g_c = run(chunk)
+    np.testing.assert_allclose(o_c, o_ref, rtol=2e-6, atol=2e-6)
+    for name, a, b in zip("qkv", g_c, g_ref):
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6,
+                                   err_msg="d%s diverged (chunk=%d)"
+                                   % (name, chunk))
+
+
+def test_ring_attention_chunk_validation():
+    from paddle_tpu.parallel.ring_attention import _pick_chunk
+
+    assert _pick_chunk(32, None) == (1, 32)          # small: whole block
+    assert _pick_chunk(4096, None) == (2, 2048)      # auto split
+    assert _pick_chunk(8192, None) == (4, 2048)
+    assert _pick_chunk(96, 32) == (3, 32)            # explicit divisor
+    with pytest.raises(ValueError, match="divide"):
+        _pick_chunk(100, 32)
+    # odd big block with no pow2 divisor >=128: stays whole
+    assert _pick_chunk(2049 * 3, None) == (1, 2049 * 3)
