@@ -479,37 +479,6 @@ def _bthd_smoke_gate():
     heads_env = _os.environ.get("BENCH_HEADS")
     if heads_env is not None and (D_MODEL // int(heads_env)) % 128 != 0:
         return None  # BTHD cannot engage at this head config
-    # memoize the verdict across bench invocations (sweep rows, driver
-    # rerun) — one hardware truth per machine boot; without this a
-    # hanging kernel would cost every sweep row the full smoke budget
-    import hashlib
-
-    kern = _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
-                         "paddle_tpu", "ops", "attention.py")
-    try:
-        with open(kern, "rb") as f:
-            ktag = hashlib.md5(f.read()).hexdigest()[:10]
-    except OSError:
-        ktag = "nokern"
-    memo = "%s/ptpu_bthd_smoke_%d_%s_%s" % (
-        __import__("tempfile").gettempdir(), _os.getuid(),
-        _os.environ.get("BENCH_PLATFORM") or "device", ktag)
-    if _os.environ.get("BENCH_BTHD_SMOKE") == "force":
-        _write_quiet(memo, "")  # drop any stale verdict and re-run
-    else:
-        try:
-            with open(memo) as f:
-                verdict = f.read().strip()
-            if verdict == "ok":
-                return None
-            if verdict == "ok-nofused":
-                _disable_fused_bwd()
-                return None
-            if verdict == "fail":
-                _os.environ["PADDLE_TPU_ATTN_BTHD"] = "0"
-                return None
-        except OSError:
-            pass
     import subprocess
     import sys
 
@@ -562,11 +531,15 @@ def check_grads(tag, grads, rgrads):
             % (tag, name, err))
 
 check_grads('bwd', grads, rgrads)
+# marker for the parent: everything up to here (the PLAIN BTHD fwd+bwd)
+# validated — any later death, Python exception (rc 3) or process-fatal
+# signal alike, indicts only the opt-in fused backward
+import sys
+print('SMOKE_PLAIN_OK', flush=True)
 # the opt-in single-pass fused backward (sweep rows enable it) must
 # match too; env is read at trace time, and these calls are un-jitted.
 # A fused-ONLY failure exits 3: the parent keeps the just-validated
 # plain BTHD layout and disables only the fused backward.
-import sys
 try:
     os.environ['PADDLE_TPU_FLASH_FUSED_BWD'] = '1'
     fval, fgrads = jax.value_and_grad(loss_bthd, argnums=(0, 1, 2))(q, k, v)
@@ -578,6 +551,40 @@ except Exception as e:
     sys.exit(3)
 """
     )
+    # memoize the verdict across bench invocations (sweep rows, driver
+    # rerun) — one hardware truth per machine boot; without this a
+    # hanging kernel would cost every sweep row the full smoke budget.
+    # The key hashes the kernel source AND the smoke code itself: a
+    # changed check/tolerance must re-run, not honor a stale verdict.
+    import hashlib
+
+    kern = _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                         "paddle_tpu", "ops", "attention.py")
+    h = hashlib.md5(code.encode())
+    try:
+        with open(kern, "rb") as f:
+            h.update(f.read())
+    except OSError:
+        h.update(b"nokern")
+    memo = "%s/ptpu_bthd_smoke_%d_%s_%s" % (
+        __import__("tempfile").gettempdir(), _os.getuid(),
+        plat or "device", h.hexdigest()[:10])
+    if _os.environ.get("BENCH_BTHD_SMOKE") == "force":
+        _write_quiet(memo, "")  # drop any stale verdict and re-run
+    else:
+        try:
+            with open(memo) as f:
+                verdict = f.read().strip()
+            if verdict == "ok":
+                return None
+            if verdict == "ok-nofused":
+                _disable_fused_bwd()
+                return None
+            if verdict == "fail":
+                _os.environ["PADDLE_TPU_ATTN_BTHD"] = "0"
+                return None
+        except OSError:
+            pass
     budget = int(_os.environ.get("BENCH_BTHD_SMOKE_TIMEOUT", 900))
     try:
         res = subprocess.run([sys.executable, "-c", code], timeout=budget,
@@ -595,28 +602,32 @@ except Exception as e:
         if problem is None:
             _write_quiet(memo, "fail")
         return problem
-    if res.returncode == 3:
-        # the PLAIN BTHD path just validated; only the opt-in fused
-        # backward mismatched — keep the layout, disable the one kernel
+    plain_ok = b"SMOKE_PLAIN_OK" in (res.stdout or b"")
+    if res.returncode == 3 or (res.returncode != 0 and plain_ok):
+        # the PLAIN BTHD path validated before the process died (clean
+        # exit 3 on a caught mismatch, or a process-fatal signal in the
+        # fused kernel) — keep the layout, disable the one kernel
         _write_quiet(memo, "ok-nofused")
         _disable_fused_bwd()
         tail = res.stderr.decode(errors="replace").strip().splitlines()
         print("bench: fused flash backward failed its numeric smoke "
-              "(%s); BTHD stays ON, PADDLE_TPU_FLASH_FUSED_BWD forced 0"
-              % (tail[-1][:160] if tail else "no stderr"), file=_sys.stderr)
+              "(rc %d: %s); BTHD stays ON, PADDLE_TPU_FLASH_FUSED_BWD "
+              "forced 0"
+              % (res.returncode, tail[-1][:160] if tail else "no stderr"),
+              file=_sys.stderr)
     elif res.returncode != 0:
         err = res.stderr.decode(errors="replace").strip()
         # memoize 'fail' only for DETERMINISTIC kernel rejections (Mosaic /
         # lowering / pallas errors reproduce every run); a one-off device
         # flake or unrelated import error must not poison later runs —
         # those retry next invocation (BENCH_BTHD_SMOKE=force also re-runs).
-        # Match against the exception MESSAGE lines: the traceback's last
-        # few lines (JAX may append its frame-filtering notice after the
-        # exception) with 'File "..."' frame lines dropped — a frame path
-        # like .../pallas/mosaic/lowering.py in an unfiltered traceback
-        # must not make a transient flake look deterministic.
+        # Match ONLY exception-MESSAGE lines — the non-indented lines of a
+        # traceback (its 'File "..."' frames AND their indented source-
+        # context lines live inside jax's pallas/mosaic modules, so any
+        # transient error raised there would otherwise look deterministic).
         tail = [l for l in err.splitlines()
-                if not l.lstrip().startswith('File "')]
+                if l and not l[0].isspace()
+                and not l.startswith("Traceback")]
         _os.environ["PADDLE_TPU_ATTN_BTHD"] = "0"
         msg = "\n".join(tail[-5:])
         deterministic = any(s in msg for s in (

@@ -167,8 +167,14 @@ class Executor:
         if check_nan_inf is None:
             check_nan_inf = os.environ.get("PADDLE_TPU_CHECK_NAN_INF", "0") == "1"
         self.check_nan_inf = check_nan_inf
+        import weakref
+
         self._cache: Dict = {}
-        self._read_ops: Dict = {}
+        # weak keys for the same reason as _steps below: _cache entries
+        # pin their program via _Compiled.program, but this cache holds
+        # no such ref, so an id-keyed entry could outlive its program
+        # and be served to a new one at the same address
+        self._read_ops = weakref.WeakKeyDictionary()
         # per-PROGRAM step counters (the RNG stream fold): running one
         # program (e.g. startup) must not advance another program's
         # stochastic-op stream, or the same training program draws
@@ -177,8 +183,6 @@ class Executor:
         # ParallelExecutor, whose counter is program-bound from step 0.
         # Weak keys: a dead program's counter must die with it, never be
         # inherited by a new program allocated at the same address
-        import weakref
-
         self._steps = weakref.WeakKeyDictionary()
         self._last_step = 0  # most recent step index (error messages)
         self._seed = 0
@@ -329,12 +333,12 @@ class Executor:
     def _read_ops_for(self, program: Program, gb):
         """(Static) read-op list, cached per program version so the hot
         path does not rescan every op each step."""
-        rkey = (id(program), program._version)
-        read_ops = self._read_ops.get(rkey)
-        if read_ops is None:
-            read_ops = [op for op in gb.ops if op.type == "read"]
-            self._read_ops[rkey] = read_ops  # grows like _cache: per version
-        return read_ops
+        entry = self._read_ops.get(program)
+        if entry is None or entry[0] != program._version:
+            entry = (program._version,
+                     [op for op in gb.ops if op.type == "read"])
+            self._read_ops[program] = entry
+        return entry[1]
 
     @staticmethod
     def _holder_for(gb, op):

@@ -58,6 +58,7 @@ class Predictor:
         self._aot_cache = aot_cache
         self._cache_dir = cache_dir or os.path.join(model_dir, _AOT_DIR)
         self._compiled: Dict = {}
+        self._touched: set = set()  # sigs whose USE this process recorded
         # params are resident device state, uploaded once at load
         self._state_names, self._state = self._load_state()
         self.traces = 0  # diagnostic: number of program traces performed
@@ -112,6 +113,13 @@ class Predictor:
         feed_sig = tuple((n, tuple(a.shape), str(a.dtype))
                          for n, a in sorted(feed_arrays.items()))
         if feed_sig in self._compiled:
+            if feed_sig not in self._touched:
+                # record USE (once per process per signature) so the
+                # preload cap's recency ordering tracks traffic, not
+                # write time
+                self._touched.add(feed_sig)
+                self._touch_sig(os.path.join(
+                    self._cache_dir, self._key(feed_sig) + ".sig"))
             return self._compiled[feed_sig]
         from .executor import Executor
 
@@ -130,6 +138,8 @@ class Predictor:
             sig_path = os.path.join(self._cache_dir, key + ".sig")
             if not os.path.exists(sig_path):
                 self._write_sig(feed_sig, key)
+            else:
+                self._touch_sig(sig_path)
         if loaded is None:
             fn = jax.jit(self._step_fn())
             lowered = fn.lower(
@@ -152,6 +162,13 @@ class Predictor:
                 self._write_sig(feed_sig, key)
         self._compiled[feed_sig] = loaded
         return loaded
+
+    @staticmethod
+    def _touch_sig(sig_path):
+        try:
+            os.utime(sig_path, None)
+        except OSError:
+            pass  # shared/read-only cache: recency just doesn't update
 
     def _write_sig(self, feed_sig, key: str):
         try:
@@ -192,10 +209,18 @@ class Predictor:
         cold tail instead of deserializing everything up front."""
         import glob
 
+        def mtime_or_zero(p):
+            # another process may clean/rewrite the shared cache between
+            # glob and stat; preload is best-effort, never a crash
+            try:
+                return os.path.getmtime(p)
+            except OSError:
+                return 0.0
+
         cap = int(os.environ.get("PADDLE_TPU_PRELOAD_MAX", 8))
         sig_paths = sorted(
             glob.glob(os.path.join(self._cache_dir, "*.sig")),
-            key=os.path.getmtime, reverse=True)
+            key=mtime_or_zero, reverse=True)
         for sig_path in sig_paths:
             if cap <= 0:
                 break

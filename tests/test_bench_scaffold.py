@@ -84,9 +84,10 @@ def test_head_ladder_respects_explicit_heads(monkeypatch):
 
 
 class _FakeRes:
-    def __init__(self, returncode, stderr=b""):
+    def __init__(self, returncode, stderr=b"", stdout=b""):
         self.returncode = returncode
         self.stderr = stderr
+        self.stdout = stdout
 
 
 def _gate_env(monkeypatch, tmp_path, fake_res):
@@ -151,3 +152,35 @@ def test_smoke_gate_frame_lines_not_deterministic(monkeypatch, tmp_path):
     assert bench._bthd_smoke_gate() is None
     assert os.environ.get("PADDLE_TPU_ATTN_BTHD") == "0"
     assert list(_memo_files(tmp_path).values()) == ["fail"]
+
+
+def test_smoke_gate_signal_after_plain_ok_keeps_bthd(monkeypatch, tmp_path):
+    """A process-FATAL death (segfault rc<0) after the SMOKE_PLAIN_OK
+    marker indicts only the fused kernel: BTHD survives, fused disabled,
+    'ok-nofused' memoized — even though stderr mentions Mosaic."""
+    import os
+
+    _gate_env(monkeypatch, tmp_path,
+              _FakeRes(-11, b"Mosaic kernel dump ...",
+                       stdout=b"SMOKE_PLAIN_OK\n"))
+    assert bench._bthd_smoke_gate() is None
+    assert os.environ.get("PADDLE_TPU_ATTN_BTHD") is None
+    assert os.environ.get("PADDLE_TPU_FLASH_FUSED_BWD") == "0"
+    assert list(_memo_files(tmp_path).values()) == ["ok-nofused"]
+
+
+def test_smoke_gate_source_context_lines_not_deterministic(monkeypatch,
+                                                           tmp_path):
+    """Indented source-CONTEXT lines of a traceback (which quote jax's
+    pallas/mosaic internals) must not classify a transient error as
+    deterministic; only the exception message lines count."""
+    import os
+
+    flake = (b'Traceback (most recent call last):\n'
+             b'  File "/x/jax/_src/pallas/mosaic/lowering.py", line 7\n'
+             b'    return mosaic_tpu_lowering(ctx, *args)\n'
+             b'XlaRuntimeError: UNAVAILABLE: connection reset')
+    _gate_env(monkeypatch, tmp_path, _FakeRes(1, flake))
+    assert bench._bthd_smoke_gate() is None
+    assert os.environ.get("PADDLE_TPU_ATTN_BTHD") == "0"
+    assert _memo_files(tmp_path) == {}  # transient: NOT memoized

@@ -118,10 +118,13 @@ def main():
         out, = p2.run({"img": batch})  # return_numpy fences device->host
         times.append((time.perf_counter() - t0) * 1e3)
     times.sort()
+    import math
+
+    p99_idx = max(0, math.ceil(0.99 * len(times)) - 1)
     _emit({"phase": "predictor_latency", "batch": BATCH,
            "run_ms_min": round(times[0], 3),
            "run_ms_p50": round(times[len(times) // 2], 3),
-           "run_ms_p99": round(times[int(len(times) * 0.99) - 1], 3),
+           "run_ms_p99": round(times[p99_idx], 3),
            "iters": iters})
 
     # -- PredictorServer dynamic-batching throughput ---------------------
