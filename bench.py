@@ -3,9 +3,17 @@
 Prints ONE JSON line. Primary metric: transformer LM tokens/sec/chip with
 "vs_baseline" = achieved_MFU / 0.50 (the north-star 50% MFU target from
 BASELINE.json; the reference publishes no numbers). The same line carries
-a "resnet50" object with images/sec/chip + conv MFU (BASELINE.json
-configs[1]: "ResNet-50 ImageNet on single TPU",
-reference benchmark/fluid/resnet.py:1). Set BENCH_RESNET=0 to skip it.
+secondary phase objects covering the rest of BASELINE.json's configs:
+- "resnet50": images/sec/chip + conv MFU (BASELINE.json configs[1],
+  reference benchmark/fluid/models/resnet.py:1); BENCH_RESNET=0 skips.
+- "stacked_lstm": words/sec/chip for the scan-heavy RNN workload
+  (reference benchmark/fluid/models/stacked_dynamic_lstm.py:1);
+  BENCH_LSTM=0 skips.
+- "deepfm": rows/sec/chip for the embedding-bound CTR workload
+  (reference paddle/fluid/operators/lookup_table_op.cc:1);
+  BENCH_DEEPFM=0 skips.
+BENCH_LM=0 skips the LM phase itself (sweep rows that only need a
+secondary phase; the headline value is then null by design).
 
 The whole training step (fwd + bwd + optimizer) is one donated jax.jit
 XLA computation produced by tracing the Program — see executor.py.
@@ -75,6 +83,33 @@ RN_STEPS = int(_os.environ.get("BENCH_RN_STEPS", 10))
 RN_WARMUP = int(_os.environ.get("BENCH_RN_WARMUP", 2))
 # fwd matmul+conv FLOPs for ResNet-50 @224 (4.09 GMACs, fvcore-style count)
 RN_FWD_FLOPS_PER_IMG = 2 * 4.089e9
+
+# Stacked dynamic LSTM config (VERDICT r4 item 3 — the scan-heavy RNN half
+# of BASELINE.json: IMDB sentiment, reference
+# benchmark/fluid/models/stacked_dynamic_lstm.py:1 — emb 512, lstm 512,
+# stacked 3; the reference feeds ragged LoD batches cropped at 1500 words,
+# our dense+lengths convention pads to a static BENCH_LSTM_SEQ instead)
+LSTM_BATCH = int(_os.environ.get("BENCH_LSTM_BATCH", 32))
+LSTM_SEQ = int(_os.environ.get("BENCH_LSTM_SEQ", 512))
+LSTM_DICT = int(_os.environ.get("BENCH_LSTM_DICT", 30000))
+LSTM_EMB = 512
+LSTM_HID = int(_os.environ.get("BENCH_LSTM_HID", 512))
+LSTM_STACK = int(_os.environ.get("BENCH_LSTM_STACK", 3))
+LSTM_STEPS = int(_os.environ.get("BENCH_LSTM_STEPS", 10))
+LSTM_WARMUP = int(_os.environ.get("BENCH_LSTM_WARMUP", 2))
+
+# DeepFM CTR config (VERDICT r4 item 3 — the embedding-bound half:
+# Criteo-shaped 26 categorical fields + 13 dense over a 1M-row hashed
+# table; the reference serves this through lookup_table with SelectedRows
+# gradients + a parameter server —
+# paddle/fluid/operators/lookup_table_op.cc:1 — our path is a dense
+# gather forward + scatter-add gradient, the TPU-native equivalent)
+DFM_BATCH = int(_os.environ.get("BENCH_DFM_BATCH", 4096))
+DFM_FEATURES = int(_os.environ.get("BENCH_DFM_FEATURES", 1000000))
+DFM_FIELDS = int(_os.environ.get("BENCH_DFM_FIELDS", 26))
+DFM_DENSE = int(_os.environ.get("BENCH_DFM_DENSE", 13))
+DFM_STEPS = int(_os.environ.get("BENCH_DFM_STEPS", 10))
+DFM_WARMUP = int(_os.environ.get("BENCH_DFM_WARMUP", 2))
 
 _PEAK_FLOPS = {
     # bf16 peak matmul FLOP/s per chip
@@ -249,25 +284,20 @@ def bench_lm_ladder(dev):
     raise head_err
 
 
-def bench_lm(dev, batch, n_head=None):
+def _bench_phase(dev, build, feed, warmup, steps, stage=True):
+    """Shared phase scaffold (every bench phase differs only in its model
+    builder and feed): seeded Program/Scope, `build()` under the program
+    guards returning the loss var (the builder also calls minimize), AMP
+    + optional remat transpilation, startup init, optional device staging
+    of the feed, slope timing. Returns (dt_per_step, last_loss)."""
     import paddle_tpu as fluid
-    from paddle_tpu import layers, models, optimizer
 
     main_p, startup = fluid.Program(), fluid.Program()
     main_p.random_seed = startup.random_seed = 1
     scope = fluid.Scope()
     with fluid.scope_guard(scope), fluid.program_guard(main_p, startup):
         with fluid.unique_name.guard():
-            ids = layers.data(name="ids", shape=[batch, SEQ], dtype="int64",
-                              append_batch_size=False)
-            labels = layers.data(name="labels", shape=[batch, SEQ],
-                                 dtype="int64", append_batch_size=False)
-            loss, _ = models.transformer.transformer_lm(
-                ids, labels, vocab_size=VOCAB, n_layer=N_LAYER,
-                n_head=n_head if n_head is not None else N_HEAD,
-                d_model=D_MODEL, d_inner=D_INNER, max_len=SEQ,
-                fused_qkv=_os.environ.get("PADDLE_TPU_FUSED_QKV", "0") == "1")
-            optimizer.Adam(learning_rate=1e-4).minimize(loss)
+            loss = build()
         if AMP:
             # bf16 matmuls, fp32 master weights; BENCH_AMP_LEVEL=O2 keeps
             # the elementwise path (residual stream) in bf16 too
@@ -281,15 +311,35 @@ def bench_lm(dev, batch, n_head=None):
         exe = fluid.Executor(fluid.TPUPlace() if dev.platform != "cpu"
                              else fluid.CPUPlace())
         exe.run(startup)
+        if stage:
+            feed = _stage_feed(feed, dev)
+        return _timed_exec(exe, main_p, feed, loss, warmup, steps)
 
-        r = np.random.RandomState(0)
-        feed = {
-            "ids": r.randint(0, VOCAB, (batch, SEQ)).astype(np.int64),
-            "labels": r.randint(0, VOCAB, (batch, SEQ)).astype(np.int64),
-        }
-        # NOTE: the LM feed stays numpy (128 KB/step is cheap; one upload
-        # per run_loop call in the default device-loop mode).
-        dt, loss_val = _timed_exec(exe, main_p, feed, loss, WARMUP, STEPS)
+
+def bench_lm(dev, batch, n_head=None):
+    from paddle_tpu import layers, models, optimizer
+
+    def build():
+        ids = layers.data(name="ids", shape=[batch, SEQ], dtype="int64",
+                          append_batch_size=False)
+        labels = layers.data(name="labels", shape=[batch, SEQ],
+                             dtype="int64", append_batch_size=False)
+        loss, _ = models.transformer.transformer_lm(
+            ids, labels, vocab_size=VOCAB, n_layer=N_LAYER,
+            n_head=n_head if n_head is not None else N_HEAD,
+            d_model=D_MODEL, d_inner=D_INNER, max_len=SEQ,
+            fused_qkv=_os.environ.get("PADDLE_TPU_FUSED_QKV", "0") == "1")
+        optimizer.Adam(learning_rate=1e-4).minimize(loss)
+        return loss
+
+    r = np.random.RandomState(0)
+    feed = {
+        "ids": r.randint(0, VOCAB, (batch, SEQ)).astype(np.int64),
+        "labels": r.randint(0, VOCAB, (batch, SEQ)).astype(np.int64),
+    }
+    # the LM feed stays numpy (128 KB/step is cheap; one upload per
+    # run_loop call in the default device-loop mode)
+    dt, loss_val = _bench_phase(dev, build, feed, WARMUP, STEPS, stage=False)
 
     mfu = _train_flops_per_step(batch) / dt / _peak_flops(dev)
     return {
@@ -303,37 +353,24 @@ def bench_lm(dev, batch, n_head=None):
 
 
 def bench_resnet(dev):
-    import paddle_tpu as fluid
     from paddle_tpu import models, optimizer
 
-    main_p, startup = fluid.Program(), fluid.Program()
-    main_p.random_seed = startup.random_seed = 1
-    scope = fluid.Scope()
-    with fluid.scope_guard(scope), fluid.program_guard(main_p, startup):
-        with fluid.unique_name.guard():
-            avg_cost, acc, feeds = models.resnet.get_model(
-                dataset="imagenet", depth=50)
-            optimizer.Momentum(learning_rate=0.1, momentum=0.9).minimize(
-                avg_cost)
-        if AMP:
-            main_p.enable_mixed_precision(
-                level=_os.environ.get("BENCH_AMP_LEVEL", "O1"))
+    def build():
+        avg_cost, acc, feeds = models.resnet.get_model(
+            dataset="imagenet", depth=50)
+        optimizer.Momentum(learning_rate=0.1, momentum=0.9).minimize(
+            avg_cost)
+        return avg_cost
 
-        exe = fluid.Executor(fluid.TPUPlace() if dev.platform != "cpu"
-                             else fluid.CPUPlace())
-        exe.run(startup)
-
-        r = np.random.RandomState(0)
-        feed = {
-            "data": r.randn(RN_BATCH, 3, 224, 224).astype(np.float32),
-            "label": r.randint(0, 1000, (RN_BATCH, 1)).astype(np.int64),
-        }
-        # the image batch (~77 MB at batch 128) must live on device:
-        # re-uploading it every step through the tunneled TPU costs ~100x
-        # the step's compute
-        feed = _stage_feed(feed, dev)
-        dt, loss_val = _timed_exec(exe, main_p, feed, avg_cost,
-                                   RN_WARMUP, RN_STEPS)
+    r = np.random.RandomState(0)
+    feed = {
+        "data": r.randn(RN_BATCH, 3, 224, 224).astype(np.float32),
+        "label": r.randint(0, 1000, (RN_BATCH, 1)).astype(np.int64),
+    }
+    # the image batch (~77 MB at batch 128) must live on device (staged):
+    # re-uploading it every step through the tunneled TPU costs ~100x
+    # the step's compute
+    dt, loss_val = _bench_phase(dev, build, feed, RN_WARMUP, RN_STEPS)
 
     mfu = 3.0 * RN_FWD_FLOPS_PER_IMG * RN_BATCH / dt / _peak_flops(dev)
     res = {
@@ -433,6 +470,91 @@ def _bench_resnet_reader(dev, synthetic):
             100.0 * (dt * 1e3 / synthetic["step_ms"] - 1.0), 1),
         "loss": loss_val,
         "window_steps": steps,
+    }
+
+
+def _lstm_train_flops_per_step() -> float:
+    """Analytic matmul FLOPs for the stacked LSTM step (fwd gate/fc
+    matmuls; bwd = 2x fwd). Embedding gathers and pools are not matmul
+    FLOPs — the MFU here measures how well lax.scan keeps the MXU busy
+    on the per-timestep (B, hid) x (hid, 4*hid) gate matmuls."""
+    tokens = LSTM_BATCH * LSTM_SEQ
+    g = 4 * LSTM_HID
+    p = LSTM_EMB * g + LSTM_HID * g  # fc1 + lstm1 recurrent
+    # stacked layers: fc over concat(fc_prev, lstm_prev) + recurrent
+    p += (LSTM_STACK - 1) * ((g + LSTM_HID) * g + LSTM_HID * g)
+    return 3.0 * 2.0 * tokens * p
+
+
+def bench_stacked_lstm(dev):
+    """Stacked dynamic LSTM training throughput (words/s/chip). The whole
+    step is one jitted XLA computation whose RNN layers are lax.scan
+    loops — exactly the path whose TPU cost a CUDA-per-op design never
+    predicts (VERDICT r4 item 3)."""
+    from paddle_tpu import models, optimizer
+
+    def build():
+        avg_cost, acc, feeds = models.stacked_lstm.get_model(
+            dict_dim=LSTM_DICT, seq_len=LSTM_SEQ, emb_dim=LSTM_EMB,
+            hid_dim=LSTM_HID, stacked_num=LSTM_STACK)
+        optimizer.Adam(learning_rate=1e-3).minimize(avg_cost)
+        return avg_cost
+
+    r = np.random.RandomState(0)
+    feed = {
+        "words": r.randint(0, LSTM_DICT,
+                           (LSTM_BATCH, LSTM_SEQ)).astype(np.int64),
+        # full lengths: every padded position is a real word, so
+        # words/s counts the tokens actually computed
+        "lengths": np.full((LSTM_BATCH,), LSTM_SEQ, np.int32),
+        "label": r.randint(0, 2, (LSTM_BATCH, 1)).astype(np.int64),
+    }
+    dt, loss_val = _bench_phase(dev, build, feed, LSTM_WARMUP, LSTM_STEPS)
+
+    mfu = _lstm_train_flops_per_step() / dt / _peak_flops(dev)
+    return {
+        "words_per_sec": round(LSTM_BATCH * LSTM_SEQ / dt, 1),
+        "mfu": round(mfu, 4),
+        "step_ms": round(dt * 1e3, 2),
+        "loss": loss_val,
+        "batch": LSTM_BATCH,
+        "seq": LSTM_SEQ,
+        "hid": LSTM_HID,
+        "stacked": LSTM_STACK,
+    }
+
+
+def bench_deepfm(dev):
+    """DeepFM CTR training throughput (rows/s/chip). Embedding-bound:
+    the step gathers (B*F) rows of a 1M x K table forward and
+    scatter-adds the same rows backward — the path where a TPU rebuild
+    of a SelectedRows/pserver design can silently be 10x off
+    (VERDICT r4 item 3)."""
+    from paddle_tpu import models, optimizer
+
+    def build():
+        avg_cost, prob, feeds = models.deepfm.get_model(
+            num_features=DFM_FEATURES, num_fields=DFM_FIELDS,
+            dense_dim=DFM_DENSE)
+        optimizer.Adam(learning_rate=1e-3).minimize(avg_cost)
+        return avg_cost
+
+    r = np.random.RandomState(0)
+    feed = {
+        "feat_ids": r.randint(0, DFM_FEATURES,
+                              (DFM_BATCH, DFM_FIELDS)).astype(np.int64),
+        "dense": r.rand(DFM_BATCH, DFM_DENSE).astype(np.float32),
+        "label": r.randint(0, 2, (DFM_BATCH, 1)).astype(np.int64),
+    }
+    dt, loss_val = _bench_phase(dev, build, feed, DFM_WARMUP, DFM_STEPS)
+
+    return {
+        "rows_per_sec": round(DFM_BATCH / dt, 1),
+        "step_ms": round(dt * 1e3, 2),
+        "loss": loss_val,
+        "batch": DFM_BATCH,
+        "features": DFM_FEATURES,
+        "fields": DFM_FIELDS,
     }
 
 
@@ -699,31 +821,49 @@ def main():
     import jax
 
     dev = jax.devices()[0]
-    lm = bench_lm_ladder(dev)
-    result = {
-        "metric": "transformer_lm_train_tokens_per_sec_per_chip",
-        "value": lm["value"],
-        "unit": "tokens/s",
-        "vs_baseline": round(lm["mfu"] / 0.50, 4),
-        "mfu": lm["mfu"],
-        "step_ms": lm["step_ms"],
-        "loss": lm["loss"],
-        "device": getattr(dev, "device_kind", dev.platform),
-        "config": {"batch": lm["batch"], "seq": SEQ, "vocab": VOCAB,
-                   "layers": N_LAYER, "d_model": D_MODEL,
-                   "n_head": lm["n_head"],
-                   "attn_bthd": _os.environ.get("PADDLE_TPU_ATTN_BTHD", "1"),
-                   "amp_level": _os.environ.get("BENCH_AMP_LEVEL", "O1")},
-    }
+    if _os.environ.get("BENCH_LM", "1") == "1":
+        lm = bench_lm_ladder(dev)
+        result = {
+            "metric": "transformer_lm_train_tokens_per_sec_per_chip",
+            "value": lm["value"],
+            "unit": "tokens/s",
+            "vs_baseline": round(lm["mfu"] / 0.50, 4),
+            "mfu": lm["mfu"],
+            "step_ms": lm["step_ms"],
+            "loss": lm["loss"],
+            "device": getattr(dev, "device_kind", dev.platform),
+            "config": {"batch": lm["batch"], "seq": SEQ, "vocab": VOCAB,
+                       "layers": N_LAYER, "d_model": D_MODEL,
+                       "n_head": lm["n_head"],
+                       "attn_bthd": _os.environ.get("PADDLE_TPU_ATTN_BTHD", "1"),
+                       "amp_level": _os.environ.get("BENCH_AMP_LEVEL", "O1")},
+        }
+    else:
+        # sweep rows measuring only a secondary phase skip the LM compile
+        # (tunnel time is the scarce resource); the headline stays null so
+        # a driver parsing this line can't mistake it for an LM number
+        result = {
+            "metric": "transformer_lm_train_tokens_per_sec_per_chip",
+            "value": None, "unit": "tokens/s", "vs_baseline": None,
+            "note": "BENCH_LM=0 (secondary-phase row)",
+            "device": getattr(dev, "device_kind", dev.platform),
+        }
+    phases = []
     if _os.environ.get("BENCH_RESNET", "1") == "1":
-        # flush the primary metric first: if the ResNet phase is killed
-        # (timeout through the TPU tunnel), the LM line is still the last
-        # complete JSON line on stdout for the driver to parse
+        phases.append(("resnet50", bench_resnet))
+    if _os.environ.get("BENCH_LSTM", "1") == "1":
+        phases.append(("stacked_lstm", bench_stacked_lstm))
+    if _os.environ.get("BENCH_DEEPFM", "1") == "1":
+        phases.append(("deepfm", bench_deepfm))
+    for name, phase in phases:
+        # flush what we have before each risky phase: if it is killed
+        # (timeout through the TPU tunnel), the flushed line is still the
+        # last complete JSON line on stdout for the driver to parse
         print(json.dumps(result), flush=True)
         try:
-            result["resnet50"] = bench_resnet(dev)
-        except Exception as e:  # keep the primary metric even if rn fails
-            result["resnet50"] = {"error": repr(e)[:200]}
+            result[name] = phase(dev)
+        except Exception as e:  # keep earlier metrics even if this fails
+            result[name] = {"error": repr(e)[:200]}
     print(json.dumps(result))
 
 

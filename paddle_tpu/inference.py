@@ -21,6 +21,7 @@ import hashlib
 import os
 import pickle
 import threading
+import warnings
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -217,7 +218,15 @@ class Predictor:
             except OSError:
                 return 0.0
 
-        cap = int(os.environ.get("PADDLE_TPU_PRELOAD_MAX", 8))
+        try:
+            cap = int(os.environ.get("PADDLE_TPU_PRELOAD_MAX", 8))
+        except ValueError:
+            # preload is best-effort, never a crash: a malformed value
+            # falls back to the default (PADDLE_TPU_RING_CHUNK precedent)
+            warnings.warn(
+                "PADDLE_TPU_PRELOAD_MAX=%r is not an integer; using 8"
+                % os.environ.get("PADDLE_TPU_PRELOAD_MAX"))
+            cap = 8
         sig_paths = sorted(
             glob.glob(os.path.join(self._cache_dir, "*.sig")),
             key=mtime_or_zero, reverse=True)

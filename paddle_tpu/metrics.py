@@ -1,8 +1,9 @@
 """Python-side metric accumulators.
 
-Reference: python/paddle/fluid/metrics.py — numpy state updated from fetched
-step outputs; nothing here touches the device (fetches are already host
-arrays), so the API carries over unchanged.
+Reference capability: python/paddle/fluid/metrics.py — numpy state folded
+in from fetched step outputs; nothing here touches the device (fetches
+are already host arrays), so the API carries over while the accumulator
+internals are vectorized numpy.
 """
 from __future__ import annotations
 
@@ -14,22 +15,34 @@ __all__ = [
 ]
 
 
-def _is_numpy_(var):
-    return isinstance(var, (np.ndarray, np.generic))
+def _scalar(v, kind=float):
+    """First element of a fetch as a python scalar (fetches arrive as
+    0-d/1-element arrays or plain numbers)."""
+    return kind(np.asarray(v).reshape(-1)[0])
 
 
-def _is_number_(var):
-    return isinstance(var, (int, float, np.float32, np.float64)) or (
-        _is_numpy_(var) and var.size == 1)
+def _require_numeric(name, v):
+    """Accept numbers and ndarrays; reject anything a fetch can't be."""
+    if isinstance(v, (int, float, np.generic, np.ndarray)):
+        return
+    raise ValueError(
+        "%s expects a python number or numpy array, got %s"
+        % (name, type(v).__name__))
 
 
-def _is_number_or_matrix_(var):
-    return _is_number_(var) or _is_numpy_(var)
+def _require_weight(name, w):
+    if isinstance(w, (int, float, np.generic)) or (
+            isinstance(w, np.ndarray) and w.size == 1):
+        return
+    raise ValueError(
+        "%s expects a scalar weight, got %s" % (name, type(w).__name__))
 
 
 class MetricBase(object):
     """Base: reset() zeroes the numpy state, update() folds in a step's
-    outputs, eval() returns the aggregate (metrics.py:MetricBase)."""
+    outputs, eval() returns the aggregate (capability of
+    metrics.py:MetricBase). Public (non-underscore) attributes are the
+    accumulator state."""
 
     def __init__(self, name):
         self._name = str(name) if name is not None else self.__class__.__name__
@@ -38,36 +51,29 @@ class MetricBase(object):
         return self._name
 
     def reset(self):
-        states = {
-            attr: value
-            for attr, value in self.__dict__.items()
-            if not attr.startswith("_")
-        }
-        for attr, value in states.items():
-            if isinstance(value, int):
-                setattr(self, attr, 0)
-            elif isinstance(value, float):
-                setattr(self, attr, 0.0)
-            elif isinstance(value, (np.ndarray, np.generic)):
-                setattr(self, attr, np.zeros_like(value))
+        for attr, value in list(self.__dict__.items()):
+            if attr.startswith("_"):
+                continue
+            if isinstance(value, (np.ndarray, np.generic)):
+                zero = np.zeros_like(value)
+            elif isinstance(value, (int, float)):
+                zero = type(value)(0)
             else:
-                setattr(self, attr, None)
+                zero = None
+            setattr(self, attr, zero)
 
     def get_config(self):
-        states = {
-            attr: value
-            for attr, value in self.__dict__.items()
-            if not attr.startswith("_")
-        }
-        config = {}
-        config.update({"name": self._name, "states": states})
-        return config
+        states = {a: v for a, v in self.__dict__.items()
+                  if not a.startswith("_")}
+        return {"name": self._name, "states": states}
 
     def update(self, preds, labels):
-        raise NotImplementedError()
+        raise NotImplementedError(
+            "%s must implement update()" % self.__class__.__name__)
 
     def eval(self):
-        raise NotImplementedError()
+        raise NotImplementedError(
+            "%s must implement eval()" % self.__class__.__name__)
 
 
 class CompositeMetric(MetricBase):
@@ -78,9 +84,12 @@ class CompositeMetric(MetricBase):
         self._metrics = []
 
     def add_metric(self, metric):
-        if not isinstance(metric, MetricBase):
-            raise ValueError("SubMetric should be inherit from MetricBase.")
-        self._metrics.append(metric)
+        if isinstance(metric, MetricBase):
+            self._metrics.append(metric)
+            return
+        raise ValueError(
+            "CompositeMetric.add_metric wants a MetricBase instance, "
+            "got %s" % type(metric).__name__)
 
     def update(self, preds, labels):
         for m in self._metrics:
@@ -91,7 +100,7 @@ class CompositeMetric(MetricBase):
 
 
 class Precision(MetricBase):
-    """Binary precision over 0/1 preds vs labels (metrics.py:Precision)."""
+    """Binary precision over 0/1 preds vs labels."""
 
     def __init__(self, name=None):
         super().__init__(name)
@@ -99,19 +108,19 @@ class Precision(MetricBase):
         self.fp = 0
 
     def update(self, preds, labels):
-        preds = np.asarray(preds)
-        labels = np.asarray(labels)
-        preds = np.rint(preds).astype(np.int64).reshape(-1)
+        preds = np.rint(np.asarray(preds)).astype(np.int64).reshape(-1)
         labels = np.asarray(labels).astype(np.int64).reshape(-1)
         self.tp += int(np.sum((preds == 1) & (labels == 1)))
         self.fp += int(np.sum((preds == 1) & (labels == 0)))
 
     def eval(self):
-        ap = self.tp + self.fp
-        return float(self.tp) / ap if ap != 0 else 0.0
+        predicted_pos = self.tp + self.fp
+        return float(self.tp) / predicted_pos if predicted_pos else 0.0
 
 
 class Recall(MetricBase):
+    """Binary recall over 0/1 preds vs labels."""
+
     def __init__(self, name=None):
         super().__init__(name)
         self.tp = 0
@@ -124,13 +133,13 @@ class Recall(MetricBase):
         self.fn += int(np.sum((preds == 0) & (labels == 1)))
 
     def eval(self):
-        recall = self.tp + self.fn
-        return float(self.tp) / recall if recall != 0 else 0.0
+        actual_pos = self.tp + self.fn
+        return float(self.tp) / actual_pos if actual_pos else 0.0
 
 
 class Accuracy(MetricBase):
-    """Weighted running mean of per-batch accuracy values
-    (metrics.py:Accuracy — pairs with layers.accuracy fetches)."""
+    """Weighted running mean of per-batch accuracy values (pairs with
+    layers.accuracy fetches)."""
 
     def __init__(self, name=None):
         super().__init__(name)
@@ -138,16 +147,16 @@ class Accuracy(MetricBase):
         self.weight = 0.0
 
     def update(self, value, weight):
-        if not _is_number_or_matrix_(value):
-            raise ValueError("The 'value' must be a number(int, float) or a numpy ndarray.")
-        if not _is_number_(weight):
-            raise ValueError("The 'weight' must be a number(int, float).")
-        self.value += float(np.asarray(value).reshape(-1)[0]) * weight
+        _require_numeric("Accuracy.update(value)", value)
+        _require_weight("Accuracy.update(weight)", weight)
+        self.value += _scalar(value) * weight
         self.weight += weight
 
     def eval(self):
         if self.weight == 0:
-            raise ValueError("There is no data in Accuracy Metrics. Please check layers.accuracy output has added to Accuracy.")
+            raise ValueError(
+                "Accuracy has accumulated nothing — feed it the fetched "
+                "layers.accuracy output via update() before eval()")
         return self.value / self.weight
 
 
@@ -162,23 +171,22 @@ class ChunkEvaluator(MetricBase):
         self.num_correct_chunks = 0
 
     def update(self, num_infer_chunks, num_label_chunks, num_correct_chunks):
-        for v in (num_infer_chunks, num_label_chunks, num_correct_chunks):
-            if not _is_number_or_matrix_(v):
-                raise ValueError("The 'chunk counts' must be a number(int, float) or a numpy ndarray.")
-        self.num_infer_chunks += int(np.asarray(num_infer_chunks).reshape(-1)[0])
-        self.num_label_chunks += int(np.asarray(num_label_chunks).reshape(-1)[0])
-        self.num_correct_chunks += int(np.asarray(num_correct_chunks).reshape(-1)[0])
+        for tag, v in (("num_infer_chunks", num_infer_chunks),
+                       ("num_label_chunks", num_label_chunks),
+                       ("num_correct_chunks", num_correct_chunks)):
+            _require_numeric("ChunkEvaluator.update(%s)" % tag, v)
+        self.num_infer_chunks += _scalar(num_infer_chunks, int)
+        self.num_label_chunks += _scalar(num_label_chunks, int)
+        self.num_correct_chunks += _scalar(num_correct_chunks, int)
 
     def eval(self):
-        precision = (
-            float(self.num_correct_chunks) / self.num_infer_chunks
-            if self.num_infer_chunks else 0.0)
-        recall = (
-            float(self.num_correct_chunks) / self.num_label_chunks
-            if self.num_label_chunks else 0.0)
-        f1_score = (
-            2 * precision * recall / (precision + recall)
-            if self.num_correct_chunks else 0.0)
+        correct = float(self.num_correct_chunks)
+        precision = correct / self.num_infer_chunks \
+            if self.num_infer_chunks else 0.0
+        recall = correct / self.num_label_chunks \
+            if self.num_label_chunks else 0.0
+        f1_score = 2 * precision * recall / (precision + recall) \
+            if self.num_correct_chunks else 0.0
         return precision, recall, f1_score
 
 
@@ -193,26 +201,26 @@ class EditDistance(MetricBase):
         self.instance_error = 0
 
     def update(self, distances, seq_num):
-        if not _is_numpy_(distances):
-            distances = np.asarray(distances, np.float64)
-        seq_right_count = int(np.sum(distances == 0))
-        total_distance = float(np.sum(distances))
-        seq_num = int(np.asarray(seq_num).reshape(-1)[0])
-        self.seq_num += seq_num
-        self.instance_error += seq_num - seq_right_count
-        self.total_distance += total_distance
+        distances = np.asarray(distances, np.float64)
+        n = _scalar(seq_num, int)
+        self.seq_num += n
+        self.instance_error += n - int(np.sum(distances == 0))
+        self.total_distance += float(np.sum(distances))
 
     def eval(self):
         if self.seq_num == 0:
-            raise ValueError("There is no data in EditDistance Metric. Please check layers.edit_distance output has been added to EditDistance.")
-        avg_distance = self.total_distance / self.seq_num
-        avg_instance_error = self.instance_error / float(self.seq_num)
-        return avg_distance, avg_instance_error
+            raise ValueError(
+                "EditDistance has accumulated nothing — feed it the fetched "
+                "layers.edit_distance outputs via update() before eval()")
+        return (self.total_distance / self.seq_num,
+                self.instance_error / float(self.seq_num))
 
 
 class Auc(MetricBase):
-    """Threshold-bucketed ROC AUC over (N, 2) probabilities
-    (metrics.py:Auc; the reference's python fallback path)."""
+    """Threshold-bucketed ROC AUC over (N, C) probabilities (the last
+    column is the positive-class probability). Buckets accumulate
+    vectorized: one (T, N) comparison per update instead of a python
+    loop over thresholds."""
 
     def __init__(self, name=None, curve="ROC", num_thresholds=200):
         super().__init__(name)
@@ -221,48 +229,42 @@ class Auc(MetricBase):
         self._curve = curve
         self._num_thresholds = num_thresholds
         self._epsilon = 1e-6
+        # threshold grid: interior points i/(T-1), endpoints nudged past
+        # [0, 1] so every probability lands strictly inside the sweep
+        eps = 1e-7
+        self._thresholds = np.concatenate([
+            [-eps],
+            np.arange(1, num_thresholds - 1) / float(num_thresholds - 1),
+            [1.0 + eps]])
         self.tp_list = np.zeros((num_thresholds,))
         self.fn_list = np.zeros((num_thresholds,))
         self.tn_list = np.zeros((num_thresholds,))
         self.fp_list = np.zeros((num_thresholds,))
 
     def update(self, preds, labels):
-        if not _is_numpy_(labels):
-            labels = np.asarray(labels)
-        if not _is_numpy_(preds):
-            preds = np.asarray(preds)
-        kepsilon = 1e-7
-        thresholds = [
-            (i + 1) * 1.0 / (self._num_thresholds - 1)
-            for i in range(self._num_thresholds - 2)
-        ]
-        thresholds = [0.0 - kepsilon] + thresholds + [1.0 + kepsilon]
-        labels = labels.reshape(-1)
+        preds = np.asarray(preds)
+        labels = np.asarray(labels).reshape(-1)
         pos_prob = preds.reshape(preds.shape[0], -1)[:, -1]
-        for idx_thresh, thresh in enumerate(thresholds):
-            pred_pos = pos_prob >= thresh
-            self.tp_list[idx_thresh] += int(np.sum(pred_pos & (labels == 1)))
-            self.fp_list[idx_thresh] += int(np.sum(pred_pos & (labels == 0)))
-            self.fn_list[idx_thresh] += int(np.sum(~pred_pos & (labels == 1)))
-            self.tn_list[idx_thresh] += int(np.sum(~pred_pos & (labels == 0)))
+        pred_pos = pos_prob[None, :] >= self._thresholds[:, None]  # (T, N)
+        # only 0/1 labels count — sentinel labels (e.g. -1 padding rows)
+        # contribute to no bucket
+        is_pos = (labels == 1)[None, :]
+        is_neg = (labels == 0)[None, :]
+        self.tp_list += (pred_pos & is_pos).sum(axis=1)
+        self.fp_list += (pred_pos & is_neg).sum(axis=1)
+        self.fn_list += (~pred_pos & is_pos).sum(axis=1)
+        self.tn_list += (~pred_pos & is_neg).sum(axis=1)
 
     def eval(self):
-        epsilon = self._epsilon
-        num_thresholds = self._num_thresholds
-        tpr = (self.tp_list.astype("float32") +
-               epsilon) / (self.tp_list + self.fn_list + epsilon)
-        fpr = self.fp_list.astype("float32") / (
-            self.fp_list + self.tn_list + epsilon)
-
-        x = fpr[:num_thresholds - 1] - fpr[1:]
-        y = (tpr[:num_thresholds - 1] + tpr[1:]) / 2.0
-        auc_value = float(np.sum(x * y))
-        return auc_value
+        eps = self._epsilon
+        tpr = (self.tp_list + eps) / (self.tp_list + self.fn_list + eps)
+        fpr = self.fp_list / (self.fp_list + self.tn_list + eps)
+        # trapezoid over the descending-fpr sweep
+        return float(np.sum((fpr[:-1] - fpr[1:]) * (tpr[:-1] + tpr[1:]) / 2.0))
 
 
 class DetectionMAP(MetricBase):
-    """Running mean of per-batch mAP values from layers.detection_map
-    (metrics.py:DetectionMAP)."""
+    """Running mean of per-batch mAP values from layers.detection_map."""
 
     def __init__(self, name=None):
         super().__init__(name)
@@ -270,14 +272,14 @@ class DetectionMAP(MetricBase):
         self.weight = 0.0
 
     def update(self, value, weight=1):
-        if not _is_number_or_matrix_(value):
-            raise ValueError("The 'value' must be a number(int, float) or a numpy ndarray.")
-        if not _is_number_(weight):
-            raise ValueError("The 'weight' must be a number(int, float).")
-        self.value += float(np.asarray(value).reshape(-1)[0]) * weight
+        _require_numeric("DetectionMAP.update(value)", value)
+        _require_weight("DetectionMAP.update(weight)", weight)
+        self.value += _scalar(value) * weight
         self.weight += weight
 
     def eval(self):
         if self.weight == 0:
-            raise ValueError("There is no data in DetectionMAP Metrics.")
+            raise ValueError(
+                "DetectionMAP has accumulated nothing — feed it the fetched "
+                "layers.detection_map output via update() before eval()")
         return self.value / self.weight

@@ -131,6 +131,17 @@ def _depthwise_conv2d(ctx):
     return _conv2d(ctx)
 
 
+@register_op("depthwise_conv2d_transpose")
+def _depthwise_conv2d_transpose(ctx):
+    """reference conv_transpose_op.cc:338: conv2d_transpose with
+    groups == input channels (each channel deconvolved independently).
+    The grouped fractionally-strided path above already regroups the
+    paddle (C, M/g, kh, kw) filter layout, so this is the same kernel —
+    XLA lowers the feature_group_count conv straight onto the MXU
+    instead of needing the reference's dedicated depthwise CUDA kernel."""
+    return _conv2d_transpose(ctx)
+
+
 @register_op("im2sequence")
 def _im2sequence(ctx):
     """Extract image patches as a sequence (reference: im2sequence_op.cc).
@@ -564,6 +575,54 @@ def _max_pool2d_with_index(ctx):
     stack_v = jnp.stack(vals)                       # (KH*KW, N, C, OH, OW)
     stack_i = jnp.stack(idxs)                       # (KH*KW, OH, OW)
     best = jnp.argmax(stack_v, axis=0)              # (N, C, OH, OW)
+    out = jnp.max(stack_v, axis=0)
+    mask = jnp.take_along_axis(
+        jnp.broadcast_to(stack_i[:, None, None], stack_v.shape),
+        best[None], axis=0)[0]
+    return {"Out": out, "Mask": mask.astype(jnp.int32)}
+
+
+@register_op("max_pool3d_with_index")
+def _max_pool3d_with_index(ctx):
+    """reference pool_with_index_op.cc:276: 3-D max pool that also emits
+    Mask, the argmax position of each window as a flat index into the
+    (D*H*W) input volume. Same unrolled-window design as the 2-D kernel
+    above: ksize is small and static, so the kd*kh*kw strided slices +
+    one argmax jit to a single fused XLA op with no data-dependent
+    control flow."""
+    x = ctx.input("X")  # NCDHW
+    kd, kh, kw = ctx.attr("ksize")
+    sd, sh, sw = _pair(ctx.attr("strides", [1, 1, 1]), 3)
+    pd, ph, pw = _pair(ctx.attr("paddings", [0, 0, 0]), 3)
+    if ctx.attr("global_pooling", False):
+        kd, kh, kw = x.shape[2], x.shape[3], x.shape[4]
+        pd = ph = pw = 0
+    n, c, d, h, w = x.shape
+    od = (d - kd + 2 * pd) // sd + 1
+    oh = (h - kh + 2 * ph) // sh + 1
+    ow = (w - kw + 2 * pw) // sw + 1
+    neg = jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating) \
+        else jnp.iinfo(x.dtype).min
+    xp = jnp.pad(x, ((0, 0), (0, 0), (pd, pd), (ph, ph), (pw, pw)),
+                 constant_values=neg)
+    vals, idxs = [], []
+    for a in range(kd):
+        for i in range(kh):
+            for j in range(kw):
+                window = lax.slice(
+                    xp, (0, 0, a, i, j),
+                    (n, c, a + (od - 1) * sd + 1, i + (oh - 1) * sh + 1,
+                     j + (ow - 1) * sw + 1),
+                    (1, 1, sd, sh, sw))
+                vals.append(window)
+                dep = jnp.arange(od) * sd - pd + a  # input-space coords
+                row = jnp.arange(oh) * sh - ph + i
+                col = jnp.arange(ow) * sw - pw + j
+                idxs.append(dep[:, None, None] * (h * w)
+                            + row[None, :, None] * w + col[None, None, :])
+    stack_v = jnp.stack(vals)                  # (KD*KH*KW, N, C, OD, OH, OW)
+    stack_i = jnp.stack(idxs)                  # (KD*KH*KW, OD, OH, OW)
+    best = jnp.argmax(stack_v, axis=0)         # (N, C, OD, OH, OW)
     out = jnp.max(stack_v, axis=0)
     mask = jnp.take_along_axis(
         jnp.broadcast_to(stack_i[:, None, None], stack_v.shape),

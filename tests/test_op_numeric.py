@@ -653,7 +653,8 @@ COVERED_ELSEWHERE = {
     "lstm", "gru", "lstmp", "lstm_unit", "gru_unit",
     # nn: tests/test_nn_ops.py
     "conv2d", "conv3d", "conv2d_transpose", "conv3d_transpose",
-    "depthwise_conv2d", "pool2d", "pool3d", "batch_norm", "layer_norm",
+    "depthwise_conv2d", "depthwise_conv2d_transpose",
+    "pool2d", "pool3d", "batch_norm", "layer_norm",
     "lrn", "norm", "dropout", "im2sequence", "roi_pool", "bilinear_interp",
     "nearest_interp", "random_crop", "sampling_id", "gaussian_random",
     "uniform_random", "truncated_gaussian_random", "prelu", "mean_iou",
@@ -667,7 +668,8 @@ COVERED_ELSEWHERE = {
     "minus", "hinge_loss", "log_loss", "margin_rank_loss",
     "modified_huber_loss", "squared_l2_distance", "squared_l2_norm",
     "l1_norm", "proximal_gd", "proximal_adagrad", "positive_negative_pair",
-    "precision_recall", "max_pool2d_with_index", "unpool", "spp",
+    "precision_recall", "max_pool2d_with_index", "max_pool3d_with_index",
+    "unpool", "spp",
     "ctc_align", "fake_quantize", "fake_dequantize_max_abs",
     "fusion_lstm", "fusion_gru", "attention_lstm",
     "fusion_seqexpand_concat_fc", "fill", "fused_elemwise_activation",

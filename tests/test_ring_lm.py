@@ -150,26 +150,32 @@ def test_ring_lm_clone_for_test_disables_attention_dropout():
         assert t1 != e1
 
 
+def _run_sp(monkeypatch, chunk_env, seed=3):
+    """One seeded training step on the 4-device sp mesh with
+    PADDLE_TPU_RING_CHUNK set — the env override must reach the CHUNKED
+    ring path (on a plain single-device Executor the ring op falls back
+    to full_attention and the env value is never consumed; ADVICE r4)."""
+    monkeypatch.setenv("PADDLE_TPU_RING_CHUNK", chunk_env)
+    main, startup, scope, loss = _build(use_ring=True, seed=seed)
+    mesh = make_mesh([4], ("sp",), devices=jax.devices()[:4])
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+        pexe = ParallelExecutor(
+            loss_name=loss.name, main_program=main, scope=scope, mesh=mesh,
+            plan=seq_parallel_plan(mesh, sp_axis="sp", batch_axes=()))
+        return float(pexe.run(feed=_feed(), fetch_list=[loss])[0])
+
+
 def test_ring_chunk_env_override(monkeypatch):
-    """PADDLE_TPU_RING_CHUNK: 0 means auto (not a crash), junk names the
-    variable (code-review regression)."""
-    feed = _feed()
-
-    monkeypatch.setenv("PADDLE_TPU_RING_CHUNK", "0")
-    main, startup, scope, loss = _build(use_ring=True, seed=3)
-    with fluid.scope_guard(scope):
-        exe = fluid.Executor(fluid.CPUPlace())
-        exe.run(startup)
-        v0 = float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+    """PADDLE_TPU_RING_CHUNK through the op route on an sp mesh: 0 means
+    auto (not a crash), an explicit chunk is numerically invisible, junk
+    names the variable (code-review regression)."""
+    v0 = _run_sp(monkeypatch, "0")     # auto
     assert np.isfinite(v0)
-
-    monkeypatch.setenv("PADDLE_TPU_RING_CHUNK", "8")
-    main, startup, scope, loss = _build(use_ring=True, seed=3)
-    with fluid.scope_guard(scope):
-        exe = fluid.Executor(fluid.CPUPlace())
-        exe.run(startup)
-        v8 = float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+    v8 = _run_sp(monkeypatch, "8")     # T_local for seq 32 over 4 devices
     np.testing.assert_allclose(v8, v0, rtol=1e-5)  # chunking is invisible
+    v4 = _run_sp(monkeypatch, "4")     # genuine sub-chunking (2 per block)
+    np.testing.assert_allclose(v4, v0, rtol=1e-5)
 
     monkeypatch.setenv("PADDLE_TPU_RING_CHUNK", "abc")
     main, startup, scope, loss = _build(use_ring=True, seed=3)
@@ -177,4 +183,4 @@ def test_ring_chunk_env_override(monkeypatch):
         exe = fluid.Executor(fluid.CPUPlace())
         exe.run(startup)
         with pytest.raises(Exception, match="PADDLE_TPU_RING_CHUNK"):
-            exe.run(main, feed=feed, fetch_list=[loss])
+            exe.run(main, feed=_feed(), fetch_list=[loss])

@@ -15,7 +15,9 @@ OUT="${OUT:-/tmp/sweep_results.txt}"
 
 run() {
   echo "=== $* ==="
-  line=$(env "$@" BENCH_RESNET=0 BENCH_PROBE_TIMEOUT=150 timeout 2400 \
+  # defaults first, "$@" last: a row's own BENCH_* assignments win
+  line=$(env BENCH_RESNET=0 BENCH_LSTM=0 BENCH_DEEPFM=0 \
+         BENCH_PROBE_TIMEOUT=150 "$@" timeout 2400 \
          python bench.py 2>/dev/null | tail -1)
   echo "$line"
   echo "{\"cfg\": \"$*\", \"result\": $(json_or_null "$line")}" >> "$OUT"
@@ -65,22 +67,34 @@ run BENCH_BATCH=24
 run BENCH_BATCH=24 BENCH_REMAT=1
 run BENCH_BATCH=32 BENCH_REMAT=1
 
+# secondary-workload rows (VERDICT r4 item 3): the scan-heavy RNN and the
+# embedding-bound CTR paths, each measured without the LM compile
+if [ "${AUX:-1}" = "1" ]; then
+  # each row measures ONE secondary phase (run()'s defaults turn the
+  # others off; BENCH_LM=0 skips the LM compile)
+  run BENCH_LM=0 BENCH_LSTM=1
+  run BENCH_LM=0 BENCH_LSTM=1 BENCH_LSTM_BATCH=64
+  run BENCH_LM=0 BENCH_LSTM=1 BENCH_AMP=0
+  run BENCH_LM=0 BENCH_DEEPFM=1
+  run BENCH_LM=0 BENCH_DEEPFM=1 BENCH_DFM_BATCH=16384
+  run BENCH_LM=0 BENCH_DEEPFM=1 BENCH_AMP=0
+fi
+
 if [ "${RN:-0}" = "1" ]; then
-  for rb in 128 256 64; do
-    echo "=== resnet batch $rb ==="
-    line=$(env BENCH_RN_BATCH=$rb BENCH_PROBE_TIMEOUT=150 BENCH_STEPS=3 \
-        BENCH_WARMUP=1 BENCH_LAYERS=1 timeout 2400 python bench.py \
-        2>/dev/null | tail -1)
+  rn_row() {  # resnet-focused row: tiny LM, secondary phases off
+    local tag="$1"; shift
+    echo "=== $tag ==="
+    line=$(env BENCH_LSTM=0 BENCH_DEEPFM=0 BENCH_PROBE_TIMEOUT=150 \
+        BENCH_STEPS=3 BENCH_WARMUP=1 BENCH_LAYERS=1 "$@" timeout 2400 \
+        python bench.py 2>/dev/null | tail -1)
     echo "$line"
-    echo "{\"cfg\": \"resnet rb=$rb\", \"result\": $(json_or_null "$line")}" >> "$OUT"
+    echo "{\"cfg\": \"$tag\", \"result\": $(json_or_null "$line")}" >> "$OUT"
+  }
+  for rb in 128 256 64; do
+    rn_row "resnet rb=$rb" BENCH_RN_BATCH=$rb
   done
   # input-pipeline proof (VERDICT r3 item 8): the same step fed through
   # recordio -> C++ reader -> reader ops -> run_loop windows; the row's
   # resnet50.reader object records step_ms vs synthetic + overhead pct
-  echo "=== resnet reader pipeline ==="
-  line=$(env BENCH_RESNET_INPUT=reader BENCH_PROBE_TIMEOUT=150 \
-      BENCH_STEPS=3 BENCH_WARMUP=1 BENCH_LAYERS=1 timeout 2400 \
-      python bench.py 2>/dev/null | tail -1)
-  echo "$line"
-  echo "{\"cfg\": \"resnet reader\", \"result\": $(json_or_null "$line")}" >> "$OUT"
+  rn_row "resnet reader" BENCH_RESNET_INPUT=reader
 fi
