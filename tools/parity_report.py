@@ -208,10 +208,10 @@ def main(argv=None):
     for root, _dirs, files in os.walk(op_dir):
         for f in files:
             if f.endswith("_op.cc"):
-                n_files += 1
                 base = f[: -len("_op.cc")]
                 if base.endswith("_mkldnn") or base == "tensorrt_engine":
                     continue
+                n_files += 1
                 ref_ops |= expand_op_cc(os.path.join(root, f), base)
     missing_ops = {o for o in ref_ops if o not in ours}
     explained = set()
