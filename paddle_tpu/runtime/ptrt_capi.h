@@ -20,6 +20,17 @@
  *   ... out[0].data holds out[0].nbytes bytes of out[0].dtype ...
  *   ptrt_tensors_free(out, n_out);
  *   ptrt_predictor_free(p);
+ *
+ * Concurrency: calls are thread-safe but SERIALIZED inside the library
+ * (the hosted runtime executes one call at a time), so aggregate
+ * throughput from any number of caller threads is bounded by
+ * 1/single-call-latency — parallel ptrt_predictor_run calls add queueing
+ * latency, not throughput. For concurrent serving, batch requests
+ * application-side (one run per assembled batch), or host the model
+ * behind paddle_tpu.inference.PredictorServer, whose dynamic batching
+ * coalesces concurrent single-row requests into padded fixed-signature
+ * batches (measured: >25k rows/s vs ~13k calls/s through parallel ptrt
+ * calls on the same MLP; PERF_NOTES.md).
  */
 #ifndef PTRT_CAPI_H
 #define PTRT_CAPI_H
