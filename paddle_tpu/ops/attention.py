@@ -475,6 +475,19 @@ _pallas_mha.defvjp(_pallas_mha_fwd, _pallas_mha_bwd)
 # ---------------------------------------------------------------------------
 
 
+def _lse_spec_bthd(h, t):
+    """BlockSpec for the per-(batch, head) softmax-stat rows (lse, delta)
+    in the BTHD kernels. The stats are laid out (B*H, 1, T) — NOT
+    (B, H, T): Mosaic requires the last TWO block dims to be 8/128
+    multiples or the full dim, and a (1, 1, T) block on a (B, H, T)
+    array has a second-minor extent of 1 under a dim of H (rejected on
+    real hardware; reproduced offline via jax.export platforms=['tpu']).
+    Flattening (B, H) into the major dim makes the singleton blocks
+    cover full dims, which is exactly how the proven BHTD path lays out
+    its stats."""
+    return pl.BlockSpec((1, 1, t), lambda bi, hi, qi: (bi * h + hi, 0, 0))
+
+
 def _mha_fwd_call_bthd(qs, k, v, h, causal, block_q, block_k, interpret):
     b, t, hd = qs.shape
     tk = k.shape[1]
@@ -492,11 +505,11 @@ def _mha_fwd_call_bthd(qs, k, v, h, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bi, hi, qi: (bi, qi, hi)),
-            pl.BlockSpec((1, 1, t), lambda bi, hi, qi: (bi, hi, 0)),
+            _lse_spec_bthd(h, t),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, t, hd), qs.dtype),
-            jax.ShapeDtypeStruct((b, h, t), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, 1, t), jnp.float32),
         ],
         interpret=interpret,
         **_tpu_params("parallel", "parallel", "arbitrary"),
@@ -522,12 +535,13 @@ def _pallas_mha_bthd_bwd(h, causal, block_q, block_k, interpret, res, do):
     b, t, hd = qs.shape
     tk = k.shape[1]
     d = hd // h
-    # per-head delta (B, H, T): the only head-axis shuffle in the whole
-    # path, on a (B, T, H) f32 tensor (~1000x smaller than q/k/v)
+    # per-head delta, laid out (B*H, 1, T) like lse (see _lse_spec_bthd):
+    # the only head-axis shuffle in the whole path, on a (B, T, H) f32
+    # tensor (~1000x smaller than q/k/v)
     delta = jnp.sum(
         do.astype(jnp.float32).reshape(b, t, h, d)
         * out.astype(jnp.float32).reshape(b, t, h, d),
-        axis=-1).transpose(0, 2, 1)
+        axis=-1).transpose(0, 2, 1).reshape(b * h, 1, t)
 
     if _fused_bwd_enabled():
         kernel = functools.partial(
@@ -541,8 +555,8 @@ def _pallas_mha_bthd_bwd(h, causal, block_q, block_k, interpret, res, do):
                 pl.BlockSpec((1, tk, d), lambda bi, hi, qi: (bi, 0, hi)),
                 pl.BlockSpec((1, tk, d), lambda bi, hi, qi: (bi, 0, hi)),
                 pl.BlockSpec((1, block_q, d), lambda bi, hi, qi: (bi, qi, hi)),
-                pl.BlockSpec((1, 1, t), lambda bi, hi, qi: (bi, hi, 0)),
-                pl.BlockSpec((1, 1, t), lambda bi, hi, qi: (bi, hi, 0)),
+                _lse_spec_bthd(h, t),
+                _lse_spec_bthd(h, t),
             ],
             out_specs=[
                 pl.BlockSpec((1, block_q, d),
@@ -571,8 +585,8 @@ def _pallas_mha_bthd_bwd(h, causal, block_q, block_k, interpret, res, do):
             pl.BlockSpec((1, tk, d), lambda bi, hi, qi: (bi, 0, hi)),
             pl.BlockSpec((1, tk, d), lambda bi, hi, qi: (bi, 0, hi)),
             pl.BlockSpec((1, block_q, d), lambda bi, hi, qi: (bi, qi, hi)),
-            pl.BlockSpec((1, 1, t), lambda bi, hi, qi: (bi, hi, 0)),
-            pl.BlockSpec((1, 1, t), lambda bi, hi, qi: (bi, hi, 0)),
+            _lse_spec_bthd(h, t),
+            _lse_spec_bthd(h, t),
         ],
         out_specs=pl.BlockSpec((1, block_q, d),
                                lambda bi, hi, qi: (bi, qi, hi)),
@@ -592,8 +606,8 @@ def _pallas_mha_bthd_bwd(h, causal, block_q, block_k, interpret, res, do):
             pl.BlockSpec((1, block_k, d), lambda bi, hi, kj: (bi, kj, hi)),
             pl.BlockSpec((1, block_k, d), lambda bi, hi, kj: (bi, kj, hi)),
             pl.BlockSpec((1, t, d), lambda bi, hi, kj: (bi, 0, hi)),
-            pl.BlockSpec((1, 1, t), lambda bi, hi, kj: (bi, hi, 0)),
-            pl.BlockSpec((1, 1, t), lambda bi, hi, kj: (bi, hi, 0)),
+            _lse_spec_bthd(h, t),
+            _lse_spec_bthd(h, t),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda bi, hi, kj: (bi, kj, hi)),

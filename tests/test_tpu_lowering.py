@@ -1,0 +1,95 @@
+"""Offline TPU (Mosaic) lowering checks for the Pallas hot-path kernels.
+
+`jax.export` with platforms=['tpu'] runs the full StableHLO + Pallas ->
+Mosaic-MLIR client-side lowering WITHOUT TPU hardware, which is exactly
+the stage that rejected the BTHD stat BlockSpecs on the real chip in
+round 5 ((1, 1, T) blocks over a (B, H, T) array violate Mosaic's
+last-two-dims tiling rule) while every interpret-mode numeric test
+passed. These tests pin that class of bug to CI: a kernel that fails
+Mosaic's layout constraints fails here, on CPU, before any tunnel
+window is spent on it.
+
+Runs in a subprocess with the axon PJRT plugin unregistered
+(PALLAS_AXON_POOL_IPS removed): the plugin hooks jax's backend lookup
+at import time and blocks on its tunnel socket during `backends()` even
+under JAX_PLATFORMS=cpu, which would hang the export in this process.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+_CODE = """
+import os, jax, jax.numpy as jnp
+from jax import export
+
+from paddle_tpu.ops.attention import (pallas_flash_attention,
+                                      pallas_flash_attention_bthd)
+from paddle_tpu.ops.fused_loss import lm_head_loss
+
+
+def loss_bthd(q, k, v):
+    return jnp.sum(jnp.sin(
+        pallas_flash_attention_bthd(q, k, v, causal=True)
+        .astype(jnp.float32)))
+
+
+def loss_bhtd(q, k, v):
+    return jnp.sum(jnp.sin(
+        pallas_flash_attention(q, k, v, causal=True)
+        .astype(jnp.float32)))
+
+
+av = jax.ShapeDtypeStruct((1, 256, 2, 128), jnp.bfloat16)   # (B, T, H, D)
+avh = jax.ShapeDtypeStruct((1, 2, 256, 128), jnp.bfloat16)  # (B, H, T, D)
+
+for tag, fn, a in (("bthd", loss_bthd, av), ("bhtd", loss_bhtd, avh)):
+    export.export(jax.jit(fn), platforms=["tpu"])(a, a, a)
+    export.export(jax.jit(jax.value_and_grad(fn, argnums=(0, 1, 2))),
+                  platforms=["tpu"])(a, a, a)
+    print("LOWER_OK", tag, flush=True)
+
+# the opt-in single-pass fused flash backward (read from env at trace)
+os.environ["PADDLE_TPU_FLASH_FUSED_BWD"] = "1"
+
+
+def loss_bthd_fused(q, k, v):
+    return loss_bthd(q, k, v)
+
+
+export.export(jax.jit(jax.value_and_grad(loss_bthd_fused, argnums=(0, 1, 2))),
+              platforms=["tpu"])(av, av, av)
+print("LOWER_OK fused_bwd", flush=True)
+
+
+def head_loss(x, w, b, labels):
+    return jnp.sum(lm_head_loss(2048, x, w, b, labels))
+
+
+xs = jax.ShapeDtypeStruct((256, 512), jnp.bfloat16)
+ws = jax.ShapeDtypeStruct((512, 8192), jnp.bfloat16)
+bs = jax.ShapeDtypeStruct((8192,), jnp.float32)
+ls = jax.ShapeDtypeStruct((256,), jnp.int32)
+export.export(jax.jit(jax.grad(head_loss, argnums=(0, 1, 2))),
+              platforms=["tpu"])(xs, ws, bs, ls)
+print("LOWER_OK lm_head", flush=True)
+"""
+
+
+def test_pallas_kernels_lower_for_tpu():
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo_root = os.path.dirname(_HERE)
+    env["PYTHONPATH"] = (repo_root + os.pathsep + env["PYTHONPATH"]
+                         if env.get("PYTHONPATH") else repo_root)
+    res = subprocess.run([sys.executable, "-c", _CODE], env=env,
+                         capture_output=True, text=True, timeout=1200,
+                         cwd=repo_root)
+    assert res.returncode == 0, (
+        "TPU lowering failed:\n%s" % res.stderr[-4000:])
+    for tag in ("bthd", "bhtd", "fused_bwd", "lm_head"):
+        assert "LOWER_OK %s" % tag in res.stdout, res.stdout
