@@ -769,13 +769,19 @@ def _env_block(var: str, default: int) -> int:
 
 def _use_pallas(t, tk, lengths, dropout_rate) -> bool:
     """Pallas fwd+bwd path: TPU only, no KV padding mask, no dropout, and
-    block-aligned sequence lengths (256 keeps small models on XLA)."""
+    block-aligned sequence lengths (256 keeps small models on XLA).
+    PADDLE_TPU_FORCE_PALLAS=1 skips only the backend check — for tracing
+    a TPU-bound program on a CPU host (offline Mosaic-lowering
+    validation via jax.export; tools/lower_bench_step.py is the
+    consumer). Executing such a trace on CPU fails — this is a
+    lowering/debug lever, not a CPU execution mode."""
     if pl is None or lengths is not None or dropout_rate:
         return False
     if os.environ.get("PADDLE_TPU_NO_PALLAS", "0") == "1":
         return False
+    force = os.environ.get("PADDLE_TPU_FORCE_PALLAS", "0") == "1"
     try:
-        if jax.default_backend() in ("cpu", "gpu"):
+        if not force and jax.default_backend() in ("cpu", "gpu"):
             return False
     except Exception:  # pragma: no cover
         return False

@@ -1,0 +1,109 @@
+"""Cross-lower the FULL bench LM training step for TPU on a CPU host.
+
+jax.export(platforms=['tpu']) runs the complete client-side lowering —
+StableHLO plus every Pallas->Mosaic kernel (PADDLE_TPU_FORCE_PALLAS=1
+keeps the attention dispatch on the Pallas path despite the CPU host) —
+so Mosaic BlockSpec/layout rejections surface HERE, in minutes on CPU,
+instead of inside a scarce tunnel window (round-5 lesson: the BTHD stat
+layout was rejected by exactly this stage on real hardware after three
+rounds of it never having compiled).
+
+Usage:
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+      python tools/lower_bench_step.py [--heads 8] [--batch 16] \
+      [--layers 12] [--fused-bwd] [--amp O1]
+
+Exit 0 = the driver-time compile has no client-side Mosaic surprises at
+this config. Does NOT guarantee the server-side Mosaic backend compile
+succeeds, but every constraint violation seen so far was client-side.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--vocab", type=int, default=32768)
+    ap.add_argument("--d-model", type=int, default=1024)
+    ap.add_argument("--amp", default="O1")
+    ap.add_argument("--fused-bwd", action="store_true")
+    args = ap.parse_args()
+
+    # self-contained on an axon host: the PJRT plugin would block on the
+    # tunnel socket during backend lookup even under JAX_PLATFORMS=cpu
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["PADDLE_TPU_FORCE_PALLAS"] = "1"
+    if args.fused_bwd:
+        os.environ["PADDLE_TPU_FLASH_FUSED_BWD"] = "1"
+
+    import numpy as np
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers, models, optimizer
+    from paddle_tpu.executor import analyze_state, build_step_fn
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup.random_seed = 1
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(main_p, startup):
+        with fluid.unique_name.guard():
+            ids = layers.data(name="ids", shape=[args.batch, args.seq],
+                              dtype="int64", append_batch_size=False)
+            labels = layers.data(name="labels",
+                                 shape=[args.batch, args.seq],
+                                 dtype="int64", append_batch_size=False)
+            loss, _ = models.transformer.transformer_lm(
+                ids, labels, vocab_size=args.vocab, n_layer=args.layers,
+                n_head=args.heads, d_model=args.d_model,
+                d_inner=4 * args.d_model, max_len=args.seq)
+            optimizer.Adam(learning_rate=1e-4).minimize(loss)
+        main_p.enable_mixed_precision(level=args.amp)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+
+        feed_names = {"ids", "labels"}
+        state_in, state_out = analyze_state(main_p, feed_names)
+        stepfn = build_step_fn(main_p, (loss.name,), state_in, state_out)
+
+        feeds_aval = {
+            "ids": jax.ShapeDtypeStruct((args.batch, args.seq), np.int32),
+            "labels": jax.ShapeDtypeStruct((args.batch, args.seq), np.int32),
+        }
+        state_aval = {}
+        for n in state_in:
+            v = scope.find_var(n)
+            a = v if hasattr(v, "shape") else np.asarray(v)
+            state_aval[n] = jax.ShapeDtypeStruct(tuple(a.shape),
+                                                 np.dtype(a.dtype))
+        key_aval = jax.ShapeDtypeStruct((2,), np.uint32)
+        step_aval = jax.ShapeDtypeStruct((), np.uint32)
+
+        from jax import export
+
+        print("lowering full step for TPU: batch=%d heads=%d layers=%d "
+              "amp=%s fused_bwd=%s ..." % (args.batch, args.heads,
+                                           args.layers, args.amp,
+                                           args.fused_bwd), flush=True)
+        exp = export.export(jax.jit(stepfn), platforms=["tpu"])(
+            feeds_aval, state_aval, key_aval, step_aval)
+        print("FULL STEP TPU LOWER OK (%d KB StableHLO)"
+              % (len(exp.mlir_module()) // 1024))
+
+
+if __name__ == "__main__":
+    main()
