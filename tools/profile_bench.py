@@ -14,19 +14,36 @@ B,S,V,L,D,F,H = (int(_e("BENCH_BATCH", 8)), int(_e("BENCH_SEQ", 1024)),
 main_p, startup = fluid.Program(), fluid.Program()
 main_p.random_seed = startup.random_seed = 1
 scope = fluid.Scope()
+MODEL = _e("PROFILE_MODEL", "transformer")
+if MODEL not in ("transformer", "resnet"):
+    raise SystemExit("PROFILE_MODEL must be 'transformer' or 'resnet', got %r" % MODEL)
 with fluid.scope_guard(scope), fluid.program_guard(main_p, startup):
     with fluid.unique_name.guard():
-        ids = layers.data(name="ids", shape=[B,S], dtype="int64", append_batch_size=False)
-        lbl = layers.data(name="labels", shape=[B,S], dtype="int64", append_batch_size=False)
-        loss, _ = models.transformer.transformer_lm(ids, lbl, vocab_size=V, n_layer=L, n_head=H, d_model=D, d_inner=F, max_len=S)
-        optimizer.Adam(learning_rate=1e-4).minimize(loss)
+        if MODEL == "resnet":
+            RB = int(_e("BENCH_RN_BATCH", 128))
+            loss, _acc, _feeds = models.resnet.get_model(
+                dataset="imagenet", depth=50)
+            optimizer.Momentum(learning_rate=0.1, momentum=0.9).minimize(loss)
+        else:
+            ids = layers.data(name="ids", shape=[B,S], dtype="int64", append_batch_size=False)
+            lbl = layers.data(name="labels", shape=[B,S], dtype="int64", append_batch_size=False)
+            loss, _ = models.transformer.transformer_lm(ids, lbl, vocab_size=V, n_layer=L, n_head=H, d_model=D, d_inner=F, max_len=S)
+            optimizer.Adam(learning_rate=1e-4).minimize(loss)
     if _e("BENCH_AMP", "1") == "1":
         main_p.enable_mixed_precision(level=_e("BENCH_AMP_LEVEL", "O1"))
     exe = fluid.Executor(fluid.TPUPlace())
     exe.run(startup)
     r = np.random.RandomState(0)
-    feed = {"ids": r.randint(0,V,(B,S)).astype(np.int64),
-            "labels": r.randint(0,V,(B,S)).astype(np.int64)}
+    if MODEL == "resnet":
+        # stage the ~77 MB image batch on device (bench.py's own helper):
+        # re-uploading it per step through the tunnel would dwarf compute
+        from bench import _stage_feed
+        feed = _stage_feed({"data": r.randn(RB,3,224,224).astype(np.float32),
+                            "label": r.randint(0,1000,(RB,1)).astype(np.int64)},
+                           jax.devices()[0])
+    else:
+        feed = {"ids": r.randint(0,V,(B,S)).astype(np.int64),
+                "labels": r.randint(0,V,(B,S)).astype(np.int64)}
     # warm + compile the loop executable, then trace one 6-step window.
     # The fence is a REAL device->host fetch: on the axon backend
     # jax.block_until_ready returns without waiting, so fencing with it
