@@ -79,13 +79,18 @@ print("LOWER_OK lm_head", flush=True)
 """
 
 
-def test_pallas_kernels_lower_for_tpu():
+def _clean_env():
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
     repo_root = os.path.dirname(_HERE)
     env["PYTHONPATH"] = (repo_root + os.pathsep + env["PYTHONPATH"]
                          if env.get("PYTHONPATH") else repo_root)
+    return env, repo_root
+
+
+def test_pallas_kernels_lower_for_tpu():
+    env, repo_root = _clean_env()
     res = subprocess.run([sys.executable, "-c", _CODE], env=env,
                          capture_output=True, text=True, timeout=1200,
                          cwd=repo_root)
@@ -93,3 +98,19 @@ def test_pallas_kernels_lower_for_tpu():
         "TPU lowering failed:\n%s" % res.stderr[-4000:])
     for tag in ("bthd", "bhtd", "fused_bwd", "lm_head"):
         assert "LOWER_OK %s" % tag in res.stdout, res.stdout
+
+
+def test_full_bench_step_lowers_for_tpu():
+    """The whole bench training step — Pallas attention (BTHD), fused
+    LM-head, Adam, AMP O1 — cross-lowers for TPU at a 2-layer config
+    (every unique kernel, a fraction of the 12-layer lowering time)."""
+    env, repo_root = _clean_env()
+    res = subprocess.run(
+        [sys.executable, os.path.join(repo_root, "tools",
+                                      "lower_bench_step.py"),
+         "--layers", "2", "--batch", "4", "--fused-bwd"],
+        env=env, capture_output=True, text=True, timeout=1200,
+        cwd=repo_root)
+    assert res.returncode == 0, (
+        "full-step TPU lowering failed:\n%s" % res.stderr[-4000:])
+    assert "FULL STEP TPU LOWER OK" in res.stdout, res.stdout
