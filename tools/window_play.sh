@@ -1,0 +1,64 @@
+#!/bin/bash
+# Round-5 window play: run AFTER the watcher banked its plain bench +
+# bthd repro (/tmp/autobench_done exists). Strict priority order; every
+# row appends to /tmp/sweep_r5.jsonl; safe to re-run (idempotent rows
+# skip via the XLA compile cache). ONE TPU process at a time.
+set -u
+cd /root/repo
+OUT=/tmp/sweep_r5.jsonl
+
+row() {
+  local tag="$1"; shift
+  echo "=== $tag ($(date -u +%H:%M:%S)) ===" | tee -a /tmp/window_play.log
+  local line
+  line=$(env BENCH_RESNET=0 BENCH_LSTM=0 BENCH_DEEPFM=0 "$@" \
+         python bench.py 2>>/tmp/window_play.log | tail -1)
+  echo "$line" | tee -a /tmp/window_play.log
+  python - "$tag" "$line" <<'EOF' >> "$OUT"
+import json, sys
+try: r = json.loads(sys.argv[2])
+except Exception: r = None
+print(json.dumps({"tag": sys.argv[1], "result": r}))
+EOF
+}
+
+touch /tmp/tpu_busy
+trap 'rm -f /tmp/tpu_busy' EXIT
+
+# 1. headline candidates, most-likely-winner first (BTHD engages via the
+#    fixed kernels; smoke re-runs automatically on the new kernel hash)
+row "heads8-bthd"            BENCH_BATCH=16 BENCH_HEADS=8
+row "heads8-bthd-fusedbwd"   BENCH_BATCH=16 BENCH_HEADS=8 PADDLE_TPU_FLASH_FUSED_BWD=1
+row "heads8-bthd-O2"         BENCH_BATCH=16 BENCH_HEADS=8 BENCH_AMP_LEVEL=O2
+row "heads8-all-levers"      BENCH_BATCH=16 BENCH_HEADS=8 BENCH_AMP_LEVEL=O2 PADDLE_TPU_FLASH_FUSED_BWD=1
+row "b24-remat-all"          BENCH_BATCH=24 BENCH_HEADS=8 BENCH_REMAT=1 BENCH_AMP_LEVEL=O2 PADDLE_TPU_FLASH_FUSED_BWD=1
+# 2. flash block shapes on the winner's base
+row "heads8-bq1024"          BENCH_BATCH=16 BENCH_HEADS=8 PADDLE_TPU_FLASH_BQ=1024 PADDLE_TPU_FLASH_BK=1024
+row "heads8-bq256bk512"      BENCH_BATCH=16 BENCH_HEADS=8 PADDLE_TPU_FLASH_BQ=256 PADDLE_TPU_FLASH_BK=512
+# 3. resnet ladder + reader-pipeline proof + profile capture
+echo "=== resnet rows ===" | tee -a /tmp/window_play.log
+for rb in 128 256; do
+  line=$(env BENCH_LM=0 BENCH_LSTM=0 BENCH_DEEPFM=0 BENCH_RN_BATCH=$rb \
+         python bench.py 2>>/tmp/window_play.log | tail -1)
+  echo "{\"tag\": \"resnet-b$rb\", \"result\": $line}" >> "$OUT" || true
+  echo "$line" | tee -a /tmp/window_play.log
+done
+line=$(env BENCH_LM=0 BENCH_LSTM=0 BENCH_DEEPFM=0 BENCH_RESNET_INPUT=reader \
+       python bench.py 2>>/tmp/window_play.log | tail -1)
+echo "{\"tag\": \"resnet-reader\", \"result\": $line}" >> "$OUT" || true
+echo "$line" | tee -a /tmp/window_play.log
+# 4. resnet profile trace for hlo_stats (untimed; writes /tmp/jaxprof)
+PROFILE_MODEL=resnet python tools/profile_bench.py >>/tmp/window_play.log 2>&1 || true
+python tools/hlo_stats.py > /tmp/resnet_hlo_stats.txt 2>&1 || true
+# 5. serving bench on device
+BENCH_SERVING_PLATFORM=device python tools/bench_serving.py > /tmp/serving_r5.log 2>&1 || true
+# 6. deepfm capture (if the watcher bench didn't already get it)
+line=$(env BENCH_LM=0 BENCH_RESNET=0 BENCH_LSTM=0 python bench.py 2>>/tmp/window_play.log | tail -1)
+echo "{\"tag\": \"deepfm\", \"result\": $line}" >> "$OUT" || true
+# 7. LAST and riskiest: the stacked-LSTM compile that killed the relay.
+#    Only run if WINDOW_LSTM=1 (manual opt-in after everything is banked).
+if [ "${WINDOW_LSTM:-0}" = "1" ]; then
+  line=$(env BENCH_LM=0 BENCH_RESNET=0 BENCH_DEEPFM=0 python bench.py 2>>/tmp/window_play.log | tail -1)
+  echo "{\"tag\": \"stacked-lstm\", \"result\": $line}" >> "$OUT" || true
+fi
+echo "WINDOW PLAY DONE $(date -u)" | tee -a /tmp/window_play.log
