@@ -857,18 +857,7 @@ def main():
             "note": "BENCH_LM=0 (secondary-phase row)",
             "device": getattr(dev, "device_kind", dev.platform),
         }
-    phases = []
-    if _os.environ.get("BENCH_RESNET", "1") == "1":
-        phases.append(("resnet50", bench_resnet))
-    if _os.environ.get("BENCH_DEEPFM", "1") == "1":
-        phases.append(("deepfm", bench_deepfm))
-    # stacked_lstm runs LAST: its 3-deep scan-of-scans backward is by far
-    # the longest tunnel-side compile (observed >40 min on axon, r5), and
-    # a phase that overruns the driver's budget must not block the
-    # cheaper deepfm capture — every earlier phase is already flushed
-    if _os.environ.get("BENCH_LSTM", "1") == "1":
-        phases.append(("stacked_lstm", bench_stacked_lstm))
-    for name, phase in phases:
+    for name, phase in _phase_list():
         # flush what we have before each risky phase: if it is killed
         # (timeout through the TPU tunnel), the flushed line is still the
         # last complete JSON line on stdout for the driver to parse
@@ -882,7 +871,24 @@ def main():
     _save_local_capture(result, dev)
 
 
-_LOCAL_CAPTURE = _os.path.join(
+def _phase_list():
+    """Secondary phases in RISK order — stacked_lstm strictly LAST: its
+    3-deep scan-of-scans backward is by far the longest tunnel-side
+    compile (observed >40 min on axon before it took the remote-compile
+    service down, r5), and a phase that overruns the driver's budget or
+    kills the tunnel must not block the cheaper captures — every earlier
+    phase's result is already flushed when it starts."""
+    phases = []
+    if _os.environ.get("BENCH_RESNET", "1") == "1":
+        phases.append(("resnet50", bench_resnet))
+    if _os.environ.get("BENCH_DEEPFM", "1") == "1":
+        phases.append(("deepfm", bench_deepfm))
+    if _os.environ.get("BENCH_LSTM", "1") == "1":
+        phases.append(("stacked_lstm", bench_stacked_lstm))
+    return phases
+
+
+_LOCAL_CAPTURE = _os.environ.get("BENCH_LOCAL_PATH") or _os.path.join(
     _os.path.dirname(_os.path.abspath(__file__)), "BENCH_LOCAL.json")
 
 
@@ -903,8 +909,8 @@ def _save_local_capture(result, dev):
 
         payload["git_sha"] = subprocess.run(
             ["git", "rev-parse", "--short", "HEAD"],
-            cwd=_os.path.dirname(_LOCAL_CAPTURE), capture_output=True,
-            text=True, timeout=10).stdout.strip() or None
+            cwd=_os.path.dirname(_os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10).stdout.strip() or None
     except Exception:  # noqa: BLE001 — SHA is best-effort context
         payload["git_sha"] = None
     try:

@@ -184,3 +184,46 @@ def test_smoke_gate_source_context_lines_not_deterministic(monkeypatch,
     assert bench._bthd_smoke_gate() is None
     assert os.environ.get("PADDLE_TPU_ATTN_BTHD") == "0"
     assert _memo_files(tmp_path) == {}  # transient: NOT memoized
+
+
+def test_phase_order_lstm_strictly_last(monkeypatch):
+    """The relay-protection ordering (r5): stacked_lstm's pathological
+    tunnel-side compile must come after every cheaper capture, so a
+    compile that hangs or kills the compile service cannot cost the
+    resnet50/deepfm numbers."""
+    for v in ("BENCH_RESNET", "BENCH_DEEPFM", "BENCH_LSTM"):
+        monkeypatch.delenv(v, raising=False)
+    names = [n for n, _ in bench._phase_list()]
+    assert names == ["resnet50", "deepfm", "stacked_lstm"]
+    monkeypatch.setenv("BENCH_LSTM", "0")
+    assert [n for n, _ in bench._phase_list()] == ["resnet50", "deepfm"]
+
+
+def test_probe_failure_attaches_local_capture(monkeypatch, tmp_path):
+    """A tunnel-dead run's error JSON must carry the last on-device
+    capture as context — with value still null (no fresh number is
+    claimed) — and a capture file must be optional."""
+    import io
+    import json as _json
+    import sys as _s
+
+    cap = tmp_path / "BENCH_LOCAL.json"
+    cap.write_text(_json.dumps({"value": 75938.1, "mfu": 0.485,
+                                "git_sha": "abc1234"}))
+    monkeypatch.setattr(bench, "_LOCAL_CAPTURE", str(cap))
+    monkeypatch.setattr(bench, "_probe_device", lambda t: "probe hung")
+    monkeypatch.setenv("BENCH_PROBE_TIMEOUT", "1")
+    buf = io.StringIO()
+    monkeypatch.setattr(_s, "stdout", buf)
+    bench.main()
+    out = _json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert out["value"] is None and out["vs_baseline"] is None
+    assert out["last_local_capture"]["mfu"] == 0.485
+    assert out["last_local_capture"]["git_sha"] == "abc1234"
+
+    cap.unlink()
+    buf2 = io.StringIO()
+    monkeypatch.setattr(_s, "stdout", buf2)
+    bench.main()
+    out2 = _json.loads(buf2.getvalue().strip().splitlines()[-1])
+    assert out2["value"] is None and "last_local_capture" not in out2

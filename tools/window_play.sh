@@ -1,17 +1,24 @@
 #!/bin/bash
 # Round-5 window play: run AFTER the watcher banked its plain bench +
 # bthd repro (/tmp/autobench_done exists). Strict priority order; every
-# row appends to /tmp/sweep_r5.jsonl; safe to re-run (idempotent rows
-# skip via the XLA compile cache). ONE TPU process at a time.
+# row appends guarded JSON to /tmp/sweep_r5.jsonl; safe to re-run (the
+# XLA compile cache makes repeat rows fast). ONE TPU process at a time.
+#
+# Per-row `timeout 2700`: SIGTERMing a claim-holder wedges a HEALTHY
+# tunnel (round-3 lesson), so the bound sits far above any sane
+# compile+run (~45 min) — a row that still exceeds it means the compile
+# service is already wedged, and losing that claim costs nothing while
+# freeing every remaining row.
 set -u
-cd /root/repo
+cd "$(dirname "$0")/.."
 OUT=/tmp/sweep_r5.jsonl
 
 row() {
+  # defaults first, "$@" last: a row's own BENCH_* assignments win
   local tag="$1"; shift
   echo "=== $tag ($(date -u +%H:%M:%S)) ===" | tee -a /tmp/window_play.log
   local line
-  line=$(env BENCH_RESNET=0 BENCH_LSTM=0 BENCH_DEEPFM=0 "$@" \
+  line=$(env BENCH_RESNET=0 BENCH_LSTM=0 BENCH_DEEPFM=0 "$@" timeout 2700 \
          python bench.py 2>>/tmp/window_play.log | tail -1)
   echo "$line" | tee -a /tmp/window_play.log
   python - "$tag" "$line" <<'EOF' >> "$OUT"
@@ -35,30 +42,21 @@ row "b24-remat-all"          BENCH_BATCH=24 BENCH_HEADS=8 BENCH_REMAT=1 BENCH_AM
 # 2. flash block shapes on the winner's base
 row "heads8-bq1024"          BENCH_BATCH=16 BENCH_HEADS=8 PADDLE_TPU_FLASH_BQ=1024 PADDLE_TPU_FLASH_BK=1024
 row "heads8-bq256bk512"      BENCH_BATCH=16 BENCH_HEADS=8 PADDLE_TPU_FLASH_BQ=256 PADDLE_TPU_FLASH_BK=512
-# 3. resnet ladder + reader-pipeline proof + profile capture
-echo "=== resnet rows ===" | tee -a /tmp/window_play.log
-for rb in 128 256; do
-  line=$(env BENCH_LM=0 BENCH_LSTM=0 BENCH_DEEPFM=0 BENCH_RN_BATCH=$rb \
-         python bench.py 2>>/tmp/window_play.log | tail -1)
-  echo "{\"tag\": \"resnet-b$rb\", \"result\": $line}" >> "$OUT" || true
-  echo "$line" | tee -a /tmp/window_play.log
-done
-line=$(env BENCH_LM=0 BENCH_LSTM=0 BENCH_DEEPFM=0 BENCH_RESNET_INPUT=reader \
-       python bench.py 2>>/tmp/window_play.log | tail -1)
-echo "{\"tag\": \"resnet-reader\", \"result\": $line}" >> "$OUT" || true
-echo "$line" | tee -a /tmp/window_play.log
+# 3. resnet ladder + reader-pipeline proof (row() defaults first, the
+#    row's own BENCH_RESNET=1 re-enables the phase)
+row "resnet-b128"            BENCH_LM=0 BENCH_RESNET=1 BENCH_RN_BATCH=128
+row "resnet-b256"            BENCH_LM=0 BENCH_RESNET=1 BENCH_RN_BATCH=256
+row "resnet-reader"          BENCH_LM=0 BENCH_RESNET=1 BENCH_RESNET_INPUT=reader
 # 4. resnet profile trace for hlo_stats (untimed; writes /tmp/jaxprof)
-PROFILE_MODEL=resnet python tools/profile_bench.py >>/tmp/window_play.log 2>&1 || true
+PROFILE_MODEL=resnet timeout 2700 python tools/profile_bench.py >>/tmp/window_play.log 2>&1 || true
 python tools/hlo_stats.py > /tmp/resnet_hlo_stats.txt 2>&1 || true
 # 5. serving bench on device
-BENCH_SERVING_PLATFORM=device python tools/bench_serving.py > /tmp/serving_r5.log 2>&1 || true
+BENCH_SERVING_PLATFORM=device timeout 2700 python tools/bench_serving.py > /tmp/serving_r5.log 2>&1 || true
 # 6. deepfm capture (if the watcher bench didn't already get it)
-line=$(env BENCH_LM=0 BENCH_RESNET=0 BENCH_LSTM=0 python bench.py 2>>/tmp/window_play.log | tail -1)
-echo "{\"tag\": \"deepfm\", \"result\": $line}" >> "$OUT" || true
+row "deepfm"                 BENCH_LM=0 BENCH_DEEPFM=1
 # 7. LAST and riskiest: the stacked-LSTM compile that killed the relay.
-#    Only run if WINDOW_LSTM=1 (manual opt-in after everything is banked).
+#    Only with WINDOW_LSTM=1 (manual opt-in after everything is banked).
 if [ "${WINDOW_LSTM:-0}" = "1" ]; then
-  line=$(env BENCH_LM=0 BENCH_RESNET=0 BENCH_DEEPFM=0 python bench.py 2>>/tmp/window_play.log | tail -1)
-  echo "{\"tag\": \"stacked-lstm\", \"result\": $line}" >> "$OUT" || true
+  row "stacked-lstm"         BENCH_LM=0 BENCH_LSTM=1
 fi
 echo "WINDOW PLAY DONE $(date -u)" | tee -a /tmp/window_play.log
