@@ -29,6 +29,10 @@ for r in rows:
     t = r.get(tt) or 0
     cat[r.get("category", "?")] += t
     total += t
+if not rows or total == 0.0:
+    sys.exit("capture %s has an empty hlo_stats table (CPU-only traces "
+             "carry no HLO device stats — capture on the TPU backend)"
+             % fs[-1])
 for k, v in sorted(cat.items(), key=lambda kv: -kv[1]):
     print("%6.1f%%  %s" % (100 * v / total, k))
 print()
