@@ -61,6 +61,22 @@ def _enable_compile_cache():
     except Exception:  # cache flags unavailable: run without, never fail
         pass
 
+# Sweep winners baked as DEFAULTS (r5 on-device sweep, /tmp/sweep_r5.jsonl):
+# O1 0.5031 -> +fused-bwd 0.5320 -> O2 0.6170 -> O2+fused 0.6192 MFU.
+# Applied inside main() (a mere `import bench` must not mutate the
+# process env — pytest imports this module, and library behavior there
+# has to stay env-driven by the TEST, not by bench defaults):
+# - BENCH_AMP_LEVEL=O2 scopes to the LM phase ONLY (measured: O2 makes
+#   ResNet 35% slower — secondary phases take BENCH_RN/LSTM/DFM_
+#   AMP_LEVEL, default O1).
+# - the fused flash backward defaults ON only AFTER the smoke gate
+#   numerically validates it on this backend (_FUSED_BWD_BAKED below):
+#   gate-skipped paths (user pinned ATTN_BTHD, off-alignment heads,
+#   BENCH_PROBE_TIMEOUT=0) and the heads-16 ladder fallback (a
+#   DIFFERENT fused kernel variant than the one the gate checks) leave
+#   it off unless the user explicitly opted in.
+_FUSED_BWD_BAKED = False  # set by main(); False when imported as a lib
+
 # LM config. Default batch 16: flash attention + the fused LM head freed
 # the HBM the (T, T) scores and (N, V) logits used to occupy, and MFU at
 # the measured batch-8 steady state (~0.42) was still injection-limited —
@@ -280,16 +296,28 @@ def bench_lm_ladder(dev):
             if heads != head_ladder[-1]:
                 print("bench: %d-head config failed (%s); falling back"
                       % (heads, repr(e)[:200]), file=_sys.stderr)
+                if _FUSED_BWD_BAKED:
+                    # the heads-16 fallback runs the BHTD-layout fused
+                    # kernel — a DIFFERENT variant than the one the gate
+                    # validated; the baked default must not ship it
+                    # unvalidated (explicit user opt-in still would)
+                    _os.environ["PADDLE_TPU_FLASH_FUSED_BWD"] = "0"
             head_err = e
     raise head_err
 
 
-def _bench_phase(dev, build, feed, warmup, steps, stage=True):
+def _bench_phase(dev, build, feed, warmup, steps, stage=True,
+                 amp_level=None):
     """Shared phase scaffold (every bench phase differs only in its model
     builder and feed): seeded Program/Scope, `build()` under the program
     guards returning the loss var (the builder also calls minimize), AMP
     + optional remat transpilation, startup init, optional device staging
-    of the feed, slope timing. Returns (dt_per_step, last_loss)."""
+    of the feed, slope timing. Returns (dt_per_step, last_loss).
+
+    amp_level: the phase's AMP level; None reads BENCH_AMP_LEVEL (the LM
+    knob). O2 is the measured LM winner but made ResNet 35% SLOWER
+    (bf16 batchnorm stats lose the conv-epilogue fusions), so each
+    secondary phase passes its own default instead of inheriting."""
     import paddle_tpu as fluid
 
     main_p, startup = fluid.Program(), fluid.Program()
@@ -299,10 +327,11 @@ def _bench_phase(dev, build, feed, warmup, steps, stage=True):
         with fluid.unique_name.guard():
             loss = build()
         if AMP:
-            # bf16 matmuls, fp32 master weights; BENCH_AMP_LEVEL=O2 keeps
-            # the elementwise path (residual stream) in bf16 too
+            # bf16 matmuls, fp32 master weights; O2 keeps the
+            # elementwise path (residual stream) in bf16 too
             main_p.enable_mixed_precision(
-                level=_os.environ.get("BENCH_AMP_LEVEL", "O1"))
+                level=amp_level if amp_level is not None
+                else _os.environ.get("BENCH_AMP_LEVEL", "O1"))
         if _os.environ.get("BENCH_REMAT", "0") == "1":
             # rematerialize the backward: frees activation HBM so larger
             # per-chip batches fit (sweep lever for batch 24/32)
@@ -370,7 +399,9 @@ def bench_resnet(dev):
     # the image batch (~77 MB at batch 128) must live on device (staged):
     # re-uploading it every step through the tunneled TPU costs ~100x
     # the step's compute
-    dt, loss_val = _bench_phase(dev, build, feed, RN_WARMUP, RN_STEPS)
+    dt, loss_val = _bench_phase(
+        dev, build, feed, RN_WARMUP, RN_STEPS,
+        amp_level=_os.environ.get("BENCH_RN_AMP_LEVEL", "O1"))
 
     mfu = 3.0 * RN_FWD_FLOPS_PER_IMG * RN_BATCH / dt / _peak_flops(dev)
     res = {
@@ -403,19 +434,27 @@ def _bench_resnet_reader(dev, synthetic):
     from paddle_tpu.models.resnet import resnet_imagenet
 
     steps = int(_os.environ.get("BENCH_RN_READER_STEPS", 4))
+    # wire dtype: uint8 by default — images travel host->device as raw
+    # bytes (4x less traffic than f32) and are cast+normalized in-graph,
+    # the layout a production image pipeline uses anyway. f32 via
+    # BENCH_RN_READER_WIRE=float32 for the old apples-to-apples row.
+    wire = _os.environ.get("BENCH_RN_READER_WIRE", "uint8")
     # both window sizes run once untimed first (see below), then timed
     batches_needed = 2 * (steps + 2 * steps) + 2
     n_samples = 2 * RN_BATCH  # 2 distinct batches on disk, replayed
     pass_num = batches_needed * RN_BATCH // n_samples + 2
     path = _os.path.join(tempfile.gettempdir(),
-                         "ptpu_rn_%d.recordio" % RN_BATCH)
+                         "ptpu_rn_%d_%s.recordio" % (RN_BATCH, wire))
     if not _os.path.exists(path):
         r = np.random.RandomState(0)
 
         def samples():
             for _ in range(n_samples):
-                yield (r.randn(3, 224, 224).astype(np.float32),
-                       r.randint(0, 1000, (1,)).astype(np.int64))
+                if wire == "uint8":
+                    img = r.randint(0, 256, (3, 224, 224)).astype(np.uint8)
+                else:
+                    img = r.randn(3, 224, 224).astype(np.float32)
+                yield (img, r.randint(0, 1000, (1,)).astype(np.int64))
 
         fluid.recordio_convert(samples, path)
 
@@ -426,10 +465,15 @@ def _bench_resnet_reader(dev, synthetic):
         with fluid.unique_name.guard():
             reader = fluid.layers.open_recordio_file(
                 path, shapes=[(3, 224, 224), (1,)],
-                dtypes=["float32", "int64"], pass_num=pass_num)
+                dtypes=[wire, "int64"], pass_num=pass_num)
             reader = fluid.layers.batch(reader, batch_size=RN_BATCH)
             reader = fluid.layers.double_buffer(reader)
             data, label = fluid.layers.read_file(reader)
+            if wire == "uint8":
+                # cast + [0,255] -> [-1,1] normalize on DEVICE: the host
+                # ships bytes, the chip does the float conversion
+                data = fluid.layers.scale(fluid.layers.cast(data, "float32"),
+                                          scale=1.0 / 127.5, bias=-1.0)
             predict = resnet_imagenet(data, 1000, depth=50)
             avg_cost = fluid.layers.mean(
                 fluid.layers.cross_entropy(input=predict, label=label))
@@ -437,7 +481,7 @@ def _bench_resnet_reader(dev, synthetic):
                 avg_cost)
         if AMP:
             main_p.enable_mixed_precision(
-                level=_os.environ.get("BENCH_AMP_LEVEL", "O1"))
+                level=_os.environ.get("BENCH_RN_AMP_LEVEL", "O1"))
         exe = fluid.Executor(fluid.TPUPlace() if dev.platform != "cpu"
                              else fluid.CPUPlace())
         exe.run(startup)
@@ -462,12 +506,51 @@ def _bench_resnet_reader(dev, synthetic):
         dt = (t2 - t1) / steps
         if dt <= 0:
             dt = t2 / (2 * steps)
+
+    # upload CONTROL: host->device transfer of the exact bytes/step the
+    # reader window ships, with nothing else attached. Through a tunneled
+    # chip this link can be ~14 MB/s and dominate everything; on a real
+    # TPU host it is PCIe at GB/s. pipeline_overhead_pct is the honest
+    # reader cost: time beyond transfer + compute (≈0 when decode and
+    # batching fully overlap the step; the transfer itself is environment
+    # physics, not pipeline design).
+    import jax
+
+    import jax.numpy as jnp
+
+    wire_np = np.uint8 if wire == "uint8" else np.float32
+    shape = (RN_BATCH, 3, 224, 224)  # ONE batch = one step's wire bytes
+    # pre-compile the fence slice for this exact shape with a
+    # device-materialized array (zeros never cross the tunnel), so the
+    # timed region below is pure host->device transfer — no XLA compile,
+    # and O(1) in BENCH_RN_READER_STEPS (a (steps, ...) stack would ship
+    # GBs through a ~14 MB/s link for the same per-step number)
+    np.asarray(jnp.zeros(shape, wire_np)[0, 0, 0, :1])
+    r = np.random.RandomState(1)  # incompressible: relay compression
+    buf = (r.randint(0, 256, shape).astype(np.uint8) if wire == "uint8"
+           else r.randn(*shape).astype(np.float32))
+    t0 = time.perf_counter()
+    x = jax.device_put(buf, dev)
+    # fence = device->host read of ONE element (a full np.asarray would
+    # re-ship the whole batch back through the tunnel); the device-side
+    # slice can't run until the put lands
+    np.asarray(x[0, 0, 0, :1])
+    up_dt = time.perf_counter() - t0
+    # the double_buffer design OVERLAPS transfer with compute, so the
+    # ideal reader step is max(transfer, compute), not their sum —
+    # pipeline_overhead_pct is the cost ABOVE that ideal (≈0 when the
+    # pipeline overlaps perfectly; the transfer floor itself is link
+    # physics: ~14 MB/s through this tunnel, GB/s PCIe on a real host)
+    ideal = max(up_dt, synthetic["step_ms"] / 1e3)
     return {
         "step_ms": round(dt * 1e3, 2),
         "images_per_sec": round(RN_BATCH / dt, 1),
         "synthetic_step_ms": synthetic["step_ms"],
+        "wire_dtype": wire,
+        "upload_ms_per_step": round(up_dt * 1e3, 2),
         "input_overhead_pct": round(
             100.0 * (dt * 1e3 / synthetic["step_ms"] - 1.0), 1),
+        "pipeline_overhead_pct": round(100.0 * (dt / ideal - 1.0), 1),
         "loss": loss_val,
         "window_steps": steps,
     }
@@ -509,7 +592,9 @@ def bench_stacked_lstm(dev):
         "lengths": np.full((LSTM_BATCH,), LSTM_SEQ, np.int32),
         "label": r.randint(0, 2, (LSTM_BATCH, 1)).astype(np.int64),
     }
-    dt, loss_val = _bench_phase(dev, build, feed, LSTM_WARMUP, LSTM_STEPS)
+    dt, loss_val = _bench_phase(
+        dev, build, feed, LSTM_WARMUP, LSTM_STEPS,
+        amp_level=_os.environ.get("BENCH_LSTM_AMP_LEVEL", "O1"))
 
     mfu = _lstm_train_flops_per_step() / dt / _peak_flops(dev)
     return {
@@ -546,7 +631,9 @@ def bench_deepfm(dev):
         "dense": r.rand(DFM_BATCH, DFM_DENSE).astype(np.float32),
         "label": r.randint(0, 2, (DFM_BATCH, 1)).astype(np.int64),
     }
-    dt, loss_val = _bench_phase(dev, build, feed, DFM_WARMUP, DFM_STEPS)
+    dt, loss_val = _bench_phase(
+        dev, build, feed, DFM_WARMUP, DFM_STEPS,
+        amp_level=_os.environ.get("BENCH_DFM_AMP_LEVEL", "O1"))
 
     return {
         "rows_per_sec": round(DFM_BATCH / dt, 1),
@@ -616,6 +703,14 @@ def _bthd_smoke_gate():
         + ("jax.config.update('jax_platforms', %r)\n" % plat if plat else "")
         + ("jax.config.update('jax_compilation_cache_dir', %r)\n" % _CACHE_DIR)
         + """
+
+# an inherited PADDLE_TPU_FLASH_FUSED_BWD=1 (explicit user opt-in, or
+# the parent's baked value on a BENCH_BTHD_SMOKE=force re-run after a
+# prior ok) would make the 'plain BTHD' section below silently validate
+# the fused kernel, so a fused-only failure would take down the whole
+# layout instead of exiting 3 — force the PLAIN backward here (the
+# fused section re-enables it explicitly)
+os.environ['PADDLE_TPU_FLASH_FUSED_BWD'] = '0'
 from paddle_tpu.ops.attention import flash_attention, pallas_flash_attention_bthd
 r = np.random.RandomState(0)
 q, k, v = (jnp.asarray(0.5 * r.randn(1, 256, 2, 128), jnp.bfloat16)
@@ -708,6 +803,7 @@ except Exception as e:
             with open(memo) as f:
                 verdict = f.read().strip()
             if verdict == "ok":
+                _enable_baked_fused()
                 return None
             if verdict == "ok-nofused":
                 _disable_fused_bwd()
@@ -775,7 +871,15 @@ except Exception as e:
               file=_sys.stderr)
     else:
         _write_quiet(memo, "ok")
+        _enable_baked_fused()
     return None
+
+
+def _enable_baked_fused():
+    """The gate just validated the fused backward on this backend — turn
+    the baked default on (never overriding an explicit user choice)."""
+    if _FUSED_BWD_BAKED:
+        _os.environ["PADDLE_TPU_FLASH_FUSED_BWD"] = "1"
 
 
 def _disable_fused_bwd():
@@ -798,6 +902,11 @@ def _write_quiet(path, text):
 
 
 def main():
+    global _FUSED_BWD_BAKED
+    # sweep-winner defaults (see the _FUSED_BWD_BAKED comment block):
+    # AMP O2 for the LM phase; fused backward only once the gate says ok
+    _os.environ.setdefault("BENCH_AMP_LEVEL", "O2")
+    _FUSED_BWD_BAKED = "PADDLE_TPU_FLASH_FUSED_BWD" not in _os.environ
     probe_s = int(_os.environ.get("BENCH_PROBE_TIMEOUT", 150))
     attempts = int(_os.environ.get("BENCH_PROBE_ATTEMPTS", 2))
     problem = None
@@ -845,6 +954,8 @@ def main():
                        "layers": N_LAYER, "d_model": D_MODEL,
                        "n_head": lm["n_head"],
                        "attn_bthd": _os.environ.get("PADDLE_TPU_ATTN_BTHD", "1"),
+                       "fused_bwd": _os.environ.get(
+                           "PADDLE_TPU_FLASH_FUSED_BWD", "0"),
                        "amp_level": _os.environ.get("BENCH_AMP_LEVEL", "O1")},
         }
     else:

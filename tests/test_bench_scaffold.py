@@ -213,6 +213,10 @@ def test_probe_failure_attaches_local_capture(monkeypatch, tmp_path):
     monkeypatch.setattr(bench, "_LOCAL_CAPTURE", str(cap))
     monkeypatch.setattr(bench, "_probe_device", lambda t: "probe hung")
     monkeypatch.setenv("BENCH_PROBE_TIMEOUT", "1")
+    # main() mutates process-global bench state; keep it out of the
+    # suite's env (monkeypatch restores both on teardown)
+    monkeypatch.setattr(bench, "_FUSED_BWD_BAKED", False)
+    monkeypatch.setenv("BENCH_AMP_LEVEL", "O1")
     buf = io.StringIO()
     monkeypatch.setattr(_s, "stdout", buf)
     bench.main()
@@ -227,3 +231,71 @@ def test_probe_failure_attaches_local_capture(monkeypatch, tmp_path):
     bench.main()
     out2 = _json.loads(buf2.getvalue().strip().splitlines()[-1])
     assert out2["value"] is None and "last_local_capture" not in out2
+
+
+def test_baked_fused_default_is_gate_conditional(monkeypatch, tmp_path):
+    """The r5 sweep-winner fused backward defaults ON only when the smoke
+    gate affirmatively validated it: a gate-skipped path (user pinned
+    PADDLE_TPU_ATTN_BTHD) must leave the kernel off, and a fresh 'ok'
+    must turn it on — never overriding an explicit user setting.
+
+    The gate writes PADDLE_TPU_FLASH_FUSED_BWD via os.environ directly,
+    which monkeypatch cannot see — interleaving monkeypatch.delenv with
+    those raw writes records '1' as a prior value and teardown would
+    RESTORE the leak, flipping the attention backward kernel for every
+    later test file. Hence raw env ops + finally here."""
+    import os
+
+    _gate_env(monkeypatch, tmp_path, _FakeRes(0, b""))
+    monkeypatch.setattr(bench, "_FUSED_BWD_BAKED", True)
+    try:
+        # gate skipped: user pinned the layout -> fused stays unset (off)
+        monkeypatch.setenv("PADDLE_TPU_ATTN_BTHD", "1")
+        assert bench._bthd_smoke_gate() is None
+        assert os.environ.get("PADDLE_TPU_FLASH_FUSED_BWD") is None
+        # gate ran and passed -> the baked default engages
+        monkeypatch.delenv("PADDLE_TPU_ATTN_BTHD", raising=False)
+        assert bench._bthd_smoke_gate() is None
+        assert os.environ.get("PADDLE_TPU_FLASH_FUSED_BWD") == "1"
+        # memoized 'ok' re-applies it in a fresh process state
+        os.environ.pop("PADDLE_TPU_FLASH_FUSED_BWD", None)
+        assert bench._bthd_smoke_gate() is None
+        assert os.environ.get("PADDLE_TPU_FLASH_FUSED_BWD") == "1"
+        # an explicit user choice is never overridden
+        monkeypatch.setattr(bench, "_FUSED_BWD_BAKED", False)
+        os.environ["PADDLE_TPU_FLASH_FUSED_BWD"] = "0"
+        assert bench._bthd_smoke_gate() is None
+        assert os.environ.get("PADDLE_TPU_FLASH_FUSED_BWD") == "0"
+    finally:
+        os.environ.pop("PADDLE_TPU_FLASH_FUSED_BWD", None)
+
+
+def test_smoke_child_plain_check_forces_fused_bwd_off(monkeypatch, tmp_path):
+    """The smoke child inherits the parent env, where
+    PADDLE_TPU_FLASH_FUSED_BWD may be '1' (explicit user opt-in, or the
+    baked value when a force re-run follows a prior ok) — the child's
+    'plain BTHD' section must therefore force the var to '0' BEFORE the
+    kernels are traced, or a fused-only failure would indict the whole
+    layout instead of exiting 3 (the rc-3 contract the gate tests above
+    rely on)."""
+    import subprocess
+
+    monkeypatch.delenv("PADDLE_TPU_ATTN_BTHD", raising=False)
+    monkeypatch.delenv("BENCH_HEADS", raising=False)
+    monkeypatch.setenv("BENCH_PLATFORM", "faketpu")
+    import tempfile
+    monkeypatch.setattr(tempfile, "gettempdir", lambda: str(tmp_path))
+    seen = {}
+
+    def capture(cmd, **k):
+        seen["code"] = cmd[-1]
+        return _FakeRes(0, b"")
+
+    monkeypatch.setattr(subprocess, "run", capture)
+    assert bench._bthd_smoke_gate() is None
+    code = seen["code"]
+    off = code.index("os.environ['PADDLE_TPU_FLASH_FUSED_BWD'] = '0'")
+    imp = code.index("from paddle_tpu.ops.attention")
+    plain_ok = code.index("SMOKE_PLAIN_OK")
+    on = code.index("os.environ['PADDLE_TPU_FLASH_FUSED_BWD'] = '1'")
+    assert off < imp < plain_ok < on

@@ -30,7 +30,14 @@ with fluid.scope_guard(scope), fluid.program_guard(main_p, startup):
             loss, _ = models.transformer.transformer_lm(ids, lbl, vocab_size=V, n_layer=L, n_head=H, d_model=D, d_inner=F, max_len=S)
             optimizer.Adam(learning_rate=1e-4).minimize(loss)
     if _e("BENCH_AMP", "1") == "1":
-        main_p.enable_mixed_precision(level=_e("BENCH_AMP_LEVEL", "O1"))
+        # mirror bench.py main()'s per-phase AMP defaults: the trace must
+        # capture the SAME graph the bench times — LM at O2 (the r5
+        # sweep winner bench.main() bakes), ResNet pinned O1 (O2
+        # measured 35% slower there). bench.main() never runs here, so
+        # the defaults are restated per phase.
+        main_p.enable_mixed_precision(
+            level=_e("BENCH_RN_AMP_LEVEL", "O1") if MODEL == "resnet"
+            else _e("BENCH_AMP_LEVEL", "O2"))
     exe = fluid.Executor(fluid.TPUPlace())
     exe.run(startup)
     r = np.random.RandomState(0)

@@ -15,8 +15,13 @@ OUT="${OUT:-/tmp/sweep_results.txt}"
 
 run() {
   echo "=== $* ==="
-  # defaults first, "$@" last: a row's own BENCH_* assignments win
+  # defaults first, "$@" last: a row's own BENCH_* assignments win.
+  # BENCH_AMP_LEVEL=O1 + FUSED_BWD=0 pin the historical lever-isolation
+  # baseline: bench.py now BAKES the sweep winner (O2 + fused) as its
+  # process defaults, which would otherwise silently turn every row
+  # below into the same config and zero all the deltas.
   line=$(env BENCH_RESNET=0 BENCH_LSTM=0 BENCH_DEEPFM=0 \
+         BENCH_AMP_LEVEL=O1 PADDLE_TPU_FLASH_FUSED_BWD=0 \
          BENCH_PROBE_TIMEOUT=150 "$@" timeout 2400 \
          python bench.py 2>/dev/null | tail -1)
   echo "$line"
@@ -30,7 +35,9 @@ try: print(json.dumps(json.loads(sys.argv[1])))
 except Exception: print("null")' "${1:-null}"
 }
 
-# 1. confirm the default config + prime the compile cache
+# 0. the baked bench.py defaults (r5 winner: O2 + fused backward)
+run BENCH_BATCH=16 BENCH_AMP_LEVEL=O2 PADDLE_TPU_FLASH_FUSED_BWD=1
+# 1. confirm the O1 lever-isolation baseline + prime the compile cache
 run BENCH_BATCH=16
 # 2. same config with a profiler trace (cached compile; /tmp/jaxprof)
 run BENCH_BATCH=16 BENCH_PROFILE=1

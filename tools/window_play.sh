@@ -14,11 +14,15 @@ cd "$(dirname "$0")/.."
 OUT=/tmp/sweep_r5.jsonl
 
 row() {
-  # defaults first, "$@" last: a row's own BENCH_* assignments win
+  # defaults first, "$@" last: a row's own BENCH_* assignments win.
+  # O1 + no-fused pins the lever-isolation baseline (bench.py bakes the
+  # O2+fused winner as its own defaults — without the pin every row
+  # here would measure the identical config).
   local tag="$1"; shift
   echo "=== $tag ($(date -u +%H:%M:%S)) ===" | tee -a /tmp/window_play.log
   local line
-  line=$(env BENCH_RESNET=0 BENCH_LSTM=0 BENCH_DEEPFM=0 "$@" timeout 2700 \
+  line=$(env BENCH_RESNET=0 BENCH_LSTM=0 BENCH_DEEPFM=0 \
+         BENCH_AMP_LEVEL=O1 PADDLE_TPU_FLASH_FUSED_BWD=0 "$@" timeout 2700 \
          python bench.py 2>>/tmp/window_play.log | tail -1)
   echo "$line" | tee -a /tmp/window_play.log
   python - "$tag" "$line" <<'EOF' >> "$OUT"
@@ -32,6 +36,8 @@ EOF
 touch /tmp/tpu_busy
 trap 'rm -f /tmp/tpu_busy' EXIT
 
+# 0. the baked bench.py defaults (what the driver's plain run measures)
+row "baked-defaults"         BENCH_BATCH=16 BENCH_HEADS=8 BENCH_AMP_LEVEL=O2 PADDLE_TPU_FLASH_FUSED_BWD=1
 # 1. headline candidates, most-likely-winner first (BTHD engages via the
 #    fixed kernels; smoke re-runs automatically on the new kernel hash)
 row "heads8-bthd"            BENCH_BATCH=16 BENCH_HEADS=8
