@@ -434,13 +434,17 @@ def _bench_resnet_reader(dev, synthetic):
     from paddle_tpu.models.resnet import resnet_imagenet
 
     steps = int(_os.environ.get("BENCH_RN_READER_STEPS", 4))
+    timed_windows = int(_os.environ.get("BENCH_RN_READER_WINDOWS", 3))
     # wire dtype: uint8 by default — images travel host->device as raw
     # bytes (4x less traffic than f32) and are cast+normalized in-graph,
     # the layout a production image pipeline uses anyway. f32 via
     # BENCH_RN_READER_WIRE=float32 for the old apples-to-apples row.
     wire = _os.environ.get("BENCH_RN_READER_WIRE", "uint8")
-    # both window sizes run once untimed first (see below), then timed
-    batches_needed = 2 * (steps + 2 * steps) + 2
+    # UNIFORM windows (training-loop shape: Trainer's steps_per_loop is
+    # fixed): 2 warmups (first compiles; second engages the executor's
+    # stable-size window prefetch) + timed windows + one window the
+    # prefetch holds staged at the end
+    batches_needed = (2 + timed_windows + 2) * steps + 2
     n_samples = 2 * RN_BATCH  # 2 distinct batches on disk, replayed
     pass_num = batches_needed * RN_BATCH // n_samples + 2
     path = _os.path.join(tempfile.gettempdir(),
@@ -491,21 +495,24 @@ def _bench_resnet_reader(dev, synthetic):
                                return_numpy=False)
             return float(np.asarray(out[0]).reshape(-1)[0])
 
-        # UNLIKE the synthetic path, each window size k is its own
-        # executable (the stacked reader upload is (k, ...)-shaped, and
-        # k can't be a traced dim of a host-side stack) — warm BOTH
-        # sizes before the slope, or T(2k)-T(k) measures a compile
+        # uniform windows, mean-timed: the per-window fixed costs (pull,
+        # stack, transfer, fence) are REAL training-loop costs here, so
+        # no slope trick — warm twice (compile, then prefetch engages on
+        # the stable size), then average the steady state
         window(steps)
-        window(2 * steps)
-        t0 = time.perf_counter()
         window(steps)
-        t1 = time.perf_counter() - t0
         t0 = time.perf_counter()
-        loss_val = window(2 * steps)
-        t2 = time.perf_counter() - t0
-        dt = (t2 - t1) / steps
-        if dt <= 0:
-            dt = t2 / (2 * steps)
+        for _ in range(timed_windows):
+            loss_val = window(steps)
+        dt = (time.perf_counter() - t0) / (timed_windows * steps)
+
+        # drain the window the executor prefetched during the last timed
+        # call: its async device_put is still riding the link, and the
+        # upload control below must not time its own transfer queued
+        # behind it
+        slot = exe._reader_prefetch.get(main_p)
+        for a in ((slot or {}).get("feeds") or {}).values():
+            np.asarray(a[tuple(0 for _ in a.shape[:-1])][:1])
 
     # upload CONTROL: host->device transfer of the exact bytes/step the
     # reader window ships, with nothing else attached. Through a tunneled
@@ -536,18 +543,29 @@ def _bench_resnet_reader(dev, synthetic):
     # slice can't run until the put lands
     np.asarray(x[0, 0, 0, :1])
     up_dt = time.perf_counter() - t0
+    # round-trip control: one dispatch + one 4-byte fetch — every window
+    # pays ~2 of these (dispatch, loss fence) regardless of size. µs on
+    # local hardware; can be SECONDS through a degraded tunnel.
+    tiny = jax.device_put(np.zeros((1,), np.float32), dev)
+    np.asarray(tiny * 1)  # warm the trivial executable
+    t0 = time.perf_counter()
+    np.asarray(tiny * 1)
+    rtt = time.perf_counter() - t0
     # the double_buffer design OVERLAPS transfer with compute, so the
-    # ideal reader step is max(transfer, compute), not their sum —
-    # pipeline_overhead_pct is the cost ABOVE that ideal (≈0 when the
-    # pipeline overlaps perfectly; the transfer floor itself is link
-    # physics: ~14 MB/s through this tunnel, GB/s PCIe on a real host)
-    ideal = max(up_dt, synthetic["step_ms"] / 1e3)
+    # ideal reader step is max(transfer, compute) plus the per-window
+    # round trips, not their sum — pipeline_overhead_pct is the cost
+    # ABOVE that ideal (≈0 when the pipeline overlaps perfectly; the
+    # transfer floor and RTTs are link physics: ~14 MB/s and ~1 s here,
+    # GB/s PCIe and µs dispatches on a real host)
+    ideal = (max(up_dt, synthetic["step_ms"] / 1e3)
+             + 2.0 * rtt / max(1, steps))
     return {
         "step_ms": round(dt * 1e3, 2),
         "images_per_sec": round(RN_BATCH / dt, 1),
         "synthetic_step_ms": synthetic["step_ms"],
         "wire_dtype": wire,
         "upload_ms_per_step": round(up_dt * 1e3, 2),
+        "rtt_ms": round(rtt * 1e3, 2),
         "input_overhead_pct": round(
             100.0 * (dt * 1e3 / synthetic["step_ms"] - 1.0), 1),
         "pipeline_overhead_pct": round(100.0 * (dt / ideal - 1.0), 1),

@@ -184,6 +184,19 @@ class Executor:
         # Weak keys: a dead program's counter must die with it, never be
         # inherited by a new program allocated at the same address
         self._steps = weakref.WeakKeyDictionary()
+        # per-program prefetched reader window (run_loop double-buffer):
+        # the NEXT window's batches, already stacked and device_put, so
+        # its host->device transfer overlaps the CURRENT window's device
+        # execution — the device-side buffering the reference gets from
+        # create_double_buffer_reader_op.cc. Raw batches ride along so a
+        # mismatched next call (different steps / program version, or a
+        # plain run()) can push them back and lose nothing. Staging only
+        # pays when the next window's size is PREDICTABLE, so it engages
+        # once two consecutive run_loop calls use the same `steps`
+        # (_last_loop_steps below) — alternating sizes would waste a
+        # full window transfer per call.
+        self._reader_prefetch = weakref.WeakKeyDictionary()
+        self._last_loop_steps = weakref.WeakKeyDictionary()
         self._last_step = 0  # most recent step index (error messages)
         self._seed = 0
         self._base_keys: Dict = {}
@@ -371,6 +384,89 @@ class Executor:
             holder._ptpu_pushback = buf
         buf.insert(0, batch)
 
+    def _pull_reader_window(self, gb, read_ops, steps):
+        """Pull up to `steps` aligned batches from every read op.
+        Returns (op_windows, k, eof_exc): op_windows is a list of
+        (op, holder, batches[:k], holder_epoch) — batches beyond the
+        common window k are already pushed back (multi-reader skew
+        realignment; k == 0 pushes ALL pulls back so an EOF on one
+        reader costs the others nothing). holder_epoch snapshots the
+        holder's reset/start generation so a later flush can tell these
+        batches belong to the CURRENT epoch. eof_exc is the EOFException
+        that closed the window early, or None."""
+        from .io.reader import EOFException  # local: io imports executor
+
+        op_windows = []
+        eof_exc = None
+        for op in read_ops:
+            holder = self._holder_for(gb, op)
+            out_names = op.output("Out")
+            batches = []
+            for _ in range(steps):
+                try:
+                    b = self._next_batch(holder)
+                except EOFException as e:
+                    eof_exc = e
+                    break
+                if batches and any(
+                        np.shape(b[o]) != np.shape(batches[0][o])
+                        for o in out_names):
+                    # shape boundary (e.g. partial final batch): close
+                    # the window here, keep the batch for the next call
+                    self._push_back(holder, b)
+                    break
+                batches.append(b)
+            op_windows.append((op, holder, batches,
+                               getattr(holder, "_ptpu_epoch", 0)))
+        k = min(len(b) for _, _, b, _e in op_windows) if op_windows else 0
+        for _op, holder, batches, _e in op_windows:
+            for b in reversed(batches[k:]):
+                self._push_back(holder, b)
+            del batches[k:]
+        return op_windows, k, eof_exc
+
+    def _stack_reader_window(self, gb, op_windows, k, stage):
+        """Stack each reader output into a (k, ...) per-step feed.
+        int64/float64 are canonicalized the way jax would anyway (x64
+        off), so the feed signature is identical whether the window is
+        host numpy or device-staged. stage=True additionally device_puts
+        each stack — an ASYNC transfer, which is the whole point: issued
+        right after the current window's dispatch, it rides the link
+        while the device is busy computing."""
+        feeds = {}
+        for op, _holder, batches, _epoch in op_windows:
+            for out_name in op.output("Out"):
+                var = gb._find_var_recursive(out_name)
+                arr = np.stack(
+                    [np.asarray(_as_feed_array(b[out_name], var))
+                     for b in batches[:k]])
+                if not jax.config.jax_enable_x64:
+                    if arr.dtype == np.int64:
+                        arr = arr.astype(np.int32)
+                    elif arr.dtype == np.float64:
+                        arr = arr.astype(np.float32)
+                feeds[out_name] = jax.device_put(arr) if stage else arr
+        return feeds
+
+    def _flush_reader_prefetch(self, program, slot=None):
+        """Return a consumed-but-unused prefetch window to its holders
+        (raw batches, original order) — called whenever the prefetched
+        shape can't be used: different steps, new program version, a
+        plain run(), or cache-off mode. Pass `slot` when it was already
+        popped. Batches from a holder whose reset()/start() epoch moved
+        on are DROPPED, not pushed back: they belong to the finished
+        epoch (same contract as the _ptpu_pushback clear in
+        layers.io._make_reader_var)."""
+        if slot is None:
+            slot = self._reader_prefetch.pop(program, None)
+        if slot is None:
+            return
+        for _op, holder, batches, epoch in reversed(slot["op_windows"]):
+            if getattr(holder, "_ptpu_epoch", 0) != epoch:
+                continue  # stale epoch: discard
+            for b in reversed(batches):
+                self._push_back(holder, b)
+
     def _gather_state(self, compiled, scope):
         state = {}
         for name in compiled.state_in_names:
@@ -427,6 +523,9 @@ class Executor:
         # op and inject its outputs as this step's feeds (reference:
         # operators/reader/read_op.cc pulling from the ReaderHolder).
         # Raises io.reader.EOFException when the pipeline is exhausted.
+        # A window run_loop prefetched but never consumed goes back to
+        # the holders first, so this step sees batches in pipeline order.
+        self._flush_reader_prefetch(program)
         for op in self._read_ops_for(program, gb):
             holder = self._holder_for(gb, op)
             batch = self._next_batch(holder)
@@ -501,6 +600,14 @@ class Executor:
         back for the next call. Each distinct window length k compiles its
         own executable (the stacked leading dim is a static shape); the
         feed-only path compiles once for any `steps`.
+
+        Reader windows are DOUBLE-BUFFERED across calls: after dispatching
+        window N, the executor pulls window N+1 and device_puts it
+        asynchronously, so its host->device transfer overlaps window N's
+        device execution (the reference's create_double_buffer_reader_op
+        behavior). A next call with different `steps`, a changed program,
+        or a plain run() pushes the prefetched batches back untouched.
+        PADDLE_TPU_READER_PREFETCH=0 disables it.
         """
         if steps < 1:
             raise ValueError("run_loop needs steps >= 1, got %d" % steps)
@@ -535,11 +642,11 @@ class Executor:
             else:
                 feed_arrays[name] = _as_feed_array(value, var)
 
-        # reader ops: pull a window of up to `steps` batches per reader so
-        # the whole window uploads in one transfer and the loop body slices
-        # it on device
-        from .io.reader import EOFException  # local: io imports executor
-
+        # reader ops: a window of up to `steps` batches per reader, so the
+        # whole window uploads in one transfer and the loop body slices it
+        # on device. The window comes from the prefetch slot when the
+        # previous run_loop call staged it (its device_put then overlapped
+        # that call's execution), else from a fresh pull here.
         read_ops = self._read_ops_for(program, gb)
         if read_ops and per_step_names:
             # checked BEFORE any pull so a failed call consumes nothing
@@ -547,44 +654,40 @@ class Executor:
                 "per_step_feeds cannot be combined with reader-op "
                 "programs (the reader window length may truncate below "
                 "`steps`, desynchronizing the stacked feeds)")
-        op_windows = []
         eof_exc = None
-        for op in read_ops:
-            holder = self._holder_for(gb, op)
-            out_names = op.output("Out")
-            batches = []
-            for _ in range(steps):
-                try:
-                    b = self._next_batch(holder)
-                except EOFException as e:
-                    eof_exc = e
-                    break
-                if batches and any(
-                        np.shape(b[o]) != np.shape(batches[0][o])
-                        for o in out_names):
-                    # shape boundary (e.g. partial final batch): close the
-                    # window here, keep the batch for the next call
-                    self._push_back(holder, b)
-                    break
-                batches.append(b)
-            op_windows.append((op, holder, batches))
+        prefetch_on = (use_program_cache and os.environ.get(
+            "PADDLE_TPU_READER_PREFETCH", "1") != "0"
+            # stage ahead only once the window size proves stable: the
+            # first call (or a size change) can't predict the next
+            # window, and a wrong guess costs a full wasted transfer
+            and self._last_loop_steps.get(program) == steps)
+        self._last_loop_steps[program] = steps
         if read_ops:
-            k = min(len(b) for _, _, b in op_windows)
-            for op, holder, batches in op_windows:
-                # push everything beyond the common window back (multi-
-                # reader skew realignment; k == 0 pushes ALL pulls back so
-                # an EOF on one reader costs the others nothing)
-                for b in reversed(batches[k:]):
-                    self._push_back(holder, b)
-            if k == 0:
-                raise eof_exc  # exhausted before the window started
-            for op, holder, batches in op_windows:
-                for out_name in op.output("Out"):
-                    var = gb._find_var_recursive(out_name)
-                    feed_arrays[out_name] = np.stack(
-                        [np.asarray(_as_feed_array(b[out_name], var))
-                         for b in batches[:k]])
-                    per_step_names.add(out_name)
+            slot = self._reader_prefetch.pop(program, None)
+            if slot is not None and (
+                    slot["version"] != program._version
+                    or slot["steps"] != steps or not prefetch_on
+                    or any(getattr(h, "_ptpu_epoch", 0) != e
+                           for _o, h, _b, e in slot["op_windows"])):
+                # unusable (shape mismatch, or a reset() started a new
+                # epoch): restore still-current batches, pull fresh below
+                self._flush_reader_prefetch(program, slot)
+                slot = None
+            if slot is not None and slot["k"] == 0:
+                raise slot["eof"]  # prefetch found the pipeline exhausted
+            if slot is not None:
+                window_feeds, k, eof_exc = (slot["feeds"], slot["k"],
+                                            slot["eof"])
+            else:
+                op_windows, k, eof_exc = self._pull_reader_window(
+                    gb, read_ops, steps)
+                if k == 0:
+                    raise eof_exc  # exhausted before the window started
+                window_feeds = self._stack_reader_window(
+                    gb, op_windows, k, stage=False)
+            for out_name, arr in window_feeds.items():
+                feed_arrays[out_name] = arr
+                per_step_names.add(out_name)
             effective_steps = k
         else:
             effective_steps = steps
@@ -622,7 +725,33 @@ class Executor:
         else:
             fetches, new_state = compiled.fn(feed_arrays, state, rng_key,
                                              step0, np.int32(effective_steps))
+        if read_ops and prefetch_on and eof_exc is None:
+            # stage the NEXT window now, while the device is still
+            # executing this one: the host pull/stack and the async
+            # device_put transfer hide under the current window's compute
+            # + the caller's fence instead of serializing before the next
+            # dispatch. An EOF mid-pull is remembered: k>0 means the next
+            # call trains the short window (contract), k==0 means the
+            # next call must raise. ANY other reader error is deferred
+            # the same way — window N already executed, and raising here
+            # would lose its state update and fetches; the error belongs
+            # to the call that would have consumed the broken batch.
+            try:
+                nwin, nk, neof = self._pull_reader_window(
+                    gb, read_ops, steps)
+                self._reader_prefetch[program] = {
+                    "version": program._version, "steps": steps, "k": nk,
+                    "eof": neof, "op_windows": nwin,
+                    "feeds": (self._stack_reader_window(
+                        gb, nwin, nk, stage=True) if nk else None),
+                }
+            except Exception as e:  # noqa: BLE001 — deferred, not dropped
+                self._reader_prefetch[program] = {
+                    "version": program._version, "steps": steps, "k": 0,
+                    "eof": e, "op_windows": [], "feeds": None,
+                }
         return self._finish(compiled, fetches, new_state, scope, return_numpy)
 
     def close(self):
         self._cache.clear()
+        self._reader_prefetch.clear()

@@ -67,10 +67,13 @@ def _make_reader_var(holder, name=None):
 
     # start()/reset() begin a fresh epoch: any batch a run_loop window
     # pushed back (partial-shape boundary) belongs to the OLD epoch and
-    # must not replay into the new one
+    # must not replay into the new one. The epoch counter lets the
+    # executor's prefetched windows (which hold already-pulled batches)
+    # detect the same staleness and drop instead of pushing back.
     def _fresh_epoch(fn):
         def wrapped():
             holder._ptpu_pushback = []
+            holder._ptpu_epoch = getattr(holder, "_ptpu_epoch", 0) + 1
             return fn()
         return wrapped
 

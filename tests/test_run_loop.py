@@ -448,3 +448,113 @@ def test_run_loop_per_step_feeds_with_reader_fails_before_pull():
         # all 6 batches still trainable
         exe.run_loop(main_p, fetch_list=[loss], steps=6)
         assert exe._steps[main_p] == 6
+
+
+def test_reader_prefetch_parity_and_flush(monkeypatch):
+    """The double-buffer prefetch (r5) must be invisible to semantics:
+    identical per-window losses and step counts with
+    PADDLE_TPU_READER_PREFETCH on and off, and a plain run() interleaved
+    after a run_loop must see the very next batch in pipeline order
+    (the prefetched window goes back to the holder untouched)."""
+    rs = np.random.RandomState(21)
+    batches = [rs.randn(4, 2).astype(np.float32) for _ in range(9)]
+
+    def run_epoch(prefetch):
+        monkeypatch.setenv("PADDLE_TPU_READER_PREFETCH", prefetch)
+        main_p, startup, scope, loss, reader = _build_reader_prog(
+            batches, "pf_%s" % prefetch)
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            reader.start()
+            losses = [float(exe.run_loop(main_p, fetch_list=[loss],
+                                         steps=3)[0])
+                      for _ in range(2)]
+            # plain run() must consume batch 7 (index 6), not a batch
+            # displaced by the prefetched window
+            losses.append(float(exe.run(main_p, fetch_list=[loss])[0]))
+            # the remaining 2 batches drain through one more window
+            losses.append(float(exe.run_loop(main_p, fetch_list=[loss],
+                                             steps=3)[0]))
+            assert exe._steps[main_p] == 9
+        return losses
+
+    assert run_epoch("0") == run_epoch("1")
+
+
+def test_reader_prefetch_steps_change_loses_nothing(monkeypatch):
+    """A run_loop with a DIFFERENT steps value after a prefetching call
+    must push the staged window back and train every batch exactly
+    once."""
+    monkeypatch.setenv("PADDLE_TPU_READER_PREFETCH", "1")
+    rs = np.random.RandomState(22)
+    batches = [rs.randn(4, 2).astype(np.float32) for _ in range(7)]
+    main_p, startup, scope, loss, reader = _build_reader_prog(
+        batches, "pf_steps")
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        reader.start()
+        exe.run_loop(main_p, fetch_list=[loss], steps=3)  # prefetches 3
+        exe.run_loop(main_p, fetch_list=[loss], steps=2)  # mismatched k
+        exe.run_loop(main_p, fetch_list=[loss], steps=2)
+        assert exe._steps[main_p] == 7
+
+
+def test_reader_prefetch_reset_discards_staged_window(monkeypatch):
+    """reset()/start() begin a fresh epoch: a window the executor
+    prefetched from the OLD epoch must be dropped, not replayed (the
+    prefetch analogue of test_reader_reset_discards_pushed_back_batch)."""
+    monkeypatch.setenv("PADDLE_TPU_READER_PREFETCH", "1")
+    poison = [np.zeros((4, 2), np.float32) for _ in range(6)] + [
+        np.full((4, 2), 99.0, np.float32) for _ in range(3)]
+    main_p, startup, scope, loss, reader = _build_reader_prog(
+        poison, "pf_reset")
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        reader.start()
+        # two equal-size windows train zeros; the second call's stable
+        # window size lets the prefetch stage the 99-batches
+        exe.run_loop(main_p, fetch_list=[loss], steps=3)
+        exe.run_loop(main_p, fetch_list=[loss], steps=3)
+        assert main_p in exe._reader_prefetch
+        reader.reset()
+        reader.start()  # fresh epoch: zeros again
+        (lv,) = exe.run_loop(main_p, fetch_list=[loss], steps=3)
+        assert float(lv) < 50.0, "stale prefetched window replayed: %r" % lv
+
+
+def test_reader_prefetch_defers_non_eof_errors(monkeypatch):
+    """A reader error hit while STAGING the next window must not cost
+    the just-executed window its fetches/state update — it surfaces on
+    the call that would have consumed the broken batch. (Injected at the
+    pull seam: py_reader's pump converts provider errors to EOF, so a
+    raw non-EOF error here models a decode/cast failure on the main
+    thread.)"""
+    monkeypatch.setenv("PADDLE_TPU_READER_PREFETCH", "1")
+    rs = np.random.RandomState(23)
+    batches = [rs.randn(4, 2).astype(np.float32) for _ in range(9)]
+    main_p, startup, scope, loss, reader = _build_reader_prog(
+        batches, "pf_err")
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        reader.start()
+        exe.run_loop(main_p, fetch_list=[loss], steps=3)  # no prefetch yet
+
+        orig = exe._pull_reader_window
+        calls = {"n": 0}
+
+        def flaky(gb, ops, steps):
+            calls["n"] += 1
+            if calls["n"] == 2:  # call 2's PREFETCH pull, after dispatch
+                raise ValueError("corrupt record")
+            return orig(gb, ops, steps)
+
+        monkeypatch.setattr(exe, "_pull_reader_window", flaky)
+        (lv,) = exe.run_loop(main_p, fetch_list=[loss], steps=3)
+        assert np.isfinite(lv).all()
+        assert exe._steps[main_p] == 6  # both windows fully trained
+        with pytest.raises(ValueError, match="corrupt record"):
+            exe.run_loop(main_p, fetch_list=[loss], steps=3)
