@@ -386,7 +386,8 @@ def bench_resnet(dev):
 
     def build():
         avg_cost, acc, feeds = models.resnet.get_model(
-            dataset="imagenet", depth=50)
+            dataset="imagenet", depth=50,
+            layout=_os.environ.get("BENCH_RN_LAYOUT", "NCHW"))
         optimizer.Momentum(learning_rate=0.1, momentum=0.9).minimize(
             avg_cost)
         return avg_cost
@@ -411,6 +412,8 @@ def bench_resnet(dev):
         "batch": RN_BATCH,
         "loss": loss_val,
     }
+    if _os.environ.get("BENCH_RN_LAYOUT", "NCHW") != "NCHW":
+        res["layout"] = _os.environ["BENCH_RN_LAYOUT"]
     if _os.environ.get("BENCH_RESNET_INPUT", "synthetic") == "reader":
         try:
             res["reader"] = _bench_resnet_reader(dev, res)
@@ -478,7 +481,9 @@ def _bench_resnet_reader(dev, synthetic):
                 # ships bytes, the chip does the float conversion
                 data = fluid.layers.scale(fluid.layers.cast(data, "float32"),
                                           scale=1.0 / 127.5, bias=-1.0)
-            predict = resnet_imagenet(data, 1000, depth=50)
+            predict = resnet_imagenet(
+                data, 1000, depth=50,
+                layout=_os.environ.get("BENCH_RN_LAYOUT", "NCHW"))
             avg_cost = fluid.layers.mean(
                 fluid.layers.cross_entropy(input=predict, label=label))
             optimizer.Momentum(learning_rate=0.1, momentum=0.9).minimize(
@@ -1030,6 +1035,15 @@ def _save_local_capture(result, dev):
     checkout the driver/judge reads."""
     if getattr(dev, "platform", "cpu") == "cpu" or result.get("value") is None:
         return
+    # only a FULL driver-shaped run (all four workloads, none errored)
+    # may replace the banked capture: a partial/experimental row (phase
+    # skips, sweep env) must not clobber the best complete record
+    for key in ("resnet50", "deepfm", "stacked_lstm"):
+        obj = result.get(key)
+        if not isinstance(obj, dict) or "error" in obj:
+            return
+    if _os.environ.get("BENCH_RN_LAYOUT", "NCHW") != "NCHW":
+        return  # experimental-layout run, not the baseline record
     payload = dict(result)
     payload["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                            time.gmtime())

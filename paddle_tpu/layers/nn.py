@@ -450,13 +450,19 @@ def conv2d(
     use_mkldnn=False,
     act=None,
     name=None,
+    data_format="NCHW",
 ):
-    """reference nn.py:conv2d (conv_op.cc). NCHW/OIHW layouts; `use_cudnn`
-    and `use_mkldnn` are accepted and ignored (XLA picks the TPU conv)."""
+    """reference nn.py:conv2d (conv_op.cc). Filter is OIHW in either
+    data_format ("NCHW"/"NHWC", matching the reference attr); `use_cudnn`
+    and `use_mkldnn` are accepted and ignored (XLA picks the TPU conv).
+    NHWC keeps channels lane-minor on TPU — see the conv2d kernel note."""
     helper = LayerHelper("conv2d", **locals())
     dtype = input.dtype
     groups = groups or 1
-    n, c, h, w_dim = input.shape
+    if data_format == "NHWC":
+        n, h, w_dim, c = input.shape
+    else:
+        n, c, h, w_dim = input.shape
     fs = _to_list(filter_size, 2)
     st = _to_list(stride, 2)
     pd = _to_list(padding, 2)
@@ -474,16 +480,18 @@ def conv2d(
     )
     out_h = _conv_out_size(h, fs[0], pd[0], st[0], dl[0])
     out_w = _conv_out_size(w_dim, fs[1], pd[1], st[1], dl[1])
-    pre_bias = helper.create_variable_for_type_inference(
-        dtype, shape=(n, num_filters, out_h, out_w)
-    )
+    out_shape = ((n, out_h, out_w, num_filters) if data_format == "NHWC"
+                 else (n, num_filters, out_h, out_w))
+    pre_bias = helper.create_variable_for_type_inference(dtype, shape=out_shape)
     helper.append_op(
         type="conv2d",
         inputs={"Input": [input], "Filter": [w]},
         outputs={"Output": [pre_bias]},
-        attrs={"strides": st, "paddings": pd, "dilations": dl, "groups": groups},
+        attrs={"strides": st, "paddings": pd, "dilations": dl,
+               "groups": groups, "data_format": data_format},
     )
-    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+    cdim = 3 if data_format == "NHWC" else 1
+    pre_act = helper.append_bias_op(pre_bias, dim_start=cdim, dim_end=cdim + 1)
     return helper.append_activation(pre_act)
 
 
@@ -641,9 +649,13 @@ def pool2d(
     use_mkldnn=False,
     name=None,
     exclusive=True,
+    data_format="NCHW",
 ):
     helper = LayerHelper("pool2d", **locals())
-    n, c, h, w_dim = input.shape
+    if data_format == "NHWC":
+        n, h, w_dim, c = input.shape
+    else:
+        n, c, h, w_dim = input.shape
     ks = _to_list(pool_size, 2)
     st = _to_list(pool_stride, 2)
     pd = _to_list(pool_padding, 2)
@@ -659,7 +671,9 @@ def pool2d(
 
         out_h = _psize(h, ks[0], pd[0], st[0])
         out_w = _psize(w_dim, ks[1], pd[1], st[1])
-    out = helper.create_variable_for_type_inference(input.dtype, shape=(n, c, out_h, out_w))
+    out_shape = ((n, out_h, out_w, c) if data_format == "NHWC"
+                 else (n, c, out_h, out_w))
+    out = helper.create_variable_for_type_inference(input.dtype, shape=out_shape)
     helper.append_op(
         type="pool2d",
         inputs={"X": [input]},
@@ -672,6 +686,7 @@ def pool2d(
             "global_pooling": global_pooling,
             "ceil_mode": ceil_mode,
             "exclusive": exclusive,
+            "data_format": data_format,
         },
     )
     return out
