@@ -105,7 +105,10 @@ RN_FWD_FLOPS_PER_IMG = 2 * 4.089e9
 # benchmark/fluid/models/stacked_dynamic_lstm.py:1 — emb 512, lstm 512,
 # stacked 3; the reference feeds ragged LoD batches cropped at 1500 words,
 # our dense+lengths convention pads to a static BENCH_LSTM_SEQ instead)
-LSTM_BATCH = int(_os.environ.get("BENCH_LSTM_BATCH", 32))
+# batch 64 measured +15% words/s over 32 on-chip (r5 third session:
+# 360,417 vs 312,896 at seq 512) — the scan step is small-matmul bound,
+# so doubling rows per step is nearly free until HBM fills
+LSTM_BATCH = int(_os.environ.get("BENCH_LSTM_BATCH", 64))
 LSTM_SEQ = int(_os.environ.get("BENCH_LSTM_SEQ", 512))
 LSTM_DICT = int(_os.environ.get("BENCH_LSTM_DICT", 30000))
 LSTM_EMB = 512
@@ -1025,6 +1028,15 @@ def _phase_list():
 _LOCAL_CAPTURE = _os.environ.get("BENCH_LOCAL_PATH") or _os.path.join(
     _os.path.dirname(_os.path.abspath(__file__)), "BENCH_LOCAL.json")
 
+# Snapshot of USER-set workload/lever overrides, taken at import — main()
+# later mutates PADDLE_TPU_* itself (gate-conditional baked defaults), so
+# checking os.environ at capture time would always trip. Any override
+# present here means the run is a sweep row, not the baseline record.
+_USER_BENCH_OVERRIDES = sorted(
+    k for k in _os.environ
+    if (k.startswith("BENCH_") and k != "BENCH_LOCAL_PATH")
+    or k.startswith("PADDLE_TPU_"))
+
 
 def _save_local_capture(result, dev):
     """Persist the latest REAL-device result (never the cpu smoke path)
@@ -1042,8 +1054,11 @@ def _save_local_capture(result, dev):
         obj = result.get(key)
         if not isinstance(obj, dict) or "error" in obj:
             return
-    if _os.environ.get("BENCH_RN_LAYOUT", "NCHW") != "NCHW":
-        return  # experimental-layout run, not the baseline record
+    if _USER_BENCH_OVERRIDES:
+        # any BENCH_*/PADDLE_TPU_* env set by the caller (batch/seq/
+        # layout/lever overrides) makes this a sweep row — it must not
+        # replace the plain-defaults baseline record
+        return
     payload = dict(result)
     payload["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                            time.gmtime())
