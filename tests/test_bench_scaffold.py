@@ -299,3 +299,58 @@ def test_smoke_child_plain_check_forces_fused_bwd_off(monkeypatch, tmp_path):
     plain_ok = code.index("SMOKE_PLAIN_OK")
     on = code.index("os.environ['PADDLE_TPU_FLASH_FUSED_BWD'] = '1'")
     assert off < imp < plain_ok < on
+
+
+class _Dev:
+    platform = "tpu"
+
+
+def _full_result():
+    return {
+        "value": 97000.0, "mfu": 0.62,
+        "resnet50": {"images_per_sec": 2500.0},
+        "deepfm": {"rows_per_sec": 330000.0},
+        "stacked_lstm": {"words_per_sec": 356000.0},
+    }
+
+
+def test_local_capture_persists_plain_full_run(monkeypatch, tmp_path):
+    import json as _json
+
+    cap = tmp_path / "cap.json"
+    monkeypatch.setattr(bench, "_LOCAL_CAPTURE", str(cap))
+    monkeypatch.setattr(bench, "_USER_BENCH_OVERRIDES", [])
+    bench._save_local_capture(_full_result(), _Dev())
+    saved = _json.loads(cap.read_text())
+    assert saved["mfu"] == 0.62 and "captured_at" in saved
+
+
+def test_local_capture_refuses_non_baseline_runs(monkeypatch, tmp_path):
+    """The banked record may only be replaced by a plain-defaults full
+    run: partial phases, errored phases, user env overrides, and the
+    cpu smoke path must all leave the file untouched (code-review r5)."""
+    cap = tmp_path / "cap.json"
+    monkeypatch.setattr(bench, "_LOCAL_CAPTURE", str(cap))
+    monkeypatch.setattr(bench, "_USER_BENCH_OVERRIDES", [])
+
+    partial = _full_result()
+    del partial["stacked_lstm"]
+    bench._save_local_capture(partial, _Dev())
+
+    errored = _full_result()
+    errored["deepfm"] = {"error": "UNAVAILABLE: relay died"}
+    bench._save_local_capture(errored, _Dev())
+
+    null_lm = _full_result()
+    null_lm["value"] = None
+    bench._save_local_capture(null_lm, _Dev())
+
+    class _Cpu:
+        platform = "cpu"
+
+    bench._save_local_capture(_full_result(), _Cpu())
+
+    monkeypatch.setattr(bench, "_USER_BENCH_OVERRIDES", ["BENCH_LSTM_SEQ"])
+    bench._save_local_capture(_full_result(), _Dev())
+
+    assert not cap.exists()

@@ -52,6 +52,7 @@ row "heads8-bq256bk512"      BENCH_BATCH=16 BENCH_HEADS=8 PADDLE_TPU_FLASH_BQ=25
 #    row's own BENCH_RESNET=1 re-enables the phase)
 row "resnet-b128"            BENCH_LM=0 BENCH_RESNET=1 BENCH_RN_BATCH=128
 row "resnet-b256"            BENCH_LM=0 BENCH_RESNET=1 BENCH_RN_BATCH=256
+row "resnet-nhwc"             BENCH_LM=0 BENCH_RESNET=1 BENCH_RN_LAYOUT=NHWC
 row "resnet-reader"          BENCH_LM=0 BENCH_RESNET=1 BENCH_RESNET_INPUT=reader
 # 4. resnet profile trace for hlo_stats (untimed; writes /tmp/jaxprof)
 PROFILE_MODEL=resnet timeout 2700 python tools/profile_bench.py >>/tmp/window_play.log 2>&1 || true
