@@ -908,6 +908,23 @@ def _enable_baked_fused():
         _os.environ["PADDLE_TPU_FLASH_FUSED_BWD"] = "1"
 
 
+def _effective_fused_bwd(n_head):
+    """What the attention dispatch will ACTUALLY run for this config:
+    env opt-in AND the kernel's VMEM-footprint gate (which silently
+    falls back to the split backward at long sequence — the recorded
+    config must not label split-kernel numbers as fused)."""
+    if _os.environ.get("PADDLE_TPU_FLASH_FUSED_BWD", "0") != "1":
+        return "0"
+    try:
+        from paddle_tpu.ops.attention import _fused_bwd_fits
+
+        # attention inputs are bf16 under both AMP levels (fused_attention
+        # is in the AMP bf16 op set), hence itemsize 2
+        return "1" if _fused_bwd_fits(SEQ, D_MODEL // n_head, 2) else "0"
+    except Exception:  # pragma: no cover — labeling must never kill a run
+        return "1"
+
+
 def _disable_fused_bwd():
     """Force the opt-in fused flash backward off for this process (and
     warn if a sweep row explicitly asked for it — the row will measure
@@ -980,8 +997,7 @@ def main():
                        "layers": N_LAYER, "d_model": D_MODEL,
                        "n_head": lm["n_head"],
                        "attn_bthd": _os.environ.get("PADDLE_TPU_ATTN_BTHD", "1"),
-                       "fused_bwd": _os.environ.get(
-                           "PADDLE_TPU_FLASH_FUSED_BWD", "0"),
+                       "fused_bwd": _effective_fused_bwd(lm["n_head"]),
                        "amp_level": _os.environ.get("BENCH_AMP_LEVEL", "O1")},
         }
     else:

@@ -335,6 +335,47 @@ def _fused_bwd_enabled() -> bool:
     return os.environ.get("PADDLE_TPU_FLASH_FUSED_BWD", "0") == "1"
 
 
+# Scoped-VMEM budget for the fused kernel's per-(batch, head) residents:
+# k+v full rows (input dtype, double-buffered by Mosaic) plus the f32
+# dk/dv accumulators. 12 MB of the 16 MB scoped limit — the rest is
+# q/do/dq blocks, lse/delta rows, and Mosaic's own stack. Measured: the
+# fused kernel compiles at T=4096 (8 MB) and OOMs at T=8192 (16 MB+,
+# 'Scoped allocation with size 24.75M and limit 16.00M' on v5e).
+_FUSED_BWD_VMEM_BUDGET = 12 * 1024 * 1024
+
+
+def _fused_bwd_fits(tk: int, d: int, kv_itemsize: int) -> bool:
+    """True when the single-pass backward's whole-row VMEM residents fit;
+    callers fall back to the split dq+dkv kernels (whose k/v or q/do
+    rows are half the footprint and have no f32 row accumulators).
+    Pure predicate — bench.py also calls it to label its config record
+    honestly; the dispatch sites warn when it overrides an explicit
+    PADDLE_TPU_FLASH_FUSED_BWD=1 (see _fused_bwd_dispatchable)."""
+    kv_rows = 2 * tk * d * kv_itemsize * 2  # k+v, double-buffered
+    acc_rows = 2 * tk * d * 4               # dk+dv f32 accumulators
+    # strict <: a footprint exactly AT the budget (f32 rows, T=4096) has
+    # never been measured on hardware — stay on the safe side of it
+    return kv_rows + acc_rows < _FUSED_BWD_VMEM_BUDGET
+
+
+def _fused_bwd_dispatchable(tk: int, d: int, kv_itemsize: int) -> bool:
+    """Dispatch-site gate: fused requested AND its VMEM residents fit.
+    Warns (once per trace) when the budget overrides the explicit
+    opt-in, so a sweep log shows its 'fused' row ran the split kernels."""
+    if not _fused_bwd_enabled():
+        return False
+    if _fused_bwd_fits(tk, d, kv_itemsize):
+        return True
+    import warnings
+
+    warnings.warn(
+        "PADDLE_TPU_FLASH_FUSED_BWD=1 but the fused backward's VMEM "
+        "residents exceed the %.0f MB budget at seq_k=%d, d_head=%d; "
+        "dispatching the split dq+dkv backward instead"
+        % (_FUSED_BWD_VMEM_BUDGET / 2**20, tk, d))
+    return False
+
+
 def _mha_fwd_call(qs, k, v, causal, block_q, block_k, interpret):
     bh, t, d = qs.shape
     tk = k.shape[1]
@@ -381,7 +422,7 @@ def _pallas_mha_bwd(causal, block_q, block_k, interpret, res, do):
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)[:, None, :]  # (BH, 1, T) — see lse layout note
 
-    if _fused_bwd_enabled():
+    if _fused_bwd_dispatchable(tk, d, k.dtype.itemsize):
         kernel = functools.partial(
             _mha_bwd_fused_kernel, block_q=block_q, block_k=block_k,
             seq_k=tk, causal=causal)
@@ -543,7 +584,7 @@ def _pallas_mha_bthd_bwd(h, causal, block_q, block_k, interpret, res, do):
         * out.astype(jnp.float32).reshape(b, t, h, d),
         axis=-1).transpose(0, 2, 1).reshape(b * h, 1, t)
 
-    if _fused_bwd_enabled():
+    if _fused_bwd_dispatchable(tk, d, k.dtype.itemsize):
         kernel = functools.partial(
             _mha_bwd_fused_kernel, block_q=block_q, block_k=block_k,
             seq_k=tk, causal=causal, pid_axis=2)

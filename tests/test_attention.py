@@ -336,3 +336,50 @@ def test_fused_bwd_matches_split_bwd_bthd(causal, monkeypatch):
     for a, b in zip(g_fused, g_split):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-5, atol=2e-6)
+
+
+def test_fused_bwd_vmem_gate_boundary():
+    """The fused single-pass backward keeps whole-row k/v + f32 dk/dv
+    accumulators in scoped VMEM, so it must not be dispatched when that
+    footprint exceeds the budget: measured on v5e, T=4096/d=128/bf16
+    compiles (8 MB) and T=8192 OOMs ('Scoped allocation with size
+    24.75M and limit 16.00M'). The gate's boundary pins exactly that."""
+    from paddle_tpu.ops.attention import _fused_bwd_fits
+
+    assert _fused_bwd_fits(4096, 128, 2)       # bf16, the measured pass
+    assert not _fused_bwd_fits(8192, 128, 2)   # bf16, the measured OOM
+    assert not _fused_bwd_fits(4096, 128, 4)   # f32 rows: 12 MB+4 MB acc
+
+
+def test_fused_bwd_gate_falls_back_to_split(monkeypatch):
+    """With PADDLE_TPU_FLASH_FUSED_BWD=1 but a footprint over budget the
+    dispatch must silently take the split backward and stay numerically
+    identical — shrink the budget so a small T trips the gate."""
+    from paddle_tpu.ops import attention as A
+
+    r = np.random.RandomState(13)
+    q, k, v = (jnp.asarray(r.randn(1, 256, 2, 128), jnp.float32) * 0.1
+               for _ in range(3))
+
+    def grads():
+        def loss(q, k, v):
+            o = A.pallas_flash_attention_bthd(q, k, v, causal=True,
+                                              block_q=128, block_k=128,
+                                              interpret=True)
+            return jnp.sum(jnp.sin(o))
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    monkeypatch.delenv("PADDLE_TPU_FLASH_FUSED_BWD", raising=False)
+    g_split = grads()
+    monkeypatch.setenv("PADDLE_TPU_FLASH_FUSED_BWD", "1")
+    monkeypatch.setattr(A, "_FUSED_BWD_VMEM_BUDGET", 1)  # force the gate
+    # the fused kernel MUST NOT run at all — numeric parity alone cannot
+    # catch a broken gate, because fused and split agree numerically
+    def _boom(*a, **k):
+        raise AssertionError("fused kernel dispatched despite VMEM gate")
+    monkeypatch.setattr(A, "_mha_bwd_fused_kernel", _boom)
+    with pytest.warns(UserWarning, match="split dq\\+dkv"):
+        g_gated = grads()
+    for a, b in zip(g_gated, g_split):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
