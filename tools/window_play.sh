@@ -48,6 +48,10 @@ row "b24-remat-all"          BENCH_BATCH=24 BENCH_HEADS=8 BENCH_REMAT=1 BENCH_AM
 # 2. flash block shapes on the winner's base
 row "heads8-bq1024"          BENCH_BATCH=16 BENCH_HEADS=8 PADDLE_TPU_FLASH_BQ=1024 PADDLE_TPU_FLASH_BK=1024
 row "heads8-bq256bk512"      BENCH_BATCH=16 BENCH_HEADS=8 PADDLE_TPU_FLASH_BQ=256 PADDLE_TPU_FLASH_BK=512
+# 2b. long-context ladder (r5: 0.6698 / 0.7307 / 0.7447 MFU measured)
+row "seq2048-b8"             BENCH_BATCH=8 BENCH_SEQ=2048 BENCH_HEADS=8 BENCH_AMP_LEVEL=O2 PADDLE_TPU_FLASH_FUSED_BWD=1
+row "seq4096-b4"             BENCH_BATCH=4 BENCH_SEQ=4096 BENCH_HEADS=8 BENCH_AMP_LEVEL=O2 PADDLE_TPU_FLASH_FUSED_BWD=1
+row "seq8192-b2"             BENCH_BATCH=2 BENCH_SEQ=8192 BENCH_HEADS=8 BENCH_AMP_LEVEL=O2 PADDLE_TPU_FLASH_FUSED_BWD=1
 # 3. resnet ladder + reader-pipeline proof (row() defaults first, the
 #    row's own BENCH_RESNET=1 re-enables the phase)
 row "resnet-b128"            BENCH_LM=0 BENCH_RESNET=1 BENCH_RN_BATCH=128
