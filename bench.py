@@ -122,8 +122,10 @@ LSTM_WARMUP = int(_os.environ.get("BENCH_LSTM_WARMUP", 2))
 # table; the reference serves this through lookup_table with SelectedRows
 # gradients + a parameter server —
 # paddle/fluid/operators/lookup_table_op.cc:1 — our path is a dense
-# gather forward + scatter-add gradient, the TPU-native equivalent)
-DFM_BATCH = int(_os.environ.get("BENCH_DFM_BATCH", 4096))
+# gather forward + scatter-add gradient, the TPU-native equivalent).
+# Batch 16384 won the on-chip ladder (r5 s4, same-session controls:
+# 338.6k @ 4096, 336.3k @ 8192, 382.1k @ 16384, 347.1k @ 32768 rows/s).
+DFM_BATCH = int(_os.environ.get("BENCH_DFM_BATCH", 16384))
 DFM_FEATURES = int(_os.environ.get("BENCH_DFM_FEATURES", 1000000))
 DFM_FIELDS = int(_os.environ.get("BENCH_DFM_FIELDS", 26))
 DFM_DENSE = int(_os.environ.get("BENCH_DFM_DENSE", 13))

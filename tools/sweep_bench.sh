@@ -83,7 +83,7 @@ if [ "${AUX:-1}" = "1" ]; then
   run BENCH_LM=0 BENCH_LSTM=1 BENCH_LSTM_BATCH=64
   run BENCH_LM=0 BENCH_LSTM=1 BENCH_AMP=0
   run BENCH_LM=0 BENCH_DEEPFM=1
-  run BENCH_LM=0 BENCH_DEEPFM=1 BENCH_DFM_BATCH=16384
+  run BENCH_LM=0 BENCH_DEEPFM=1 BENCH_DFM_BATCH=4096
   run BENCH_LM=0 BENCH_DEEPFM=1 BENCH_AMP=0
 fi
 
