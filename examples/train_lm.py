@@ -14,6 +14,8 @@ On CPU smoke-test with:
       python examples/train_lm.py --mesh dp=2,mp=4 --layers 2 --d-model 128 \
       --seq 256 --steps 3
 """
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))  # run from anywhere
 import argparse
 import time
 

@@ -3,6 +3,8 @@ python/paddle/fluid/tests/book/test_recognize_digits.py).
 
 Run: python examples/train_mnist.py [--epochs 1] [--batch-size 64]
 """
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))  # run from anywhere
 import argparse
 
 import numpy as np

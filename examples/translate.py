@@ -4,6 +4,8 @@ python/paddle/fluid/tests/book/test_machine_translation.py).
 
 Run: python examples/translate.py [--steps 50] [--beam 3] [--cpu]
 """
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))  # run from anywhere
 import argparse
 
 import numpy as np
