@@ -9,15 +9,18 @@ import pytest
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run(args, timeout=420, env_extra=None):
+def _run(args, timeout=420, env_extra=None, cwd=_ROOT, set_pythonpath=True):
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)  # no device tunnel in tests
     env["JAX_PLATFORMS"] = "cpu"
     env.update(env_extra or {})
-    env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    if set_pythonpath:
+        env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    else:
+        env.pop("PYTHONPATH", None)
     env["PADDLE_TPU_SYNTH_MNIST_TRAIN"] = "256"
     env["PADDLE_TPU_SYNTH_MNIST_TEST"] = "128"
-    res = subprocess.run([sys.executable] + args, cwd=_ROOT, env=env,
+    res = subprocess.run([sys.executable] + args, cwd=cwd, env=env,
                          capture_output=True, text=True, timeout=timeout)
     assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
     return res.stdout
@@ -51,6 +54,17 @@ def test_train_lm_example_pipeline():
                 "--no-amp"],
                env_extra={"XLA_FLAGS": flags})
     assert "tokens/s" in out
+
+
+def test_train_ctr_example_learns():
+    """The CTR example asserts held-out AUC > 0.6 itself — rc 0 IS the
+    learning check. Run from a neutral cwd with no PYTHONPATH to also pin
+    the examples' run-from-anywhere sys.path bootstrap."""
+    out = _run([os.path.join(_ROOT, "examples", "train_ctr.py"), "--cpu",
+                "--steps", "40", "--features", "5000",
+                "--batch-size", "512"],
+               cwd="/", set_pythonpath=False)
+    assert "held-out auc" in out
 
 
 def test_train_lm_example_loop_mode():
