@@ -362,7 +362,8 @@ def bench_lm(dev, batch, n_head=None):
             ids, labels, vocab_size=VOCAB, n_layer=N_LAYER,
             n_head=n_head if n_head is not None else N_HEAD,
             d_model=D_MODEL, d_inner=D_INNER, max_len=SEQ,
-            fused_qkv=_os.environ.get("PADDLE_TPU_FUSED_QKV", "0") == "1")
+            fused_qkv=_os.environ.get("PADDLE_TPU_FUSED_QKV", "0") == "1",
+            tie_embeddings=_os.environ.get("BENCH_TIE", "0") == "1")
         optimizer.Adam(learning_rate=1e-4).minimize(loss)
         return loss
 
@@ -1000,7 +1001,8 @@ def main():
                        "n_head": lm["n_head"],
                        "attn_bthd": _os.environ.get("PADDLE_TPU_ATTN_BTHD", "1"),
                        "fused_bwd": _effective_fused_bwd(lm["n_head"]),
-                       "amp_level": _os.environ.get("BENCH_AMP_LEVEL", "O1")},
+                       "amp_level": _os.environ.get("BENCH_AMP_LEVEL", "O1"),
+                       "tie_emb": _os.environ.get("BENCH_TIE", "0")},
         }
     else:
         # sweep rows measuring only a secondary phase skip the LM compile

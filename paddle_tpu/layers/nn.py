@@ -2164,7 +2164,7 @@ def moe_ffn(x, num_experts, d_ff, capacity_factor=2.0, k=2, ep_axis="ep",
 
 
 def fused_lm_head_loss(input, label, size, param_attr=None, bias_attr=None,
-                       block_v=4096, name=None):
+                       block_v=4096, transpose_w=False, name=None):
     """Fused vocabulary projection + softmax-cross-entropy: computes the
     per-token loss of `fc(input, size)` vs `label` WITHOUT materializing
     the (N, vocab) logits (kernel: ops/fused_loss.py, chunked online
@@ -2173,12 +2173,29 @@ def fused_lm_head_loss(input, label, size, param_attr=None, bias_attr=None,
     operators/softmax_with_cross_entropy_op.cc) for large vocabularies.
 
     input: (..., D) features; label: (...,) or (..., 1) int ids;
-    returns (N, 1) fp32 loss, N = prod of input's leading dims."""
+    returns (N, 1) fp32 loss, N = prod of input's leading dims.
+
+    transpose_w=True declares the weight as (size, D) instead of (D, size)
+    — the tied-embedding layout: pass a param_attr naming the token
+    embedding table and the head projects through the SAME parameter
+    (x @ W^T), with both gradient contributions summed by the whole-step
+    autodiff. No transposed copy is ever made (the kernel slices the
+    table along the vocab axis in place)."""
     helper = LayerHelper("fused_lm_head_loss", **locals())
     dtype = helper.input_dtype()
     d = input.shape[-1]
+    w_shape = [size, d] if transpose_w else [d, size]
     w = helper.create_parameter(
-        attr=helper.param_attr, shape=[d, size], dtype=dtype, is_bias=False)
+        attr=helper.param_attr, shape=w_shape, dtype=dtype, is_bias=False)
+    if list(w.shape) != w_shape:
+        # create_parameter reuses an existing param by NAME ignoring the
+        # requested shape (the aliasing the tied path relies on) — so a
+        # layout mix-up (wrong transpose_w for the named table) must be
+        # caught here, not as garbage logits or a deep jnp.dot error.
+        raise ValueError(
+            "fused_lm_head_loss: reused parameter %r has shape %s but "
+            "transpose_w=%s requires %s" %
+            (w.name, list(w.shape), bool(transpose_w), w_shape))
     inputs = {"X": [input], "W": [w], "Label": [label]}
     bias_attr = helper.bias_attr
     if bias_attr is not False:
@@ -2192,6 +2209,6 @@ def fused_lm_head_loss(input, label, size, param_attr=None, bias_attr=None,
         type="fused_lm_head_loss",
         inputs=inputs,
         outputs={"Loss": [loss]},
-        attrs={"block_v": block_v},
+        attrs={"block_v": block_v, "transpose_w": bool(transpose_w)},
     )
     return loss

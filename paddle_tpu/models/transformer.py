@@ -16,6 +16,7 @@ import os
 import numpy as np
 
 from .. import layers
+from ..framework import default_main_program
 from ..initializer import NormalInitializer
 from ..param_attr import ParamAttr
 
@@ -254,7 +255,7 @@ def transformer_lm(
     ids, labels, vocab_size, n_layer=4, n_head=8, d_model=512, d_inner=2048,
     dropout_rate=0.0, max_len=2048, fused_head=True,
     use_ring_attention=False, sp_axis="sp", moe_experts=0,
-    fused_qkv=False,
+    fused_qkv=False, tie_embeddings=False,
 ):
     """Decoder-only causal LM (flagship). Returns (avg_cost, logits).
 
@@ -272,7 +273,18 @@ def transformer_lm(
     fused_qkv=True packs each layer's self-attention q/k/v into one
     (D, 3D) matmul (see multi_head_attention); bench.py flips it from
     PADDLE_TPU_FUSED_QKV so Program construction itself stays
-    deterministic under a given argument list."""
+    deterministic under a given argument list.
+
+    tie_embeddings=True shares the token-embedding table with the vocab
+    projection (head logits = x @ emb^T): one less (V, D) parameter, so
+    the Adam f32 moment traffic and gradient convert chains on the two
+    largest tensors halve — the profiled ~1.5%-of-step lever
+    (PERF_NOTES). Off by default: the reference benchmark model keeps
+    the matrices separate (reference
+    benchmark/fluid/models/machine_translation.py:1). Under a
+    tensor-parallel mesh pass megatron_transformer_plan(tied=True) —
+    the default plan's hidden-sharded emb rule would split the head
+    matmul's contracted axis (see that plan's docstring)."""
     x = _embed(ids, vocab_size, d_model, max_len, "lm")
     for i in range(n_layer):
         x = decoder_layer(x, None, n_head, d_model, d_inner, dropout_rate,
@@ -282,14 +294,32 @@ def transformer_lm(
     x = _pre_norm(x)
     B, T = ids.shape
     if fused_head:
+        if tie_embeddings:
+            # create_parameter returns the EXISTING "lm.tok_emb" (V, D)
+            # table; transpose_w makes the kernel read it in place. The
+            # table MUST already exist (built by _embed above) — a fresh
+            # creation here would silently train untied.
+            default_main_program().global_block().var("lm.tok_emb")
+            head_attr = ParamAttr(name="lm.tok_emb")
+        else:
+            head_attr = ParamAttr(name="lm.head.w",
+                                  initializer=NormalInitializer(0.0, 0.02))
         loss = layers.fused_lm_head_loss(
             x, labels, vocab_size,
-            param_attr=ParamAttr(name="lm.head.w",
-                                 initializer=NormalInitializer(0.0, 0.02)),
+            param_attr=head_attr,
             bias_attr=ParamAttr(name="lm.head.b"),
+            transpose_w=tie_embeddings,
         )
         return layers.mean(loss), None
-    logits = _linear(x, vocab_size, "lm.head")
+    if tie_embeddings:
+        emb = default_main_program().global_block().var("lm.tok_emb")
+        logits = layers.matmul(x, emb, transpose_y=True)
+        bias = layers.create_parameter(
+            shape=[vocab_size], dtype=logits.dtype, name="lm.head.b",
+            is_bias=True)
+        logits = layers.elementwise_add(logits, bias)
+    else:
+        logits = _linear(x, vocab_size, "lm.head")
     loss = layers.softmax_with_cross_entropy(
         layers.reshape(logits, shape=[B * T, vocab_size]),
         layers.reshape(labels, shape=[B * T, 1]),

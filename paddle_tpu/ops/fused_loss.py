@@ -83,41 +83,67 @@ def _grad_vma_like(g, primal):
     return lax.psum(g, tuple(extra)) if extra else g
 
 
-def _pad_wb(w, b, block_v):
-    """Pad (D, V) / (V,) up to a multiple of block_v. Padded bias is -1e30
-    so padded logits vanish from the logsumexp (exp(-1e30 - lse) == 0).
-    No copy when V is already aligned (the usual case)."""
-    v = w.shape[1]
+def _pad_wb(w, b, block_v, transpose_w=False):
+    """Pad the vocab axis — dim 1 of a (D, V) weight, dim 0 of a (V, D)
+    one (``transpose_w``, the tied-embedding layout) — up to a multiple of
+    block_v. Padded bias is -1e30 so padded logits vanish from the
+    logsumexp (exp(-1e30 - lse) == 0). No copy when V is already aligned
+    (the usual case)."""
+    vdim = 0 if transpose_w else 1
+    v = w.shape[vdim]
     nblk = -(-v // block_v)
     pv = nblk * block_v
     if pv != v:
-        w = jnp.pad(w, ((0, 0), (0, pv - v)))
+        pad = [(0, 0), (0, 0)]
+        pad[vdim] = (0, pv - v)
+        w = jnp.pad(w, pad)
         b = jnp.pad(b, (0, pv - v), constant_values=_NEG)
     return w, b, nblk
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
-def lm_head_loss(block_v, x, w, b, labels):
-    """x: (N, D); w: (D, V); b: (V,); labels: (N,) int -> loss (N, 1) fp32.
+def _w_chunk(wp, j, block_v, transpose_w):
+    """Slice chunk j of the vocab axis IN PLACE — (D, BV) from (D, V), or
+    (BV, D) from (V, D) — never a transposed copy of the weight."""
+    return lax.dynamic_slice_in_dim(wp, j * block_v, block_v,
+                                    0 if transpose_w else 1)
 
-    loss_i = logsumexp_v(x_i @ w + b) - (x_i @ w + b)[labels_i]
-    """
-    loss, _ = _lm_head_fwd(block_v, x, w, b, labels)
+
+def _chunk_logits(x, wb, transpose_w):
+    """(N, D) x chunk -> (N, BV) fp32, contracting D in the chunk's native
+    orientation (MXU takes either operand layout)."""
+    if transpose_w:
+        return jnp.einsum("nd,vd->nv", x, wb,
+                          preferred_element_type=jnp.float32)
+    return jnp.dot(x, wb, preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _lm_head_loss(block_v, transpose_w, x, w, b, labels):
+    loss, _ = _lm_head_fwd(block_v, transpose_w, x, w, b, labels)
     return loss
 
 
-def _lm_head_fwd(block_v, x, w, b, labels):
+def lm_head_loss(block_v, x, w, b, labels, transpose_w=False):
+    """x: (N, D); w: (D, V) — or (V, D) with ``transpose_w=True``, the
+    tied-embedding layout where w IS the token-embedding table used in
+    place; b: (V,); labels: (N,) int -> loss (N, 1) fp32.
+
+    loss_i = logsumexp_v(x_i @ w + b) - (x_i @ w + b)[labels_i]
+    """
+    return _lm_head_loss(block_v, bool(transpose_w), x, w, b, labels)
+
+
+def _lm_head_fwd(block_v, transpose_w, x, w, b, labels):
     n = x.shape[0]
     labels = labels.reshape(n).astype(jnp.int32)
-    wp, bp, nblk = _pad_wb(w, b, block_v)
+    wp, bp, nblk = _pad_wb(w, b, block_v, transpose_w)
     xdt = x.dtype
 
     def body(j, carry):
         m, s, picked = carry
-        wb = lax.dynamic_slice_in_dim(wp, j * block_v, block_v, 1)
+        wb = _w_chunk(wp, j, block_v, transpose_w).astype(xdt)
         bb = lax.dynamic_slice_in_dim(bp, j * block_v, block_v, 0)
-        logits = jnp.dot(x, wb.astype(xdt),
-                         preferred_element_type=jnp.float32) + bb
+        logits = _chunk_logits(x, wb, transpose_w) + bb
         col = j * block_v + jnp.arange(block_v)
         m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
         s = s * jnp.exp(m - m_new) + jnp.sum(
@@ -144,36 +170,45 @@ def _lm_head_fwd(block_v, x, w, b, labels):
     return loss, (x, w, b, labels, lse)
 
 
-def _lm_head_bwd(block_v, res, g):
+def _lm_head_bwd(block_v, transpose_w, res, g):
     x, w, b, labels, lse = res
     n, d = x.shape
-    v = w.shape[1]
+    v = w.shape[0 if transpose_w else 1]
     gl = g.reshape(n, 1).astype(jnp.float32)
-    wp, bp, nblk = _pad_wb(w, b, block_v)
+    wp, bp, nblk = _pad_wb(w, b, block_v, transpose_w)
     pv = nblk * block_v
     xdt = x.dtype
 
     def body(j, carry):
         dx, dw, db = carry
-        wb = lax.dynamic_slice_in_dim(wp, j * block_v, block_v, 1)
+        wb = _w_chunk(wp, j, block_v, transpose_w)
         bb = lax.dynamic_slice_in_dim(bp, j * block_v, block_v, 0)
         wbx = wb.astype(xdt)
-        logits = jnp.dot(x, wbx, preferred_element_type=jnp.float32) + bb
+        logits = _chunk_logits(x, wbx, transpose_w) + bb
         p = jnp.exp(logits - lse[:, None])  # padded cols: exp(-1e30-lse)=0
         col = j * block_v + jnp.arange(block_v)
         hit = labels[:, None] == col[None, :]
         gch = (p - hit.astype(jnp.float32)) * gl  # (N, BV) fp32
         gchx = gch.astype(xdt)
-        dwb = jnp.dot(x.T, gchx, preferred_element_type=jnp.float32)
+        if transpose_w:
+            dwb = jnp.einsum("nv,nd->vd", gchx, x,
+                             preferred_element_type=jnp.float32)
+            dx = dx + jnp.dot(gchx, wbx,
+                              preferred_element_type=jnp.float32)
+            dw = lax.dynamic_update_slice_in_dim(dw, dwb, j * block_v, 0)
+        else:
+            dwb = jnp.dot(x.T, gchx, preferred_element_type=jnp.float32)
+            dx = dx + jnp.dot(gchx, wbx.T,
+                              preferred_element_type=jnp.float32)
+            dw = lax.dynamic_update_slice_in_dim(dw, dwb, j * block_v, 1)
         dbb = jnp.sum(gch, axis=0)
-        dx = dx + jnp.dot(gchx, wbx.T, preferred_element_type=jnp.float32)
-        dw = lax.dynamic_update_slice_in_dim(dw, dwb, j * block_v, 1)
         db = lax.dynamic_update_slice_in_dim(db, dbb, j * block_v, 0)
         return dx, dw, db
 
+    dw_shape = (pv, d) if transpose_w else (d, pv)
     init = tuple(_vary_like(c, x, labels, g, wp, bp) for c in
                  (jnp.zeros((n, d), jnp.float32),
-                  jnp.zeros((d, pv), jnp.float32),
+                  jnp.zeros(dw_shape, jnp.float32),
                   jnp.zeros((pv,), jnp.float32)))
     if _unroll_chunks(nblk):
         carry = init
@@ -182,24 +217,28 @@ def _lm_head_bwd(block_v, res, g):
         dx, dw, db = carry
     else:
         dx, dw, db = lax.fori_loop(0, nblk, body, init)
+    dw = dw[:v] if transpose_w else dw[:, :v]
     return (_grad_vma_like(dx.astype(x.dtype), x),
-            _grad_vma_like(dw[:, :v].astype(w.dtype), w),
+            _grad_vma_like(dw.astype(w.dtype), w),
             _grad_vma_like(db[:v].astype(b.dtype), b), None)
 
 
-lm_head_loss.defvjp(_lm_head_fwd, _lm_head_bwd)
+_lm_head_loss.defvjp(_lm_head_fwd, _lm_head_bwd)
 
 
 @register_op("fused_lm_head_loss")
 def _fused_lm_head_loss(ctx):
     """Inputs X: (..., D), W: (D, V), Bias: (V,) optional, Label: (..., 1)
     or (...,) int. Output Loss: (N, 1) fp32 per-token loss, N = prod of
-    X's leading dims. Attr block_v: vocab chunk size (multiple of 128)."""
+    X's leading dims. Attr block_v: vocab chunk size (multiple of 128).
+    Attr transpose_w: W is (V, D) — the tied-embedding layout, where W is
+    the token-embedding table itself used in place."""
     from .attention import _env_block
 
     x = ctx.input("X")
     w = ctx.input("W")
     labels = ctx.input("Label")
+    transpose_w = bool(ctx.attr("transpose_w", False))
     # env override for on-hardware sweeps (tools/sweep_bench.sh),
     # validated like the flash-attention block knobs
     block_v = _env_block("PADDLE_TPU_LMHEAD_BLOCK",
@@ -208,7 +247,7 @@ def _fused_lm_head_loss(ctx):
     xf = x.reshape(-1, d)
     b = ctx.input("Bias")
     if b is None:
-        b = jnp.zeros((w.shape[1],), jnp.float32)
+        b = jnp.zeros((w.shape[0 if transpose_w else 1],), jnp.float32)
     loss = lm_head_loss(block_v, xf, w, b.astype(jnp.float32),
-                        labels.reshape(-1))
+                        labels.reshape(-1), transpose_w=transpose_w)
     return {"Loss": loss}

@@ -147,6 +147,7 @@ def megatron_transformer_plan(
     mesh: Mesh,
     mp_axis: str = "mp",
     batch_axes: Sequence[str] = ("dp",),
+    tied: bool = False,
 ) -> ShardingPlan:
     """Tensor-parallel plan for our transformer naming convention
     (models/transformer.py): q/k/v/fc1 weights column-parallel, out/fc2
@@ -154,6 +155,16 @@ def megatron_transformer_plan(
     propagates head-sharded activations through attention and inserts one
     all-reduce after each row-parallel matmul — the Megatron-LM comm
     pattern, derived by the compiler instead of hand-written NCCL calls.
+
+    tied=True is for ``transformer_lm(tie_embeddings=True)``: the token
+    table doubles as the vocab projection, so neither of this plan's
+    embedding rules fits it — hidden-sharding (the default emb rule)
+    would split the head matmul's CONTRACTED axis (an all-reduce of
+    partial logits per vocab chunk), and the head's vocab-column split
+    would shard the axis the fused kernel dynamic-slices in place.
+    The tied table and head bias are pinned replicated instead: the
+    whole head stays comm-free, and dp/ZeRO still shards its optimizer
+    state where that plan composes.
     """
     plan = ShardingPlan(mesh, batch_axes=batch_axes)
     col_w = P(None, mp_axis)  # (in, out) split on out
@@ -168,9 +179,10 @@ def megatron_transformer_plan(
         (r"\.(q|k|v|qkv|fc1)\.b", col_b),
         (r"\.(out|fc2)\.w", row_w),
         (r"\.(out|fc2)\.b", P()),
-        (r"(tok|pos)_emb", P(None, mp_axis)),
+        (r"pos_emb", P(None, mp_axis)),
+        (r"tok_emb", P() if tied else P(None, mp_axis)),
         (r"\.head\.w", col_w),  # vocab-parallel output projection
-        (r"\.head\.b", col_b),
+        (r"\.head\.b", P() if tied else col_b),
     ]:
         plan.set_regex(pat, spec)
     return plan

@@ -252,14 +252,16 @@ def test_parallel_executor_rnn_model_parity():
     assert single[0] > single[-1]
 
 
-@pytest.mark.parametrize("fused_qkv", [False, True])
-def test_transformer_lm_dp_x_mp_parity(fused_qkv):
+@pytest.mark.parametrize("fused_qkv,tied", [
+    (False, False), (True, False), (False, True)])
+def test_transformer_lm_dp_x_mp_parity(fused_qkv, tied):
     """Flagship path: the transformer LM trained under a dp=2 x mp=4 mesh
     with the Megatron plan must match single-device training exactly
     (same seed/feeds) — embedding/attention/ffn/vocab-parallel-head
-    shardings change the partitioning, never the math. Covers both the
-    separate q/k/v projections and the fused head-grouped .qkv layout the
-    plan's column split was extended for."""
+    shardings change the partitioning, never the math. Covers the
+    separate q/k/v projections, the fused head-grouped .qkv layout the
+    plan's column split was extended for, and the tied embed/head table
+    under the plan's tied=True rules (replicated table, comm-free head)."""
     from paddle_tpu import models
     from paddle_tpu.parallel import make_mesh, megatron_transformer_plan
 
@@ -276,7 +278,8 @@ def test_transformer_lm_dp_x_mp_parity(fused_qkv):
                         append_batch_size=False)
         loss, _ = models.transformer.transformer_lm(
             i, l, vocab_size=V, n_layer=2, n_head=4, d_model=32,
-            d_inner=64, max_len=T, fused_qkv=fused_qkv)
+            d_inner=64, max_len=T, fused_qkv=fused_qkv,
+            tie_embeddings=tied)
         fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
         return loss
 
@@ -301,7 +304,8 @@ def test_transformer_lm_dp_x_mp_parity(fused_qkv):
         mesh = make_mesh([2, 4], ("dp", "mp"))
         pexe = ParallelExecutor(loss_name=loss_b.name, main_program=main_b,
                                 scope=scope_b, mesh=mesh,
-                                plan=megatron_transformer_plan(mesh))
+                                plan=megatron_transformer_plan(mesh,
+                                                               tied=tied))
         par = [pexe.run(feed=feed, fetch_list=[loss_b])[0]
                for _ in range(3)]
 

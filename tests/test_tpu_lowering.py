@@ -114,3 +114,20 @@ def test_full_bench_step_lowers_for_tpu():
     assert res.returncode == 0, (
         "full-step TPU lowering failed:\n%s" % res.stderr[-4000:])
     assert "FULL STEP TPU LOWER OK" in res.stdout, res.stdout
+
+
+def test_tied_bench_step_lowers_for_tpu():
+    """The BENCH_TIE=1 sweep config (tied embed/head table through the
+    transpose_w fused-head kernel) cross-lowers for TPU too — at AMP O2,
+    the level the queued tie-emb A/B row actually runs on-chip."""
+    env, repo_root = _clean_env()
+    res = subprocess.run(
+        [sys.executable, os.path.join(repo_root, "tools",
+                                      "lower_bench_step.py"),
+         "--layers", "2", "--batch", "4", "--fused-bwd", "--tie",
+         "--amp", "O2"],
+        env=env, capture_output=True, text=True, timeout=1200,
+        cwd=repo_root)
+    assert res.returncode == 0, (
+        "tied full-step TPU lowering failed:\n%s" % res.stderr[-4000:])
+    assert "FULL STEP TPU LOWER OK" in res.stdout, res.stdout

@@ -36,6 +36,8 @@ def main():
     ap.add_argument("--d-model", type=int, default=1024)
     ap.add_argument("--amp", default="O1")
     ap.add_argument("--fused-bwd", action="store_true")
+    ap.add_argument("--tie", action="store_true",
+                    help="tie_embeddings=True (BENCH_TIE sweep lever)")
     args = ap.parse_args()
 
     # self-contained on an axon host: the PJRT plugin would block on the
@@ -69,7 +71,8 @@ def main():
             loss, _ = models.transformer.transformer_lm(
                 ids, labels, vocab_size=args.vocab, n_layer=args.layers,
                 n_head=args.heads, d_model=args.d_model,
-                d_inner=4 * args.d_model, max_len=args.seq)
+                d_inner=4 * args.d_model, max_len=args.seq,
+                tie_embeddings=args.tie)
             optimizer.Adam(learning_rate=1e-4).minimize(loss)
         main_p.enable_mixed_precision(level=args.amp)
 
@@ -96,9 +99,10 @@ def main():
         from jax import export
 
         print("lowering full step for TPU: batch=%d heads=%d layers=%d "
-              "amp=%s fused_bwd=%s ..." % (args.batch, args.heads,
-                                           args.layers, args.amp,
-                                           args.fused_bwd), flush=True)
+              "amp=%s fused_bwd=%s tie=%s ..." % (args.batch, args.heads,
+                                                  args.layers, args.amp,
+                                                  args.fused_bwd,
+                                                  args.tie), flush=True)
         exp = export.export(jax.jit(stepfn), platforms=["tpu"])(
             feeds_aval, state_aval, key_aval, step_aval)
         print("FULL STEP TPU LOWER OK (%d KB StableHLO)"
