@@ -2189,9 +2189,11 @@ def fused_lm_head_loss(input, label, size, param_attr=None, bias_attr=None,
         attr=helper.param_attr, shape=w_shape, dtype=dtype, is_bias=False)
     if list(w.shape) != w_shape:
         # create_parameter reuses an existing param by NAME ignoring the
-        # requested shape (the aliasing the tied path relies on) — so a
-        # layout mix-up (wrong transpose_w for the named table) must be
-        # caught here, not as garbage logits or a deep jnp.dot error.
+        # requested shape (the aliasing the tied path relies on) — catch
+        # a layout mix-up (wrong transpose_w for the named table) here
+        # instead of as garbage logits or a deep jnp.dot error. Blind
+        # spot by construction: a SQUARE reused table (size == d) has no
+        # shape signal for orientation and cannot be checked.
         raise ValueError(
             "fused_lm_head_loss: reused parameter %r has shape %s but "
             "transpose_w=%s requires %s" %

@@ -63,6 +63,8 @@ run BENCH_BATCH=24 BENCH_HEADS=8 BENCH_REMAT=1
 # 6c2. tied embed/head table: one less (V,D) param — halves Adam f32
 # moment traffic + grad convert chains on the two largest tensors
 run BENCH_BATCH=16 BENCH_HEADS=8 BENCH_AMP_LEVEL=O2 PADDLE_TPU_FLASH_FUSED_BWD=1 BENCH_TIE=1
+# 6c3. transposed-form dW backward (targets the FFN-hidden relayout copies)
+run BENCH_BATCH=16 BENCH_HEADS=8 BENCH_AMP_LEVEL=O2 PADDLE_TPU_FLASH_FUSED_BWD=1 PADDLE_TPU_MUL_DWT=1
 # 6d. AMP O2: bf16 residual stream (elementwise path joins the bf16 set)
 run BENCH_BATCH=16 BENCH_AMP_LEVEL=O2
 run BENCH_BATCH=16 BENCH_HEADS=8 BENCH_AMP_LEVEL=O2

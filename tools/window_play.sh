@@ -49,6 +49,10 @@ row "b24-remat-all"          BENCH_BATCH=24 BENCH_HEADS=8 BENCH_REMAT=1 BENCH_AM
 #     convert chains on the two (32768,1024) params — profiled ~1.5-3%
 #     lever; cross-lowered clean offline). A/B against baked-defaults.
 row "tie-emb-all-levers"     BENCH_BATCH=16 BENCH_HEADS=8 BENCH_AMP_LEVEL=O2 PADDLE_TPU_FLASH_FUSED_BWD=1 BENCH_TIE=1
+# 1c. transposed-form dW backward for fc matmuls (r5: targets the 4.65%
+#     FFN-hidden relayout copies — moves any layout copy to the 4x
+#     smaller gradient; pure schedule change, parity-tested)
+row "mul-dwt-all-levers"     BENCH_BATCH=16 BENCH_HEADS=8 BENCH_AMP_LEVEL=O2 PADDLE_TPU_FLASH_FUSED_BWD=1 PADDLE_TPU_MUL_DWT=1
 # 2. flash block shapes on the winner's base
 row "heads8-bq1024"          BENCH_BATCH=16 BENCH_HEADS=8 PADDLE_TPU_FLASH_BQ=1024 PADDLE_TPU_FLASH_BK=1024
 row "heads8-bq256bk512"      BENCH_BATCH=16 BENCH_HEADS=8 PADDLE_TPU_FLASH_BQ=256 PADDLE_TPU_FLASH_BK=512
