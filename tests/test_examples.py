@@ -67,6 +67,16 @@ def test_train_ctr_example_learns():
     assert "held-out auc" in out
 
 
+def test_serve_example_round_trip():
+    """serve.py asserts itself that the exported model fits its batch
+    (acc > 0.9) and that every dynamically batched served row matches
+    the direct predictor — rc 0 IS the check. Neutral cwd pins the
+    run-from-anywhere bootstrap on the export/AOT-cache paths too."""
+    out = _run([os.path.join(_ROOT, "examples", "serve.py"), "--cpu",
+                "--steps", "150"], cwd="/", set_pythonpath=False)
+    assert "every row" in out
+
+
 def test_train_lm_example_loop_mode():
     out = _run(["examples/train_lm.py", "--layers", "1", "--d-model", "64",
                 "--seq", "128", "--vocab", "256", "--batch", "2",
