@@ -986,6 +986,7 @@ def main():
 
     dev = jax.devices()[0]
     if _os.environ.get("BENCH_LM", "1") == "1":
+        obs_before = _obs_counters()
         lm = bench_lm_ladder(dev)
         result = {
             "metric": "transformer_lm_train_tokens_per_sec_per_chip",
@@ -1005,6 +1006,9 @@ def main():
                        "tie_emb": _os.environ.get("BENCH_TIE", "0")},
         }
         result = _maybe_retry_anomaly_lm(dev, result)
+        delta = _obs_delta(obs_before)
+        if delta:
+            result["metrics"] = delta
     else:
         # sweep rows measuring only a secondary phase skip the LM compile
         # (tunnel time is the scarce resource); the headline stays null so
@@ -1021,11 +1025,15 @@ def main():
         # last complete JSON line on stdout for the driver to parse
         print(json.dumps(result), flush=True)
         _save_local_capture(result, dev)
+        obs_before = _obs_counters()
         try:
             result[name] = _maybe_retry_anomaly_phase(dev, name, phase,
                                                       phase(dev))
         except Exception as e:  # keep earlier metrics even if this fails
             result[name] = {"error": repr(e)[:200]}
+        delta = _obs_delta(obs_before)
+        if delta and isinstance(result[name], dict):
+            result[name]["metrics"] = delta
     print(json.dumps(result))
     _save_local_capture(result, dev)
 
@@ -1081,6 +1089,38 @@ _PHASE_CONFIG_KEYS = {"resnet50": ("batch",),
                       "stacked_lstm": ("batch", "seq", "hid", "stacked")}
 
 
+def _obs_counters():
+    """Registry before-image for one bench phase. Phases diff against it
+    (export.delta_state) instead of resetting, so the emitted "metrics"
+    object carries only what THIS phase moved and the process-wide
+    registry stays intact for later phases."""
+    try:
+        from paddle_tpu.observability import export
+        return export.counters_state()
+    except Exception:  # metrics must never break a bench capture
+        return None
+
+
+def _obs_delta(before):
+    """Nonzero registry movement since ``before``, rounded for the JSON
+    line; None when observability was unavailable at phase start."""
+    if before is None:
+        return None
+    try:
+        from paddle_tpu.observability import export
+        return {k: round(v, 4) for k, v in export.delta_state(before).items()}
+    except Exception:
+        return None
+
+
+def _obs_anomaly_retry(phase_name):
+    try:
+        from paddle_tpu import observability as obs
+        obs.BENCH_ANOMALY_RETRIES.inc(phase=phase_name)
+    except Exception:
+        pass
+
+
 def _anomaly_wait(dev):
     """Retry pause in seconds, or None when the guard is off for this run."""
     if (_os.environ.get("BENCH_ANOMALY_RETRY", "1") != "1"
@@ -1113,6 +1153,7 @@ def _maybe_retry_anomaly_lm(dev, result):
           "same config (sha %s) — transient-contention re-measure in %.0fs"
           % (result["value"], _ANOMALY_RATIO * 100, banked["value"],
              banked.get("git_sha"), wait), file=_sys.stderr)
+    _obs_anomaly_retry("lm")
     time.sleep(wait)
     note = {"first_tokens_per_sec": result["value"],
             "banked_tokens_per_sec": banked["value"],
@@ -1163,6 +1204,7 @@ def _maybe_retry_anomaly_phase(dev, name, phase, fresh):
           "batch — transient-contention re-measure in %.0fs"
           % (name, fresh[key], key, _ANOMALY_RATIO * 100, banked[key], wait),
           file=_sys.stderr)
+    _obs_anomaly_retry(name)
     time.sleep(wait)
     note = {"first_" + key: fresh[key], "banked_" + key: banked[key]}
     try:

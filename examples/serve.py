@@ -14,7 +14,16 @@ paddle/fluid/inference/api/api_impl.cc + paddle/contrib/inference demos).
 Concurrent callers belong on this server path, not on per-request
 Predictor/C-ABI calls (see docs/performance.md "serving").
 
+The server also exposes the process metrics over HTTP
+(``server.start_http``): ``GET /metrics`` is the Prometheus text
+exposition (request latency histogram incl. queue wait, dynamic-batch
+fill, compile-cache counters), ``GET /metrics.json`` the JSON snapshot
+with the step timeline — see docs/performance.md "Observability". After
+serving, this script scrapes its own endpoint and prints the
+per-request latency summary.
+
 Run: python examples/serve.py [--steps 150] [--clients 4] [--cpu]
+     [--metrics-port 9100]   (0 = pick a free port; default)
 """
 import os as _os, sys as _sys
 _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))  # run from anywhere
@@ -61,6 +70,11 @@ def main():
     ap.add_argument("--clients", type=int, default=4)
     ap.add_argument("--rows-per-client", type=int, default=8)
     ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--metrics-port", type=int, default=0,
+                    help="bind /metrics here (0 = pick a free port)")
+    ap.add_argument("--metrics-host", default="127.0.0.1",
+                    help="bind address for /metrics; 0.0.0.0 to let an "
+                         "external Prometheus scrape this process")
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
     place = fluid.CPUPlace() if args.cpu else None
@@ -78,6 +92,13 @@ def main():
         # --- dynamically batched server, concurrent clients ------------
         server = PredictorServer(pred, max_batch=args.max_batch)
         server.start()
+        port = server.start_http(args.metrics_port, host=args.metrics_host)
+        # an all-interfaces bind is still scrapeable via loopback
+        scrape_host = ("127.0.0.1" if args.metrics_host == "0.0.0.0"
+                       else args.metrics_host)
+        print("metrics: curl http://%s:%d/metrics  "
+              "(Prometheus text; /metrics.json for the step timeline)"
+              % (scrape_host, port))
         errs = []
 
         def client(cid):
@@ -102,11 +123,26 @@ def main():
             t.start()
         for t in threads:
             t.join()
+        # scrape our own endpoint before teardown — the same lines a
+        # Prometheus job would ingest
+        import urllib.request
+        text = urllib.request.urlopen(
+            "http://%s:%d/metrics" % (scrape_host, port), timeout=30
+        ).read().decode("utf-8")
+        assert "paddle_tpu_predict_latency_ms_bucket" in text
+
+        from paddle_tpu import observability as obs
+        lat = obs.PREDICT_LATENCY_MS.stats(path="server")
+        fill = obs.PREDICT_BATCH_ROWS.stats(path="server")
         server.stop()
         assert not errs, errs
         n = args.clients * args.rows_per_client
         print("served %d rows from %d concurrent clients; every row "
               "matches the direct predictor" % (n, args.clients))
+        print("per-request latency (queue wait incl.): %.2f ms mean over "
+              "%d requests; mean dynamic-batch fill %.1f rows"
+              % (lat["mean"], lat["count"],
+                 fill["mean"] if fill["count"] else 0.0))
 
 
 if __name__ == "__main__":

@@ -26,6 +26,9 @@ import os
 import time
 import warnings
 
+import itertools
+
+from . import observability as obs
 from . import profiler
 from .framework.core import Program, Variable, default_main_program
 from .framework.dtypes import as_numpy_dtype
@@ -65,10 +68,15 @@ def _fetch_name(f) -> str:
     return f.name if isinstance(f, Variable) else str(f)
 
 
-class _Compiled:
-    __slots__ = ("fn", "state_in_names", "state_out_names", "fetch_names", "program")
+_EXE_IDS = itertools.count()
 
-    def __init__(self, fn, state_in_names, state_out_names, fetch_names, program):
+
+class _Compiled:
+    __slots__ = ("fn", "state_in_names", "state_out_names", "fetch_names",
+                 "program", "fp", "hlo")
+
+    def __init__(self, fn, state_in_names, state_out_names, fetch_names,
+                 program, fp=None, hlo=None):
         self.fn = fn
         self.state_in_names = state_in_names
         self.state_out_names = state_out_names
@@ -76,6 +84,40 @@ class _Compiled:
         # strong ref: the cache key uses id(program), so the program must
         # stay alive for as long as the cache entry does (prevents id reuse)
         self.program = program
+        self.fp = fp          # short program fingerprint (observability)
+        self.hlo = hlo        # opt-in trace/lower timings + cost estimates
+
+
+class _CompileCache:
+    """LRU-bounded compile cache (cap via PADDLE_TPU_COMPILE_CACHE_MAX,
+    default 256; 0 = unbounded). A long-lived server recompiling across
+    many feed signatures must not grow executables without bound; each
+    eviction is counted so cache thrash is visible in /metrics."""
+
+    def __init__(self, cap: int):
+        import collections
+
+        self._cap = cap
+        self._d = collections.OrderedDict()
+
+    def get(self, key):
+        c = self._d.get(key)
+        if c is not None:
+            self._d.move_to_end(key)
+        return c
+
+    def put(self, key, val):
+        self._d[key] = val
+        self._d.move_to_end(key)
+        while self._cap > 0 and len(self._d) > self._cap:
+            _, old = self._d.popitem(last=False)
+            obs.CACHE_EVICTIONS.inc(program=getattr(old, "fp", None) or "?")
+
+    def clear(self):
+        self._d.clear()
+
+    def __len__(self):
+        return len(self._d)
 
 
 def analyze_state(program: Program, feed_names):
@@ -169,7 +211,16 @@ class Executor:
         self.check_nan_inf = check_nan_inf
         import weakref
 
-        self._cache: Dict = {}
+        try:
+            cache_cap = int(os.environ.get("PADDLE_TPU_COMPILE_CACHE_MAX",
+                                           256))
+        except ValueError:
+            cache_cap = 256
+        self._cache = _CompileCache(cache_cap)
+        # label for this executor's prefetch-depth gauge series: the gauge
+        # is process-global, so two executors writing an unlabeled series
+        # would overwrite each other (sum the series for process truth)
+        self._obs_exe = "exe%d" % next(_EXE_IDS)
         # weak keys for the same reason as _steps below: _cache entries
         # pin their program via _Compiled.program, but this cache holds
         # no such ref, so an id-keyed entry could outlive its program
@@ -258,7 +309,9 @@ class Executor:
 
         stepfn = build_step_fn(program, fetch_names, state_in, state_out)
         fn = jax.jit(stepfn, donate_argnums=(1,))
-        return _Compiled(fn, state_in, state_out, fetch_names, program)
+        hlo = self._hlo_compile_stats(fn, feed_sig, state_in, scope)
+        return _Compiled(fn, state_in, state_out, fetch_names, program,
+                         fp=obs.program_fp(program), hlo=hlo)
 
     def _compile_loop(self, program: Program, feed_sig, fetch_names,
                       scope: Scope, per_step_names: frozenset,
@@ -293,7 +346,50 @@ class Executor:
             }
 
         fn = jax.jit(make_loop_fn(stepfn, slice_feeds), donate_argnums=(1,))
-        return _Compiled(fn, state_in, state_out, fetch_names, program)
+        hlo = self._hlo_compile_stats(fn, feed_sig, state_in, scope,
+                                      loop=True)
+        return _Compiled(fn, state_in, state_out, fetch_names, program,
+                         fp=obs.program_fp(program), hlo=hlo)
+
+    @staticmethod
+    def _hlo_compile_stats(fn, feed_sig, state_in, scope, loop=False):
+        """Opt-in (``observability.TIMELINE.set_hlo_cost(True)``): lower +
+        compile the jitted fn explicitly on abstract avals so the compile
+        timeline event can split trace time from XLA compile time and
+        carry the executable's cost-analysis FLOPs/bytes estimates (the
+        numbers tools/hlo_stats.py mines from an xprof capture). The
+        executor keeps executing through the lazy jit — this pays one
+        extra compile per cache miss, which is why it is off by default.
+        Returns a dict for timeline.record_compile, or None."""
+        if not obs.TIMELINE.hlo_cost_enabled():
+            return None
+        try:
+            feeds_aval = {n: jax.ShapeDtypeStruct(tuple(s), np.dtype(d))
+                          for n, s, d in feed_sig}
+            state_aval = {}
+            for n in state_in:
+                val = scope.find_var(n)
+                arr = (val if hasattr(val, "shape") and hasattr(val, "dtype")
+                       else np.asarray(val))
+                state_aval[n] = jax.ShapeDtypeStruct(tuple(arr.shape),
+                                                     np.dtype(arr.dtype))
+            args = [feeds_aval, state_aval,
+                    jax.eval_shape(lambda: jax.random.PRNGKey(0)),
+                    jax.ShapeDtypeStruct((), np.uint32)]
+            if loop:
+                args.append(jax.ShapeDtypeStruct((), np.int32))
+            t0 = time.perf_counter()
+            lowered = fn.lower(*args)
+            t1 = time.perf_counter()
+            compiled = lowered.compile()
+            t2 = time.perf_counter()
+            out = {"trace_ms": (t1 - t0) * 1e3, "xla_ms": (t2 - t1) * 1e3}
+            cost = obs.hlo_cost_stats(compiled)
+            if cost:
+                out.update(cost)
+            return out
+        except Exception:  # measurement must never break compilation
+            return None
 
     @staticmethod
     def _has_nan_inf(val) -> bool:
@@ -461,6 +557,9 @@ class Executor:
             slot = self._reader_prefetch.pop(program, None)
         if slot is None:
             return
+        obs.READER_PREFETCH_EVENTS.inc(event="flushed")
+        obs.READER_PREFETCH_DEPTH.set(len(self._reader_prefetch),
+                                          exe=self._obs_exe)
         for _op, holder, batches, epoch in reversed(slot["op_windows"]):
             if getattr(holder, "_ptpu_epoch", 0) != epoch:
                 continue  # stale epoch: discard
@@ -540,29 +639,41 @@ class Executor:
         compiled = self._cache.get(key) if use_program_cache else None
         if use_program_cache:
             profiler.record_cache(compiled is not None)
+            (obs.CACHE_HITS if compiled is not None else obs.CACHE_MISSES
+             ).inc(kind="run", program=obs.program_fp(program))
         first_run = compiled is None
         if compiled is None:
             compiled = self._compile(program, feed_sig, fetch_names, scope,
                                      user_feed_names=frozenset(feed))
             if use_program_cache:
-                self._cache[key] = compiled
+                self._cache.put(key, compiled)
 
         state = self._gather_state(compiled, scope)
         rng_key = self._rng_for(program)
         step = np.uint32(self._next_steps(program, 1))
 
-        if profiler.is_profiling():
+        profiling = profiler.is_profiling()
+        # a device fence per step serializes the async dispatch pipeline,
+        # so only the profiler window / opt-in timeline device-time mode
+        # pays it; unfenced wall time is dispatch (+compile on first run)
+        fence = profiling or obs.TIMELINE.device_time_enabled()
+        t0 = time.perf_counter()
+        fetches, new_state = compiled.fn(feed_arrays, state, rng_key, step)
+        if fence:
+            self._profiler_fence(fetches, new_state)
+        wall = time.perf_counter() - t0
+        if profiling:
             # jax.jit is lazy: trace + XLA compile all happen inside the
             # FIRST call, so bill that call to a separate event
             label = ("trace+compile+run" if first_run else "run")
-            t0 = time.perf_counter()
-            fetches, new_state = compiled.fn(feed_arrays, state, rng_key, step)
-            self._profiler_fence(fetches, new_state)
             profiler.record_event(
-                "%s/program_%x" % (label, id(program) & 0xFFFF),
-                time.perf_counter() - t0)
-        else:
-            fetches, new_state = compiled.fn(feed_arrays, state, rng_key, step)
+                "%s/program_%x" % (label, id(program) & 0xFFFF), wall)
+        obs.observe_run(
+            "run", wall, steps=1, program=compiled.fp, compiled=first_run,
+            hlo=compiled.hlo if first_run else None,
+            feed_bytes=obs.nbytes_of(feed_arrays.values()),
+            fetch_bytes=obs.nbytes_of(fetches),
+            device_ms=wall * 1e3 if fence else None)
         return self._finish(compiled, fetches, new_state, scope, return_numpy)
 
     def run_loop(
@@ -676,6 +787,9 @@ class Executor:
             if slot is not None and slot["k"] == 0:
                 raise slot["eof"]  # prefetch found the pipeline exhausted
             if slot is not None:
+                obs.READER_PREFETCH_EVENTS.inc(event="used")
+                obs.READER_PREFETCH_DEPTH.set(len(self._reader_prefetch),
+                                          exe=self._obs_exe)
                 window_feeds, k, eof_exc = (slot["feeds"], slot["k"],
                                             slot["eof"])
             else:
@@ -691,6 +805,10 @@ class Executor:
             effective_steps = k
         else:
             effective_steps = steps
+        # window-length distribution: mass below `steps` = truncation on
+        # the reader path (EOF / shape boundary), the run_loop per-window
+        # stat
+        obs.RUN_LOOP_WINDOW_STEPS.observe(effective_steps)
         feed_sig = tuple(
             (name, arr.shape, str(arr.dtype))
             for name, arr in sorted(feed_arrays.items())
@@ -701,30 +819,38 @@ class Executor:
         compiled = self._cache.get(key) if use_program_cache else None
         if use_program_cache:
             profiler.record_cache(compiled is not None)
+            (obs.CACHE_HITS if compiled is not None else obs.CACHE_MISSES
+             ).inc(kind="loop", program=obs.program_fp(program))
         first_run = compiled is None
         if compiled is None:
             compiled = self._compile_loop(
                 program, feed_sig, fetch_names, scope,
                 frozenset(per_step_names), user_feed_names=frozenset(feed))
             if use_program_cache:
-                self._cache[key] = compiled
+                self._cache.put(key, compiled)
 
         state = self._gather_state(compiled, scope)
         rng_key = self._rng_for(program)
         step0 = np.uint32(self._next_steps(program, effective_steps))
 
-        if profiler.is_profiling():
-            label = ("trace+compile+run_loop" if first_run else "run_loop")
-            t0 = time.perf_counter()
-            fetches, new_state = compiled.fn(feed_arrays, state, rng_key,
-                                             step0, np.int32(effective_steps))
+        profiling = profiler.is_profiling()
+        fence = profiling or obs.TIMELINE.device_time_enabled()
+        t0 = time.perf_counter()
+        fetches, new_state = compiled.fn(feed_arrays, state, rng_key,
+                                         step0, np.int32(effective_steps))
+        if fence:
             self._profiler_fence(fetches, new_state)
+        wall = time.perf_counter() - t0
+        if profiling:
+            label = ("trace+compile+run_loop" if first_run else "run_loop")
             profiler.record_event(
-                "%s/program_%x" % (label, id(program) & 0xFFFF),
-                time.perf_counter() - t0)
-        else:
-            fetches, new_state = compiled.fn(feed_arrays, state, rng_key,
-                                             step0, np.int32(effective_steps))
+                "%s/program_%x" % (label, id(program) & 0xFFFF), wall)
+        obs.observe_run(
+            "loop", wall, steps=effective_steps, program=compiled.fp,
+            compiled=first_run, hlo=compiled.hlo if first_run else None,
+            feed_bytes=obs.nbytes_of(feed_arrays.values()),
+            fetch_bytes=obs.nbytes_of(fetches),
+            device_ms=wall * 1e3 if fence else None)
         if read_ops and prefetch_on and eof_exc is None:
             # stage the NEXT window now, while the device is still
             # executing this one: the host pull/stack and the async
@@ -745,13 +871,20 @@ class Executor:
                     "feeds": (self._stack_reader_window(
                         gb, nwin, nk, stage=True) if nk else None),
                 }
+                obs.READER_PREFETCH_EVENTS.inc(event="staged")
             except Exception as e:  # noqa: BLE001 — deferred, not dropped
                 self._reader_prefetch[program] = {
                     "version": program._version, "steps": steps, "k": 0,
                     "eof": e, "op_windows": [], "feeds": None,
                 }
+                obs.READER_PREFETCH_EVENTS.inc(event="error")
+            obs.READER_PREFETCH_DEPTH.set(len(self._reader_prefetch),
+                                          exe=self._obs_exe)
         return self._finish(compiled, fetches, new_state, scope, return_numpy)
 
     def close(self):
         self._cache.clear()
         self._reader_prefetch.clear()
+        # retire this executor's gauge series so executor churn in a
+        # long-lived process doesn't grow the registry without bound
+        obs.READER_PREFETCH_DEPTH.remove(exe=self._obs_exe)

@@ -21,6 +21,7 @@ import hashlib
 import os
 import pickle
 import threading
+import time
 import warnings
 from typing import Dict, List, Optional, Sequence
 
@@ -28,6 +29,7 @@ import numpy as np
 
 import jax
 
+from . import observability as obs
 from .framework.core import Program
 from .framework.scope import Scope
 from .framework.trace import RngStream, trace_block
@@ -114,6 +116,10 @@ class Predictor:
         feed_sig = tuple((n, tuple(a.shape), str(a.dtype))
                          for n, a in sorted(feed_arrays.items()))
         if feed_sig in self._compiled:
+            # per-dispatch hit accounting, same contract as kind=run/loop
+            # (the resident-executable path dominates a steady server)
+            obs.CACHE_HITS.inc(kind="predict",
+                               program=obs.program_fp(self._program))
             if feed_sig not in self._touched:
                 # record USE (once per process per signature) so the
                 # preload cap's recency ordering tracks traffic, not
@@ -132,6 +138,10 @@ class Predictor:
         loaded = (self._deserialize_executable(path)
                   if self._aot_cache and os.path.exists(path) else None)
         if loaded is not None:
+            obs.CACHE_HITS.inc(kind="predict",
+                               program=obs.program_fp(self._program))
+            obs.TIMELINE.record_compile(
+                "predict", obs.program_fp(self._program), cache="aot-load")
             # a cache written before sidecars existed: create the .sig now
             # so the NEXT process's preload finds this executable (without
             # this, pre-sidecar caches would pay the lazy-deserialization
@@ -142,13 +152,26 @@ class Predictor:
             else:
                 self._touch_sig(sig_path)
         if loaded is None:
+            fp = obs.program_fp(self._program)
+            obs.CACHE_MISSES.inc(kind="predict", program=fp)
             fn = jax.jit(self._step_fn())
+            t0 = time.perf_counter()
             lowered = fn.lower(
                 {n: jax.ShapeDtypeStruct(s, np.dtype(d))
                  for n, s, d in feed_sig},
                 {n: jax.ShapeDtypeStruct(a.shape, a.dtype)
                  for n, a in self._state.items()})
+            t1 = time.perf_counter()
             loaded = lowered.compile()
+            t2 = time.perf_counter()
+            # the predictor compiles AOT anyway, so the trace/XLA split
+            # and cost-analysis estimates come for free here
+            cost = obs.hlo_cost_stats(loaded) or {}
+            obs.COMPILE_TOTAL.inc(kind="predict")
+            obs.COMPILE_LATENCY_MS.observe((t2 - t0) * 1e3, kind="predict")
+            obs.TIMELINE.record_compile(
+                "predict", fp, wall_ms=(t2 - t0) * 1e3,
+                trace_ms=(t1 - t0) * 1e3, xla_ms=(t2 - t1) * 1e3, **cost)
             if self._aot_cache:
                 from jax.experimental import serialize_executable as se
 
@@ -250,9 +273,11 @@ class Predictor:
                 cap -= 1
 
     # -- prediction --------------------------------------------------------
-    def run(self, feed, return_numpy: bool = True) -> List[np.ndarray]:
+    def run(self, feed, return_numpy: bool = True,
+            _obs_path: str = "direct") -> List[np.ndarray]:
         from .framework.dtypes import as_numpy_dtype
 
+        t0 = time.perf_counter()
         if isinstance(feed, (list, tuple)):
             feed = dict(zip(self._feed_names, feed))
         gb = self._program.global_block()
@@ -270,9 +295,17 @@ class Predictor:
             feed_arrays[name] = arr
         exe = self._get_executable(feed_arrays)
         outs = exe(feed_arrays, self._state)
-        if return_numpy:
-            return [np.asarray(o) for o in outs]
-        return list(outs)
+        outs = ([np.asarray(o) for o in outs] if return_numpy
+                else list(outs))
+        # batch latency + fill distribution (per-request latency for the
+        # server path is recorded by PredictorServer, queue wait included)
+        first = next(iter(feed_arrays.values())) if feed_arrays else None
+        rows = (first.shape[0] if first is not None and first.ndim else 1)
+        obs.PREDICT_LATENCY_MS.observe((time.perf_counter() - t0) * 1e3,
+                                       path=_obs_path)
+        obs.PREDICT_REQUESTS.inc(path=_obs_path)
+        obs.PREDICT_BATCH_ROWS.observe(rows, path=_obs_path)
+        return outs
 
     predict = run  # api parity sugar
 
@@ -305,6 +338,11 @@ class PredictorServer:
     drains up to max_batch per iteration with ptrt_chan_recv_batch (block
     for the first, no wait for the rest), stacks rows into one batch, runs
     the AOT predictor, and slices responses back per request.
+
+    ``server.start_http(port)`` additionally serves the process metrics
+    (request latency histograms, dynamic-batch fill, compile-cache
+    counters — see paddle_tpu.observability) at ``GET /metrics`` in
+    Prometheus text format and ``GET /metrics.json`` as a JSON snapshot.
     """
 
     def __init__(self, predictor: Predictor, max_batch: int = 8,
@@ -322,6 +360,8 @@ class PredictorServer:
         self._results: Dict[int, "_Future"] = {}
         self._next_id = 0
         self._lock = threading.Lock()
+        self._http = None
+        self._http_thread: Optional[threading.Thread] = None
 
     def start(self):
         if self._thread is not None and self._thread.is_alive():
@@ -332,6 +372,7 @@ class PredictorServer:
     def submit(self, sample: Sequence[np.ndarray]) -> "_Future":
         """sample: one array per feed slot (a single row, no batch dim)."""
         fut = _Future()
+        fut._t0 = time.perf_counter()  # request latency incl. queue wait
         with self._lock:
             rid = self._next_id
             self._next_id += 1
@@ -360,11 +401,16 @@ class PredictorServer:
                     feed = [np.concatenate(
                         [f, np.zeros((pad,) + f.shape[1:], f.dtype)])
                         for f in feed]
-                outs = self.predictor.run(feed)
+                obs.PREDICT_BATCH_ROWS.observe(len(rows), path="server")
+                outs = self.predictor.run(feed, _obs_path="server_batch")
+                now = time.perf_counter()
                 for i, (rid, _) in enumerate(reqs):
                     fut = self._pop(rid)
                     if fut is not None:
                         fut.set_result([o[i] for o in outs])
+                        obs.PREDICT_LATENCY_MS.observe(
+                            (now - fut._t0) * 1e3, path="server")
+                        obs.PREDICT_REQUESTS.inc(path="server")
             except Exception as e:  # fan the error out; keep serving
                 for rid, _ in reqs:
                     fut = self._pop(rid)
@@ -375,7 +421,59 @@ class PredictorServer:
         with self._lock:
             return self._results.pop(rid, None)
 
+    # -- observability endpoint ------------------------------------------
+    def start_http(self, port: int = 0, host: str = "127.0.0.1") -> int:
+        """Expose the process metrics over HTTP for a Prometheus scrape:
+        ``GET /metrics`` serves the text exposition of the global
+        registry, ``GET /metrics.json`` the JSON snapshot including the
+        step timeline. port=0 picks a free port; returns the bound port.
+        """
+        if self._http is not None:
+            return self._http.server_address[1]
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from .observability import export
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(h):  # noqa: N805 — BaseHTTPRequestHandler idiom
+                path = h.path.split("?", 1)[0]
+                if path == "/metrics":
+                    body = export.to_prometheus().encode("utf-8")
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif path == "/metrics.json":
+                    body = export.dumps_json(indent=2).encode("utf-8")
+                    ctype = "application/json"
+                else:
+                    h.send_response(404)
+                    h.end_headers()
+                    return
+                h.send_response(200)
+                h.send_header("Content-Type", ctype)
+                h.send_header("Content-Length", str(len(body)))
+                h.end_headers()
+                h.wfile.write(body)
+
+            def log_message(self, *args):  # scrape spam stays off stderr
+                pass
+
+        self._http = ThreadingHTTPServer((host, port), _Handler)
+        self._http_thread = threading.Thread(
+            target=self._http.serve_forever, daemon=True)
+        self._http_thread.start()
+        return self._http.server_address[1]
+
+    def stop_http(self):
+        if self._http is None:
+            return
+        self._http.shutdown()
+        self._http.server_close()
+        if self._http_thread is not None:
+            self._http_thread.join(timeout=5)
+            self._http_thread = None
+        self._http = None
+
     def stop(self):
+        self.stop_http()
         self._chan.close()
         if self._thread is not None:
             self._thread.join(timeout=5)

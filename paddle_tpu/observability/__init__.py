@@ -1,0 +1,161 @@
+"""paddle_tpu.observability — structured metrics + step timeline.
+
+The signals that matter for a framework whose whole Program executes as
+ONE fused XLA computation: compile events and compile-cache behavior
+(executor.py), per-step host/device time and feed/fetch volumes
+(Executor.run / run_loop / ParallelExecutor.run), serving latency and
+batch-size distribution (Predictor / PredictorServer), and bench phase
+accounting (bench.py). Everything records into one process-wide
+``MetricRegistry`` (metrics.py) and one bounded ``StepTimeline``
+(timeline.py); export.py renders Prometheus text / JSON, and
+``PredictorServer.start_http()`` serves it at ``GET /metrics``.
+
+The legacy ``paddle_tpu.profiler`` module is a compatibility shim over
+this registry (its event table lives in the
+``paddle_tpu_profiler_event_ms`` summary).
+"""
+from __future__ import annotations
+
+import weakref
+from typing import Optional
+
+from . import export, metrics, timeline  # noqa: F401
+from .metrics import (  # noqa: F401
+    DEFAULT_SIZE_BUCKETS, MetricRegistry, REGISTRY, get_registry,
+)
+from .timeline import TIMELINE, StepTimeline, get_timeline, hlo_cost_stats  # noqa: F401
+
+__all__ = [
+    "REGISTRY", "TIMELINE", "get_registry", "get_timeline",
+    "MetricRegistry", "StepTimeline", "metrics", "timeline", "export",
+    "program_fp", "observe_run", "reset_all", "hlo_cost_stats", "nbytes_of",
+    # shared instruments
+    "COMPILE_TOTAL", "COMPILE_LATENCY_MS", "CACHE_HITS", "CACHE_MISSES",
+    "CACHE_EVICTIONS", "STEP_LATENCY_MS", "STEPS_TOTAL", "FEED_BYTES",
+    "FETCH_BYTES", "RUN_LOOP_WINDOW_STEPS", "READER_PREFETCH_EVENTS",
+    "READER_PREFETCH_DEPTH", "PREDICT_LATENCY_MS", "PREDICT_REQUESTS",
+    "PREDICT_BATCH_ROWS", "PROFILER_EVENT_MS", "BENCH_ANOMALY_RETRIES",
+]
+
+# -- the shared instrument set (registered once, process-wide) -----------
+
+COMPILE_TOTAL = REGISTRY.counter(
+    "paddle_tpu_compile_total",
+    "Program compilations (trace + XLA compile), by executor kind")
+COMPILE_LATENCY_MS = REGISTRY.histogram(
+    "paddle_tpu_compile_latency_ms",
+    "Wall time of each compilation (first call: trace+compile+run)")
+CACHE_HITS = REGISTRY.counter(
+    "paddle_tpu_compile_cache_hits_total",
+    "Compile-cache hits, by kind and program fingerprint")
+CACHE_MISSES = REGISTRY.counter(
+    "paddle_tpu_compile_cache_misses_total",
+    "Compile-cache misses, by kind and program fingerprint")
+CACHE_EVICTIONS = REGISTRY.counter(
+    "paddle_tpu_compile_cache_evictions_total",
+    "Compile-cache LRU evictions (cap: PADDLE_TPU_COMPILE_CACHE_MAX)")
+STEP_LATENCY_MS = REGISTRY.histogram(
+    "paddle_tpu_step_latency_ms",
+    "Wall time per executor dispatch (run: one step; loop: one window)")
+STEPS_TOTAL = REGISTRY.counter(
+    "paddle_tpu_steps_total", "Training/inference steps executed")
+FEED_BYTES = REGISTRY.counter(
+    "paddle_tpu_feed_bytes_total", "Bytes fed into executed programs")
+FETCH_BYTES = REGISTRY.counter(
+    "paddle_tpu_fetch_bytes_total", "Bytes fetched out of executed programs")
+RUN_LOOP_WINDOW_STEPS = REGISTRY.histogram(
+    "paddle_tpu_run_loop_window_steps",
+    "Per-call reader/loop window length (truncation shows up as mass "
+    "below `steps`)", buckets=DEFAULT_SIZE_BUCKETS)
+READER_PREFETCH_EVENTS = REGISTRY.counter(
+    "paddle_tpu_reader_prefetch_events_total",
+    "Reader double-buffer lifecycle: staged / used / flushed / error")
+READER_PREFETCH_DEPTH = REGISTRY.gauge(
+    "paddle_tpu_reader_prefetch_depth",
+    "Programs with a device-staged next window right now")
+PREDICT_LATENCY_MS = REGISTRY.histogram(
+    "paddle_tpu_predict_latency_ms",
+    "Predictor request latency (path=direct|server; server includes queue "
+    "wait)")
+PREDICT_REQUESTS = REGISTRY.counter(
+    "paddle_tpu_predict_requests_total", "Predictor requests served")
+PREDICT_BATCH_ROWS = REGISTRY.histogram(
+    "paddle_tpu_predict_batch_rows",
+    "Rows per executed predict batch (server: dynamic batch fill)",
+    buckets=DEFAULT_SIZE_BUCKETS)
+PROFILER_EVENT_MS = REGISTRY.summary(
+    "paddle_tpu_profiler_event_ms",
+    "Legacy profiler event table (exact count/sum/min/max per event)")
+BENCH_ANOMALY_RETRIES = REGISTRY.counter(
+    "paddle_tpu_bench_anomaly_retry_total",
+    "bench.py transient-contention re-measurements, by phase")
+
+
+# -- helpers -------------------------------------------------------------
+
+# fingerprint cache: Program.fingerprint() json-serializes the whole
+# program — fine once per compile, far too hot for once per step. Weak
+# keys so a dead program's entry dies with it (same reasoning as the
+# executor's per-program step counters).
+_FP_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def program_fp(program) -> str:
+    """Short (8-hex) fingerprint of a Program, cached per version."""
+    try:
+        entry = _FP_CACHE.get(program)
+        version = getattr(program, "_version", None)
+        if entry is None or entry[0] != version:
+            entry = (version, program.fingerprint()[:8])
+            _FP_CACHE[program] = entry
+        return entry[1]
+    except Exception:  # fingerprinting must never break execution
+        return "%08x" % (id(program) & 0xFFFFFFFF)
+
+
+def observe_run(kind: str, wall_s: float, *, steps: int = 1,
+                program: Optional[str] = None, compiled: bool = False,
+                hlo: Optional[dict] = None,
+                feed_bytes: int = 0, fetch_bytes: int = 0,
+                device_ms: Optional[float] = None):
+    """One executor dispatch -> registry + timeline, in one call (keeps
+    the executor hot path to a single function call). ``compiled=True``
+    marks a first call (the lazy jit's trace+compile happened inside it);
+    ``hlo`` carries the opt-in trace/lower split and cost estimates from
+    ``Executor._hlo_compile_stats``."""
+    wall_ms = wall_s * 1e3
+    STEP_LATENCY_MS.observe(wall_ms, kind=kind)
+    STEPS_TOTAL.inc(steps, kind=kind)
+    if feed_bytes:
+        FEED_BYTES.inc(feed_bytes, kind=kind)
+    if fetch_bytes:
+        FETCH_BYTES.inc(fetch_bytes, kind=kind)
+    if compiled:
+        COMPILE_TOTAL.inc(kind=kind)
+        COMPILE_LATENCY_MS.observe(wall_ms, kind=kind)
+        TIMELINE.record_compile(kind, program, wall_ms=wall_ms,
+                                **(hlo or {}))
+    TIMELINE.record_step(kind, wall_ms, steps=steps, program=program,
+                         device_ms=device_ms, feed_bytes=feed_bytes,
+                         fetch_bytes=fetch_bytes)
+
+
+def nbytes_of(values) -> int:
+    """Total nbytes across an iterable of arrays (jax or numpy); values
+    without a known size count 0 — accounting must never throw."""
+    total = 0
+    for v in values:
+        n = getattr(v, "nbytes", None)
+        if n is None:
+            size = getattr(v, "size", None)
+            itemsize = getattr(getattr(v, "dtype", None), "itemsize", None)
+            n = size * itemsize if size is not None and itemsize else 0
+        total += int(n)
+    return total
+
+
+def reset_all():
+    """Zero the registry and clear the timeline (the registry-wide reset
+    the legacy ``profiler.reset_profiler`` delegates to)."""
+    REGISTRY.reset()
+    TIMELINE.reset()

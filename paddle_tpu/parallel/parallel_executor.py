@@ -18,6 +18,7 @@ each process feeds its local shard (jax.make_array_from_process_local_data).
 """
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -25,6 +26,7 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import observability as obs
 from ..executor import analyze_state, build_step_fn, _as_feed_array, _fetch_name
 from ..framework import trace as trace_mod
 from ..framework.core import Program, default_main_program
@@ -331,7 +333,11 @@ class ParallelExecutor:
         )
         key = (id(self._program), self._program._version, feed_sig,
                fetch_names, loop)
+        fp = obs.program_fp(self._program)
         compiled = self._cache.get(key)
+        first_run = compiled is None
+        (obs.CACHE_HITS if compiled is not None else obs.CACHE_MISSES
+         ).inc(kind="parallel", program=fp)
         if compiled is None:
             compiled = self._compile(feed_sig, fetch_names, loop=loop)
             self._cache[key] = compiled
@@ -362,6 +368,7 @@ class ParallelExecutor:
 
         # jit traces lazily inside the first call: distributed-capable
         # kernels (ring_attention) read the mesh from this context
+        t0 = time.perf_counter()
         with trace_mod.mesh_context(self._mesh):
             if loop:
                 fetches, new_state = compiled.fn(feeds, state,
@@ -370,12 +377,23 @@ class ParallelExecutor:
             else:
                 fetches, new_state = compiled.fn(feeds, state,
                                                  self._base_keys[seed], step)
+        obs.observe_run(
+            "parallel", time.perf_counter() - t0, steps=steps, program=fp,
+            compiled=first_run,
+            feed_bytes=obs.nbytes_of(feed_arrays.values()),
+            fetch_bytes=obs.nbytes_of(fetches))
         for name, val in new_state.items():
             self._scope.set_var(name, val)
 
         if return_numpy:
             return [np.asarray(v) for v in fetches]
         return list(fetches)
+
+    def run_stats(self):
+        """Run statistics for the mesh-parallel path — see module-level
+        ``run_stats()``; the registry series are process-global, so every
+        instance reports the same aggregate."""
+        return run_stats()
 
     def run_loop(self, fetch_list: Sequence, feed=None, steps: int = 1,
                  return_numpy=True):
@@ -389,3 +407,18 @@ class ParallelExecutor:
             raise ValueError("run_loop needs steps >= 1, got %d" % steps)
         return self.run(fetch_list, feed=feed, return_numpy=return_numpy,
                         _steps=steps)
+
+
+def run_stats():
+    """Aggregate {'steps', 'dispatches', 'mean_step_ms'} over every
+    ParallelExecutor in the process, read from the observability
+    registry (the same counters Executor feeds, ``kind="parallel"``).
+    mean_step_ms is wall dispatch time over steps executed, so run_loop
+    windows amortize exactly as they do on the device."""
+    lat = obs.STEP_LATENCY_MS.stats(kind="parallel")
+    steps = obs.STEPS_TOTAL.value(kind="parallel")
+    return {
+        "steps": int(steps),
+        "dispatches": int(lat["count"]),
+        "mean_step_ms": (lat["sum"] / steps) if steps else 0.0,
+    }

@@ -1,6 +1,16 @@
 """Module alias for ParallelExecutor (reference:
 python/paddle/fluid/parallel_executor.py; the implementation lives in
-parallel/parallel_executor.py here)."""
-from .parallel import BuildStrategy, ExecutionStrategy, ParallelExecutor  # noqa: F401
+parallel/parallel_executor.py here).
 
-__all__ = ["ParallelExecutor", "ExecutionStrategy", "BuildStrategy"]
+Also the home of the module-level ``run_stats()`` helper: ParallelExecutor
+records its dispatches into the same ``paddle_tpu.observability`` registry
+as the single-device Executor (``kind="parallel"`` series of
+``paddle_tpu_step_latency_ms`` / ``paddle_tpu_steps_total`` / the
+compile-cache counters), so run statistics are a registry read, not
+executor-private state.
+"""
+from .parallel import BuildStrategy, ExecutionStrategy, ParallelExecutor  # noqa: F401
+from .parallel.parallel_executor import run_stats  # noqa: F401
+
+__all__ = ["ParallelExecutor", "ExecutionStrategy", "BuildStrategy",
+           "run_stats"]

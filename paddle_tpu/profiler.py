@@ -6,14 +6,23 @@ op kernel launch; here a whole Program executes as ONE fused XLA
 computation, so the meaningful events are per-program compiles and step
 executions (plus compile-cache hits/misses), and deep per-op timelines come
 from the XLA trace viewer via ``jax.profiler`` (`tpu_trace`).
+
+This module is now a thin compatibility shim over
+``paddle_tpu.observability``: events recorded while profiling is on live in
+the registry's ``paddle_tpu_profiler_event_ms`` summary (exact
+count/sum/min/max per event — the reference report's columns), and
+``reset_profiler`` performs the registry-wide reset. The always-on metrics
+(compile cache, step latency, serving) record regardless of the
+start/stop window; this window only gates the legacy event table.
 """
 from __future__ import annotations
 
 import contextlib
 import time
 import warnings
-from collections import defaultdict
 from typing import Optional
+
+from . import observability as _obs
 
 __all__ = [
     "cuda_profiler", "reset_profiler", "start_profiler", "stop_profiler",
@@ -21,7 +30,6 @@ __all__ = [
 ]
 
 _enabled = False
-_events = defaultdict(lambda: [0, 0.0])  # name -> [calls, total_s]
 _cache_stats = {"hits": 0, "misses": 0}
 
 
@@ -34,9 +42,7 @@ def is_profiling() -> bool:
 
 def record_event(name: str, seconds: float):
     if _enabled:
-        ev = _events[name]
-        ev[0] += 1
-        ev[1] += seconds
+        _obs.PROFILER_EVENT_MS.observe(seconds * 1e3, event=name)
 
 
 def record_cache(hit: bool):
@@ -58,7 +64,9 @@ def timed(name: str):
 
 
 def cache_stats():
-    """Compile-cache stats (SURVEY aux: tracing / compile-cache stats)."""
+    """Compile-cache stats within the profiling window (SURVEY aux:
+    tracing / compile-cache stats). The always-on equivalents are the
+    ``paddle_tpu_compile_cache_*_total`` registry counters."""
     return dict(_cache_stats)
 
 
@@ -66,7 +74,10 @@ def cache_stats():
 
 
 def reset_profiler():
-    _events.clear()
+    """Clear the event table — and, since the table lives in the
+    observability registry now, the whole registry and step timeline with
+    it (one reset clears everything, as the reference's global reset)."""
+    _obs.reset_all()
     _cache_stats["hits"] = 0
     _cache_stats["misses"] = 0
 
@@ -80,23 +91,41 @@ def start_profiler(state="All"):
     _enabled = True
 
 
+def _event_rows():
+    """(name, calls, total_s, avg_s, min_s, max_s) per recorded event."""
+    rows = []
+    for labels, v in _obs.PROFILER_EVENT_MS.samples():
+        calls, total_ms, min_ms, max_ms = v
+        rows.append((labels.get("event", "?"), calls, total_ms / 1e3,
+                     total_ms / 1e3 / max(calls, 1), min_ms / 1e3,
+                     max_ms / 1e3))
+    return rows
+
+
 def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
     """Stop and emit the event table (reference profiler.py:stop_profiler).
-    sorted_key in {None, 'calls', 'total', 'ave'}."""
+    sorted_key in {None, 'calls', 'total', 'max', 'min', 'ave'} — each
+    sorts descending by that column (min/max are tracked per event)."""
     global _enabled
     _enabled = False
-    rows = [(name, calls, total, total / max(calls, 1))
-            for name, (calls, total) in _events.items()]
+    rows = _event_rows()
     if sorted_key == "calls":
         rows.sort(key=lambda r: -r[1])
-    elif sorted_key in ("total", "max", "min"):
+    elif sorted_key == "total":
         rows.sort(key=lambda r: -r[2])
     elif sorted_key == "ave":
         rows.sort(key=lambda r: -r[3])
-    lines = ["%-50s %8s %12s %12s" % ("Event", "Calls", "Total(ms)", "Avg(ms)")]
-    for name, calls, total, avg in rows:
-        lines.append("%-50s %8d %12.3f %12.3f"
-                     % (name[:50], calls, total * 1e3, avg * 1e3))
+    elif sorted_key == "min":
+        rows.sort(key=lambda r: -r[4])
+    elif sorted_key == "max":
+        rows.sort(key=lambda r: -r[5])
+    lines = ["%-50s %8s %12s %12s %12s %12s"
+             % ("Event", "Calls", "Total(ms)", "Min(ms)", "Max(ms)",
+                "Avg(ms)")]
+    for name, calls, total, avg, mn, mx in rows:
+        lines.append("%-50s %8d %12.3f %12.3f %12.3f %12.3f"
+                     % (name[:50], calls, total * 1e3, mn * 1e3, mx * 1e3,
+                        avg * 1e3))
     lines.append("compile cache: %(hits)d hits / %(misses)d misses"
                  % _cache_stats)
     report = "\n".join(lines)

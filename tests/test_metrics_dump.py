@@ -1,0 +1,97 @@
+"""Tier-1 smoke for the observability exposition: runs tools/metrics_dump.py
+(tiny CPU train loop + Predictor round-trip) in a subprocess and checks the
+Prometheus text format and JSON snapshot it prints. A format regression in
+observability/export.py fails here before it reaches a real scrape job."""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOL = os.path.join(_REPO, "tools", "metrics_dump.py")
+
+# the exposition names the acceptance surface pins (ISSUE 1): a rename is
+# a dashboard-breaking change and must be deliberate
+_REQUIRED_SERIES = (
+    "paddle_tpu_compile_total",
+    "paddle_tpu_compile_cache_hits_total",
+    "paddle_tpu_compile_cache_misses_total",
+    "paddle_tpu_step_latency_ms_bucket",
+    "paddle_tpu_step_latency_ms_sum",
+    "paddle_tpu_step_latency_ms_count",
+    "paddle_tpu_steps_total",
+    "paddle_tpu_predict_latency_ms_bucket",
+    "paddle_tpu_run_loop_window_steps_bucket",
+)
+
+
+@pytest.fixture(scope="module")
+def dump_output():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # keep the axon sitecustomize plugin from force-selecting the TPU
+    # tunnel in the subprocess (conftest can't reach a subprocess)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(
+        [sys.executable, _TOOL, "--steps", "2"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=_REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return proc.stdout
+
+
+def test_prometheus_exposition_contains_required_series(dump_output):
+    text = dump_output.split("\n{", 1)[0]  # prometheus part precedes JSON
+    for name in _REQUIRED_SERIES:
+        assert name in text, "missing %s in exposition" % name
+    # text-format invariants a scraper relies on
+    assert "# TYPE paddle_tpu_compile_total counter" in text
+    assert "# TYPE paddle_tpu_step_latency_ms histogram" in text
+    assert 'le="+Inf"' in text
+
+
+def test_histogram_buckets_are_cumulative_and_consistent(dump_output):
+    # every _bucket line for one series must be monotonically nondecreasing
+    # and the +Inf bucket must equal _count
+    text = dump_output.split("\n{", 1)[0]
+    series = {}
+    for line in text.splitlines():
+        if line.startswith("paddle_tpu_step_latency_ms_bucket"):
+            labels, val = line.rsplit(" ", 1)
+            key = labels.split('le="')[0]
+            series.setdefault(key, []).append(int(val))
+    assert series, "no step-latency buckets emitted"
+    for key, counts in series.items():
+        assert counts == sorted(counts), "non-cumulative buckets in %s" % key
+    counts_by_key = {}
+    for line in text.splitlines():
+        if line.startswith("paddle_tpu_step_latency_ms_count"):
+            labels, val = line.rsplit(" ", 1)
+            # "..._count{kind=run}" -> the prefix its bucket lines share
+            # ("le" sorts after "kind", so it is the last label)
+            counts_by_key[labels.replace("_count{", "_bucket{")
+                          .rstrip("}")] = int(val)
+    matched = 0
+    for key, counts in series.items():
+        want = [v for k, v in counts_by_key.items() if key.startswith(k)]
+        assert want and counts[-1] == want[0]
+        matched += 1
+    assert matched == len(counts_by_key)
+
+
+def test_json_snapshot_parses_and_carries_timeline(dump_output):
+    json_part = dump_output[dump_output.index("\n{") + 1:]
+    snap = json.loads(json_part)
+    assert "metrics" in snap and "timeline" in snap
+    assert "paddle_tpu_compile_total" in snap["metrics"]
+    tl = snap["timeline"]
+    assert tl["recorded"] >= 1 and isinstance(tl["events"], list)
+    types = {e["type"] for e in tl["events"]}
+    assert "step" in types and "compile" in types
+    # each step event carries the fields the timeline promises
+    step = next(e for e in tl["events"] if e["type"] == "step")
+    for field in ("ts", "kind", "wall_ms", "steps", "feed_bytes",
+                  "fetch_bytes", "seq"):
+        assert field in step, field

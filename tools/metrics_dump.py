@@ -1,0 +1,107 @@
+"""Observability smoke: tiny CPU train loop -> Prometheus + JSON dump.
+
+Runs a few Executor.run steps and one run_loop window on the CPU backend,
+a Predictor round-trip when --predict is given (or by default), then
+prints the paddle_tpu.observability registry twice: the Prometheus text
+exposition (what a scrape of PredictorServer's /metrics returns) and the
+JSON snapshot including the step timeline. tests/test_metrics_dump.py
+runs this in tier-1, so an exposition-format regression fails CI before
+it reaches a real scrape job.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/metrics_dump.py [--steps 4] [--json]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# CPU by default: this is a format smoke, not a perf measurement, and it
+# must run in CI / on laptops with no accelerator attached
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+# a sitecustomize-installed PJRT plugin can override JAX_PLATFORMS at
+# import time (see tests/conftest.py) — pin the platform after import too
+jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import numpy as np
+
+
+def tiny_train_loop(steps: int):
+    import paddle_tpu as fluid
+    from paddle_tpu import layers, optimizer
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = layers.data(name="x", shape=[8])
+            y = layers.data(name="y", shape=[1])
+            h = layers.fc(x, 16, act="relu")
+            pred = layers.fc(h, 1)
+            loss = layers.mean(layers.square(pred - y))
+            optimizer.SGD(learning_rate=0.01).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rs = np.random.RandomState(0)
+        xs = rs.rand(4, 8).astype(np.float32)
+        ys = rs.rand(4, 1).astype(np.float32)
+        for _ in range(steps):
+            exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        # one device-side while-loop window so the loop-kind series and
+        # the window-length histogram have samples too
+        exe.run_loop(main, feed={"x": xs, "y": ys}, fetch_list=[loss],
+                     steps=2)
+
+
+def predict_roundtrip(tmpdir: str):
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.inference import Predictor
+
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = layers.data(name="x", shape=[8])
+            out = layers.fc(x, 3, act="softmax")
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        fluid.io.save_inference_model(tmpdir, ["x"], [out], exe,
+                                      main_program=main, scope=scope)
+    p = Predictor(tmpdir, aot_cache=False)
+    p.run({"x": np.ones((2, 8), np.float32)})
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--steps", type=int, default=4,
+                    help="Executor.run steps in the tiny loop")
+    ap.add_argument("--no-predict", action="store_true",
+                    help="skip the Predictor round-trip")
+    ap.add_argument("--json", action="store_true",
+                    help="print ONLY the JSON snapshot (no Prometheus text)")
+    args = ap.parse_args()
+
+    tiny_train_loop(args.steps)
+    if not args.no_predict:
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            predict_roundtrip(td)
+
+    from paddle_tpu.observability import export
+
+    if not args.json:
+        sys.stdout.write(export.to_prometheus())
+        sys.stdout.write("\n")
+    sys.stdout.write(export.dumps_json(indent=2))
+    sys.stdout.write("\n")
+
+
+if __name__ == "__main__":
+    main()
