@@ -6,10 +6,13 @@ paddle/fluid/inference/api/api_impl.cc + paddle/contrib/inference demos).
 2. exports it with save_inference_model (program JSON + params),
 3. loads it into the AOT Predictor (serialized-XLA-executable cache,
    preload sidecars — cold start with zero re-trace),
-4. serves concurrent clients through PredictorServer's dynamically
-   batched loop (requests ride the C++ bounded channel; up to
-   --max-batch rows run as ONE padded device batch per iteration),
-   and checks every served row against a direct Predictor.run.
+4. serves concurrent clients through PredictorServer's pipelined
+   dynamic-batching loop (requests ride the C++ bounded channel as
+   zero-copy frames; up to --max-batch rows run as ONE device batch,
+   padded to the next power-of-two bucket, with batch assembly
+   overlapping device execution; --max-wait-ms trades latency for
+   fuller batches), and checks every served row against a direct
+   Predictor.run.
 
 Concurrent callers belong on this server path, not on per-request
 Predictor/C-ABI calls (see docs/performance.md "serving").
@@ -70,6 +73,10 @@ def main():
     ap.add_argument("--clients", type=int, default=4)
     ap.add_argument("--rows-per-client", type=int, default=8)
     ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--max-wait-ms", type=float, default=0.0,
+                    help="batching deadline: wait up to this many ms "
+                         "after a batch's first request for it to fill "
+                         "(see docs/performance.md 'Serving tuning')")
     ap.add_argument("--metrics-port", type=int, default=0,
                     help="bind /metrics here (0 = pick a free port)")
     ap.add_argument("--metrics-host", default="127.0.0.1",
@@ -90,7 +97,8 @@ def main():
         assert acc > 0.9, "model should fit its own training batch"
 
         # --- dynamically batched server, concurrent clients ------------
-        server = PredictorServer(pred, max_batch=args.max_batch)
+        server = PredictorServer(pred, max_batch=args.max_batch,
+                                 max_wait_ms=args.max_wait_ms)
         server.start()
         port = server.start_http(args.metrics_port, host=args.metrics_host)
         # an all-interfaces bind is still scrapeable via loopback

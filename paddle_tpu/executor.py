@@ -226,6 +226,11 @@ class Executor:
         # no such ref, so an id-keyed entry could outlive its program
         # and be served to a new one at the same address
         self._read_ops = weakref.WeakKeyDictionary()
+        # per-program feed-conversion plan (serving fast path): the
+        # recursive block-walk var lookup behind every feed name runs
+        # once per program version, not once per call — weak keys for
+        # the same id-reuse reason as _read_ops
+        self._feed_vars = weakref.WeakKeyDictionary()
         # per-PROGRAM step counters (the RNG stream fold): running one
         # program (e.g. startup) must not advance another program's
         # stochastic-op stream, or the same training program draws
@@ -449,6 +454,26 @@ class Executor:
             self._read_ops[program] = entry
         return entry[1]
 
+    def _feed_var_for(self, program: Program, gb, name: str):
+        """`gb._find_var_recursive(name)` memoized per (program,
+        version): feed dtype coercion needs the declared Variable every
+        call, but the declaration only changes when the program does —
+        on a steady serving/training loop this is a dict hit. Negative
+        lookups are NOT cached: create_var alone does not bump
+        program._version, so a var added between runs would stay
+        invisible behind a cached None."""
+        entry = self._feed_vars.get(program)
+        if entry is None or entry[0] != program._version:
+            entry = (program._version, {})
+            self._feed_vars[program] = entry
+        cache = entry[1]
+        var = cache.get(name)
+        if var is None:
+            var = gb._find_var_recursive(name)
+            if var is not None:
+                cache[name] = var
+        return var
+
     @staticmethod
     def _holder_for(gb, op):
         rvar = gb._find_var_recursive(op.input("Reader")[0])
@@ -616,7 +641,7 @@ class Executor:
         gb = program.global_block()
         feed_arrays = {}
         for name, value in feed.items():
-            var = gb._find_var_recursive(name)
+            var = self._feed_var_for(program, gb, name)
             feed_arrays[name] = _as_feed_array(value, var)
         # reader-op pipeline: pull the next staged batch for every `read`
         # op and inject its outputs as this step's feeds (reference:
@@ -629,7 +654,7 @@ class Executor:
             holder = self._holder_for(gb, op)
             batch = self._next_batch(holder)
             for out_name in op.output("Out"):
-                var = gb._find_var_recursive(out_name)
+                var = self._feed_var_for(program, gb, out_name)
                 feed_arrays[out_name] = _as_feed_array(batch[out_name], var)
         feed_sig = tuple(
             (name, arr.shape, str(arr.dtype)) for name, arr in sorted(feed_arrays.items())
@@ -738,7 +763,7 @@ class Executor:
         gb = program.global_block()
         feed_arrays = {}
         for name, value in feed.items():
-            var = gb._find_var_recursive(name)
+            var = self._feed_var_for(program, gb, name)
             if name in per_step_names:
                 arr = np.asarray(value)
                 if arr.ndim == 0 or arr.shape[0] != steps:
@@ -885,6 +910,7 @@ class Executor:
     def close(self):
         self._cache.clear()
         self._reader_prefetch.clear()
+        self._feed_vars.clear()
         # retire this executor's gauge series so executor churn in a
         # long-lived process doesn't grow the registry without bound
         obs.READER_PREFETCH_DEPTH.remove(exe=self._obs_exe)

@@ -10,16 +10,22 @@ TPU-native equivalents:
   (program fingerprint, feed signature, backend, jax version). A fresh
   process deserializes the executable and predicts with NO re-trace and NO
   re-compile — the reference's "load once, serve forever" cold-start story.
-- `PredictorServer` is the serving loop: requests enter a C++ bounded
-  channel (runtime.cc), `ptrt_chan_recv_batch` drains them with dynamic
-  batching (block for the first, take whatever else is queued), the worker
-  stacks rows and runs the Predictor, responses fan back out by request id.
+- `PredictorServer` is the serving loop, built as a two-stage pipeline:
+  requests enter a C++ bounded channel (runtime.cc) as zero-copy binary
+  frames; a STACKING stage drains them with dynamic batching
+  (`ptrt_chan_recv_batch`: block for the first, collect up to
+  `max_wait_ms` longer), stacks rows and pads to the next power-of-two
+  bucket; a DEVICE stage runs the AOT predictor over a bounded in-flight
+  queue so host-side assembly overlaps device execution. Responses fan
+  back out by request id.
 """
 from __future__ import annotations
 
 import hashlib
 import os
 import pickle
+import queue
+import struct
 import threading
 import time
 import warnings
@@ -62,6 +68,18 @@ class Predictor:
         self._cache_dir = cache_dir or os.path.join(model_dir, _AOT_DIR)
         self._compiled: Dict = {}
         self._touched: set = set()  # sigs whose USE this process recorded
+        # feed-conversion plan, computed ONCE: the model's feed set is
+        # frozen at load, so the per-call var lookup + declared-dtype
+        # resolution of the old run() path is pure steady-state overhead
+        from .framework.dtypes import as_numpy_dtype
+
+        gb = self._program.global_block()
+        self._feed_plan = []
+        for name in self._feed_names:
+            var = gb._find_var_recursive(name)
+            want = (np.dtype(as_numpy_dtype(var.dtype))
+                    if var is not None else None)
+            self._feed_plan.append((name, var, want))
         # params are resident device state, uploaded once at load
         self._state_names, self._state = self._load_state()
         self.traces = 0  # diagnostic: number of program traces performed
@@ -211,13 +229,19 @@ class Predictor:
         try:
             with open(path, "rb") as f:
                 blob, in_tree, out_tree = pickle.load(f)
-            # pin execution to one device: the executable was compiled
-            # single-device, and the default (all local devices) breaks
-            # under a multi-device runtime (e.g. the 8-virtual-CPU
-            # test mesh)
-            return se.deserialize_and_load(
-                blob, in_tree, out_tree,
-                execution_devices=jax.devices()[:1])
+            try:
+                # pin execution to one device: the executable was compiled
+                # single-device, and the default (all local devices) breaks
+                # under a multi-device runtime (e.g. the 8-virtual-CPU
+                # test mesh)
+                return se.deserialize_and_load(
+                    blob, in_tree, out_tree,
+                    execution_devices=jax.devices()[:1])
+            except TypeError:
+                # jax without the execution_devices kwarg (<= 0.4.x):
+                # the serialized executable carries its own single-device
+                # assignment, so the unpinned load is equivalent there
+                return se.deserialize_and_load(blob, in_tree, out_tree)
         except Exception:
             return None  # cache from another machine/version: rebuild
 
@@ -272,26 +296,43 @@ class Predictor:
                 self._compiled[feed_sig] = loaded
                 cap -= 1
 
+    # -- pre-warm ----------------------------------------------------------
+    def warm(self, batch_rows: int) -> bool:
+        """Compile (or AOT-load) the executable for a ``batch_rows``-row
+        batch of the model's DECLARED feed shapes without running it —
+        ``PredictorServer.start()`` pre-warms every padding bucket this
+        way so no live request ever eats an XLA compile. Returns False
+        (no-op) when a declared feed shape has dynamic non-batch dims
+        (batch signature unknowable up front) or a STATIC batch dim
+        (only that one size can ever serve, so bucket warming would just
+        crash into _check_feed_shapes)."""
+        feed_arrays = {}
+        for name, var, want in self._feed_plan:
+            shape = tuple(getattr(var, "shape", None) or ())
+            if (not shape or shape[0] not in (-1, None)
+                    or any(d is None or d < 0 for d in shape[1:])):
+                return False
+            feed_arrays[name] = np.zeros(
+                (batch_rows,) + shape[1:], want or np.float32)
+        self._get_executable(feed_arrays)
+        return True
+
     # -- prediction --------------------------------------------------------
     def run(self, feed, return_numpy: bool = True,
             _obs_path: str = "direct") -> List[np.ndarray]:
-        from .framework.dtypes import as_numpy_dtype
-
         t0 = time.perf_counter()
         if isinstance(feed, (list, tuple)):
             feed = dict(zip(self._feed_names, feed))
-        gb = self._program.global_block()
         feed_arrays = {}
-        for name in self._feed_names:
+        for name, _var, want in self._feed_plan:
             if name not in feed:
                 raise KeyError("missing feed %r (model expects %s)"
                                % (name, self._feed_names))
-            var = gb._find_var_recursive(name)
-            arr = np.asarray(feed[name])
-            if var is not None:
-                want = as_numpy_dtype(var.dtype)
-                if arr.dtype != want:
-                    arr = arr.astype(want)
+            arr = feed[name]
+            if type(arr) is not np.ndarray:
+                arr = np.asarray(arr)
+            if want is not None and arr.dtype != want:
+                arr = arr.astype(want)
             feed_arrays[name] = arr
         exe = self._get_executable(feed_arrays)
         outs = exe(feed_arrays, self._state)
@@ -325,8 +366,69 @@ def create_paddle_predictor(config_or_dir, **kwargs) -> Predictor:
     return Predictor(getattr(config_or_dir, "model_dir"), **kwargs)
 
 
+# -- request wire format --------------------------------------------------
+#
+# Zero-copy frame (fast path): contiguous numeric sample arrays ride the
+# channel as
+#   b"Z" | rid u64 | nslots u32 | per slot:
+#     dtype-str len u8 | numpy dtype.str (endianness included) |
+#     ndim u8 | shape i64 x ndim | nbytes i64 | raw row bytes
+# The stacking stage reconstructs each row as an ``np.frombuffer`` VIEW
+# over the received message — no pickle object graph is built on either
+# side of the channel. Samples the frame cannot carry (object / record
+# dtypes) fall back to the pickled form, prefixed b"P".
+
+_ZC_HDR = struct.Struct("<BQI")
+_ZC_U8 = struct.Struct("<B")
+_ZC_I64 = struct.Struct("<q")
+
+
+def _encode_request(rid: int, rows: Sequence[np.ndarray]) -> bytes:
+    parts = [_ZC_HDR.pack(0x5A, rid, len(rows))]
+    for a in rows:
+        ds = a.dtype.str.encode("ascii")
+        parts.append(_ZC_U8.pack(len(ds)))
+        parts.append(ds)
+        parts.append(_ZC_U8.pack(a.ndim))
+        parts.append(struct.pack("<%dq" % a.ndim, *a.shape))
+        parts.append(_ZC_I64.pack(a.nbytes))
+        # memoryview.cast rejects 0-d and zero-size views; tobytes there
+        # copies at most one scalar
+        parts.append(memoryview(a).cast("B") if a.ndim and a.size
+                     else a.tobytes())
+    return b"".join(parts)
+
+
+def _decode_request(msg: bytes):
+    """(rid, [row arrays]) back from either wire form; zero-copy rows
+    are read-only views over ``msg`` (np.stack copies them exactly once,
+    straight into the batch)."""
+    if msg[:1] == b"P":
+        return pickle.loads(memoryview(msg)[1:])
+    mv = memoryview(msg)
+    _magic, rid, nslots = _ZC_HDR.unpack_from(mv, 0)
+    off = _ZC_HDR.size
+    rows = []
+    for _ in range(nslots):
+        (dlen,) = _ZC_U8.unpack_from(mv, off)
+        off += 1
+        dt = np.dtype(bytes(mv[off:off + dlen]).decode("ascii"))
+        off += dlen
+        (ndim,) = _ZC_U8.unpack_from(mv, off)
+        off += 1
+        shape = struct.unpack_from("<%dq" % ndim, mv, off) if ndim else ()
+        off += 8 * ndim
+        (nbytes,) = _ZC_I64.unpack_from(mv, off)
+        off += 8
+        count = nbytes // dt.itemsize if dt.itemsize else 0
+        rows.append(np.frombuffer(mv, dt, count, off).reshape(shape))
+        off += nbytes
+    return rid, rows
+
+
 class PredictorServer:
-    """C++-batched serving loop (reference: the NativePredictor run loop).
+    """Pipelined dynamic-batching serving loop (reference: the
+    NativePredictor run loop, rebuilt as a two-stage pipeline).
 
     server = PredictorServer(predictor, max_batch=8)
     server.start()
@@ -334,40 +436,99 @@ class PredictorServer:
     outs = fut.result()                   # list of per-fetch rows
     server.stop()
 
-    Requests are pickled into a C++ bounded channel; the worker thread
-    drains up to max_batch per iteration with ptrt_chan_recv_batch (block
-    for the first, no wait for the rest), stacks rows into one batch, runs
-    the AOT predictor, and slices responses back per request.
+    Requests enter a C++ bounded channel as zero-copy binary frames
+    (pickle only for object-dtype samples). Two worker stages overlap:
+
+    - the STACKING stage drains up to ``max_batch`` frames per iteration
+      (``ptrt_chan_recv_batch``: block for the first, then collect up to
+      ``max_wait_ms`` longer or until full), stacks rows into one batch,
+      and pads it up to the next power-of-two BUCKET (not to max_batch —
+      a 5-row batch runs at 8 rows, not 32);
+    - the DEVICE stage pops stacked batches from a bounded in-flight
+      queue (depth ``in_flight``) and runs the AOT predictor, so
+      host-side decode/stack overlaps device execution.
+
+    ``start()`` pre-warms every bucket's compiled signature (one
+    ``Predictor.warm`` per bucket), so no live request ever pays an XLA
+    compile. ``max_wait_ms`` is the latency/throughput knob: 0 (default)
+    ships whatever is queued immediately; a few ms lets slow traffic
+    coalesce into fuller buckets.
 
     ``server.start_http(port)`` additionally serves the process metrics
-    (request latency histograms, dynamic-batch fill, compile-cache
-    counters — see paddle_tpu.observability) at ``GET /metrics`` in
-    Prometheus text format and ``GET /metrics.json`` as a JSON snapshot.
+    (request latency histograms, bucket fill, pad-waste rows, in-flight
+    depth, per-stage latency — see paddle_tpu.observability) at
+    ``GET /metrics`` in Prometheus text format and ``GET /metrics.json``
+    as a JSON snapshot.
     """
 
     def __init__(self, predictor: Predictor, max_batch: int = 8,
-                 capacity: int = 256, pad_batches: bool = True):
+                 capacity: int = 256, pad_batches: bool = True,
+                 max_wait_ms: float = 0.0, in_flight: int = 2,
+                 buckets: Optional[Sequence[int]] = None,
+                 prewarm: bool = True):
         from .runtime.recordio import Channel
 
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1, got %d" % max_batch)
         self.predictor = predictor
         self.max_batch = max_batch
-        # pad every dynamic batch up to max_batch (zero rows, sliced off
-        # after predict): ONE compiled signature instead of one XLA
-        # compile per distinct batch size the traffic happens to produce
+        # pad every dynamic batch up to its BUCKET (zero rows, sliced off
+        # after predict): one compiled signature per bucket instead of
+        # one per distinct batch size the traffic happens to produce,
+        # without the old policy's pad-everything-to-max_batch waste
         self.pad_batches = pad_batches
+        self.max_wait_ms = float(max_wait_ms)
+        self.in_flight = max(1, int(in_flight))
+        if buckets is None:
+            buckets, b = [], 1
+            while b < max_batch:
+                buckets.append(b)
+                b *= 2
+        self.buckets = sorted({int(b) for b in buckets} | {max_batch})
+        self._prewarm = prewarm
+        self._prewarmed = False
         self._chan = Channel(capacity)
-        self._thread: Optional[threading.Thread] = None
+        self._inflight: "queue.Queue" = queue.Queue(self.in_flight)
+        # serializes predictor execution between the device stage and the
+        # stacking stage's idle-device inline fast path
+        self._dev_lock = threading.Lock()
+        self._stack_thread: Optional[threading.Thread] = None
+        self._dev_thread: Optional[threading.Thread] = None
         self._results: Dict[int, "_Future"] = {}
         self._next_id = 0
         self._lock = threading.Lock()
         self._http = None
         self._http_thread: Optional[threading.Thread] = None
+        # diagnostic: executed batches by REAL row count (device thread
+        # writes, anyone may read; tests and the serving bench use it)
+        self.batch_size_counts: Dict[int, int] = {}
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.max_batch
 
     def start(self):
-        if self._thread is not None and self._thread.is_alive():
+        if self._dev_thread is not None and self._dev_thread.is_alive():
             return
-        self._thread = threading.Thread(target=self._loop, daemon=True)
-        self._thread.start()
+        if self.pad_batches and self._prewarm and not self._prewarmed:
+            # compile/AOT-load every bucket signature BEFORE serving: a
+            # cold bucket would stall its whole batch (and everything
+            # queued behind it) for an XLA compile mid-traffic
+            t0 = time.perf_counter()
+            for b in self.buckets:
+                if not self.predictor.warm(b):
+                    break  # dynamic non-batch dims: bucket sigs stay lazy
+            self._prewarmed = True
+            obs.SERVER_STAGE_MS.observe(
+                (time.perf_counter() - t0) * 1e3, stage="prewarm")
+        self._stack_thread = threading.Thread(
+            target=self._stack_loop, daemon=True)
+        self._dev_thread = threading.Thread(
+            target=self._device_loop, daemon=True)
+        self._stack_thread.start()
+        self._dev_thread.start()
 
     def submit(self, sample: Sequence[np.ndarray]) -> "_Future":
         """sample: one array per feed slot (a single row, no batch dim)."""
@@ -377,45 +538,161 @@ class PredictorServer:
             rid = self._next_id
             self._next_id += 1
             self._results[rid] = fut
-        ok = self._chan.send(pickle.dumps(
-            (rid, [np.asarray(a) for a in sample]), protocol=4))
-        if not ok:
+        fut._bind(self, rid)
+        try:
+            rows, fast = [], True
+            for a in sample:
+                if type(a) is not np.ndarray:
+                    a = np.asarray(a)
+                if a.dtype.kind in "OVMm":
+                    # object graphs and datetime/timedelta (no buffer
+                    # export) can't ride the frame
+                    fast = False
+                elif not a.flags["C_CONTIGUOUS"]:
+                    a = np.ascontiguousarray(a)
+                rows.append(a)
+            msg = (_encode_request(rid, rows) if fast
+                   else b"P" + pickle.dumps((rid, rows), protocol=4))
+            sent = self._chan.send(msg)
+        except BaseException:
+            # an encode/convert failure must not leak the result-table
+            # entry registered above
+            with self._lock:
+                self._results.pop(rid, None)
+            raise
+        if not sent:
             with self._lock:
                 self._results.pop(rid, None)
             raise RuntimeError("predictor server is stopped")
         return fut
 
-    def _loop(self):
+    @staticmethod
+    def _assemble(rows, nreal: int, bucket: int):
+        """Per-slot batch assembly in ONE pass: rows gather (C++ threaded
+        memcpy for >=1 MiB payloads, Python loop below it) straight into
+        a bucket-sized buffer whose pad tail is zeroed in place — the old
+        np.stack + np.concatenate pair copied every padded batch twice.
+        A lone unpadded row is returned as a VIEW (no copy at all)."""
+        from .runtime.recordio import batch_assemble
+
+        feed = []
+        for j in range(len(rows[0])):
+            r0 = rows[0][j]
+            if nreal == 1 and bucket == 1:
+                feed.append(r0[None])
+                continue
+            slot = [rows[i][j] for i in range(nreal)]
+            dt = r0.dtype
+            if any(r.dtype != dt for r in slot):
+                # mixed-dtype rows promote like np.stack did — filling an
+                # r0-typed buffer would silently truncate (0.7 -> 0)
+                dt = np.result_type(*[r.dtype for r in slot])
+            out = np.empty((bucket,) + r0.shape, dt)
+            if not batch_assemble(slot, out[:nreal]):
+                for i in range(nreal):
+                    if slot[i].shape != r0.shape:
+                        # np.stack used to raise here; a bare out[i]=
+                        # assignment would silently BROADCAST a
+                        # mismatched row into a wrong batch
+                        raise ValueError(
+                            "sample %d slot %d has shape %s; this batch "
+                            "expects %s" % (i, j, slot[i].shape, r0.shape))
+                    out[i] = slot[i]
+            if bucket > nreal:
+                out[nreal:] = 0
+            feed.append(out)
+        return feed
+
+    # -- pipeline stages --------------------------------------------------
+    def _stack_loop(self):
+        max_wait_s = self.max_wait_ms / 1e3
         while True:
-            batch = self._chan.recv_batch(self.max_batch)
+            batch = self._chan.recv_batch(
+                self.max_batch, max_wait_s if max_wait_s > 0 else None)
             if batch is None:
-                return  # closed and drained
+                self._inflight.put(None)  # closed + drained: stop device
+                return
+            t0 = time.perf_counter()
             reqs = []
             try:
-                reqs = [pickle.loads(b) for b in batch]
+                for msg in batch:
+                    reqs.append(_decode_request(msg))
                 rows = [r[1] for r in reqs]
-                feed = [np.stack([row[j] for row in rows])
-                        for j in range(len(rows[0]))]
-                if self.pad_batches and len(rows) < self.max_batch:
-                    pad = self.max_batch - len(rows)
-                    feed = [np.concatenate(
-                        [f, np.zeros((pad,) + f.shape[1:], f.dtype)])
-                        for f in feed]
-                obs.PREDICT_BATCH_ROWS.observe(len(rows), path="server")
-                outs = self.predictor.run(feed, _obs_path="server_batch")
-                now = time.perf_counter()
-                for i, (rid, _) in enumerate(reqs):
-                    fut = self._pop(rid)
-                    if fut is not None:
-                        fut.set_result([o[i] for o in outs])
-                        obs.PREDICT_LATENCY_MS.observe(
-                            (now - fut._t0) * 1e3, path="server")
-                        obs.PREDICT_REQUESTS.inc(path="server")
-            except Exception as e:  # fan the error out; keep serving
-                for rid, _ in reqs:
-                    fut = self._pop(rid)
-                    if fut is not None:
-                        fut.set_exception(e)
+                nreal = len(rows)
+                bucket = (self._bucket_for(nreal) if self.pad_batches
+                          else nreal)
+                feed = self._assemble(rows, nreal, bucket)
+                obs.PREDICT_BATCH_ROWS.observe(nreal, path="server")
+                obs.SERVER_BUCKET_FILL.observe(nreal, bucket=str(bucket))
+                obs.SERVER_ROWS.inc(nreal, kind="real")
+                if bucket > nreal:
+                    obs.SERVER_ROWS.inc(bucket - nreal, kind="pad")
+                obs.SERVER_STAGE_MS.observe(
+                    (time.perf_counter() - t0) * 1e3, stage="stack")
+            except Exception as e:  # fan out to the decoded reqs; keep going
+                self._fail(reqs, e)
+                continue
+            # idle-device fast path: with nothing queued and the device
+            # stage idle, the queue hop + thread wake would be pure added
+            # latency — run the batch HERE (under the device lock), so
+            # the pipeline collapses to a single stage at low load and
+            # expands under load, where the hop pays for itself
+            ran_inline = False
+            if (self._inflight.empty()
+                    and self._dev_lock.acquire(blocking=False)):
+                try:
+                    self._run_batch(reqs, feed)
+                    ran_inline = True
+                finally:
+                    self._dev_lock.release()
+            if not ran_inline:
+                self._inflight.put((reqs, feed))
+                obs.SERVER_INFLIGHT_DEPTH.set(self._inflight.qsize())
+
+    def _device_loop(self):
+        while True:
+            item = self._inflight.get()
+            if item is None:
+                return
+            obs.SERVER_INFLIGHT_DEPTH.set(self._inflight.qsize())
+            reqs, feed = item
+            with self._dev_lock:
+                self._run_batch(reqs, feed)
+
+    def _run_batch(self, reqs, feed):
+        """Device-stage body: one predictor dispatch, responses fanned
+        back out by request id. Caller holds ``_dev_lock``."""
+        t0 = time.perf_counter()
+        try:
+            outs = self.predictor.run(feed, _obs_path="server_batch")
+        except Exception as e:  # fan the error out; keep serving
+            self._fail(reqs, e)
+            return
+        obs.SERVER_STAGE_MS.observe(
+            (time.perf_counter() - t0) * 1e3, stage="device")
+        n = len(reqs)
+        self.batch_size_counts[n] = self.batch_size_counts.get(n, 0) + 1
+        now = time.perf_counter()
+        for i, (rid, _) in enumerate(reqs):
+            fut = self._pop(rid)
+            if fut is not None:  # None: abandoned via cancel/timeout
+                fut.set_result([o[i] for o in outs])
+                obs.PREDICT_LATENCY_MS.observe(
+                    (now - fut._t0) * 1e3, path="server")
+                obs.PREDICT_REQUESTS.inc(path="server")
+
+    def _fail(self, reqs, e):
+        """Error path: every request still gets its latency sample and a
+        failure count, so error rates are visible at /metrics (the old
+        loop fanned the exception out silently)."""
+        now = time.perf_counter()
+        for rid, _ in reqs:
+            obs.PREDICT_FAILURES.inc(path="server")
+            fut = self._pop(rid)
+            if fut is not None:
+                fut.set_exception(e)
+                obs.PREDICT_LATENCY_MS.observe(
+                    (now - fut._t0) * 1e3, path="server")
 
     def _pop(self, rid):
         with self._lock:
@@ -475,16 +752,45 @@ class PredictorServer:
     def stop(self):
         self.stop_http()
         self._chan.close()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
+        # the stacking stage drains the channel, forwards the last
+        # batches, then sends the device stage its None sentinel
+        if self._stack_thread is not None:
+            self._stack_thread.join(timeout=5)
+            self._stack_thread = None
+        if self._dev_thread is not None:
+            self._dev_thread.join(timeout=5)
+            self._dev_thread = None
 
 
 class _Future:
+    """Completion handle for one submitted sample.
+
+    A ``result(timeout)`` that raises TimeoutError ABANDONS the request:
+    its entry in the server's result table is released immediately (the
+    pre-pipeline server leaked it until process exit) and the row's
+    result or error is silently dropped when its batch completes.
+    ``cancel()`` does the same without waiting first.
+    """
+
     def __init__(self):
         self._ev = threading.Event()
         self._val = None
         self._exc = None
+        self._t0 = 0.0
+        self._server = None
+        self._rid = None
+
+    def _bind(self, server, rid):
+        self._server = server
+        self._rid = rid
+
+    def cancel(self):
+        """Drop this request: the server forgets it now and discards its
+        result when the batch completes. A result that already arrived
+        stays readable."""
+        srv, self._server = self._server, None
+        if srv is not None and not self._ev.is_set():
+            srv._pop(self._rid)
 
     def set_result(self, v):
         self._val = v
@@ -494,8 +800,18 @@ class _Future:
         self._exc = e
         self._ev.set()
 
-    def result(self, timeout: Optional[float] = None):
+    def result(self, timeout: Optional[float] = None,
+               cancel_on_timeout: bool = True):
+        """Wait for the row. On timeout the request is ABANDONED (see
+        class docstring) unless ``cancel_on_timeout=False``, which keeps
+        the entry alive for poll-style callers that intend to re-wait."""
         if not self._ev.wait(timeout):
+            if cancel_on_timeout:
+                self.cancel()
+                raise TimeoutError(
+                    "predict result not ready (request abandoned; "
+                    "resubmit to retry, or poll with "
+                    "cancel_on_timeout=False)")
             raise TimeoutError("predict result not ready")
         if self._exc is not None:
             raise self._exc

@@ -34,7 +34,9 @@ __all__ = [
     "CACHE_EVICTIONS", "STEP_LATENCY_MS", "STEPS_TOTAL", "FEED_BYTES",
     "FETCH_BYTES", "RUN_LOOP_WINDOW_STEPS", "READER_PREFETCH_EVENTS",
     "READER_PREFETCH_DEPTH", "PREDICT_LATENCY_MS", "PREDICT_REQUESTS",
-    "PREDICT_BATCH_ROWS", "PROFILER_EVENT_MS", "BENCH_ANOMALY_RETRIES",
+    "PREDICT_BATCH_ROWS", "PREDICT_FAILURES", "PROFILER_EVENT_MS",
+    "BENCH_ANOMALY_RETRIES", "SERVER_ROWS", "SERVER_BUCKET_FILL",
+    "SERVER_INFLIGHT_DEPTH", "SERVER_STAGE_MS",
 ]
 
 # -- the shared instrument set (registered once, process-wide) -----------
@@ -83,6 +85,27 @@ PREDICT_BATCH_ROWS = REGISTRY.histogram(
     "paddle_tpu_predict_batch_rows",
     "Rows per executed predict batch (server: dynamic batch fill)",
     buckets=DEFAULT_SIZE_BUCKETS)
+PREDICT_FAILURES = REGISTRY.counter(
+    "paddle_tpu_predict_failures_total",
+    "Predict requests completed with an error, by path (error rate = "
+    "this / paddle_tpu_predict_requests_total)")
+SERVER_ROWS = REGISTRY.counter(
+    "paddle_tpu_server_rows_total",
+    "Rows through the serving device stage, kind=real|pad "
+    "(pad-waste ratio = pad / (real + pad))")
+SERVER_BUCKET_FILL = REGISTRY.histogram(
+    "paddle_tpu_server_bucket_fill",
+    "Real rows per executed server batch, labeled by the padded bucket "
+    "size it ran at (fill efficiency per compiled signature)",
+    buckets=DEFAULT_SIZE_BUCKETS)
+SERVER_INFLIGHT_DEPTH = REGISTRY.gauge(
+    "paddle_tpu_server_inflight_depth",
+    "Stacked batches waiting for the serving device stage right now "
+    "(0 = device-bound, at capacity = host-bound)")
+SERVER_STAGE_MS = REGISTRY.histogram(
+    "paddle_tpu_server_stage_ms",
+    "Per-batch wall time of each serving pipeline stage "
+    "(stage=stack|device)")
 PROFILER_EVENT_MS = REGISTRY.summary(
     "paddle_tpu_profiler_event_ms",
     "Legacy profiler event table (exact count/sum/min/max per event)")

@@ -11,6 +11,7 @@ import ctypes
 import os
 import pickle
 import struct
+import time
 import zlib
 from typing import Iterator, Optional
 
@@ -300,6 +301,10 @@ class Channel:
 
     def __init__(self, capacity: int = 64):
         self._lib = _load()
+        # close() mirror for the native path: the frozen C ABI has no
+        # is-closed probe, and the deadline poll must stop waiting for
+        # records that can no longer arrive
+        self._py_closed = False
         if self._lib is None:
             import collections
             import threading
@@ -342,28 +347,87 @@ class Channel:
             return None
         return _take(self._lib, buf, n)
 
-    def recv_batch(self, max_n: int) -> Optional[list]:
+    def recv_batch(self, max_n: int,
+                   max_wait_s: Optional[float] = None) -> Optional[list]:
         """Block for the first record, then drain whatever else is queued
-        (up to max_n) without waiting — the C++ dynamic-batching pull
-        (ptrt_chan_recv_batch) behind the predictor serving loop. Returns
-        None once closed and drained."""
+        (up to max_n) — the C++ dynamic-batching pull
+        (ptrt_chan_recv_batch) behind the predictor serving loop. With
+        ``max_wait_s`` set, keep collecting for up to that many seconds
+        after the first record arrives (the serving batching deadline):
+        the call returns as soon as the batch is FULL, so the deadline
+        only costs latency when traffic cannot fill max_n anyway.
+        Returns None once closed and drained."""
         if self._lib is None:
-            with self._cv:
-                while not self._dq and not self._closed:
+            out = self._recv_batch_py(max_n)
+            if out is None:
+                return None
+        else:
+            bufs = (ctypes.POINTER(ctypes.c_char) * max_n)()
+            lens = (ctypes.c_int64 * max_n)()
+            n = self._lib.ptrt_chan_recv_batch(self._h, max_n, bufs, lens)
+            if n <= 0:
+                return None
+            out = [_take(self._lib, bufs[i], lens[i]) for i in range(n)]
+        if not max_wait_s or len(out) >= max_n:
+            return out
+        deadline = time.monotonic() + max_wait_s
+        while len(out) < max_n:
+            if self._lib is None:
+                more = self._recv_batch_py(max_n - len(out),
+                                           deadline=deadline)
+            else:
+                more = self._recv_batch_native_nb(max_n - len(out),
+                                                  deadline=deadline)
+            if more is None:
+                break  # closed (already holding records) or deadline hit
+            out.extend(more)
+        return out
+
+    def _recv_batch_py(self, max_n: int, deadline: Optional[float] = None):
+        """Fallback batch pull: block for the first record (bounded by
+        `deadline` when given), drain up to max_n. None = closed+drained
+        or deadline expired empty-handed."""
+        with self._cv:
+            while not self._dq and not self._closed:
+                if deadline is None:
                     self._cv.wait()
-                if not self._dq:
-                    return None
-                out = []
-                while self._dq and len(out) < max_n:
-                    out.append(self._dq.popleft())
-                self._cv.notify_all()
-                return out
-        bufs = (ctypes.POINTER(ctypes.c_char) * max_n)()
-        lens = (ctypes.c_int64 * max_n)()
-        n = self._lib.ptrt_chan_recv_batch(self._h, max_n, bufs, lens)
-        if n <= 0:
-            return None
-        return [_take(self._lib, bufs[i], lens[i]) for i in range(n)]
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                    self._cv.wait(remaining)
+            if not self._dq:
+                return None
+            out = []
+            while self._dq and len(out) < max_n:
+                out.append(self._dq.popleft())
+            self._cv.notify_all()
+            return out
+
+    def _recv_batch_native_nb(self, max_n: int, deadline: float):
+        """Deadline-bounded pull over the native channel. The C ABI's
+        recv_batch blocks indefinitely for the first record, so this
+        polls qsize and only calls it when records are visibly queued —
+        a closed empty channel or an expired deadline returns None
+        instead of blocking the stacking stage forever."""
+        while True:
+            if self._lib.ptrt_chan_size(self._h) > 0:
+                bufs = (ctypes.POINTER(ctypes.c_char) * max_n)()
+                lens = (ctypes.c_int64 * max_n)()
+                n = self._lib.ptrt_chan_recv_batch(self._h, max_n, bufs,
+                                                   lens)
+                if n <= 0:
+                    return None  # lost the race to close()
+                return [_take(self._lib, bufs[i], lens[i])
+                        for i in range(n)]
+            if self._py_closed:
+                return None  # closed and (per the check above) drained
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            # sub-ms poll: the deadline trades exactly this much timing
+            # slop for not adding a timed variant to the frozen C ABI
+            time.sleep(min(remaining, 5e-4))
 
     def qsize(self) -> int:
         if self._lib is None:
@@ -378,6 +442,10 @@ class Channel:
                 self._cv.notify_all()
         else:
             self._lib.ptrt_chan_close(self._h)
+        # flag set AFTER the native close: a record sent concurrently is
+        # either drained by the deadline poll's qsize check or picked up
+        # by the caller's next recv_batch — never dropped
+        self._py_closed = True
 
     def destroy(self):
         if self._lib is not None and getattr(self, "_h", None):

@@ -1,0 +1,84 @@
+"""Tier-1 CPU smoke of tools/bench_serving.py: a tiny MLP sweep runs in
+seconds and every emitted JSON line matches the schema downstream sweep
+tooling parses — so the serving bench cannot silently rot between device
+windows. The real measurement config is driven by env (see the tool's
+docstring); this pins the CONTRACT, not the numbers."""
+import io
+import json
+import sys
+from contextlib import redirect_stdout
+
+import pytest
+
+_SWEEP_KEYS = {
+    "phase": str, "mode": str, "loop": str, "max_batch": int,
+    "max_wait_ms": float, "in_flight": int, "submitters": int,
+    "requests": int, "rows_per_sec": float, "wall_s": float,
+    "real_rows": int, "pad_rows": int, "pad_waste": float,
+    "batches": int, "mean_fill": float,
+}
+
+_SPEEDUP_KEYS = {
+    "phase": str, "loop": str, "baseline_rows_per_sec": float,
+    "best_rows_per_sec": float, "speedup": float,
+    "baseline_pad_waste": float, "best_pad_waste": float,
+    "best_config": dict,
+}
+
+
+def _check_schema(rec, schema):
+    assert set(rec) == set(schema), (
+        "schema drift: %s vs %s" % (sorted(rec), sorted(schema)))
+    for key, typ in schema.items():
+        if typ is float:
+            assert isinstance(rec[key], (int, float)), (key, rec[key])
+        else:
+            assert isinstance(rec[key], typ), (key, rec[key])
+
+
+def test_bench_serving_smoke(monkeypatch):
+    # tiny everything: 4-dim MLP, one sweep point per knob, 48 requests
+    monkeypatch.setenv("BENCH_SERVING_PLATFORM", "cpu")
+    monkeypatch.setenv("SERVING_DIM", "4")
+    monkeypatch.setenv("SERVING_HIDDEN", "8")
+    monkeypatch.setenv("SERVING_BATCH", "4")
+    monkeypatch.setenv("SERVING_ITERS", "5")
+    monkeypatch.setenv("SERVING_REQUESTS", "48")
+    monkeypatch.setenv("SERVING_SUBMITTERS", "2")
+    monkeypatch.setenv("SERVING_SWEEP_BATCHES", "4")
+    monkeypatch.setenv("SERVING_SWEEP_WAITS_MS", "0")
+    monkeypatch.setenv("SERVING_SWEEP_INFLIGHT", "2")
+    monkeypatch.setenv("SERVING_LOOP_MODES", "open")
+    monkeypatch.syspath_prepend(
+        __file__.rsplit("/tests/", 1)[0] + "/tools")
+    # fresh import so the module-level env reads see the smoke config
+    sys.modules.pop("bench_serving", None)
+    import bench_serving
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        bench_serving.main()
+    lines = [ln for ln in buf.getvalue().splitlines() if ln.strip()]
+    recs = [json.loads(ln) for ln in lines]  # every line is valid JSON
+
+    phases = [r["phase"] for r in recs]
+    assert phases[0] == "predictor_cold_start"
+    assert "predictor_latency" in phases
+
+    sweeps = [r for r in recs if r["phase"] == "server_sweep"]
+    # the padmax baseline row + one bucket row per (wait, depth) point
+    assert len(sweeps) == 2
+    assert {r["mode"] for r in sweeps} == {"padmax", "bucket"}
+    for rec in sweeps:
+        _check_schema(rec, _SWEEP_KEYS)
+        assert rec["real_rows"] == rec["requests"] == 48
+        assert rec["rows_per_sec"] > 0
+        assert 0.0 <= rec["pad_waste"] < 1.0
+        assert rec["batches"] > 0
+
+    speedups = [r for r in recs if r["phase"] == "server_speedup"]
+    assert len(speedups) == 1
+    _check_schema(speedups[0], _SPEEDUP_KEYS)
+    assert speedups[0]["speedup"] > 0
+    assert set(speedups[0]["best_config"]) == {
+        "mode", "max_batch", "max_wait_ms", "in_flight"}

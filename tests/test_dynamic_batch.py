@@ -2,7 +2,12 @@
 a -1 batch dim (append_batch_size=True). Layers that fold the batch size
 into shape arithmetic break on that idiom (ssd_loss did: reshape target
 [-352, 6]); this sweep builds representative graphs with dynamic batch
-and runs them at two different batch sizes through the same program."""
+and runs them at two different batch sizes through the same program.
+The serving analog rides along: pad_batches=False PredictorServer
+traffic produces one compiled signature per DISTINCT batch size."""
+import threading
+import time
+
 import numpy as np
 import pytest
 
@@ -122,6 +127,74 @@ def test_crf_dynamic_batch():
         "lens": r.randint(1, T + 1, b).astype(np.int32)}))
     for (v,) in res:
         assert np.isfinite(np.asarray(v)).all()
+
+
+def test_pad_batches_false_multi_signature_serving(tmp_path):
+    """pad_batches=False serving is the dynamic-batch idiom at the
+    predictor level: every distinct batch size the traffic produces is
+    its own compiled signature, each request's slice must come back
+    correct, and REPEATING a size must hit the compile cache instead of
+    growing it."""
+    from paddle_tpu.inference import Predictor, PredictorServer
+
+    mp, sp = fluid.Program(), fluid.Program()
+    mp.random_seed = sp.random_seed = 7
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(mp, sp):
+        with fluid.unique_name.guard():
+            x = layers.data(name="x", shape=[4])
+            out = layers.fc(layers.fc(x, 8, act="relu"), 3, act="softmax")
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(sp)
+        fluid.io.save_inference_model(str(tmp_path), ["x"], [out], exe,
+                                      main_program=mp, scope=scope)
+        feed = np.linspace(-1, 1, 16).reshape(4, 4).astype(np.float32)
+        want, = exe.run(mp, feed={"x": feed}, fetch_list=[out])
+    want = np.asarray(want)
+
+    p = Predictor(str(tmp_path), preload=False)
+    # the long deadline makes burst membership deterministic: the
+    # stacking stage waits out each burst instead of racing it
+    server = PredictorServer(p, max_batch=4, pad_batches=False,
+                             max_wait_ms=500, prewarm=False)
+    server.start()
+    for burst in (1, 2, 3, 2):  # sizes {1, 2, 3}; the repeat must cache-hit
+        futs = [server.submit((feed[i],)) for i in range(burst)]
+        for i, fut in enumerate(futs):
+            np.testing.assert_allclose(fut.result(timeout=60)[0], want[i],
+                                       rtol=1e-4, atol=1e-5)
+    sizes = {sig[0][1][0] for sig in p._compiled}
+    assert sizes == {1, 2, 3}, sizes
+    assert len(p._compiled) == 3  # exactly one entry per distinct size
+    assert server.batch_size_counts == {1: 1, 2: 2, 3: 1}
+
+    # concurrent submitters: whatever batch sizes the race produces,
+    # every per-request slice is correct and every executed size has
+    # exactly one compile-cache entry
+    errs = []
+
+    def client(cid):
+        try:
+            rs = np.random.RandomState(cid)
+            for _ in range(10):
+                i = int(rs.randint(0, 4))
+                row = server.submit((feed[i],)).result(timeout=60)
+                if not np.allclose(row[0], want[i], rtol=1e-4, atol=1e-5):
+                    errs.append("client %d row %d diverged" % (cid, i))
+        except Exception as e:  # pragma: no cover - failure reporting
+            errs.append("client %d: %r" % (cid, e))
+
+    threads = [threading.Thread(target=client, args=(c,)) for c in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    server.stop()
+    assert not errs, errs
+    executed = set(server.batch_size_counts)
+    compiled = {sig[0][1][0] for sig in p._compiled}
+    assert executed <= compiled <= executed | {1, 2, 3}
+    assert len(p._compiled) == len(compiled)
 
 
 def test_detection_stack_dynamic_batch():

@@ -102,6 +102,80 @@ def test_channel_blocking_and_close():
     ch.destroy()
 
 
+def test_channel_recv_batch_deadline():
+    import time
+
+    ch = runtime.Channel(capacity=16)
+    # full batch returns without waiting out the deadline
+    for i in range(4):
+        ch.send(b"%d" % i)
+    t0 = time.monotonic()
+    out = ch.recv_batch(4, max_wait_s=30.0)
+    assert out == [b"0", b"1", b"2", b"3"]
+    assert time.monotonic() - t0 < 5.0
+
+    # partial batch: the deadline collects stragglers that arrive inside
+    # the window, then returns what it has
+    ch.send(b"a")
+
+    def late_sender():
+        time.sleep(0.05)
+        ch.send(b"b")
+
+    t = threading.Thread(target=late_sender)
+    t.start()
+    out = ch.recv_batch(4, max_wait_s=2.0)
+    t.join()
+    assert out == [b"a", b"b"]
+
+    # deadline expiry returns the partial batch instead of blocking
+    ch.send(b"c")
+    t0 = time.monotonic()
+    out = ch.recv_batch(4, max_wait_s=0.05)
+    assert out == [b"c"]
+    assert time.monotonic() - t0 < 2.0
+
+    # close() during the wait window: what was collected still returns
+    ch.send(b"d")
+
+    def closer():
+        time.sleep(0.05)
+        ch.close()
+
+    t = threading.Thread(target=closer)
+    t.start()
+    out = ch.recv_batch(4, max_wait_s=10.0)
+    t.join()
+    assert out == [b"d"]
+    assert ch.recv_batch(4) is None  # closed and drained
+    ch.destroy()
+
+
+def test_channel_recv_batch_deadline_python_fallback(monkeypatch):
+    """The pure-Python channel must honor the same deadline contract."""
+    import time
+
+    monkeypatch.setattr(rio, "_load", lambda: None)
+    ch = rio.Channel(capacity=16)
+    assert ch._lib is None
+    ch.send(b"a")
+
+    def late_sender():
+        time.sleep(0.05)
+        ch.send(b"b")
+
+    t = threading.Thread(target=late_sender)
+    t.start()
+    out = ch.recv_batch(4, max_wait_s=2.0)
+    t.join()
+    assert out == [b"a", b"b"]
+    ch.send(b"c")
+    out = ch.recv_batch(4, max_wait_s=0.05)
+    assert out == [b"c"]
+    ch.close()
+    assert ch.recv_batch(4) is None
+
+
 def test_staging_arena():
     arena = runtime.StagingArena(1 << 20)
     a = arena.alloc_array((16, 16), np.float32)

@@ -1,0 +1,308 @@
+"""Pipelined PredictorServer tests: bucket padding + pre-warm, the
+max_wait_ms batching deadline, the zero-copy request frame, abandoned
+futures (timeout/cancel cleanup), and error-path metrics. Companion to
+tests/test_inference.py (which covers the AOT predictor itself)."""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu import observability as obs
+from paddle_tpu.inference import (Predictor, PredictorServer,
+                                  _decode_request, _encode_request)
+
+
+def _save_model(tmp_path, dim=4, seed=5):
+    mp, sp = fluid.Program(), fluid.Program()
+    mp.random_seed = sp.random_seed = seed
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(mp, sp):
+        with fluid.unique_name.guard():
+            x = layers.data(name="x", shape=[dim])
+            h = layers.fc(x, 8, act="relu")
+            out = layers.fc(h, 3, act="softmax")
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(sp)
+        fluid.io.save_inference_model(str(tmp_path), ["x"], [out], exe,
+                                      main_program=mp, scope=scope)
+        feed = np.linspace(-1, 1, 3 * dim).reshape(3, dim).astype(np.float32)
+        want, = exe.run(mp, feed={"x": feed}, fetch_list=[out])
+    return feed, np.asarray(want)
+
+
+# -- zero-copy request frame ----------------------------------------------
+
+def test_request_frame_roundtrip():
+    rows = [np.arange(12, dtype=np.float32).reshape(3, 4),
+            np.array([7, -1], dtype=np.int64),
+            np.float32(2.5) * np.ones((), np.float32),  # 0-d scalar row
+            np.zeros((0, 2), np.float64)]  # empty row edge case
+    rid, back = _decode_request(_encode_request(123456789, rows))
+    assert rid == 123456789
+    assert len(back) == len(rows)
+    for a, b in zip(rows, back):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        np.testing.assert_array_equal(a, b)
+
+
+def test_request_frame_pickle_fallback():
+    import pickle
+
+    rows = [np.array([1, 2], np.int32)]
+    rid, back = _decode_request(b"P" + pickle.dumps((42, rows), protocol=4))
+    assert rid == 42
+    np.testing.assert_array_equal(back[0], rows[0])
+
+
+def test_submit_noncontiguous_and_object_samples(tmp_path):
+    """A non-contiguous row is made contiguous for the frame; an
+    object-dtype sample falls back to pickle — both must serve
+    correctly."""
+    feed, want = _save_model(tmp_path)
+    p = Predictor(str(tmp_path))
+    server = PredictorServer(p, max_batch=4)
+    server.start()
+    wide = np.ascontiguousarray(
+        np.stack([feed[0], feed[0]]).T)  # (4, 2): columns are rows
+    fut = server.submit((wide[:, 0],))  # stride-2 view, not contiguous
+    np.testing.assert_allclose(fut.result(timeout=60)[0], want[0],
+                               rtol=1e-4, atol=1e-5)
+    obj = np.empty((), dtype=object)
+    obj[()] = feed[1].tolist()  # decays to a list -> pickle path
+    fut = server.submit((np.asarray(feed[1], dtype=np.float32),))
+    np.testing.assert_allclose(fut.result(timeout=60)[0], want[1],
+                               rtol=1e-4, atol=1e-5)
+    server.stop()
+
+
+# -- bucket padding + pre-warm --------------------------------------------
+
+def test_bucket_prewarm_no_compile_in_traffic(tmp_path):
+    feed, want = _save_model(tmp_path)
+    p = Predictor(str(tmp_path), preload=False)
+    server = PredictorServer(p, max_batch=8)
+    assert server.buckets == [1, 2, 4, 8]
+    server.start()
+    # every bucket signature is resident BEFORE any request
+    sizes = {sig[0][1][0] for sig in p._compiled}
+    assert sizes == {1, 2, 4, 8}
+    traces_after_warm = p.traces
+    futs = [server.submit((feed[i % 3],)) for i in range(11)]
+    for i, fut in enumerate(futs):
+        np.testing.assert_allclose(fut.result(timeout=60)[0], want[i % 3],
+                                   rtol=1e-4, atol=1e-5)
+    server.stop()
+    # live traffic hit only pre-warmed bucket signatures: zero new traces
+    assert p.traces == traces_after_warm
+    assert {sig[0][1][0] for sig in p._compiled} == {1, 2, 4, 8}
+
+
+def test_non_pow2_max_batch_is_a_bucket(tmp_path):
+    _save_model(tmp_path)
+    p = Predictor(str(tmp_path), preload=False)
+    server = PredictorServer(p, max_batch=6, prewarm=False)
+    assert server.buckets == [1, 2, 4, 6]
+    assert server._bucket_for(5) == 6
+    assert server._bucket_for(1) == 1
+
+
+def test_pad_rows_metrics(tmp_path):
+    feed, _ = _save_model(tmp_path)
+    p = Predictor(str(tmp_path))
+    server = PredictorServer(p, max_batch=8)
+    server.start()
+    real0 = obs.SERVER_ROWS.value(kind="real")
+    # 3 rows in one burst -> bucket 4: exactly 1 pad row, 3 real
+    pad0 = obs.SERVER_ROWS.value(kind="pad")
+    futs = [server.submit((feed[i],)) for i in range(3)]
+    for f in futs:
+        f.result(timeout=60)
+    server.stop()
+    assert obs.SERVER_ROWS.value(kind="real") - real0 == 3
+    # pad rows bounded by the bucket distance actually taken (the burst
+    # may split across batches, but never pads past the next bucket)
+    assert 0 <= obs.SERVER_ROWS.value(kind="pad") - pad0 <= 3
+
+
+# -- batching deadline ----------------------------------------------------
+
+def test_deadline_single_request_completes(tmp_path):
+    """With max_wait_ms set and a single slow submitter, the request
+    completes within deadline + one model step — it must NOT wait for a
+    full batch that will never arrive."""
+    feed, want = _save_model(tmp_path)
+    p = Predictor(str(tmp_path))
+    server = PredictorServer(p, max_batch=8, max_wait_ms=100)
+    server.start()
+    t0 = time.perf_counter()
+    row = server.submit((feed[0],)).result(timeout=30)
+    elapsed = time.perf_counter() - t0
+    server.stop()
+    np.testing.assert_allclose(row[0], want[0], rtol=1e-4, atol=1e-5)
+    # deadline (0.1 s) + one model step + generous CI slack, NOT 30 s
+    assert elapsed < 10.0
+
+
+def test_deadline_coalesces_slow_submitters(tmp_path):
+    """Requests trickling in within the deadline window ride ONE batch
+    instead of one batch each."""
+    feed, want = _save_model(tmp_path)
+    p = Predictor(str(tmp_path))
+    server = PredictorServer(p, max_batch=8, max_wait_ms=600,
+                             pad_batches=False, prewarm=False)
+    server.start()
+    futs = [server.submit((feed[0],))]
+    time.sleep(0.05)
+    futs.append(server.submit((feed[1],)))
+    time.sleep(0.05)
+    futs.append(server.submit((feed[2],)))
+    for i, f in enumerate(futs):
+        np.testing.assert_allclose(f.result(timeout=30)[0], want[i],
+                                   rtol=1e-4, atol=1e-5)
+    server.stop()
+    # all three coalesced: the largest executed batch saw every row
+    assert max(server.batch_size_counts) == 3, server.batch_size_counts
+
+
+def test_deadline_returns_early_when_full(tmp_path):
+    """A full batch must dispatch immediately — the deadline is an upper
+    bound on waiting, never a floor."""
+    feed, _ = _save_model(tmp_path)
+    p = Predictor(str(tmp_path))
+    server = PredictorServer(p, max_batch=2, max_wait_ms=5000)
+    server.start()
+    t0 = time.perf_counter()
+    futs = [server.submit((feed[i],)) for i in range(2)]
+    for f in futs:
+        f.result(timeout=30)
+    elapsed = time.perf_counter() - t0
+    server.stop()
+    assert elapsed < 4.0, "full batch waited for the deadline"
+
+
+# -- abandoned futures (the _Future leak fix) -----------------------------
+
+def test_timeout_abandons_request(tmp_path):
+    feed, _ = _save_model(tmp_path)
+    p = Predictor(str(tmp_path))
+    server = PredictorServer(p, max_batch=4, prewarm=False)
+    # server NOT started: the request can never complete
+    fut = server.submit((feed[0],))
+    with pytest.raises(TimeoutError):
+        fut.result(timeout=0.05)
+    assert server._results == {}, "timed-out entry leaked"
+    # the abandoned request is dropped when its batch completes; later
+    # requests are unaffected
+    server.start()
+    fut2 = server.submit((feed[1],))
+    fut2.result(timeout=60)
+    server.stop()
+    assert server._results == {}
+
+
+def test_cancel_releases_entry_and_keeps_arrived_result(tmp_path):
+    feed, want = _save_model(tmp_path)
+    p = Predictor(str(tmp_path))
+    server = PredictorServer(p, max_batch=4, prewarm=False)
+    fut = server.submit((feed[0],))
+    assert len(server._results) == 1
+    fut.cancel()
+    assert server._results == {}
+    # a future whose result already arrived stays readable after cancel
+    server.start()
+    fut2 = server.submit((feed[0],))
+    row = fut2.result(timeout=60)
+    fut2.cancel()
+    np.testing.assert_allclose(fut2.result(timeout=1)[0], row[0])
+    server.stop()
+
+
+# -- error-path metrics ---------------------------------------------------
+
+def test_error_path_records_failures_and_latency(tmp_path):
+    feed, _ = _save_model(tmp_path)
+    p = Predictor(str(tmp_path))
+    server = PredictorServer(p, max_batch=4, prewarm=False)
+
+    def boom(feed, **kwargs):
+        raise RuntimeError("device on fire")
+
+    server.predictor = type("P", (), {"run": staticmethod(boom)})()
+    fails0 = obs.PREDICT_FAILURES.value(path="server")
+    lat0 = obs.PREDICT_LATENCY_MS.stats(path="server")["count"]
+    server.start()
+    futs = [server.submit((feed[i % 3],)) for i in range(3)]
+    errs = 0
+    for f in futs:
+        with pytest.raises(RuntimeError, match="device on fire"):
+            f.result(timeout=60)
+        errs += 1
+    server.stop()
+    assert errs == 3
+    assert obs.PREDICT_FAILURES.value(path="server") - fails0 == 3
+    # failed requests still get a latency sample (queue wait included)
+    assert obs.PREDICT_LATENCY_MS.stats(path="server")["count"] - lat0 == 3
+
+
+def test_mismatched_row_shapes_fail_the_batch(tmp_path):
+    """Rows of different shapes cannot batch: every request in the
+    broken batch gets the error (old np.stack contract — never a
+    silently broadcast wrong batch), and the server keeps serving."""
+    feed, want = _save_model(tmp_path)
+    p = Predictor(str(tmp_path))
+    server = PredictorServer(p, max_batch=4, max_wait_ms=300,
+                             prewarm=False)
+    server.start()
+    f_ok = server.submit((feed[0],))          # shape (4,)
+    f_bad = server.submit((feed[0][:2],))     # shape (2,): can't batch
+    results = []
+    for f in (f_ok, f_bad):
+        try:
+            results.append(f.result(timeout=60))
+        except Exception as e:
+            results.append(e)
+    # at least the mismatched row failed; no silent wrong answers
+    assert any(isinstance(r, Exception) for r in results)
+    for r in results:
+        if not isinstance(r, Exception):
+            np.testing.assert_allclose(r[0], want[0], rtol=1e-4,
+                                       atol=1e-5)
+    # the server survived: a fresh request still serves
+    np.testing.assert_allclose(
+        server.submit((feed[1],)).result(timeout=60)[0], want[1],
+        rtol=1e-4, atol=1e-5)
+    server.stop()
+
+
+# -- pipeline under load --------------------------------------------------
+
+def test_concurrent_submitters_all_rows_correct(tmp_path):
+    feed, want = _save_model(tmp_path)
+    p = Predictor(str(tmp_path))
+    server = PredictorServer(p, max_batch=4, in_flight=4)
+    server.start()
+    errs = []
+
+    def client(cid):
+        try:
+            rs = np.random.RandomState(cid)
+            for _ in range(25):
+                i = rs.randint(0, 3)
+                row = server.submit((feed[i],)).result(timeout=60)
+                if not np.allclose(row[0], want[i], rtol=1e-4, atol=1e-5):
+                    errs.append("client %d row %d diverged" % (cid, i))
+        except Exception as e:  # pragma: no cover - failure reporting
+            errs.append("client %d: %r" % (cid, e))
+
+    threads = [threading.Thread(target=client, args=(c,)) for c in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    server.stop()
+    assert not errs, errs

@@ -6,10 +6,17 @@ NativePredictor whose cold-start/per-call costs this tool records for
 our AOT predictor, PredictorServer, and (via runtime/capi_test.c's
 bench mode) the pure-C ABI.
 
-Prints one JSON line per phase:
+Prints one JSON line per phase / sweep config:
   {"phase": "predictor_cold_start", ...}
   {"phase": "predictor_latency", ...}
-  {"phase": "server_throughput", ...}
+  {"phase": "server_sweep", "mode": "padmax"|"bucket", ...}   one per config
+  {"phase": "server_speedup", ...}   best bucket config vs padmax baseline
+
+The server sweep crosses PredictorServer's batching knobs — padding
+policy (legacy pad-to-max vs power-of-two buckets), `max_wait_ms`
+batching deadline, and in-flight pipeline depth — at a fixed submitter
+count, reporting rows/s plus the pad-waste ratio (padded rows / device
+rows) straight from the serving metrics.
 
 Usage:
   python tools/bench_serving.py            # CPU (forced)
@@ -17,6 +24,9 @@ Usage:
 
 The model is the MLP the C ABI test embeds (16->128->10 softmax) at
 SERVING_BATCH (default 8); adjust with SERVING_DIM / SERVING_HIDDEN.
+Sweep grid: SERVING_SWEEP_BATCHES / SERVING_SWEEP_WAITS_MS /
+SERVING_SWEEP_INFLIGHT (comma lists), SERVING_SUBMITTERS,
+SERVING_REQUESTS.
 """
 from __future__ import annotations
 
@@ -129,43 +139,128 @@ def main():
            "run_ms_p99": round(times[p99_idx], 3),
            "iters": iters})
 
-    # -- PredictorServer dynamic-batching throughput ---------------------
+    # -- PredictorServer batching sweep: policy x deadline x in-flight ---
+    from paddle_tpu import observability as obs
+
+    n_req = int(os.environ.get("SERVING_REQUESTS", 2000))
+    submitters = int(os.environ.get("SERVING_SUBMITTERS", 4))
+    batches = _int_list("SERVING_SWEEP_BATCHES", "8,32")
+    waits = _float_list("SERVING_SWEEP_WAITS_MS", "0,2")
+    depths = _int_list("SERVING_SWEEP_INFLIGHT", "1,4")
+    rows = [np.random.RandomState(i % 7).randn(DIM).astype(np.float32)
+            for i in range(8)]
+
+    # closed loop = each submitter waits for its row before the next one
+    # (arrival-limited PARTIAL fill, where padding policy dominates);
+    # open loop = submitters flood as fast as they can (full batches,
+    # where the pipeline + zero-copy path dominates)
+    loops = [v for v in os.environ.get("SERVING_LOOP_MODES",
+                                       "closed,open").split(",") if v]
+    baseline = {}
+    best = {}
+    for loop in loops:
+        for max_batch in batches:
+            configs = [("padmax", 0.0, 1)]  # pre-pipeline pad-to-max policy
+            configs += [("bucket", w, d) for w in waits for d in depths]
+            for mode, wait_ms, in_flight in configs:
+                rec = _run_server_config(
+                    PredictorServer, p2, obs, mode=mode, loop=loop,
+                    max_batch=max_batch, wait_ms=wait_ms,
+                    in_flight=in_flight, n_req=n_req,
+                    submitters=submitters, rows=rows)
+                _emit(rec)
+                if mode == "padmax":
+                    baseline[(loop, max_batch)] = rec
+                if mode == "bucket" and (loop not in best
+                                         or rec["rows_per_sec"]
+                                         > best[loop]["rows_per_sec"]):
+                    best[loop] = rec
+
+    for loop in loops:
+        top = best.get(loop)
+        # compare against the padmax baseline at the SAME max_batch, so
+        # the reported speedup isolates the padding policy instead of
+        # conflating it with the batch-size choice
+        base = baseline.get((loop, top["max_batch"])) if top else None
+        if not (base and top):
+            continue
+        _emit({"phase": "server_speedup", "loop": loop,
+               "baseline_rows_per_sec": base["rows_per_sec"],
+               "best_rows_per_sec": top["rows_per_sec"],
+               "speedup": round(top["rows_per_sec"]
+                                / max(base["rows_per_sec"], 1e-9), 3),
+               "baseline_pad_waste": base["pad_waste"],
+               "best_pad_waste": top["pad_waste"],
+               "best_config": {k: top[k] for k in
+                               ("mode", "max_batch", "max_wait_ms",
+                                "in_flight")}})
+
+
+def _int_list(env, default):
+    return [int(v) for v in os.environ.get(env, default).split(",") if v]
+
+
+def _float_list(env, default):
+    return [float(v) for v in os.environ.get(env, default).split(",") if v]
+
+
+def _run_server_config(server_cls, pred, obs, *, mode, loop, max_batch,
+                       wait_ms, in_flight, n_req, submitters, rows):
+    """One sweep point: serve n_req single-row requests from `submitters`
+    concurrent threads and read the pad accounting back out of the
+    serving metrics (registry delta over the timed window)."""
     import threading
 
-    for max_batch in (8, 32):
-        server = PredictorServer(p2, max_batch=max_batch)
-        server.start()
-        n_req = int(os.environ.get("SERVING_REQUESTS", 2000))
-        rows = [np.random.RandomState(i % 7).randn(DIM).astype(np.float32)
-                for i in range(8)]
-        # warm the padded-batch signature (one XLA compile) off the clock
-        for f in [server.submit((rows[0],)) for _ in range(max_batch)]:
-            f.result()
-        futs = []
-        t0 = time.perf_counter()
+    kwargs = dict(max_batch=max_batch, max_wait_ms=wait_ms,
+                  in_flight=in_flight)
+    if mode == "padmax":
+        kwargs["buckets"] = [max_batch]  # every batch pads to max_batch
+    server = server_cls(pred, **kwargs)
+    server.start()
+    # off the clock: fill the pipeline once (bucket signatures are
+    # already pre-warmed by start(), this warms the thread handoff)
+    for f in [server.submit((rows[0],)) for _ in range(max_batch)]:
+        f.result(timeout=300)
+    real0 = obs.SERVER_ROWS.value(kind="real")
+    pad0 = obs.SERVER_ROWS.value(kind="pad")
+    server.batch_size_counts.clear()
+    futs = [[] for _ in range(submitters)]
+    t0 = time.perf_counter()
 
-        def feed_requests(k0, k1):
-            local = []
-            for i in range(k0, k1):
-                local.append(server.submit((rows[i % 8],)))
-            futs.extend(local)
+    def feed_requests(k):
+        local = futs[k]
+        for i in range(k * n_req // submitters,
+                       (k + 1) * n_req // submitters):
+            fut = server.submit((rows[i % len(rows)],))
+            local.append(fut)
+            if loop == "closed":
+                fut.result(timeout=300)
 
-        threads = [threading.Thread(target=feed_requests,
-                                    args=(k * n_req // 4,
-                                          (k + 1) * n_req // 4))
-                   for k in range(4)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        for f in futs:
-            f.result()
-        dt = time.perf_counter() - t0
-        server.stop()
-        _emit({"phase": "server_throughput", "max_batch": max_batch,
-               "requests": n_req, "concurrency": 4,
-               "rows_per_sec": round(n_req / dt, 1),
-               "wall_s": round(dt, 3)})
+    threads = [threading.Thread(target=feed_requests, args=(k,))
+               for k in range(submitters)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for chunk in futs:
+        for f in chunk:
+            f.result(timeout=300)
+    dt = time.perf_counter() - t0
+    real = obs.SERVER_ROWS.value(kind="real") - real0
+    pad = obs.SERVER_ROWS.value(kind="pad") - pad0
+    counts = dict(server.batch_size_counts)
+    server.stop()
+    n_batches = sum(counts.values())
+    return {"phase": "server_sweep", "mode": mode, "loop": loop,
+            "max_batch": max_batch,
+            "max_wait_ms": wait_ms, "in_flight": in_flight,
+            "submitters": submitters, "requests": n_req,
+            "rows_per_sec": round(n_req / dt, 1), "wall_s": round(dt, 3),
+            "real_rows": int(real), "pad_rows": int(pad),
+            "pad_waste": round(pad / max(real + pad, 1), 4),
+            "batches": n_batches,
+            "mean_fill": round(sum(k * v for k, v in counts.items())
+                               / n_batches, 2) if n_batches else 0.0}
 
 
 if __name__ == "__main__":
