@@ -674,6 +674,45 @@ def bench_deepfm(dev):
     }
 
 
+def _input_pipeline_metric():
+    """Host-side input-pipeline throughput (tools/bench_dataloader.py
+    quick_metric): batches/s through the multiprocess shared-memory
+    DataLoader on a decode-heavy synthetic workload, with the threaded
+    xmap_readers rate as baseline. Pure host measurement — no device
+    required, so it reports even when the probe fails (the one series a
+    tunnel-dead round can still bank). BENCH_INPUT_PIPELINE=0 skips."""
+    import sys as _s
+
+    tools_dir = _os.path.join(
+        _os.path.dirname(_os.path.abspath(__file__)), "tools")
+    if tools_dir not in _s.path:
+        _s.path.insert(0, tools_dir)
+    import bench_dataloader
+
+    return bench_dataloader.quick_metric(
+        workers=int(_os.environ.get("BENCH_IP_WORKERS", 0)) or None,
+        sample_kb=int(_os.environ.get("BENCH_IP_SAMPLE_KB", 16)),
+        batch=int(_os.environ.get("BENCH_IP_BATCH", 16)),
+        n_batches=int(_os.environ.get("BENCH_IP_BATCHES", 48)))
+
+
+def _emit_input_pipeline():
+    """Measure + print the input-pipeline metric as its OWN JSON line
+    (never the last line: the driver parses the final line as the device
+    metric). Returns the phase dict to attach to the main result."""
+    if _os.environ.get("BENCH_INPUT_PIPELINE", "1") != "1":
+        return None
+    try:
+        ip = _input_pipeline_metric()
+    except Exception as e:  # the host metric must never cost the bench
+        ip = {"error": repr(e)[:200]}
+    line = {"metric": "input_pipeline_batches_per_sec",
+            "value": ip.get("batches_per_sec"), "unit": "batches/s"}
+    line.update({k: v for k, v in ip.items() if k != "batches_per_sec"})
+    print(json.dumps(line), flush=True)
+    return ip
+
+
 def _probe_device(timeout_s: int):
     """Check (in a subprocess, so a hang can be killed) that the backend
     answers a trivial computation. The axon TPU tunnel can wedge on a
@@ -964,11 +1003,17 @@ def main():
     if problem is None and probe_s > 0:
         problem = _bthd_smoke_gate()
     if problem is not None:
+        # the input pipeline is host-measurable: emit its line FIRST so
+        # the device-metric error line stays last (the driver parses the
+        # final line) — a tunnel-dead round still banks a non-null series
+        ip = _emit_input_pipeline()
         err = {
             "metric": "transformer_lm_train_tokens_per_sec_per_chip",
             "value": None, "unit": "tokens/s", "vs_baseline": None,
             "error": "device backend unreachable: " + problem,
         }
+        if ip is not None:
+            err["input_pipeline"] = ip
         # value stays null (no fresh hardware number), but carry the last
         # successful on-device capture from this checkout as CONTEXT so a
         # tunnel-dead driver run still records what the chip measured
@@ -1019,6 +1064,9 @@ def main():
             "note": "BENCH_LM=0 (secondary-phase row)",
             "device": getattr(dev, "device_kind", dev.platform),
         }
+    ip = _emit_input_pipeline()
+    if ip is not None:
+        result["input_pipeline"] = ip
     for name, phase in _phase_list():
         # flush what we have before each risky phase: if it is killed
         # (timeout through the TPU tunnel), the flushed line is still the

@@ -57,6 +57,7 @@ from .framework import (  # noqa: F401
 )
 from .executor import Executor  # noqa: F401
 from .io.reader import EOFException  # noqa: F401  (reference: core.EOFException)
+from .io.dataloader import DataLoader  # noqa: F401  (multiprocess input fast path)
 from .backward import append_backward  # noqa: F401
 from . import layers  # noqa: F401
 from . import nets  # noqa: F401

@@ -9,7 +9,7 @@ import numpy as np
 
 __all__ = [
     "resize_short", "to_chw", "center_crop", "random_crop", "left_right_flip",
-    "simple_transform",
+    "simple_transform", "SimpleTransform",
 ]
 
 
@@ -64,6 +64,64 @@ def random_crop(im: np.ndarray, size: int, is_color: bool = True,
 
 def left_right_flip(im: np.ndarray, is_color: bool = True) -> np.ndarray:
     return im[:, ::-1]
+
+
+class SimpleTransform:
+    """Picklable simple_transform closure for worker processes: the
+    io.DataLoader (and spawn/forkserver multiprocessing generally) must
+    pickle the per-sample mapper, which a lambda or nested function
+    cannot cross. Maps ``(image, label) -> (chw_float32, label)``; extra
+    tuple elements pass through untouched.
+
+        mapper = image.SimpleTransform(256, 224, is_train=True, seed=1)
+        loader.decorate_sample_reader(raw_reader, batch_size, mapper=mapper)
+
+    Augmentation randomness is seeded per PROCESS (seed mixed with the
+    pid), so parallel workers don't replay identical crop/flip draws.
+    """
+
+    def __init__(self, resize_size: int, crop_size: int, is_train: bool,
+                 is_color: bool = True, mean=None, seed=None):
+        self.resize_size = resize_size
+        self.crop_size = crop_size
+        self.is_train = is_train
+        self.is_color = is_color
+        self.mean = None if mean is None else np.asarray(mean, np.float32)
+        self.seed = seed
+        self._rng = None  # created lazily, per process
+        self._rng_pid = None
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["_rng"] = None  # RandomState must not cross the boundary
+        state["_rng_pid"] = None
+        return state
+
+    def _rng_for_process(self):
+        import os
+
+        pid = os.getpid()
+        if self._rng is None or self._rng_pid != pid:
+            # keyed on the CURRENT pid, not just lazily created: a
+            # fork-started worker inherits an already-initialized _rng
+            # (fork skips __getstate__), and siblings replaying the
+            # parent's stream would emit identical augmentation draws
+            base = self.seed if self.seed is not None else 0
+            self._rng = np.random.RandomState(
+                (base * 1000003 + pid) % (2 ** 31))
+            self._rng_pid = pid
+        return self._rng
+
+    def __call__(self, sample):
+        if isinstance(sample, tuple):
+            im, rest = sample[0], sample[1:]
+        else:
+            im, rest = sample, ()
+        out = simple_transform(np.asarray(im), self.resize_size,
+                               self.crop_size, self.is_train,
+                               is_color=self.is_color, mean=self.mean,
+                               rng=self._rng_for_process())
+        return (out,) + rest
 
 
 def simple_transform(im: np.ndarray, resize_size: int, crop_size: int,

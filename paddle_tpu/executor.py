@@ -517,6 +517,7 @@ class Executor:
         that closed the window early, or None."""
         from .io.reader import EOFException  # local: io imports executor
 
+        t_pull = time.perf_counter()
         op_windows = []
         eof_exc = None
         for op in read_ops:
@@ -527,7 +528,14 @@ class Executor:
                 try:
                     b = self._next_batch(holder)
                 except EOFException as e:
-                    eof_exc = e
+                    # tracebackless copy: the exception may be STORED in
+                    # the prefetch slot until the next call raises it,
+                    # and a live traceback pins the whole calling frame
+                    # chain (run_loop's locals — including the consumed
+                    # window's batch views) in a refcount CYCLE only the
+                    # cyclic GC would free. A zero-copy DataLoader slot
+                    # held hostage by that cycle starves its worker.
+                    eof_exc = e.with_traceback(None)
                     break
                 if batches and any(
                         np.shape(b[o]) != np.shape(batches[0][o])
@@ -544,6 +552,11 @@ class Executor:
             for b in reversed(batches[k:]):
                 self._push_back(holder, b)
             del batches[k:]
+        # input-starvation accounting: host time blocked on the reader
+        # pipeline before this window could dispatch (compare against
+        # step latency to tell input-bound from compute-bound)
+        obs.READER_PULL_MS.inc((time.perf_counter() - t_pull) * 1e3,
+                               kind="loop")
         return op_windows, k, eof_exc
 
     def _stack_reader_window(self, gb, op_windows, k, stage):
@@ -650,12 +663,18 @@ class Executor:
         # A window run_loop prefetched but never consumed goes back to
         # the holders first, so this step sees batches in pipeline order.
         self._flush_reader_prefetch(program)
-        for op in self._read_ops_for(program, gb):
-            holder = self._holder_for(gb, op)
-            batch = self._next_batch(holder)
-            for out_name in op.output("Out"):
-                var = self._feed_var_for(program, gb, out_name)
-                feed_arrays[out_name] = _as_feed_array(batch[out_name], var)
+        run_read_ops = self._read_ops_for(program, gb)
+        if run_read_ops:
+            t_pull = time.perf_counter()
+            for op in run_read_ops:
+                holder = self._holder_for(gb, op)
+                batch = self._next_batch(holder)
+                for out_name in op.output("Out"):
+                    var = self._feed_var_for(program, gb, out_name)
+                    feed_arrays[out_name] = _as_feed_array(batch[out_name],
+                                                           var)
+            obs.READER_PULL_MS.inc((time.perf_counter() - t_pull) * 1e3,
+                                   kind="run")
         feed_sig = tuple(
             (name, arr.shape, str(arr.dtype)) for name, arr in sorted(feed_arrays.items())
         )
@@ -898,9 +917,22 @@ class Executor:
                 }
                 obs.READER_PREFETCH_EVENTS.inc(event="staged")
             except Exception as e:  # noqa: BLE001 — deferred, not dropped
+                import traceback as _tb
+
+                # tracebackless for the same frame-cycle reason as the
+                # _pull_reader_window EOF capture — but a REAL error's
+                # diagnostics must survive the deferral, so the formatted
+                # original traceback rides along as the __cause__ (plain
+                # string payload: no frame objects, no cycle)
+                if e.__traceback__ is not None and e.__cause__ is None:
+                    e.__cause__ = RuntimeError(
+                        "original traceback (deferred from reader "
+                        "prefetch):\n" + "".join(_tb.format_exception(
+                            type(e), e, e.__traceback__)).rstrip())
                 self._reader_prefetch[program] = {
                     "version": program._version, "steps": steps, "k": 0,
-                    "eof": e, "op_windows": [], "feeds": None,
+                    "eof": e.with_traceback(None), "op_windows": [],
+                    "feeds": None,
                 }
                 obs.READER_PREFETCH_EVENTS.inc(event="error")
             obs.READER_PREFETCH_DEPTH.set(len(self._reader_prefetch),

@@ -25,7 +25,6 @@ import hashlib
 import os
 import pickle
 import queue
-import struct
 import threading
 import time
 import warnings
@@ -39,6 +38,7 @@ from . import observability as obs
 from .framework.core import Program
 from .framework.scope import Scope
 from .framework.trace import RngStream, trace_block
+from .runtime import recordio as _rio
 
 __all__ = ["Predictor", "PredictorServer", "create_paddle_predictor"]
 
@@ -369,61 +369,16 @@ def create_paddle_predictor(config_or_dir, **kwargs) -> Predictor:
 # -- request wire format --------------------------------------------------
 #
 # Zero-copy frame (fast path): contiguous numeric sample arrays ride the
-# channel as
-#   b"Z" | rid u64 | nslots u32 | per slot:
-#     dtype-str len u8 | numpy dtype.str (endianness included) |
-#     ndim u8 | shape i64 x ndim | nbytes i64 | raw row bytes
-# The stacking stage reconstructs each row as an ``np.frombuffer`` VIEW
-# over the received message — no pickle object graph is built on either
-# side of the channel. Samples the frame cannot carry (object / record
+# channel as the shared array-frame layout from runtime/recordio.py
+# (b"Z" | rid u64 | nslots u32 | per-slot dtype/shape/bytes — the SAME
+# layout the DataLoader writes into its shared-memory slots). The
+# stacking stage reconstructs each row as an ``np.frombuffer`` VIEW over
+# the received message — no pickle object graph is built on either side
+# of the channel. Samples the frame cannot carry (object / record
 # dtypes) fall back to the pickled form, prefixed b"P".
 
-_ZC_HDR = struct.Struct("<BQI")
-_ZC_U8 = struct.Struct("<B")
-_ZC_I64 = struct.Struct("<q")
-
-
-def _encode_request(rid: int, rows: Sequence[np.ndarray]) -> bytes:
-    parts = [_ZC_HDR.pack(0x5A, rid, len(rows))]
-    for a in rows:
-        ds = a.dtype.str.encode("ascii")
-        parts.append(_ZC_U8.pack(len(ds)))
-        parts.append(ds)
-        parts.append(_ZC_U8.pack(a.ndim))
-        parts.append(struct.pack("<%dq" % a.ndim, *a.shape))
-        parts.append(_ZC_I64.pack(a.nbytes))
-        # memoryview.cast rejects 0-d and zero-size views; tobytes there
-        # copies at most one scalar
-        parts.append(memoryview(a).cast("B") if a.ndim and a.size
-                     else a.tobytes())
-    return b"".join(parts)
-
-
-def _decode_request(msg: bytes):
-    """(rid, [row arrays]) back from either wire form; zero-copy rows
-    are read-only views over ``msg`` (np.stack copies them exactly once,
-    straight into the batch)."""
-    if msg[:1] == b"P":
-        return pickle.loads(memoryview(msg)[1:])
-    mv = memoryview(msg)
-    _magic, rid, nslots = _ZC_HDR.unpack_from(mv, 0)
-    off = _ZC_HDR.size
-    rows = []
-    for _ in range(nslots):
-        (dlen,) = _ZC_U8.unpack_from(mv, off)
-        off += 1
-        dt = np.dtype(bytes(mv[off:off + dlen]).decode("ascii"))
-        off += dlen
-        (ndim,) = _ZC_U8.unpack_from(mv, off)
-        off += 1
-        shape = struct.unpack_from("<%dq" % ndim, mv, off) if ndim else ()
-        off += 8 * ndim
-        (nbytes,) = _ZC_I64.unpack_from(mv, off)
-        off += 8
-        count = nbytes // dt.itemsize if dt.itemsize else 0
-        rows.append(np.frombuffer(mv, dt, count, off).reshape(shape))
-        off += nbytes
-    return rid, rows
+_encode_request = _rio.encode_frame
+_decode_request = _rio.decode_frame
 
 
 class PredictorServer:

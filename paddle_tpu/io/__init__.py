@@ -49,6 +49,7 @@ __all__ = [
     "get_parameter_value_by_name",
     "save_sharded_checkpoint",
     "load_sharded_checkpoint",
+    "DataLoader",
 ]
 
 _MODEL_FILE = "__model__"
@@ -417,3 +418,6 @@ def load_sharded_checkpoint(
 # reader-op pipeline (py_reader / double_buffer / recordio readers)
 from . import reader  # noqa: E402,F401
 from .reader import EOFException  # noqa: E402,F401
+# multiprocess input fast path (shared-memory zero-copy batches)
+from . import dataloader  # noqa: E402,F401
+from .dataloader import DataLoader  # noqa: E402,F401

@@ -13,11 +13,12 @@ from __future__ import annotations
 from ..framework import unique_name
 from ..framework.core import default_main_program, default_startup_program
 from ..framework.dtypes import convert_dtype
+from ..io import dataloader as dataloader_mod
 from ..io import reader as reader_mod
 
-__all__ = ["data", "py_reader", "read_file", "open_recordio_file",
-           "open_files", "batch", "double_buffer", "shuffle",
-           "random_data_generator", "Preprocessor", "load"]
+__all__ = ["data", "py_reader", "data_loader", "read_file",
+           "open_recordio_file", "open_files", "batch", "double_buffer",
+           "shuffle", "random_data_generator", "Preprocessor", "load"]
 
 
 def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0, type=None, stop_gradient=True):
@@ -106,6 +107,39 @@ def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
     var.decorate_tensor_provider = holder.decorate_tensor_provider
     if use_double_buffer:
         return double_buffer(var, keep_decoration=True)
+    return var
+
+
+def data_loader(capacity, shapes, dtypes, num_workers=2, ordered=True,
+                slot_bytes=4 << 20, start_method=None, name=None,
+                use_double_buffer=False):
+    """py_reader's multiprocess twin: `num_workers` worker PROCESSES
+    decode/assemble batches into a shared-memory slot ring (zero-copy,
+    GIL-free — see io/dataloader.py). Same wiring: decorate with
+    decorate_paddle_reader / decorate_sample_reader /
+    decorate_tensor_provider, then reader.start() per epoch; get the
+    data Variables with fluid.layers.read_file(reader); exhaustion
+    raises fluid.EOFException. `capacity` is the ring depth in batches.
+    Call reader.close() (or let it be GC'd) to release the workers and
+    the shared-memory segment."""
+    base = name or unique_name.generate("data_loader")
+    names = _slot_names(base, len(shapes))
+    holder = dataloader_mod.DataLoader(
+        names, [list(s) for s in shapes],
+        [convert_dtype(d) for d in dtypes], num_workers=num_workers,
+        capacity=capacity, slot_bytes=slot_bytes, ordered=ordered,
+        start_method=start_method)
+
+    def _wire(var):
+        var.decorate_paddle_reader = holder.decorate_paddle_reader
+        var.decorate_sample_reader = holder.decorate_sample_reader
+        var.decorate_tensor_provider = holder.decorate_tensor_provider
+        var.close = holder.close
+        return var
+
+    var = _wire(_make_reader_var(holder, name=base))
+    if use_double_buffer:
+        return _wire(double_buffer(var))
     return var
 
 

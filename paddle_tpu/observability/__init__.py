@@ -33,7 +33,9 @@ __all__ = [
     "COMPILE_TOTAL", "COMPILE_LATENCY_MS", "CACHE_HITS", "CACHE_MISSES",
     "CACHE_EVICTIONS", "STEP_LATENCY_MS", "STEPS_TOTAL", "FEED_BYTES",
     "FETCH_BYTES", "RUN_LOOP_WINDOW_STEPS", "READER_PREFETCH_EVENTS",
-    "READER_PREFETCH_DEPTH", "PREDICT_LATENCY_MS", "PREDICT_REQUESTS",
+    "READER_PREFETCH_DEPTH", "READER_PULL_MS", "LOADER_BATCHES",
+    "LOADER_BLOCKED_MS", "LOADER_WORKER_BUSY_MS", "LOADER_QUEUE_DEPTH",
+    "LOADER_WORKERS", "PREDICT_LATENCY_MS", "PREDICT_REQUESTS",
     "PREDICT_BATCH_ROWS", "PREDICT_FAILURES", "PROFILER_EVENT_MS",
     "BENCH_ANOMALY_RETRIES", "SERVER_ROWS", "SERVER_BUCKET_FILL",
     "SERVER_INFLIGHT_DEPTH", "SERVER_STAGE_MS",
@@ -75,6 +77,28 @@ READER_PREFETCH_EVENTS = REGISTRY.counter(
 READER_PREFETCH_DEPTH = REGISTRY.gauge(
     "paddle_tpu_reader_prefetch_depth",
     "Programs with a device-staged next window right now")
+READER_PULL_MS = REGISTRY.counter(
+    "paddle_tpu_reader_pull_ms_total",
+    "Host time the executor spent pulling reader batches before dispatch, "
+    "by kind=run|loop (input-bound when this rivals step latency)")
+LOADER_BATCHES = REGISTRY.counter(
+    "paddle_tpu_loader_batches_total",
+    "DataLoader batches delivered, by loader and transport="
+    "shm|pickle|inline (pickle = batch outgrew the slot or object dtype)")
+LOADER_BLOCKED_MS = REGISTRY.counter(
+    "paddle_tpu_loader_blocked_ms_total",
+    "Time DataLoader consumers spent blocked in next() (starvation "
+    "fraction = this / wall time)")
+LOADER_WORKER_BUSY_MS = REGISTRY.counter(
+    "paddle_tpu_loader_worker_busy_ms_total",
+    "Summed DataLoader worker decode+assemble time (utilization = this / "
+    "(workers x wall time))")
+LOADER_QUEUE_DEPTH = REGISTRY.gauge(
+    "paddle_tpu_loader_queue_depth",
+    "Ready DataLoader batches buffered consumer-side right now "
+    "(0 while blocked = workers can't keep up)")
+LOADER_WORKERS = REGISTRY.gauge(
+    "paddle_tpu_loader_workers", "Worker processes per running DataLoader")
 PREDICT_LATENCY_MS = REGISTRY.histogram(
     "paddle_tpu_predict_latency_ms",
     "Predictor request latency (path=direct|server; server includes queue "

@@ -7,16 +7,19 @@ samples; decorators wrap creators and stay lazy.
 
 The threaded decorators (buffered, xmap_readers) keep the host-side
 pipeline ahead of the device: on TPU the jitted step consumes a batch in
-one transfer, so a couple of worker threads is enough to hide IO — the
-heavier double-buffer path is runtime/prefetch.py (C++ bounded channel).
+one transfer, so a couple of worker threads is enough to hide cheap IO.
+The heavier double-buffer path is io/reader.py's DoubleBufferReader over
+the C++ bounded channel/prefetch in runtime/runtime.cc; when per-sample
+decode is heavy enough to serialize these THREADS on the GIL (PIL/cv2
+style transforms), use io/dataloader.py's DataLoader — worker PROCESSES
+feeding batches through shared memory.
 """
 from __future__ import annotations
 
 import itertools
-import time as _time
 import random as _random
 from queue import Queue
-from threading import Thread
+from threading import Condition, Thread
 
 class _RaiseSignal:
     """Carries a worker-thread exception to the consuming generator."""
@@ -201,23 +204,33 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
         _relay(sample if isinstance(sample, _Raise) else end,
                in_queue, out_queue)
 
-    def order_handle_worker(in_queue, out_queue, out_order, err):
+    def order_handle_worker(in_queue, out_queue, out_order, err, turn):
+        # `turn` (a Condition over out_order) replaces the old
+        # _time.sleep(0) busy-spin: a worker whose sample is done but
+        # whose turn hasn't come SLEPT on the scheduler, burning a full
+        # core per waiting worker. Only the current-turn worker can
+        # advance out_order, so emitting outside the lock is safe.
         ins = in_queue.get()
         try:
             while not isinstance(ins, (XmapEndSignal, _Raise)):
                 order, sample = ins
                 result = mapper(sample)
-                while order != out_order[0] and err[0] is None:
-                    _time.sleep(0)
-                if err[0] is not None:
-                    break
+                with turn:
+                    while order != out_order[0] and err[0] is None:
+                        turn.wait()
+                    if err[0] is not None:
+                        break
                 out_queue.put(result)
-                out_order[0] += 1
+                with turn:
+                    out_order[0] += 1
+                    turn.notify_all()
                 ins = in_queue.get()
         except BaseException as exc:  # noqa: B036
             ins = _Raise(exc)
         if isinstance(ins, _Raise):
-            err[0] = ins.exc  # releases siblings spinning on out_order
+            with turn:
+                err[0] = ins.exc  # releases siblings waiting on out_order
+                turn.notify_all()
         _relay(ins if isinstance(ins, _Raise) else end, in_queue, out_queue)
 
     def xreader():
@@ -230,8 +243,9 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
         t.start()
         workers = []
         err = [None]
+        turn = Condition()
         htarget = order_handle_worker if order else handle_worker
-        hargs = ((in_queue, out_queue, out_order, err) if order
+        hargs = ((in_queue, out_queue, out_order, err, turn) if order
                  else (in_queue, out_queue))
         for _ in range(process_num):
             w = Thread(target=htarget, args=hargs)

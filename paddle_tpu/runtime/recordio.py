@@ -28,6 +28,12 @@ __all__ = [
     "batch_assemble",
     "recordio_convert",
     "recordio_sample_reader",
+    "frame_encodable",
+    "frame_nbytes",
+    "encode_frame",
+    "encode_frame_into",
+    "encode_frame_pickle",
+    "decode_frame",
 ]
 
 _MAGIC = 0x50445452
@@ -522,6 +528,135 @@ def recordio_sample_reader(path: str, prefetch: bool = True, capacity: int = 256
             src.close()
 
     return reader
+
+
+# ---------------------------------------------------------------------------
+# zero-copy array frames (shared wire/shm layout)
+# ---------------------------------------------------------------------------
+#
+# One frame carries an ordered list of ndarrays plus a u64 tag (a request
+# id on the serving channel, a batch sequence number in the DataLoader's
+# shared-memory ring):
+#
+#   b"Z" (0x5A u8) | tag u64 | nslots u32 | per slot:
+#     dtype-str len u8 | numpy dtype.str (endianness included) |
+#     ndim u8 | shape i64 x ndim | nbytes i64 | raw array bytes
+#
+# decode_frame over a memoryview reconstructs each slot as an
+# ``np.frombuffer`` VIEW — no pickle object graph and no payload copy on
+# the reading side. Arrays a frame cannot carry (object / record dtypes,
+# datetimes) use the pickled form, prefixed b"P".
+
+_FRAME_HDR = struct.Struct("<BQI")
+_FRAME_U8 = struct.Struct("<B")
+_FRAME_I64 = struct.Struct("<q")
+_FRAME_MAGIC = 0x5A
+
+
+def frame_encodable(rows) -> bool:
+    """True when every row can ride the zero-copy frame (numeric/bytes
+    dtypes with buffer export; object/void/datetime kinds cannot)."""
+    for a in rows:
+        dt = getattr(a, "dtype", None)
+        if dt is None or dt.kind in "OVMm":
+            return False
+    return True
+
+
+def _frame_meta_nbytes(a) -> int:
+    return 1 + len(a.dtype.str) + 1 + 8 * a.ndim + 8
+
+
+def frame_nbytes(rows) -> int:
+    """Exact encoded size of the zero-copy frame for `rows`."""
+    return _FRAME_HDR.size + sum(_frame_meta_nbytes(a) + a.nbytes
+                                 for a in rows)
+
+
+def _write_frame(buf, off: int, tag: int, rows) -> int:
+    _FRAME_HDR.pack_into(buf, off, _FRAME_MAGIC, tag, len(rows))
+    off += _FRAME_HDR.size
+    for a in rows:
+        ds = a.dtype.str.encode("ascii")
+        _FRAME_U8.pack_into(buf, off, len(ds))
+        off += 1
+        buf[off:off + len(ds)] = ds
+        off += len(ds)
+        _FRAME_U8.pack_into(buf, off, a.ndim)
+        off += 1
+        struct.pack_into("<%dq" % a.ndim, buf, off, *a.shape)
+        off += 8 * a.ndim
+        _FRAME_I64.pack_into(buf, off, a.nbytes)
+        off += 8
+        if a.nbytes:
+            # memoryview slice assignment is one C memcpy; 0-d and
+            # zero-size views can't be cast, tobytes copies <= 1 scalar
+            if a.ndim and a.size:
+                buf[off:off + a.nbytes] = memoryview(a).cast("B")
+            else:
+                buf[off:off + a.nbytes] = a.tobytes()
+            off += a.nbytes
+    return off
+
+
+def encode_frame(tag: int, rows) -> bytes:
+    """Zero-copy frame as a fresh bytes object (the serving channel's
+    wire form). `rows` must already be C-contiguous ndarrays of
+    frame-encodable dtypes (see frame_encodable)."""
+    out = bytearray(frame_nbytes(rows))
+    _write_frame(out, 0, tag, rows)
+    return bytes(out)
+
+
+def encode_frame_into(buf, tag: int, rows) -> int:
+    """Write the frame IN PLACE into a writable buffer (a shared-memory
+    slot): returns the encoded size, or -1 when `rows` cannot ride the
+    frame or `buf` is too small (caller falls back to pickle transport).
+    Rows are made contiguous here if needed (one copy, in the writer)."""
+    if not frame_encodable(rows):
+        return -1
+    import numpy as _np
+
+    rows = [_np.ascontiguousarray(a) for a in rows]
+    need = frame_nbytes(rows)
+    if need > len(buf):
+        return -1
+    _write_frame(buf, 0, tag, rows)
+    return need
+
+
+def encode_frame_pickle(tag: int, rows) -> bytes:
+    """The fallback form decode_frame also understands."""
+    return b"P" + pickle.dumps((tag, list(rows)), protocol=4)
+
+
+def decode_frame(msg):
+    """(tag, [row arrays]) back from either form. Zero-copy rows are
+    ``np.frombuffer`` views over ``msg`` — they stay valid (and alias)
+    exactly as long as the underlying buffer does."""
+    import numpy as np
+
+    if bytes(msg[:1]) == b"P":
+        return pickle.loads(memoryview(msg)[1:])
+    mv = memoryview(msg)
+    _magic, tag, nslots = _FRAME_HDR.unpack_from(mv, 0)
+    off = _FRAME_HDR.size
+    rows = []
+    for _ in range(nslots):
+        (dlen,) = _FRAME_U8.unpack_from(mv, off)
+        off += 1
+        dt = np.dtype(bytes(mv[off:off + dlen]).decode("ascii"))
+        off += dlen
+        (ndim,) = _FRAME_U8.unpack_from(mv, off)
+        off += 1
+        shape = struct.unpack_from("<%dq" % ndim, mv, off) if ndim else ()
+        off += 8 * ndim
+        (nbytes,) = _FRAME_I64.unpack_from(mv, off)
+        off += 8
+        count = nbytes // dt.itemsize if dt.itemsize else 0
+        rows.append(np.frombuffer(mv, dt, count, off).reshape(shape))
+        off += nbytes
+    return tag, rows
 
 
 def batch_assemble(rows, dst, min_bytes: int = 1 << 20):

@@ -19,6 +19,52 @@ import pytest
 
 
 @pytest.fixture(autouse=True)
+def no_leaked_workers():
+    """Tier-1 hygiene: a test that leaks worker PROCESSES (DataLoader
+    workers, multihost helpers) or non-daemon THREADS fails instead of
+    silently poisoning the rest of the suite. Cheap on the clean path
+    (two snapshots); only a suspected leak pays the gc + grace joins.
+    Library-pool threads (ThreadPoolExecutor) are process-lifetime by
+    design and exempt, as are daemon threads."""
+    import gc
+    import multiprocessing as mp
+    import threading
+    import time
+
+    procs_before = {p.pid for p in mp.active_children()}
+    threads_before = {t.ident for t in threading.enumerate()}
+    yield
+
+    def leaked_procs():
+        return [p for p in mp.active_children()
+                if p.pid not in procs_before and p.is_alive()]
+
+    def leaked_threads():
+        return [t for t in threading.enumerate()
+                if t.ident not in threads_before and t.is_alive()
+                and not t.daemon
+                and not t.name.startswith("ThreadPoolExecutor")
+                and not t.name.startswith("QueueFeederThread")]
+
+    if leaked_procs() or leaked_threads():
+        # grace period: teardown may still be finishing (GC finalizers,
+        # worker joins); collect to run weakref cleanups, then re-check
+        gc.collect()
+        deadline = time.monotonic() + 3.0
+        while ((leaked_procs() or leaked_threads())
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        procs, threads = leaked_procs(), leaked_threads()
+        for p in procs:  # don't poison the NEXT test with the leak
+            p.terminate()
+        if procs or threads:
+            pytest.fail(
+                "test leaked workers: processes=%s threads=%s (close() "
+                "your DataLoaders / join your threads)"
+                % ([p.name for p in procs], [t.name for t in threads]))
+
+
+@pytest.fixture(autouse=True)
 def fresh_programs():
     """Each test gets fresh default programs / scope / name counters."""
     import paddle_tpu as fluid

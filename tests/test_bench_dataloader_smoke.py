@@ -1,0 +1,45 @@
+"""tools/bench_dataloader.py smoke: the sweep-line schema is a driver
+contract (like test_bench_serving_smoke pins bench_serving's), and the
+two measurement paths must agree on batch counts at a tiny config."""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+import bench_dataloader as bdl  # noqa: E402
+
+
+def test_run_config_line_schema():
+    lines = []
+    s = bdl.run_config(workers=2, nbytes=2048, batch=2, n_batches=6,
+                       rounds=1, emit=lines.append)
+    sweep = [l for l in lines if l["phase"] == "dataloader_sweep"]
+    assert [l["mode"] for l in sweep] == ["threads", "process"]
+    for l in sweep:
+        for key in ("workers", "sample_kb", "batch", "batches",
+                    "batches_per_sec", "samples_per_sec", "rounds"):
+            assert key in l, key
+        assert l["batches_per_sec"] > 0
+    proc = sweep[1]
+    assert proc["shm_batches"] + proc["pickle_batches"] == 6
+    for key in ("consumer_blocked_frac", "worker_utilization",
+                "worker_stall_frac"):
+        assert 0.0 <= proc[key], key
+    assert s["phase"] == "dataloader_speedup"
+    assert s["speedup"] > 0
+    assert s["threads_batches_per_sec"] == sweep[0]["batches_per_sec"]
+    assert s["process_batches_per_sec"] == sweep[1]["batches_per_sec"]
+
+
+def test_quick_metric_schema():
+    m = bdl.quick_metric(workers=2, sample_kb=2, batch=2, n_batches=6,
+                         rounds=1)
+    for key in ("batches_per_sec", "threads_batches_per_sec",
+                "speedup_vs_threads", "workers", "batch", "sample_kb",
+                "transport", "worker_utilization"):
+        assert key in m, key
+    assert m["batches_per_sec"] > 0
+    assert m["transport"]["shm"] + m["transport"]["pickle"] == 6

@@ -213,6 +213,9 @@ def test_probe_failure_attaches_local_capture(monkeypatch, tmp_path):
     monkeypatch.setattr(bench, "_LOCAL_CAPTURE", str(cap))
     monkeypatch.setattr(bench, "_probe_device", lambda t: "probe hung")
     monkeypatch.setenv("BENCH_PROBE_TIMEOUT", "1")
+    # the host-side input-pipeline measurement is real work (worker
+    # processes); this test pins the capture-context contract only
+    monkeypatch.setenv("BENCH_INPUT_PIPELINE", "0")
     # main() mutates process-global bench state; keep it out of the
     # suite's env (monkeypatch restores both on teardown)
     monkeypatch.setattr(bench, "_FUSED_BWD_BAKED", False)
@@ -231,6 +234,51 @@ def test_probe_failure_attaches_local_capture(monkeypatch, tmp_path):
     bench.main()
     out2 = _json.loads(buf2.getvalue().strip().splitlines()[-1])
     assert out2["value"] is None and "last_local_capture" not in out2
+
+
+def test_probe_failure_still_emits_input_pipeline_line(monkeypatch):
+    """A tunnel-dead run must still bank the host-measurable
+    input-pipeline series: its JSON line comes FIRST, the device-metric
+    error line stays LAST (the driver parses the final line)."""
+    import io
+    import json as _json
+    import sys as _s
+
+    monkeypatch.setattr(bench, "_probe_device", lambda t: "probe hung")
+    monkeypatch.setattr(
+        bench, "_input_pipeline_metric",
+        lambda: {"batches_per_sec": 41.5, "threads_batches_per_sec": 18.1,
+                 "speedup_vs_threads": 2.29, "workers": 2})
+    monkeypatch.setenv("BENCH_PROBE_TIMEOUT", "1")
+    monkeypatch.setattr(bench, "_FUSED_BWD_BAKED", False)
+    monkeypatch.setenv("BENCH_AMP_LEVEL", "O1")
+    buf = io.StringIO()
+    monkeypatch.setattr(_s, "stdout", buf)
+    bench.main()
+    lines = [_json.loads(l) for l in buf.getvalue().strip().splitlines()]
+    assert len(lines) == 2
+    ip, err = lines
+    assert ip["metric"] == "input_pipeline_batches_per_sec"
+    assert ip["value"] == 41.5 and ip["unit"] == "batches/s"
+    assert ip["speedup_vs_threads"] == 2.29
+    # the device metric line is LAST and still carries the error + null
+    assert err["metric"] == "transformer_lm_train_tokens_per_sec_per_chip"
+    assert err["value"] is None and "unreachable" in err["error"]
+    assert err["input_pipeline"]["batches_per_sec"] == 41.5
+
+    # a broken measurement must not cost the bench: error rides the line
+    def boom():
+        raise RuntimeError("loader exploded")
+
+    monkeypatch.setattr(bench, "_input_pipeline_metric", boom)
+    buf2 = io.StringIO()
+    monkeypatch.setattr(_s, "stdout", buf2)
+    bench.main()
+    lines2 = [_json.loads(l) for l in buf2.getvalue().strip().splitlines()]
+    assert lines2[0]["metric"] == "input_pipeline_batches_per_sec"
+    assert lines2[0]["value"] is None
+    assert "loader exploded" in lines2[0]["error"]
+    assert lines2[-1]["value"] is None  # device line still last
 
 
 def test_baked_fused_default_is_gate_conditional(monkeypatch, tmp_path):

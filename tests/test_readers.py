@@ -41,6 +41,61 @@ def test_reader_decorators():
     ]
 
 
+def _raising_reader(good, exc_type=ValueError):
+    def reader():
+        for i in range(good):
+            yield i
+        raise exc_type("source died mid-epoch")
+
+    return reader
+
+
+def test_buffered_propagates_reader_exception():
+    """A reader exception inside the pump thread must surface to the
+    consumer, not strand it on an empty queue."""
+    r = rd.buffered(_raising_reader(5), 2)()
+    got = []
+    with pytest.raises(ValueError, match="died mid-epoch"):
+        for item in r:
+            got.append(item)
+    assert got == [0, 1, 2, 3, 4]
+
+
+@pytest.mark.parametrize("order", [False, True])
+def test_xmap_propagates_reader_exception(order):
+    r = rd.xmap_readers(lambda x: x * 2, _raising_reader(6), 3, 4,
+                        order=order)()
+    with pytest.raises(ValueError, match="died mid-epoch"):
+        list(r)
+
+
+@pytest.mark.parametrize("order", [False, True])
+def test_xmap_propagates_mapper_exception(order):
+    def mapper(x):
+        if x == 7:
+            raise RuntimeError("mapper blew up")
+        return x + 1
+
+    r = rd.xmap_readers(mapper, _counting_reader(40), 4, 8, order=order)()
+    with pytest.raises(RuntimeError, match="mapper blew up"):
+        list(r)
+
+
+def test_xmap_ordered_preserves_order_under_skew():
+    """order=True must emit input order even when early samples are the
+    slowest (exercises the Condition-based turn taking)."""
+    import time as _t
+
+    def mapper(x):
+        if x < 4:
+            _t.sleep(0.02)
+        return x * 10
+
+    out = list(rd.xmap_readers(mapper, _counting_reader(24), 4, 8,
+                               order=True)())
+    assert out == [i * 10 for i in range(24)]
+
+
 def test_dataset_schemas():
     img, lbl = next(mnist.train()())
     assert img.shape == (784,) and img.dtype == np.float32

@@ -558,3 +558,110 @@ def test_reader_prefetch_defers_non_eof_errors(monkeypatch):
         assert exe._steps[main_p] == 6  # both windows fully trained
         with pytest.raises(ValueError, match="corrupt record"):
             exe.run_loop(main_p, fetch_list=[loss], steps=3)
+
+
+# -- _pull_reader_window unit tests (fake holders, no programs) -----------
+
+
+class _FakeOp:
+    """Just enough of an Operator for _pull_reader_window: a read op with
+    one Reader input and fixed Out names."""
+
+    type = "read"
+
+    def __init__(self, reader_name, out_names):
+        self._reader_name = reader_name
+        self._out_names = list(out_names)
+
+    def input(self, slot):
+        assert slot == "Reader"
+        return [self._reader_name]
+
+    def output(self, slot):
+        assert slot == "Out"
+        return list(self._out_names)
+
+
+class _FakeHolder:
+    """Scripted reader holder: yields preloaded batches then EOF."""
+
+    def __init__(self, batches):
+        self.batches = list(batches)
+        self.i = 0
+
+    def next(self):
+        from paddle_tpu.io.reader import EOFException
+
+        if self.i >= len(self.batches):
+            raise EOFException("fake exhausted")
+        b = self.batches[self.i]
+        self.i += 1
+        return b
+
+
+class _FakeVar:
+    def __init__(self, holder):
+        self._reader_holder = holder
+
+
+class _FakeBlock:
+    def __init__(self, vars_):
+        self._vars = vars_
+
+    def _find_var_recursive(self, name):
+        return self._vars[name]
+
+
+def _window_setup(a_batches, b_batches):
+    ha, hb = _FakeHolder(a_batches), _FakeHolder(b_batches)
+    gb = _FakeBlock({"ra": _FakeVar(ha), "rb": _FakeVar(hb)})
+    ops = [_FakeOp("ra", ["xa"]), _FakeOp("rb", ["xb"])]
+    return fluid.Executor(fluid.CPUPlace()), gb, ops, ha, hb
+
+
+def _ab(n, d):
+    return [{"xa" if d == 2 else "xb": np.ones((4, d), np.float32) * i}
+            for i in range(n)]
+
+
+def test_pull_reader_window_multi_reader_skew_pushback():
+    """Reader A yields 5 batches, reader B only 3: a steps=5 window must
+    close at k=3 and push A's 2 extra pulls back in order."""
+    exe, gb, ops, ha, hb = _window_setup(_ab(5, 2), _ab(3, 3))
+    op_windows, k, eof = exe._pull_reader_window(gb, ops, 5)
+    assert k == 3 and eof is not None  # B hit EOF inside the window
+    assert all(len(b) == 3 for _o, _h, b, _e in op_windows)
+    pushback = getattr(ha, "_ptpu_pushback", [])
+    assert [float(b["xa"][0, 0]) for b in pushback] == [3.0, 4.0]
+    # the pushed-back batches replay in pipeline order on the next pull
+    op_windows2, k2, eof2 = exe._pull_reader_window(gb, [ops[0]], 2)
+    (_op, _h, batches, _e), = op_windows2
+    assert [float(b["xa"][0, 0]) for b in batches] == [3.0, 4.0]
+    assert k2 == 2 and eof2 is None
+
+
+def test_pull_reader_window_k0_eof_pushes_all_back():
+    """First reader EOFs immediately: every batch the OTHER reader
+    already pulled must be returned (k == 0 loses nothing)."""
+    exe, gb, ops, ha, hb = _window_setup(_ab(4, 2), [])
+    # order matters: A is pulled first, then B EOFs at its first pull
+    op_windows, k, eof = exe._pull_reader_window(gb, ops, 3)
+    assert k == 0 and eof is not None
+    assert all(len(b) == 0 for _o, _h, b, _e in op_windows)
+    assert len(ha._ptpu_pushback) == 3  # A's whole window returned
+    # nothing was consumed: a fresh pull sees A's batches from the start
+    op_windows2, k2, _ = exe._pull_reader_window(gb, [ops[0]], 4)
+    (_op, _h, batches, _e), = op_windows2
+    assert k2 == 4
+    assert [float(b["xa"][0, 0]) for b in batches] == [0.0, 1.0, 2.0, 3.0]
+
+
+def test_pull_reader_window_eof_has_no_traceback_cycle():
+    """The deferred EOFException must be stored WITHOUT a traceback: a
+    live traceback pins the pulling frame chain in a reference cycle,
+    which keeps zero-copy DataLoader batch views (and their shared-memory
+    slots) alive until a cyclic GC happens to run."""
+    exe, gb, ops, _ha, _hb = _window_setup(_ab(2, 2), _ab(1, 3))
+    _w, k, eof = exe._pull_reader_window(gb, ops, 4)
+    assert k == 1 and eof is not None
+    assert eof.__traceback__ is None

@@ -229,3 +229,57 @@ def test_batch_assemble_native_gather():
     # non-contiguous rows are rejected
     assert not batch_assemble([rows[0].T, rows[1].T],
                               np.empty((2, 7, 33), np.float32), min_bytes=0)
+
+
+# -- zero-copy array frames (shared wire/shm layout) ----------------------
+
+
+def test_frame_roundtrip_bytes_and_views():
+    rows = [np.arange(12, dtype=np.float32).reshape(3, 4),
+            np.array([7], dtype=np.int64),
+            np.float64(3.5).reshape(()),  # 0-d
+            np.zeros((0, 5), dtype=np.uint8)]  # zero-size
+    msg = rio.encode_frame(41, rows)
+    assert len(msg) == rio.frame_nbytes(rows)
+    tag, back = rio.decode_frame(msg)
+    assert tag == 41 and len(back) == 4
+    for a, b in zip(rows, back):
+        assert b.dtype == a.dtype and b.shape == a.shape
+        np.testing.assert_array_equal(a, b)
+    # decoded rows are VIEWS over the message buffer, not copies
+    assert back[0].base is not None
+
+
+def test_frame_encode_into_shared_buffer():
+    rows = [np.arange(6, dtype=np.int32), np.ones((2, 2), np.float32)]
+    buf = bytearray(4096)
+    n = rio.encode_frame_into(memoryview(buf), 9, rows)
+    assert n == rio.frame_nbytes(rows)
+    tag, back = rio.decode_frame(memoryview(buf)[:n])
+    assert tag == 9
+    np.testing.assert_array_equal(back[0], rows[0])
+    np.testing.assert_array_equal(back[1], rows[1])
+    # in-place decode aliases the buffer: writes show through
+    back[0][...] = 5
+    _, again = rio.decode_frame(memoryview(buf)[:n])
+    assert int(again[0][0]) == 5
+
+
+def test_frame_encode_into_rejects_misfits():
+    big = [np.zeros((64, 64), np.float32)]
+    assert rio.encode_frame_into(memoryview(bytearray(64)), 0, big) == -1
+    objs = [np.array(["a", None], dtype=object)]
+    assert not rio.frame_encodable(objs)
+    assert rio.encode_frame_into(memoryview(bytearray(4096)), 0, objs) == -1
+    # the pickle form round-trips through the same decoder
+    tag, back = rio.decode_frame(rio.encode_frame_pickle(3, objs))
+    assert tag == 3 and back[0][0] == "a"
+
+
+def test_frame_encode_into_makes_rows_contiguous():
+    t = np.arange(12, dtype=np.float32).reshape(3, 4).T  # non-contiguous
+    buf = bytearray(4096)
+    n = rio.encode_frame_into(memoryview(buf), 1, [t])
+    assert n > 0
+    _, back = rio.decode_frame(memoryview(buf)[:n])
+    np.testing.assert_array_equal(back[0], t)
