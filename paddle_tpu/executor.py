@@ -30,6 +30,7 @@ import itertools
 
 from . import observability as obs
 from . import profiler
+from .runtime import aot_cache as _aot
 from .framework.core import Program, Variable, default_main_program
 from .framework.dtypes import as_numpy_dtype
 from .framework.scope import CPUPlace, Place, Scope, global_scope
@@ -217,6 +218,13 @@ class Executor:
         except ValueError:
             cache_cap = 256
         self._cache = _CompileCache(cache_cap)
+        # persistent executable store (warm start): a fresh process
+        # deserializes executables a previous run compiled instead of
+        # paying trace + XLA compile before step 1. PADDLE_TPU_AOT_CACHE=0
+        # turns this executor back into a memory-only compiler.
+        self._disk = _aot.AotDiskCache()
+        # opt-in second tier: jax's own persistent compilation cache
+        _aot.maybe_enable_jax_cache()
         # label for this executor's prefetch-depth gauge series: the gauge
         # is process-global, so two executors writing an unlabeled series
         # would overwrite each other (sum the series for process truth)
@@ -314,7 +322,9 @@ class Executor:
 
         stepfn = build_step_fn(program, fetch_names, state_in, state_out)
         fn = jax.jit(stepfn, donate_argnums=(1,))
-        hlo = self._hlo_compile_stats(fn, feed_sig, state_in, scope)
+        fn, hlo = self._aot_compile(
+            fn, program, feed_sig, fetch_names, state_in, state_out, scope,
+            loop=False, kind="run")
         return _Compiled(fn, state_in, state_out, fetch_names, program,
                          fp=obs.program_fp(program), hlo=hlo)
 
@@ -351,38 +361,122 @@ class Executor:
             }
 
         fn = jax.jit(make_loop_fn(stepfn, slice_feeds), donate_argnums=(1,))
-        hlo = self._hlo_compile_stats(fn, feed_sig, state_in, scope,
-                                      loop=True)
+        fn, hlo = self._aot_compile(
+            fn, program, feed_sig, fetch_names, state_in, state_out, scope,
+            loop=True, per_step_names=per_step_names, kind="loop")
         return _Compiled(fn, state_in, state_out, fetch_names, program,
                          fp=obs.program_fp(program), hlo=hlo)
 
     @staticmethod
-    def _hlo_compile_stats(fn, feed_sig, state_in, scope, loop=False):
+    def _avals_for(feed_sig, state_in, scope, loop=False):
+        """Abstract call signature of the step/loop fn — what explicit
+        ``fn.lower`` needs instead of concrete first-call args: feeds from
+        the feed signature, state from the scope values' shapes/dtypes,
+        the RNG key aval, the uint32 step, and (loop only) the traced
+        int32 step count."""
+        feeds_aval = {n: jax.ShapeDtypeStruct(tuple(s), np.dtype(d))
+                      for n, s, d in feed_sig}
+        state_aval = {}
+        for n in state_in:
+            val = scope.find_var(n)
+            arr = (val if hasattr(val, "shape") and hasattr(val, "dtype")
+                   else np.asarray(val))
+            state_aval[n] = jax.ShapeDtypeStruct(tuple(arr.shape),
+                                                 np.dtype(arr.dtype))
+        args = [feeds_aval, state_aval,
+                jax.eval_shape(lambda: jax.random.PRNGKey(0)),
+                jax.ShapeDtypeStruct((), np.uint32)]
+        if loop:
+            args.append(jax.ShapeDtypeStruct((), np.int32))
+        return args
+
+    def _aot_compile(self, fn, program: Program, feed_sig, fetch_names,
+                     state_in, state_out, scope, *, loop: bool, kind: str,
+                     per_step_names: frozenset = frozenset()):
+        """Acquire the executable through the persistent disk tier:
+        explicit ``lower → compile`` AOT (donation set on `fn` is
+        preserved through lowering AND serialization), with the compiled
+        executable stored under a key that covers everything that shapes
+        it (see aot_cache.env_fingerprint). Returns ``(callable, hlo)``
+        where hlo feeds timeline.record_compile.
+
+        Failure contract: a disabled cache or an un-abstractable
+        signature falls back to the lazy ``jax.jit`` path unchanged;
+        trace/compile errors PROPAGATE (they are the same program errors
+        the lazy path would raise on first call); disk I/O problems are
+        absorbed (counted) by AotDiskCache."""
+        if not self._disk.enabled:
+            return fn, self._hlo_compile_stats(fn, feed_sig, state_in,
+                                               scope, loop=loop)
+        fp = obs.program_fp(program)
+        try:
+            args = self._avals_for(feed_sig, state_in, scope, loop=loop)
+            # the state SIGNATURE (not just names) keys the cache: scope
+            # values nearly always follow the program's declarations, but
+            # an executable compiled against different state shapes/dtypes
+            # must be unreachable, not a call-time XLA arity error
+            state_sig = tuple(sorted(
+                (n, tuple(a.shape), str(a.dtype))
+                for n, a in args[1].items()))
+            # program._version is deliberately NOT in the key: the
+            # fingerprint already hashes full content, and the version is
+            # a process-local mutation counter — a content-identical
+            # program rebuilt another way (from_json, clone) would end at
+            # a different version and spuriously miss its warm start.
+            # (The in-memory cache still keys on (id, version) for its
+            # staleness check; disk keys don't need one.)
+            key = self._disk.key((
+                "loop" if loop else "step", program.fingerprint(),
+                feed_sig, fetch_names, state_sig,
+                tuple(state_out), tuple(sorted(per_step_names)),
+                _aot.env_fingerprint()))
+        except Exception:
+            # an aval we can't build (exotic state value) must never
+            # block execution: lazy jit handles it like before
+            return fn, self._hlo_compile_stats(fn, feed_sig, state_in,
+                                               scope, loop=loop)
+        t0 = time.perf_counter()
+        loaded = self._disk.load(key)
+        if loaded is not None:
+            obs.CACHE_HITS.inc(kind=kind, tier="disk", program=fp)
+            obs.AOT_COMPILE_MS.observe((time.perf_counter() - t0) * 1e3,
+                                       path="warm", kind=kind)
+            obs.TIMELINE.record_compile(kind, fp, cache="aot-load")
+            return loaded, None
+        obs.CACHE_MISSES.inc(kind=kind, tier="disk", program=fp)
+        t0 = time.perf_counter()
+        lowered = fn.lower(*args)
+        t1 = time.perf_counter()
+        compiled = lowered.compile()
+        t2 = time.perf_counter()
+        obs.AOT_COMPILE_MS.observe((t2 - t0) * 1e3, path="cold", kind=kind)
+        # the trace/XLA split comes free on the explicit AOT path (the
+        # lazy path needs opt-in _hlo_compile_stats to pay for it)
+        hlo = {"trace_ms": (t1 - t0) * 1e3, "xla_ms": (t2 - t1) * 1e3}
+        if obs.TIMELINE.hlo_cost_enabled():
+            cost = obs.hlo_cost_stats(compiled)
+            if cost:
+                hlo.update(cost)
+        self._disk.store(key, compiled, meta={
+            "kind": "loop" if loop else "step", "program": fp,
+            "feed_sig": feed_sig, "fetch_names": tuple(fetch_names),
+            "env": _aot.env_fingerprint(), "created": time.time()})
+        return compiled, hlo
+
+    def _hlo_compile_stats(self, fn, feed_sig, state_in, scope, loop=False):
         """Opt-in (``observability.TIMELINE.set_hlo_cost(True)``): lower +
         compile the jitted fn explicitly on abstract avals so the compile
         timeline event can split trace time from XLA compile time and
         carry the executable's cost-analysis FLOPs/bytes estimates (the
-        numbers tools/hlo_stats.py mines from an xprof capture). The
-        executor keeps executing through the lazy jit — this pays one
-        extra compile per cache miss, which is why it is off by default.
-        Returns a dict for timeline.record_compile, or None."""
+        numbers tools/hlo_stats.py mines from an xprof capture). Only the
+        LAZY-jit fallback path (disk tier disabled) uses this — it pays
+        one extra compile per cache miss, which is why it is off by
+        default; the AOT path gets the same split for free. Returns a
+        dict for timeline.record_compile, or None."""
         if not obs.TIMELINE.hlo_cost_enabled():
             return None
         try:
-            feeds_aval = {n: jax.ShapeDtypeStruct(tuple(s), np.dtype(d))
-                          for n, s, d in feed_sig}
-            state_aval = {}
-            for n in state_in:
-                val = scope.find_var(n)
-                arr = (val if hasattr(val, "shape") and hasattr(val, "dtype")
-                       else np.asarray(val))
-                state_aval[n] = jax.ShapeDtypeStruct(tuple(arr.shape),
-                                                     np.dtype(arr.dtype))
-            args = [feeds_aval, state_aval,
-                    jax.eval_shape(lambda: jax.random.PRNGKey(0)),
-                    jax.ShapeDtypeStruct((), np.uint32)]
-            if loop:
-                args.append(jax.ShapeDtypeStruct((), np.int32))
+            args = self._avals_for(feed_sig, state_in, scope, loop=loop)
             t0 = time.perf_counter()
             lowered = fn.lower(*args)
             t1 = time.perf_counter()
@@ -684,7 +778,7 @@ class Executor:
         if use_program_cache:
             profiler.record_cache(compiled is not None)
             (obs.CACHE_HITS if compiled is not None else obs.CACHE_MISSES
-             ).inc(kind="run", program=obs.program_fp(program))
+             ).inc(kind="run", tier="memory", program=obs.program_fp(program))
         first_run = compiled is None
         if compiled is None:
             compiled = self._compile(program, feed_sig, fetch_names, scope,
@@ -864,7 +958,8 @@ class Executor:
         if use_program_cache:
             profiler.record_cache(compiled is not None)
             (obs.CACHE_HITS if compiled is not None else obs.CACHE_MISSES
-             ).inc(kind="loop", program=obs.program_fp(program))
+             ).inc(kind="loop", tier="memory",
+                   program=obs.program_fp(program))
         first_run = compiled is None
         if compiled is None:
             compiled = self._compile_loop(

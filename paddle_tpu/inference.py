@@ -6,10 +6,12 @@ TPU-native equivalents:
 
 - `Predictor` loads a save_inference_model directory, traces the program
   ONCE per feed signature, AOT-compiles it (jit → lower → compile) and
-  serializes the XLA executable to `<model_dir>/__aot_cache__/` keyed on
-  (program fingerprint, feed signature, backend, jax version). A fresh
-  process deserializes the executable and predicts with NO re-trace and NO
-  re-compile — the reference's "load once, serve forever" cold-start story.
+  serializes the XLA executable to `<model_dir>/__aot_cache__/` through
+  the SHARED persistent store (`runtime/aot_cache.py` — the same file
+  layout, key derivation, corruption quarantine, and mtime-LRU GC the
+  training `Executor` uses). A fresh process deserializes the executable
+  and predicts with NO re-trace and NO re-compile — the reference's
+  "load once, serve forever" cold-start story.
 - `PredictorServer` is the serving loop, built as a two-stage pipeline:
   requests enter a C++ bounded channel (runtime.cc) as zero-copy binary
   frames; a STACKING stage drains them with dynamic batching
@@ -21,7 +23,6 @@ TPU-native equivalents:
 """
 from __future__ import annotations
 
-import hashlib
 import os
 import pickle
 import queue
@@ -38,6 +39,7 @@ from . import observability as obs
 from .framework.core import Program
 from .framework.scope import Scope
 from .framework.trace import RngStream, trace_block
+from .runtime import aot_cache as _aot
 from .runtime import recordio as _rio
 
 __all__ = ["Predictor", "PredictorServer", "create_paddle_predictor"]
@@ -61,11 +63,23 @@ class Predictor:
         self.model_dir = model_dir
         self._scope = Scope()
         exe = Executor(place)
+        if not aot_cache:
+            # aot_cache=False promises NO disk persistence — that covers
+            # the loader Executor's own compiles (load/startup programs
+            # would otherwise land in the training-side default cache)
+            exe._disk.enabled = False
         self._program, self._feed_names, self._fetch_targets = (
             fluid_io.load_inference_model(model_dir, exe, scope=self._scope))
         self._fetch_names = [t.name for t in self._fetch_targets]
-        self._aot_cache = aot_cache
         self._cache_dir = cache_dir or os.path.join(model_dir, _AOT_DIR)
+        # the shared persistent executable store (runtime/aot_cache.py):
+        # same layout/GC/quarantine as the training Executor's cache, but
+        # rooted at the model's own directory so the executables ship
+        # with the model artifacts. aot_cache=False (or the global
+        # PADDLE_TPU_AOT_CACHE=0 kill switch) turns it off.
+        self._disk = _aot.AotDiskCache(cache_dir=self._cache_dir,
+                                       enabled=aot_cache)
+        _aot.maybe_enable_jax_cache()
         self._compiled: Dict = {}
         self._touched: set = set()  # sigs whose USE this process recorded
         # feed-conversion plan, computed ONCE: the model's feed set is
@@ -107,14 +121,22 @@ class Predictor:
         return state_in, state
 
     # -- compilation cache -------------------------------------------------
+    def _key_fields(self, feed_sig):
+        """Key fields for the shared store: program + feeds + fetch ORDER
+        (the executable returns outputs in this order) + the environment
+        fingerprint (jax/jaxlib/backend/device kind/x64/trace knobs) —
+        a toolchain change is a key miss, never a stale-blob load."""
+        return ("predict", self._program.fingerprint(), feed_sig,
+                tuple(self._fetch_names), _aot.env_fingerprint())
+
     def _key(self, feed_sig) -> str:
-        h = hashlib.sha1()
-        h.update(repr((self._program.fingerprint(), feed_sig,
-                       tuple(self._fetch_names),  # ORDER matters: the
-                       # executable returns outputs in this order
-                       jax.default_backend(), jax.__version__,
-                       )).encode())
-        return h.hexdigest()[:24]
+        return self._disk.key(self._key_fields(feed_sig))
+
+    def _meta(self, feed_sig) -> Dict:
+        return {"kind": "predict", "program": obs.program_fp(self._program),
+                "feed_sig": feed_sig,
+                "fetch_names": tuple(self._fetch_names),
+                "env": _aot.env_fingerprint(), "created": time.time()}
 
     def _step_fn(self):
         program = self._program
@@ -133,45 +155,45 @@ class Predictor:
     def _get_executable(self, feed_arrays):
         feed_sig = tuple((n, tuple(a.shape), str(a.dtype))
                          for n, a in sorted(feed_arrays.items()))
+        fp = obs.program_fp(self._program)
         if feed_sig in self._compiled:
             # per-dispatch hit accounting, same contract as kind=run/loop
             # (the resident-executable path dominates a steady server)
-            obs.CACHE_HITS.inc(kind="predict",
-                               program=obs.program_fp(self._program))
+            obs.CACHE_HITS.inc(kind="predict", tier="memory", program=fp)
             if feed_sig not in self._touched:
                 # record USE (once per process per signature) so the
                 # preload cap's recency ordering tracks traffic, not
                 # write time
                 self._touched.add(feed_sig)
-                self._touch_sig(os.path.join(
-                    self._cache_dir, self._key(feed_sig) + ".sig"))
+                self._disk.touch(self._key(feed_sig))
             return self._compiled[feed_sig]
+        obs.CACHE_MISSES.inc(kind="predict", tier="memory", program=fp)
         from .executor import Executor
 
         # fail fast with the variable name on an impossible feed shape
         Executor._check_feed_shapes(self._program, feed_sig)
 
         key = self._key(feed_sig)
-        path = os.path.join(self._cache_dir, key + ".xla")
-        loaded = (self._deserialize_executable(path)
-                  if self._aot_cache and os.path.exists(path) else None)
-        if loaded is not None:
-            obs.CACHE_HITS.inc(kind="predict",
-                               program=obs.program_fp(self._program))
-            obs.TIMELINE.record_compile(
-                "predict", obs.program_fp(self._program), cache="aot-load")
-            # a cache written before sidecars existed: create the .sig now
-            # so the NEXT process's preload finds this executable (without
-            # this, pre-sidecar caches would pay the lazy-deserialization
-            # first call forever)
-            sig_path = os.path.join(self._cache_dir, key + ".sig")
-            if not os.path.exists(sig_path):
-                self._write_sig(feed_sig, key)
+        loaded = None
+        if self._disk.enabled:
+            t0 = time.perf_counter()
+            loaded = self._disk.load(key)
+            if loaded is not None:
+                obs.CACHE_HITS.inc(kind="predict", tier="disk", program=fp)
+                obs.AOT_COMPILE_MS.observe(
+                    (time.perf_counter() - t0) * 1e3, path="warm",
+                    kind="predict")
+                obs.TIMELINE.record_compile("predict", fp, cache="aot-load")
+                if not self._disk.has_meta(key):
+                    # a cache written before sidecars existed: create the
+                    # .sig now so the NEXT process's preload finds this
+                    # executable (without this, pre-sidecar caches would
+                    # pay the lazy-deserialization first call forever)
+                    self._disk.write_meta(key, self._meta(feed_sig))
             else:
-                self._touch_sig(sig_path)
+                obs.CACHE_MISSES.inc(kind="predict", tier="disk",
+                                     program=fp)
         if loaded is None:
-            fp = obs.program_fp(self._program)
-            obs.CACHE_MISSES.inc(kind="predict", program=fp)
             fn = jax.jit(self._step_fn())
             t0 = time.perf_counter()
             lowered = fn.lower(
@@ -187,84 +209,28 @@ class Predictor:
             cost = obs.hlo_cost_stats(loaded) or {}
             obs.COMPILE_TOTAL.inc(kind="predict")
             obs.COMPILE_LATENCY_MS.observe((t2 - t0) * 1e3, kind="predict")
+            obs.AOT_COMPILE_MS.observe((t2 - t0) * 1e3, path="cold",
+                                       kind="predict")
             obs.TIMELINE.record_compile(
                 "predict", fp, wall_ms=(t2 - t0) * 1e3,
                 trace_ms=(t1 - t0) * 1e3, xla_ms=(t2 - t1) * 1e3, **cost)
-            if self._aot_cache:
-                from jax.experimental import serialize_executable as se
-
-                os.makedirs(self._cache_dir, exist_ok=True)
-                blob, in_tree, out_tree = se.serialize(loaded)
-                tmp = path + ".tmp.%d" % os.getpid()
-                with open(tmp, "wb") as f:
-                    pickle.dump((blob, in_tree, out_tree), f)
-                os.replace(tmp, path)
-                # sidecar records the feed signature so a later load can
-                # preload this executable without knowing the signature
-                self._write_sig(feed_sig, key)
+            # serialize + atomic write + sidecar + GC, all through the
+            # shared store (unwritable dir degrades to compile-only)
+            self._disk.store(key, loaded, meta=self._meta(feed_sig))
         self._compiled[feed_sig] = loaded
         return loaded
-
-    @staticmethod
-    def _touch_sig(sig_path):
-        try:
-            os.utime(sig_path, None)
-        except OSError:
-            pass  # shared/read-only cache: recency just doesn't update
-
-    def _write_sig(self, feed_sig, key: str):
-        try:
-            os.makedirs(self._cache_dir, exist_ok=True)
-            tmp = os.path.join(self._cache_dir,
-                               key + ".sigtmp.%d" % os.getpid())
-            with open(tmp, "wb") as f:
-                pickle.dump(feed_sig, f)
-            os.replace(tmp, os.path.join(self._cache_dir, key + ".sig"))
-        except OSError:
-            pass  # a read-only cache dir only loses preload, not serving
-
-    def _deserialize_executable(self, path):
-        from jax.experimental import serialize_executable as se
-
-        try:
-            with open(path, "rb") as f:
-                blob, in_tree, out_tree = pickle.load(f)
-            try:
-                # pin execution to one device: the executable was compiled
-                # single-device, and the default (all local devices) breaks
-                # under a multi-device runtime (e.g. the 8-virtual-CPU
-                # test mesh)
-                return se.deserialize_and_load(
-                    blob, in_tree, out_tree,
-                    execution_devices=jax.devices()[:1])
-            except TypeError:
-                # jax without the execution_devices kwarg (<= 0.4.x):
-                # the serialized executable carries its own single-device
-                # assignment, so the unpinned load is equivalent there
-                return se.deserialize_and_load(blob, in_tree, out_tree)
-        except Exception:
-            return None  # cache from another machine/version: rebuild
 
     def _preload_executables(self):
         """Load cached executables for this (program, backend, jax) at
         construction (VERDICT r3 weak #4: first-call latency was
         dominated by lazy AOT deserialization). Signatures come from the
-        .sig sidecars; keys that don't re-hash to their filename belong
-        to another program/backend/jax version and are skipped.
-        Construction cost is bounded: only the PADDLE_TPU_PRELOAD_MAX
-        (default 8) most-recently-used signatures preload — a deployment
-        whose traffic produced many batch shapes pays lazily for the
-        cold tail instead of deserializing everything up front."""
-        import glob
-
-        def mtime_or_zero(p):
-            # another process may clean/rewrite the shared cache between
-            # glob and stat; preload is best-effort, never a crash
-            try:
-                return os.path.getmtime(p)
-            except OSError:
-                return 0.0
-
+        shared store's sidecars; keys that don't re-hash to their
+        filename belong to another program/backend/jax version and are
+        skipped. Construction cost is bounded: only the
+        PADDLE_TPU_PRELOAD_MAX (default 8) most-recently-used signatures
+        preload — a deployment whose traffic produced many batch shapes
+        pays lazily for the cold tail instead of deserializing
+        everything up front."""
         try:
             cap = int(os.environ.get("PADDLE_TPU_PRELOAD_MAX", 8))
         except ValueError:
@@ -274,24 +240,15 @@ class Predictor:
                 "PADDLE_TPU_PRELOAD_MAX=%r is not an integer; using 8"
                 % os.environ.get("PADDLE_TPU_PRELOAD_MAX"))
             cap = 8
-        sig_paths = sorted(
-            glob.glob(os.path.join(self._cache_dir, "*.sig")),
-            key=mtime_or_zero, reverse=True)
-        for sig_path in sig_paths:
+        for key, meta in self._disk.sidecars_by_recency():
             if cap <= 0:
                 break
-            try:
-                with open(sig_path, "rb") as f:
-                    feed_sig = pickle.load(f)
-            except Exception:
+            feed_sig = meta.get("feed_sig")
+            if feed_sig is None or feed_sig in self._compiled:
                 continue
-            key = self._key(feed_sig)
-            if os.path.basename(sig_path) != key + ".sig":
-                continue
-            if feed_sig in self._compiled:
-                continue
-            loaded = self._deserialize_executable(
-                os.path.join(self._cache_dir, key + ".xla"))
+            if self._key(feed_sig) != key:
+                continue  # another program/backend/jax version
+            loaded = self._disk.load(key)
             if loaded is not None:
                 self._compiled[feed_sig] = loaded
                 cap -= 1

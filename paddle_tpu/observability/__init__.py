@@ -38,7 +38,9 @@ __all__ = [
     "LOADER_WORKERS", "PREDICT_LATENCY_MS", "PREDICT_REQUESTS",
     "PREDICT_BATCH_ROWS", "PREDICT_FAILURES", "PROFILER_EVENT_MS",
     "BENCH_ANOMALY_RETRIES", "SERVER_ROWS", "SERVER_BUCKET_FILL",
-    "SERVER_INFLIGHT_DEPTH", "SERVER_STAGE_MS",
+    "SERVER_INFLIGHT_DEPTH", "SERVER_STAGE_MS", "AOT_CACHE_BYTES",
+    "AOT_CACHE_WRITTEN_BYTES", "AOT_CACHE_EVICTIONS", "AOT_CACHE_CORRUPT",
+    "AOT_CACHE_ERRORS", "AOT_COMPILE_MS",
 ]
 
 # -- the shared instrument set (registered once, process-wide) -----------
@@ -51,10 +53,12 @@ COMPILE_LATENCY_MS = REGISTRY.histogram(
     "Wall time of each compilation (first call: trace+compile+run)")
 CACHE_HITS = REGISTRY.counter(
     "paddle_tpu_compile_cache_hits_total",
-    "Compile-cache hits, by kind and program fingerprint")
+    "Compile-cache hits, by kind, program fingerprint, and "
+    "tier=memory|disk (disk = persistent AOT executable store)")
 CACHE_MISSES = REGISTRY.counter(
     "paddle_tpu_compile_cache_misses_total",
-    "Compile-cache misses, by kind and program fingerprint")
+    "Compile-cache misses, by kind, program fingerprint, and "
+    "tier=memory|disk")
 CACHE_EVICTIONS = REGISTRY.counter(
     "paddle_tpu_compile_cache_evictions_total",
     "Compile-cache LRU evictions (cap: PADDLE_TPU_COMPILE_CACHE_MAX)")
@@ -130,6 +134,30 @@ SERVER_STAGE_MS = REGISTRY.histogram(
     "paddle_tpu_server_stage_ms",
     "Per-batch wall time of each serving pipeline stage "
     "(stage=stack|device)")
+AOT_CACHE_BYTES = REGISTRY.gauge(
+    "paddle_tpu_aot_cache_bytes",
+    "On-disk size of the persistent AOT executable cache after the last "
+    "store/GC, by cache dir")
+AOT_CACHE_WRITTEN_BYTES = REGISTRY.counter(
+    "paddle_tpu_aot_cache_written_bytes_total",
+    "Serialized executable bytes written to the AOT disk cache")
+AOT_CACHE_EVICTIONS = REGISTRY.counter(
+    "paddle_tpu_aot_cache_evictions_total",
+    "AOT disk-cache entries evicted by the mtime-LRU GC "
+    "(bound: PADDLE_TPU_AOT_CACHE_MAX_BYTES)")
+AOT_CACHE_CORRUPT = REGISTRY.counter(
+    "paddle_tpu_aot_cache_corrupt_total",
+    "Unreadable AOT cache payloads, reason=blob|sidecar (blobs are "
+    "quarantined *.corrupt and recompiled — never a crash)")
+AOT_CACHE_ERRORS = REGISTRY.counter(
+    "paddle_tpu_aot_cache_errors_total",
+    "AOT disk-cache operations that degraded to compile-only, by "
+    "op=serialize|store (e.g. read-only cache dir)")
+AOT_COMPILE_MS = REGISTRY.histogram(
+    "paddle_tpu_aot_compile_ms",
+    "Executable acquisition wall time on the AOT path, by kind and "
+    "path=cold (explicit lower+XLA compile) | warm (disk deserialize) — "
+    "the cold-start-vs-warm-start distribution")
 PROFILER_EVENT_MS = REGISTRY.summary(
     "paddle_tpu_profiler_event_ms",
     "Legacy profiler event table (exact count/sum/min/max per event)")

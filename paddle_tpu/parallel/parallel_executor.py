@@ -336,8 +336,11 @@ class ParallelExecutor:
         fp = obs.program_fp(self._program)
         compiled = self._cache.get(key)
         first_run = compiled is None
+        # tier=memory: sharded multi-device executables stay memory-only
+        # (serialize_executable round-trips single-device executables; the
+        # mesh path would need per-topology keys — see runtime/aot_cache)
         (obs.CACHE_HITS if compiled is not None else obs.CACHE_MISSES
-         ).inc(kind="parallel", program=fp)
+         ).inc(kind="parallel", tier="memory", program=fp)
         if compiled is None:
             compiled = self._compile(feed_sig, fetch_names, loop=loop)
             self._cache[key] = compiled

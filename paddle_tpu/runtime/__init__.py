@@ -1,6 +1,8 @@
 """C++ host runtime (built on import from runtime.cc, with pure-Python
 fallbacks): recordio chunk IO, prefetch readers, bounded channels, staging
-arena. See runtime.cc for the reference mapping."""
+arena (see runtime.cc for the reference mapping) — plus the persistent
+AOT executable cache (`aot_cache` submodule, imported lazily so this
+package stays importable without pulling the observability registry)."""
 from .recordio import (  # noqa: F401
     Channel,
     PrefetchReader,
@@ -13,8 +15,20 @@ from .recordio import (  # noqa: F401
     recordio_sample_reader,
 )
 
+def __getattr__(name):
+    if name == "aot_cache":
+        # importlib, NOT `from . import ...`: the from-import form asks
+        # this package for the attribute first, which re-enters this
+        # __getattr__ and recurses
+        import importlib
+
+        return importlib.import_module(".aot_cache", __name__)
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))
+
+
 __all__ = [
     "Channel",
+    "aot_cache",
     "PrefetchReader",
     "RecordIOError",
     "RecordIOReader",

@@ -1,0 +1,432 @@
+"""Persistent on-disk AOT executable cache, shared by training and serving.
+
+The reference framework never recompiles: a ProgramDesc is interpreted
+op-by-op, so a fresh process starts executing immediately. Our TPU-native
+executor instead compiles the whole Program into one XLA executable —
+which makes *cold start* (restarts, preemption recovery, CI, sweep
+workers) pay a full trace + XLA compile before step 1. This module is the
+warm-start store both `Executor` (training step + fused loop) and
+`inference.Predictor` (serving) write their executables into, keyed so a
+later process with the same program/feeds/toolchain deserializes instead
+of recompiling.
+
+Design rules (the "never a crash" contract):
+
+- Keys are content hashes over (kind, program fingerprint + version, feed
+  signature, fetch/state names, per-step feed set) PLUS the environment
+  fingerprint (jax/jaxlib versions, backend, device kind, x64 flag,
+  XLA_FLAGS, trace-affecting PADDLE_TPU_* knobs). A toolchain or backend
+  change is therefore a plain MISS, never a deserialization attempt of an
+  incompatible blob.
+- Writes are atomic (tmp + `os.replace`); concurrent writers of the same
+  key are idempotent (last rename wins, both blobs identical).
+- A blob that fails to unpickle/deserialize anyway (truncation, foreign
+  machine) is QUARANTINED (renamed `*.corrupt`) and treated as a miss —
+  the caller recompiles; nothing raises through the executor.
+- A read-only or unwritable cache directory degrades to compile-only
+  (counted, not raised).
+- Size is bounded by an mtime-LRU GC (`PADDLE_TPU_AOT_CACHE_MAX_BYTES`,
+  default 1 GiB, 0 = unbounded); `load()`/use touches the entry so GC
+  eviction order tracks traffic, not write time.
+
+Layout (one format for serving and training): `<key>.xla` is the pickled
+`(blob, in_tree, out_tree)` triple from
+`jax.experimental.serialize_executable`; `<key>.sig` is a pickled metadata
+dict (format version, kind, program fingerprint, feed signature, fetch
+names, env fingerprint, creation time) that lets `Predictor` preload
+executables without knowing their feed signatures up front and lets
+`tools/aot_cache_ls.py` inspect entries without jax.
+
+Env knobs:
+- ``PADDLE_TPU_AOT_CACHE=0``        — kill switch (memory-only compiles)
+- ``PADDLE_TPU_AOT_CACHE_DIR``      — training-side cache directory
+  (default ``$XDG_CACHE_HOME/paddle_tpu/aot`` or ``~/.cache/...``);
+  `Predictor` keeps its per-model ``<model_dir>/__aot_cache__``
+- ``PADDLE_TPU_AOT_CACHE_MAX_BYTES``— GC bound (default 1 GiB, 0 = off)
+- ``PADDLE_TPU_JAX_CACHE_DIR``      — opt-in SECOND tier: jax's own
+  persistent compilation cache (caches XLA output keyed on HLO, so even a
+  *changed* program whose subcomputations match still compiles faster)
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import time
+import warnings
+from typing import Any, Dict, List, Optional, Tuple
+
+from .. import observability as obs
+
+__all__ = [
+    "AotDiskCache", "default_cache_dir", "enabled_by_env",
+    "max_bytes_from_env", "env_fingerprint", "trace_env_fingerprint",
+    "serialize_executable", "deserialize_executable",
+    "maybe_enable_jax_cache", "FORMAT_VERSION", "BLOB_SUFFIX",
+    "META_SUFFIX", "QUARANTINE_SUFFIX", "DEFAULT_MAX_BYTES",
+]
+
+FORMAT_VERSION = 1
+BLOB_SUFFIX = ".xla"
+META_SUFFIX = ".sig"
+QUARANTINE_SUFFIX = ".corrupt"
+DEFAULT_MAX_BYTES = 1 << 30  # 1 GiB
+
+# Env vars consumed INSIDE op lowering (trace time): they change the HLO
+# without changing the Program fingerprint, so they must be part of the
+# key or a cached executable could silently carry the wrong kernel
+# configuration into a process with different knobs. Model-CONSTRUCTION
+# knobs (PADDLE_TPU_ATTN_BTHD, PADDLE_TPU_FUSED_QKV, ...) change the
+# program itself and are already covered by the fingerprint.
+_TRACE_ENV = (
+    "PADDLE_TPU_ATTN_BLOCK_K",
+    "PADDLE_TPU_DIM_SEMANTICS",
+    "PADDLE_TPU_FLASH_BQ",
+    "PADDLE_TPU_FLASH_BK",
+    "PADDLE_TPU_FLASH_FUSED_BWD",
+    "PADDLE_TPU_FORCE_PALLAS",
+    "PADDLE_TPU_NO_PALLAS",
+    "PADDLE_TPU_LMHEAD_BLOCK",
+    "PADDLE_TPU_LMHEAD_UNROLL",
+    "PADDLE_TPU_MUL_DWT",
+    "PADDLE_TPU_RING_CHUNK",
+)
+
+
+def default_cache_dir() -> str:
+    d = os.environ.get("PADDLE_TPU_AOT_CACHE_DIR")
+    if d:
+        return os.path.expanduser(d)
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache")
+    return os.path.join(base, "paddle_tpu", "aot")
+
+
+def enabled_by_env() -> bool:
+    return os.environ.get("PADDLE_TPU_AOT_CACHE", "1") != "0"
+
+
+def max_bytes_from_env() -> int:
+    raw = os.environ.get("PADDLE_TPU_AOT_CACHE_MAX_BYTES")
+    if not raw:
+        return DEFAULT_MAX_BYTES
+    try:
+        return int(raw)
+    except ValueError:
+        # cache management is best-effort, never a crash (the
+        # PADDLE_TPU_PRELOAD_MAX precedent)
+        warnings.warn(
+            "PADDLE_TPU_AOT_CACHE_MAX_BYTES=%r is not an integer; using "
+            "the default (%d)" % (raw, DEFAULT_MAX_BYTES))
+        return DEFAULT_MAX_BYTES
+
+
+def trace_env_fingerprint() -> Tuple[Tuple[str, str], ...]:
+    """(name, value) for every SET trace-affecting env knob."""
+    return tuple((k, os.environ[k]) for k in _TRACE_ENV if k in os.environ)
+
+
+def env_fingerprint() -> Tuple:
+    """Everything outside the Program that shapes the compiled
+    executable. Two processes whose fingerprints differ can never share
+    an entry — a version/backend mismatch is a key miss by construction,
+    so stale blobs are unreachable rather than a deserialization risk."""
+    import jax
+    import jaxlib
+
+    try:
+        dev = jax.devices()[0]
+        device_kind = getattr(dev, "device_kind", "?")
+    except Exception:  # backend init failure: still produce a stable key
+        device_kind = "?"
+    return (
+        "fmt%d" % FORMAT_VERSION,
+        jax.__version__,
+        jaxlib.__version__,
+        jax.default_backend(),
+        device_kind,
+        bool(jax.config.jax_enable_x64),
+        os.environ.get("XLA_FLAGS", ""),
+        trace_env_fingerprint(),
+    )
+
+
+def serialize_executable(compiled) -> bytes:
+    """jax Compiled -> bytes (the shared on-disk payload format)."""
+    from jax.experimental import serialize_executable as se
+
+    blob, in_tree, out_tree = se.serialize(compiled)
+    return pickle.dumps((blob, in_tree, out_tree), protocol=4)
+
+
+def deserialize_executable(payload: bytes):
+    """bytes -> jax Compiled (raises on any corruption — callers go
+    through AotDiskCache.load, which quarantines)."""
+    import jax
+    from jax.experimental import serialize_executable as se
+
+    blob, in_tree, out_tree = pickle.loads(payload)
+    try:
+        # pin execution to one device: the executable was compiled
+        # single-device, and the default (all local devices) breaks under
+        # a multi-device runtime (e.g. the 8-virtual-CPU test mesh)
+        return se.deserialize_and_load(
+            blob, in_tree, out_tree, execution_devices=jax.devices()[:1])
+    except TypeError:
+        # jax without the execution_devices kwarg: the serialized
+        # executable carries its own single-device assignment, so the
+        # unpinned load is equivalent there
+        return se.deserialize_and_load(blob, in_tree, out_tree)
+
+
+_JAX_CACHE_APPLIED = False
+
+
+def maybe_enable_jax_cache():
+    """Opt-in second tier: jax's persistent compilation cache, keyed on
+    HLO rather than our Program-level key — it helps even when OUR key
+    misses (e.g. a program edit that leaves most subcomputations
+    intact). Enabled once per process when PADDLE_TPU_JAX_CACHE_DIR is
+    set; thresholds drop to 0 so small test-sized programs cache too."""
+    global _JAX_CACHE_APPLIED
+    if _JAX_CACHE_APPLIED:
+        return
+    d = os.environ.get("PADDLE_TPU_JAX_CACHE_DIR")
+    if not d:
+        return
+    _JAX_CACHE_APPLIED = True  # one attempt per process, success or not
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", os.path.expanduser(d))
+        for knob, val in (("jax_persistent_cache_min_compile_time_secs", 0),
+                          ("jax_persistent_cache_min_entry_size_bytes", 0)):
+            try:
+                jax.config.update(knob, val)
+            except Exception:
+                pass  # knob renamed/absent on this jax: dir alone suffices
+    except Exception as e:
+        warnings.warn("PADDLE_TPU_JAX_CACHE_DIR could not be applied: %s" % e)
+
+
+class AotDiskCache:
+    """One cache directory: load/store/touch/GC with the module-docstring
+    failure contract. Instances are cheap (env resolved at construction,
+    no I/O until used); Executor and Predictor each hold their own."""
+
+    def __init__(self, cache_dir: Optional[str] = None,
+                 max_bytes: Optional[int] = None,
+                 enabled: Optional[bool] = None):
+        self.dir = (os.path.expanduser(cache_dir) if cache_dir
+                    else default_cache_dir())
+        self.max_bytes = (max_bytes_from_env() if max_bytes is None
+                          else int(max_bytes))
+        want = True if enabled is None else bool(enabled)
+        self.enabled = want and enabled_by_env()
+
+    # -- keys and paths ---------------------------------------------------
+    @staticmethod
+    def key(fields) -> str:
+        """Stable 24-hex content key over a tuple of picklable/reprable
+        key fields (repr of tuples/strings/ints is deterministic)."""
+        return hashlib.sha1(repr(tuple(fields)).encode()).hexdigest()[:24]
+
+    def blob_path(self, key: str) -> str:
+        return os.path.join(self.dir, key + BLOB_SUFFIX)
+
+    def meta_path(self, key: str) -> str:
+        return os.path.join(self.dir, key + META_SUFFIX)
+
+    # -- load/store -------------------------------------------------------
+    def load(self, key: str):
+        """Deserialized executable, or None (miss / disabled / corrupt —
+        corrupt blobs are quarantined and counted, never raised)."""
+        if not self.enabled:
+            return None
+        path = self.blob_path(key)
+        try:
+            with open(path, "rb") as f:
+                payload = f.read()
+        except OSError:
+            return None  # plain miss
+        try:
+            exe = deserialize_executable(payload)
+        except Exception:
+            self._quarantine(key)
+            obs.AOT_CACHE_CORRUPT.inc(reason="blob")
+            return None
+        self.touch(key)
+        return exe
+
+    def store(self, key: str, compiled, meta: Optional[Dict] = None) -> bool:
+        """Serialize + atomic write + sidecar + GC. Returns False (with a
+        counter) instead of raising on ANY failure — an unwritable cache
+        loses warm starts, not execution."""
+        if not self.enabled:
+            return False
+        try:
+            payload = serialize_executable(compiled)
+        except Exception:
+            # executable kind (or backend) without serialization support
+            obs.AOT_CACHE_ERRORS.inc(op="serialize")
+            return False
+        tmp = None
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            tmp = self.blob_path(key) + ".tmp.%d" % os.getpid()
+            with open(tmp, "wb") as f:
+                f.write(payload)
+            os.replace(tmp, self.blob_path(key))
+        except OSError:
+            obs.AOT_CACHE_ERRORS.inc(op="store")
+            if tmp is not None:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+            return False
+        if meta is not None:
+            self.write_meta(key, meta)
+        obs.AOT_CACHE_WRITTEN_BYTES.inc(len(payload))
+        self.gc()
+        return True
+
+    def _quarantine(self, key: str):
+        """Move a bad blob aside (one postmortem copy per key; GC removes
+        stale quarantines) and drop its sidecar so preload scans skip it."""
+        try:
+            os.replace(self.blob_path(key),
+                       self.blob_path(key) + QUARANTINE_SUFFIX)
+        except OSError:
+            pass
+        try:
+            os.unlink(self.meta_path(key))
+        except OSError:
+            pass
+
+    # -- sidecar metadata -------------------------------------------------
+    def write_meta(self, key: str, meta: Dict) -> bool:
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            tmp = self.meta_path(key) + ".tmp.%d" % os.getpid()
+            with open(tmp, "wb") as f:
+                pickle.dump(dict(meta, v=FORMAT_VERSION), f, protocol=4)
+            os.replace(tmp, self.meta_path(key))
+            return True
+        except OSError:
+            obs.AOT_CACHE_ERRORS.inc(op="store")
+            return False
+
+    def read_meta(self, key: str) -> Optional[Dict]:
+        try:
+            with open(self.meta_path(key), "rb") as f:
+                meta = pickle.load(f)
+        except OSError:
+            return None
+        except Exception:
+            obs.AOT_CACHE_CORRUPT.inc(reason="sidecar")
+            return None
+        return meta if isinstance(meta, dict) else None
+
+    def has_meta(self, key: str) -> bool:
+        return os.path.exists(self.meta_path(key))
+
+    def touch(self, key: str):
+        """Refresh mtime so LRU eviction order tracks USE. Best-effort:
+        a shared/read-only cache just doesn't update recency."""
+        for p in (self.blob_path(key), self.meta_path(key)):
+            try:
+                os.utime(p, None)
+            except OSError:
+                pass
+
+    # -- enumeration (preload + tools) -----------------------------------
+    def entries(self) -> List[Dict[str, Any]]:
+        """[{key, path, bytes, mtime, meta}] for every blob, newest
+        first. meta is the sidecar dict or None; missing/corrupt sidecars
+        do not hide their blob."""
+        out = []
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return out
+        for n in names:
+            if not n.endswith(BLOB_SUFFIX):
+                continue
+            key = n[:-len(BLOB_SUFFIX)]
+            p = os.path.join(self.dir, n)
+            try:
+                st = os.stat(p)
+            except OSError:
+                continue  # racing writer/GC: scan is best-effort
+            out.append({"key": key, "path": p, "bytes": st.st_size,
+                        "mtime": st.st_mtime, "meta": self.read_meta(key)})
+        out.sort(key=lambda e: e["mtime"], reverse=True)
+        return out
+
+    def sidecars_by_recency(self) -> List[Tuple[str, Dict]]:
+        """(key, meta) for every entry with a readable sidecar, newest
+        first — the Predictor preload scan."""
+        return [(e["key"], e["meta"]) for e in self.entries()
+                if e["meta"] is not None]
+
+    def total_bytes(self) -> int:
+        total = 0
+        try:
+            for n in os.listdir(self.dir):
+                try:
+                    total += os.stat(os.path.join(self.dir, n)).st_size
+                except OSError:
+                    pass
+        except OSError:
+            pass
+        return total
+
+    # -- GC ---------------------------------------------------------------
+    def gc(self, max_bytes: Optional[int] = None) -> List[str]:
+        """mtime-LRU: evict oldest (blob, sidecar) pairs until the
+        directory fits `max_bytes` (<= 0 = unbounded). Stale tmp files
+        and quarantined blobs older than an hour are removed regardless
+        (crashed writers / already-diagnosed corruption). Returns evicted
+        keys; also refreshes the byte-size gauge."""
+        limit = self.max_bytes if max_bytes is None else max_bytes
+        evicted: List[str] = []
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return evicted
+        now = time.time()
+        total = 0
+        blobs = []
+        for n in names:
+            p = os.path.join(self.dir, n)
+            try:
+                st = os.stat(p)
+            except OSError:
+                continue
+            if ".tmp." in n or n.endswith(QUARANTINE_SUFFIX):
+                if now - st.st_mtime > 3600:
+                    try:
+                        os.unlink(p)
+                        continue
+                    except OSError:
+                        pass
+            total += st.st_size
+            if n.endswith(BLOB_SUFFIX):
+                blobs.append((st.st_mtime, st.st_size, n[:-len(BLOB_SUFFIX)]))
+        if limit > 0 and total > limit:
+            blobs.sort()  # oldest first
+            for _mt, size, key in blobs:
+                if total <= limit:
+                    break
+                for p in (self.blob_path(key), self.meta_path(key)):
+                    try:
+                        sz = os.stat(p).st_size
+                        os.unlink(p)
+                        total -= sz
+                    except OSError:
+                        pass
+                evicted.append(key)
+                obs.AOT_CACHE_EVICTIONS.inc()
+        obs.AOT_CACHE_BYTES.set(total, dir=self.dir)
+        return evicted

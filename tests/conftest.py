@@ -10,6 +10,18 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
+import tempfile
+
+# Isolate the persistent AOT executable cache (runtime/aot_cache.py): the
+# suite still exercises the disk tier (warm-start reuse across tests is
+# by design — identical fingerprints load instead of recompiling), but in
+# a per-session tmp dir instead of the operator's cache — UNCONDITIONAL,
+# so a developer's exported PADDLE_TPU_AOT_CACHE_DIR is never polluted
+# (or GC-evicted) by test traffic. Subprocess tests (metrics_dump, bench
+# smokes) inherit the tmp dir through os.environ.
+os.environ["PADDLE_TPU_AOT_CACHE_DIR"] = tempfile.mkdtemp(
+    prefix="ptpu-aot-t1-")
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")

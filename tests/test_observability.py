@@ -86,12 +86,14 @@ def test_run_then_identical_run_is_one_miss_one_hit():
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(fluid.default_startup_program())
 
-    miss0 = obs.CACHE_MISSES.value(kind="run", program=fp)
-    hit0 = obs.CACHE_HITS.value(kind="run", program=fp)
+    miss0 = obs.CACHE_MISSES.value(kind="run", tier="memory", program=fp)
+    hit0 = obs.CACHE_HITS.value(kind="run", tier="memory", program=fp)
     exe.run(prog, feed=_feed(), fetch_list=[loss])
     exe.run(prog, feed=_feed(), fetch_list=[loss])
-    assert obs.CACHE_MISSES.value(kind="run", program=fp) - miss0 == 1
-    assert obs.CACHE_HITS.value(kind="run", program=fp) - hit0 == 1
+    assert obs.CACHE_MISSES.value(
+        kind="run", tier="memory", program=fp) - miss0 == 1
+    assert obs.CACHE_HITS.value(
+        kind="run", tier="memory", program=fp) - hit0 == 1
 
 
 def test_run_loop_windows_do_not_double_count():
@@ -103,15 +105,17 @@ def test_run_loop_windows_do_not_double_count():
 
     steps0 = obs.STEPS_TOTAL.value(kind="loop")
     disp0 = obs.STEP_LATENCY_MS.stats(kind="loop")["count"]
-    miss0 = obs.CACHE_MISSES.value(kind="loop", program=fp)
-    hit0 = obs.CACHE_HITS.value(kind="loop", program=fp)
+    miss0 = obs.CACHE_MISSES.value(kind="loop", tier="memory", program=fp)
+    hit0 = obs.CACHE_HITS.value(kind="loop", tier="memory", program=fp)
     exe.run_loop(prog, feed=_feed(), fetch_list=[loss], steps=3)
     exe.run_loop(prog, feed=_feed(), fetch_list=[loss], steps=3)
     # 2 windows = 2 dispatches but 6 steps; the loop compiles ONCE
     assert obs.STEPS_TOTAL.value(kind="loop") - steps0 == 6
     assert obs.STEP_LATENCY_MS.stats(kind="loop")["count"] - disp0 == 2
-    assert obs.CACHE_MISSES.value(kind="loop", program=fp) - miss0 == 1
-    assert obs.CACHE_HITS.value(kind="loop", program=fp) - hit0 == 1
+    assert obs.CACHE_MISSES.value(
+        kind="loop", tier="memory", program=fp) - miss0 == 1
+    assert obs.CACHE_HITS.value(
+        kind="loop", tier="memory", program=fp) - hit0 == 1
 
 
 def test_feed_fetch_bytes_accounted():
