@@ -88,6 +88,8 @@ from . import evaluator  # noqa: F401
 from . import profiler  # noqa: F401
 from . import debugger  # noqa: F401
 from .framework.verifier import verify_program, ProgramVerifyError  # noqa: F401
+from . import analysis  # noqa: F401
+from .analysis import analyze_program, AnalysisError  # noqa: F401
 from .ops.registry import op_support_tpu, registered_ops, OpProtoHolder  # noqa: F401
 from .trainer import (  # noqa: F401
     BeginEpochEvent,

@@ -1,0 +1,46 @@
+"""paddle_tpu.analysis — whole-Program static analysis.
+
+The reference validates Programs through per-op InferShape/InferVarType
+passes (paddle/fluid/framework/shape_inference.h); this package is that
+layer rebuilt for the Python-native IR, plus the lints TPU execution
+actually needs:
+
+- ``infer``: a per-op shape/dtype inference registry
+  (``@register_infer("matmul")`` mirroring ``ops/registry.py``) and an
+  abstract-interpretation driver propagating ``(shape, dtype)`` lattice
+  values through a whole Program — control-flow sub-blocks via a fixed
+  point over loop carries — attaching results to the Variables.
+- ``rules``: the rule set for the high-traffic ops (math, nn, attention,
+  rnn/sequence, optimizers); ``tests/op_test.py:check_infer``
+  cross-checks every rule against traced-kernel shapes.
+- ``lints``: diagnostics framework hosting shape/dtype mismatch,
+  TPU static-shape, recompile-risk, dead-code, and the former
+  ``framework/verifier.py`` def-use rules.
+- ``analyzer``: one-call orchestration + PADDLE_TPU_VERIFY integration +
+  trace-error re-rendering + observability counters.
+
+CLI: ``python tools/program_lint.py --example all --json``.
+"""
+from .analyzer import (  # noqa: F401
+    AnalysisError, ProgramAnalysis, analyze_program, enforce,
+    explain_trace_error, verify_mode,
+)
+from .diagnostics import (  # noqa: F401
+    Diagnostic, Report, closest_names, did_you_mean,
+)
+from .infer import (  # noqa: F401
+    InferContext, InferError, VarInfo, get_infer_rule, infer_program,
+    register_infer, registered_infer_ops, render_shape,
+)
+from .lints import LINTS, LintContext, register_lint, run_lints  # noqa: F401
+from . import rules  # noqa: F401  — populate the infer registry eagerly
+
+__all__ = [
+    "AnalysisError", "ProgramAnalysis", "analyze_program", "enforce",
+    "explain_trace_error", "verify_mode",
+    "Diagnostic", "Report", "closest_names", "did_you_mean",
+    "InferContext", "InferError", "VarInfo", "get_infer_rule",
+    "infer_program", "register_infer", "registered_infer_ops",
+    "render_shape",
+    "LINTS", "LintContext", "register_lint", "run_lints",
+]

@@ -1,0 +1,189 @@
+"""Whole-Program analyzer: inference + lints + observability, one entry.
+
+``analyze_program`` is what everything calls:
+
+- ``Executor``/``Predictor`` run it pre-trace behind ``PADDLE_TPU_VERIFY``
+  (``1`` = errors raise, warnings warn; ``strict`` = warnings raise too),
+- ``framework.verifier.verify_program`` (now a shim) runs the def-use
+  subset on every compile, exactly as before,
+- ``tools/program_lint.py`` runs the full pass and renders text/JSON.
+
+Results feed the observability registry
+(``paddle_tpu_analysis_issues_total`` by code+severity,
+``paddle_tpu_analysis_infer_coverage`` per program fingerprint), so
+analyzer findings are scrapeable next to the compile/step series.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+from ..framework.verifier import ProgramVerifyError
+from .diagnostics import Report
+from .infer import (
+    ProgramInference, infer_program, render_shape,
+)
+from .lints import DEF_USE_LINTS, LintContext, run_lints
+
+__all__ = [
+    "ProgramAnalysis", "analyze_program", "verify_mode",
+    "explain_trace_error", "AnalysisError",
+]
+
+
+class AnalysisError(ProgramVerifyError):
+    """Raised by strict/verify integrations on error findings; carries
+    the full report. Subclasses ProgramVerifyError so callers catching
+    the legacy verifier exception keep working under
+    PADDLE_TPU_VERIFY=1."""
+
+    def __init__(self, message: str, report: Report):
+        super().__init__(message)
+        self.report = report
+
+
+class ProgramAnalysis:
+    """Bundle of everything one pass produced."""
+
+    def __init__(self, program, report: Report,
+                 inference: Optional[ProgramInference]):
+        self.program = program
+        self.report = report
+        self.inference = inference
+
+    # conveniences mirrored from the report
+    @property
+    def errors(self):
+        return self.report.errors
+
+    @property
+    def warnings(self):
+        return self.report.warnings
+
+    @property
+    def coverage(self) -> float:
+        return self.report.coverage
+
+    def render(self, min_severity: str = "info") -> str:
+        return self.report.render(min_severity)
+
+    def to_dict(self):
+        return self.report.to_dict()
+
+
+def analyze_program(program, feed_names: Sequence[str] = (),
+                    fetch_names: Sequence[str] = (),
+                    level: str = "full",
+                    observe: bool = True) -> ProgramAnalysis:
+    """Run the static analyzer.
+
+    level="verify": only the def-use rules (cheap; what every compile
+    pays — the former framework/verifier.py behavior).
+    level="full": shape/dtype inference over the whole program plus every
+    lint rule.
+    """
+    report = Report()
+    inference = None
+    if level == "full":
+        inference = infer_program(program, feed_names, report=report)
+    ctx = LintContext(program, report, feed_names=feed_names,
+                      fetch_names=fetch_names, inference=inference)
+    run_lints(ctx, only=DEF_USE_LINTS if level == "verify" else None)
+    if observe and level == "full":
+        _observe(program, report)
+    return ProgramAnalysis(program, report, inference)
+
+
+def _observe(program, report: Report):
+    try:
+        from .. import observability as obs
+
+        fp = obs.program_fp(program)
+        for d in report:
+            obs.ANALYSIS_ISSUES.inc(code=d.code, severity=d.severity)
+        obs.ANALYSIS_COVERAGE.set(report.coverage, program=fp)
+    except Exception:  # metrics must never break analysis
+        pass
+
+
+def verify_mode() -> str:
+    """The PADDLE_TPU_VERIFY knob: "" (default, def-use only), "1"
+    (full analysis: errors raise, warnings warn), or "strict" (warnings
+    raise too)."""
+    v = os.environ.get("PADDLE_TPU_VERIFY", "").strip().lower()
+    if v in ("", "0", "off", "false"):
+        return ""
+    if v == "strict":
+        return "strict"
+    return "1"
+
+
+def enforce(analysis: ProgramAnalysis, strict: bool = False):
+    """Raise AnalysisError on error findings (strict: warnings too);
+    otherwise emit python warnings for warning-level findings."""
+    import warnings as _warnings
+
+    floor = "warning" if strict else "error"
+    fatal = analysis.report.at_least(floor)
+    if fatal:
+        raise AnalysisError(
+            "static analysis failed (%d finding%s):\n  %s"
+            % (len(fatal), "s" if len(fatal) != 1 else "",
+               "\n  ".join(d.render() for d in fatal)),
+            analysis.report)
+    for d in analysis.report.warnings:
+        _warnings.warn("program analyzer: " + d.render())
+
+
+def explain_trace_error(program, exc, feed_names: Sequence[str] = (),
+                        fetch_names: Sequence[str] = ()) -> Optional[str]:
+    """Re-render a trace-time failure with the analyzer's per-op
+    provenance. ``exc`` is a TraceError whose ``pt_block_idx`` /
+    ``pt_op_idx`` / ``pt_op_type`` attributes the tracer stamped; returns
+    a text block to append to the error message, or None when there is
+    nothing useful to add. Pass the run's ``feed_names`` — without them
+    the def-use lint would (correctly, from its viewpoint) flag every
+    feed var as use-before-def and drown the real finding."""
+    block_idx = getattr(exc, "pt_block_idx", None)
+    op_idx = getattr(exc, "pt_op_idx", None)
+    if block_idx is None or op_idx is None:
+        return None
+    try:
+        analysis = analyze_program(program, feed_names=feed_names,
+                                   fetch_names=fetch_names, level="full",
+                                   observe=False)
+    except Exception:
+        return None
+    try:
+        block = program.blocks[block_idx]
+        op = block.ops[op_idx]
+    except (IndexError, AttributeError):
+        return None
+    inf = analysis.inference
+    lines = ["analyzer provenance: block %d op %d (%s)"
+             % (block_idx, op_idx, op.type)]
+    for slot, names in op.inputs.items():
+        for n in names:
+            vi = inf.info(n, block_idx)
+            lines.append("  input  %s=%r: %s %s"
+                         % (slot, n, render_shape(vi.shape),
+                            vi.dtype or "?"))
+    for slot, names in op.outputs.items():
+        for n in names:
+            vi = inf.info(n, block_idx)
+            lines.append("  output %s=%r: %s %s"
+                         % (slot, n, render_shape(vi.shape),
+                            vi.dtype or "?"))
+    # liveness/recompile findings need the caller's fetch context to be
+    # meaningful — keep the post-mortem to contract violations
+    here = [d for d in analysis.report.for_op(block_idx, op_idx)
+            if d.code not in ("dead-op", "dead-var", "recompile-risk")]
+    for d in here:
+        lines.append("  finding: " + d.render().replace("\n", "\n  "))
+    if not here:
+        other = analysis.report.at_least("error")
+        if other:
+            lines.append("  other errors elsewhere in the program:")
+            lines.extend("    " + d.render().split("\n")[0]
+                         for d in other[:5])
+    return "\n".join(lines)

@@ -1,0 +1,179 @@
+"""Diagnostics: structured findings with op-level provenance.
+
+Every analyzer/lint finding is a :class:`Diagnostic` pinning WHERE in the
+Program IR the problem sits (block index, op index, op type, variable) and
+WHAT to do about it (a fix hint). The reference surfaces the same class of
+errors through PADDLE_ENFORCE messages inside per-op InferShape
+(paddle/fluid/framework/shape_inference.h) at AddOp time; here the whole
+Program is analyzed in one pre-trace pass and findings are collected
+instead of thrown one at a time, so a single run reports everything.
+
+Shared rendering helpers (``did_you_mean``) are also used by
+``ops.registry.get_kernel`` so registry errors and analyzer diagnostics
+speak the same language.
+"""
+from __future__ import annotations
+
+import difflib
+from typing import Dict, List, Optional, Sequence
+
+__all__ = [
+    "Diagnostic", "Report", "SEVERITIES", "closest_names", "did_you_mean",
+]
+
+# ordered weakest -> strongest; "note" is analyzer self-check chatter
+# (declared-vs-inferred drift), "info" is FYI (dead vars, expected dynamic
+# batch), "warning" is a smell (write-once, recompile risk), "error" is a
+# defect that will fail or misbehave at trace/run time.
+SEVERITIES = ("note", "info", "warning", "error")
+
+
+def _sev_rank(severity: str) -> int:
+    return SEVERITIES.index(severity)
+
+
+class Diagnostic:
+    """One finding. ``code`` is a stable kebab-case identifier (tests and
+    tooling key on it); ``message`` is human text; ``hint`` says how to
+    fix. Provenance fields may be None for program-level findings."""
+
+    __slots__ = ("severity", "code", "message", "block_idx", "op_idx",
+                 "op_type", "var", "hint")
+
+    def __init__(self, severity: str, code: str, message: str,
+                 block_idx: Optional[int] = None,
+                 op_idx: Optional[int] = None,
+                 op_type: Optional[str] = None,
+                 var: Optional[str] = None,
+                 hint: Optional[str] = None):
+        if severity not in SEVERITIES:
+            raise ValueError("unknown severity %r" % (severity,))
+        self.severity = severity
+        self.code = code
+        self.message = message
+        self.block_idx = block_idx
+        self.op_idx = op_idx
+        self.op_type = op_type
+        self.var = var
+        self.hint = hint
+
+    @property
+    def where(self) -> str:
+        parts = []
+        if self.block_idx is not None:
+            parts.append("block %d" % self.block_idx)
+        if self.op_idx is not None:
+            parts.append("op %d" % self.op_idx)
+        if self.op_type is not None:
+            parts.append("(%s)" % self.op_type)
+        return " ".join(parts)
+
+    def render(self) -> str:
+        where = self.where
+        out = "[%s] %s%s: %s" % (self.severity, self.code,
+                                 " @ " + where if where else "", self.message)
+        if self.hint:
+            out += "\n    hint: " + self.hint
+        return out
+
+    def to_dict(self) -> Dict:
+        return {
+            "severity": self.severity,
+            "code": self.code,
+            "message": self.message,
+            "block": self.block_idx,
+            "op": self.op_idx,
+            "op_type": self.op_type,
+            "var": self.var,
+            "hint": self.hint,
+        }
+
+    def __repr__(self):
+        return "Diagnostic(%s)" % self.render()
+
+
+class Report:
+    """Ordered collection of diagnostics plus inference coverage stats."""
+
+    def __init__(self):
+        self.diagnostics: List[Diagnostic] = []
+        # filled by the analyzer driver
+        self.total_ops = 0          # real (non-pseudo) op instances
+        self.covered_ops = 0        # instances with a registered infer rule
+        self.inferred_vars = 0      # vars with a fully/partially known shape
+
+    # -- collection ------------------------------------------------------
+    def add(self, severity: str, code: str, message: str, **kw) -> Diagnostic:
+        d = Diagnostic(severity, code, message, **kw)
+        self.diagnostics.append(d)
+        return d
+
+    def extend(self, other: "Report"):
+        self.diagnostics.extend(other.diagnostics)
+
+    # -- queries ---------------------------------------------------------
+    def __iter__(self):
+        return iter(self.diagnostics)
+
+    def __len__(self):
+        return len(self.diagnostics)
+
+    def at_least(self, severity: str) -> List[Diagnostic]:
+        floor = _sev_rank(severity)
+        return [d for d in self.diagnostics if _sev_rank(d.severity) >= floor]
+
+    @property
+    def errors(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == "error"]
+
+    @property
+    def warnings(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == "warning"]
+
+    def by_code(self, code: str) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.code == code]
+
+    def for_op(self, block_idx: int, op_idx: int) -> List[Diagnostic]:
+        return [d for d in self.diagnostics
+                if d.block_idx == block_idx and d.op_idx == op_idx]
+
+    @property
+    def coverage(self) -> float:
+        if not self.total_ops:
+            return 1.0
+        return self.covered_ops / self.total_ops
+
+    # -- rendering -------------------------------------------------------
+    def render(self, min_severity: str = "info") -> str:
+        lines = [d.render() for d in self.at_least(min_severity)]
+        if not lines:
+            return "clean (%d/%d ops covered by shape inference)" % (
+                self.covered_ops, self.total_ops)
+        return "\n".join(lines)
+
+    def to_dict(self) -> Dict:
+        return {
+            "total_ops": self.total_ops,
+            "covered_ops": self.covered_ops,
+            "infer_coverage": round(self.coverage, 4),
+            "inferred_vars": self.inferred_vars,
+            "counts": {s: sum(1 for d in self.diagnostics
+                              if d.severity == s) for s in SEVERITIES},
+            "issues": [d.to_dict() for d in self.diagnostics],
+        }
+
+
+# -- shared "did you mean" rendering -------------------------------------
+
+def closest_names(name: str, candidates: Sequence[str], n: int = 3):
+    """Closest registered names to a misspelled one (difflib ratio)."""
+    return difflib.get_close_matches(name, list(candidates), n=n, cutoff=0.6)
+
+
+def did_you_mean(name: str, candidates: Sequence[str]) -> str:
+    """Renders '; did you mean 'x' or 'y'?' — empty string when nothing is
+    close. Appended verbatim to registry/analyzer messages."""
+    close = closest_names(name, candidates)
+    if not close:
+        return ""
+    return "; did you mean %s?" % " or ".join("%r" % c for c in close)

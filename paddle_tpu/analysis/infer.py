@@ -1,0 +1,545 @@
+"""Per-op shape/dtype inference registry + abstract-interpretation driver.
+
+The reference validates every Program through per-op ``InferShape`` /
+``InferVarType`` passes before execution (paddle/fluid/framework/
+shape_inference.h, operators/*_op.cc:InferShape). This module rebuilds that
+layer for the Python-native IR: a registry of small pure functions — one
+per op type, mirroring ``ops/registry.py`` — that map input ``(shape,
+dtype)`` lattice values to output values, plus a driver that propagates
+them through a whole Program (control-flow sub-blocks via a fixed point
+over the loop carries) and attaches results to the Variables.
+
+Lattice: a :class:`VarInfo` is ``(shape, dtype)`` where ``shape`` is a
+tuple with ``None`` for unknown dims (the IR's ``-1``), or ``None``
+entirely for unknown rank, and ``dtype`` is a canonical dtype string or
+``None``. Everything degrades monotonically to unknown — a rule must never
+guess, so a reported mismatch is a real mismatch (the lint layer's
+zero-false-positive contract rests on this).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..framework.dtypes import convert_dtype
+from .diagnostics import Report
+
+__all__ = [
+    "VarInfo", "InferError", "InferContext", "register_infer",
+    "registered_infer_ops", "get_infer_rule", "infer_program",
+    "normalize_shape", "render_shape", "join_shapes", "broadcast_shapes",
+    "promote_dtypes", "info",
+]
+
+# op types the tracer interprets (or skips) itself (trace.py _SKIP_OPS +
+# autodiff); they are not "real" ops for coverage accounting. This is THE
+# shared definition: lints.py aliases it as TRACER_OPS, and
+# _Driver.infer_block's special-case branches enumerate exactly this set
+# — extend all three together.
+PSEUDO_OPS = {"feed", "fetch", "read", "autodiff"}
+
+Shape = Optional[Tuple[Optional[int], ...]]
+
+
+class VarInfo:
+    """One lattice value. Immutable by convention."""
+
+    __slots__ = ("shape", "dtype")
+
+    def __init__(self, shape: Shape = None, dtype: Optional[str] = None):
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+
+    @property
+    def known(self) -> bool:
+        return self.shape is not None and all(
+            d is not None for d in self.shape)
+
+    def __repr__(self):
+        return "VarInfo(%s, %s)" % (render_shape(self.shape), self.dtype)
+
+    def __eq__(self, other):
+        return (isinstance(other, VarInfo) and self.shape == other.shape
+                and self.dtype == other.dtype)
+
+    def __hash__(self):
+        return hash((self.shape, self.dtype))
+
+
+UNKNOWN = VarInfo(None, None)
+
+
+def info(shape, dtype=None) -> VarInfo:
+    """Rule-side constructor: normalizes -1 dims and dtype spellings."""
+    return VarInfo(
+        normalize_shape(shape) if shape is not None else None,
+        convert_dtype(dtype) if dtype is not None else None)
+
+
+class InferError(ValueError):
+    """Raised by a rule on a definite contract violation (mismatched
+    shapes/dtypes at an op boundary). ``code`` picks the diagnostic
+    bucket."""
+
+    def __init__(self, message: str, code: str = "shape-mismatch",
+                 hint: Optional[str] = None):
+        super().__init__(message)
+        self.code = code
+        self.hint = hint
+
+
+# -- shape algebra --------------------------------------------------------
+
+def normalize_shape(shape) -> Shape:
+    """IR shape -> lattice shape: -1 (and any negative) becomes None."""
+    if shape is None:
+        return None
+    return tuple(None if (d is None or int(d) < 0) else int(d)
+                 for d in shape)
+
+
+def render_shape(shape: Shape) -> str:
+    if shape is None:
+        return "(?)"
+    return "(" + ", ".join("?" if d is None else str(d)
+                           for d in shape) + ")"
+
+
+def _merge_dim(a: Optional[int], b: Optional[int]) -> Optional[int]:
+    """Join of two dims: agree -> the dim, disagree/unknown -> None."""
+    if a is None or b is None or a != b:
+        return None
+    return a
+
+
+def join_shapes(a: Shape, b: Shape) -> Shape:
+    """Lattice join (widening): used at control-flow merge points."""
+    if a is None or b is None or len(a) != len(b):
+        return None
+    return tuple(_merge_dim(x, y) for x, y in zip(a, b))
+
+
+def broadcast_shapes(a: Shape, b: Shape, what: str = "operands") -> Shape:
+    """Numpy-style broadcast with unknown dims. Raises InferError only on
+    a DEFINITE mismatch (both dims known, unequal, neither 1)."""
+    if a is None or b is None:
+        return None
+    ra, rb = len(a), len(b)
+    rank = max(ra, rb)
+    out: List[Optional[int]] = []
+    for i in range(rank):
+        da = a[ra - rank + i] if ra - rank + i >= 0 else 1
+        db = b[rb - rank + i] if rb - rank + i >= 0 else 1
+        if da == 1:
+            out.append(db)
+        elif db == 1:
+            out.append(da)
+        elif da is None or db is None:
+            out.append(None)
+        elif da == db:
+            out.append(da)
+        else:
+            raise InferError(
+                "%s have unbroadcastable shapes %s vs %s"
+                % (what, render_shape(a), render_shape(b)))
+    return tuple(out)
+
+
+def promote_dtypes(a: Optional[str], b: Optional[str]) -> Optional[str]:
+    if a is None or b is None:
+        return a or b
+    if a == b:
+        return a
+    # bfloat16 is not in vanilla numpy's promotion table; treat it like
+    # float16-class (promotes with any float to the wider float)
+    if "bfloat16" in (a, b):
+        other = b if a == "bfloat16" else a
+        if other.startswith("float"):
+            return other if other in ("float32", "float64") else "bfloat16"
+        return "float32"
+    try:
+        return convert_dtype(np.promote_types(a, b))
+    except Exception:
+        return None
+
+
+def prod_dims(dims: Sequence[Optional[int]]) -> Optional[int]:
+    out = 1
+    for d in dims:
+        if d is None:
+            return None
+        out *= d
+    return out
+
+
+# -- registry -------------------------------------------------------------
+
+INFER_RULES: Dict[str, Callable] = {}
+
+
+def register_infer(*op_types: str):
+    """``@register_infer("matmul")`` — one rule may serve several op types
+    (the elementwise family registers in one shot). Rules return
+    ``{slot: VarInfo | [VarInfo, ...]}``; build VarInfos with
+    :func:`info`."""
+
+    def deco(fn):
+        for t in op_types:
+            if t in INFER_RULES:
+                raise ValueError("duplicate infer rule for op %r" % t)
+            INFER_RULES[t] = fn
+        return fn
+
+    return deco
+
+
+def registered_infer_ops() -> List[str]:
+    return sorted(INFER_RULES)
+
+
+def get_infer_rule(op_type: str) -> Optional[Callable]:
+    return INFER_RULES.get(op_type)
+
+
+class InferContext:
+    """Per-op view handed to an infer rule (the static twin of
+    ``ops.registry.OpContext``)."""
+
+    __slots__ = ("op", "block", "_env")
+
+    def __init__(self, op, block, env: "_Env"):
+        self.op = op
+        self.block = block
+        self._env = env
+
+    # -- inputs ----------------------------------------------------------
+    def in_info(self, slot: str, idx: int = 0) -> VarInfo:
+        names = self.op.input(slot)
+        if idx >= len(names):
+            return UNKNOWN
+        return self._env.get(names[idx])
+
+    def in_infos(self, slot: str) -> List[VarInfo]:
+        return [self._env.get(n) for n in self.op.input(slot)]
+
+    def in_shape(self, slot: str, idx: int = 0) -> Shape:
+        return self.in_info(slot, idx).shape
+
+    def in_dtype(self, slot: str, idx: int = 0) -> Optional[str]:
+        return self.in_info(slot, idx).dtype
+
+    def has_input(self, slot: str) -> bool:
+        return bool(self.op.input(slot))
+
+    def input_name(self, slot: str, idx: int = 0) -> Optional[str]:
+        names = self.op.input(slot)
+        return names[idx] if idx < len(names) else None
+
+    # -- outputs / attrs -------------------------------------------------
+    def out_names(self, slot: str) -> List[str]:
+        return self.op.output(slot)
+
+    def n_outputs(self, slot: str) -> int:
+        return len(self.op.output(slot))
+
+    def declared(self, name: str) -> VarInfo:
+        """The IR declaration for a var (layers precompute shapes on most
+        intermediates) — rules may fall back to it for data-dependent
+        outputs. An empty shape () reads as "no declaration" (the
+        Variable default), same convention as the driver's seeding."""
+        var = self.block._find_var_recursive(name)
+        if var is None:
+            return UNKNOWN
+        return VarInfo(normalize_shape(var.shape) or None, var.dtype)
+
+    def attr(self, name: str, default=None):
+        return self.op.attr(name, default)
+
+    # -- convenience guards ----------------------------------------------
+    def want_rank(self, slot: str, *ranks: int, idx: int = 0) -> Shape:
+        """Input shape, checked against allowed ranks when known."""
+        s = self.in_shape(slot, idx)
+        if s is not None and ranks and len(s) not in ranks:
+            raise InferError(
+                "input %s of %r must have rank %s, got %s"
+                % (slot, self.op.type,
+                   "/".join(map(str, ranks)), render_shape(s)))
+        return s
+
+
+# -- driver ---------------------------------------------------------------
+
+class _Env:
+    """Per-block value namespace chained to the parent block's (mirrors
+    Block._find_var_recursive scoping)."""
+
+    __slots__ = ("d", "parent")
+
+    def __init__(self, parent: Optional["_Env"] = None):
+        self.d: Dict[str, VarInfo] = {}
+        self.parent = parent
+
+    def get(self, name: str, default=UNKNOWN) -> VarInfo:
+        env: Optional[_Env] = self
+        while env is not None:
+            if name in env.d:
+                return env.d[name]
+            env = env.parent
+        return default
+
+    def __contains__(self, name):
+        env: Optional[_Env] = self
+        while env is not None:
+            if name in env.d:
+                return True
+            env = env.parent
+        return False
+
+    def set(self, name: str, value: VarInfo):
+        self.d[name] = value
+
+
+class ProgramInference:
+    """Result of :func:`infer_program`: per-block name -> VarInfo maps,
+    plus coverage stats and any diagnostics the rules raised (in
+    ``report``)."""
+
+    def __init__(self, program, report: Report):
+        self.program = program
+        self.report = report
+        self.values: List[Dict[str, VarInfo]] = [
+            {} for _ in program.blocks]
+
+    def info(self, name: str, block_idx: int = 0) -> VarInfo:
+        """Lookup honoring block parent chains."""
+        blocks = self.program.blocks
+        idx = block_idx
+        while idx >= 0:
+            if name in self.values[idx]:
+                return self.values[idx][name]
+            idx = blocks[idx].parent_idx
+        return UNKNOWN
+
+    def shape(self, name: str, block_idx: int = 0) -> Shape:
+        return self.info(name, block_idx).shape
+
+    def dtype(self, name: str, block_idx: int = 0) -> Optional[str]:
+        return self.info(name, block_idx).dtype
+
+
+_MAX_FIXPOINT_ITERS = 4
+
+
+class _Driver:
+    def __init__(self, program, report: Report, result: ProgramInference):
+        self.program = program
+        self.report = report
+        self.result = result
+        # (block_idx, op_idx) pairs already counted for coverage, so
+        # fixpoint re-runs don't inflate the stats
+        self.counted: set = set()
+
+    # -- plumbing --------------------------------------------------------
+    def seed_block(self, block, env: _Env, feed_names):
+        """Entry facts: data vars and persistable state carry their
+        declared shapes (-1 dims become unknown); explicit feeds too.
+
+        An EMPTY shape () is this IR's "no declaration" (Variable
+        defaults shape to () when none is given, and layer helpers
+        create output vars that way), so it seeds as unknown rank —
+        genuine scalars degrade too, which is the conservative
+        direction: unknown can never produce a false finding."""
+        for name, var in block.vars.items():
+            if var.persistable or var.is_data or name in feed_names:
+                shape = normalize_shape(var.shape)
+                env.set(name, VarInfo(shape if shape else None, var.dtype))
+
+    def set_outputs(self, op, env: _Env, result: Optional[Dict], block,
+                    fallback_declared: bool):
+        for slot, names in op.outputs.items():
+            vals: Optional[List] = None
+            if result is not None and slot in result:
+                v = result[slot]
+                vals = list(v) if isinstance(v, (list, tuple)) else [v]
+            for i, name in enumerate(names):
+                if vals is not None and i < len(vals):
+                    out = vals[i]
+                    out = out if isinstance(out, VarInfo) else UNKNOWN
+                elif fallback_declared:
+                    var = block._find_var_recursive(name)
+                    out = (VarInfo(normalize_shape(var.shape) or None,
+                                   var.dtype)
+                           if var is not None and var.shape else UNKNOWN)
+                else:
+                    out = UNKNOWN
+                env.set(name, out)
+
+    # -- inference -------------------------------------------------------
+    def infer_op(self, op, op_idx, block, env: _Env, record: bool):
+        rule = INFER_RULES.get(op.type)
+        if rule is None:
+            # no rule: trust the layer's declared output shapes, if any
+            self.set_outputs(op, env, None, block, fallback_declared=True)
+            return
+        ctx = InferContext(op, block, env)
+        try:
+            result = rule(ctx)
+        except InferError as e:
+            if record:
+                self.report.add(
+                    "error", e.code, "%s: %s" % (op.type, e),
+                    block_idx=block.idx, op_idx=op_idx, op_type=op.type,
+                    hint=e.hint)
+            self.set_outputs(op, env, None, block, fallback_declared=False)
+            return
+        except Exception as e:  # a rule crash must not kill the analysis
+            if record:
+                self.report.add(
+                    "note", "infer-rule-crash",
+                    "infer rule for %r raised %s: %s — outputs degraded "
+                    "to unknown" % (op.type, type(e).__name__, e),
+                    block_idx=block.idx, op_idx=op_idx, op_type=op.type)
+            self.set_outputs(op, env, None, block, fallback_declared=False)
+            return
+        self.set_outputs(op, env, result, block, fallback_declared=False)
+
+    def infer_block(self, block, env: _Env, record: bool = True):
+        for op_idx, op in enumerate(block.ops):
+            if op.type in ("feed", "read"):
+                # outputs materialize from the executor; declarations hold
+                self.set_outputs(op, env, None, block,
+                                 fallback_declared=True)
+                continue
+            if op.type == "fetch":
+                continue
+            if op.type == "autodiff":
+                # grads mirror their parameters exactly (vjp contract)
+                params = list(op.attr("param_names") or ())
+                grads = op.output("Grads")
+                for pname, gname in zip(params, grads):
+                    env.set(gname, env.get(pname))
+                continue
+            key = (block.idx, op_idx)
+            if key not in self.counted:
+                self.counted.add(key)
+                self.report.total_ops += 1
+                if op.type in INFER_RULES:
+                    self.report.covered_ops += 1
+            sub_idx = op.attr("sub_block")
+            if sub_idx is not None:
+                self.infer_subblock_fixpoint(op, int(sub_idx), block, env,
+                                             record)
+                if op.type not in INFER_RULES:
+                    # outputs (the loop carries) already hold their
+                    # fixpoint values; the declared-shape fallback must
+                    # not overwrite a widened dim
+                    continue
+            self.infer_op(op, op_idx, block, env, record)
+        self.result.values[block.idx].update(env.d)
+
+    def infer_subblock_fixpoint(self, op, sub_idx: int, block, env: _Env,
+                                record: bool):
+        """Control-flow sub-blocks: iterate inference over the sub-block
+        until the loop-carried values stop changing. ``carry_vals`` holds
+        the accumulated JOIN over {entry value, every iteration's body
+        output} — the loop invariant — and each iteration re-runs the
+        body FROM those joined values, so a carry whose shape varies
+        across iterations widens to unknown and STAYS widened (the final
+        recording pass and the parent scope both see the invariant, never
+        one iteration's concrete shape)."""
+        sub = self.program.blocks[sub_idx]
+        carried = list(op.attr("carried_names") or ())
+        carry_vals = {n: env.get(n) for n in carried}
+        entry_vals = dict(carry_vals)
+
+        def run_body(record_pass: bool) -> _Env:
+            sub_env = _Env(parent=env)
+            self.seed_block(sub, sub_env, ())
+            for name, val in carry_vals.items():
+                sub_env.set(name, val)
+            self.infer_block(sub, sub_env, record=record_pass)
+            return sub_env
+
+        first_out: Dict[str, VarInfo] = {}
+        for it in range(_MAX_FIXPOINT_ITERS):
+            sub_env = run_body(record_pass=False)
+            if it == 0:
+                # body outputs computed from the CONCRETE entry values —
+                # the invariance diagnostic must compare against these,
+                # not a later pass that started from widened carries
+                # (where the growth would hide behind unknown dims)
+                first_out = {n: sub_env.get(n) for n in carried}
+            changed = False
+            for n in carried:
+                after = sub_env.get(n)
+                prev = carry_vals[n]
+                joined = VarInfo(
+                    join_shapes(prev.shape, after.shape),
+                    prev.dtype if prev.dtype == after.dtype else None)
+                if joined != prev:
+                    carry_vals[n] = joined
+                    changed = True
+            if not changed:
+                break
+        # final pass records the sub-block's diagnostics at the fixpoint
+        run_body(record_pass=record)
+        if record:
+            # a carry whose DEFINITE shape differs between loop entry and
+            # body output is not loop-invariant: lax.while_loop/scan will
+            # reject it at trace time, so surface it here with provenance
+            op_idx = block.ops.index(op)
+            for n in carried:
+                entry_s = entry_vals[n].shape
+                after_s = first_out.get(n, UNKNOWN).shape
+                if entry_s is not None and after_s is not None and (
+                        len(entry_s) != len(after_s)
+                        or any(a is not None and b is not None and a != b
+                               for a, b in zip(entry_s, after_s))):
+                    self.report.add(
+                        "warning", "loop-carry-varies",
+                        "loop carry %r enters as %s but the body "
+                        "produces %s — carries must be shape-invariant "
+                        "(XLA while loops reject varying carry shapes)"
+                        % (n, render_shape(entry_s),
+                           render_shape(after_s)),
+                        block_idx=block.idx, op_idx=op_idx,
+                        op_type=op.type, var=n,
+                        hint="pad/reshape the carry to a fixed shape "
+                             "before the loop boundary")
+        # the parent scope sees the invariant (possibly widened) values
+        for n in carried:
+            env.set(n, carry_vals[n])
+
+
+def infer_program(program, feed_names=(), report: Optional[Report] = None,
+                  attach: bool = True) -> ProgramInference:
+    """Propagate (shape, dtype) facts through every reachable op of
+    ``program`` (sub-blocks via their owning control-flow ops). Returns a
+    :class:`ProgramInference`; contract violations land in
+    ``result.report`` as error diagnostics with op provenance.
+
+    ``attach=True`` additionally pins each Variable's inferred facts on
+    the Variable itself (``var.inferred_shape`` / ``var.inferred_dtype``)
+    so later passes — and trace-error re-rendering — can read them without
+    re-running the analysis.
+    """
+    from . import rules  # noqa: F401 — populate INFER_RULES on first use
+
+    report = report if report is not None else Report()
+    result = ProgramInference(program, report)
+    driver = _Driver(program, report, result)
+    gb = program.global_block()
+    env = _Env()
+    driver.seed_block(gb, env, set(feed_names))
+    driver.infer_block(gb, env)
+    if attach:
+        for b in program.blocks:
+            for name, var in b.vars.items():
+                vi = result.info(name, b.idx)
+                var.inferred_shape = vi.shape
+                var.inferred_dtype = vi.dtype
+    report.inferred_vars = sum(
+        1 for vals in result.values
+        for v in vals.values() if v.shape is not None)
+    return result

@@ -1,0 +1,992 @@
+"""Shape/dtype inference rules for the high-traffic op set.
+
+One small pure function per op type, registered with
+``@register_infer(...)`` — the static twin of the kernel registry in
+``ops/``. Each rule mirrors its kernel's output contract exactly
+(reference: the per-op ``InferShape`` methods in
+paddle/fluid/operators/*_op.cc); ``tests/op_test.py:check_infer``
+cross-checks every rule against the shapes JAX actually produces when the
+kernel is traced, so rules cannot drift from kernels.
+
+Conventions:
+- unknown dims are ``None``; a rule must degrade to unknown rather than
+  guess (the zero-false-positive contract),
+- a DEFINITE contract violation raises :class:`InferError`, which the
+  driver turns into an error diagnostic with op provenance.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..framework.dtypes import convert_dtype
+from .infer import (
+    InferContext, InferError, Shape, VarInfo, broadcast_shapes, info,
+    prod_dims, promote_dtypes, register_infer, render_shape,
+)
+
+# ---------------------------------------------------------------------------
+# elementwise binary family (paddle axis-span broadcast, see
+# ops/math.py:_broadcast_y)
+# ---------------------------------------------------------------------------
+
+_ELEMENTWISE = (
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "elementwise_max", "elementwise_min",
+    "elementwise_pow", "elementwise_mod",
+)
+
+
+@register_infer(*_ELEMENTWISE)
+def _infer_elementwise(ctx: InferContext):
+    x, y = ctx.in_info("X"), ctx.in_info("Y")
+    dt = promote_dtypes(x.dtype, y.dtype)
+    xs, ys = x.shape, y.shape
+    if xs is None:
+        return {"Out": VarInfo(None, dt)}
+    if ys is None:
+        # Y could broadcast any of X's 1-dims UP — degrade those to
+        # unknown instead of echoing X's shape verbatim
+        return {"Out": VarInfo(
+            tuple(None if d == 1 else d for d in xs), dt)}
+    if len(ys) > len(xs):
+        raise InferError(
+            "Y rank %d exceeds X rank %d (Y must match a span of X's "
+            "dims)" % (len(ys), len(xs)))
+    if len(xs) == len(ys):
+        out = broadcast_shapes(xs, ys, "X and Y")
+        return {"Out": VarInfo(out, dt)}
+    axis = ctx.attr("axis", -1)
+    if axis is None or axis == -1:
+        axis = len(xs) - len(ys)
+    if axis < 0 or axis + len(ys) > len(xs):
+        raise InferError(
+            "axis=%d places Y%s outside X%s"
+            % (axis, render_shape(ys), render_shape(xs)))
+    out: List[Optional[int]] = list(xs)
+    for i, dy in enumerate(ys):
+        dx = xs[axis + i]
+        if dx is not None and dy is not None and dx != dy and dy != 1 \
+                and dx != 1:
+            raise InferError(
+                "Y%s does not match X%s's dims starting at axis %d"
+                % (render_shape(ys), render_shape(xs), axis))
+        if dx == 1:
+            # broadcasts up to Y's dim — unknown dy means unknown out,
+            # never a guessed 1 (degrade-to-unknown contract)
+            out[axis + i] = dy
+    return {"Out": VarInfo(tuple(out), dt)}
+
+
+# ---------------------------------------------------------------------------
+# unary, shape- and dtype-preserving ops
+# ---------------------------------------------------------------------------
+
+_UNARY = (
+    "sigmoid", "logsigmoid", "exp", "relu", "tanh", "tanh_shrink", "sqrt",
+    "abs", "ceil", "floor", "cos", "sin", "round", "reciprocal", "square",
+    "softplus", "softsign", "log", "sign", "relu6", "leaky_relu", "elu",
+    "brelu", "soft_relu", "pow", "stanh", "hard_sigmoid", "swish",
+    "thresholded_relu", "hard_shrink", "softshrink", "prelu", "scale",
+    "clip", "clip_by_norm", "cumsum", "label_smooth", "assign", "softmax",
+    "log_softmax", "sequence_softmax", "increment", "fill_zeros_like",
+)
+
+
+@register_infer(*_UNARY)
+def _infer_unary(ctx: InferContext):
+    return {"Out": ctx.in_info("X")}
+
+
+@register_infer("dropout")
+def _infer_dropout(ctx: InferContext):
+    x = ctx.in_info("X")
+    return {"Out": x, "Mask": x}
+
+
+@register_infer("logical_not")
+def _infer_logical_not(ctx: InferContext):
+    return {"Out": VarInfo(ctx.in_shape("X"), "bool")}
+
+
+@register_infer("isfinite")
+def _infer_isfinite(ctx: InferContext):
+    return {"Out": info((), "bool")}
+
+
+# ---------------------------------------------------------------------------
+# comparisons / logical binary (plain numpy broadcast, bool result)
+# ---------------------------------------------------------------------------
+
+_COMPARE = (
+    "less_than", "less_equal", "greater_than", "greater_equal", "equal",
+    "not_equal", "logical_and", "logical_or", "logical_xor",
+)
+
+
+@register_infer(*_COMPARE)
+def _infer_compare(ctx: InferContext):
+    out = broadcast_shapes(ctx.in_shape("X"), ctx.in_shape("Y"), "X and Y")
+    return {"Out": VarInfo(out, "bool")}
+
+
+# ---------------------------------------------------------------------------
+# matmul family — the MXU path, and the highest-value mismatch catcher
+# ---------------------------------------------------------------------------
+
+
+@register_infer("mul")
+def _infer_mul(ctx: InferContext):
+    xs, ys = ctx.in_shape("X"), ctx.in_shape("Y")
+    dt = promote_dtypes(ctx.in_dtype("X"), ctx.in_dtype("Y"))
+    xnc = int(ctx.attr("x_num_col_dims", 1))
+    ync = int(ctx.attr("y_num_col_dims", 1))
+    if xs is None or ys is None:
+        return {"Out": VarInfo(None, dt)}
+    if xnc > len(xs) or ync >= len(ys) + 1:
+        raise InferError(
+            "x_num_col_dims=%d / y_num_col_dims=%d out of range for "
+            "X%s, Y%s" % (xnc, ync, render_shape(xs), render_shape(ys)))
+    k_x = prod_dims(xs[xnc:])
+    k_y = prod_dims(ys[:ync])
+    if k_x is not None and k_y is not None and k_x != k_y:
+        raise InferError(
+            "contraction dims disagree: X%s flattens to K=%d but Y%s "
+            "flattens to K=%d"
+            % (render_shape(xs), k_x, render_shape(ys), k_y),
+            hint="the fc/mul weight's first dim must equal the flattened "
+                 "input feature count")
+    return {"Out": VarInfo(tuple(xs[:xnc]) + tuple(ys[ync:]), dt)}
+
+
+@register_infer("matmul")
+def _infer_matmul(ctx: InferContext):
+    xs, ys = ctx.in_shape("X"), ctx.in_shape("Y")
+    dt = promote_dtypes(ctx.in_dtype("X"), ctx.in_dtype("Y"))
+    if xs is None or ys is None or len(xs) < 2 or len(ys) < 2:
+        # 1-D operands follow jnp.matmul's special cases; rare in
+        # programs, so degrade instead of modeling them
+        return {"Out": VarInfo(None, dt)}
+    if ctx.attr("transpose_X", False):
+        xs = xs[:-2] + (xs[-1], xs[-2])
+    if ctx.attr("transpose_Y", False):
+        ys = ys[:-2] + (ys[-1], ys[-2])
+    if xs[-1] is not None and ys[-2] is not None and xs[-1] != ys[-2]:
+        raise InferError(
+            "matmul contraction dims disagree: X%s x Y%s (K %d vs %d)"
+            % (render_shape(xs), render_shape(ys), xs[-1], ys[-2]),
+            hint="check transpose_X/transpose_Y and the operand layouts")
+    batch = broadcast_shapes(xs[:-2], ys[:-2], "matmul batch dims")
+    if batch is None:
+        return {"Out": VarInfo(None, dt)}
+    return {"Out": VarInfo(tuple(batch) + (xs[-2], ys[-1]), dt)}
+
+
+@register_infer("sum")
+def _infer_sum(ctx: InferContext):
+    infos = ctx.in_infos("X")
+    if not infos:
+        return {"Out": VarInfo(None, None)}
+    shape = infos[0].shape
+    dt = infos[0].dtype
+    for other in infos[1:]:
+        shape = broadcast_shapes(shape, other.shape, "sum operands")
+        dt = promote_dtypes(dt, other.dtype)
+    return {"Out": VarInfo(shape, dt)}
+
+
+@register_infer("minus")
+def _infer_minus(ctx: InferContext):
+    out = broadcast_shapes(ctx.in_shape("X"), ctx.in_shape("Y"), "X and Y")
+    return {"Out": VarInfo(
+        out, promote_dtypes(ctx.in_dtype("X"), ctx.in_dtype("Y")))}
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+
+
+@register_infer("mean")
+def _infer_mean(ctx: InferContext):
+    return {"Out": VarInfo((), ctx.in_dtype("X"))}
+
+
+def _reduce_axes(dim, rank: int) -> List[int]:
+    # fold only genuine negative dims; an out-of-range positive dim must
+    # stay out of range so the caller's check fires (the kernel would
+    # fail at trace time — wrapping it here would infer a wrong shape)
+    dims = dim if isinstance(dim, (list, tuple)) else [dim]
+    return sorted({int(d) + rank if int(d) < 0 else int(d) for d in dims})
+
+
+@register_infer("reduce_sum", "reduce_mean", "reduce_max", "reduce_min",
+                "reduce_prod")
+def _infer_reduce(ctx: InferContext):
+    x = ctx.in_info("X")
+    if ctx.attr("reduce_all", False):
+        return {"Out": VarInfo((), x.dtype)}
+    if x.shape is None:
+        return {"Out": VarInfo(None, x.dtype)}
+    rank = len(x.shape)
+    axes = _reduce_axes(ctx.attr("dim", [0]), rank)
+    if any(a >= rank or a < 0 for a in axes):
+        raise InferError(
+            "reduce dim %s out of range for input %s"
+            % (ctx.attr("dim"), render_shape(x.shape)))
+    if ctx.attr("keep_dim", False):
+        out = [1 if i in axes else d for i, d in enumerate(x.shape)]
+    else:
+        out = [d for i, d in enumerate(x.shape) if i not in axes]
+    return {"Out": VarInfo(tuple(out), x.dtype)}
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+
+@register_infer("cross_entropy")
+def _infer_cross_entropy(ctx: InferContext):
+    x = ctx.in_info("X")
+    if x.shape is None:
+        return {"Y": VarInfo(None, x.dtype)}
+    lbl = ctx.in_shape("Label")
+    if not ctx.attr("soft_label", False) and lbl is not None and x.shape \
+            and lbl[0] is not None and x.shape[0] is not None \
+            and lbl[0] != x.shape[0]:
+        raise InferError(
+            "Label batch %d does not match X batch %d"
+            % (lbl[0], x.shape[0]))
+    return {"Y": VarInfo(tuple(x.shape[:-1]) + (1,), x.dtype)}
+
+
+@register_infer("softmax_with_cross_entropy")
+def _infer_softmax_xent(ctx: InferContext):
+    logits = ctx.in_info("Logits")
+    if logits.shape is None:
+        return {"Loss": VarInfo(None, logits.dtype),
+                "Softmax": VarInfo(None, logits.dtype)}
+    lbl = ctx.in_shape("Label")
+    if lbl is not None and logits.shape[0] is not None \
+            and lbl[0] is not None and lbl[0] != logits.shape[0]:
+        raise InferError(
+            "Label batch %d does not match Logits batch %d"
+            % (lbl[0], logits.shape[0]))
+    loss = tuple(logits.shape[:-1]) + (1,)
+    return {"Loss": VarInfo(loss, logits.dtype), "Softmax": logits}
+
+
+@register_infer("square_error_cost")
+def _infer_square_error(ctx: InferContext):
+    out = broadcast_shapes(ctx.in_shape("X"), ctx.in_shape("Y"), "X and Y")
+    return {"Out": VarInfo(
+        out, promote_dtypes(ctx.in_dtype("X"), ctx.in_dtype("Y")))}
+
+
+@register_infer("sigmoid_cross_entropy_with_logits")
+def _infer_sig_xent(ctx: InferContext):
+    out = broadcast_shapes(ctx.in_shape("X"), ctx.in_shape("Label"),
+                           "X and Label")
+    return {"Out": VarInfo(out, ctx.in_dtype("X"))}
+
+
+@register_infer("huber_loss")
+def _infer_huber(ctx: InferContext):
+    out = broadcast_shapes(ctx.in_shape("X"), ctx.in_shape("Y"), "X and Y")
+    dt = promote_dtypes(ctx.in_dtype("X"), ctx.in_dtype("Y"))
+    return {"Out": VarInfo(out, dt), "Residual": VarInfo(out, dt)}
+
+
+@register_infer("hinge_loss")
+def _infer_hinge(ctx: InferContext):
+    out = broadcast_shapes(ctx.in_shape("Logits"), ctx.in_shape("Labels"),
+                           "Logits and Labels")
+    return {"Loss": VarInfo(out, ctx.in_dtype("Logits"))}
+
+
+@register_infer("log_loss")
+def _infer_log_loss(ctx: InferContext):
+    out = broadcast_shapes(ctx.in_shape("Predicted"),
+                           ctx.in_shape("Labels"), "Predicted and Labels")
+    return {"Loss": VarInfo(out, ctx.in_dtype("Predicted"))}
+
+
+# ---------------------------------------------------------------------------
+# shape manipulation
+# ---------------------------------------------------------------------------
+
+
+@register_infer("reshape")
+def _infer_reshape(ctx: InferContext):
+    x = ctx.in_info("X")
+    target = list(ctx.attr("shape") or ())
+    if not target:
+        return {"Out": VarInfo(None, x.dtype)}
+    out: List[Optional[int]] = []
+    neg_idx = None
+    for i, s in enumerate(target):
+        s = int(s)
+        if s == 0:  # paddle: copy dim i from input
+            if x.shape is None or i >= len(x.shape):
+                if x.shape is not None:
+                    raise InferError(
+                        "reshape shape[%d]=0 copies a dim the input %s "
+                        "does not have" % (i, render_shape(x.shape)))
+                out.append(None)
+            else:
+                out.append(x.shape[i])
+        elif s == -1:
+            if neg_idx is not None:
+                raise InferError("reshape shape has more than one -1")
+            neg_idx = i
+            out.append(None)
+        else:
+            out.append(s)
+    total = prod_dims(x.shape) if x.shape is not None else None
+    if neg_idx is not None:
+        rest = prod_dims([d for i, d in enumerate(out) if i != neg_idx])
+        if total is not None and rest is not None:
+            if rest == 0 or total % rest != 0:
+                raise InferError(
+                    "cannot reshape %s (%d elements) into %s"
+                    % (render_shape(x.shape), total, target))
+            out[neg_idx] = total // rest
+    else:
+        want = prod_dims(out)
+        if total is not None and want is not None and total != want:
+            raise InferError(
+                "cannot reshape %s (%d elements) into %s (%d elements)"
+                % (render_shape(x.shape), total, target, want))
+    return {"Out": VarInfo(tuple(out), x.dtype)}
+
+
+@register_infer("squeeze")
+def _infer_squeeze(ctx: InferContext):
+    x = ctx.in_info("X")
+    if x.shape is None:
+        return {"Out": x}
+    axes = ctx.attr("axes", []) or []
+    if not axes:
+        if not x.known:
+            return {"Out": VarInfo(None, x.dtype)}
+        return {"Out": VarInfo(
+            tuple(d for d in x.shape if d != 1), x.dtype)}
+    rank = len(x.shape)
+    drop = set()
+    for a in axes:
+        a = int(a) % rank
+        if x.shape[a] is not None and x.shape[a] != 1:
+            raise InferError(
+                "squeeze axis %d has size %d (must be 1) in %s"
+                % (a, x.shape[a], render_shape(x.shape)))
+        drop.add(a)
+    return {"Out": VarInfo(
+        tuple(d for i, d in enumerate(x.shape) if i not in drop),
+        x.dtype)}
+
+
+@register_infer("unsqueeze")
+def _infer_unsqueeze(ctx: InferContext):
+    x = ctx.in_info("X")
+    if x.shape is None:
+        return {"Out": x}
+    out = list(x.shape)
+    for ax in sorted(int(a) for a in ctx.attr("axes")):
+        if ax < 0:
+            ax += len(out) + 1
+        out.insert(ax, 1)
+    return {"Out": VarInfo(tuple(out), x.dtype)}
+
+
+@register_infer("transpose")
+def _infer_transpose(ctx: InferContext):
+    x = ctx.in_info("X")
+    perm = [int(p) for p in ctx.attr("axis")]
+    if x.shape is None:
+        return {"Out": x}
+    if sorted(p % len(x.shape) for p in perm) != list(range(len(x.shape))):
+        raise InferError(
+            "transpose perm %s is not a permutation of input rank %d"
+            % (perm, len(x.shape)))
+    return {"Out": VarInfo(
+        tuple(x.shape[p % len(x.shape)] for p in perm), x.dtype)}
+
+
+@register_infer("concat")
+def _infer_concat(ctx: InferContext):
+    infos = ctx.in_infos("X")
+    axis = int(ctx.attr("axis", 0))
+    shapes = [i.shape for i in infos]
+    dt = infos[0].dtype if infos else None
+    for i in infos[1:]:
+        dt = promote_dtypes(dt, i.dtype)
+    known = [s for s in shapes if s is not None]
+    if not known:
+        return {"Out": VarInfo(None, dt)}
+    rank = len(known[0])
+    if any(len(s) != rank for s in known):
+        raise InferError(
+            "concat inputs have different ranks: %s"
+            % ", ".join(render_shape(s) for s in known))
+    ax = axis % rank
+    out: List[Optional[int]] = list(known[0])
+    for s in known[1:]:
+        for i in range(rank):
+            if i == ax:
+                continue
+            if out[i] is not None and s[i] is not None and out[i] != s[i]:
+                raise InferError(
+                    "concat inputs disagree on non-concat dim %d: %s"
+                    % (i, ", ".join(render_shape(k) for k in known)))
+            if out[i] is None:
+                out[i] = s[i]
+    if len(known) == len(shapes):
+        out[ax] = sum_or_none([s[ax] for s in known])
+    else:
+        out[ax] = None
+    return {"Out": VarInfo(tuple(out), dt)}
+
+
+def sum_or_none(dims: List[Optional[int]]) -> Optional[int]:
+    total = 0
+    for d in dims:
+        if d is None:
+            return None
+        total += d
+    return total
+
+
+@register_infer("split")
+def _infer_split(ctx: InferContext):
+    x = ctx.in_info("X")
+    n_out = ctx.n_outputs("Out")
+    if x.shape is None:
+        return {"Out": [VarInfo(None, x.dtype)] * n_out}
+    axis = int(ctx.attr("axis", 0)) % len(x.shape)
+    sections = ctx.attr("sections", None)
+    outs = []
+    if sections:
+        if x.shape[axis] is not None and sum(sections) != x.shape[axis]:
+            raise InferError(
+                "split sections %s sum to %d but dim %d of %s is %d"
+                % (sections, sum(sections), axis, render_shape(x.shape),
+                   x.shape[axis]))
+        for s in sections:
+            shp = list(x.shape)
+            shp[axis] = int(s)
+            outs.append(VarInfo(tuple(shp), x.dtype))
+    else:
+        num = int(ctx.attr("num", 0)) or n_out
+        d = x.shape[axis]
+        if d is not None and num and d % num != 0:
+            raise InferError(
+                "split num=%d does not divide dim %d (size %d) of %s"
+                % (num, axis, d, render_shape(x.shape)))
+        piece = None if d is None else d // num
+        for _ in range(n_out):
+            shp = list(x.shape)
+            shp[axis] = piece
+            outs.append(VarInfo(tuple(shp), x.dtype))
+    return {"Out": outs}
+
+
+@register_infer("stack")
+def _infer_stack(ctx: InferContext):
+    infos = ctx.in_infos("X")
+    shape = infos[0].shape if infos else None
+    dt = infos[0].dtype if infos else None
+    for i in infos[1:]:
+        shape = join_or_raise(shape, i.shape, "stack inputs")
+        dt = promote_dtypes(dt, i.dtype)
+    if shape is None:
+        return {"Y": VarInfo(None, dt)}
+    axis = int(ctx.attr("axis", 0))
+    if axis < 0:
+        axis += len(shape) + 1
+    out = list(shape)
+    out.insert(axis, len(infos))
+    return {"Y": VarInfo(tuple(out), dt)}
+
+
+def join_or_raise(a: Shape, b: Shape, what: str) -> Shape:
+    """Shapes that must be identical (modulo unknowns)."""
+    if a is None or b is None:
+        return None
+    if len(a) != len(b):
+        raise InferError("%s have different ranks: %s vs %s"
+                         % (what, render_shape(a), render_shape(b)))
+    out = []
+    for da, db in zip(a, b):
+        if da is not None and db is not None and da != db:
+            raise InferError("%s disagree: %s vs %s"
+                             % (what, render_shape(a), render_shape(b)))
+        out.append(da if da is not None else db)
+    return tuple(out)
+
+
+@register_infer("unstack")
+def _infer_unstack(ctx: InferContext):
+    x = ctx.in_info("X")
+    n = ctx.n_outputs("Y")
+    if x.shape is None:
+        return {"Y": [VarInfo(None, x.dtype)] * n}
+    axis = int(ctx.attr("axis", 0)) % len(x.shape)
+    if x.shape[axis] is not None and x.shape[axis] != n:
+        raise InferError(
+            "unstack expects %d outputs but dim %d of %s is %d"
+            % (n, axis, render_shape(x.shape), x.shape[axis]))
+    shp = tuple(d for i, d in enumerate(x.shape) if i != axis)
+    return {"Y": [VarInfo(shp, x.dtype)] * n}
+
+
+@register_infer("flatten")
+def _infer_flatten(ctx: InferContext):
+    x = ctx.in_info("X")
+    if x.shape is None:
+        return {"Out": VarInfo(None, x.dtype)}
+    ax = int(ctx.attr("axis", 1))
+    lead = prod_dims(x.shape[:ax])
+    tail = prod_dims(x.shape[ax:])
+    return {"Out": VarInfo((lead, tail), x.dtype)}
+
+
+@register_infer("expand")
+def _infer_expand(ctx: InferContext):
+    x = ctx.in_info("X")
+    times = [int(t) for t in ctx.attr("expand_times")]
+    if x.shape is None:
+        return {"Out": VarInfo(None, x.dtype)}
+    if len(times) != len(x.shape):
+        raise InferError(
+            "expand_times %s must have one entry per input dim (%s)"
+            % (times, render_shape(x.shape)))
+    return {"Out": VarInfo(
+        tuple(None if d is None else d * t
+              for d, t in zip(x.shape, times)), x.dtype)}
+
+
+@register_infer("slice")
+def _infer_slice(ctx: InferContext):
+    x = ctx.in_info("Input")
+    if x.shape is None:
+        return {"Out": VarInfo(None, x.dtype)}
+    out = list(x.shape)
+    for ax, st, en in zip(ctx.attr("axes"), ctx.attr("starts"),
+                          ctx.attr("ends")):
+        ax = int(ax) % len(out)
+        d = out[ax]
+        if d is None:
+            continue
+        out[ax] = len(range(*slice(int(st), int(en)).indices(d)))
+    return {"Out": VarInfo(tuple(out), x.dtype)}
+
+
+@register_infer("pad")
+def _infer_pad(ctx: InferContext):
+    x = ctx.in_info("X")
+    pads = [int(p) for p in ctx.attr("paddings")]
+    if x.shape is None:
+        return {"Out": VarInfo(None, x.dtype)}
+    if len(pads) != 2 * len(x.shape):
+        raise InferError(
+            "paddings has %d entries; input %s needs %d"
+            % (len(pads), render_shape(x.shape), 2 * len(x.shape)))
+    out = tuple(None if d is None else d + pads[2 * i] + pads[2 * i + 1]
+                for i, d in enumerate(x.shape))
+    return {"Out": VarInfo(out, x.dtype)}
+
+
+@register_infer("pad_constant_like")
+def _infer_pad_constant_like(ctx: InferContext):
+    return {"Out": VarInfo(ctx.in_shape("X"), ctx.in_dtype("Y"))}
+
+
+@register_infer("crop")
+def _infer_crop(ctx: InferContext):
+    shape = ctx.attr("shape")
+    return {"Out": info(tuple(int(s) for s in shape), ctx.in_dtype("X"))}
+
+
+@register_infer("reverse")
+def _infer_reverse(ctx: InferContext):
+    return {"Out": ctx.in_info("X")}
+
+
+@register_infer("shape")
+def _infer_shape_op(ctx: InferContext):
+    x = ctx.in_shape("Input")
+    return {"Out": VarInfo((len(x),) if x is not None else (None,),
+                           "int32")}
+
+
+# ---------------------------------------------------------------------------
+# indexing / selection
+# ---------------------------------------------------------------------------
+
+
+def _require_int(ctx: InferContext, slot: str):
+    dt = ctx.in_dtype(slot)
+    if dt is not None and not (dt.startswith("int") or dt.startswith("uint")
+                               or dt == "bool"):
+        raise InferError(
+            "input %s of %r must be an integer tensor, got %s"
+            % (slot, ctx.op.type, dt), code="dtype-mismatch",
+            hint="cast the indices with layers.cast(..., 'int64')")
+
+
+@register_infer("gather")
+def _infer_gather(ctx: InferContext):
+    x = ctx.in_info("X")
+    _require_int(ctx, "Index")
+    idx = ctx.in_shape("Index")
+    n = prod_dims(idx) if idx is not None else None
+    if x.shape is None:
+        return {"Out": VarInfo(None, x.dtype)}
+    return {"Out": VarInfo((n,) + tuple(x.shape[1:]), x.dtype)}
+
+
+@register_infer("lookup_table")
+def _infer_lookup_table(ctx: InferContext):
+    w = ctx.want_rank("W", 2)
+    _require_int(ctx, "Ids")
+    ids = ctx.in_shape("Ids")
+    emb = w[1] if w is not None else None
+    if ids is None or (len(ids) > 1 and ids[-1] is None):
+        # the kernel squeezes a trailing 1 at trace time; an UNKNOWN
+        # trailing dim means the output rank itself is unknown
+        return {"Out": VarInfo(None, ctx.in_dtype("W"))}
+    if len(ids) > 1 and ids[-1] == 1:
+        ids = ids[:-1]
+    return {"Out": VarInfo(tuple(ids) + (emb,), ctx.in_dtype("W"))}
+
+
+@register_infer("one_hot")
+def _infer_one_hot(ctx: InferContext):
+    _require_int(ctx, "X")
+    ids = ctx.in_shape("X")
+    depth = int(ctx.attr("depth"))
+    if ids is None or (len(ids) > 1 and ids[-1] is None):
+        # same trailing-1 squeeze caveat as lookup_table: unknown
+        # trailing dim -> unknown output rank
+        return {"Out": VarInfo(None, "float32")}
+    if len(ids) > 1 and ids[-1] == 1:
+        ids = ids[:-1]
+    return {"Out": VarInfo(tuple(ids) + (depth,), "float32")}
+
+
+@register_infer("top_k")
+def _infer_top_k(ctx: InferContext):
+    x = ctx.in_info("X")
+    k = int(ctx.attr("k", 1))
+    if x.shape is None:
+        return {"Out": VarInfo(None, x.dtype),
+                "Indices": VarInfo(None, "int64")}
+    last = x.shape[-1]
+    if last is not None and k > last:
+        raise InferError(
+            "top_k k=%d exceeds the candidate dim %d of %s"
+            % (k, last, render_shape(x.shape)))
+    out = tuple(x.shape[:-1]) + (k,)
+    return {"Out": VarInfo(out, x.dtype), "Indices": VarInfo(out, "int64")}
+
+
+@register_infer("arg_max", "arg_min")
+def _infer_arg_extreme(ctx: InferContext):
+    x = ctx.in_shape("X")
+    if x is None:
+        return {"Out": VarInfo(None, "int64")}
+    axis = int(ctx.attr("axis", -1)) % len(x)
+    return {"Out": VarInfo(
+        tuple(d for i, d in enumerate(x) if i != axis), "int64")}
+
+
+@register_infer("argsort")
+def _infer_argsort(ctx: InferContext):
+    x = ctx.in_info("X")
+    return {"Out": x, "Indices": VarInfo(x.shape, "int64")}
+
+
+# ---------------------------------------------------------------------------
+# casts / fills / random
+# ---------------------------------------------------------------------------
+
+
+@register_infer("cast")
+def _infer_cast(ctx: InferContext):
+    return {"Out": VarInfo(ctx.in_shape("X"),
+                           convert_dtype(ctx.attr("out_dtype")))}
+
+
+@register_infer("fill_constant", "gaussian_random", "uniform_random",
+                "truncated_gaussian_random")
+def _infer_fill_shape_attr(ctx: InferContext):
+    return {"Out": info(tuple(int(s) for s in ctx.attr("shape")),
+                        ctx.attr("dtype", "float32"))}
+
+
+@register_infer("fill", "assign_value")
+def _infer_fill_values(ctx: InferContext):
+    return {"Out": info(tuple(int(s) for s in ctx.attr("shape")),
+                        ctx.attr("dtype", "float32"))}
+
+
+@register_infer("fill_constant_batch_size_like",
+                "uniform_random_batch_size_like",
+                "gaussian_random_batch_size_like")
+def _infer_fill_batch_like(ctx: InferContext):
+    ref = ctx.in_shape("Input")
+    shape = [int(s) for s in ctx.attr("shape")]
+    in_idx = int(ctx.attr("input_dim_idx", 0))
+    out_idx = int(ctx.attr("output_dim_idx", 0))
+    out: List[Optional[int]] = [None if s < 0 else s for s in shape]
+    out[out_idx] = (ref[in_idx]
+                    if ref is not None and in_idx < len(ref) else None)
+    return {"Out": VarInfo(tuple(out),
+                           convert_dtype(ctx.attr("dtype", "float32")))}
+
+
+# ---------------------------------------------------------------------------
+# normalization / conv / pool
+# ---------------------------------------------------------------------------
+
+
+@register_infer("l2_normalize")
+def _infer_l2_normalize(ctx: InferContext):
+    x = ctx.in_info("X")
+    if x.shape is None:
+        return {"Out": x, "Norm": VarInfo(None, x.dtype)}
+    axis = int(ctx.attr("axis", -1)) % len(x.shape)
+    norm = tuple(1 if i == axis else d for i, d in enumerate(x.shape))
+    return {"Out": x, "Norm": VarInfo(norm, x.dtype)}
+
+
+@register_infer("batch_norm")
+def _infer_batch_norm(ctx: InferContext):
+    x = ctx.in_info("X")
+    layout = ctx.attr("data_layout", "NCHW")
+    c = None
+    if x.shape is not None:
+        c_axis = 1 if layout == "NCHW" else len(x.shape) - 1
+        c = x.shape[c_axis]
+        scale = ctx.in_shape("Scale")
+        if scale is not None and scale[0] is not None and c is not None \
+                and scale[0] != c:
+            raise InferError(
+                "Scale has %d channels but X%s has %d"
+                % (scale[0], render_shape(x.shape), c))
+    stat = VarInfo((c,), ctx.in_dtype("Mean") or "float32")
+    return {"Y": x, "MeanOut": stat, "VarianceOut": stat,
+            "SavedMean": stat, "SavedVariance": stat}
+
+
+@register_infer("layer_norm")
+def _infer_layer_norm(ctx: InferContext):
+    x = ctx.in_info("X")
+    begin = int(ctx.attr("begin_norm_axis", 1))
+    if x.shape is None:
+        return {"Y": x, "Mean": VarInfo(None, None),
+                "Variance": VarInfo(None, None)}
+    stat_shape = tuple(x.shape[:begin])
+    # stats ship in the DECLARED dtype (see ops/nn.py:_layer_norm)
+    names = ctx.out_names("Mean")
+    st_dt = ctx.declared(names[0]).dtype if names else "float32"
+    stat = VarInfo(stat_shape, st_dt or "float32")
+    return {"Y": x, "Mean": stat, "Variance": stat}
+
+
+def _conv_spatial(d, k, p, s, dil):
+    if d is None or k is None:
+        return None
+    return (d + 2 * p - dil * (k - 1) - 1) // s + 1
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+@register_infer("conv2d", "depthwise_conv2d")
+def _infer_conv2d(ctx: InferContext):
+    x = ctx.in_shape("Input")
+    w = ctx.in_shape("Filter")
+    dt = ctx.in_dtype("Input")
+    if x is None or w is None or len(x) != 4 or len(w) != 4:
+        return {"Output": VarInfo(None, dt)}
+    strides = _pair(ctx.attr("strides", [1, 1]))
+    pads = _pair(ctx.attr("paddings", [0, 0]))
+    dil = _pair(ctx.attr("dilations", [1, 1]))
+    groups = int(ctx.attr("groups", 1) or 1)
+    nhwc = (ctx.attr("data_format", "NCHW") or "NCHW") == "NHWC"
+    cin = x[3] if nhwc else x[1]
+    if cin is not None and w[1] is not None and cin != w[1] * groups:
+        raise InferError(
+            "Input has %d channels but Filter %s with groups=%d expects "
+            "%d" % (cin, render_shape(w), groups, w[1] * groups),
+            hint="num_filters/groups or the input channel count is wrong")
+    h_in, w_in = (x[1], x[2]) if nhwc else (x[2], x[3])
+    oh = _conv_spatial(h_in, w[2], pads[0], strides[0], dil[0])
+    ow = _conv_spatial(w_in, w[3], pads[1], strides[1], dil[1])
+    if nhwc:
+        out = (x[0], oh, ow, w[0])
+    else:
+        out = (x[0], w[0], oh, ow)
+    return {"Output": VarInfo(out, dt)}
+
+
+@register_infer("conv3d")
+def _infer_conv3d(ctx: InferContext):
+    x = ctx.in_shape("Input")
+    w = ctx.in_shape("Filter")
+    dt = ctx.in_dtype("Input")
+    if x is None or w is None or len(x) != 5 or len(w) != 5:
+        return {"Output": VarInfo(None, dt)}
+    strides = _pair(ctx.attr("strides", [1, 1, 1]), 3)
+    pads = _pair(ctx.attr("paddings", [0, 0, 0]), 3)
+    dil = _pair(ctx.attr("dilations", [1, 1, 1]), 3)
+    groups = int(ctx.attr("groups", 1) or 1)
+    if x[1] is not None and w[1] is not None and x[1] != w[1] * groups:
+        raise InferError(
+            "Input has %d channels but Filter %s with groups=%d expects "
+            "%d" % (x[1], render_shape(w), groups, w[1] * groups))
+    sp = tuple(
+        _conv_spatial(x[2 + i], w[2 + i], pads[i], strides[i], dil[i])
+        for i in range(3))
+    return {"Output": VarInfo((x[0], w[0]) + sp, dt)}
+
+
+@register_infer("pool2d", "pool3d")
+def _infer_pool(ctx: InferContext):
+    x = ctx.in_info("X")
+    nd = 2 if ctx.op.type == "pool2d" else 3
+    if x.shape is None or len(x.shape) != nd + 2:
+        return {"Out": VarInfo(None, x.dtype)}
+    nhwc = (ctx.attr("data_format", "NCHW") or "NCHW") in ("NHWC", "NDHWC")
+    sp0 = 1 if nhwc else 2
+    out = list(x.shape)
+    if ctx.attr("global_pooling", False):
+        for i in range(nd):
+            out[sp0 + i] = 1
+        return {"Out": VarInfo(tuple(out), x.dtype)}
+    ksize = _pair(ctx.attr("ksize"), nd)
+    strides = _pair(ctx.attr("strides", [1] * nd), nd)
+    pads = _pair(ctx.attr("paddings", [0] * nd), nd)
+    for i in range(nd):
+        d = x.shape[sp0 + i]
+        out[sp0 + i] = (None if d is None
+                        else (d + 2 * pads[i] - ksize[i]) // strides[i] + 1)
+    return {"Out": VarInfo(tuple(out), x.dtype)}
+
+
+# ---------------------------------------------------------------------------
+# rnn / sequence
+# ---------------------------------------------------------------------------
+
+
+@register_infer("lstm")
+def _infer_lstm(ctx: InferContext):
+    x = ctx.want_rank("Input", 3)
+    w = ctx.want_rank("Weight", 2)
+    dt = ctx.in_dtype("Input")
+    hidden = w[0] if w is not None else None
+    if x is not None and hidden is not None and x[-1] is not None \
+            and x[-1] != 4 * hidden:
+        raise InferError(
+            "lstm Input%s last dim must be 4*hidden (=%d from Weight%s)"
+            % (render_shape(x), 4 * hidden, render_shape(w)),
+            hint="project the input with fc(size=4*hidden) first")
+    b = x[0] if x is not None else None
+    t = x[1] if x is not None else None
+    seq = VarInfo((b, t, hidden), dt)
+    last = VarInfo((b, hidden), dt)
+    return {"Hidden": seq, "Cell": seq, "LastHidden": last,
+            "LastCell": last}
+
+
+@register_infer("gru")
+def _infer_gru(ctx: InferContext):
+    x = ctx.want_rank("Input", 3)
+    w = ctx.want_rank("Weight", 2)
+    dt = ctx.in_dtype("Input")
+    hidden = w[0] if w is not None else None
+    if x is not None and hidden is not None and x[-1] is not None \
+            and x[-1] != 3 * hidden:
+        raise InferError(
+            "gru Input%s last dim must be 3*hidden (=%d from Weight%s)"
+            % (render_shape(x), 3 * hidden, render_shape(w)))
+    b = x[0] if x is not None else None
+    t = x[1] if x is not None else None
+    return {"Hidden": VarInfo((b, t, hidden), dt),
+            "LastHidden": VarInfo((b, hidden), dt)}
+
+
+@register_infer("sequence_pool")
+def _infer_sequence_pool(ctx: InferContext):
+    x = ctx.want_rank("X", 3)
+    dt = ctx.in_dtype("X")
+    if x is None:
+        return {"Out": VarInfo(None, dt)}
+    return {"Out": VarInfo((x[0], x[2]), dt)}
+
+
+@register_infer("sequence_concat")
+def _infer_sequence_concat(ctx: InferContext):
+    infos = ctx.in_infos("X")
+    shapes = [i.shape for i in infos]
+    dt = infos[0].dtype if infos else None
+    known = [s for s in shapes if s is not None]
+    if not known or any(len(s) != len(known[0]) for s in known):
+        return {"Out": VarInfo(None, dt)}
+    out = list(known[0])
+    out[1] = sum_or_none([s[1] for s in known]) \
+        if len(known) == len(shapes) else None
+    for i in range(len(out)):
+        if i == 1:
+            continue
+        for s in known[1:]:
+            out[i] = out[i] if out[i] is not None else s[i]
+    return {"Out": VarInfo(tuple(out), dt)}
+
+
+# ---------------------------------------------------------------------------
+# optimizers — elementwise updates: every "<Slot>Out" output mirrors its
+# "<Slot>" input (reference: sgd_op.cc etc. InferShape does the same)
+# ---------------------------------------------------------------------------
+
+_OPTIMIZERS = (
+    "sgd", "momentum", "adam", "adamax", "adagrad", "adadelta",
+    "decayed_adagrad", "ftrl", "rmsprop", "proximal_gd",
+    "proximal_adagrad",
+)
+
+
+@register_infer(*_OPTIMIZERS)
+def _infer_optimizer(ctx: InferContext):
+    param = ctx.in_info("Param")
+    grad = ctx.in_shape("Grad")
+    if param.shape is not None and grad is not None:
+        join_or_raise(param.shape, grad, "Param and Grad")
+    out = {}
+    for slot in ctx.op.outputs:
+        if slot.endswith("Out"):
+            src = slot[:-3]
+            out[slot] = ctx.in_info(src) if ctx.has_input(src) else param
+    return out
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+
+@register_infer("accuracy")
+def _infer_accuracy(ctx: InferContext):
+    ind = ctx.in_shape("Indices")
+    lbl = ctx.in_shape("Label")
+    if ind is not None and lbl is not None and ind[0] is not None \
+            and lbl[0] is not None and ind[0] != lbl[0]:
+        raise InferError(
+            "Indices batch %d does not match Label batch %d"
+            % (ind[0], lbl[0]))
+    return {"Accuracy": info((), "float32"),
+            "Correct": info((), "int32"), "Total": info((), "int32")}

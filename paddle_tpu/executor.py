@@ -34,7 +34,7 @@ from .runtime import aot_cache as _aot
 from .framework.core import Program, Variable, default_main_program
 from .framework.dtypes import as_numpy_dtype
 from .framework.scope import CPUPlace, Place, Scope, global_scope
-from .framework.trace import RngStream, trace_block
+from .framework.trace import RngStream, TraceError, trace_block
 from .framework.verifier import verify_program
 
 __all__ = ["Executor"]
@@ -293,17 +293,31 @@ class Executor:
                     "declaration" % (name, tuple(shape), declared))
 
     def _verify_and_analyze(self, program: Program, feed_sig, scope: Scope,
-                            user_feed_names=None):
+                            user_feed_names=None, fetch_names=()):
         """Shared pre-compile prologue for _compile/_compile_loop: feed
         shape check, static program verification (SURVEY aux: race-
         detection equivalent — hard errors raise with op context, write-
         once findings only warn), state analysis, and the missing-
-        persistable check."""
+        persistable check.
+
+        PADDLE_TPU_VERIFY=1 upgrades the def-use verifier to the FULL
+        static analyzer (analysis/: whole-program shape/dtype inference,
+        TPU static-shape + recompile-risk + dead-code lints) pre-trace:
+        errors raise with op provenance, warnings warn.
+        PADDLE_TPU_VERIFY=strict raises on warnings too."""
         feed_names = tuple(n for n, _, _ in feed_sig)
         self._check_feed_shapes(program, feed_sig, user_feed_names)
-        for kind, msg in verify_program(program, feed_names):
-            if kind == "write-once":
-                warnings.warn("program verifier: " + msg)
+        from .analysis import analyze_program, enforce, verify_mode
+
+        mode = verify_mode()
+        if mode:
+            enforce(analyze_program(program, feed_names=feed_names,
+                                    fetch_names=fetch_names),
+                    strict=(mode == "strict"))
+        else:
+            for kind, msg in verify_program(program, feed_names):
+                if kind == "write-once":
+                    warnings.warn("program verifier: " + msg)
         state_in, state_out = analyze_state(program, set(feed_names))
         # state vars written before ever being read (pure init, e.g. startup
         # programs) need no input value
@@ -318,7 +332,8 @@ class Executor:
     def _compile(self, program: Program, feed_sig, fetch_names, scope: Scope,
                  user_feed_names=None) -> _Compiled:
         state_in, state_out = self._verify_and_analyze(
-            program, feed_sig, scope, user_feed_names)
+            program, feed_sig, scope, user_feed_names,
+            fetch_names=fetch_names)
 
         stepfn = build_step_fn(program, fetch_names, state_in, state_out)
         fn = jax.jit(stepfn, donate_argnums=(1,))
@@ -349,7 +364,7 @@ class Executor:
             # per-step feeds are validated against their per-iteration shape
             [(n, s[1:] if n in per_step_names else s, d)
              for n, s, d in feed_sig],
-            scope, user_feed_names)
+            scope, user_feed_names, fetch_names=fetch_names)
 
         stepfn = build_step_fn(program, fetch_names, state_in, state_out)
 
@@ -445,7 +460,12 @@ class Executor:
             return loaded, None
         obs.CACHE_MISSES.inc(kind=kind, tier="disk", program=fp)
         t0 = time.perf_counter()
-        lowered = fn.lower(*args)
+        try:
+            lowered = fn.lower(*args)
+        except TraceError as e:
+            self._rethrow_with_provenance(
+                program, e, feed_names=tuple(n for n, _, _ in feed_sig),
+                fetch_names=tuple(fetch_names))
         t1 = time.perf_counter()
         compiled = lowered.compile()
         t2 = time.perf_counter()
@@ -489,6 +509,29 @@ class Executor:
             return out
         except Exception:  # measurement must never break compilation
             return None
+
+    @staticmethod
+    def _rethrow_with_provenance(program: Program, e: TraceError,
+                                 feed_names=(), fetch_names=()):
+        """Re-render a trace-time failure with the static analyzer's
+        per-op provenance: the TraceError already names the failing op;
+        the analyzer adds the statically-inferred input/output shapes and
+        dtypes plus any findings it has for that op (and the rest of the
+        program), so the user sees the IR-level cause instead of a bare
+        JAX exception."""
+        from .analysis import explain_trace_error
+
+        try:
+            note = explain_trace_error(program, e, feed_names=feed_names,
+                                       fetch_names=fetch_names)
+        except Exception:  # post-mortem must never mask the real error
+            note = None
+        if note:
+            err = TraceError("%s\n%s" % (e, note))
+            err.__dict__.update({k: v for k, v in e.__dict__.items()
+                                 if k.startswith("pt_")})
+            raise err from e
+        raise e
 
     @staticmethod
     def _has_nan_inf(val) -> bool:
@@ -796,7 +839,15 @@ class Executor:
         # pays it; unfenced wall time is dispatch (+compile on first run)
         fence = profiling or obs.TIMELINE.device_time_enabled()
         t0 = time.perf_counter()
-        fetches, new_state = compiled.fn(feed_arrays, state, rng_key, step)
+        try:
+            fetches, new_state = compiled.fn(feed_arrays, state, rng_key,
+                                             step)
+        except TraceError as e:
+            # lazy-jit path (disk tier off): the first call traces; give
+            # its failures the same analyzer post-mortem as the AOT path
+            self._rethrow_with_provenance(
+                program, e, feed_names=tuple(feed_arrays),
+                fetch_names=fetch_names)
         if fence:
             self._profiler_fence(fetches, new_state)
         wall = time.perf_counter() - t0
@@ -975,8 +1026,14 @@ class Executor:
         profiling = profiler.is_profiling()
         fence = profiling or obs.TIMELINE.device_time_enabled()
         t0 = time.perf_counter()
-        fetches, new_state = compiled.fn(feed_arrays, state, rng_key,
-                                         step0, np.int32(effective_steps))
+        try:
+            fetches, new_state = compiled.fn(
+                feed_arrays, state, rng_key, step0,
+                np.int32(effective_steps))
+        except TraceError as e:
+            self._rethrow_with_provenance(
+                program, e, feed_names=tuple(feed_arrays),
+                fetch_names=fetch_names)
         if fence:
             self._profiler_fence(fetches, new_state)
         wall = time.perf_counter() - t0

@@ -187,10 +187,20 @@ def trace_op(op: Operator, block: Block, env: Dict, rng_fn, subblock_fn=None):
             slot: [getattr(env.get(n), "shape", None) for n in names]
             for slot, names in op.inputs.items()
         }
-        raise TraceError(
+        err = TraceError(
             "error while lowering op %r (inputs %s, attrs %s): %s"
             % (op.type, in_shapes, op.attrs, e)
-        ) from e
+        )
+        # op provenance for the static analyzer's post-mortem: the
+        # executor re-renders trace failures with the analyzer's per-op
+        # shape/dtype facts (analysis.explain_trace_error) keyed on these
+        err.pt_op_type = op.type
+        err.pt_block_idx = block.idx
+        try:
+            err.pt_op_idx = block.ops.index(op)
+        except ValueError:  # op replayed from a detached copy
+            err.pt_op_idx = None
+        raise err from e
     _apply_outputs(op, block, env, result)
 
 

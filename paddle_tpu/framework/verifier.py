@@ -1,86 +1,24 @@
-"""Static Program verifier: use-before-def + write-once (SSA-ish) checks.
+"""Static Program verifier — thin shim over ``paddle_tpu.analysis``.
 
-SURVEY aux: the TPU-native stand-in for the reference's data-race surface —
-the reference's multi-stream SSA executor (paddle/fluid/framework/details)
-can race on vars written twice without a dependency edge; our programs run
-as one XLA computation, so the analogous bug is a Program whose op list
-reads a value before any op produces it, or silently overwrites an
-intermediate. Runs before compile; errors carry the op index + repr.
+Historically this module held the use-before-def / write-once (SSA-ish)
+checks itself; they now live as lint rules in ``analysis/lints.py``
+(``def-use``), alongside the full shape/dtype inference pass and the
+TPU-specific lints. This shim keeps the old call surface — every compile
+still runs the cheap def-use subset through ``verify_program`` — and the
+old exception type. For the full analyzer (shape/dtype inference,
+dead-code, TPU static-shape and recompile-risk lints) set
+``PADDLE_TPU_VERIFY=1`` (or ``strict``), or call
+``paddle_tpu.analysis.analyze_program`` directly.
 """
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List
 
 __all__ = ["verify_program", "ProgramVerifyError"]
-
-# ops that legitimately rewrite an existing var (loop counters, tensor
-# arrays, in-place scatter updates, optimizer-style accumulators)
-_REWRITE_OK = {
-    "increment", "write_to_array", "assign", "scatter", "fill_constant",
-    "sums", "sum",
-}
 
 
 class ProgramVerifyError(ValueError):
     pass
-
-
-def _verify_block(block, defined: set, issues: List[str], feed_names: set,
-                  is_sub: bool = False):
-    local_defined = set(defined)
-    written_by = {}
-    for op_idx, op in enumerate(block.ops):
-        if op.type == "feed":
-            for name in op.output_arg_names:
-                local_defined.add(name)
-            continue
-        if op.type == "read":
-            # reader handle is bound host-side (layers/io.py reader
-            # pipeline); outputs are injected as feeds by the executor
-            for name in op.output_arg_names:
-                local_defined.add(name)
-            continue
-        for name in op.input_arg_names:
-            if name in local_defined or name in feed_names:
-                continue
-            var = block._find_var_recursive(name)
-            if var is None:
-                issues.append((
-                    "undeclared",
-                    "block %d op %d (%s): input %r is not declared anywhere"
-                    % (block.idx, op_idx, op.type, name)))
-            elif not var.persistable and name not in written_by and not is_sub:
-                # sub-blocks get loop carries / step inputs injected by the
-                # parent control-flow op at trace time, so use-before-def
-                # is only decidable statically at the top level
-                issues.append((
-                    "use-before-def",
-                    "block %d op %d (%s): input %r is read before any op "
-                    "defines it (use-before-def)"
-                    % (block.idx, op_idx, op.type, name)))
-        sub_idx = op.attr("sub_block")
-        if sub_idx is not None:
-            sub = block.program.blocks[int(sub_idx)]
-            _verify_block(sub, local_defined | set(written_by), issues,
-                          feed_names, is_sub=True)
-        for name in op.output_arg_names:
-            var = block._find_var_recursive(name)
-            persistable = var is not None and var.persistable
-            if (name in written_by and not persistable
-                    and op.type not in _REWRITE_OK
-                    and written_by[name][1] not in _REWRITE_OK
-                    # control-flow ops legitimately rewrite their loop
-                    # carries / condition vars
-                    and sub_idx is None):
-                issues.append((
-                    "write-once",
-                    "block %d op %d (%s): output %r was already written by "
-                    "op %d (%s) — write-once violation (would be a race in "
-                    "a parallel executor)"
-                    % (block.idx, op_idx, op.type, name,
-                       written_by[name][0], written_by[name][1])))
-            written_by[name] = (op_idx, op.type)
-            local_defined.add(name)
 
 
 def verify_program(program, feed_names=(), raise_on_error: bool = True):
@@ -91,10 +29,14 @@ def verify_program(program, feed_names=(), raise_on_error: bool = True):
     feed_names: vars supplied externally at run time (executor feeds).
     Persistable vars are assumed initialized by the startup program.
     """
-    issues: List[tuple] = []
-    gb = program.global_block()
-    defined = {name for name, var in gb.vars.items() if var.persistable}
-    _verify_block(gb, defined, issues, set(feed_names))
+    from ..analysis import analyze_program
+
+    analysis = analyze_program(program, feed_names=feed_names,
+                               level="verify", observe=False)
+    issues: List[tuple] = [(d.code, d.message)
+                           for d in analysis.report
+                           if d.code in ("undeclared", "use-before-def",
+                                         "write-once")]
     hard = [msg for kind, msg in issues
             if kind in ("undeclared", "use-before-def")]
     if hard and raise_on_error:

@@ -94,6 +94,17 @@ class Predictor:
             want = (np.dtype(as_numpy_dtype(var.dtype))
                     if var is not None else None)
             self._feed_plan.append((name, var, want))
+        # pre-trace static analysis, same knob as the Executor
+        # (PADDLE_TPU_VERIFY=1|strict): a broken exported model fails at
+        # LOAD with op-level provenance, not at the first predict call
+        from .analysis import analyze_program, enforce, verify_mode
+
+        mode = verify_mode()
+        if mode:
+            enforce(analyze_program(self._program,
+                                    feed_names=self._feed_names,
+                                    fetch_names=self._fetch_names),
+                    strict=(mode == "strict"))
         # params are resident device state, uploaded once at load
         self._state_names, self._state = self._load_state()
         self.traces = 0  # diagnostic: number of program traces performed
@@ -194,13 +205,21 @@ class Predictor:
                 obs.CACHE_MISSES.inc(kind="predict", tier="disk",
                                      program=fp)
         if loaded is None:
+            from .framework.trace import TraceError
+
             fn = jax.jit(self._step_fn())
             t0 = time.perf_counter()
-            lowered = fn.lower(
-                {n: jax.ShapeDtypeStruct(s, np.dtype(d))
-                 for n, s, d in feed_sig},
-                {n: jax.ShapeDtypeStruct(a.shape, a.dtype)
-                 for n, a in self._state.items()})
+            try:
+                lowered = fn.lower(
+                    {n: jax.ShapeDtypeStruct(s, np.dtype(d))
+                     for n, s, d in feed_sig},
+                    {n: jax.ShapeDtypeStruct(a.shape, a.dtype)
+                     for n, a in self._state.items()})
+            except TraceError as e:
+                # same analyzer post-mortem as Executor trace failures
+                Executor._rethrow_with_provenance(
+                    self._program, e, feed_names=tuple(self._feed_names),
+                    fetch_names=tuple(self._fetch_names))
             t1 = time.perf_counter()
             loaded = lowered.compile()
             t2 = time.perf_counter()

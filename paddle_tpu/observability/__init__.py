@@ -40,7 +40,8 @@ __all__ = [
     "BENCH_ANOMALY_RETRIES", "SERVER_ROWS", "SERVER_BUCKET_FILL",
     "SERVER_INFLIGHT_DEPTH", "SERVER_STAGE_MS", "AOT_CACHE_BYTES",
     "AOT_CACHE_WRITTEN_BYTES", "AOT_CACHE_EVICTIONS", "AOT_CACHE_CORRUPT",
-    "AOT_CACHE_ERRORS", "AOT_COMPILE_MS",
+    "AOT_CACHE_ERRORS", "AOT_COMPILE_MS", "ANALYSIS_ISSUES",
+    "ANALYSIS_COVERAGE",
 ]
 
 # -- the shared instrument set (registered once, process-wide) -----------
@@ -158,6 +159,15 @@ AOT_COMPILE_MS = REGISTRY.histogram(
     "Executable acquisition wall time on the AOT path, by kind and "
     "path=cold (explicit lower+XLA compile) | warm (disk deserialize) — "
     "the cold-start-vs-warm-start distribution")
+ANALYSIS_ISSUES = REGISTRY.counter(
+    "paddle_tpu_analysis_issues_total",
+    "Static-analyzer findings, by diagnostic code and severity "
+    "(analysis/: shape-mismatch, use-before-def, tpu-dynamic-shape, "
+    "recompile-risk, dead-op, ...)")
+ANALYSIS_COVERAGE = REGISTRY.gauge(
+    "paddle_tpu_analysis_infer_coverage",
+    "Fraction of a program's op instances covered by a registered "
+    "shape/dtype inference rule, per program fingerprint")
 PROFILER_EVENT_MS = REGISTRY.summary(
     "paddle_tpu_profiler_event_ms",
     "Legacy profiler event table (exact count/sum/min/max per event)")

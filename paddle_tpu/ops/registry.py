@@ -37,9 +37,14 @@ def register_op(op_type: str):
 
 def get_kernel(op_type: str) -> Callable:
     if op_type not in KERNELS:
+        # same rendering helper the static analyzer's diagnostics use
+        # (analysis/diagnostics.py), so registry errors and lint findings
+        # suggest alike
+        from ..analysis.diagnostics import did_you_mean
+
         raise NotImplementedError(
-            "no TPU kernel registered for op %r (registered: %d ops)"
-            % (op_type, len(KERNELS))
+            "no TPU kernel registered for op %r (registered: %d ops)%s"
+            % (op_type, len(KERNELS), did_you_mean(op_type, KERNELS))
         )
     return KERNELS[op_type]
 

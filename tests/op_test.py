@@ -19,11 +19,12 @@ from paddle_tpu.framework.core import Program
 from paddle_tpu.framework.trace import RngStream, trace_block
 
 
-def run_op(op_type, inputs, attrs=None, outs=("Out",), env_overrides=None,
-           rng_seed=0):
-    """Build a one-op Program and trace it eagerly. `inputs` maps slot ->
-    array | list of arrays (jnp arrays pass through, so this is jax-
-    differentiable). Returns {slot: value} for `outs`."""
+def build_one_op_program(op_type, inputs, attrs=None, outs=("Out",)):
+    """The shared one-op Program construction (used by BOTH run_op's
+    kernel trace and check_infer's static replay — they must build the
+    exact same graph or the infer-vs-kernel cross-check is meaningless).
+    Returns (block, op, env, in_map, out_map): env maps input var name ->
+    jnp value."""
     prog = Program()
     block = prog.global_block()
     env = {}
@@ -44,8 +45,18 @@ def run_op(op_type, inputs, attrs=None, outs=("Out",), env_overrides=None,
         name = "out_%s" % slot.lower()
         block.create_var(name=name, shape=None, dtype="float32")
         out_map[slot] = [name]
-    block.append_op(type=op_type, inputs=in_map, outputs=out_map,
-                    attrs=dict(attrs or {}))
+    op = block.append_op(type=op_type, inputs=in_map, outputs=out_map,
+                         attrs=dict(attrs or {}))
+    return block, op, env, in_map, out_map
+
+
+def run_op(op_type, inputs, attrs=None, outs=("Out",), env_overrides=None,
+           rng_seed=0):
+    """Build a one-op Program and trace it eagerly. `inputs` maps slot ->
+    array | list of arrays (jnp arrays pass through, so this is jax-
+    differentiable). Returns {slot: value} for `outs`."""
+    block, _op, env, _in_map, out_map = build_one_op_program(
+        op_type, inputs, attrs, outs)
     if env_overrides:
         env.update(env_overrides)
     rng = RngStream(jax.random.PRNGKey(rng_seed))
@@ -70,6 +81,72 @@ def check_forward(op_type, inputs, ref, attrs=None, outs=("Out",),
             g, np.asarray(w), rtol=rtol, atol=atol,
             err_msg="%s forward mismatch on slot %s" % (op_type, slot))
     return got
+
+
+def check_infer(op_type, inputs, attrs=None, outs=("Out",), **kw):
+    """Cross-check the op's registered shape/dtype INFERENCE rule
+    (paddle_tpu.analysis) against the shapes/dtypes JAX actually produces
+    when the kernel is traced — so infer rules can't drift from kernels.
+
+    Runs the kernel through run_op, then replays the same one-op Program
+    through the static analyzer with the concrete input shapes as
+    entry facts. For every checked output slot the inferred shape must
+    MATCH the traced shape dim-for-dim (an unknown inferred dim is
+    allowed only where the rule genuinely cannot know — but a KNOWN
+    inferred dim must be right), and an inferred dtype must match the
+    traced dtype exactly. Returns the analyzer's VarInfo per slot."""
+    from paddle_tpu.analysis import get_infer_rule
+    from paddle_tpu.analysis.infer import (
+        InferContext, VarInfo, _Env, normalize_shape)
+
+    rule = get_infer_rule(op_type)
+    assert rule is not None, "no infer rule registered for %r" % op_type
+
+    got = run_op(op_type, inputs, attrs, outs, **kw)
+
+    # rebuild the IDENTICAL one-op program (shared builder), seed the
+    # static env with the CONCRETE input facts, and run the op's rule
+    block, op, trace_env, _in_map, _out_map = build_one_op_program(
+        op_type, inputs, attrs, outs)
+    env = _Env()
+    for name, val in trace_env.items():
+        arr = np.asarray(val)
+        env.set(name, VarInfo(normalize_shape(arr.shape),
+                              str(arr.dtype)))
+
+    result = rule(InferContext(op, block, env))
+    infos = {}
+    for slot in outs:
+        traced = np.asarray(got[slot])
+        inferred = result.get(slot)
+        assert inferred is not None, (
+            "%s infer rule returned nothing for slot %s" % (op_type, slot))
+        if isinstance(inferred, (list, tuple)):
+            inferred = inferred[0]
+        infos[slot] = inferred
+        if inferred.shape is not None:
+            assert len(inferred.shape) == traced.ndim, (
+                "%s slot %s: inferred rank %d != traced rank %d (%s vs %s)"
+                % (op_type, slot, len(inferred.shape), traced.ndim,
+                   inferred.shape, traced.shape))
+            for i, (d_inf, d_got) in enumerate(
+                    zip(inferred.shape, traced.shape)):
+                assert d_inf is None or d_inf == d_got, (
+                    "%s slot %s: inferred dim %d = %s but kernel produced"
+                    " %d (inferred %s vs traced %s)"
+                    % (op_type, slot, i, d_inf, d_got, inferred.shape,
+                       traced.shape))
+        if inferred.dtype is not None:
+            want = inferred.dtype
+            if not jax.config.jax_enable_x64:
+                # jax canonicalizes 64-bit values with x64 off; the IR
+                # declaration (what the rule infers) stays 64-bit
+                want = {"int64": "int32", "uint64": "uint32",
+                        "float64": "float32"}.get(want, want)
+            assert want == str(traced.dtype), (
+                "%s slot %s: inferred dtype %s != traced dtype %s"
+                % (op_type, slot, inferred.dtype, traced.dtype))
+    return infos
 
 
 def check_grad(op_type, inputs, wrt, attrs=None, outs=("Out",),

@@ -1,0 +1,565 @@
+"""Static analyzer tests: infer registry coverage, zero false positives
+on the bundled example programs, seeded-defect detection with op-level
+provenance, infer-vs-kernel cross-checks, lint units, and the
+verifier-shim / executor / registry integrations."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, optimizer
+from paddle_tpu.analysis import (
+    AnalysisError, analyze_program, did_you_mean, registered_infer_ops,
+)
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from op_test import check_infer  # noqa: E402
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+
+
+def _load_program_lint():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "program_lint", os.path.join(TOOLS, "program_lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# -- registry coverage ----------------------------------------------------
+
+
+def test_infer_registry_covers_target_op_set():
+    ops = registered_infer_ops()
+    assert len(ops) >= 40, "acceptance floor: >= 40 op types, got %d" % (
+        len(ops),)
+    # spot-check the families the ISSUE names
+    for must in ("matmul", "mul", "conv2d", "lstm", "softmax",
+                 "lookup_table", "reduce_sum", "concat", "adam",
+                 "elementwise_add", "sequence_pool", "reshape"):
+        assert must in ops, must
+
+
+def test_every_infer_rule_names_a_registered_kernel():
+    """Infer rules for ops that do not exist would be dead weight —
+    every registered rule must target a real kernel."""
+    from paddle_tpu.ops.registry import KERNELS
+
+    missing = [t for t in registered_infer_ops() if t not in KERNELS]
+    assert not missing, missing
+
+
+def test_rewrite_ok_set_is_registered():
+    """Satellite: every op the write-once check exempts must actually be
+    a registered op (the stale 'sums' entry — the sums LAYER emits a
+    'sum' op — was dropped in the audit)."""
+    from paddle_tpu.analysis.lints import REWRITE_OK
+    from paddle_tpu.ops.registry import KERNELS
+
+    unregistered = sorted(t for t in REWRITE_OK if t not in KERNELS)
+    assert not unregistered, unregistered
+    assert "sums" not in REWRITE_OK
+
+
+# -- bundled example programs: zero false positives -----------------------
+
+
+@pytest.mark.parametrize("name", ["mlp", "deepfm", "lstm"])
+def test_examples_lint_clean(name):
+    pl = _load_program_lint()
+    prog, feeds, fetches = pl.build_example(name)
+    analysis = analyze_program(prog, feed_names=feeds,
+                               fetch_names=fetches)
+    rep = analysis.report
+    assert rep.errors == [], rep.render("error")
+    assert rep.warnings == [], rep.render("warning")
+    # analyzer self-checks: inferred shapes agree with layer-declared
+    # shapes, and no rule crashed
+    assert rep.by_code("declared-drift") == [], rep.render("note")
+    assert rep.by_code("infer-rule-crash") == [], rep.render("note")
+    # every op instance in these graphs has a registered rule
+    assert rep.covered_ops == rep.total_ops
+    assert rep.total_ops > 0
+
+
+def test_program_lint_cli_json_and_exit_code(capsys):
+    pl = _load_program_lint()
+    rc = pl.main(["--example", "all", "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    import json
+
+    doc = json.loads(out)
+    assert len(doc["programs"]) == 3
+    for p in doc["programs"]:
+        assert p["counts"]["error"] == 0
+        assert p["infer_coverage"] == 1.0
+
+
+# -- seeded defects: caught pre-trace with op provenance ------------------
+
+
+def _seed_bad_mul(prog):
+    """A mul whose weight K disagrees with the activation's feature
+    count."""
+    b = prog.global_block()
+    w = b.create_parameter(name="bad_w", shape=[5, 3], dtype="float32")
+    out = b.create_var(name="bad_out", shape=(-1, 3), dtype="float32")
+    # find an fc activation to abuse
+    src = next(op.output("Out")[0] for op in b.ops if op.type == "mul")
+    op = b.append_op(type="mul", inputs={"X": [src], "Y": [w]},
+                     outputs={"Out": [out]})
+    return b.ops.index(op)
+
+
+def test_seeded_shape_mismatch_mlp():
+    pl = _load_program_lint()
+    prog, feeds, fetches = pl.build_example("mlp")
+    bad_idx = _seed_bad_mul(prog)
+    rep = analyze_program(prog, feed_names=feeds,
+                          fetch_names=fetches).report
+    errs = rep.by_code("shape-mismatch")
+    assert len(errs) == 1
+    d = errs[0]
+    # op-level provenance, pinned
+    assert d.block_idx == 0 and d.op_idx == bad_idx and d.op_type == "mul"
+    assert "K=" in d.message and d.hint
+
+
+def test_seeded_use_before_def_deepfm():
+    pl = _load_program_lint()
+    prog, feeds, fetches = pl.build_example("deepfm")
+    b = prog.global_block()
+    ghost_out = b.create_var(name="ghost_out", shape=(-1, 1),
+                             dtype="float32")
+    op = b.insert_op(0, type="relu", inputs={"X": ["never_written"]},
+                     outputs={"Out": [ghost_out]})
+    del op
+    rep = analyze_program(prog, feed_names=feeds,
+                          fetch_names=fetches).report
+    errs = [d for d in rep.errors
+            if d.code in ("use-before-def", "undeclared")]
+    assert errs and errs[0].op_idx == 0 and errs[0].op_type == "relu"
+
+
+def test_seeded_dynamic_shape_lstm():
+    pl = _load_program_lint()
+    prog, feeds, fetches = pl.build_example("lstm")
+    b = prog.global_block()
+    # a data var with an unknown NON-batch dim: TPU-fatal dynamism
+    b.create_var(name="bad_feed", shape=(-1, -1), dtype="float32",
+                 is_data=True)
+    rep = analyze_program(prog, feed_names=feeds + ["bad_feed"],
+                          fetch_names=fetches).report
+    dyn = rep.by_code("tpu-dynamic-shape")
+    assert len(dyn) == 1 and dyn[0].var == "bad_feed"
+    assert dyn[0].severity == "warning"
+    risky = [d for d in rep.by_code("recompile-risk")
+             if d.severity == "warning"]
+    assert risky and risky[0].var == "bad_feed"
+
+
+# -- infer rules cross-checked against traced kernels ---------------------
+
+RNG = np.random.RandomState(7)
+
+
+def _f(*shape):
+    return RNG.randn(*shape).astype(np.float32)
+
+
+@pytest.mark.parametrize("op_type,inputs,attrs,outs", [
+    ("relu", {"X": _f(3, 4)}, None, ("Out",)),
+    ("tanh", {"X": _f(2, 5)}, None, ("Out",)),
+    ("scale", {"X": _f(4,)}, {"scale": 2.0}, ("Out",)),
+    ("softmax", {"X": _f(3, 7)}, None, ("Out",)),
+    ("elementwise_add", {"X": _f(2, 3, 4), "Y": _f(3, 4)}, {"axis": 1},
+     ("Out",)),
+    ("elementwise_mul", {"X": _f(4, 5), "Y": _f(4, 5)}, None, ("Out",)),
+    ("mul", {"X": _f(3, 4), "Y": _f(4, 6)}, None, ("Out",)),
+    ("matmul", {"X": _f(2, 3, 4), "Y": _f(2, 4, 5)}, None, ("Out",)),
+    ("matmul", {"X": _f(3, 4), "Y": _f(5, 4)}, {"transpose_Y": True},
+     ("Out",)),
+    ("sum", {"X": [_f(3, 4), _f(3, 4)]}, None, ("Out",)),
+    ("mean", {"X": _f(3, 4)}, None, ("Out",)),
+    ("reduce_sum", {"X": _f(2, 3, 4)}, {"dim": [1]}, ("Out",)),
+    ("reduce_mean", {"X": _f(2, 3)}, {"dim": [0], "keep_dim": True},
+     ("Out",)),
+    ("reduce_max", {"X": _f(2, 3)}, {"reduce_all": True}, ("Out",)),
+    ("cross_entropy",
+     {"X": np.abs(_f(4, 10)) + 0.1,
+      "Label": RNG.randint(0, 10, (4, 1))}, None, ("Y",)),
+    ("softmax_with_cross_entropy",
+     {"Logits": _f(4, 10), "Label": RNG.randint(0, 10, (4, 1))}, None,
+     ("Loss", "Softmax")),
+    ("square_error_cost", {"X": _f(3, 1), "Y": _f(3, 1)}, None, ("Out",)),
+    ("sigmoid_cross_entropy_with_logits",
+     {"X": _f(3, 2), "Label": np.ones((3, 2), np.float32)}, None,
+     ("Out",)),
+    ("reshape", {"X": _f(2, 6)}, {"shape": [0, 2, 3]}, ("Out",)),
+    ("reshape", {"X": _f(4, 6)}, {"shape": [-1, 8]}, ("Out",)),
+    ("squeeze", {"X": _f(2, 1, 3)}, {"axes": [1]}, ("Out",)),
+    ("unsqueeze", {"X": _f(2, 3)}, {"axes": [0, 2]}, ("Out",)),
+    ("transpose", {"X": _f(2, 3, 4)}, {"axis": [2, 0, 1]}, ("Out",)),
+    ("concat", {"X": [_f(2, 3), _f(2, 5)]}, {"axis": 1}, ("Out",)),
+    ("stack", {"X": [_f(2, 3), _f(2, 3)]}, {"axis": 1}, ("Y",)),
+    ("flatten", {"X": _f(2, 3, 4)}, {"axis": 2}, ("Out",)),
+    ("expand", {"X": _f(2, 3)}, {"expand_times": [2, 1]}, ("Out",)),
+    ("slice", {"Input": _f(4, 6)},
+     {"axes": [1], "starts": [1], "ends": [4]}, ("Out",)),
+    ("pad", {"X": _f(2, 3)}, {"paddings": [0, 1, 2, 0]}, ("Out",)),
+    ("shape", {"Input": _f(2, 3, 4)}, None, ("Out",)),
+    ("gather", {"X": _f(5, 3), "Index": np.array([0, 2, 4])}, None,
+     ("Out",)),
+    ("lookup_table",
+     {"W": _f(10, 4), "Ids": RNG.randint(0, 10, (3, 5))}, None, ("Out",)),
+    ("one_hot", {"X": RNG.randint(0, 6, (4, 1))}, {"depth": 6}, ("Out",)),
+    ("top_k", {"X": _f(3, 8)}, {"k": 2}, ("Out", "Indices")),
+    ("arg_max", {"X": _f(3, 8)}, {"axis": 1}, ("Out",)),
+    ("argsort", {"X": _f(3, 8)}, None, ("Out", "Indices")),
+    ("cast", {"X": _f(3, 4)}, {"out_dtype": "int32"}, ("Out",)),
+    ("fill_constant", {}, {"shape": [2, 3], "value": 1.5}, ("Out",)),
+    ("fill_constant_batch_size_like", {"Input": _f(7, 2)},
+     {"shape": [1, 4], "input_dim_idx": 0, "output_dim_idx": 0},
+     ("Out",)),
+    ("less_than", {"X": _f(3, 4), "Y": _f(3, 4)}, None, ("Out",)),
+    ("equal", {"X": _f(2, 2), "Y": _f(2, 2)}, None, ("Out",)),
+    ("dropout", {"X": _f(3, 4)}, {"dropout_prob": 0.0}, ("Out",)),
+    ("l2_normalize", {"X": _f(3, 4)}, {"axis": -1}, ("Out", "Norm")),
+    ("split", {"X": _f(4, 6)}, {"axis": 1, "num": 2}, ("Out",)),
+    ("conv2d", {"Input": _f(2, 3, 8, 8), "Filter": _f(6, 3, 3, 3)},
+     {"strides": [2, 2], "paddings": [1, 1]}, ("Output",)),
+    ("pool2d", {"X": _f(2, 3, 8, 8)},
+     {"ksize": [2, 2], "strides": [2, 2], "pooling_type": "avg"},
+     ("Out",)),
+    ("batch_norm",
+     {"X": _f(2, 3, 4, 4), "Scale": _f(3), "Bias": _f(3),
+      "Mean": _f(3), "Variance": np.abs(_f(3)) + 0.5},
+     {"is_test": True}, ("Y",)),
+    ("layer_norm", {"X": _f(4, 6)}, {"begin_norm_axis": 1},
+     ("Y", "Mean", "Variance")),
+])
+def test_check_infer_matches_traced_kernel(op_type, inputs, attrs, outs):
+    check_infer(op_type, inputs, attrs=attrs, outs=outs)
+
+
+def test_check_infer_catches_a_drifted_rule(monkeypatch):
+    """The harness itself must fail when a rule lies about shapes."""
+    from paddle_tpu.analysis import infer as infer_mod
+
+    def bad_rule(ctx):
+        return {"Out": infer_mod.VarInfo((1, 2, 3), "float32")}
+
+    monkeypatch.setitem(infer_mod.INFER_RULES, "relu", bad_rule)
+    with pytest.raises(AssertionError, match="rank"):
+        check_infer("relu", {"X": _f(3, 4)})
+
+
+# -- degrade-to-unknown contract (no guessed dims) ------------------------
+
+
+def test_elementwise_broadcast_up_unknown_dim_degrades():
+    """X dim 1 broadcasting against an UNKNOWN Y dim must infer unknown,
+    never a guessed 1 (a guessed dim could cascade into a false
+    shape-mismatch downstream)."""
+    from paddle_tpu.analysis.infer import INFER_RULES, InferContext, _Env, VarInfo
+    from paddle_tpu.framework.core import Program
+
+    prog = Program()
+    b = prog.global_block()
+    b.create_var(name="x", shape=(2, 1, 5), dtype="float32")
+    b.create_var(name="y", shape=(-1, 5), dtype="float32")
+    out = b.create_var(name="o", shape=None, dtype="float32")
+    op = b.append_op(type="elementwise_add",
+                     inputs={"X": ["x"], "Y": ["y"]},
+                     outputs={"Out": [out]}, attrs={"axis": 1})
+    env = _Env()
+    env.set("x", VarInfo((2, 1, 5), "float32"))
+    env.set("y", VarInfo((None, 5), "float32"))
+    res = INFER_RULES["elementwise_add"](InferContext(op, b, env))
+    assert res["Out"].shape == (2, None, 5)
+
+
+def test_lookup_table_unknown_trailing_ids_dim_degrades():
+    """The kernel squeezes a trailing 1 at trace time; an unknown
+    trailing Ids dim means the OUTPUT RANK is unknown."""
+    from paddle_tpu.analysis.infer import INFER_RULES, InferContext, _Env, VarInfo
+    from paddle_tpu.framework.core import Program
+
+    prog = Program()
+    b = prog.global_block()
+    b.create_var(name="w", shape=(10, 4), dtype="float32")
+    b.create_var(name="ids", shape=(-1, -1), dtype="int64")
+    out = b.create_var(name="o", shape=None, dtype="float32")
+    op = b.append_op(type="lookup_table",
+                     inputs={"W": ["w"], "Ids": ["ids"]},
+                     outputs={"Out": [out]})
+    env = _Env()
+    env.set("w", VarInfo((10, 4), "float32"))
+    env.set("ids", VarInfo((None, None), "int64"))
+    res = INFER_RULES["lookup_table"](InferContext(op, b, env))
+    assert res["Out"].shape is None
+    assert res["Out"].dtype == "float32"
+
+
+def test_reduce_out_of_range_dim_is_an_error():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = layers.data(name="x", shape=[4])
+        b = prog.global_block()
+        out = b.create_var(name="o", shape=(-1,), dtype="float32")
+        b.append_op(type="reduce_sum", inputs={"X": [x]},
+                    outputs={"Out": [out]}, attrs={"dim": [3]})
+    rep = analyze_program(prog, feed_names=["x"],
+                          fetch_names=["o"]).report
+    assert any(d.code == "shape-mismatch" and "out of range" in d.message
+               for d in rep.errors), rep.render("note")
+
+
+# -- lint units -----------------------------------------------------------
+
+
+def test_dead_code_lint_silent_without_roots():
+    """A forward-only graph with no fetch info, no fetch ops, and no
+    persistable writes has nothing to anchor liveness on — the lint must
+    stay silent instead of calling the whole program dead."""
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = layers.data(name="x", shape=[4])
+        layers.softmax(layers.reduce_sum(x, dim=[1], keep_dim=True))
+    rep = analyze_program(prog, feed_names=["x"], fetch_names=[]).report
+    assert rep.by_code("dead-op") == [], rep.render("note")
+
+
+def test_dead_op_lint():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = layers.data(name="x", shape=[4])
+        live = layers.reduce_sum(x)
+        layers.relu(x)  # dead: output never consumed, not fetched
+    rep = analyze_program(prog, feed_names=["x"],
+                          fetch_names=[live.name]).report
+    dead = rep.by_code("dead-op")
+    assert len(dead) == 1 and dead[0].op_type == "relu"
+    assert dead[0].severity == "warning"
+
+
+def test_op_not_registered_lint_with_suggestion():
+    prog = fluid.Program()
+    b = prog.global_block()
+    b.create_var(name="x", shape=(2, 2), dtype="float32", is_data=True)
+    out = b.create_var(name="y", shape=(2, 2), dtype="float32")
+    b.append_op(type="matmull", inputs={"X": ["x"], "Y": ["x"]},
+                outputs={"Out": [out]})
+    rep = analyze_program(prog, feed_names=["x"],
+                          fetch_names=["y"]).report
+    bad = rep.by_code("op-not-registered")
+    assert len(bad) == 1 and "did you mean" in bad[0].message
+    assert "matmul" in bad[0].message
+
+
+def test_while_shape_varying_carry_widens_and_warns():
+    """A carry whose shape differs between loop entry and body output is
+    not invariant: the parent scope must see the WIDENED value (never one
+    iteration's concrete shape) and a loop-carry-varies warning fires."""
+    from paddle_tpu.layers import control_flow as cf
+
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        c = layers.fill_constant(shape=[10], dtype="float32", value=0.0)
+        i = layers.fill_constant(shape=[1], dtype="int32", value=0)
+        limit = layers.fill_constant(shape=[1], dtype="int32", value=3)
+        cond = cf.less_than(i, limit)
+        w = cf.While(cond)
+        with w.block():
+            layers.fill_constant(shape=[20], dtype="float32", value=1.0,
+                                 out=c)
+            cf.increment(i)
+            cf.less_than(i, limit, cond=cond)
+    a = analyze_program(prog, fetch_names=[c.name])
+    assert a.inference.shape(c.name) == (None,), a.inference.info(c.name)
+    flags = a.report.by_code("loop-carry-varies")
+    assert len(flags) == 1 and flags[0].var == c.name
+    assert flags[0].op_type == "while" and flags[0].severity == "warning"
+
+
+def test_while_carry_dependent_growth_warns():
+    """The canonical growing-carry case — the body's output shape depends
+    on the carry itself (concat grows it every iteration). The diagnostic
+    must compare against the FIRST iteration's concrete output, where the
+    growth is visible, not a widened later pass."""
+    from paddle_tpu.layers import control_flow as cf
+
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        c = layers.fill_constant(shape=[10], dtype="float32", value=0.0)
+        extra = layers.fill_constant(shape=[1], dtype="float32", value=1.0)
+        i = layers.fill_constant(shape=[1], dtype="int32", value=0)
+        limit = layers.fill_constant(shape=[1], dtype="int32", value=3)
+        cond = cf.less_than(i, limit)
+        w = cf.While(cond)
+        with w.block():
+            layers.assign(layers.concat([c, extra], axis=0), c)
+            cf.increment(i)
+            cf.less_than(i, limit, cond=cond)
+    a = analyze_program(prog, fetch_names=[c.name])
+    flags = [d for d in a.report.by_code("loop-carry-varies")
+             if d.var == c.name]
+    assert flags, a.render("note")
+    assert a.inference.shape(c.name) == (None,), a.inference.info(c.name)
+
+
+def test_while_subblock_fixpoint():
+    """Control-flow sub-blocks analyze to a fixed point without findings
+    on a well-formed loop."""
+    from paddle_tpu.layers import control_flow as cf
+
+    i = layers.fill_constant(shape=[1], dtype="int32", value=0)
+    acc = layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+    limit = layers.fill_constant(shape=[1], dtype="int32", value=10)
+    cond = cf.less_than(i, limit)
+    w = cf.While(cond)
+    with w.block():
+        layers.assign(
+            layers.elementwise_add(acc, layers.cast(i, "float32")), acc)
+        cf.increment(i)
+        cf.less_than(i, limit, cond=cond)
+    prog = fluid.default_main_program()
+    analysis = analyze_program(prog, fetch_names=[acc.name])
+    assert analysis.report.errors == [], analysis.render("error")
+    assert analysis.inference.shape(acc.name) == (1,)
+
+
+def test_inference_attaches_to_variables():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = layers.data(name="x", shape=[4])
+        h = layers.fc(x, 8, act="relu")
+    analyze_program(prog, feed_names=["x"], fetch_names=[h.name])
+    assert h.inferred_shape == (None, 8)
+    assert h.inferred_dtype == "float32"
+
+
+def test_analysis_observability_counters():
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability import export
+
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = layers.data(name="x", shape=[4])
+        layers.relu(x)  # dead op -> at least one finding
+    analyze_program(prog, feed_names=["x"], fetch_names=[])
+    text = export.to_prometheus(obs.REGISTRY)
+    assert "paddle_tpu_analysis_infer_coverage" in text
+    assert "paddle_tpu_analysis_issues_total" in text
+
+
+# -- registry did-you-mean (satellite) ------------------------------------
+
+
+def test_get_kernel_did_you_mean():
+    from paddle_tpu.ops.registry import get_kernel
+
+    with pytest.raises(NotImplementedError,
+                       match="did you mean 'matmul'"):
+        get_kernel("matmull")
+    # nothing close: no suggestion rendered
+    with pytest.raises(NotImplementedError) as ei:
+        get_kernel("zzzzqqqq_no_such")
+    assert "did you mean" not in str(ei.value)
+
+
+def test_did_you_mean_helper():
+    assert "softmax" in did_you_mean("softmxa", ["softmax", "relu"])
+    assert did_you_mean("zzz", ["softmax"]) == ""
+
+
+# -- executor / predictor integration -------------------------------------
+
+
+def test_executor_verify_env_catches_pre_trace(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_VERIFY", "1")
+    pl = _load_program_lint()
+    prog, feeds, fetches = pl.build_example("mlp")
+    bad_idx = _seed_bad_mul(prog)
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = {"pixel": np.zeros((2, 784), np.float32),
+            "label": np.zeros((2, 1), np.int64)}
+    with pytest.raises(fluid.ProgramVerifyError) as ei:
+        exe.run(prog, feed=feed, fetch_list=list(fetches) + ["bad_out"])
+    msg = str(ei.value)
+    assert "shape-mismatch" in msg and ("op %d" % bad_idx) in msg
+    assert isinstance(ei.value, AnalysisError)
+
+
+def test_executor_strict_mode_raises_on_warnings(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_VERIFY", "strict")
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = layers.data(name="x", shape=[4])
+        live = layers.reduce_sum(x)
+        layers.relu(x)  # dead-op warning -> fatal under strict
+    exe = fluid.Executor(fluid.CPUPlace())
+    with pytest.raises(fluid.ProgramVerifyError, match="dead-op"):
+        exe.run(prog, feed={"x": np.ones((1, 4), np.float32)},
+                fetch_list=[live])
+
+
+def test_verify_default_mode_unchanged(monkeypatch):
+    """Without PADDLE_TPU_VERIFY the legacy def-use verifier (shim) runs:
+    use-before-def still raises ProgramVerifyError, clean programs run."""
+    monkeypatch.delenv("PADDLE_TPU_VERIFY", raising=False)
+    x = layers.data(name="x", shape=[4])
+    out = layers.reduce_sum(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with pytest.raises(fluid.ProgramVerifyError, match="use-before-def"):
+        exe.run(feed={}, fetch_list=[out])
+    r, = exe.run(feed={"x": np.ones((2, 4), np.float32)},
+                 fetch_list=[out])
+    assert np.isclose(float(r), 8.0)
+
+
+def test_trace_error_rerendered_with_provenance():
+    """A defect the analyzer knows about but default mode doesn't check:
+    the TraceError must carry the analyzer's per-op post-mortem."""
+    from paddle_tpu.framework.trace import TraceError
+
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = layers.data(name="x", shape=[4])
+        h = layers.fc(x, 8)
+        b = prog.global_block()
+        w = b.create_parameter(name="bad_w", shape=[5, 3],
+                               dtype="float32")
+        out = b.create_var(name="bad_out", shape=(-1, 3),
+                           dtype="float32")
+        b.append_op(type="mul", inputs={"X": [h], "Y": [w]},
+                    outputs={"Out": [out]})
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    fluid.global_scope().set_var("bad_w", np.ones((5, 3), np.float32))
+    with pytest.raises(TraceError) as ei:
+        exe.run(prog, feed={"x": np.ones((2, 4), np.float32)},
+                fetch_list=[out])
+    msg = str(ei.value)
+    assert "analyzer provenance" in msg
+    assert "shape-mismatch" in msg
+
+
+def test_verify_program_shim_returns_issue_tuples():
+    prog = fluid.Program()
+    b = prog.global_block()
+    b.create_var(name="a", shape=(2,), dtype="float32", is_data=True)
+    out1 = b.create_var(name="o", shape=(2,), dtype="float32")
+    b.append_op(type="relu", inputs={"X": ["a"]}, outputs={"Out": [out1]})
+    b.append_op(type="tanh", inputs={"X": ["a"]}, outputs={"Out": [out1]})
+    issues = fluid.verify_program(prog, feed_names=["a"],
+                                  raise_on_error=False)
+    kinds = [k for k, _ in issues]
+    assert kinds == ["write-once"]
+    assert "write-once violation" in issues[0][1]
