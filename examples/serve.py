@@ -77,6 +77,15 @@ def main():
                     help="batching deadline: wait up to this many ms "
                          "after a batch's first request for it to fill "
                          "(see docs/performance.md 'Serving tuning')")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help=">1 serves through the fleet Router: N worker "
+                         "PROCESSES behind one front door, least-"
+                         "outstanding balancing, per-replica health "
+                         "(docs/performance.md 'Serving fleet tuning')")
+    ap.add_argument("--shard", type=int, default=1,
+                    help=">1 serves ONE tensor-parallel model under "
+                         "pjit over this many devices per replica "
+                         "(megatron plan rules reused at inference)")
     ap.add_argument("--metrics-port", type=int, default=0,
                     help="bind /metrics here (0 = pick a free port)")
     ap.add_argument("--metrics-host", default="127.0.0.1",
@@ -97,9 +106,23 @@ def main():
         assert acc > 0.9, "model should fit its own training batch"
 
         # --- dynamically batched server, concurrent clients ------------
-        server = PredictorServer(pred, max_batch=args.max_batch,
-                                 max_wait_ms=args.max_wait_ms)
-        server.start()
+        # one process (the PR-2 pipelined server), or a fleet of worker
+        # processes behind the Router front door — same submit() surface
+        if args.replicas > 1 or args.shard > 1:
+            from paddle_tpu.serving import Router
+
+            server = Router(model_dir, replicas=args.replicas,
+                            shard=args.shard, max_batch=args.max_batch,
+                            max_wait_ms=args.max_wait_ms,
+                            jax_platform="cpu" if args.cpu else None)
+            server.start()
+            print("fleet: %d replica(s), shard=%d — %s"
+                  % (args.replicas, args.shard,
+                     [(h["replica"], h["state"]) for h in server.health()]))
+        else:
+            server = PredictorServer(pred, max_batch=args.max_batch,
+                                     max_wait_ms=args.max_wait_ms)
+            server.start()
         port = server.start_http(args.metrics_port, host=args.metrics_host)
         # an all-interfaces bind is still scrapeable via loopback
         scrape_host = ("127.0.0.1" if args.metrics_host == "0.0.0.0"
@@ -140,8 +163,21 @@ def main():
         assert "paddle_tpu_predict_latency_ms_bucket" in text
 
         from paddle_tpu import observability as obs
-        lat = obs.PREDICT_LATENCY_MS.stats(path="server")
+        fleet = args.replicas > 1 or args.shard > 1
+        lat = obs.PREDICT_LATENCY_MS.stats(
+            path="router" if fleet else "server")
         fill = obs.PREDICT_BATCH_ROWS.stats(path="server")
+        if fleet:
+            # batch fill lives in the worker processes: pull the merged
+            # fleet registry over the control pipes
+            merged = server.fleet_metrics()
+            for s in merged["metrics"].get(
+                    "paddle_tpu_predict_batch_rows", {}).get("series", ()):
+                if s["labels"].get("path") == "server":
+                    fill = {"count": fill["count"] + s["count"],
+                            "sum": fill["sum"] + s["sum"], "mean": 0.0}
+            if fill["count"]:
+                fill["mean"] = fill["sum"] / fill["count"]
         server.stop()
         assert not errs, errs
         n = args.clients * args.rows_per_client

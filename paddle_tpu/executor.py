@@ -234,11 +234,12 @@ class Executor:
         # no such ref, so an id-keyed entry could outlive its program
         # and be served to a new one at the same address
         self._read_ops = weakref.WeakKeyDictionary()
-        # per-program feed-conversion plan (serving fast path): the
-        # recursive block-walk var lookup behind every feed name runs
-        # once per program version, not once per call — weak keys for
-        # the same id-reuse reason as _read_ops
-        self._feed_vars = weakref.WeakKeyDictionary()
+        # per-program compile/execute core (serving.engine.Engine):
+        # feed-conversion plan + AOT key derivation + the
+        # load-or-compile acquisition path, SHARED with the inference
+        # Predictor so the two can never diverge — weak keys for the
+        # same id-reuse reason as _read_ops
+        self._engines = weakref.WeakKeyDictionary()
         # per-PROGRAM step counters (the RNG stream fold): running one
         # program (e.g. startup) must not advance another program's
         # stochastic-op stream, or the same training program draws
@@ -423,7 +424,7 @@ class Executor:
         if not self._disk.enabled:
             return fn, self._hlo_compile_stats(fn, feed_sig, state_in,
                                                scope, loop=loop)
-        fp = obs.program_fp(program)
+        eng = self._engine_for(program)
         try:
             args = self._avals_for(feed_sig, state_in, scope, loop=loop)
             # the state SIGNATURE (not just names) keys the cache: scope
@@ -433,54 +434,37 @@ class Executor:
             state_sig = tuple(sorted(
                 (n, tuple(a.shape), str(a.dtype))
                 for n, a in args[1].items()))
-            # program._version is deliberately NOT in the key: the
-            # fingerprint already hashes full content, and the version is
-            # a process-local mutation counter — a content-identical
-            # program rebuilt another way (from_json, clone) would end at
-            # a different version and spuriously miss its warm start.
-            # (The in-memory cache still keys on (id, version) for its
-            # staleness check; disk keys don't need one.)
-            key = self._disk.key((
-                "loop" if loop else "step", program.fingerprint(),
-                feed_sig, fetch_names, state_sig,
-                tuple(state_out), tuple(sorted(per_step_names)),
-                _aot.env_fingerprint()))
+            # key derivation lives in serving.engine.Engine (the layout —
+            # incl. the deliberate ABSENCE of program._version — is
+            # documented on Engine.key_fields and shared with Predictor)
+            key = eng.key("loop" if loop else "step", feed_sig, fetch_names,
+                          state_sig, tuple(state_out),
+                          tuple(sorted(per_step_names)))
         except Exception:
             # an aval we can't build (exotic state value) must never
             # block execution: lazy jit handles it like before
             return fn, self._hlo_compile_stats(fn, feed_sig, state_in,
                                                scope, loop=loop)
-        t0 = time.perf_counter()
-        loaded = self._disk.load(key)
-        if loaded is not None:
-            obs.CACHE_HITS.inc(kind=kind, tier="disk", program=fp)
-            obs.AOT_COMPILE_MS.observe((time.perf_counter() - t0) * 1e3,
-                                       path="warm", kind=kind)
-            obs.TIMELINE.record_compile(kind, fp, cache="aot-load")
-            return loaded, None
-        obs.CACHE_MISSES.inc(kind=kind, tier="disk", program=fp)
-        t0 = time.perf_counter()
-        try:
-            lowered = fn.lower(*args)
-        except TraceError as e:
-            self._rethrow_with_provenance(
-                program, e, feed_names=tuple(n for n, _, _ in feed_sig),
-                fetch_names=tuple(fetch_names))
-        t1 = time.perf_counter()
-        compiled = lowered.compile()
-        t2 = time.perf_counter()
-        obs.AOT_COMPILE_MS.observe((t2 - t0) * 1e3, path="cold", kind=kind)
+
+        def lower():
+            try:
+                return fn.lower(*args)
+            except TraceError as e:
+                self._rethrow_with_provenance(
+                    program, e, feed_names=tuple(n for n, _, _ in feed_sig),
+                    fetch_names=tuple(fetch_names))
+
+        compiled, path, hlo = eng.acquire(
+            kind, key, lower,
+            meta=eng.meta("loop" if loop else "step", feed_sig, fetch_names))
+        if path == "warm":
+            return compiled, None
         # the trace/XLA split comes free on the explicit AOT path (the
         # lazy path needs opt-in _hlo_compile_stats to pay for it)
-        hlo = {"trace_ms": (t1 - t0) * 1e3, "xla_ms": (t2 - t1) * 1e3}
         if obs.TIMELINE.hlo_cost_enabled():
             cost = obs.hlo_cost_stats(compiled)
             if cost:
                 hlo.update(cost)
-        self._disk.store(key, compiled, meta={
-            "kind": "loop" if loop else "step", "program": fp,
-            "feed_sig": feed_sig, "fetch_names": tuple(fetch_names),
-            "env": _aot.env_fingerprint(), "created": time.time()})
         return compiled, hlo
 
     def _hlo_compile_stats(self, fn, feed_sig, state_in, scope, loop=False):
@@ -591,25 +575,27 @@ class Executor:
             self._read_ops[program] = entry
         return entry[1]
 
+    def _engine_for(self, program: Program):
+        """This program's shared compile/execute core (one per program,
+        weak-keyed). The disk handle is refreshed on every access so a
+        caller that swaps ``self._disk`` (tests point it at scratch
+        dirs) is honored by engines built earlier."""
+        from .serving.engine import Engine
+
+        eng = self._engines.get(program)
+        if eng is None:
+            eng = Engine(program, disk=self._disk)
+            self._engines[program] = eng
+        eng.disk = self._disk
+        return eng
+
     def _feed_var_for(self, program: Program, gb, name: str):
-        """`gb._find_var_recursive(name)` memoized per (program,
-        version): feed dtype coercion needs the declared Variable every
-        call, but the declaration only changes when the program does —
-        on a steady serving/training loop this is a dict hit. Negative
-        lookups are NOT cached: create_var alone does not bump
-        program._version, so a var added between runs would stay
-        invisible behind a cached None."""
-        entry = self._feed_vars.get(program)
-        if entry is None or entry[0] != program._version:
-            entry = (program._version, {})
-            self._feed_vars[program] = entry
-        cache = entry[1]
-        var = cache.get(name)
-        if var is None:
-            var = gb._find_var_recursive(name)
-            if var is not None:
-                cache[name] = var
-        return var
+        """Declared Variable behind a feed name, memoized per (program,
+        version) in the program's Engine (see Engine.feed_var for the
+        negative-lookup contract) — feed dtype coercion needs the
+        declaration every call, but it only changes when the program
+        does, so on a steady serving/training loop this is a dict hit."""
+        return self._engine_for(program).feed_var(name)
 
     @staticmethod
     def _holder_for(gb, op):
@@ -1094,7 +1080,7 @@ class Executor:
     def close(self):
         self._cache.clear()
         self._reader_prefetch.clear()
-        self._feed_vars.clear()
+        self._engines.clear()
         # retire this executor's gauge series so executor churn in a
         # long-lived process doesn't grow the registry without bound
         obs.READER_PREFETCH_DEPTH.remove(exe=self._obs_exe)

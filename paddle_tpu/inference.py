@@ -80,20 +80,20 @@ class Predictor:
         self._disk = _aot.AotDiskCache(cache_dir=self._cache_dir,
                                        enabled=aot_cache)
         _aot.maybe_enable_jax_cache()
+        # the shared compile/execute core (serving.engine.Engine): the
+        # SAME feed-plan + AOT-key + load-or-compile code path the
+        # training Executor uses — the two can no longer diverge
+        from .serving.engine import Engine
+
+        self._engine = Engine(self._program, disk=self._disk,
+                              feed_names=self._feed_names,
+                              fetch_names=self._fetch_names)
         self._compiled: Dict = {}
         self._touched: set = set()  # sigs whose USE this process recorded
         # feed-conversion plan, computed ONCE: the model's feed set is
         # frozen at load, so the per-call var lookup + declared-dtype
         # resolution of the old run() path is pure steady-state overhead
-        from .framework.dtypes import as_numpy_dtype
-
-        gb = self._program.global_block()
-        self._feed_plan = []
-        for name in self._feed_names:
-            var = gb._find_var_recursive(name)
-            want = (np.dtype(as_numpy_dtype(var.dtype))
-                    if var is not None else None)
-            self._feed_plan.append((name, var, want))
+        self._feed_plan = self._engine.feed_plan()
         # pre-trace static analysis, same knob as the Executor
         # (PADDLE_TPU_VERIFY=1|strict): a broken exported model fails at
         # LOAD with op-level provenance, not at the first predict call
@@ -132,22 +132,17 @@ class Predictor:
         return state_in, state
 
     # -- compilation cache -------------------------------------------------
-    def _key_fields(self, feed_sig):
-        """Key fields for the shared store: program + feeds + fetch ORDER
-        (the executable returns outputs in this order) + the environment
-        fingerprint (jax/jaxlib/backend/device kind/x64/trace knobs) —
-        a toolchain change is a key miss, never a stale-blob load."""
-        return ("predict", self._program.fingerprint(), feed_sig,
-                tuple(self._fetch_names), _aot.env_fingerprint())
-
     def _key(self, feed_sig) -> str:
-        return self._disk.key(self._key_fields(feed_sig))
+        """Shared-store key via the Engine: program + feeds + fetch ORDER
+        (the executable returns outputs in this order) + the environment
+        fingerprint — a toolchain change is a key miss, never a
+        stale-blob load (field layout: Engine.key_fields)."""
+        return self._engine.key("predict", feed_sig,
+                                tuple(self._fetch_names))
 
     def _meta(self, feed_sig) -> Dict:
-        return {"kind": "predict", "program": obs.program_fp(self._program),
-                "feed_sig": feed_sig,
-                "fetch_names": tuple(self._fetch_names),
-                "env": _aot.env_fingerprint(), "created": time.time()}
+        return self._engine.meta("predict", feed_sig,
+                                 tuple(self._fetch_names))
 
     def _step_fn(self):
         program = self._program
@@ -185,32 +180,13 @@ class Predictor:
         Executor._check_feed_shapes(self._program, feed_sig)
 
         key = self._key(feed_sig)
-        loaded = None
-        if self._disk.enabled:
-            t0 = time.perf_counter()
-            loaded = self._disk.load(key)
-            if loaded is not None:
-                obs.CACHE_HITS.inc(kind="predict", tier="disk", program=fp)
-                obs.AOT_COMPILE_MS.observe(
-                    (time.perf_counter() - t0) * 1e3, path="warm",
-                    kind="predict")
-                obs.TIMELINE.record_compile("predict", fp, cache="aot-load")
-                if not self._disk.has_meta(key):
-                    # a cache written before sidecars existed: create the
-                    # .sig now so the NEXT process's preload finds this
-                    # executable (without this, pre-sidecar caches would
-                    # pay the lazy-deserialization first call forever)
-                    self._disk.write_meta(key, self._meta(feed_sig))
-            else:
-                obs.CACHE_MISSES.inc(kind="predict", tier="disk",
-                                     program=fp)
-        if loaded is None:
+
+        def lower():
             from .framework.trace import TraceError
 
             fn = jax.jit(self._step_fn())
-            t0 = time.perf_counter()
             try:
-                lowered = fn.lower(
+                return fn.lower(
                     {n: jax.ShapeDtypeStruct(s, np.dtype(d))
                      for n, s, d in feed_sig},
                     {n: jax.ShapeDtypeStruct(a.shape, a.dtype)
@@ -220,22 +196,29 @@ class Predictor:
                 Executor._rethrow_with_provenance(
                     self._program, e, feed_names=tuple(self._feed_names),
                     fetch_names=tuple(self._fetch_names))
-            t1 = time.perf_counter()
-            loaded = lowered.compile()
-            t2 = time.perf_counter()
+
+        # acquisition (disk-load-or-compile + the tier metrics contract)
+        # goes through the shared Engine — the same code path the
+        # training Executor's _aot_compile runs
+        loaded, path, timings = self._engine.acquire(
+            "predict", key, lower, meta=self._meta(feed_sig))
+        if path == "warm":
+            if self._disk.read_meta(key) is None:
+                # missing OR unreadable sidecar next to a valid blob
+                # (pre-sidecar cache, or a torn/corrupt .sig write):
+                # rewrite it now so the NEXT process's preload finds
+                # this executable instead of paying the lazy
+                # first-call deserialization forever
+                self._disk.write_meta(key, self._meta(feed_sig))
+        else:
             # the predictor compiles AOT anyway, so the trace/XLA split
             # and cost-analysis estimates come for free here
             cost = obs.hlo_cost_stats(loaded) or {}
+            wall_ms = timings["trace_ms"] + timings["xla_ms"]
             obs.COMPILE_TOTAL.inc(kind="predict")
-            obs.COMPILE_LATENCY_MS.observe((t2 - t0) * 1e3, kind="predict")
-            obs.AOT_COMPILE_MS.observe((t2 - t0) * 1e3, path="cold",
-                                       kind="predict")
+            obs.COMPILE_LATENCY_MS.observe(wall_ms, kind="predict")
             obs.TIMELINE.record_compile(
-                "predict", fp, wall_ms=(t2 - t0) * 1e3,
-                trace_ms=(t1 - t0) * 1e3, xla_ms=(t2 - t1) * 1e3, **cost)
-            # serialize + atomic write + sidecar + GC, all through the
-            # shared store (unwritable dir degrades to compile-only)
-            self._disk.store(key, loaded, meta=self._meta(feed_sig))
+                "predict", fp, wall_ms=wall_ms, **dict(timings, **cost))
         self._compiled[feed_sig] = loaded
         return loaded
 
@@ -299,17 +282,9 @@ class Predictor:
         t0 = time.perf_counter()
         if isinstance(feed, (list, tuple)):
             feed = dict(zip(self._feed_names, feed))
-        feed_arrays = {}
-        for name, _var, want in self._feed_plan:
-            if name not in feed:
-                raise KeyError("missing feed %r (model expects %s)"
-                               % (name, self._feed_names))
-            arr = feed[name]
-            if type(arr) is not np.ndarray:
-                arr = np.asarray(arr)
-            if want is not None and arr.dtype != want:
-                arr = arr.astype(want)
-            feed_arrays[name] = arr
+        # conversion walks the precomputed plan (Engine.convert_feeds —
+        # the one feed-plan code path, shared with the Executor's engine)
+        feed_arrays = self._engine.convert_feeds(feed, self._feed_plan)
         exe = self._get_executable(feed_arrays)
         outs = exe(feed_arrays, self._state)
         outs = ([np.asarray(o) for o in outs] if return_numpy
@@ -355,6 +330,27 @@ def create_paddle_predictor(config_or_dir, **kwargs) -> Predictor:
 
 _encode_request = _rio.encode_frame
 _decode_request = _rio.decode_frame
+
+
+def _encode_sample(rid: int, sample) -> bytes:
+    """One request sample (per-slot arrays, no batch dim) -> wire frame:
+    the zero-copy form when every slot has a buffer-exporting dtype, the
+    pickled ``b"P"`` form otherwise. Shared by ``PredictorServer.submit``
+    and the fleet ``Router.submit`` so the two front doors can never
+    drift in what they put on the wire."""
+    rows, fast = [], True
+    for a in sample:
+        if type(a) is not np.ndarray:
+            a = np.asarray(a)
+        if a.dtype.kind in "OVMm":
+            # object graphs and datetime/timedelta (no buffer export)
+            # can't ride the frame
+            fast = False
+        elif not a.flags["C_CONTIGUOUS"]:
+            a = np.ascontiguousarray(a)
+        rows.append(a)
+    return (_encode_request(rid, rows) if fast
+            else b"P" + pickle.dumps((rid, rows), protocol=4))
 
 
 class PredictorServer:
@@ -471,20 +467,7 @@ class PredictorServer:
             self._results[rid] = fut
         fut._bind(self, rid)
         try:
-            rows, fast = [], True
-            for a in sample:
-                if type(a) is not np.ndarray:
-                    a = np.asarray(a)
-                if a.dtype.kind in "OVMm":
-                    # object graphs and datetime/timedelta (no buffer
-                    # export) can't ride the frame
-                    fast = False
-                elif not a.flags["C_CONTIGUOUS"]:
-                    a = np.ascontiguousarray(a)
-                rows.append(a)
-            msg = (_encode_request(rid, rows) if fast
-                   else b"P" + pickle.dumps((rid, rows), protocol=4))
-            sent = self._chan.send(msg)
+            sent = self._chan.send(_encode_sample(rid, sample))
         except BaseException:
             # an encode/convert failure must not leak the result-table
             # entry registered above
@@ -492,6 +475,29 @@ class PredictorServer:
                 self._results.pop(rid, None)
             raise
         if not sent:
+            with self._lock:
+                self._results.pop(rid, None)
+            raise RuntimeError("predictor server is stopped")
+        return fut
+
+    def submit_frame(self, msg) -> "_Future":
+        """Submit an ALREADY-ENCODED request frame (the fleet worker's
+        fan-in path: the Router forwards the client's wire frame
+        verbatim, so the worker re-encodes nothing). The frame's
+        embedded tag becomes the request id — the caller owns the tag
+        namespace and must not collide with ids minted by ``submit()``
+        (a fleet worker only ever receives router-minted tags, so the
+        two namespaces never mix in one server)."""
+        rid = _rio.frame_tag(msg)
+        fut = _Future()
+        fut._t0 = time.perf_counter()
+        with self._lock:
+            if rid in self._results:
+                raise ValueError("request tag %d is already in flight"
+                                 % rid)
+            self._results[rid] = fut
+        fut._bind(self, rid)
+        if not self._chan.send(msg):
             with self._lock:
                 self._results.pop(rid, None)
             raise RuntimeError("predictor server is stopped")
@@ -710,10 +716,40 @@ class _Future:
         self._t0 = 0.0
         self._server = None
         self._rid = None
+        self._cb_lock = threading.Lock()
+        self._callbacks: list = []
 
     def _bind(self, server, rid):
         self._server = server
         self._rid = rid
+
+    def add_done_callback(self, fn):
+        """Call ``fn(self)`` when the result or error lands (immediately
+        if it already has). Runs on the completing thread (the server's
+        device/stacking stage) — keep it short; exceptions are swallowed
+        so a broken callback cannot kill the serving loop. The fleet
+        worker streams responses back to the router this way instead of
+        parking one thread per in-flight request."""
+        run_now = False
+        with self._cb_lock:
+            if self._ev.is_set():
+                run_now = True
+            else:
+                self._callbacks.append(fn)
+        if run_now:
+            self._run_callback(fn)
+
+    def _run_callback(self, fn):
+        try:
+            fn(self)
+        except Exception:
+            pass
+
+    def _fire_callbacks(self):
+        with self._cb_lock:
+            cbs, self._callbacks = self._callbacks, []
+        for fn in cbs:
+            self._run_callback(fn)
 
     def cancel(self):
         """Drop this request: the server forgets it now and discards its
@@ -725,11 +761,15 @@ class _Future:
 
     def set_result(self, v):
         self._val = v
-        self._ev.set()
+        with self._cb_lock:
+            self._ev.set()
+        self._fire_callbacks()
 
     def set_exception(self, e):
         self._exc = e
-        self._ev.set()
+        with self._cb_lock:
+            self._ev.set()
+        self._fire_callbacks()
 
     def result(self, timeout: Optional[float] = None,
                cancel_on_timeout: bool = True):

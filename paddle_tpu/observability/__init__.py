@@ -22,6 +22,7 @@ from typing import Optional
 from . import export, metrics, timeline  # noqa: F401
 from .metrics import (  # noqa: F401
     DEFAULT_SIZE_BUCKETS, MetricRegistry, REGISTRY, get_registry,
+    process_labels, set_replica,
 )
 from .timeline import TIMELINE, StepTimeline, get_timeline, hlo_cost_stats  # noqa: F401
 
@@ -41,7 +42,9 @@ __all__ = [
     "SERVER_INFLIGHT_DEPTH", "SERVER_STAGE_MS", "AOT_CACHE_BYTES",
     "AOT_CACHE_WRITTEN_BYTES", "AOT_CACHE_EVICTIONS", "AOT_CACHE_CORRUPT",
     "AOT_CACHE_ERRORS", "AOT_COMPILE_MS", "ANALYSIS_ISSUES",
-    "ANALYSIS_COVERAGE",
+    "ANALYSIS_COVERAGE", "set_replica", "process_labels",
+    "FLEET_WORKERS", "FLEET_OUTSTANDING", "FLEET_DISPATCHES",
+    "FLEET_REQUEUED", "FLEET_MISVERSIONED", "FLEET_BACKPRESSURE_MS",
 ]
 
 # -- the shared instrument set (registered once, process-wide) -----------
@@ -168,6 +171,33 @@ ANALYSIS_COVERAGE = REGISTRY.gauge(
     "paddle_tpu_analysis_infer_coverage",
     "Fraction of a program's op instances covered by a registered "
     "shape/dtype inference rule, per program fingerprint")
+FLEET_WORKERS = REGISTRY.gauge(
+    "paddle_tpu_fleet_workers",
+    "Router view of worker replicas by state=starting|ready|draining|"
+    "stopped|dead (recorded in the ROUTER process)")
+FLEET_OUTSTANDING = REGISTRY.gauge(
+    "paddle_tpu_fleet_outstanding",
+    "Requests dispatched to a replica and not yet answered, by replica "
+    "(at max_outstanding on every replica = fleet saturated, router "
+    "backpressures)")
+FLEET_DISPATCHES = REGISTRY.counter(
+    "paddle_tpu_fleet_dispatches_total",
+    "Request frames the router forwarded, by replica (balance skew = "
+    "max/min across replicas)")
+FLEET_REQUEUED = REGISTRY.counter(
+    "paddle_tpu_fleet_requeued_total",
+    "In-flight frames re-dispatched after their worker died (predict is "
+    "stateless/idempotent, so replay is safe)")
+FLEET_MISVERSIONED = REGISTRY.counter(
+    "paddle_tpu_fleet_misversioned_total",
+    "Responses whose program version differed from the one their "
+    "request was dispatched under (must stay 0 through drain/restart "
+    "and hot swaps)")
+FLEET_BACKPRESSURE_MS = REGISTRY.counter(
+    "paddle_tpu_fleet_backpressure_ms_total",
+    "Router dispatch time blocked because every routable replica was at "
+    "max_outstanding (rivaling wall time = add replicas or raise the "
+    "window)")
 PROFILER_EVENT_MS = REGISTRY.summary(
     "paddle_tpu_profiler_event_ms",
     "Legacy profiler event table (exact count/sum/min/max per event)")

@@ -14,12 +14,13 @@ import json
 import math
 from typing import Dict, Optional
 
-from .metrics import Counter, Gauge, Histogram, MetricRegistry, Summary, REGISTRY
+from .metrics import (Counter, Gauge, Histogram, MetricRegistry, Summary,
+                      REGISTRY, process_labels)
 from .timeline import TIMELINE, StepTimeline
 
 __all__ = [
     "to_prometheus", "to_json", "dumps_json",
-    "counters_state", "delta_state",
+    "counters_state", "delta_state", "merge_json_snapshots",
 ]
 
 
@@ -52,15 +53,16 @@ def to_prometheus(registry: Optional[MetricRegistry] = None) -> str:
     gauges) an explicit 0 sample, so scrape dashboards see the full
     catalogue from the first scrape."""
     registry = registry or REGISTRY
+    proc = process_labels()  # replica identity, when set (fleet workers)
     out = []
     for m in registry.collect():
-        samples = m.samples()
+        samples = [(dict(proc, **labels), v) for labels, v in m.samples()]
         kind = "summary" if isinstance(m, Summary) else m.kind
         out.append("# HELP %s %s" % (m.name, _escape(m.help or m.name)))
         out.append("# TYPE %s %s" % (m.name, kind))
         if isinstance(m, (Counter, Gauge)):
             if not samples:
-                out.append("%s 0" % m.name)
+                out.append("%s%s 0" % (m.name, _labels_str(proc)))
             for labels, value in samples:
                 out.append("%s%s %s" % (m.name, _labels_str(labels),
                                         _fmt(value)))
@@ -94,10 +96,12 @@ def to_json(registry: Optional[MetricRegistry] = None,
     """JSON-able snapshot: {"metrics": {name: {kind, help, series}},
     "timeline": <timeline snapshot>}."""
     registry = registry or REGISTRY
+    proc = process_labels()
     metrics = {}
     for m in registry.collect():
         series = []
         for labels, v in m.samples():
+            labels = dict(proc, **labels)
             if isinstance(m, Histogram):
                 series.append({"labels": labels,
                                "buckets": dict(zip(
@@ -111,6 +115,8 @@ def to_json(registry: Optional[MetricRegistry] = None,
                 series.append({"labels": labels, "value": v})
         metrics[m.name] = {"kind": m.kind, "help": m.help, "series": series}
     out = {"metrics": metrics}
+    if proc:
+        out["replica"] = proc.get("replica")
     if include_timeline:
         out["timeline"] = (timeline or TIMELINE).snapshot()
     return out
@@ -122,6 +128,53 @@ def dumps_json(registry: Optional[MetricRegistry] = None,
                include_timeline: bool = True) -> str:
     return json.dumps(to_json(registry, timeline, include_timeline),
                       indent=indent, sort_keys=True)
+
+
+def merge_json_snapshots(snapshots) -> Dict:
+    """Aggregate several ``to_json()`` snapshots (one per fleet worker /
+    per dump file) into one: series whose label sets match are SUMMED
+    (counters, gauges, histogram buckets, summary count/sum; summary
+    min/max take the min/max), distinct label sets stay distinct — so
+    dumps whose series carry a ``replica`` label merge collision-free
+    while the per-metric totals a dashboard wants come from summing the
+    label dimension away downstream, exactly the Prometheus model.
+    Timelines are per-process and are NOT merged (dropped); the output
+    records the source replicas under ``"replicas"``."""
+    merged: Dict = {"metrics": {}, "replicas": []}
+    out_metrics = merged["metrics"]
+    for snap in snapshots:
+        rep = snap.get("replica")
+        if rep is not None:
+            merged["replicas"].append(rep)
+        for name, m in (snap.get("metrics") or {}).items():
+            om = out_metrics.setdefault(
+                name, {"kind": m.get("kind"), "help": m.get("help"),
+                       "series": []})
+            index = {tuple(sorted((s.get("labels") or {}).items())): s
+                     for s in om["series"]}
+            for s in m.get("series") or ():
+                key = tuple(sorted((s.get("labels") or {}).items()))
+                dst = index.get(key)
+                if dst is None:
+                    import copy
+
+                    dst = copy.deepcopy(s)
+                    om["series"].append(dst)
+                    index[key] = dst
+                    continue
+                if "buckets" in s:  # histogram
+                    for ub, n in (s.get("buckets") or {}).items():
+                        dst["buckets"][ub] = dst["buckets"].get(ub, 0) + n
+                    dst["sum"] += s.get("sum", 0)
+                    dst["count"] += s.get("count", 0)
+                elif "min" in s:  # summary
+                    dst["count"] += s.get("count", 0)
+                    dst["sum"] += s.get("sum", 0)
+                    dst["min"] = min(dst["min"], s.get("min", dst["min"]))
+                    dst["max"] = max(dst["max"], s.get("max", dst["max"]))
+                else:  # counter / gauge
+                    dst["value"] = dst.get("value", 0) + s.get("value", 0)
+    return merged
 
 
 def counters_state(registry: Optional[MetricRegistry] = None) -> Dict[str, float]:

@@ -24,14 +24,41 @@ combination is an independent series, exactly the Prometheus data model.
 """
 from __future__ import annotations
 
+import os
 import threading
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Summary", "MetricRegistry",
     "REGISTRY", "get_registry", "DEFAULT_LATENCY_BUCKETS_MS",
-    "DEFAULT_SIZE_BUCKETS",
+    "DEFAULT_SIZE_BUCKETS", "set_replica", "process_labels",
 ]
+
+# -- process identity ------------------------------------------------------
+#
+# N fleet workers all export the same metric names; a scrape/merge of
+# their payloads needs a per-process label or the series collide. When a
+# replica identity is set — via PADDLE_TPU_REPLICA at import, or
+# set_replica() at runtime — every exported series (export.py) carries
+# ``replica="<name|pid>"``. Unset (the default, every pre-fleet process)
+# the exposition is byte-identical to before.
+
+_PROCESS_LABELS: Dict[str, str] = {}
+if os.environ.get("PADDLE_TPU_REPLICA"):
+    _PROCESS_LABELS["replica"] = os.environ["PADDLE_TPU_REPLICA"]
+
+
+def set_replica(name: Optional[str] = None):
+    """Tag this process's metric exports with ``replica=name`` (the pid
+    when name is None) — call once at fleet-worker startup."""
+    _PROCESS_LABELS["replica"] = (str(name) if name is not None
+                                  else str(os.getpid()))
+
+
+def process_labels() -> Dict[str, str]:
+    """Constant labels stamped onto every exported series ({} unless a
+    replica identity was set)."""
+    return dict(_PROCESS_LABELS)
 
 # latency buckets in milliseconds: sub-ms serving hits through multi-minute
 # XLA compiles all land in a finite bucket before +Inf

@@ -23,7 +23,7 @@ from typing import Dict, Optional, Sequence
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["ShardingPlan", "PartitionSpec", "megatron_transformer_plan",
-           "zero_plan", "seq_parallel_plan"]
+           "zero_plan", "seq_parallel_plan", "infer_tp_plan"]
 
 PartitionSpec = P
 
@@ -185,6 +185,83 @@ def megatron_transformer_plan(
         (r"\.head\.b", P() if tied else col_b),
     ]:
         plan.set_regex(pat, spec)
+    return plan
+
+
+def infer_tp_plan(mesh: Mesh, program, mp_axis: str = "mp") -> ShardingPlan:
+    """Tensor-parallel plan for INFERENCE of a loaded program — the
+    training-side megatron plan rules reused at serving time
+    (ROADMAP item 1: "the megatron plan rules exist for training; reuse
+    them at inference").
+
+    Two regimes:
+
+    - The program's parameter names match our transformer convention
+      (``.qkv.w`` / ``.fc1.w`` / ``.out.w`` …): return
+      ``megatron_transformer_plan`` with batch axes DISABLED — serving
+      batches are small and dynamic, so feeds stay replicated and only
+      the params shard.
+    - Otherwise (exported MLPs and friends): derive the SAME
+      column/row alternation structurally. Walk the ops in program
+      order; every matmul against a persistable 2-D weight alternates
+      column-parallel ``P(None, mp)`` then row-parallel ``P(mp, None)``
+      (the Megatron pairing: the all-reduce lands after each
+      row-parallel matmul, everything between stays local), and each
+      weight's bias follows its matmul (column -> ``P(mp)``, row ->
+      replicated). Weights whose shard dim does not divide the mesh
+      axis fall back to replicated via ``ShardingPlan.spec``'s shape
+      fixing, so an odd layer degrades that layer, not the program.
+    """
+    matched = False
+    probe = megatron_transformer_plan(mesh, mp_axis=mp_axis, batch_axes=())
+    try:
+        for var in program.global_block().vars.values():
+            if getattr(var, "persistable", False) and any(
+                    rx.search(var.name) for rx, _ in probe._regex):
+                matched = True
+                break
+    except Exception:
+        matched = False
+    if matched:
+        return probe
+
+    plan = ShardingPlan(mesh, batch_axes=())
+    col = True  # start column-parallel; its successor goes row-parallel
+    pending_bias = None  # spec for the next persistable 1-D add operand
+    gb = program.global_block()
+
+    def _pvar(name):
+        v = gb._find_var_recursive(name)
+        return v if v is not None and getattr(v, "persistable", False) else None
+
+    for block in program.blocks:
+        for op in block.ops:
+            if op.type in ("mul", "matmul", "matmul_v2"):
+                for name in op.input_arg_names:
+                    var = _pvar(name)
+                    if var is None or len(getattr(var, "shape", ()) or ()) != 2:
+                        continue
+                    plan.set(name, P(None, mp_axis) if col
+                             else P(mp_axis, None))
+                    pending_bias = "col" if col else "row"
+                    col = not col
+            elif op.type == "elementwise_add" and pending_bias is not None:
+                for name in op.input_arg_names:
+                    var = _pvar(name)
+                    shape = tuple(getattr(var, "shape", ()) or ()
+                                  ) if var is not None else ()
+                    if shape and len(shape) <= 2:
+                        # bias follows its matmul: the sharded dim is the
+                        # LAST one (fc biases are 1-D [out]; a 2-D bias
+                        # replicates its leading dim)
+                        if pending_bias == "col":
+                            spec = P(*([None] * (len(shape) - 1)
+                                       + [mp_axis]))
+                        else:
+                            spec = P()
+                        plan.set(name, spec)
+                        pending_bias = None
+                        break
     return plan
 
 

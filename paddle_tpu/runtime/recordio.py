@@ -30,6 +30,7 @@ __all__ = [
     "recordio_sample_reader",
     "frame_encodable",
     "frame_nbytes",
+    "frame_tag",
     "encode_frame",
     "encode_frame_into",
     "encode_frame_pickle",
@@ -358,11 +359,24 @@ class Channel:
         """Block for the first record, then drain whatever else is queued
         (up to max_n) — the C++ dynamic-batching pull
         (ptrt_chan_recv_batch) behind the predictor serving loop. With
-        ``max_wait_s`` set, keep collecting for up to that many seconds
-        after the first record arrives (the serving batching deadline):
-        the call returns as soon as the batch is FULL, so the deadline
-        only costs latency when traffic cannot fill max_n anyway.
+        ``max_wait_s`` set (> 0), keep collecting for up to that many
+        seconds after the first record arrives (the serving batching
+        deadline): the call returns as soon as the batch is FULL, so the
+        deadline only costs latency when traffic cannot fill max_n
+        anyway.
+
+        ``max_wait_s=0`` means "drain what's ready, don't wait": return
+        whatever is queued RIGHT NOW without blocking — ``[]`` when the
+        channel is open but empty (the fleet router's opportunistic
+        drain), None when it is closed and drained. Only ``None``
+        (the default) blocks for the first record. (PredictorServer's
+        stacking stage passes None explicitly for ``max_wait_ms=0`` — it
+        WANTS block-for-first — so the old coercion of 0 to None there
+        is now a documented contract, not an accident.)
+
         Returns None once closed and drained."""
+        if max_wait_s is not None and max_wait_s <= 0:
+            return self._recv_batch_nowait(max_n)
         if self._lib is None:
             out = self._recv_batch_py(max_n)
             if out is None:
@@ -388,6 +402,27 @@ class Channel:
                 break  # closed (already holding records) or deadline hit
             out.extend(more)
         return out
+
+    def _recv_batch_nowait(self, max_n: int):
+        """The max_wait_s=0 branch: non-blocking drain of up to max_n
+        queued records. [] = open but empty; None = closed and drained."""
+        if self._lib is None:
+            with self._cv:
+                if not self._dq:
+                    return None if self._closed else []
+                out = []
+                while self._dq and len(out) < max_n:
+                    out.append(self._dq.popleft())
+                self._cv.notify_all()
+                return out
+        if self._lib.ptrt_chan_size(self._h) > 0:
+            bufs = (ctypes.POINTER(ctypes.c_char) * max_n)()
+            lens = (ctypes.c_int64 * max_n)()
+            n = self._lib.ptrt_chan_recv_batch(self._h, max_n, bufs, lens)
+            if n <= 0:
+                return None  # lost the race to close()
+            return [_take(self._lib, bufs[i], lens[i]) for i in range(n)]
+        return None if self._py_closed else []
 
     def _recv_batch_py(self, max_n: int, deadline: Optional[float] = None):
         """Fallback batch pull: block for the first record (bounded by
@@ -628,6 +663,16 @@ def encode_frame_into(buf, tag: int, rows) -> int:
 def encode_frame_pickle(tag: int, rows) -> bytes:
     """The fallback form decode_frame also understands."""
     return b"P" + pickle.dumps((tag, list(rows)), protocol=4)
+
+
+def frame_tag(msg) -> int:
+    """The frame's u64 tag WITHOUT decoding the payload: a header peek
+    on the zero-copy form (the router/worker request-id path), a full
+    unpickle only on the rare ``b"P"`` fallback form."""
+    if bytes(msg[:1]) == b"P":
+        return pickle.loads(memoryview(msg)[1:])[0]
+    _magic, tag, _nslots = _FRAME_HDR.unpack_from(memoryview(msg), 0)
+    return tag
 
 
 def decode_frame(msg):

@@ -1,0 +1,52 @@
+"""paddle_tpu.serving — the horizontal serving layer.
+
+One front door over N model replicas (ROADMAP item 1): the pieces the
+single-process ``inference.PredictorServer`` cannot provide by itself.
+
+- ``engine.Engine`` — the compile/execute core factored OUT of
+  ``Executor`` and ``inference.Predictor``: program identity/version,
+  the persistent AOT-cache handle, the precomputed feed-conversion
+  plan, and the one load-or-compile acquisition path. Both executors
+  construct their core through it, and a serving replica is exactly
+  "engine + channel loop".
+- ``sharded.ShardedPredictor`` — one model LARGER than a single device
+  served under ``pjit`` over a tensor-parallel mesh, reusing the
+  training-side megatron plan rules at inference
+  (``parallel.sharding.infer_tp_plan``). Same ``run``/``warm`` surface
+  as ``Predictor``, so it drops into ``PredictorServer`` and the fleet
+  unchanged.
+- ``router.Router`` — the front door: requests enter the same C++
+  bounded channel as zero-copy binary frames, a dispatch loop
+  load-balances them across worker PROCESSES (least outstanding work,
+  sticky per-program-version routing, backpressure when every worker's
+  in-flight window is full), per-worker reader threads fan responses
+  back out, and the fleet exposes per-replica health plus aggregated
+  metrics. Graceful ``drain_restart`` of one worker loses zero
+  requests; a crashed worker's in-flight frames are re-dispatched.
+
+Import policy: ``Engine`` is imported eagerly (executor.py depends on
+it); ``Router``/``ShardedPredictor`` resolve lazily so importing the
+engine from the executor does not drag the inference stack (and its
+import cycle) along.
+"""
+from __future__ import annotations
+
+from .engine import Engine  # noqa: F401
+
+__all__ = ["Engine", "Router", "ShardedPredictor", "worker_main"]
+
+
+def __getattr__(name):  # PEP 562: lazy, cycle-free router/sharded exports
+    if name == "Router":
+        from .router import Router
+
+        return Router
+    if name == "ShardedPredictor":
+        from .sharded import ShardedPredictor
+
+        return ShardedPredictor
+    if name == "worker_main":
+        from .worker import worker_main
+
+        return worker_main
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))

@@ -1,0 +1,180 @@
+"""Engine: the compile/execute core shared by Executor and Predictor.
+
+Before this module, the training ``Executor`` and the serving
+``inference.Predictor`` each carried a private copy of the same three
+things: a per-program-version feed-conversion plan (declared-variable
+lookup + dtype coercion), the AOT disk-cache KEY derivation (what makes
+a cached executable reachable), and the load-or-compile acquisition
+path (disk hit -> deserialize, miss -> lower + XLA compile + store,
+with the hit/miss/latency accounting). Divergence between the copies is
+exactly how stale-cache bugs are born — a key field added on one side
+but not the other silently serves the wrong executable or recompiles
+forever.
+
+``Engine`` owns those three things for ONE program:
+
+- identity: the program, its content fingerprint (cached per version),
+  and the environment fingerprint that completes every cache key;
+- the AOT-cache handle (``runtime.aot_cache.AotDiskCache``);
+- the feed plan: ``feed_var(name)`` (memoized per program version) and
+  ``feed_plan(names)`` — the ``(name, declared var, numpy dtype)``
+  triples the serving hot path converts feeds with;
+- ``key(kind, feed_sig, fetch_names, *extra)`` — the ONE key-derivation
+  function (field order is shared by training and serving, so the
+  on-disk key space is identical to what PR 5 wrote);
+- ``acquire(kind, key, lower, meta=...)`` — the ONE
+  disk-load-or-compile path with the cold/warm metrics contract.
+
+``Executor`` holds one Engine per program (weak-keyed);
+``inference.Predictor`` and ``serving.sharded.ShardedPredictor`` hold
+one for their loaded model — and a fleet replica is just an Engine (via
+its Predictor) plus a channel loop (``serving.worker``).
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import observability as obs
+from ..runtime import aot_cache as _aot
+
+__all__ = ["Engine"]
+
+
+class Engine:
+    """Compile/execute core for one Program. Cheap to construct: no I/O
+    and no trace until used; the feed plan materializes lazily per
+    program version."""
+
+    def __init__(self, program, disk: Optional[_aot.AotDiskCache] = None,
+                 feed_names: Optional[Sequence[str]] = None,
+                 fetch_names: Optional[Sequence[str]] = None):
+        self.program = program
+        self.disk = disk if disk is not None else _aot.AotDiskCache()
+        self.feed_names = list(feed_names) if feed_names is not None else None
+        self.fetch_names = (list(fetch_names) if fetch_names is not None
+                            else None)
+        # per-version memo: (version, {name: Variable}) — negative
+        # lookups are NOT cached (create_var alone does not bump
+        # program._version, same contract as the old
+        # Executor._feed_var_for)
+        self._feed_vars: Tuple = (None, {})
+
+    # -- identity ---------------------------------------------------------
+    @property
+    def version(self):
+        """The program's process-local mutation counter."""
+        return getattr(self.program, "_version", None)
+
+    def fingerprint(self) -> str:
+        """Short (8-hex) program fingerprint, cached per version."""
+        return obs.program_fp(self.program)
+
+    # -- feed plan --------------------------------------------------------
+    def feed_var(self, name: str):
+        """Declared Variable behind a feed name, memoized per program
+        version (the recursive block walk runs once per version, not
+        once per call — the serving/training hot-path lookup)."""
+        ver, cache = self._feed_vars
+        if ver != self.version:
+            cache = {}
+            self._feed_vars = (self.version, cache)
+        var = cache.get(name)
+        if var is None:
+            var = self.program.global_block()._find_var_recursive(name)
+            if var is not None:
+                cache[name] = var
+        return var
+
+    def feed_plan(self, feed_names: Optional[Sequence[str]] = None
+                  ) -> List[Tuple[str, object, Optional[np.dtype]]]:
+        """``[(name, declared var, numpy dtype or None)]`` for a frozen
+        feed set — the conversion plan the Predictor walks per request
+        instead of re-resolving declarations per call."""
+        from ..framework.dtypes import as_numpy_dtype
+
+        names = self.feed_names if feed_names is None else feed_names
+        plan = []
+        for name in names or ():
+            var = self.feed_var(name)
+            want = (np.dtype(as_numpy_dtype(var.dtype))
+                    if var is not None else None)
+            plan.append((name, var, want))
+        return plan
+
+    def convert_feeds(self, feed: Dict, plan=None) -> Dict[str, np.ndarray]:
+        """Feed dict -> contiguous, declared-dtype arrays (the serving
+        request path; KeyError names the missing feed)."""
+        if plan is None:
+            plan = self.feed_plan()
+        out = {}
+        for name, _var, want in plan:
+            if name not in feed:
+                raise KeyError("missing feed %r (model expects %s)"
+                               % (name, [n for n, _, _ in plan]))
+            arr = feed[name]
+            if type(arr) is not np.ndarray:
+                arr = np.asarray(arr)
+            if want is not None and arr.dtype != want:
+                arr = arr.astype(want)
+            out[name] = arr
+        return out
+
+    # -- cache keys -------------------------------------------------------
+    def key_fields(self, kind: str, feed_sig, fetch_names, *extra) -> Tuple:
+        """The shared key-field layout: (kind, program content
+        fingerprint, feed signature, fetch ORDER, <caller extras>,
+        environment fingerprint). Training appends its state signature /
+        per-step set as extras; serving appends nothing — both end with
+        the env fingerprint so a toolchain change is a miss, never a
+        stale load. program._version is deliberately absent: the content
+        fingerprint already covers it, and a content-identical program
+        rebuilt another way must still warm-start."""
+        return ((kind, self.program.fingerprint(), feed_sig,
+                 tuple(fetch_names)) + tuple(extra)
+                + (_aot.env_fingerprint(),))
+
+    def key(self, kind: str, feed_sig, fetch_names, *extra) -> str:
+        return self.disk.key(self.key_fields(kind, feed_sig, fetch_names,
+                                             *extra))
+
+    def meta(self, kind: str, feed_sig, fetch_names) -> Dict:
+        """Sidecar metadata for preload scans and aot_cache_ls."""
+        return {"kind": kind, "program": self.fingerprint(),
+                "feed_sig": feed_sig, "fetch_names": tuple(fetch_names),
+                "env": _aot.env_fingerprint(), "created": time.time()}
+
+    # -- acquisition ------------------------------------------------------
+    def acquire(self, kind: str, key: str, lower, meta: Optional[Dict] = None):
+        """THE load-or-compile path: disk hit deserializes (path=warm),
+        miss runs ``lower()`` -> ``.compile()`` and stores the result
+        (path=cold). Returns ``(compiled, path, timings)`` where path is
+        ``"warm" | "cold"`` and timings is ``{"trace_ms", "xla_ms"}`` on
+        the cold path (None on warm — a deserialize has no split).
+
+        ``lower`` may raise (program errors propagate exactly as the
+        lazy-jit first call would); disk I/O failures are absorbed by
+        AotDiskCache per its never-a-crash contract."""
+        fp = self.fingerprint()
+        use_disk = self.disk.enabled
+        t0 = time.perf_counter()
+        loaded = self.disk.load(key) if use_disk else None
+        if loaded is not None:
+            obs.CACHE_HITS.inc(kind=kind, tier="disk", program=fp)
+            obs.AOT_COMPILE_MS.observe((time.perf_counter() - t0) * 1e3,
+                                       path="warm", kind=kind)
+            obs.TIMELINE.record_compile(kind, fp, cache="aot-load")
+            return loaded, "warm", None
+        if use_disk:  # a disabled tier compiles without tier accounting
+            obs.CACHE_MISSES.inc(kind=kind, tier="disk", program=fp)
+        t0 = time.perf_counter()
+        lowered = lower()
+        t1 = time.perf_counter()
+        compiled = lowered.compile()
+        t2 = time.perf_counter()
+        obs.AOT_COMPILE_MS.observe((t2 - t0) * 1e3, path="cold", kind=kind)
+        self.disk.store(key, compiled, meta=meta)
+        return compiled, "cold", {"trace_ms": (t1 - t0) * 1e3,
+                                  "xla_ms": (t2 - t1) * 1e3}

@@ -1,0 +1,655 @@
+"""Router: one front door over N PredictorServer replicas.
+
+Requests enter exactly as they do for the single-process
+``PredictorServer`` — zero-copy binary frames on the C++ bounded
+channel (``ptrt_chan_recv_batch`` behind ``Channel.recv_batch``) — and
+a dispatch loop forwards each frame VERBATIM to a worker process over
+its pipe. Policy:
+
+- **least outstanding work**: each frame goes to the routable replica
+  with the fewest unanswered requests (outstanding map, not a counter —
+  the map also holds the frame bytes so a dead worker's in-flight
+  requests can be re-dispatched).
+- **sticky per-program-version routing**: a replica is routable only
+  while its reported program version matches the fleet's ACTIVE
+  version. During a model load/hot swap a restarted worker that comes
+  up on a different version receives no traffic until
+  ``set_version()`` flips the fleet — so a client can never get version
+  N and N+1 rows interleaved from one logical model
+  (``paddle_tpu_fleet_misversioned_total`` counts violations; it must
+  stay 0).
+- **backpressure**: when every routable replica is at
+  ``max_outstanding`` the dispatch loop parks (counted in
+  ``paddle_tpu_fleet_backpressure_ms_total``); the front channel then
+  fills and ``submit()`` blocks — bounded memory end to end, no
+  unbounded queue anywhere.
+
+Lifecycle: ``drain_restart(i)`` marks one replica unroutable, waits for
+its outstanding responses, stops it gracefully (the worker's
+``server.stop()`` flushes its stacking queue — zero drops), respawns,
+and waits ready. A worker that DIES instead of draining has its
+in-flight frames re-dispatched to the survivors (predict is stateless,
+replay is safe; ``paddle_tpu_fleet_requeued_total``).
+
+Observability: the router process records request latency under
+``path="router"`` plus the fleet gauges/counters; ``health()`` is the
+per-replica view, ``fleet_metrics()`` pulls every worker's registry
+snapshot over the control pipe and merges them
+(``observability.export.merge_json_snapshots``); ``start_http()``
+serves ``/metrics`` (router process), ``/fleet.json`` (health +
+aggregated fleet registry) and ``/health.json``.
+"""
+from __future__ import annotations
+
+import pickle
+import queue
+import struct
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .. import observability as obs
+from ..inference import _Future, _encode_sample
+from ..runtime import recordio as _rio
+
+__all__ = ["Router"]
+
+
+class _Worker:
+    """Router-side handle for one replica process."""
+
+    __slots__ = (
+        "idx", "name", "proc", "conn", "state", "version", "pid",
+        "metrics_port", "outstanding", "dispatched", "reader",
+        "ready_ev", "stopped_ev", "status_q", "send_lock", "error",
+    )
+
+    def __init__(self, idx: int, name: str):
+        self.idx = idx
+        self.name = name
+        self.proc = None
+        self.conn = None
+        self.state = "starting"
+        self.version = None
+        self.pid = None
+        self.metrics_port = 0
+        # rid -> (frame bytes, version dispatched under): the frame is
+        # kept so a dead worker's in-flight work is re-dispatchable
+        self.outstanding: Dict[int, tuple] = {}
+        self.dispatched = 0
+        self.reader = None
+        self.ready_ev = threading.Event()
+        self.stopped_ev = threading.Event()
+        self.status_q: "queue.Queue" = queue.Queue()
+        self.send_lock = threading.Lock()
+        self.error = None
+
+
+class Router:
+    """
+    router = Router(model_dir, replicas=4, max_batch=32)
+    router.start()
+    fut = router.submit((row,))      # same surface as PredictorServer
+    outs = fut.result()
+    router.drain_restart(0)          # zero dropped requests
+    router.stop()
+    """
+
+    def __init__(self, model_dir: str, replicas: int = 2,
+                 max_batch: int = 8, max_wait_ms: float = 0.0,
+                 in_flight: int = 2, shard: int = 1,
+                 capacity: int = 1024,
+                 max_outstanding: Optional[int] = None,
+                 start_method: Optional[str] = None,
+                 jax_platform: Optional[str] = None,
+                 worker_env: Optional[Dict[str, str]] = None,
+                 worker_http: bool = False,
+                 start_timeout: float = 300.0,
+                 dispatch_batch: int = 64):
+        from ..runtime.recordio import Channel
+
+        if replicas < 1:
+            raise ValueError("replicas must be >= 1, got %d" % replicas)
+        self.model_dir = model_dir
+        self.replicas = int(replicas)
+        self.shard = int(shard)
+        self.start_timeout = float(start_timeout)
+        self.dispatch_batch = int(dispatch_batch)
+        # per-replica in-flight window: enough to keep the worker's
+        # stacking + device stages full (one bucket building while
+        # in_flight batches queue) without hoarding requests a draining
+        # neighbour could have served
+        self.max_outstanding = (int(max_outstanding) if max_outstanding
+                                else max(2 * max_batch * in_flight, 8))
+        self._opts = {
+            "model_dir": model_dir, "max_batch": int(max_batch),
+            "max_wait_ms": float(max_wait_ms), "in_flight": int(in_flight),
+            "shard": int(shard), "http": bool(worker_http),
+            "jax_platform": jax_platform, "env": dict(worker_env or {}),
+            # one capacity knob bounds BOTH the router's front channel
+            # and each worker server's channel
+            "capacity": int(capacity),
+        }
+        import multiprocessing as mp
+
+        if start_method is None:
+            # fork from a jax-threaded parent deadlocks children (PR-3
+            # DataLoader lesson); forkserver keeps respawn cheap
+            start_method = ("forkserver"
+                            if "forkserver" in mp.get_all_start_methods()
+                            else "spawn")
+        self._ctx = mp.get_context(start_method)
+        self._chan = Channel(capacity)
+        self._workers: List[_Worker] = []
+        self._futures: Dict[int, _Future] = {}
+        self._next_id = 0
+        self._lock = threading.Lock()          # futures + rid allocation
+        self._cond = threading.Condition()     # worker states/capacity
+        self.active_version: Optional[str] = None
+        self._dispatch_thread: Optional[threading.Thread] = None
+        self._stopping = False
+        self._http = None
+        self._http_thread = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        if self._dispatch_thread is not None:
+            return
+        for i in range(self.replicas):
+            self._workers.append(self._spawn(i))
+        self._wait_ready(self._workers)
+        with self._cond:
+            if self.active_version is None:
+                self.active_version = self._workers[0].version
+        self._refresh_worker_gauge()
+        self._dispatch_thread = threading.Thread(
+            target=self._dispatch_loop, daemon=True,
+            name="ptpu-router-dispatch")
+        self._dispatch_thread.start()
+
+    def _spawn(self, idx: int, name: Optional[str] = None) -> _Worker:
+        from .worker import worker_main
+
+        w = _Worker(idx, name or "replica%d" % idx)
+        parent, child = self._ctx.Pipe(duplex=True)
+        opts = dict(self._opts, name=w.name)
+        w.proc = self._ctx.Process(
+            target=worker_main, args=(child, opts), daemon=True,
+            name="ptpu-" + w.name)
+        w.proc.start()
+        child.close()
+        w.conn = parent
+        w.reader = threading.Thread(
+            target=self._reader_loop, args=(w,), daemon=True,
+            name="ptpu-router-read-" + w.name)
+        w.reader.start()
+        return w
+
+    def _wait_ready(self, workers, timeout: Optional[float] = None,
+                    abort_scope=None):
+        """Wait for every worker in `workers` to report ready. On
+        failure, terminate ONLY the workers in `abort_scope` (default:
+        the ones being waited on) — a failed drain_restart respawn must
+        never take down the healthy replicas still serving traffic."""
+        scope = workers if abort_scope is None else abort_scope
+        deadline = time.monotonic() + (timeout or self.start_timeout)
+        for w in workers:
+            # poll so a worker that DIES during bootstrap (bad model
+            # dir, spawn outside a __main__ guard, import crash) fails
+            # the start immediately instead of eating the full timeout
+            while not w.ready_ev.wait(0.25):
+                if time.monotonic() >= deadline:
+                    self._abort_workers(scope)
+                    raise RuntimeError(
+                        "fleet worker %s did not become ready within %.0fs"
+                        % (w.name, self.start_timeout))
+                if w.proc is not None and not w.proc.is_alive():
+                    self._abort_workers(scope)
+                    raise RuntimeError(
+                        "fleet worker %s died during startup (exitcode "
+                        "%s)%s" % (w.name, w.proc.exitcode,
+                                   ": " + w.error if w.error else ""))
+            if w.error is not None:
+                err = w.error
+                self._abort_workers(scope)
+                raise RuntimeError(
+                    "fleet worker %s failed to start: %s" % (w.name, err))
+
+    def _abort_workers(self, workers):
+        for w in workers:
+            try:
+                if w.proc is not None and w.proc.is_alive():
+                    w.proc.terminate()
+                    w.proc.join(timeout=5)
+            except Exception:
+                pass
+        self._refresh_worker_gauge()
+
+    # -- submission --------------------------------------------------------
+    def submit(self, sample) -> _Future:
+        """sample: one array per feed slot (a single row, no batch dim)
+        — identical contract to ``PredictorServer.submit``, same wire
+        frame (``inference._encode_sample``)."""
+        fut = _Future()
+        fut._t0 = time.perf_counter()
+        with self._lock:
+            rid = self._next_id
+            self._next_id += 1
+            self._futures[rid] = fut
+        fut._bind(self, rid)
+        try:
+            sent = self._chan.send(_encode_sample(rid, sample))
+        except BaseException:
+            with self._lock:
+                self._futures.pop(rid, None)
+            raise
+        if not sent:
+            with self._lock:
+                self._futures.pop(rid, None)
+            raise RuntimeError("serving fleet is stopped")
+        return fut
+
+    def _pop(self, rid):  # _Future.cancel protocol (same as the server)
+        with self._lock:
+            return self._futures.pop(rid, None)
+
+    # -- dispatch ----------------------------------------------------------
+    def _dispatch_loop(self):
+        from . import wire
+
+        while True:
+            batch = self._chan.recv_batch(self.dispatch_batch, None)
+            if batch is None:
+                return  # closed and drained
+            # assign every drained frame, then ship each worker ITS
+            # frames as ONE coalesced pipe message — at load the pipe
+            # hop is per-burst, not per-request. Assignment greedily
+            # avoids blocking: when capacity runs out mid-burst, what is
+            # already grouped is flushed first (no head-of-line wait),
+            # then the rest dispatches one by one through the blocking
+            # path.
+            groups: Dict[int, list] = {}
+            rest = None
+            for i, msg in enumerate(batch):
+                w = self._assign(msg, block=False)
+                if w is False:
+                    continue  # failed (fleet dead/stopping), future set
+                if w is None:
+                    rest = batch[i:]
+                    break
+                groups.setdefault(w.idx, (w, []))[1].append(msg)
+            self._flush_groups(wire, groups)
+            for msg in rest or ():
+                w = self._assign(msg, block=True)
+                if w in (None, False):
+                    continue
+                self._send_to(w, msg)
+
+    def _flush_groups(self, wire, groups):
+        for w, msgs in groups.values():
+            self._send_to(w, wire.pack(msgs))
+
+    def _send_to(self, w: _Worker, payload):
+        try:
+            with w.send_lock:
+                w.conn.send_bytes(payload)
+        except (OSError, ValueError):
+            # worker died between assignment and send: the reader thread
+            # notices the dead pipe and requeues its outstanding frames
+            pass
+
+    def _eligible(self):
+        """Routable replicas: ready, on the active version, with
+        in-flight headroom."""
+        return [w for w in self._workers
+                if w.state == "ready" and w.version == self.active_version
+                and len(w.outstanding) < self.max_outstanding]
+
+    def _alive(self):
+        return [w for w in self._workers
+                if w.state in ("starting", "ready", "draining")]
+
+    def _assign(self, msg, block: bool):
+        """Record `msg` against the least-outstanding routable replica.
+        Returns the worker, None when nothing is routable and
+        ``block=False`` (caller flushes and retries blocking), or False
+        when the request had to be FAILED (fleet stopping / all dead)."""
+        rid = _rio.frame_tag(msg)
+        t0 = time.perf_counter()
+        waited = False
+        with self._cond:
+            while True:
+                elig = self._eligible()
+                if elig:
+                    break
+                # park while saturated or mid-restart; give up only when
+                # the fleet is stopping or EVERY replica crashed (a
+                # gracefully "stopped" replica means a restart is in
+                # flight — hold the request, don't fail it)
+                if self._stopping or (
+                        not self._alive()
+                        and all(w.state == "dead" for w in self._workers)):
+                    fut = self._pop(rid)
+                    if fut is not None:
+                        fut.set_exception(RuntimeError(
+                            "no serving replica available for request %d"
+                            % rid))
+                        obs.PREDICT_FAILURES.inc(path="router")
+                    return False
+                if not block:
+                    return None
+                waited = True
+                self._cond.wait(0.5)
+            # least outstanding work
+            w = min(elig, key=lambda w: len(w.outstanding))
+            w.outstanding[rid] = (msg, self.active_version)
+            w.dispatched += 1
+            obs.FLEET_OUTSTANDING.set(len(w.outstanding), replica=w.name)
+        if waited:
+            obs.FLEET_BACKPRESSURE_MS.inc(
+                (time.perf_counter() - t0) * 1e3)
+        obs.FLEET_DISPATCHES.inc(replica=w.name)
+        return w
+
+    # -- responses ---------------------------------------------------------
+    def _reader_loop(self, w: _Worker):
+        from . import wire
+
+        while True:
+            try:
+                payload = w.conn.recv_bytes()
+            except (EOFError, OSError):
+                break
+            for msg in wire.iter_messages(payload):
+                try:
+                    kind = bytes(msg[:1])
+                    if kind == b"S":
+                        self._on_status(w, pickle.loads(msg[1:]))
+                    elif kind == b"R":
+                        vlen = struct.unpack_from("<B", msg, 1)[0]
+                        version = bytes(msg[2:2 + vlen]).decode("ascii")
+                        frame = msg[2 + vlen:]
+                        self._complete(w, _rio.frame_tag(frame),
+                                       frame=frame, version=version)
+                    elif kind == b"E":
+                        rid, exc = pickle.loads(msg[1:])
+                        self._complete(w, rid, exc=exc)
+                except Exception:
+                    # one undecodable message (e.g. an exception class
+                    # that fails to reconstruct on unpickle) must not
+                    # kill the reader thread — that would strand every
+                    # other outstanding response AND skip the
+                    # _on_worker_exit requeue below. Count it and keep
+                    # reading; the affected rid's future is eventually
+                    # abandoned by its caller's timeout.
+                    obs.PREDICT_FAILURES.inc(path="router_decode")
+        self._on_worker_exit(w)
+
+    def _on_status(self, w: _Worker, st: Dict):
+        if st.get("ready"):
+            with self._cond:
+                w.version = st.get("version")
+                w.pid = st.get("pid")
+                w.metrics_port = st.get("metrics_port", 0)
+                w.state = "ready"
+                self._cond.notify_all()
+            self._refresh_worker_gauge()
+            w.ready_ev.set()
+        elif "error" in st and not w.ready_ev.is_set():
+            w.error = st.get("error")
+            if st.get("traceback"):
+                w.error += "\n" + st["traceback"]
+            with self._cond:
+                w.state = "dead"
+                self._cond.notify_all()
+            w.ready_ev.set()
+        elif st.get("stopped"):
+            w.stopped_ev.set()
+        else:  # pong / metrics replies
+            w.status_q.put(st)
+
+    def _complete(self, w: _Worker, rid, frame=None, version=None,
+                  exc=None):
+        with self._cond:
+            entry = w.outstanding.pop(rid, None)
+            obs.FLEET_OUTSTANDING.set(len(w.outstanding), replica=w.name)
+            self._cond.notify_all()  # capacity freed / drain progressed
+        fut = self._pop(rid)
+        if fut is None:
+            return  # abandoned via cancel/timeout
+        if exc is not None:
+            obs.PREDICT_FAILURES.inc(path="router")
+            fut.set_exception(exc)
+            obs.PREDICT_LATENCY_MS.observe(
+                (time.perf_counter() - fut._t0) * 1e3, path="router")
+            return
+        if (entry is not None and version is not None
+                and entry[1] is not None and version != entry[1]):
+            # a replica answered with a different program version than
+            # the one this request was routed under — sticky routing
+            # makes this structurally impossible; count loudly if a bug
+            # ever breaks that
+            obs.FLEET_MISVERSIONED.inc()
+        _tag, rows = _rio.decode_frame(frame)
+        fut.set_result(rows)
+        obs.PREDICT_LATENCY_MS.observe(
+            (time.perf_counter() - fut._t0) * 1e3, path="router")
+        obs.PREDICT_REQUESTS.inc(path="router")
+
+    def _on_worker_exit(self, w: _Worker):
+        """Reader saw EOF: graceful stop keeps state, a crash requeues
+        the worker's in-flight frames onto the survivors."""
+        with self._cond:
+            crashed = not w.stopped_ev.is_set() and w.state != "stopped"
+            entries = list(w.outstanding.items())
+            w.outstanding.clear()
+            obs.FLEET_OUTSTANDING.set(0, replica=w.name)
+            w.state = "dead" if crashed else "stopped"
+            self._cond.notify_all()
+        self._refresh_worker_gauge()
+        if not entries:
+            return
+        for rid, (msg, _ver) in entries:
+            obs.FLEET_REQUEUED.inc()
+            # back through the front channel: the dispatch loop re-routes
+            # to a live replica (predict is stateless — replay is safe)
+            if not self._chan.send(msg):
+                fut = self._pop(rid)
+                if fut is not None:
+                    fut.set_exception(RuntimeError(
+                        "replica %s died and the fleet is stopping"
+                        % w.name))
+                    obs.PREDICT_FAILURES.inc(path="router")
+
+    # -- fleet operations --------------------------------------------------
+    def set_version(self, version: str):
+        """Flip the fleet's active program version (hot-swap cutover):
+        replicas reporting `version` become routable, everyone else
+        drains naturally as their outstanding work completes."""
+        with self._cond:
+            self.active_version = version
+            self._cond.notify_all()
+
+    def drain_restart(self, idx: int, timeout: float = 300.0):
+        """Gracefully recycle one replica with ZERO dropped requests:
+        unroute it, wait out its in-flight responses, stop it (the
+        worker flushes its own stacking queue before exiting), respawn,
+        wait ready. The rest of the fleet keeps serving throughout."""
+        w = self._workers[idx]
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            if w.state == "ready":
+                w.state = "draining"
+            self._cond.notify_all()
+        self._refresh_worker_gauge()
+        with self._cond:
+            while w.outstanding and time.monotonic() < deadline:
+                self._cond.wait(0.5)
+            pending = len(w.outstanding)
+        if pending:
+            raise RuntimeError(
+                "replica %s still has %d outstanding requests after %.0fs"
+                % (w.name, pending, timeout))
+        self._stop_worker(w, deadline)
+        nw = self._spawn(idx, name=w.name)
+        self._workers[idx] = nw
+        self._wait_ready([nw], timeout=max(1.0, deadline - time.monotonic()))
+        self._refresh_worker_gauge()
+        with self._cond:
+            self._cond.notify_all()
+
+    def _stop_worker(self, w: _Worker, deadline=None):
+        try:
+            with w.send_lock:
+                w.conn.send_bytes(b"C" + pickle.dumps({"cmd": "stop"},
+                                                      protocol=4))
+        except (OSError, ValueError):
+            pass
+        remaining = (max(1.0, deadline - time.monotonic())
+                     if deadline else 30.0)
+        w.stopped_ev.wait(remaining)
+        if w.proc is not None:
+            w.proc.join(timeout=remaining)
+            if w.proc.is_alive():
+                w.proc.terminate()
+                w.proc.join(timeout=5)
+        with self._cond:
+            if w.state != "dead":
+                w.state = "stopped"
+            self._cond.notify_all()
+        if w.reader is not None:
+            w.reader.join(timeout=5)
+
+    def stop(self):
+        """Drain the front channel through the fleet, then stop every
+        replica gracefully (flushing their queues) and reap processes."""
+        self.stop_http()
+        with self._cond:
+            already = self._stopping and self._dispatch_thread is None
+        if already:
+            return
+        self._chan.close()
+        if self._dispatch_thread is not None:
+            # the dispatch loop finishes routing everything already
+            # accepted, then sees the closed+drained channel and exits
+            self._dispatch_thread.join(timeout=60)
+            self._dispatch_thread = None
+        # wait for in-flight responses BEFORE stopping workers: nothing
+        # accepted is dropped
+        with self._cond:
+            deadline = time.monotonic() + 60
+            while (any(w.outstanding for w in self._workers)
+                   and time.monotonic() < deadline):
+                self._cond.wait(0.5)
+            self._stopping = True
+            self._cond.notify_all()
+        for w in self._workers:
+            if w.state in ("ready", "draining", "starting"):
+                self._stop_worker(w)
+        self._refresh_worker_gauge()
+
+    # -- introspection -----------------------------------------------------
+    def _refresh_worker_gauge(self):
+        counts: Dict[str, int] = {}
+        for w in self._workers:
+            counts[w.state] = counts.get(w.state, 0) + 1
+        for state in ("starting", "ready", "draining", "stopped", "dead"):
+            obs.FLEET_WORKERS.set(counts.get(state, 0), state=state)
+
+    def health(self) -> List[Dict]:
+        """Per-replica view: state, version, pid, outstanding depth,
+        dispatch count, metrics port."""
+        with self._cond:
+            return [{"replica": w.name, "state": w.state,
+                     "version": w.version, "pid": w.pid,
+                     "outstanding": len(w.outstanding),
+                     "dispatched": w.dispatched,
+                     "metrics_port": w.metrics_port,
+                     "shard": self.shard}
+                    for w in self._workers]
+
+    def _worker_call(self, w: _Worker, cmd: str, timeout: float = 30.0):
+        try:
+            with w.send_lock:
+                w.conn.send_bytes(b"C" + pickle.dumps({"cmd": cmd},
+                                                      protocol=4))
+            return w.status_q.get(timeout=timeout)
+        except (OSError, ValueError, queue.Empty):
+            return None
+
+    def fleet_metrics(self, timeout: float = 30.0) -> Dict:
+        """Aggregated registry across the fleet: every live worker's
+        JSON snapshot (pulled over the control pipe, each labeled by its
+        ``replica``) merged with the router's own via
+        ``export.merge_json_snapshots``."""
+        from ..observability import export
+
+        snaps = [export.to_json(include_timeline=False)]
+        with self._cond:
+            live = [w for w in self._workers if w.state == "ready"]
+        for w in live:
+            st = self._worker_call(w, "metrics", timeout=timeout)
+            if st and "metrics" in st:
+                snaps.append(st["metrics"])
+        return export.merge_json_snapshots(snaps)
+
+    # -- HTTP --------------------------------------------------------------
+    def start_http(self, port: int = 0, host: str = "127.0.0.1") -> int:
+        """Fleet observability endpoint: ``GET /metrics`` (router
+        process, Prometheus text), ``GET /health.json`` (per-replica
+        states), ``GET /fleet.json`` (health + merged fleet registry).
+        port=0 picks a free port; returns the bound port."""
+        if self._http is not None:
+            return self._http.server_address[1]
+        import json as _json
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from ..observability import export
+
+        router = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(h):  # noqa: N805 — BaseHTTPRequestHandler idiom
+                path = h.path.split("?", 1)[0]
+                if path == "/metrics":
+                    body = export.to_prometheus().encode("utf-8")
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif path == "/health.json":
+                    body = _json.dumps(router.health(),
+                                       indent=2).encode("utf-8")
+                    ctype = "application/json"
+                elif path == "/fleet.json":
+                    body = _json.dumps(
+                        {"health": router.health(),
+                         "metrics": router.fleet_metrics()},
+                        indent=2, sort_keys=True).encode("utf-8")
+                    ctype = "application/json"
+                else:
+                    h.send_response(404)
+                    h.end_headers()
+                    return
+                h.send_response(200)
+                h.send_header("Content-Type", ctype)
+                h.send_header("Content-Length", str(len(body)))
+                h.end_headers()
+                h.wfile.write(body)
+
+            def log_message(self, *args):  # scrape spam stays off stderr
+                pass
+
+        self._http = ThreadingHTTPServer((host, port), _Handler)
+        self._http_thread = threading.Thread(
+            target=self._http.serve_forever, daemon=True,
+            name="ptpu-router-http")
+        self._http_thread.start()
+        return self._http.server_address[1]
+
+    def stop_http(self):
+        if self._http is None:
+            return
+        self._http.shutdown()
+        self._http.server_close()
+        if self._http_thread is not None:
+            self._http_thread.join(timeout=5)
+            self._http_thread = None
+        self._http = None

@@ -1,0 +1,223 @@
+"""Fleet worker: one serving replica = engine + channel loop.
+
+``worker_main`` is the process entry the Router spawns (spawn/forkserver
+start methods — fork from a jax-threaded parent deadlocks children, the
+PR-3 DataLoader lesson). It builds a ``Predictor`` (or a tp
+``ShardedPredictor``) over the SHARED persistent AOT cache — so N
+replicas deserialize the executables one process compiled, making a
+warm fleet spawn nearly compile-free — wraps it in the PR-2 pipelined
+``PredictorServer``, and shuttles binary frames between the router pipe
+and the server's C++ channel.
+
+Pipe wire protocol (each message one ``send_bytes`` payload):
+
+router -> worker
+    ``b"Z..."`` / ``b"P..."``  request frame, forwarded VERBATIM from
+                               the client (the embedded tag is the
+                               router-minted request id)
+    ``b"C" + pickle(dict)``    control: {"cmd": "stop" | "ping" |
+                               "metrics"}
+
+worker -> router
+    ``b"S" + pickle(dict)``    status: ready/pong/metrics/stopped
+    ``b"R" + u8 vlen + version + frame``
+                               response: version = this replica's
+                               program fingerprint (the router checks
+                               it against the version the request was
+                               dispatched under — mis-versioned
+                               responses must be impossible, and are
+                               counted if they ever happen); frame =
+                               encoded (rid, fetch rows)
+    ``b"E" + pickle((rid, exc))``  per-request failure
+
+Responses stream back from ``_Future.add_done_callback`` (the server's
+device/stacking threads), serialized by a send lock. On "stop" the
+worker calls ``server.stop()``, which flushes everything still queued
+in the stacking stage (the drain contract pinned by
+``tests/test_serving_pipeline.py::test_stop_flushes_queued_requests``),
+so every response is on the pipe before the final "stopped" status —
+the zero-dropped-requests half of the fleet drain story.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import threading
+import traceback
+
+__all__ = ["worker_main"]
+
+
+def _apply_env(options):
+    """Environment overrides BEFORE jax is imported (spawned children
+    import everything inside this function for exactly this reason):
+    virtual-device XLA_FLAGS for tp-on-CPU tests, cache dirs, etc."""
+    for k, v in (options.get("env") or {}).items():
+        os.environ[k] = str(v)
+    platform = options.get("jax_platform")
+    if platform:
+        os.environ["JAX_PLATFORMS"] = platform
+
+
+def worker_main(conn, options):
+    """Run one replica until the pipe closes or a stop command arrives.
+    ``conn`` is the router end of a duplex multiprocessing Pipe;
+    ``options`` is a plain picklable dict (see Router._spawn)."""
+    _apply_env(options)
+
+    import jax
+
+    if options.get("jax_platform"):
+        # a sitecustomize-installed PJRT plugin can override
+        # JAX_PLATFORMS at import time (tests/conftest.py precedent):
+        # pin the platform after import too
+        jax.config.update("jax_platforms", options["jax_platform"])
+
+    from .. import observability as obs
+    from ..inference import Predictor, PredictorServer, _encode_sample
+
+    from . import wire
+
+    name = options.get("name") or "worker%d" % os.getpid()
+    obs.set_replica(name)
+
+    # outbound coalescing: responses fire from the server's device /
+    # stacking threads one future at a time; a dedicated sender drains
+    # them and ships everything queued as ONE pipe message (wire.pack),
+    # so the per-request syscall disappears under load
+    import queue as _queue
+
+    out_q: "_queue.Queue" = _queue.Queue()
+    _SENDER_STOP = object()
+
+    def _sender_loop():
+        while True:
+            item = out_q.get()
+            if item is _SENDER_STOP:
+                return
+            items = [item]
+            while True:
+                try:
+                    nxt = out_q.get_nowait()
+                except _queue.Empty:
+                    break
+                if nxt is _SENDER_STOP:
+                    out_q.put(nxt)  # re-deliver after this flush
+                    break
+                items.append(nxt)
+            try:
+                conn.send_bytes(wire.pack(items))
+            except (OSError, ValueError, BrokenPipeError):
+                return  # router gone: nothing left to tell it
+
+    sender = threading.Thread(target=_sender_loop, daemon=True,
+                              name="ptpu-worker-send")
+    sender.start()
+
+    def send(payload: bytes):
+        out_q.put(payload)
+
+    try:
+        shard = int(options.get("shard") or 1)
+        if shard > 1:
+            from .sharded import ShardedPredictor
+
+            pred = ShardedPredictor(options["model_dir"], shard=shard)
+        else:
+            pred = Predictor(options["model_dir"])
+        version = pred._engine.fingerprint()
+        server = PredictorServer(
+            pred,
+            max_batch=int(options.get("max_batch", 8)),
+            max_wait_ms=float(options.get("max_wait_ms", 0.0)),
+            in_flight=int(options.get("in_flight", 2)),
+            capacity=int(options.get("capacity", 256)))
+        server.start()
+        port = server.start_http(0) if options.get("http") else 0
+    except Exception as e:
+        # a replica that cannot come up reports WHY before dying — the
+        # router surfaces this instead of a bare dead-pipe error
+        send(b"S" + pickle.dumps(
+            {"ready": False, "error": repr(e),
+             "traceback": traceback.format_exc()}, protocol=4))
+        return
+    vtag = version.encode("ascii")
+    send(b"S" + pickle.dumps(
+        {"ready": True, "version": version, "pid": os.getpid(),
+         "name": name, "metrics_port": port, "shard": shard}, protocol=4))
+
+    def respond(rid, fut):
+        try:
+            rows = fut.result(timeout=0)
+            send(b"R" + struct.pack("<B", len(vtag)) + vtag
+                 + _encode_sample(rid, rows))
+        except Exception as e:
+            send(b"E" + _pickle_error(rid, e))
+
+    def _pickle_error(rid, e):
+        """An error response must ALWAYS reach the router — an exception
+        whose state cannot pickle (locks, device handles, tracers) or
+        whose class cannot reconstruct degrades to a plain RuntimeError
+        carrying its repr, never a silently dropped response (which
+        would strand the router's outstanding entry forever)."""
+        try:
+            payload = pickle.dumps((rid, e), protocol=4)
+            pickle.loads(payload)  # reconstruction must work router-side
+            return payload
+        except Exception:
+            return pickle.dumps(
+                (rid, RuntimeError("replica error (unpicklable): %r" % (e,))),
+                protocol=4)
+
+    from ..runtime import recordio as _rio
+
+    try:
+        stop = False
+        while not stop:
+            try:
+                payload = conn.recv_bytes()
+            except (EOFError, OSError):
+                break  # router gone: drain and exit
+            for msg in wire.iter_messages(payload):
+                kind = bytes(msg[:1])
+                if kind == b"C":
+                    cmd = pickle.loads(msg[1:])
+                    op = cmd.get("cmd")
+                    if op == "stop":
+                        stop = True
+                        break
+                    if op == "ping":
+                        send(b"S" + pickle.dumps(
+                            {"pong": True, "version": version,
+                             "pid": os.getpid()}, protocol=4))
+                    elif op == "metrics":
+                        from ..observability import export
+
+                        send(b"S" + pickle.dumps(
+                            {"metrics": export.to_json(
+                                include_timeline=False)}, protocol=4))
+                    continue
+                # request frame: submit as-is (bytes — the C channel
+                # copies from a bytes payload); the response streams
+                # back from the completing server thread via the done
+                # callback
+                msg = bytes(msg)
+                rid = _rio.frame_tag(msg)
+                try:
+                    fut = server.submit_frame(msg)
+                except Exception as e:
+                    send(b"E" + _pickle_error(rid, e))
+                    continue
+                fut.add_done_callback(
+                    lambda f, rid=rid: respond(rid, f))
+    finally:
+        # stop() drains the stacking queue (never drops): every
+        # outstanding future completes -> every response is queued
+        # BEFORE the stopped status below, and the sender flushes the
+        # queue in order before exiting
+        server.stop()
+        send(b"S" + pickle.dumps({"stopped": True}, protocol=4))
+        out_q.put(_SENDER_STOP)
+        sender.join(timeout=30)
+        conn.close()

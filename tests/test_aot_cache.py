@@ -304,3 +304,100 @@ def test_predictor_and_executor_share_the_store(tmp_path):
     entries = cache.entries()
     assert entries and entries[0]["meta"]["kind"] == "predict"
     assert entries[0]["meta"]["feed_sig"] == (("x", (2, 4), "float32"),)
+
+
+# -- multi-process safety (the fleet-spawn story) -------------------------
+
+def test_concurrent_cold_compile_same_key(tmp_path):
+    """TWO processes cold-compile the SAME key against one cache dir at
+    once — the fleet-startup race (N replicas spawned into an empty
+    cache). Writes are tmp+rename atomic and idempotent (identical
+    blobs, last rename wins), so both must exit clean and the surviving
+    blob must be a VALID executable: a third process pays zero cold
+    compiles."""
+    import threading
+
+    d = str(tmp_path / "cache")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PADDLE_TPU_AOT_CACHE_DIR=d, PADDLE_TPU_AOT_CACHE="1")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    cmd = [sys.executable,
+           os.path.join(_REPO, "tools", "bench_coldstart.py"),
+           "--child", "--config", "mlp-tiny", "--loop-steps", "2"]
+
+    procs = [subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True, env=env,
+                              cwd=_REPO)
+             for _ in range(2)]
+    outs = []
+
+    def reap(p):
+        out, err = p.communicate(timeout=600)
+        outs.append((p.returncode, out, err))
+
+    threads = [threading.Thread(target=reap, args=(p,)) for p in procs]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    results = []
+    for rc, out, err in outs:
+        assert rc == 0, err[-3000:]
+        results.append(json.loads(out.strip().splitlines()[-1]))
+    # both children actually raced cold (neither found a finished warm
+    # cache): at least one compiled everything; losses agree either way
+    assert max(r["cold_compiles"] for r in results) >= 3
+    assert results[0]["first_loss"] == results[1]["first_loss"]
+    assert not [n for n in os.listdir(d) if ".tmp." in n], "torn tmp left"
+    assert not [n for n in os.listdir(d)
+                if n.endswith(aot_cache.QUARANTINE_SUFFIX)]
+    # the blob both wrote is loadable: a third process is fully warm
+    third = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=600, env=env, cwd=_REPO)
+    assert third.returncode == 0, third.stderr[-3000:]
+    rec = json.loads(third.stdout.strip().splitlines()[-1])
+    assert rec["cold_compiles"] == 0, "racing writers corrupted the blob"
+    assert rec["warm_loads"] >= 3
+    assert rec["first_loss"] == results[0]["first_loss"]
+
+
+def test_corrupt_sidecar_with_valid_blob_repairs(tmp_path):
+    """A torn/garbage .sig next to a VALID blob must not cost the blob:
+    preload skips it (counted reason=sidecar), the predict call still
+    disk-loads the executable (zero re-compiles), and the sidecar is
+    REWRITTEN so the next process's preload works again."""
+    from paddle_tpu.inference import Predictor
+
+    mp, sp = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(mp, sp):
+        with fluid.unique_name.guard():
+            x = layers.data(name="x", shape=[4])
+            out = layers.fc(x, 3, act="softmax")
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(sp)
+        fluid.io.save_inference_model(str(tmp_path), ["x"], [out], exe,
+                                      main_program=mp, scope=scope)
+    feed = {"x": np.ones((2, 4), np.float32)}
+    p = Predictor(str(tmp_path))
+    want, = p.run(feed)
+    cache = aot_cache.AotDiskCache(
+        cache_dir=os.path.join(str(tmp_path), "__aot_cache__"))
+    (entry,) = cache.entries()
+    sig_path = cache.meta_path(entry["key"])
+    with open(sig_path, "wb") as f:
+        f.write(b"\x80garbage not a pickle")
+
+    corrupt0 = obs.AOT_CACHE_CORRUPT.value(reason="sidecar")
+    p2 = Predictor(str(tmp_path))  # preload scans the corrupt sidecar
+    assert p2._compiled == {}, "corrupt sidecar should not preload"
+    got, = p2.run(feed)
+    np.testing.assert_allclose(got, want)
+    assert p2.traces == 0, "valid blob was recompiled over a bad sidecar"
+    assert obs.AOT_CACHE_CORRUPT.value(reason="sidecar") > corrupt0
+    # repaired: readable again, and the next process preloads normally
+    meta = cache.read_meta(entry["key"])
+    assert meta is not None and meta["kind"] == "predict"
+    p3 = Predictor(str(tmp_path))
+    assert len(p3._compiled) == 1
+    assert p3.traces == 0

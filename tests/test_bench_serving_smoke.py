@@ -82,3 +82,63 @@ def test_bench_serving_smoke(monkeypatch):
     assert speedups[0]["speedup"] > 0
     assert set(speedups[0]["best_config"]) == {
         "mode", "max_batch", "max_wait_ms", "in_flight"}
+
+
+_FLEET_SWEEP_KEYS = {
+    "phase": str, "replicas": int, "submitters": int, "loop": str,
+    "max_wait_ms": float,
+    "shard": int, "max_batch": int, "in_flight": int, "requests": int,
+    "rounds": int, "rows_per_sec": float, "baseline_rows_per_sec": float,
+    "fleet_speedup": float, "rows_per_sec_rounds": list,
+    "baseline_rounds": list, "fleet_up_s": float, "wall_s": float,
+}
+
+_FLEET_BEST_KEYS = {
+    "phase": str, "fleet_speedup": float, "rows_per_sec": float,
+    "baseline_rows_per_sec": float, "best_config": dict,
+}
+
+
+def test_bench_serving_fleet_smoke(monkeypatch):
+    """--fleet mode contract: one schema-stable JSON line per
+    (replicas, submitters, deadline) config, each carrying its own
+    fleet_speedup vs the interleaved single-server baseline, plus the
+    fleet_best summary. Tiny grid (2 replicas, 32 requests, 1 round) so
+    this stays a tier-1 smoke; subprocess workers run on CPU."""
+    monkeypatch.setenv("BENCH_SERVING_PLATFORM", "cpu")
+    monkeypatch.setenv("SERVING_DIM", "4")
+    monkeypatch.setenv("SERVING_HIDDEN", "8")
+    monkeypatch.setenv("FLEET_REQUESTS", "32")
+    monkeypatch.setenv("FLEET_ROUNDS", "1")
+    monkeypatch.setenv("FLEET_MAX_BATCH", "4")
+    monkeypatch.setenv("FLEET_INFLIGHT", "2")
+    monkeypatch.setenv("FLEET_REPLICAS", "2")
+    monkeypatch.setenv("FLEET_SUBMITTERS", "2")
+    monkeypatch.setenv("FLEET_WAITS_MS", "0")
+    monkeypatch.setenv("FLEET_LOOP_MODES", "open")
+    monkeypatch.syspath_prepend(
+        __file__.rsplit("/tests/", 1)[0] + "/tools")
+    sys.modules.pop("bench_serving", None)
+    import bench_serving
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        bench_serving.fleet_main()
+    recs = [json.loads(ln) for ln in buf.getvalue().splitlines()
+            if ln.strip()]
+
+    sweeps = [r for r in recs if r["phase"] == "fleet_sweep"]
+    assert len(sweeps) == 1  # one line per config: 2 replicas x 1 x 1
+    rec = sweeps[0]
+    _check_schema(rec, _FLEET_SWEEP_KEYS)
+    assert rec["replicas"] == 2 and rec["requests"] == 32
+    assert rec["rows_per_sec"] > 0 and rec["baseline_rows_per_sec"] > 0
+    assert rec["fleet_speedup"] > 0
+    assert len(rec["rows_per_sec_rounds"]) == rec["rounds"] == 1
+
+    bests = [r for r in recs if r["phase"] == "fleet_best"]
+    assert len(bests) == 1
+    _check_schema(bests[0], _FLEET_BEST_KEYS)
+    assert set(bests[0]["best_config"]) == {
+        "replicas", "submitters", "loop", "max_wait_ms", "max_batch",
+        "in_flight"}

@@ -95,3 +95,53 @@ def test_json_snapshot_parses_and_carries_timeline(dump_output):
     for field in ("ts", "kind", "wall_ms", "steps", "feed_bytes",
                   "fetch_bytes", "seq"):
         assert field in step, field
+
+
+def test_replica_label_and_merge(tmp_path):
+    """Two worker-labeled dumps merge collision-free: the replica label
+    (PADDLE_TPU_REPLICA / --replica) keeps each process's series
+    distinct, and --merge aggregates them into one snapshot."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    dumps = []
+    for name in ("w0", "w1"):
+        proc = subprocess.run(
+            [sys.executable, _TOOL, "--steps", "1", "--no-predict",
+             "--json", "--replica", name],
+            capture_output=True, text=True, timeout=600, env=env,
+            cwd=_REPO)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        snap = json.loads(proc.stdout)
+        assert snap["replica"] == name
+        steps = snap["metrics"]["paddle_tpu_steps_total"]["series"]
+        assert all(s["labels"]["replica"] == name for s in steps)
+        path = tmp_path / ("%s.json" % name)
+        path.write_text(proc.stdout)
+        dumps.append((str(path), snap))
+
+    proc = subprocess.run(
+        [sys.executable, _TOOL, "--merge", dumps[0][0], dumps[1][0]],
+        capture_output=True, text=True, timeout=600, env=env, cwd=_REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    merged = json.loads(proc.stdout)
+    assert sorted(merged["replicas"]) == ["w0", "w1"]
+    series = merged["metrics"]["paddle_tpu_steps_total"]["series"]
+    # no collisions: each worker's series is still addressable...
+    replicas = {s["labels"]["replica"] for s in series}
+    assert replicas == {"w0", "w1"}
+    # ...and values survived intact (sum over the fleet = sum of dumps)
+    def total(snap_series):
+        return sum(s["value"] for s in snap_series)
+    want = sum(total(s["metrics"]["paddle_tpu_steps_total"]["series"])
+               for _p, s in dumps)
+    assert total(series) == want
+
+
+def test_unlabeled_export_format_unchanged():
+    """A process that never sets a replica identity exports EXACTLY the
+    pre-fleet format: no replica label anywhere (existing dashboards and
+    scrape configs must not churn)."""
+    from paddle_tpu.observability import export
+
+    text = export.to_prometheus()
+    assert 'replica="' not in text

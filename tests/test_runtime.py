@@ -151,6 +151,48 @@ def test_channel_recv_batch_deadline():
     ch.destroy()
 
 
+def test_channel_recv_batch_zero_wait():
+    """max_wait_s=0 = "drain what's ready, don't wait": queued records
+    return immediately, an OPEN empty channel returns [] without
+    blocking (the router's opportunistic drain), a closed drained one
+    returns None — pinned on the NATIVE branch."""
+    import time
+
+    ch = runtime.Channel(capacity=16)
+    assert ch._lib is not None, "native branch required"
+    # open + empty: no block, no records
+    t0 = time.monotonic()
+    assert ch.recv_batch(4, max_wait_s=0) == []
+    assert time.monotonic() - t0 < 1.0
+    # queued records drain immediately (bounded by max_n)
+    for i in range(3):
+        ch.send(b"%d" % i)
+    assert ch.recv_batch(2, max_wait_s=0) == [b"0", b"1"]
+    assert ch.recv_batch(4, max_wait_s=0) == [b"2"]
+    # closed + drained: None (same contract as the blocking form)
+    ch.close()
+    assert ch.recv_batch(4, max_wait_s=0) is None
+    ch.destroy()
+
+
+def test_channel_recv_batch_zero_wait_python_fallback(monkeypatch):
+    """The pure-Python channel pins the same max_wait_s=0 contract."""
+    import time
+
+    monkeypatch.setattr(rio, "_load", lambda: None)
+    ch = rio.Channel(capacity=16)
+    assert ch._lib is None
+    t0 = time.monotonic()
+    assert ch.recv_batch(4, max_wait_s=0) == []
+    assert time.monotonic() - t0 < 1.0
+    for i in range(3):
+        ch.send(b"%d" % i)
+    assert ch.recv_batch(2, max_wait_s=0) == [b"0", b"1"]
+    assert ch.recv_batch(4, max_wait_s=0) == [b"2"]
+    ch.close()
+    assert ch.recv_batch(4, max_wait_s=0) is None
+
+
 def test_channel_recv_batch_deadline_python_fallback(monkeypatch):
     """The pure-Python channel must honor the same deadline contract."""
     import time

@@ -306,3 +306,83 @@ def test_concurrent_submitters_all_rows_correct(tmp_path):
         t.join()
     server.stop()
     assert not errs, errs
+
+
+# -- stop() drain contract (the fleet drain builds on this) ---------------
+
+def test_stop_flushes_queued_requests(tmp_path):
+    """stop() with requests still sitting in the stacking channel must
+    FLUSH them, not drop: the stacking stage drains the closed channel,
+    forwards the final batches, and every future completes. (The fleet
+    worker's graceful drain relies on exactly this — its responses must
+    all be on the wire before the worker reports stopped.)"""
+    feed, want = _save_model(tmp_path)
+    p = Predictor(str(tmp_path))
+    # deadline keeps the stacking stage busy coalescing while we queue
+    # more behind it, so stop() really does catch requests in-queue
+    server = PredictorServer(p, max_batch=2, max_wait_ms=50)
+    server.start()
+    futs = [server.submit((feed[i % 3],)) for i in range(17)]
+    server.stop()
+    for i, fut in enumerate(futs):
+        row, = fut.result(timeout=60)  # flushed, never dropped
+        np.testing.assert_allclose(row, want[i % 3], rtol=1e-4, atol=1e-5)
+    assert server._results == {}
+    # and the channel is really closed: new submits are refused loudly
+    with pytest.raises(RuntimeError, match="stopped"):
+        server.submit((feed[0],))
+
+
+# -- submit_frame (the fleet worker's fan-in path) ------------------------
+
+def test_submit_frame_round_trip(tmp_path):
+    """An already-encoded frame serves identically to submit(): the
+    embedded tag is the request id, and both wire forms (zero-copy +
+    pickle fallback) work."""
+    import pickle
+
+    from paddle_tpu.runtime import recordio as rio
+
+    feed, want = _save_model(tmp_path)
+    p = Predictor(str(tmp_path))
+    server = PredictorServer(p, max_batch=4)
+    server.start()
+    msg = _encode_request(12345, [np.ascontiguousarray(feed[0])])
+    assert rio.frame_tag(msg) == 12345
+    fut = server.submit_frame(msg)
+    np.testing.assert_allclose(fut.result(timeout=60)[0], want[0],
+                               rtol=1e-4, atol=1e-5)
+    pmsg = b"P" + pickle.dumps((77, [feed[1]]), protocol=4)
+    assert rio.frame_tag(pmsg) == 77
+    fut = server.submit_frame(pmsg)
+    np.testing.assert_allclose(fut.result(timeout=60)[0], want[1],
+                               rtol=1e-4, atol=1e-5)
+    # duplicate in-flight tags are refused (the router mints unique ids)
+    slow = _encode_request(9, [feed[2]])
+    server.stop()
+    f1 = None
+    try:
+        f1 = server.submit_frame(slow)
+    except RuntimeError:
+        pass  # stopped server refuses — also fine for this assertion
+    if f1 is not None:
+        with pytest.raises(ValueError, match="already in flight"):
+            server.submit_frame(slow)
+
+
+def test_future_done_callback():
+    """add_done_callback fires on completion (and immediately when
+    already done) — the fleet worker's response streaming hook."""
+    from paddle_tpu.inference import _Future
+
+    fut = _Future()
+    seen = []
+    fut.add_done_callback(lambda f: seen.append(f.result(timeout=0)))
+    fut.set_result([1, 2])
+    assert seen == [[1, 2]]
+    fut.add_done_callback(lambda f: seen.append("late"))
+    assert seen == [[1, 2], "late"]
+    bad = _Future()
+    bad.add_done_callback(lambda f: seen.append(type(f._exc).__name__))
+    bad.set_exception(KeyError("boom"))
+    assert seen[-1] == "KeyError"

@@ -18,15 +18,29 @@ batching deadline, and in-flight pipeline depth — at a fixed submitter
 count, reporting rows/s plus the pad-waste ratio (padded rows / device
 rows) straight from the serving metrics.
 
+With ``--fleet`` the tool instead measures the HORIZONTAL layer
+(serving.Router): a single in-process PredictorServer (the PR-2
+baseline) against an N-replica worker fleet behind the router, crossed
+over replicas x submitters x batching deadline. Baseline and fleet
+rounds are INTERLEAVED (base, fleet, base, fleet, ...) per config so
+host noise hits both arms equally — the PR-2/3/5 A/B discipline — and
+every config line carries its own ``fleet_speedup`` (median fleet
+rows/s over median baseline rows/s):
+  {"phase": "fleet_sweep", "replicas": N, ..., "fleet_speedup": ...}
+  {"phase": "fleet_best", ...}   best config overall
+
 Usage:
   python tools/bench_serving.py            # CPU (forced)
+  python tools/bench_serving.py --fleet    # replica-scaling sweep
   BENCH_SERVING_PLATFORM=device python tools/bench_serving.py  # real chip
 
 The model is the MLP the C ABI test embeds (16->128->10 softmax) at
 SERVING_BATCH (default 8); adjust with SERVING_DIM / SERVING_HIDDEN.
 Sweep grid: SERVING_SWEEP_BATCHES / SERVING_SWEEP_WAITS_MS /
 SERVING_SWEEP_INFLIGHT (comma lists), SERVING_SUBMITTERS,
-SERVING_REQUESTS.
+SERVING_REQUESTS. Fleet grid: FLEET_REPLICAS / FLEET_SUBMITTERS /
+FLEET_WAITS_MS (comma lists), FLEET_ROUNDS, FLEET_MAX_BATCH,
+FLEET_INFLIGHT, FLEET_REQUESTS.
 """
 from __future__ import annotations
 
@@ -196,6 +210,142 @@ def main():
                                 "in_flight")}})
 
 
+def _fleet_rows_per_sec(submit, n_req, submitters, rows, loop="closed",
+                        timeout=600.0):
+    """Serve n_req single-row requests from `submitters` threads through
+    `submit`; returns rows/s. loop="closed": each thread waits for its
+    row before the next (latency-bound — what an RPC frontend sees);
+    loop="open": threads flood and futures are awaited at the end
+    (aggregate CAPACITY — the front channel's backpressure bounds
+    memory). The shared measurement body for the baseline-server and
+    fleet-router arms."""
+    import threading
+
+    errs = []
+
+    def feed_requests(k):
+        try:
+            futs = []
+            for i in range(k * n_req // submitters,
+                           (k + 1) * n_req // submitters):
+                fut = submit((rows[i % len(rows)],))
+                if loop == "closed":
+                    fut.result(timeout=timeout)
+                else:
+                    futs.append(fut)
+            for fut in futs:
+                fut.result(timeout=timeout)
+        except Exception as e:  # pragma: no cover - failure reporting
+            errs.append(repr(e))
+
+    threads = [threading.Thread(target=feed_requests, args=(k,))
+               for k in range(submitters)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    if errs:
+        raise RuntimeError("bench clients failed: %s" % errs[:3])
+    return n_req / dt
+
+
+def fleet_main():
+    """--fleet: replicas x submitters x deadline sweep, interleaved A/B
+    against a single PR-2 PredictorServer baseline."""
+    from paddle_tpu.inference import Predictor, PredictorServer
+    from paddle_tpu.serving import Router
+
+    platform = os.environ.get("BENCH_SERVING_PLATFORM", "cpu")
+    tmp = tempfile.mkdtemp(prefix="ptpu_fleet_")
+    model_dir = os.path.join(tmp, "model")
+    _save_model(model_dir)
+
+    n_req = int(os.environ.get("FLEET_REQUESTS",
+                               os.environ.get("SERVING_REQUESTS", 2000)))
+    rounds = int(os.environ.get("FLEET_ROUNDS", 3))
+    max_batch = int(os.environ.get("FLEET_MAX_BATCH", 32))
+    in_flight = int(os.environ.get("FLEET_INFLIGHT", 4))
+    replicas_grid = _int_list("FLEET_REPLICAS", "1,2,4")
+    submitters_grid = _int_list("FLEET_SUBMITTERS", "8")
+    waits_grid = _float_list("FLEET_WAITS_MS", "0")
+    loops = [v for v in os.environ.get("FLEET_LOOP_MODES",
+                                       "closed,open").split(",") if v]
+    rows = [np.random.RandomState(i % 7).randn(DIM).astype(np.float32)
+            for i in range(8)]
+
+    # the baseline arm: one in-process pipelined server, PR-2 bucket
+    # config — constructed once, reused in every interleaved round
+    pred = Predictor(model_dir)
+    base_server = PredictorServer(pred, max_batch=max_batch,
+                                  in_flight=in_flight)
+    base_server.start()
+    # prime both arms' compiled buckets off the clock
+    for f in [base_server.submit((rows[0],)) for _ in range(max_batch)]:
+        f.result(timeout=600)
+
+    best = None
+    for replicas in replicas_grid:
+        for wait_ms in waits_grid:
+            router = Router(
+                model_dir, replicas=replicas, max_batch=max_batch,
+                max_wait_ms=wait_ms, in_flight=in_flight,
+                jax_platform=("cpu" if platform == "cpu" else None))
+            t_up = time.perf_counter()
+            router.start()
+            fleet_up_s = time.perf_counter() - t_up
+            for submitters in submitters_grid:
+                # warm the routed path off the clock
+                for f in [router.submit((rows[0],))
+                          for _ in range(max_batch)]:
+                    f.result(timeout=600)
+                for loop in loops:
+                    base_rs, fleet_rs = [], []
+                    t0 = time.perf_counter()
+                    for _ in range(rounds):  # interleaved A/B per round
+                        base_rs.append(_fleet_rows_per_sec(
+                            base_server.submit, n_req, submitters, rows,
+                            loop=loop))
+                        fleet_rs.append(_fleet_rows_per_sec(
+                            router.submit, n_req, submitters, rows,
+                            loop=loop))
+                    wall = time.perf_counter() - t0
+                    base_med = sorted(base_rs)[len(base_rs) // 2]
+                    fleet_med = sorted(fleet_rs)[len(fleet_rs) // 2]
+                    rec = {
+                        "phase": "fleet_sweep", "replicas": replicas,
+                        "submitters": submitters, "loop": loop,
+                        "max_wait_ms": wait_ms,
+                        "shard": 1, "max_batch": max_batch,
+                        "in_flight": in_flight, "requests": n_req,
+                        "rounds": rounds,
+                        "rows_per_sec": round(fleet_med, 1),
+                        "baseline_rows_per_sec": round(base_med, 1),
+                        "fleet_speedup": round(
+                            fleet_med / max(base_med, 1e-9), 3),
+                        "rows_per_sec_rounds": [round(v, 1)
+                                                for v in fleet_rs],
+                        "baseline_rounds": [round(v, 1) for v in base_rs],
+                        "fleet_up_s": round(fleet_up_s, 2),
+                        "wall_s": round(wall, 3),
+                    }
+                    _emit(rec)
+                    if (best is None
+                            or rec["fleet_speedup"] > best["fleet_speedup"]):
+                        best = rec
+            router.stop()
+    base_server.stop()
+    if best is not None:
+        _emit({"phase": "fleet_best",
+               "fleet_speedup": best["fleet_speedup"],
+               "rows_per_sec": best["rows_per_sec"],
+               "baseline_rows_per_sec": best["baseline_rows_per_sec"],
+               "best_config": {k: best[k] for k in
+                               ("replicas", "submitters", "loop",
+                                "max_wait_ms", "max_batch", "in_flight")}})
+
+
 def _int_list(env, default):
     return [int(v) for v in os.environ.get(env, default).split(",") if v]
 
@@ -264,4 +414,4 @@ def _run_server_config(server_cls, pred, obs, *, mode, loop, max_batch,
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(fleet_main() if "--fleet" in sys.argv[1:] else main())

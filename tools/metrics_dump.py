@@ -8,8 +8,18 @@ JSON snapshot including the step timeline. tests/test_metrics_dump.py
 runs this in tier-1, so an exposition-format regression fails CI before
 it reaches a real scrape job.
 
+``--merge a.json b.json ...`` instead aggregates several previously
+captured JSON dumps (a worker's ``/metrics.json``, or this tool's own
+``--json`` output) into ONE snapshot via
+``observability.export.merge_json_snapshots``: series with identical
+label sets sum (counters/gauges/histogram buckets; summaries merge
+min/max), distinct label sets stay distinct — so fleet workers exporting
+with a ``replica`` label (PADDLE_TPU_REPLICA / ``--replica``) merge
+collision-free. No jax import, no train loop.
+
 Usage:
     JAX_PLATFORMS=cpu python tools/metrics_dump.py [--steps 4] [--json]
+    python tools/metrics_dump.py --merge w0.json w1.json > fleet.json
 """
 from __future__ import annotations
 
@@ -22,16 +32,20 @@ import sys
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
 
-# a sitecustomize-installed PJRT plugin can override JAX_PLATFORMS at
-# import time (see tests/conftest.py) — pin the platform after import too
-jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+def _pin_platform():
+    """Deferred jax import (the --merge path must stay jax-free): a
+    sitecustomize-installed PJRT plugin can override JAX_PLATFORMS at
+    import time (see tests/conftest.py) — pin the platform after import
+    too."""
+    import jax
 
-import numpy as np
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 
 def tiny_train_loop(steps: int):
+    import numpy as np
+
     import paddle_tpu as fluid
     from paddle_tpu import layers, optimizer
 
@@ -59,6 +73,8 @@ def tiny_train_loop(steps: int):
 
 
 def predict_roundtrip(tmpdir: str):
+    import numpy as np
+
     import paddle_tpu as fluid
     from paddle_tpu import layers
     from paddle_tpu.inference import Predictor
@@ -77,6 +93,39 @@ def predict_roundtrip(tmpdir: str):
     p.run({"x": np.ones((2, 8), np.float32)})
 
 
+def merge_dumps(paths):
+    """Load each JSON dump and print the aggregated snapshot. Stays off
+    the jax import path ENTIRELY: merging is pure dict arithmetic
+    (export.merge_json_snapshots) and the observability subtree is
+    jax-free, so the parent package's heavy __init__ is stubbed out —
+    a scrape sidecar pays ~ms, not a framework import."""
+    import json
+    import types
+
+    if "paddle_tpu" not in sys.modules:
+        # import ONLY paddle_tpu.observability: a bare namespace module
+        # with the right __path__ stands in for the parent package so
+        # its jax-importing __init__ never runs
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        stub = types.ModuleType("paddle_tpu")
+        stub.__path__ = [os.path.join(root, "paddle_tpu")]
+        sys.modules["paddle_tpu"] = stub
+    from paddle_tpu.observability.export import merge_json_snapshots
+
+    snaps = []
+    for p in paths:
+        with open(p) as f:
+            snap = json.load(f)
+        if "metrics" not in snap:
+            raise SystemExit(
+                "%s is not a metrics snapshot (expected a top-level "
+                "'metrics' key, i.e. /metrics.json or --json output)" % p)
+        snaps.append(snap)
+    merged = merge_json_snapshots(snaps)
+    sys.stdout.write(json.dumps(merged, indent=2, sort_keys=True))
+    sys.stdout.write("\n")
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--steps", type=int, default=4,
@@ -85,8 +134,22 @@ def main():
                     help="skip the Predictor round-trip")
     ap.add_argument("--json", action="store_true",
                     help="print ONLY the JSON snapshot (no Prometheus text)")
+    ap.add_argument("--merge", nargs="+", metavar="DUMP.json",
+                    help="aggregate previously captured JSON dumps "
+                         "(fleet workers) instead of running the smoke")
+    ap.add_argument("--replica", default=None,
+                    help="label this process's exports replica=<value> "
+                         "(same effect as PADDLE_TPU_REPLICA)")
     args = ap.parse_args()
 
+    if args.merge:
+        merge_dumps(args.merge)
+        return
+    _pin_platform()
+    if args.replica:
+        from paddle_tpu import observability as obs
+
+        obs.set_replica(args.replica)
     tiny_train_loop(args.steps)
     if not args.no_predict:
         import tempfile
