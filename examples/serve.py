@@ -67,6 +67,88 @@ def train_and_export(model_dir, steps, place):
     return xs, ys
 
 
+def train_and_export_lm(model_dir, steps, place):
+    """Tiny causal LM + decode-servable export (KV-cache serving path:
+    docs/performance.md 'Decode serving tuning')."""
+    from paddle_tpu.models import transformer as T
+    from paddle_tpu.serving import DecodeConfig, save_decode_model
+
+    V, L, NH, D, DI, ML = 64, 2, 2, 32, 64, 128
+    B, S = 4, 32
+    rs = np.random.RandomState(0)
+    ids_v = layers.data(name="ids", shape=[B, S], dtype="int64",
+                        append_batch_size=False)
+    lbl_v = layers.data(name="lbl", shape=[B, S], dtype="int64",
+                        append_batch_size=False)
+    loss, _ = T.transformer_lm(ids_v, lbl_v, V, n_layer=L, n_head=NH,
+                               d_model=D, d_inner=DI, dropout_rate=0.0,
+                               max_len=ML, fused_head=False)
+    optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    exe = fluid.Executor(place)
+    exe.run(fluid.default_startup_program())
+    for i in range(steps):
+        x = rs.randint(0, V, (B, S)).astype(np.int64)
+        y = np.concatenate([x[:, 1:], x[:, :1]], axis=1)
+        lv, = exe.run(feed={"ids": x, "lbl": y}, fetch_list=[loss])
+        if i % 10 == 0:
+            print("step %3d  loss %.4f" % (i, float(lv)))
+    save_decode_model(model_dir, DecodeConfig(
+        vocab_size=V, n_layer=L, n_head=NH, d_model=D, d_inner=DI,
+        max_len=ML), exe)
+    print("exported decode model to", model_dir)
+    return V
+
+
+def serve_decode(args, place):
+    """--decode: train/export a tiny LM, generate through the
+    continuous-batching DecodeServer (or the Router fleet with
+    --replicas > 1), and check every generation against the direct
+    DecodePredictor."""
+    import tempfile
+
+    from paddle_tpu.serving import DecodePredictor, DecodeServer
+
+    with tempfile.TemporaryDirectory() as model_dir:
+        vocab = train_and_export_lm(model_dir, args.steps, place)
+        pred = DecodePredictor(model_dir)
+        rs = np.random.RandomState(7)
+        prompts = [rs.randint(1, vocab, 3 + (i % 6)).astype(np.int64)
+                   for i in range(args.clients * args.rows_per_client)]
+        max_new = 8
+        want = pred.generate(prompts, max_new_tokens=max_new)
+        if args.replicas > 1:
+            from paddle_tpu.serving import Router
+
+            server = Router(model_dir, replicas=args.replicas, decode=True,
+                            decode_slots=4, max_new_tokens=max_new,
+                            jax_platform="cpu" if args.cpu else None)
+        else:
+            server = DecodeServer(pred, slots=4, max_new_tokens=max_new)
+        server.start()
+        port = server.start_http(args.metrics_port,
+                                 host=args.metrics_host)
+        scrape_host = ("127.0.0.1" if args.metrics_host == "0.0.0.0"
+                       else args.metrics_host)
+        opts = np.array([max_new], np.int64)
+        futs = [server.submit((p, opts)) for p in prompts]
+        res = [f.result(timeout=600)[0] for f in futs]
+        import urllib.request
+        text = urllib.request.urlopen(
+            "http://%s:%d/metrics" % (scrape_host, port), timeout=30
+        ).read().decode("utf-8")
+        server.stop()
+        for w, g in zip(want, res):
+            assert np.array_equal(np.asarray(g), w), (g, w)
+        if args.replicas <= 1:
+            assert "paddle_tpu_decode_tokens_total" in text
+        ntok = sum(len(g) for g in res)
+        print("decode-served %d sequences (%d tokens) through %s; every "
+              "generation matches the direct DecodePredictor"
+              % (len(res), ntok,
+                 "the %d-replica fleet" % args.replicas
+                 if args.replicas > 1 else "continuous batching"))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=150)
@@ -92,8 +174,16 @@ def main():
                     help="bind address for /metrics; 0.0.0.0 to let an "
                          "external Prometheus scrape this process")
     ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--decode", action="store_true",
+                    help="serve a causal LM through the KV-cache "
+                         "incremental-decode path (continuous batching; "
+                         "docs/performance.md 'Decode serving tuning')")
     args = ap.parse_args()
     place = fluid.CPUPlace() if args.cpu else None
+
+    if args.decode:
+        serve_decode(args, place)
+        return
 
     with tempfile.TemporaryDirectory() as model_dir:
         xs, ys = train_and_export(model_dir, args.steps, place)

@@ -32,6 +32,8 @@ from .ops import control_flow as _k_control_flow  # noqa: F401
 from .ops import decode as _k_decode  # noqa: F401
 from .ops import attention as _k_attention  # noqa: F401
 from .ops import fused_loss as _k_fused_loss  # noqa: F401
+from .ops import kv_cache as _k_kv_cache  # noqa: F401
+from .ops import sampling as _k_sampling  # noqa: F401
 from .ops import detection as _k_detection  # noqa: F401
 
 from .framework import (  # noqa: F401

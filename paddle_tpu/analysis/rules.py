@@ -979,6 +979,99 @@ def _infer_optimizer(ctx: InferContext):
 # ---------------------------------------------------------------------------
 
 
+@register_infer("fused_attention", "ring_attention")
+def _infer_fused_attention(ctx: InferContext):
+    """Out mirrors Q ((B, H, T, Dh) or (B, T, H, Dh) — layout-agnostic:
+    attention preserves the query tensor's shape either way)."""
+    q = ctx.in_info("Q")
+    for slot in ("K", "V"):
+        o = ctx.in_shape(slot)
+        if (q.shape is not None and o is not None
+                and len(o) != len(q.shape)):
+            raise InferError(
+                "%s rank %d does not match Q rank %d"
+                % (slot, len(o), len(q.shape)))
+    return {"Out": VarInfo(q.shape, q.dtype)}
+
+
+@register_infer("decode_attention")
+def _infer_decode_attention(ctx: InferContext):
+    """Q (B, 1, H, Dh) x KCache/VCache (B, S, H, Dh) -> Out = Q shape.
+    The slab's batch/head/depth dims must match the query's."""
+    q = ctx.in_info("Q")
+    qs = q.shape
+    if qs is not None and len(qs) != 4:
+        raise InferError("Q must be rank 4 (B, 1, H, Dh), got rank %d"
+                         % len(qs))
+    if qs is not None and qs[1] not in (None, 1):
+        raise InferError(
+            "decode_attention takes ONE query per sequence; Q%s has "
+            "time dim %s" % (render_shape(qs), qs[1]))
+    for slot in ("KCache", "VCache"):
+        c = ctx.in_shape(slot)
+        if qs is None or c is None:
+            continue
+        if len(c) != 4:
+            raise InferError("%s must be rank 4 (B, S, H, Dh), got rank "
+                             "%d" % (slot, len(c)))
+        for qi, ci, label in ((0, 0, "batch"), (2, 2, "head"),
+                              (3, 3, "depth")):
+            if qs[qi] is not None and c[ci] is not None \
+                    and qs[qi] != c[ci]:
+                raise InferError(
+                    "%s %s dim %d does not match Q%s"
+                    % (slot, label, c[ci], render_shape(qs)))
+    return {"Out": VarInfo(qs, q.dtype)}
+
+
+@register_infer("cache_append")
+def _infer_cache_append(ctx: InferContext):
+    """Out is the updated slab: Cache's shape and dtype verbatim."""
+    c = ctx.in_info("Cache")
+    n = ctx.in_shape("New")
+    if c.shape is not None and n is not None:
+        if len(n) == len(c.shape) and n[1] is not None and n[1] != 1:
+            raise InferError(
+                "cache_append appends ONE row per sequence; New has "
+                "time dim %d" % n[1])
+        tail = n[2:] if len(n) == len(c.shape) else n[1:]
+        want = tuple(c.shape[2:])
+        if (len(tail) != len(want)
+            or any(a is not None and b is not None and a != b
+                   for a, b in zip(tail, want))):
+            raise InferError(
+                "New%s row shape does not match Cache%s rows"
+                % (render_shape(n), render_shape(c.shape)))
+    return {"Out": VarInfo(c.shape, c.dtype)}
+
+
+@register_infer("cache_gather")
+def _infer_cache_gather(ctx: InferContext):
+    """Out: Index's element count of slab rows — (N,) + Cache[1:]."""
+    c = ctx.in_info("Cache")
+    idx = ctx.in_shape("Index")
+    n = prod_dims(idx) if idx is not None else None
+    if c.shape is None:
+        return {"Out": VarInfo(None, c.dtype)}
+    return {"Out": VarInfo((n,) + tuple(c.shape[1:]), c.dtype)}
+
+
+@register_infer("greedy_sample", "top_k_sample", "top_p_sample")
+def _infer_sample(ctx: InferContext):
+    """(B, V) or (B, 1, V) logits -> (B,) int64 sampled ids."""
+    lg = ctx.in_shape("Logits")
+    if lg is None:
+        return {"Out": VarInfo(None, "int64")}
+    if len(lg) not in (2, 3):
+        raise InferError(
+            "Logits must be (B, V) or (B, 1, V), got rank %d" % len(lg))
+    if len(lg) == 3 and lg[1] not in (None, 1):
+        raise InferError(
+            "3-D Logits need a singleton time dim, got %s"
+            % render_shape(lg))
+    return {"Out": VarInfo((lg[0],), "int64")}
+
+
 @register_infer("accuracy")
 def _infer_accuracy(ctx: InferContext):
     ind = ctx.in_shape("Indices")

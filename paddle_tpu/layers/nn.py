@@ -108,6 +108,12 @@ __all__ = [
     "ring_attention",
     "moe_ffn",
     "fused_lm_head_loss",
+    "decode_attention",
+    "cache_append",
+    "cache_gather",
+    "greedy_sample",
+    "top_k_sample",
+    "top_p_sample",
 ]
 
 from .ops import elementwise_add  # re-export for parity
@@ -2117,6 +2123,114 @@ def ring_attention(q, k, v, causal=False, scale=None, sp_axis="sp",
         attrs={"causal": causal, "scale": scale, "sp_axis": sp_axis,
                "dropout_rate": dropout_rate, "chunk": chunk},
     )
+    return out
+
+
+def decode_attention(q, k_cache, v_cache, lengths, scale=None, block_s=None,
+                     name=None):
+    """Single-query attention against a preallocated KV slab (kernel:
+    ops/kv_cache.py — Pallas on TPU, exact lax fallback elsewhere). The
+    incremental-decode twin of ``fused_attention``: q (B, 1, H, Dh)
+    attends k/v slabs (B, S, H, Dh) up to ``lengths`` (B,) valid rows
+    per slot. S is static; serving buckets it to powers of two."""
+    helper = LayerHelper("decode_attention", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype, shape=q.shape)
+    helper.append_op(
+        type="decode_attention",
+        inputs={"Q": [q], "KCache": [k_cache], "VCache": [v_cache],
+                "Lengths": [lengths]},
+        outputs={"Out": [out]},
+        attrs={"scale": scale, "block_s": block_s or _DEFAULT_ATTN_BLOCK_K},
+    )
+    return out
+
+
+def cache_append(cache, new, pos, name=None):
+    """Append one row per sequence into a KV slab: ``new`` (B, 1, ...)
+    lands at row ``pos[b]`` of ``cache`` (B, S, ...). Functional update;
+    the decode step threads the slab through feeds/fetches and XLA
+    aliases it in place under donation (kernel: ops/kv_cache.py)."""
+    helper = LayerHelper("cache_append", name=name)
+    out = helper.create_variable_for_type_inference(
+        cache.dtype, shape=cache.shape)
+    helper.append_op(
+        type="cache_append",
+        inputs={"Cache": [cache], "New": [new], "Pos": [pos]},
+        outputs={"Out": [out]},
+        attrs={},
+    )
+    return out
+
+
+def cache_gather(cache, index, name=None):
+    """Reorder KV-slab slot rows: out[i] = cache[index[i]] — beam-search
+    parent reordering and continuous-batching slot compaction (kernel:
+    ops/kv_cache.py)."""
+    helper = LayerHelper("cache_gather", name=name)
+    # the kernel FLATTENS Index, so the declared row count is the
+    # product of all its dims (None if any is unknown) — matching the
+    # infer rule, or the declared-vs-inferred drift lint fires
+    if index.shape is None:
+        n = None
+    else:
+        n = 1
+        for d in tuple(index.shape):
+            if d is None or d < 0:
+                n = None
+                break
+            n *= d
+    out = helper.create_variable_for_type_inference(
+        cache.dtype, shape=(n,) + tuple(cache.shape)[1:])
+    helper.append_op(
+        type="cache_gather",
+        inputs={"Cache": [cache], "Index": [index]},
+        outputs={"Out": [out]},
+        attrs={},
+    )
+    return out
+
+
+def greedy_sample(logits, name=None):
+    """argmax token per row: (B, V) or (B, 1, V) -> (B,) int64 (kernel:
+    ops/sampling.py)."""
+    helper = LayerHelper("greedy_sample", name=name)
+    out = helper.create_variable_for_type_inference(
+        "int64", shape=(logits.shape[0],))
+    helper.append_op(type="greedy_sample", inputs={"Logits": [logits]},
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def top_k_sample(logits, seed=None, k=40, temperature=1.0, name=None):
+    """Sample from the renormalized top-k logits slice -> (B,) int64.
+    ``seed`` (an int tensor; first element used) MUST be a per-step feed
+    in compiled decode loops — the trace-time RNG is baked into the
+    executable (kernel: ops/sampling.py)."""
+    helper = LayerHelper("top_k_sample", name=name)
+    out = helper.create_variable_for_type_inference(
+        "int64", shape=(logits.shape[0],))
+    inputs = {"Logits": [logits]}
+    if seed is not None:
+        inputs["Seed"] = [seed]
+    helper.append_op(type="top_k_sample", inputs=inputs,
+                     outputs={"Out": [out]},
+                     attrs={"k": k, "temperature": temperature})
+    return out
+
+
+def top_p_sample(logits, seed=None, p=0.9, temperature=1.0, name=None):
+    """Nucleus sampling over the smallest probability mass >= p -> (B,)
+    int64; same Seed contract as ``top_k_sample`` (kernel:
+    ops/sampling.py)."""
+    helper = LayerHelper("top_p_sample", name=name)
+    out = helper.create_variable_for_type_inference(
+        "int64", shape=(logits.shape[0],))
+    inputs = {"Logits": [logits]}
+    if seed is not None:
+        inputs["Seed"] = [seed]
+    helper.append_op(type="top_p_sample", inputs=inputs,
+                     outputs={"Out": [out]},
+                     attrs={"p": p, "temperature": temperature})
     return out
 
 

@@ -327,6 +327,174 @@ def transformer_lm(
     return layers.mean(loss), logits
 
 
+# ---------------------------------------------------------------------------
+# incremental decode graphs (KV-cache serving path, serving/decode.py)
+# ---------------------------------------------------------------------------
+#
+# Both builders re-create transformer_lm's parameter set NAME-FOR-NAME
+# (explicitly named projections AND the auto-named layer_norm_N scale/
+# bias pairs), so a scope trained through transformer_lm loads into them
+# directly. That only holds when the layer-creation ORDER matches
+# transformer_lm exactly — build under unique_name.guard() and keep the
+# layer_norm call sequence identical (2 per layer + 1 final). A drifted
+# name fails loudly at export/load time (missing persistable), and the
+# prefill-vs-training logits parity test pins it.
+
+
+def _cached_self_attention(h, n_head, d_model, name, k_cache=None,
+                           v_cache=None, lengths=None, kv_lengths=None):
+    """transformer_lm's self-attention with its K/V exposed.
+
+    Prefill mode (no caches): full causal flash attention over (B, S);
+    returns (out, k, v) with k/v in the (B, S, H, Dh) slab layout —
+    exactly what decode steps attend against. Decode mode (caches
+    given): h is (B, 1, D); the step's k/v rows append into the slabs
+    at ``lengths`` and a single-query decode_attention runs against the
+    updated slabs up to ``kv_lengths`` valid rows; returns
+    (out, new_k_cache, new_v_cache). Parameter names and creation order
+    match multi_head_attention(fused_qkv=False) verbatim."""
+    B, T, _ = h.shape
+    d_head = d_model // n_head
+    q = _linear(h, d_model, name + ".q")
+    k = _linear(h, d_model, name + ".k")
+    v = _linear(h, d_model, name + ".v")
+    q = layers.reshape(q, shape=[B, T, n_head, d_head])
+    k = layers.reshape(k, shape=[B, T, n_head, d_head])
+    v = layers.reshape(v, shape=[B, T, n_head, d_head])
+    if k_cache is None:
+        ctx = layers.fused_attention(q, k, v, causal=True, layout="bthd")
+        out = _linear(layers.reshape(ctx, shape=[B, T, d_model]),
+                      d_model, name + ".out")
+        return out, k, v
+    new_k = layers.cache_append(k_cache, k, lengths)
+    new_v = layers.cache_append(v_cache, v, lengths)
+    ctx = layers.decode_attention(q, new_k, new_v, kv_lengths)
+    out = _linear(layers.reshape(ctx, shape=[B, T, d_model]),
+                  d_model, name + ".out")
+    return out, new_k, new_v
+
+
+def _lm_head_logits(x, vocab_size, tie_embeddings, prefix):
+    """Vocab projection on a (B, D) last-hidden row; same parameters as
+    transformer_lm(fused_head=False)."""
+    if tie_embeddings:
+        emb = default_main_program().global_block().var(prefix + ".tok_emb")
+        logits = layers.matmul(x, emb, transpose_y=True)
+        bias = layers.create_parameter(
+            shape=[vocab_size], dtype=logits.dtype, name=prefix + ".head.b",
+            is_bias=True)
+        return layers.elementwise_add(logits, bias)
+    return layers.fc(
+        x, vocab_size, num_flatten_dims=1,
+        param_attr=ParamAttr(name=prefix + ".head.w",
+                             initializer=NormalInitializer(0.0, 0.02)),
+        bias_attr=ParamAttr(name=prefix + ".head.b"))
+
+
+def transformer_lm_prefill(
+    tokens, lengths, vocab_size, n_layer=4, n_head=8, d_model=512,
+    d_inner=2048, max_len=2048, tie_embeddings=False, prefix="lm",
+):
+    """Prefill graph: run the full causal forward over padded prompts
+    ``tokens`` (B, S) with ``lengths`` (B,) valid tokens, POPULATING the
+    KV slabs as a side product of the flash-attention forward.
+
+    Returns (last_logits, caches): last_logits (B, V) is the vocab
+    projection of each row's final valid position (the hidden state is
+    gathered BEFORE the head, so the (B, S, V) logits tensor never
+    materializes), caches is [(k_0, v_0), ...] per layer in the
+    (B, S, H, Dh) slab layout. Positions past a row's length hold
+    garbage K/V — decode_attention masks them by length, so they are
+    never read."""
+    x = _embed(tokens, vocab_size, d_model, max_len, prefix)
+    B, S = tokens.shape
+    caches = []
+    for i in range(n_layer):
+        h = _pre_norm(x)
+        attn, k, v = _cached_self_attention(
+            h, n_head, d_model, "%s.l%d.self" % (prefix, i))
+        caches.append((k, v))
+        x = layers.elementwise_add(x, attn)
+        ffn = positionwise_ffn(_pre_norm(x), d_inner, d_model, 0.0,
+                               name="%s.l%d.ffn" % (prefix, i))
+        x = layers.elementwise_add(x, ffn)
+    x = _pre_norm(x)
+    # gather each row's LAST VALID hidden state: flat row index
+    # b*S + (lengths[b] - 1)
+    flat = layers.reshape(x, shape=[B * S, d_model])
+    base = layers.assign(
+        (np.arange(B, dtype=np.int32) * S - 1).reshape(B))
+    idx = layers.elementwise_add(layers.cast(lengths, "int32"), base)
+    last = layers.gather(flat, idx)  # (B, D)
+    return _lm_head_logits(last, vocab_size, tie_embeddings, prefix), caches
+
+
+def transformer_lm_decode(
+    tokens, positions, lengths, k_caches, v_caches, vocab_size,
+    n_layer=4, n_head=8, d_model=512, d_inner=2048, max_len=2048,
+    tie_embeddings=False, prefix="lm", strategy="greedy", seed=None,
+    sample_k=40, sample_p=0.9, temperature=1.0,
+):
+    """One incremental decode step: ``tokens`` (B, 1) int64 (the
+    previously sampled token per slot), ``positions`` (B, 1) int64 (its
+    sequence position = the slot's pre-append length), ``lengths`` (B,)
+    int32 valid cache rows BEFORE this step, and per-layer K/V slabs
+    (B, S, H, Dh).
+
+    Each layer appends its fresh K/V row at ``lengths`` and runs
+    single-query decode_attention over lengths+1 valid rows. Returns
+    (next_ids, logits, new_caches): next_ids (B,) int64 per
+    ``strategy`` ("greedy" | "topk" | "topp" | "logits" — the last
+    skips sampling for host-side beam search), logits (B, V), and the
+    updated slabs to thread into the next step (donated in place on
+    TPU)."""
+    B = tokens.shape[0]
+    # embedding squeezes the trailing ids dim of 1 (LoD convention):
+    # (B, 1) ids -> (B, D); restore the singleton time axis explicitly
+    tok = layers.embedding(
+        input=tokens, size=[vocab_size, d_model],
+        param_attr=ParamAttr(name=prefix + ".tok_emb",
+                             initializer=NormalInitializer(0.0, 0.02)))
+    pos = layers.embedding(
+        input=positions, size=[max_len, d_model],
+        param_attr=ParamAttr(name=prefix + ".pos_emb",
+                             initializer=NormalInitializer(0.0, 0.02)))
+    x = layers.reshape(layers.elementwise_add(tok, pos),
+                       shape=[B, 1, d_model])
+    kv_lengths = layers.elementwise_add(
+        layers.cast(lengths, "int32"),
+        layers.fill_constant(shape=[B], dtype="int32", value=1))
+    new_caches = []
+    for i in range(n_layer):
+        h = _pre_norm(x)
+        attn, nk, nv = _cached_self_attention(
+            h, n_head, d_model, "%s.l%d.self" % (prefix, i),
+            k_cache=k_caches[i], v_cache=v_caches[i], lengths=lengths,
+            kv_lengths=kv_lengths)
+        new_caches.append((nk, nv))
+        x = layers.elementwise_add(x, attn)
+        ffn = positionwise_ffn(_pre_norm(x), d_inner, d_model, 0.0,
+                               name="%s.l%d.ffn" % (prefix, i))
+        x = layers.elementwise_add(x, ffn)
+    x = _pre_norm(x)
+    last = layers.reshape(x, shape=[B, d_model])
+    logits = _lm_head_logits(last, vocab_size, tie_embeddings, prefix)
+    if strategy == "greedy":
+        next_ids = layers.greedy_sample(logits)
+    elif strategy == "topk":
+        next_ids = layers.top_k_sample(logits, seed=seed, k=sample_k,
+                                       temperature=temperature)
+    elif strategy == "topp":
+        next_ids = layers.top_p_sample(logits, seed=seed, p=sample_p,
+                                       temperature=temperature)
+    elif strategy == "logits":
+        next_ids = None
+    else:
+        raise ValueError("unknown decode strategy %r (greedy | topk | "
+                         "topp | logits)" % (strategy,))
+    return next_ids, logits, new_caches
+
+
 def get_model(
     batch_size=16, seq_len=64, src_vocab_size=10000, tgt_vocab_size=10000,
     n_layer=2, n_head=8, d_model=512, d_inner=2048, dropout_rate=0.1,

@@ -45,6 +45,7 @@ __all__ = [
     "ANALYSIS_COVERAGE", "set_replica", "process_labels",
     "FLEET_WORKERS", "FLEET_OUTSTANDING", "FLEET_DISPATCHES",
     "FLEET_REQUEUED", "FLEET_MISVERSIONED", "FLEET_BACKPRESSURE_MS",
+    "DECODE_TOKENS", "DECODE_SLOTS", "DECODE_STEP_MS", "DECODE_REQUESTS",
 ]
 
 # -- the shared instrument set (registered once, process-wide) -----------
@@ -198,6 +199,23 @@ FLEET_BACKPRESSURE_MS = REGISTRY.counter(
     "Router dispatch time blocked because every routable replica was at "
     "max_outstanding (rivaling wall time = add replicas or raise the "
     "window)")
+DECODE_TOKENS = REGISTRY.counter(
+    "paddle_tpu_decode_tokens_total",
+    "Tokens generated by the KV-cache decode path, by kind=prefill "
+    "(prompt tokens absorbed) | decode (sampled tokens)")
+DECODE_SLOTS = REGISTRY.gauge(
+    "paddle_tpu_decode_slots",
+    "Continuous-batching cache-slot occupancy, state=active|free "
+    "(active at the slot cap with a non-empty admission queue = grow "
+    "slots or add replicas)")
+DECODE_STEP_MS = REGISTRY.histogram(
+    "paddle_tpu_decode_step_ms",
+    "Wall time per decode iteration, stage=prefill (one admission "
+    "sub-batch) | step (one token across every active slot)")
+DECODE_REQUESTS = REGISTRY.counter(
+    "paddle_tpu_decode_requests_total",
+    "Decode-serving sequences, kind=admitted (entered a cache slot) | "
+    "retired (finished and freed it); admitted - retired = in flight")
 PROFILER_EVENT_MS = REGISTRY.summary(
     "paddle_tpu_profiler_event_ms",
     "Legacy profiler event table (exact count/sum/min/max per event)")

@@ -488,18 +488,13 @@ def _hsigmoid(ctx):
 # ---------------------------------------------------------------------------
 
 
-@register_op("beam_search")
-def _beam_search(ctx):
-    """One decode step: (B, K) beams x (B, K, V) accumulated scores ->
-    top-K continuations. Finished beams (pre_id == end_id) only propose
-    end_id, keeping their score (beam_search_op.cc semantics). Dense
-    replacement for the reference's LoD-based candidate selection."""
-    pre_ids = ctx.input("pre_ids")  # (B, K)
-    pre_scores = ctx.input("pre_scores")  # (B, K)
-    scores = ctx.input("scores")  # (B, K, V) accumulated log-probs
-    ids = ctx.input("ids")  # (B, K, V) candidate ids or None -> arange
-    beam_size = int(ctx.attr("beam_size"))
-    end_id = int(ctx.attr("end_id"))
+def beam_search_step(pre_ids, pre_scores, scores, ids, beam_size, end_id):
+    """One pure beam-search step (the ``beam_search`` op's math, exposed
+    for host-driven decode loops — serving/decode.py's beam strategy
+    calls this eagerly between compiled decode steps): (B, K) beams x
+    (B, K, V) ACCUMULATED scores -> (sel_ids, sel_scores, parents), each
+    (B, beam_size). Finished beams (pre_id == end_id) only propose
+    end_id, keeping their score (beam_search_op.cc semantics)."""
     if pre_ids.ndim == 3:
         pre_ids = pre_ids[..., 0]
     if pre_scores.ndim == 3:
@@ -521,18 +516,29 @@ def _beam_search(ctx):
     else:
         sel_ids = jnp.take_along_axis(
             ids.reshape(b, k * v).astype(jnp.int32), top_idx, axis=1)
+    return sel_ids, top_scores, parent
+
+
+@register_op("beam_search")
+def _beam_search(ctx):
+    """One decode step: (B, K) beams x (B, K, V) accumulated scores ->
+    top-K continuations (math: beam_search_step). Dense replacement for
+    the reference's LoD-based candidate selection."""
+    sel_ids, top_scores, parent = beam_search_step(
+        ctx.input("pre_ids"), ctx.input("pre_scores"),
+        ctx.input("scores"), ctx.input("ids"),
+        int(ctx.attr("beam_size")), int(ctx.attr("end_id")))
     return {"selected_ids": sel_ids, "selected_scores": top_scores,
             "parent_idx": parent}
 
 
-@register_op("beam_search_decode")
-def _beam_search_decode(ctx):
-    """Backtrack stacked per-step selections (S, B, K) through parent
-    pointers to full sentences (B, K, S) + lengths (first end_id wins)."""
-    ids = ctx.input("Ids").astype(jnp.int32)  # (S, B, K)
-    parents = ctx.input("ParentIdx").astype(jnp.int32)  # (S, B, K)
-    scores = ctx.input("Scores")  # (S, B, K) or None
-    end_id = int(ctx.attr("end_id"))
+def beam_search_backtrack(ids, parents, end_id):
+    """Pure backtrack (the ``beam_search_decode`` op's math, shared with
+    host-driven decode loops): stacked per-step selections (S, B, K) +
+    parent pointers -> (sentences (B, K, S), lengths (B, K), first
+    end_id inclusive)."""
+    ids = ids.astype(jnp.int32)
+    parents = parents.astype(jnp.int32)
     s, b, k = ids.shape
 
     beam0 = jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32)[None, :], (b, k))
@@ -549,6 +555,18 @@ def _beam_search_decode(ctx):
     first_end = jnp.argmax(ended, axis=2)  # 0 if none
     any_end = jnp.any(ended, axis=2)
     lengths = jnp.where(any_end, first_end + 1, s).astype(jnp.int32)
+    return sent, lengths
+
+
+@register_op("beam_search_decode")
+def _beam_search_decode(ctx):
+    """Backtrack stacked per-step selections (S, B, K) through parent
+    pointers to full sentences (B, K, S) + lengths (first end_id wins;
+    math: beam_search_backtrack)."""
+    scores = ctx.input("Scores")  # (S, B, K) or None
+    sent, lengths = beam_search_backtrack(
+        ctx.input("Ids"), ctx.input("ParentIdx"),
+        int(ctx.attr("end_id")))
     out = {"SentenceIds": sent, "SentenceLengths": lengths}
     if scores is not None:
         out["SentenceScores"] = scores[-1]

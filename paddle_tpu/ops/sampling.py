@@ -1,0 +1,109 @@
+"""Token sampling kernels for the decode serving path.
+
+Greedy, top-k, and top-p (nucleus) sampling over a (B, V) logits row —
+the last op of a compiled decode step (serving/decode.py), so the
+sampled ids come off the device as (B,) int64 and the full logits
+tensor never crosses the host boundary.
+
+Randomness contract: a decode step executable runs MANY times, but the
+tracer's RNG stream is fixed at trace time — ``ctx.rng()`` would
+produce the same bits every step. Stochastic sampling therefore takes
+an explicit ``Seed`` input (any int tensor; the first element is used):
+the caller feeds a fresh per-step seed and the key derives inside the
+compiled program (``jax.random.PRNGKey`` accepts traced ints). Rows
+sample independently (jax.random.categorical is batched over leading
+axes).
+
+All three ops accept logits as (B, V) or (B, 1, V) (the decode step's
+natural head output) and flatten the singleton.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+_NEG = -1e30
+
+
+def _flat_logits(x):
+    if x.ndim == 3:
+        x = x[:, 0] if x.shape[1] == 1 else x.reshape(-1, x.shape[-1])
+    return x
+
+
+def _key_from_seed(seed):
+    return jax.random.PRNGKey(seed.reshape(-1)[0].astype(jnp.int32))
+
+
+def greedy_sample(logits):
+    """argmax over the vocab axis -> (B,) int64."""
+    return jnp.argmax(_flat_logits(logits), axis=-1).astype(jnp.int64)
+
+
+def top_k_sample(logits, seed, k, temperature=1.0):
+    """Sample from the renormalized top-k slice of each row.
+
+    k=1 degenerates to greedy (the categorical over one candidate);
+    temperature rescales logits BEFORE the cut, like every serving stack
+    does, so k and temperature compose predictably.
+    """
+    logits = _flat_logits(logits)
+    b, v = logits.shape
+    k = max(1, min(int(k), v))
+    scaled = logits / jnp.maximum(jnp.float32(temperature), 1e-6)
+    top, idx = lax.top_k(scaled, k)                       # (B, k) each
+    choice = jax.random.categorical(_key_from_seed(seed), top, axis=-1)
+    return jnp.take_along_axis(
+        idx, choice[:, None], axis=1)[:, 0].astype(jnp.int64)
+
+
+def top_p_sample(logits, seed, p, temperature=1.0):
+    """Nucleus sampling: keep the smallest descending-probability prefix
+    whose mass reaches ``p`` (the first token is always kept, so p -> 0
+    degenerates to greedy), renormalize, sample."""
+    logits = _flat_logits(logits)
+    scaled = logits / jnp.maximum(jnp.float32(temperature), 1e-6)
+    sort_idx = jnp.argsort(-scaled, axis=-1)
+    sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # token i is kept while the mass BEFORE it is < p (first always kept)
+    keep = (cum - probs) < jnp.float32(p)
+    masked = jnp.where(keep, sorted_logits, _NEG)
+    choice = jax.random.categorical(_key_from_seed(seed), masked, axis=-1)
+    return jnp.take_along_axis(
+        sort_idx, choice[:, None], axis=1)[:, 0].astype(jnp.int64)
+
+
+@register_op("greedy_sample")
+def _greedy_sample_op(ctx):
+    """Inputs Logits (B, V) or (B, 1, V) -> Out (B,) int64 argmax ids."""
+    return {"Out": greedy_sample(ctx.input("Logits"))}
+
+
+@register_op("top_k_sample")
+def _top_k_sample_op(ctx):
+    """Inputs Logits (B, V) or (B, 1, V), Seed (int tensor, first element
+    used; omitted -> the trace-time RNG stream, fixed per executable);
+    attrs k, temperature -> Out (B,) int64 sampled ids."""
+    logits = ctx.input("Logits")
+    seed = ctx.input("Seed")
+    if seed is None:
+        seed = jax.random.key_data(ctx.rng()).astype(jnp.uint32)
+    return {"Out": top_k_sample(logits, seed, int(ctx.attr("k", 40)),
+                                float(ctx.attr("temperature", 1.0) or 1.0))}
+
+
+@register_op("top_p_sample")
+def _top_p_sample_op(ctx):
+    """Inputs Logits (B, V) or (B, 1, V), Seed (see top_k_sample); attrs
+    p, temperature -> Out (B,) int64 sampled ids."""
+    logits = ctx.input("Logits")
+    seed = ctx.input("Seed")
+    if seed is None:
+        seed = jax.random.key_data(ctx.rng()).astype(jnp.uint32)
+    return {"Out": top_p_sample(logits, seed, float(ctx.attr("p", 0.9)),
+                                float(ctx.attr("temperature", 1.0) or 1.0))}

@@ -33,20 +33,27 @@ from __future__ import annotations
 
 from .engine import Engine  # noqa: F401
 
-__all__ = ["Engine", "Router", "ShardedPredictor", "worker_main"]
+__all__ = ["Engine", "Router", "ShardedPredictor", "worker_main",
+           "DecodeConfig", "DecodePredictor", "DecodeServer",
+           "save_decode_model"]
+
+_LAZY = {
+    "Router": ("router", "Router"),
+    "ShardedPredictor": ("sharded", "ShardedPredictor"),
+    "worker_main": ("worker", "worker_main"),
+    "DecodeConfig": ("decode", "DecodeConfig"),
+    "DecodePredictor": ("decode", "DecodePredictor"),
+    "DecodeServer": ("decode", "DecodeServer"),
+    "save_decode_model": ("decode", "save_decode_model"),
+}
 
 
-def __getattr__(name):  # PEP 562: lazy, cycle-free router/sharded exports
-    if name == "Router":
-        from .router import Router
+def __getattr__(name):  # PEP 562: lazy, cycle-free heavy exports
+    entry = _LAZY.get(name)
+    if entry is None:
+        raise AttributeError("module %r has no attribute %r"
+                             % (__name__, name))
+    import importlib
 
-        return Router
-    if name == "ShardedPredictor":
-        from .sharded import ShardedPredictor
-
-        return ShardedPredictor
-    if name == "worker_main":
-        from .worker import worker_main
-
-        return worker_main
-    raise AttributeError("module %r has no attribute %r" % (__name__, name))
+    module = importlib.import_module("." + entry[0], __name__)
+    return getattr(module, entry[1])

@@ -1,0 +1,953 @@
+"""KV-cache autoregressive decode serving: DecodePredictor + DecodeServer.
+
+Serving an LM before this module meant full forward passes: generating N
+tokens re-ran the whole prefix N times — O(T^2) work the training-side
+flash attention cannot hide. This module is the incremental path:
+
+- ``save_decode_model`` exports a trained ``models.transformer.
+  transformer_lm`` scope as a decode-servable directory: the canonical
+  prefill graph goes through ``save_inference_model`` (so the plain
+  ``Predictor`` can still serve it), plus a ``__decode__.json`` manifest
+  with the architecture config the decode-side builders need.
+
+- ``DecodePredictor`` loads that directory and compiles TWO kinds of
+  executables through the shared PR-8 ``Engine`` (both land in the PR-5
+  AOT disk cache next to the model): a PREFILL step (the existing
+  flash-attention forward over the padded prompt, emitting last-position
+  logits plus per-layer K/V slabs) and a per-token DECODE step
+  (single-query ``decode_attention`` against the slabs, ``cache_append``
+  of the fresh K/V row, and in-graph greedy/top-k/top-p sampling so only
+  token ids cross the host boundary). Shapes are static: batch and slab
+  length bucket to powers of two (the PR-2 batch-bucket trick applied to
+  the sequence axis), so the executable count stays bounded at
+  O(log B x log S) per strategy.
+
+- ``DecodeServer`` is the continuous-batching serving loop (Orca-style
+  iteration-level scheduling): requests enter the same C++ bounded
+  channel as every other server, but instead of padding whole batches,
+  new requests are admitted into FREE CACHE SLOTS between decode steps
+  (prefilled as a power-of-two sub-batch, scattered into the resident
+  slab) and finished sequences retire eagerly, freeing their slot
+  mid-flight. One compiled decode signature — (slots, S) — serves the
+  whole lifetime of the server. ``continuous=False`` degrades to static
+  batching (admit a batch, run it to completion) for A/B measurement.
+
+The fleet path reuses all of it: ``serving.worker`` builds a
+DecodeServer when the Router is constructed with ``decode=True``, and
+the zero-drop drain/restart contract extends to in-flight decode
+sequences (``stop()`` finishes every admitted generation and admits
+everything still queued before exiting).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import observability as obs
+from ..runtime import aot_cache as _aot
+from ..runtime import recordio as _rio
+
+__all__ = ["DecodeConfig", "save_decode_model", "DecodePredictor",
+           "DecodeServer"]
+
+_DECODE_MANIFEST = "__decode__.json"
+_AOT_DIR = "__aot_cache__"
+
+
+def _pow2_bucket(n: int, floor: int = 1) -> int:
+    b = max(int(floor), 1)
+    while b < n:
+        b *= 2
+    return b
+
+
+class DecodeConfig:
+    """Architecture manifest for the decode-side graph builders — the
+    arguments ``models.transformer.transformer_lm`` was trained with.
+    Everything else (batch, slab length, strategy) is a serving-time
+    choice and deliberately NOT part of the manifest."""
+
+    FIELDS = ("vocab_size", "n_layer", "n_head", "d_model", "d_inner",
+              "max_len", "tie_embeddings", "prefix", "eos_id")
+
+    def __init__(self, vocab_size, n_layer=4, n_head=8, d_model=512,
+                 d_inner=2048, max_len=2048, tie_embeddings=False,
+                 prefix="lm", eos_id=None):
+        self.vocab_size = int(vocab_size)
+        self.n_layer = int(n_layer)
+        self.n_head = int(n_head)
+        self.d_model = int(d_model)
+        self.d_inner = int(d_inner)
+        self.max_len = int(max_len)
+        self.tie_embeddings = bool(tie_embeddings)
+        self.prefix = str(prefix)
+        self.eos_id = None if eos_id is None else int(eos_id)
+
+    @property
+    def d_head(self) -> int:
+        return self.d_model // self.n_head
+
+    def to_dict(self) -> Dict:
+        return {f: getattr(self, f) for f in self.FIELDS}
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "DecodeConfig":
+        return cls(**{f: d[f] for f in cls.FIELDS if f in d})
+
+
+def save_decode_model(dirname: str, config: DecodeConfig, executor,
+                      scope=None, export_batch: int = 1,
+                      export_seq: Optional[int] = None) -> None:
+    """Export a trained transformer_lm scope for decode serving.
+
+    Builds the canonical prefill graph (full flash-attention forward,
+    last-position logits as the fetch target) and writes it through
+    ``save_inference_model`` — the directory stays loadable by the plain
+    ``Predictor`` — plus the ``__decode__.json`` manifest. Parameters
+    come from ``scope`` (or the current global scope), exactly as
+    ``save_inference_model`` resolves them; a parameter the decode
+    builders expect but the scope lacks fails HERE, not at first
+    request."""
+    from .. import Program, io as fluid_io, program_guard, unique_name
+    from ..models import transformer as _T
+
+    export_seq = int(export_seq or min(config.max_len, 128))
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        with unique_name.guard():
+            from .. import layers
+
+            tokens = layers.data(name="tokens",
+                                 shape=[export_batch, export_seq],
+                                 dtype="int64", append_batch_size=False)
+            lengths = layers.data(name="lengths", shape=[export_batch],
+                                  dtype="int32", append_batch_size=False)
+            last_logits, _caches = _T.transformer_lm_prefill(
+                tokens, lengths, config.vocab_size,
+                n_layer=config.n_layer, n_head=config.n_head,
+                d_model=config.d_model, d_inner=config.d_inner,
+                max_len=config.max_len,
+                tie_embeddings=config.tie_embeddings,
+                prefix=config.prefix)
+    fluid_io.save_inference_model(
+        dirname, ["tokens", "lengths"], [last_logits], executor,
+        main_program=prog, scope=scope)
+    with open(os.path.join(dirname, _DECODE_MANIFEST), "w") as f:
+        json.dump(config.to_dict(), f, indent=2, sort_keys=True)
+
+
+class DecodePredictor:
+    """Incremental-decode predictor over an exported decode model.
+
+    pred = DecodePredictor(model_dir)
+    outs = pred.generate([np.array([5, 3, 9])], max_new_tokens=16)
+
+    Compiled executables are acquired through the shared ``Engine``
+    (kind="prefill" | "decode") and persist in the model directory's AOT
+    disk cache — a fresh process warm-starts every bucket it has served
+    before. ``generate`` is the static-batch surface (one call, one
+    bucketed batch, run to completion); ``DecodeServer`` drives the same
+    executables with continuous batching.
+    """
+
+    def __init__(self, model_dir: str, place=None, aot_cache: bool = True,
+                 cache_dir: Optional[str] = None, strategy: str = "greedy",
+                 sample_k: int = 40, sample_p: float = 0.9,
+                 temperature: float = 1.0, eos_id: Optional[int] = None):
+        from .. import io as fluid_io
+        from ..executor import Executor, analyze_state
+        from ..framework.scope import Scope
+
+        with open(os.path.join(model_dir, _DECODE_MANIFEST)) as f:
+            self.config = DecodeConfig.from_dict(json.load(f))
+        self.model_dir = model_dir
+        self.strategy = strategy
+        self.sample_k = int(sample_k)
+        self.sample_p = float(sample_p)
+        self.temperature = float(temperature)
+        self.eos_id = eos_id if eos_id is not None else self.config.eos_id
+        self._scope = Scope()
+        exe = Executor(place)
+        if not aot_cache:
+            exe._disk.enabled = False
+        # the canonical prefill program: parameter loading + the stable
+        # model fingerprint the fleet's sticky version routing keys on
+        self._program, self._feed_names, _fetch = (
+            fluid_io.load_inference_model(model_dir, exe,
+                                          scope=self._scope))
+        self._disk = _aot.AotDiskCache(
+            cache_dir=cache_dir or os.path.join(model_dir, _AOT_DIR),
+            enabled=aot_cache)
+        _aot.maybe_enable_jax_cache()
+        state_in, _ = analyze_state(self._program, set(self._feed_names))
+        dev = jax.devices()[0]
+        self._state = {}
+        for n in state_in:
+            val = self._scope.find_var(n)
+            if val is None:
+                raise RuntimeError(
+                    "decode model is missing persistable %r" % n)
+            self._state[n] = jax.device_put(np.asarray(val), dev)
+        self._compiled: Dict = {}
+        self._lock = threading.Lock()
+        self.traces = 0
+
+    def fingerprint(self) -> str:
+        """Stable model identity (program content fingerprint of the
+        canonical prefill graph) — the fleet's program version."""
+        return obs.program_fp(self._program)
+
+    # -- graph building ---------------------------------------------------
+    def _build(self, kind: str, batch: int, seq: int, strategy: str):
+        """Build the (batch, seq) prefill or decode Program; returns
+        (program, feed_names, fetch_names). Deterministic for given
+        arguments, so the program content fingerprint (and with it the
+        AOT key) is stable across processes."""
+        from .. import Program, layers, program_guard, unique_name
+        from ..models import transformer as _T
+
+        cfg = self.config
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup):
+            with unique_name.guard():
+                if kind == "prefill":
+                    tokens = layers.data(name="tokens", shape=[batch, seq],
+                                         dtype="int64",
+                                         append_batch_size=False)
+                    lengths = layers.data(name="lengths", shape=[batch],
+                                          dtype="int32",
+                                          append_batch_size=False)
+                    logits, caches = _T.transformer_lm_prefill(
+                        tokens, lengths, cfg.vocab_size,
+                        n_layer=cfg.n_layer, n_head=cfg.n_head,
+                        d_model=cfg.d_model, d_inner=cfg.d_inner,
+                        max_len=cfg.max_len,
+                        tie_embeddings=cfg.tie_embeddings,
+                        prefix=cfg.prefix)
+                    feeds = ["tokens", "lengths"]
+                    fetches = [logits.name] + [
+                        c.name for pair in caches for c in pair]
+                else:
+                    tokens = layers.data(name="tokens", shape=[batch, 1],
+                                         dtype="int64",
+                                         append_batch_size=False)
+                    positions = layers.data(name="positions",
+                                            shape=[batch, 1], dtype="int64",
+                                            append_batch_size=False)
+                    lengths = layers.data(name="lengths", shape=[batch],
+                                          dtype="int32",
+                                          append_batch_size=False)
+                    seed = layers.data(name="seed", shape=[1],
+                                       dtype="int64",
+                                       append_batch_size=False)
+                    kc, vc = [], []
+                    for i in range(cfg.n_layer):
+                        kc.append(layers.data(
+                            name="kcache_%d" % i,
+                            shape=[batch, seq, cfg.n_head, cfg.d_head],
+                            dtype="float32", append_batch_size=False))
+                        vc.append(layers.data(
+                            name="vcache_%d" % i,
+                            shape=[batch, seq, cfg.n_head, cfg.d_head],
+                            dtype="float32", append_batch_size=False))
+                    next_ids, logits, ncaches = _T.transformer_lm_decode(
+                        tokens, positions, lengths, kc, vc, cfg.vocab_size,
+                        n_layer=cfg.n_layer, n_head=cfg.n_head,
+                        d_model=cfg.d_model, d_inner=cfg.d_inner,
+                        max_len=cfg.max_len,
+                        tie_embeddings=cfg.tie_embeddings,
+                        prefix=cfg.prefix, strategy=strategy, seed=seed,
+                        sample_k=self.sample_k, sample_p=self.sample_p,
+                        temperature=self.temperature)
+                    feeds = (["tokens", "positions", "lengths", "seed"]
+                             + [v.name for v in kc]
+                             + [v.name for v in vc])
+                    fetches = [logits.name] + [
+                        c.name for pair in ncaches for c in pair]
+                    if next_ids is not None:
+                        fetches = [next_ids.name] + fetches
+        return prog, feeds, fetches
+
+    # -- compilation ------------------------------------------------------
+    def _feed_structs(self, program, feed_names):
+        from ..framework.dtypes import as_numpy_dtype
+
+        structs = {}
+        for name in feed_names:
+            var = program.global_block().var(name)
+            structs[name] = jax.ShapeDtypeStruct(
+                tuple(var.shape), np.dtype(as_numpy_dtype(var.dtype)))
+        return structs
+
+    def acquire(self, kind: str, batch: int, seq: int,
+                strategy: Optional[str] = None):
+        """Executable for one (kind, batch, seq, strategy) signature:
+        memory hit, else the shared Engine's disk-load-or-compile path.
+        Returns (executable, fetch_names)."""
+        strategy = strategy or self.strategy
+        ck = (kind, batch, seq, strategy if kind == "decode" else "")
+        with self._lock:
+            hit = self._compiled.get(ck)
+        if hit is not None:
+            obs.CACHE_HITS.inc(kind=kind, tier="memory",
+                               program=self.fingerprint())
+            return hit
+        from .engine import Engine
+        from ..framework.trace import RngStream, trace_block
+
+        program, feed_names, fetch_names = self._build(
+            kind, batch, seq, strategy)
+        engine = Engine(program, disk=self._disk, feed_names=feed_names,
+                        fetch_names=fetch_names)
+        feed_structs = self._feed_structs(program, feed_names)
+        feed_sig = tuple((n, tuple(s.shape), str(np.dtype(s.dtype)))
+                         for n, s in sorted(feed_structs.items()))
+        key = engine.key(kind, feed_sig, tuple(fetch_names))
+
+        def step_fn(feeds, state):
+            self.traces += 1
+            env = dict(state)
+            env.update(feeds)
+            rng = RngStream(jax.random.PRNGKey(0))
+            trace_block(program.global_block(), env, rng)
+            return tuple(env[n] for n in fetch_names)
+
+        def lower():
+            # donate the feeds (the KV slabs dominate them) so XLA
+            # appends cache rows IN PLACE on device backends; CPU
+            # ignores donation with a warning, so keep it off there
+            donate = ()
+            try:
+                if jax.default_backend() not in ("cpu",):
+                    donate = (0,)
+            except Exception:  # pragma: no cover
+                pass
+            fn = jax.jit(step_fn, donate_argnums=donate)
+            state_structs = {n: jax.ShapeDtypeStruct(a.shape, a.dtype)
+                             for n, a in self._state.items()}
+            return fn.lower(feed_structs, state_structs)
+
+        loaded, path, timings = engine.acquire(
+            kind, key, lower,
+            meta=engine.meta(kind, feed_sig, tuple(fetch_names)))
+        if path == "cold":
+            obs.COMPILE_TOTAL.inc(kind=kind)
+            obs.COMPILE_LATENCY_MS.observe(
+                timings["trace_ms"] + timings["xla_ms"], kind=kind)
+        with self._lock:
+            self._compiled[ck] = (loaded, fetch_names)
+        return loaded, fetch_names
+
+    # -- host-side sampling (first token, from prefill logits) ------------
+    def _sample_host(self, logits, strategy: str, seed: int):
+        from ..ops import sampling as _S
+
+        if strategy in ("greedy", "logits", "beam"):
+            return np.asarray(_S.greedy_sample(logits))
+        seed_arr = jnp.asarray([seed], jnp.int32)
+        if strategy == "topk":
+            return np.asarray(_S.top_k_sample(
+                logits, seed_arr, self.sample_k, self.temperature))
+        if strategy == "topp":
+            return np.asarray(_S.top_p_sample(
+                logits, seed_arr, self.sample_p, self.temperature))
+        raise ValueError("unknown decode strategy %r" % strategy)
+
+    def _bucketed(self, prompts: Sequence[np.ndarray], max_new: int,
+                  batch_floor: int = 1, seq: Optional[int] = None):
+        """Pad a prompt list into bucketed (tokens, lengths) arrays.
+        Pad rows (beyond the real batch) carry one dummy token so the
+        prefill's last-position gather stays in range."""
+        b = len(prompts)
+        plens = [int(len(p)) for p in prompts]
+        if min(plens) < 1:
+            raise ValueError("empty prompt (decode needs >= 1 token)")
+        need = max(plens) + max_new
+        if need > self.config.max_len:
+            raise ValueError(
+                "prompt %d + max_new_tokens %d exceeds the model's "
+                "max_len %d" % (max(plens), max_new, self.config.max_len))
+        s = seq if seq is not None else _pow2_bucket(need, floor=16)
+        s = min(s, _pow2_bucket(self.config.max_len))
+        if s > self.config.max_len:
+            s = self.config.max_len  # max_len itself may not be pow2
+        bb = _pow2_bucket(b, floor=batch_floor)
+        tokens = np.zeros((bb, s), np.int64)
+        lens = np.ones((bb,), np.int32)
+        for i, p in enumerate(prompts):
+            tokens[i, :plens[i]] = np.asarray(p, np.int64).reshape(-1)
+            lens[i] = plens[i]
+        return tokens, lens, b, s
+
+    def _prefill(self, tokens, lens, slab_seq):
+        """Run prefill at the PROMPTS' own pow2 sequence bucket, then
+        zero-pad the returned K/V rows out to the slab length — prompt
+        cost scales with the prompt, not with the decode budget."""
+        bb = tokens.shape[0]
+        sp = min(_pow2_bucket(int(lens.max()), floor=16), slab_seq)
+        pexe, _ = self.acquire("prefill", bb, sp)
+        t0 = time.perf_counter()
+        outs = pexe({"tokens": tokens[:, :sp], "lengths": lens},
+                    self._state)
+        obs.DECODE_STEP_MS.observe((time.perf_counter() - t0) * 1e3,
+                                   stage="prefill")
+        caches = list(outs[1:])
+        if sp < slab_seq:
+            pad = [(0, 0), (0, slab_seq - sp), (0, 0), (0, 0)]
+            caches = [jnp.pad(jnp.asarray(c), pad) for c in caches]
+        return outs, caches
+
+    # -- generation (static batch, run to completion) ----------------------
+    def generate(self, prompts: Sequence[np.ndarray],
+                 max_new_tokens: int = 32, strategy: Optional[str] = None,
+                 seed: int = 0, eos_id: Optional[int] = None,
+                 beam_size: int = 4) -> List[np.ndarray]:
+        """Generate up to ``max_new_tokens`` per prompt (stopping a row
+        early at ``eos_id``). Returns one int64 array of generated ids
+        per prompt. ``strategy`` overrides the constructor's
+        ("greedy" | "topk" | "topp" | "beam")."""
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1, got %d"
+                             % max_new_tokens)
+        strategy = strategy or self.strategy
+        eos = eos_id if eos_id is not None else self.eos_id
+        if strategy == "beam":
+            return self.generate_beam(prompts, max_new_tokens,
+                                      beam_size=beam_size, eos_id=eos)
+        if strategy not in ("greedy", "topk", "topp"):
+            # "logits" builds a sampler-less step whose fetch layout
+            # (no next_ids) this loop cannot drive — it is the
+            # generate_beam/acquire surface, not a generate strategy
+            raise ValueError(
+                "unknown decode strategy %r (greedy | topk | topp | "
+                "beam)" % (strategy,))
+        tokens, lens, b, s = self._bucketed(prompts, max_new_tokens)
+        bb = tokens.shape[0]
+        outs, caches = self._prefill(tokens, lens, s)
+        obs.DECODE_TOKENS.inc(int(lens[:b].sum()), kind="prefill")
+        cur = self._sample_host(outs[0], strategy, seed)
+        generated = [[int(cur[i])] for i in range(b)]
+        finished = np.array([eos is not None and int(cur[i]) == eos
+                             for i in range(b)])
+        obs.DECODE_TOKENS.inc(b, kind="decode")
+        if max_new_tokens > 1 and not finished.all():
+            dexe, fetch_names = self.acquire("decode", bb, s, strategy)
+            lens = lens.copy()
+            for step in range(1, max_new_tokens):
+                feeds = {"tokens": cur.reshape(bb, 1).astype(np.int64),
+                         "positions": lens.reshape(bb, 1).astype(np.int64),
+                         "lengths": lens,
+                         "seed": np.array([seed + step], np.int64)}
+                for i in range(self.config.n_layer):
+                    feeds["kcache_%d" % i] = caches[2 * i]
+                    feeds["vcache_%d" % i] = caches[2 * i + 1]
+                t0 = time.perf_counter()
+                outs = dexe(feeds, self._state)
+                obs.DECODE_STEP_MS.observe(
+                    (time.perf_counter() - t0) * 1e3, stage="step")
+                cur = np.asarray(outs[0]).astype(np.int64)
+                caches = list(outs[2:])
+                lens = lens + 1
+                live = 0
+                for i in range(b):
+                    if finished[i]:
+                        continue
+                    generated[i].append(int(cur[i]))
+                    live += 1
+                    if eos is not None and int(cur[i]) == eos:
+                        finished[i] = True
+                obs.DECODE_TOKENS.inc(live, kind="decode")
+                if finished.all():
+                    break
+        return [np.asarray(g, np.int64) for g in generated]
+
+    # -- beam-search strategy (ops-layer beam step between decode execs) ---
+    def generate_beam(self, prompts: Sequence[np.ndarray],
+                      max_new_tokens: int = 32, beam_size: int = 4,
+                      eos_id: Optional[int] = None,
+                      return_all: bool = False):
+        """Beam-search decode: the compiled decode step runs with
+        strategy="logits" (no sampler) and the ops-layer
+        ``beam_search_step`` / ``beam_search_backtrack`` kernels
+        (ops/decode.py — the same math contrib's BeamSearchDecoder scans
+        with) pick continuations and reorder the KV slabs by parent via
+        ``cache_gather`` between steps. Returns the best beam's ids per
+        prompt (or, with return_all, (ids (B, K, T), lengths, scores))."""
+        from ..ops.decode import beam_search_backtrack, beam_search_step
+        from ..ops.kv_cache import cache_gather
+
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1, got %d"
+                             % max_new_tokens)
+        k = int(beam_size)
+        eos = eos_id if eos_id is not None else self.eos_id
+        end_id = -1 if eos is None else int(eos)
+        tokens, lens, b, s = self._bucketed(prompts, max_new_tokens)
+        outs, pcaches = self._prefill(tokens, lens, s)
+        obs.DECODE_TOKENS.inc(int(lens[:b].sum()), kind="prefill")
+        lp = jax.nn.log_softmax(
+            jnp.asarray(outs[0][:b]).astype(jnp.float32), axis=-1)
+        pre_scores, pre_ids = jax.lax.top_k(lp, k)     # (B, K) each
+        pre_ids = pre_ids.astype(jnp.int32)
+
+        bk = _pow2_bucket(b * k)
+        # beam-expand the caches: slab row b*K+j starts as prompt b's
+        pad = np.zeros(bk - b * k, np.int32)
+        expand = np.concatenate(
+            [np.repeat(np.arange(b, dtype=np.int32), k), pad])
+        caches = [cache_gather(c, jnp.asarray(expand)) for c in pcaches]
+        lens_k = np.concatenate(
+            [np.repeat(lens[:b], k), np.ones(bk - b * k, np.int32)]
+        ).astype(np.int32)
+        step_ids = [pre_ids]
+        step_parents = [jnp.broadcast_to(
+            jnp.arange(k, dtype=jnp.int32)[None, :], (b, k))]
+        dexe, _ = self.acquire("decode", bk, s, "logits")
+        for _step in range(1, max_new_tokens):
+            cur = np.zeros((bk,), np.int64)
+            cur[:b * k] = np.asarray(pre_ids).reshape(-1)
+            feeds = {"tokens": cur.reshape(bk, 1),
+                     "positions": lens_k.reshape(bk, 1).astype(np.int64),
+                     "lengths": lens_k,
+                     "seed": np.zeros((1,), np.int64)}
+            for i in range(self.config.n_layer):
+                feeds["kcache_%d" % i] = caches[2 * i]
+                feeds["vcache_%d" % i] = caches[2 * i + 1]
+            t0 = time.perf_counter()
+            outs = dexe(feeds, self._state)
+            obs.DECODE_STEP_MS.observe((time.perf_counter() - t0) * 1e3,
+                                       stage="step")
+            logits = jnp.asarray(outs[0][:b * k]).astype(jnp.float32)
+            lp = jax.nn.log_softmax(logits, axis=-1).reshape(
+                b, k, self.config.vocab_size)
+            total = pre_scores[:, :, None] + lp
+            sel_ids, sel_scores, parents = beam_search_step(
+                pre_ids, pre_scores, total, None, k, end_id)
+            # reorder the APPENDED slabs by parent so each surviving
+            # beam carries its parent's full lineage
+            flat_parent = np.concatenate([
+                (np.arange(b, dtype=np.int32)[:, None] * k
+                 + np.asarray(parents)).reshape(-1), pad])
+            caches = [cache_gather(c, jnp.asarray(flat_parent))
+                      for c in outs[1:]]
+            pre_ids, pre_scores = sel_ids, sel_scores
+            step_ids.append(sel_ids)
+            step_parents.append(parents)
+            lens_k = lens_k + 1
+            obs.DECODE_TOKENS.inc(b * k, kind="decode")
+            if eos is not None and bool(
+                    (np.asarray(sel_ids) == end_id).all()):
+                break
+        sent, slens = beam_search_backtrack(
+            jnp.stack(step_ids), jnp.stack(step_parents), end_id)
+        sent = np.asarray(sent)
+        slens = np.asarray(slens)
+        if return_all:
+            return sent, slens, np.asarray(pre_scores)
+        return [np.asarray(sent[i, 0, :slens[i, 0]], np.int64)
+                for i in range(b)]
+
+
+class DecodeServer:
+    """Continuous-batching decode serving loop.
+
+    server = DecodeServer(DecodePredictor(model_dir), slots=8)
+    server.start()
+    fut = server.submit((prompt_ids,))            # or (ids, [max_new])
+    (generated,) = fut.result()
+    server.stop()
+
+    One resident KV slab of ``slots`` rows serves every request: the
+    loop admits queued prompts into free rows BETWEEN decode steps (a
+    bucketed prefill sub-batch, scattered into the slab), steps every
+    active row one token per iteration through ONE compiled (slots, S)
+    executable, and retires finished rows eagerly — a long sequence
+    never holds short ones hostage, and a fresh request starts decoding
+    mid-flight instead of waiting for the batch to drain
+    (``continuous=False`` restores gang scheduling for A/B runs).
+
+    Requests ride the same zero-copy channel frames as PredictorServer
+    (slot 0: int prompt ids; optional slot 1: [max_new_tokens] or
+    [max_new_tokens, seed] int64), and the response is one int64 array
+    of generated ids — so the PR-8 Router forwards decode traffic
+    verbatim and ``stop()`` keeps the zero-drop contract: everything
+    admitted OR still queued finishes before the loop exits. A
+    per-request ``seed`` seeds that request's FIRST sampled token;
+    later steps draw from the server's stream (steps are shared across
+    slots), so fully seeded reproducible sampling is
+    ``DecodePredictor.generate``'s surface — greedy traffic is
+    deterministic either way.
+    """
+
+    def __init__(self, predictor: DecodePredictor, slots: int = 4,
+                 max_seq: Optional[int] = None, max_new_tokens: int = 32,
+                 strategy: Optional[str] = None, capacity: int = 256,
+                 eos_id: Optional[int] = None, continuous: bool = True,
+                 prewarm: bool = True):
+        from ..runtime.recordio import Channel
+
+        if slots < 1:
+            raise ValueError("slots must be >= 1, got %d" % slots)
+        self.predictor = predictor
+        self.slots = int(slots)
+        cfg = predictor.config
+        want = max_seq or cfg.max_len
+        self.seq = min(_pow2_bucket(want, floor=16),
+                       _pow2_bucket(cfg.max_len))
+        if self.seq > cfg.max_len:
+            self.seq = cfg.max_len
+        self.max_new_tokens = int(max_new_tokens)
+        self.strategy = strategy or predictor.strategy
+        if self.strategy in ("beam", "logits"):
+            raise ValueError(
+                "DecodeServer streams one token per step; strategy %r "
+                "is a DecodePredictor.generate-only mode" % self.strategy)
+        self.eos_id = eos_id if eos_id is not None else predictor.eos_id
+        self.continuous = bool(continuous)
+        self._prewarm = prewarm
+        self._chan = Channel(capacity)
+        self._results: Dict[int, "_DecodeFuture"] = {}
+        self._next_id = 0
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._http = None
+        self._http_thread = None
+        self._seed_ctr = 0
+        # diagnostic: per-iteration active-slot counts (the continuous-
+        # vs-static fill story; bench_decode reads it). BOUNDED: a
+        # long-lived server must not grow an entry per decode step
+        # forever — 100k covers any bench window
+        import collections
+
+        self.step_active_counts: "collections.deque" = collections.deque(
+            maxlen=100_000)
+
+    # -- submission (PredictorServer-compatible surface) -------------------
+    def submit(self, sample: Sequence[np.ndarray]):
+        from ..inference import _Future, _encode_sample
+
+        fut = _Future()
+        fut._t0 = time.perf_counter()
+        with self._lock:
+            rid = self._next_id
+            self._next_id += 1
+            self._results[rid] = fut
+        fut._bind(self, rid)
+        try:
+            sent = self._chan.send(_encode_sample(rid, sample))
+        except BaseException:
+            with self._lock:
+                self._results.pop(rid, None)
+            raise
+        if not sent:
+            with self._lock:
+                self._results.pop(rid, None)
+            raise RuntimeError("decode server is stopped")
+        return fut
+
+    def submit_frame(self, msg):
+        """Router fan-in: an already-encoded frame, tag = request id."""
+        from ..inference import _Future
+
+        rid = _rio.frame_tag(msg)
+        fut = _Future()
+        fut._t0 = time.perf_counter()
+        with self._lock:
+            if rid in self._results:
+                raise ValueError("request tag %d is already in flight"
+                                 % rid)
+            self._results[rid] = fut
+        fut._bind(self, rid)
+        if not self._chan.send(msg):
+            with self._lock:
+                self._results.pop(rid, None)
+            raise RuntimeError("decode server is stopped")
+        return fut
+
+    def _pop(self, rid):
+        with self._lock:
+            return self._results.pop(rid, None)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        if self._thread is not None and self._thread.is_alive():
+            return
+        if self._prewarm:
+            # the steady-state signatures compile/AOT-load BEFORE the
+            # first request: the ONE (slots, S) decode step, plus the
+            # single-request and full-burst admission prefills at the
+            # floor PROMPT bucket (_admit prefills at the prompts' own
+            # pow2 bucket, so the floor is what typical short-prompt
+            # traffic actually hits — longer prompts lazily warm their
+            # own bucket on first arrival)
+            t0 = time.perf_counter()
+            self.predictor.acquire("decode", self.slots, self.seq,
+                                   self.strategy)
+            sp = min(16, self.seq)
+            self.predictor.acquire("prefill", 1, sp)
+            if self.slots > 1:
+                self.predictor.acquire("prefill",
+                                       _pow2_bucket(self.slots), sp)
+            obs.SERVER_STAGE_MS.observe(
+                (time.perf_counter() - t0) * 1e3, stage="prewarm")
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="ptpu-decode-loop")
+        self._thread.start()
+
+    def stop(self):
+        """Zero-drop stop: close the intake, then the loop admits
+        everything still queued (as slots free) and finishes every
+        in-flight generation before exiting."""
+        self.stop_http()
+        self._chan.close()
+        if self._thread is not None:
+            self._thread.join(timeout=300)
+            self._thread = None
+
+    # metrics endpoint: same handler as the PR-2 server (self._http/
+    # self._http_thread are the only state it touches)
+    from ..inference import PredictorServer as _PS
+
+    start_http = _PS.start_http
+    stop_http = _PS.stop_http
+    del _PS
+
+    # -- serving loop ------------------------------------------------------
+    def _decode_request(self, msg):
+        from ..inference import _decode_request
+
+        rid, rows = _decode_request(msg)
+        prompt = np.asarray(rows[0]).reshape(-1).astype(np.int64)
+        max_new = self.max_new_tokens
+        seed = None
+        if len(rows) > 1:
+            opts = np.asarray(rows[1]).reshape(-1)
+            if opts.size >= 1:
+                if int(opts[0]) < 1:
+                    raise ValueError(
+                        "max_new_tokens must be >= 1, got %d"
+                        % int(opts[0]))
+                max_new = min(int(opts[0]), self.max_new_tokens)
+            if opts.size >= 2:
+                seed = int(opts[1])
+        return rid, prompt, max_new, seed
+
+    def _set_slot_gauges(self, n_active: int):
+        obs.DECODE_SLOTS.set(n_active, state="active")
+        obs.DECODE_SLOTS.set(self.slots - n_active, state="free")
+
+    def _fail(self, rid, exc):
+        fut = self._pop(rid)
+        if fut is not None:
+            obs.PREDICT_FAILURES.inc(path="decode")
+            fut.set_exception(exc)
+
+    def _retire(self, slot_state):
+        rid = slot_state["rid"]
+        fut = self._pop(rid)
+        obs.DECODE_REQUESTS.inc(kind="retired")
+        if fut is not None:  # abandoned via cancel/timeout otherwise
+            fut.set_result([np.asarray(slot_state["generated"], np.int64)])
+            obs.PREDICT_LATENCY_MS.observe(
+                (time.perf_counter() - fut._t0) * 1e3, path="decode")
+            obs.PREDICT_REQUESTS.inc(path="decode")
+
+    def _admit(self, pending, caches, lens, active):
+        """Prefill a sub-batch of queued requests into free slots.
+        ``pending`` entries are (rid, prompt, max_new, seed); returns
+        the updated caches (slab rows replaced via one scatter per
+        tensor)."""
+        free = [i for i in range(self.slots) if active[i] is None]
+        batch = pending[:len(free)]
+        del pending[:len(batch)]
+        n = len(batch)
+        bb = _pow2_bucket(n)
+        # prefill at the PROMPTS' own sequence bucket, not the slab
+        # length: admitting a 16-token prompt into a 1024-token slab
+        # must cost a 16-token forward (this is what lets continuous
+        # admission beat gang scheduling — a slab-sized prefill per
+        # admission would eat the win)
+        sp = min(_pow2_bucket(max(len(b[1]) for b in batch), floor=16),
+                 self.seq)
+        tokens = np.zeros((bb, sp), np.int64)
+        plens = np.ones((bb,), np.int32)
+        for i, (_rid, prompt, _mn, _seed) in enumerate(batch):
+            tokens[i, :len(prompt)] = prompt
+            plens[i] = len(prompt)
+        try:
+            pexe, _ = self.predictor.acquire("prefill", bb, sp)
+            t0 = time.perf_counter()
+            outs = pexe({"tokens": tokens, "lengths": plens},
+                        self.predictor._state)
+        except Exception as e:
+            # an admission that cannot prefill (compile error, device
+            # OOM) fails ITS requests and leaves the server serving —
+            # the already-admitted slots and the queue are untouched
+            for rid, _p, _mn, _seed in batch:
+                self._fail(rid, e)
+            return caches
+        obs.DECODE_STEP_MS.observe((time.perf_counter() - t0) * 1e3,
+                                   stage="prefill")
+        obs.DECODE_TOKENS.inc(int(plens[:n].sum()), kind="prefill")
+        first = np.array(self.predictor._sample_host(
+            outs[0], self.strategy, self._seed_ctr))  # writable copy
+        self._seed_ctr += 1
+        # a request that carried its own seed gets ITS first token from
+        # that seed (matching DecodePredictor.generate(..., seed=s) for
+        # the first sample); later steps draw from the server's stream —
+        # full per-request reproducibility under continuous batching is
+        # a greedy/direct-predictor property, not a server one
+        for i, (_rid, _p, _mn, seed) in enumerate(batch):
+            if seed is not None and self.strategy not in ("greedy",):
+                first[i] = self.predictor._sample_host(
+                    outs[0][i:i + 1], self.strategy, seed)[0]
+        slot_idx = jnp.asarray(np.array(free[:n], np.int32))
+        sub = list(outs[1:])
+        # scatter the (n, sp, H, Dh) prefill rows into the slab's first
+        # sp positions; rows past sp keep old garbage, masked by length
+        caches = [c.at[slot_idx, :sp].set(jnp.asarray(s)[:n])
+                  for c, s in zip(caches, sub)]
+        for i, (rid, prompt, max_new, seed) in enumerate(batch):
+            slot = free[i]
+            tok = int(first[i])
+            st = {"rid": rid, "generated": [tok], "max_new": max_new,
+                  "cur": tok, "count": 1}
+            lens[slot] = len(prompt)
+            active[slot] = st
+            obs.DECODE_REQUESTS.inc(kind="admitted")
+            obs.DECODE_TOKENS.inc(kind="decode")
+            if (self.eos_id is not None and tok == self.eos_id) \
+                    or max_new <= 1:
+                self._retire(st)
+                active[slot] = None
+                lens[slot] = 0
+        return caches
+
+    def _loop(self):
+        cfg = self.predictor.config
+        shape = (self.slots, self.seq, cfg.n_head, cfg.d_head)
+        caches = [jnp.zeros(shape, jnp.float32)
+                  for _ in range(2 * cfg.n_layer)]
+        lens = np.zeros((self.slots,), np.int32)
+        active: List[Optional[dict]] = [None] * self.slots
+        pending: List[tuple] = []
+        dexe, _ = self.predictor.acquire("decode", self.slots, self.seq,
+                                         self.strategy)
+        closed = False
+        while True:
+            n_active = sum(1 for a in active if a is not None)
+            free = self.slots - n_active
+            if not closed:
+                if n_active == 0 and not pending:
+                    # idle: park on the channel until work (or close)
+                    batch = self._chan.recv_batch(self.slots, None)
+                elif free > 0 and (self.continuous or n_active == 0):
+                    # mid-flight admission: non-blocking drain, bounded
+                    # by the free slots (leaving the rest in the channel
+                    # keeps submit()'s backpressure intact)
+                    batch = self._chan.recv_batch(free, 0)
+                else:
+                    batch = []
+                if batch is None:
+                    closed = True
+                    batch = []
+            else:
+                batch = []
+            for msg in batch:
+                try:
+                    rid, prompt, max_new, seed = self._decode_request(msg)
+                    if len(prompt) + max_new > self.seq:
+                        raise ValueError(
+                            "prompt %d + max_new %d exceeds the server's "
+                            "%d-token slab" % (len(prompt), max_new,
+                                               self.seq))
+                    if len(prompt) < 1:
+                        raise ValueError("empty prompt")
+                    pending.append((rid, prompt, max_new, seed))
+                except Exception as e:
+                    try:
+                        self._fail(_rio.frame_tag(bytes(msg)), e)
+                    except Exception:
+                        pass
+            admit_ok = (free > 0 and pending
+                        and (self.continuous or n_active == 0))
+            if admit_ok:
+                caches = self._admit(pending, caches, lens, active)
+                n_active = sum(1 for a in active if a is not None)
+            self._set_slot_gauges(n_active)
+            if n_active == 0:
+                if closed and not pending:
+                    return
+                continue
+            # one token across every active slot
+            cur = np.zeros((self.slots,), np.int64)
+            for i, st in enumerate(active):
+                if st is not None:
+                    cur[i] = st["cur"]
+            feeds = {"tokens": cur.reshape(self.slots, 1),
+                     "positions": lens.reshape(self.slots, 1).astype(
+                         np.int64),
+                     "lengths": lens.copy(),
+                     "seed": np.array([self._seed_ctr], np.int64)}
+            self._seed_ctr += 1
+            for i in range(cfg.n_layer):
+                feeds["kcache_%d" % i] = caches[2 * i]
+                feeds["vcache_%d" % i] = caches[2 * i + 1]
+            try:
+                t0 = time.perf_counter()
+                outs = dexe(feeds, self.predictor._state)
+                nxt = np.asarray(outs[0]).astype(np.int64)
+            except Exception as e:
+                # a decode step that dies (device OOM, donated-buffer
+                # misuse, backend loss) must not kill the serving loop
+                # and strand every future: fail the ACTIVE sequences
+                # (their cache state is no longer trustworthy), free the
+                # slots, keep serving the queue
+                for i, st in enumerate(active):
+                    if st is not None:
+                        self._fail(st["rid"], e)
+                        obs.DECODE_REQUESTS.inc(kind="retired")
+                        active[i] = None
+                        lens[i] = 0
+                # the failed call may have CONSUMED the fed slabs
+                # (donate_argnums on device backends) — reusing them
+                # next iteration would poison every future step.
+                # Lengths are all 0 now, so fresh zeros are correct.
+                caches = [jnp.zeros(shape, jnp.float32)
+                          for _ in range(2 * cfg.n_layer)]
+                self._set_slot_gauges(0)
+                continue
+            obs.DECODE_STEP_MS.observe((time.perf_counter() - t0) * 1e3,
+                                       stage="step")
+            self.step_active_counts.append(n_active)
+            caches = list(outs[2:])
+            emitted = 0
+            for i, st in enumerate(active):
+                if st is None:
+                    continue
+                lens[i] += 1
+                tok = int(nxt[i])
+                st["generated"].append(tok)
+                st["cur"] = tok
+                st["count"] += 1
+                emitted += 1
+                if (self.eos_id is not None and tok == self.eos_id) \
+                        or st["count"] >= st["max_new"] \
+                        or lens[i] + 1 >= self.seq:
+                    self._retire(st)
+                    active[i] = None
+                    lens[i] = 0
+            obs.DECODE_TOKENS.inc(emitted, kind="decode")
+            # refresh occupancy AFTER retirements: an idle server must
+            # scrape as 0 active, not as its pre-retirement count (the
+            # next iteration may park on the channel before updating)
+            self._set_slot_gauges(
+                sum(1 for a in active if a is not None))

@@ -105,11 +105,24 @@ class Router:
                  worker_env: Optional[Dict[str, str]] = None,
                  worker_http: bool = False,
                  start_timeout: float = 300.0,
-                 dispatch_batch: int = 64):
+                 dispatch_batch: int = 64,
+                 decode: bool = False,
+                 decode_slots: int = 4,
+                 decode_max_seq: Optional[int] = None,
+                 max_new_tokens: int = 32,
+                 strategy: Optional[str] = None):
         from ..runtime.recordio import Channel
 
         if replicas < 1:
             raise ValueError("replicas must be >= 1, got %d" % replicas)
+        if decode and shard > 1:
+            # fail HERE, not silently in the worker: the decode branch
+            # builds a single-device DecodePredictor and would quietly
+            # serve a tp-exported model unsharded
+            raise ValueError(
+                "decode mode does not support shard > 1 yet (the "
+                "DecodeServer replica hosts a single-device "
+                "DecodePredictor)")
         self.model_dir = model_dir
         self.replicas = int(replicas)
         self.shard = int(shard)
@@ -129,6 +142,19 @@ class Router:
             # one capacity knob bounds BOTH the router's front channel
             # and each worker server's channel
             "capacity": int(capacity),
+            # decode mode: replicas run the continuous-batching
+            # DecodeServer (serving/decode.py) instead of the
+            # PredictorServer; requests are (prompt_ids[, opts]) frames
+            # and responses one generated-ids row — the router forwards
+            # both verbatim, and in-flight decode SEQUENCES inherit the
+            # zero-drop drain/restart + crash-requeue contracts
+            # (generation is stateless from the router's view: the kept
+            # frame re-prefills on a survivor)
+            "decode": bool(decode),
+            "decode_slots": int(decode_slots),
+            "decode_max_seq": decode_max_seq,
+            "max_new_tokens": int(max_new_tokens),
+            "strategy": strategy,
         }
         import multiprocessing as mp
 

@@ -120,19 +120,40 @@ def worker_main(conn, options):
 
     try:
         shard = int(options.get("shard") or 1)
-        if shard > 1:
-            from .sharded import ShardedPredictor
+        if options.get("decode"):
+            # decode replica: DecodePredictor + continuous-batching
+            # DecodeServer — same submit_frame/stop/start_http surface,
+            # so the rest of the worker (and the whole Router) is
+            # mode-agnostic
+            if shard > 1:  # Router raises first; belt for direct callers
+                raise ValueError(
+                    "decode mode does not support shard > 1")
+            from .decode import DecodePredictor, DecodeServer
 
-            pred = ShardedPredictor(options["model_dir"], shard=shard)
+            pred = DecodePredictor(
+                options["model_dir"],
+                strategy=options.get("strategy") or "greedy")
+            version = pred.fingerprint()
+            server = DecodeServer(
+                pred,
+                slots=int(options.get("decode_slots", 4)),
+                max_seq=options.get("decode_max_seq"),
+                max_new_tokens=int(options.get("max_new_tokens", 32)),
+                capacity=int(options.get("capacity", 256)))
         else:
-            pred = Predictor(options["model_dir"])
-        version = pred._engine.fingerprint()
-        server = PredictorServer(
-            pred,
-            max_batch=int(options.get("max_batch", 8)),
-            max_wait_ms=float(options.get("max_wait_ms", 0.0)),
-            in_flight=int(options.get("in_flight", 2)),
-            capacity=int(options.get("capacity", 256)))
+            if shard > 1:
+                from .sharded import ShardedPredictor
+
+                pred = ShardedPredictor(options["model_dir"], shard=shard)
+            else:
+                pred = Predictor(options["model_dir"])
+            version = pred._engine.fingerprint()
+            server = PredictorServer(
+                pred,
+                max_batch=int(options.get("max_batch", 8)),
+                max_wait_ms=float(options.get("max_wait_ms", 0.0)),
+                in_flight=int(options.get("in_flight", 2)),
+                capacity=int(options.get("capacity", 256)))
         server.start()
         port = server.start_http(0) if options.get("http") else 0
     except Exception as e:

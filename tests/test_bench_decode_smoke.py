@@ -1,0 +1,104 @@
+"""Tier-1 CPU smoke of tools/bench_decode.py: a tiny LM A/B runs in
+seconds and every emitted JSON line matches the schema downstream sweep
+tooling parses — the decode bench cannot silently rot between device
+windows. This pins the CONTRACT, not the numbers (the speedup
+acceptance lives in PERF_NOTES, measured at the real config)."""
+import io
+import json
+import sys
+from contextlib import redirect_stdout
+
+_AB_KEYS = {
+    "phase": str, "mode": str, "batch": int, "decode_steps": int,
+    "prompt_len": int, "seq_bucket": int, "rounds": int, "tokens": int,
+    "tokens_per_sec": float, "tokens_per_sec_rounds": list,
+    "wall_s": float,
+}
+
+_AB_SPEEDUP_KEYS = {
+    "phase": str, "batch": int, "decode_steps": int,
+    "kv_tokens_per_sec": float, "full_tokens_per_sec": float,
+    "speedup": float,
+}
+
+_BATCH_KEYS = {
+    "phase": str, "mode": str, "slots": int, "requests": int,
+    "max_new_mix": str, "rounds": int, "tokens": int,
+    "tokens_per_sec": float, "tokens_per_sec_rounds": list,
+    "mean_active": float, "decode_iters_per_round": float,
+    "wall_s": float,
+}
+
+_BATCH_SPEEDUP_KEYS = {
+    "phase": str, "slots": int, "requests": int,
+    "continuous_tokens_per_sec": float, "static_tokens_per_sec": float,
+    "speedup": float, "iters_ratio": float,
+}
+
+
+def _check_schema(rec, schema):
+    assert set(rec) == set(schema), (
+        "schema drift: %s vs %s" % (sorted(rec), sorted(schema)))
+    for key, typ in schema.items():
+        if typ is float:
+            assert isinstance(rec[key], (int, float)), (key, rec[key])
+        else:
+            assert isinstance(rec[key], typ), (key, rec[key])
+
+
+def test_bench_decode_smoke(monkeypatch):
+    monkeypatch.setenv("BENCH_DECODE_PLATFORM", "cpu")
+    monkeypatch.setenv("DECODE_LAYERS", "1")
+    monkeypatch.setenv("DECODE_HEADS", "2")
+    monkeypatch.setenv("DECODE_DMODEL", "16")
+    monkeypatch.setenv("DECODE_DINNER", "32")
+    monkeypatch.setenv("DECODE_VOCAB", "64")
+    monkeypatch.setenv("DECODE_PROMPT", "4")
+    monkeypatch.setenv("DECODE_BATCH", "2")
+    monkeypatch.setenv("DECODE_STEPS", "6")
+    monkeypatch.setenv("DECODE_ROUNDS", "1")
+    monkeypatch.setenv("CONT_REQUESTS", "5")
+    monkeypatch.setenv("CONT_SLOTS", "2")
+    monkeypatch.setenv("CONT_ROUNDS", "1")
+    monkeypatch.setenv("CONT_MAXNEW_MIX", "2,5")
+    monkeypatch.syspath_prepend(
+        __file__.rsplit("/tests/", 1)[0] + "/tools")
+    # fresh import so the module-level env reads see the smoke config
+    sys.modules.pop("bench_decode", None)
+    import bench_decode
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        bench_decode.main()
+    recs = [json.loads(ln) for ln in buf.getvalue().splitlines()
+            if ln.strip()]
+    phases = [r["phase"] for r in recs]
+    assert phases == ["decode_ab", "decode_ab", "decode_speedup",
+                      "batch_mode", "batch_mode", "batching_speedup"]
+
+    ab = [r for r in recs if r["phase"] == "decode_ab"]
+    assert {r["mode"] for r in ab} == {"kv_cache", "full_forward"}
+    for rec in ab:
+        _check_schema(rec, _AB_KEYS)
+        assert rec["tokens_per_sec"] > 0
+        assert rec["batch"] == 2 and rec["decode_steps"] == 6
+        assert len(rec["tokens_per_sec_rounds"]) == rec["rounds"] == 1
+
+    sp = [r for r in recs if r["phase"] == "decode_speedup"][0]
+    _check_schema(sp, _AB_SPEEDUP_KEYS)
+    assert sp["speedup"] > 0
+
+    bm = [r for r in recs if r["phase"] == "batch_mode"]
+    assert {r["mode"] for r in bm} == {"continuous", "static"}
+    for rec in bm:
+        _check_schema(rec, _BATCH_KEYS)
+        assert rec["tokens_per_sec"] > 0
+        assert rec["slots"] == 2 and rec["requests"] == 5
+
+    bs = [r for r in recs if r["phase"] == "batching_speedup"][0]
+    _check_schema(bs, _BATCH_SPEEDUP_KEYS)
+    assert bs["speedup"] > 0
+    # the structural half is noise-free even in a smoke: mixed budgets
+    # through continuous admission need no MORE sweeps than the gang
+    # schedule
+    assert bs["iters_ratio"] >= 1.0

@@ -1,0 +1,457 @@
+"""KV-cache decode serving (serving/decode.py): incremental-vs-full
+parity, slab bucketing + AOT warm start, sampling strategies, the
+continuous-batching DecodeServer, decode observability, the Router
+fleet path (zero-drop drain_restart over in-flight decode sequences),
+and the ops-layer beam-search strategy — including parity against
+contrib's BeamSearchDecoder on a small seq2seq."""
+import json
+import os
+import subprocess
+import sys
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, optimizer
+from paddle_tpu.models import transformer as T
+from paddle_tpu.serving.decode import (
+    DecodeConfig, DecodePredictor, DecodeServer, save_decode_model,
+    _pow2_bucket)
+
+V, L, NH, D, DI, ML = 37, 2, 2, 16, 32, 64
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    """A tiny trained LM exported for decode serving, shared module-wide
+    (every test reads; none mutates the export)."""
+    d = str(tmp_path_factory.mktemp("decode_model"))
+    B, S = 2, 16
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = startup.random_seed = 7
+    with fluid.program_guard(prog, startup):
+        with fluid.unique_name.guard():
+            ids = layers.data(name="ids", shape=[B, S], dtype="int64",
+                              append_batch_size=False)
+            lbl = layers.data(name="lbl", shape=[B, S], dtype="int64",
+                              append_batch_size=False)
+            loss, _ = T.transformer_lm(
+                ids, lbl, V, n_layer=L, n_head=NH, d_model=D, d_inner=DI,
+                dropout_rate=0.0, max_len=ML, fused_head=False)
+            optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    r = np.random.RandomState(0)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(3):
+            x = r.randint(0, V, (B, S)).astype(np.int64)
+            exe.run(prog, feed={"ids": x, "lbl": x})
+        save_decode_model(d, DecodeConfig(
+            vocab_size=V, n_layer=L, n_head=NH, d_model=D, d_inner=DI,
+            max_len=ML), exe, scope=scope)
+    return d
+
+
+@pytest.fixture(scope="module")
+def pred(model_dir):
+    return DecodePredictor(model_dir)
+
+
+def _prompts(n, seed=1, lo=3, hi=9):
+    r = np.random.RandomState(seed)
+    return [r.randint(1, V, r.randint(lo, hi + 1)).astype(np.int64)
+            for _ in range(n)]
+
+
+def _full_forward_greedy(pred, prompts, steps):
+    """Reference rollout: one full prefill forward per generated token
+    (greedy) — the O(T^2) path the KV cache replaces."""
+    b = len(prompts)
+    bb = _pow2_bucket(b)
+    s = _pow2_bucket(max(len(p) for p in prompts) + steps, floor=16)
+    tokens = np.zeros((bb, s), np.int64)
+    lens = np.ones((bb,), np.int32)
+    for i, p in enumerate(prompts):
+        tokens[i, :len(p)] = p
+        lens[i] = len(p)
+    pexe, _ = pred.acquire("prefill", bb, s)
+    out = [[] for _ in range(b)]
+    rows = np.arange(bb)
+    for _ in range(steps):
+        outs = pexe({"tokens": tokens, "lengths": lens}, pred._state)
+        nxt = np.asarray(outs[0]).argmax(axis=1)
+        for i in range(b):
+            out[i].append(int(nxt[i]))
+        tokens[rows, np.minimum(lens, s - 1)] = nxt
+        lens = np.minimum(lens + 1, s - 1)
+    return [np.asarray(o, np.int64) for o in out]
+
+
+# -- DecodePredictor ------------------------------------------------------
+
+def test_export_dir_serves_plain_predictor(model_dir):
+    """The exported dir stays a normal inference model: the plain
+    Predictor loads and serves the prefill graph."""
+    from paddle_tpu.inference import Predictor
+
+    p = Predictor(model_dir)
+    assert p.feed_names == ["tokens", "lengths"]
+    # the canonical export shape: batch 1 x min(max_len, 128) tokens
+    toks = np.zeros((1, ML), np.int64)
+    toks[0, :4] = [5, 3, 9, 2]
+    (logits,) = p.run({"tokens": toks,
+                       "lengths": np.array([4], np.int32)})
+    assert logits.shape == (1, V)
+    assert os.path.exists(os.path.join(model_dir, "__decode__.json"))
+
+
+def test_incremental_decode_matches_full_forward(pred):
+    """THE contract: N decode steps against the cache produce exactly
+    the tokens N full-prefix forwards produce (greedy both sides)."""
+    prompts = _prompts(3)
+    steps = 10
+    got = pred.generate(prompts, max_new_tokens=steps)
+    want = _full_forward_greedy(pred, prompts, steps)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+
+
+def test_generate_eos_stops_row_early(pred):
+    prompts = _prompts(2, seed=2)
+    base = pred.generate(prompts, max_new_tokens=8)
+    eos = int(base[0][3])  # stop row 0 at its 4th generated token
+    got = pred.generate(prompts, max_new_tokens=8, eos_id=eos)
+    assert len(got[0]) <= 4 and got[0][-1] == eos
+    # the other row is untouched unless it also emits eos
+    stop1 = np.where(base[1] == eos)[0]
+    want1 = base[1][:stop1[0] + 1] if len(stop1) else base[1]
+    np.testing.assert_array_equal(got[1], want1)
+
+
+def test_sampling_strategies_determinism(pred):
+    prompts = _prompts(2, seed=3)
+    a = pred.generate(prompts, max_new_tokens=6, strategy="topk", seed=5)
+    b = pred.generate(prompts, max_new_tokens=6, strategy="topk", seed=5)
+    c = pred.generate(prompts, max_new_tokens=6, strategy="topp", seed=5)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)  # same seed -> same tokens
+    for row in a + c:
+        assert row.min() >= 0 and row.max() < V
+
+
+def test_warm_start_compiles_nothing(model_dir, pred):
+    """A fresh process-equivalent (new DecodePredictor over the same
+    dir) must AOT-load every executable the first predictor compiled:
+    zero traces on the warm path (the PR-5 story extended to decode)."""
+    prompts = _prompts(3)
+    pred.generate(prompts, max_new_tokens=10)  # ensure sigs on disk
+    p2 = DecodePredictor(model_dir)
+    p2.generate(prompts, max_new_tokens=10)
+    assert p2.traces == 0
+
+
+def test_signature_count_stays_bucketed(pred):
+    """1..4 prompts of assorted lengths share ONE (batch-bucket, slab-
+    bucket) signature set — the pow2 discipline that bounds compiles."""
+    before = dict(pred._compiled)
+    outs = pred.generate(_prompts(3, seed=4, lo=3, hi=5),
+                         max_new_tokens=10)
+    assert len(outs) == 3
+    pred.generate(_prompts(4, seed=5, lo=3, hi=5), max_new_tokens=9)
+    new_keys = set(pred._compiled) - set(before)
+    # both calls: batch bucket 4, slab bucket 16 -> at most one prefill
+    # + one decode signature added beyond what the fixture already has
+    assert all(k[1] == 4 and k[2] == 16 for k in new_keys), new_keys
+
+
+# -- DecodeServer ---------------------------------------------------------
+
+def test_server_continuous_matches_generate(pred):
+    prompts = _prompts(6, seed=6)
+    want = pred.generate(prompts, max_new_tokens=6)
+    srv = DecodeServer(pred, slots=2, max_seq=32, max_new_tokens=6)
+    srv.start()
+    futs = [srv.submit((p,)) for p in prompts]
+    got = [f.result(timeout=300)[0] for f in futs]
+    srv.stop()
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+    # continuous admission actually happened: more sequences than slots
+    assert max(srv.step_active_counts, default=0) <= 2
+
+
+def test_server_static_mode_matches(pred):
+    prompts = _prompts(5, seed=7)
+    want = pred.generate(prompts, max_new_tokens=5)
+    srv = DecodeServer(pred, slots=2, max_seq=32, max_new_tokens=5,
+                       continuous=False)
+    srv.start()
+    futs = [srv.submit((p,)) for p in prompts]
+    got = [f.result(timeout=300)[0] for f in futs]
+    srv.stop()
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+
+
+def test_server_per_request_budget_and_mixed_lengths(pred):
+    prompts = _prompts(4, seed=8)
+    budgets = [2, 7, 3, 5]
+    srv = DecodeServer(pred, slots=4, max_seq=32, max_new_tokens=8)
+    srv.start()
+    futs = [srv.submit((p, np.array([mn], np.int64)))
+            for p, mn in zip(prompts, budgets)]
+    got = [f.result(timeout=300)[0] for f in futs]
+    srv.stop()
+    want = pred.generate(prompts, max_new_tokens=8)
+    for g, w, mn in zip(got, want, budgets):
+        assert len(g) == mn
+        np.testing.assert_array_equal(g, w[:mn])
+
+
+def test_server_stop_is_zero_drop(pred):
+    """stop() right after a submit burst: every request still completes
+    (queued ones admitted as slots free, in-flight ones finished)."""
+    prompts = _prompts(8, seed=9)
+    srv = DecodeServer(pred, slots=2, max_seq=32, max_new_tokens=4)
+    srv.start()
+    futs = [srv.submit((p,)) for p in prompts]
+    stopper = threading.Thread(target=srv.stop)
+    stopper.start()
+    got = [f.result(timeout=300)[0] for f in futs]
+    stopper.join(timeout=300)
+    assert len(got) == len(prompts)
+    want = pred.generate(prompts, max_new_tokens=4)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+
+
+def test_server_survives_step_failure(model_dir):
+    """A decode step that raises (device OOM, backend loss) must fail
+    the affected futures and keep the loop alive — not strand every
+    client on a dead daemon thread."""
+    p = DecodePredictor(model_dir)
+    boom = {"armed": True}
+    real_acquire = p.acquire
+
+    def flaky_acquire(kind, batch, seq, strategy=None):
+        exe, fetch = real_acquire(kind, batch, seq, strategy)
+        if kind != "decode":
+            return exe, fetch
+
+        def wrapped(feeds, state):
+            if boom.pop("armed", False):
+                raise RuntimeError("injected device failure")
+            return exe(feeds, state)
+
+        return wrapped, fetch
+
+    p.acquire = flaky_acquire
+    srv = DecodeServer(p, slots=2, max_seq=32, max_new_tokens=4,
+                       prewarm=False)
+    srv.start()
+    prompts = _prompts(2, seed=14)
+    futs = [srv.submit((pr,)) for pr in prompts]
+    with pytest.raises(RuntimeError, match="injected device failure"):
+        futs[0].result(timeout=120)
+    # the loop survived: fresh requests still serve end to end
+    fut = srv.submit((prompts[0],))
+    out, = fut.result(timeout=120)
+    srv.stop()
+    want = DecodePredictor(model_dir).generate([prompts[0]],
+                                               max_new_tokens=4)[0]
+    np.testing.assert_array_equal(out, want)
+
+
+def test_server_rejects_oversized_prompt(pred):
+    srv = DecodeServer(pred, slots=1, max_seq=16, max_new_tokens=8)
+    srv.start()
+    fut = srv.submit((np.arange(1, 20, dtype=np.int64),))  # 19 + 8 > 16
+    with pytest.raises(ValueError):
+        fut.result(timeout=120)
+    srv.stop()
+
+
+def test_decode_metrics_exported_and_merged(pred, tmp_path):
+    """Acceptance pin: the decode series reach /metrics, and
+    tools/metrics_dump.py --merge aggregates snapshots containing
+    them."""
+    srv = DecodeServer(pred, slots=2, max_seq=32, max_new_tokens=4)
+    srv.start()
+    futs = [srv.submit((p,)) for p in _prompts(3, seed=10)]
+    for f in futs:
+        f.result(timeout=300)
+    port = srv.start_http(0)
+    text = urllib.request.urlopen(
+        "http://127.0.0.1:%d/metrics" % port, timeout=30
+    ).read().decode("utf-8")
+    srv.stop()
+    for series in ("paddle_tpu_decode_tokens_total",
+                   "paddle_tpu_decode_slots",
+                   "paddle_tpu_decode_step_ms_bucket",
+                   "paddle_tpu_decode_requests_total"):
+        assert series in text, series
+
+    from paddle_tpu.observability import export
+
+    snap = tmp_path / "w0.json"
+    snap.write_text(json.dumps(export.to_json(include_timeline=False)))
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    res = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "metrics_dump.py"),
+         "--merge", str(snap), str(snap)],
+        capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stderr
+    merged = json.loads(res.stdout)
+    names = json.dumps(merged)
+    assert "paddle_tpu_decode_tokens_total" in names
+
+
+# -- fleet path -----------------------------------------------------------
+
+def test_fleet_decode_round_trip_with_drain_restart(model_dir, pred):
+    """Acceptance pin: decode requests round-trip through the PR-8
+    Router fleet, and a drain_restart mid-traffic drops NOTHING — the
+    zero-drop contract extended to in-flight decode sequences."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.serving import Router
+
+    prompts = _prompts(10, seed=11)
+    want = pred.generate(prompts, max_new_tokens=5)
+    before_mis = obs.FLEET_MISVERSIONED.value()
+    router = Router(model_dir, replicas=2, decode=True, decode_slots=2,
+                    decode_max_seq=32, max_new_tokens=8,
+                    jax_platform="cpu")
+    router.start()
+    opts = np.array([5], np.int64)
+    futs = [router.submit((p, opts)) for p in prompts[:5]]
+    drainer = threading.Thread(target=lambda: router.drain_restart(0))
+    drainer.start()
+    futs += [router.submit((p, opts)) for p in prompts[5:]]
+    got = [f.result(timeout=300)[0] for f in futs]
+    drainer.join(timeout=300)
+    router.stop()
+    assert len(got) == len(prompts)  # zero drops
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+    assert obs.FLEET_MISVERSIONED.value() == before_mis
+
+
+# -- beam-search strategy -------------------------------------------------
+
+def test_beam_size_one_equals_greedy(pred):
+    prompts = _prompts(2, seed=12)
+    beam = pred.generate(prompts, max_new_tokens=6, strategy="beam",
+                         beam_size=1)
+    greedy = pred.generate(prompts, max_new_tokens=6, strategy="greedy")
+    for b, g in zip(beam, greedy):
+        np.testing.assert_array_equal(b, g)
+
+
+def test_beam_scores_are_ordered(pred):
+    prompts = _prompts(2, seed=13)
+    sent, lens, scores = pred.generate_beam(
+        prompts, max_new_tokens=5, beam_size=3, return_all=True)
+    assert sent.shape[:2] == (2, 3)
+    for b in range(2):
+        assert all(scores[b, i] >= scores[b, i + 1] - 1e-6
+                   for i in range(2))
+
+
+def test_beam_strategy_parity_with_contrib_decoder():
+    """Satellite pin: the ops-layer beam search driven HOST-SIDE between
+    step executions (beam_search_step / cache_gather state reorder /
+    beam_search_backtrack — exactly DecodePredictor.generate_beam's
+    loop) reproduces contrib BeamSearchDecoder's program-level scan on a
+    small seq2seq cell, id-for-id and score-for-score."""
+    from paddle_tpu.contrib import BeamSearchDecoder, InitState, StateCell
+    from paddle_tpu.ops.decode import (beam_search_backtrack,
+                                       beam_search_step)
+    from paddle_tpu.ops.kv_cache import cache_gather
+
+    B, Dh, Vc, WD, K, MAXLEN, END = 2, 8, 11, 6, 3, 6, 1
+
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = startup.random_seed = 3
+    with fluid.program_guard(prog, startup):
+        with fluid.unique_name.guard():
+            enc = layers.data(name="enc", shape=[Dh])
+            init_ids = layers.data(name="init_ids", shape=[1],
+                                   dtype="int64")
+            init_scores = layers.data(name="init_scores", shape=[1])
+            cell = StateCell(inputs={"x": None},
+                             states={"h": InitState(init=enc)},
+                             out_state="h")
+
+            @cell.state_updater
+            def updater(c):
+                x = c.get_input("x")
+                h = c.get_state("h")
+                c.set_state("h", layers.fc(input=[x, h], size=Dh,
+                                           act="tanh", bias_attr=False))
+
+            decoder = BeamSearchDecoder(
+                cell, init_ids, init_scores, target_dict_dim=Vc,
+                word_dim=WD, topk_size=Vc, sparse_emb=False,
+                max_len=MAXLEN, beam_size=K, end_id=END)
+            decoder.decode()
+            ids_v, scores_v = decoder()
+    r = np.random.RandomState(11)
+    enc_v = r.randn(B, Dh).astype(np.float32)
+    feed = {"enc": enc_v, "init_ids": np.zeros((B, 1), np.int64),
+            "init_scores": np.zeros((B, 1), np.float32)}
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ids_p, scores_p = exe.run(prog, feed=feed,
+                                  fetch_list=[ids_v, scores_v])
+        params = {n: np.asarray(scope.find_var(n))
+                  for n in prog.global_block().vars
+                  if scope.find_var(n) is not None
+                  and getattr(prog.global_block().vars[n],
+                              "persistable", False)}
+    ids_p, scores_p = np.asarray(ids_p), np.asarray(scores_p)
+    emb_w = next(v for v in params.values() if v.shape == (Vc, WD))
+    x_w = next(v for v in params.values() if v.shape == (WD, Dh))
+    h_w = next(v for v in params.values() if v.shape == (Dh, Dh))
+    s_w = next(v for v in params.values() if v.shape == (Dh, Vc))
+    s_b = next(v for v in params.values() if v.shape == (Vc,))
+
+    # host-side replay: the generate_beam loop shape, with the RNN cell
+    # in place of the compiled LM decode step
+    h = np.repeat(enc_v, K, axis=0)                     # beam-tiled state
+    pre_ids = jnp.zeros((B, K), jnp.int32)
+    pre_scores = jnp.asarray(
+        np.concatenate([np.zeros((B, 1), np.float32),
+                        np.full((B, K - 1), -1e9, np.float32)], axis=1))
+    step_ids, step_parents, scores_stack = [], [], []
+    for _ in range(MAXLEN):
+        x = emb_w[np.asarray(pre_ids).reshape(-1)]
+        h = np.tanh(x @ x_w + h @ h_w)
+        probs = jax.nn.softmax(jnp.asarray(h @ s_w + s_b), axis=-1)
+        cand_probs, cand_ids = jax.lax.top_k(probs, Vc)
+        cum = (jnp.log(cand_probs)
+               + pre_scores.reshape(-1, 1)).reshape(B, K, Vc)
+        sel_ids, sel_scores, parents = beam_search_step(
+            pre_ids, pre_scores, cum, cand_ids.reshape(B, K, Vc), K, END)
+        flat_parent = (np.arange(B, dtype=np.int32)[:, None] * K
+                       + np.asarray(parents)).reshape(-1)
+        # the slab-reorder primitive doubles as the RNN-state reorder
+        h = np.asarray(cache_gather(jnp.asarray(h),
+                                    jnp.asarray(flat_parent)))
+        pre_ids, pre_scores = sel_ids.astype(jnp.int32), sel_scores
+        step_ids.append(sel_ids)
+        step_parents.append(parents)
+        scores_stack.append(sel_scores)
+    sent, lens = beam_search_backtrack(jnp.stack(step_ids),
+                                      jnp.stack(step_parents), END)
+    np.testing.assert_array_equal(np.asarray(sent), ids_p)
+    np.testing.assert_allclose(np.asarray(scores_stack[-1]), scores_p,
+                               rtol=1e-5, atol=1e-6)

@@ -44,6 +44,18 @@ def test_train_lm_example_single_device():
     assert "tokens/s" in out
 
 
+def _jax_has_pvary():
+    import jax
+
+    return hasattr(jax.lax, "pvary")
+
+
+@pytest.mark.skipif(
+    not _jax_has_pvary(),
+    reason="this jax build lacks lax.pvary, which shard_map-based "
+           "pipeline parallelism needs at trace time (present from "
+           "jax 0.6; this box runs 0.4.37) — the pipeline example "
+           "cannot run here, not a regression")
 def test_train_lm_example_pipeline():
     flags = (os.environ.get("XLA_FLAGS", "")
              + " --xla_force_host_platform_device_count=8").strip()
@@ -75,6 +87,17 @@ def test_serve_example_round_trip():
     out = _run([os.path.join(_ROOT, "examples", "serve.py"), "--cpu",
                 "--steps", "150"], cwd="/", set_pythonpath=False)
     assert "every row" in out
+
+
+def test_serve_example_decode_round_trip():
+    """serve.py --decode asserts itself that every generation served
+    through the continuous-batching DecodeServer matches the direct
+    DecodePredictor — rc 0 IS the check (the CI serving step's decode
+    smoke)."""
+    out = _run([os.path.join(_ROOT, "examples", "serve.py"), "--cpu",
+                "--decode", "--steps", "10"], cwd="/",
+               set_pythonpath=False)
+    assert "matches the direct DecodePredictor" in out
 
 
 def test_train_lm_example_loop_mode():

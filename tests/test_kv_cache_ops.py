@@ -1,0 +1,189 @@
+"""KV-cache op battery (ops/kv_cache.py): decode_attention numerics vs
+the full-attention kernels, Pallas-interpret parity, cache append/gather
+semantics, and the infer-rule cross-checks."""
+import math
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddle_tpu.ops import kv_cache as kc
+from tests.op_test import check_infer, run_op
+
+B, S, H, D = 3, 32, 2, 8
+
+
+def _rand(shape, seed=0):
+    return np.random.RandomState(seed).randn(*shape).astype(np.float32)
+
+
+def _ref_decode(q, k, v, lens, scale=None):
+    """Plain numpy single-query attention over the first lens[b] rows."""
+    scale = scale or 1.0 / math.sqrt(q.shape[-1])
+    out = np.zeros_like(q)
+    for b in range(q.shape[0]):
+        for h in range(q.shape[2]):
+            if lens[b] == 0:
+                continue
+            s = (q[b, 0, h] @ k[b, :lens[b], h].T) * scale
+            p = np.exp(s - s.max())
+            p /= p.sum()
+            out[b, 0, h] = p @ v[b, :lens[b], h]
+    return out
+
+
+@pytest.fixture
+def qkv():
+    return (_rand((B, 1, H, D), 0), _rand((B, S, H, D), 1),
+            _rand((B, S, H, D), 2))
+
+
+def test_decode_attention_matches_numpy(qkv):
+    q, k, v = qkv
+    lens = np.array([5, S, 1], np.int32)
+    out = np.asarray(run_op("decode_attention",
+                            {"Q": q, "KCache": k, "VCache": v,
+                             "Lengths": lens})["Out"])
+    np.testing.assert_allclose(out, _ref_decode(q, k, v, lens),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_decode_attention_zero_length_row_is_finite(qkv):
+    """Length-0 slots (free continuous-batching slots) must produce
+    zeros, not NaN/garbage — the server steps every slot of the slab."""
+    q, k, v = qkv
+    lens = np.array([0, 4, 0], np.int32)
+    out = np.asarray(run_op("decode_attention",
+                            {"Q": q, "KCache": k, "VCache": v,
+                             "Lengths": lens})["Out"])
+    assert np.isfinite(out).all()
+    np.testing.assert_allclose(out[0], 0.0, atol=1e-7)
+    np.testing.assert_allclose(out[2], 0.0, atol=1e-7)
+
+
+def test_decode_attention_matches_causal_prefix_of_flash_attention(qkv):
+    """The incremental contract itself: attending a cache of the first
+    t tokens must equal row t-1 of full causal flash attention."""
+    from paddle_tpu.ops.attention import flash_attention
+
+    _, k, v = qkv
+    q_full = _rand((B, S, H, D), 3)
+    # full causal attention, BHTD layout
+    full = np.asarray(flash_attention(
+        jnp.asarray(q_full.transpose(0, 2, 1, 3)),
+        jnp.asarray(k.transpose(0, 2, 1, 3)),
+        jnp.asarray(v.transpose(0, 2, 1, 3)), causal=True))
+    for t in (1, 7, S):
+        lens = np.full((B,), t, np.int32)
+        out = np.asarray(run_op(
+            "decode_attention",
+            {"Q": q_full[:, t - 1:t], "KCache": k, "VCache": v,
+             "Lengths": lens})["Out"])
+        np.testing.assert_allclose(out[:, 0], full[:, :, t - 1],
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_pallas_decode_kernel_interpret_parity(qkv):
+    """The TPU kernel, run under interpret=True, must match the lax
+    fallback bit-for-tolerance — the off-hardware guard for the
+    on-hardware path."""
+    q, k, v = qkv
+    lens = np.array([5, S, 1], np.int32)
+    got = np.asarray(kc.pallas_decode_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        jnp.asarray(lens), interpret=True, block_s=8))
+    np.testing.assert_allclose(got, _ref_decode(q, k, v, lens),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pallas_decode_kernel_partial_block(qkv):
+    """Lengths that end mid-KV-block exercise the kernel's masked tail."""
+    q, k, v = qkv
+    lens = np.array([3, 13, 27], np.int32)
+    got = np.asarray(kc.pallas_decode_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        jnp.asarray(lens), interpret=True, block_s=8))
+    np.testing.assert_allclose(got, _ref_decode(q, k, v, lens),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_cache_append():
+    cache = _rand((B, S, H, D), 4)
+    new = _rand((B, 1, H, D), 5)
+    pos = np.array([0, 7, S - 1], np.int32)
+    out = np.asarray(run_op("cache_append",
+                            {"Cache": cache, "New": new, "Pos": pos})
+                     ["Out"])
+    for b in range(B):
+        np.testing.assert_array_equal(out[b, pos[b]], new[b, 0])
+        untouched = [i for i in range(S) if i != pos[b]]
+        np.testing.assert_array_equal(out[b, untouched],
+                                      cache[b, untouched])
+
+
+def test_cache_append_squeezed_new():
+    """New accepted as (B, ...) without the singleton time axis."""
+    cache = _rand((B, S, H, D), 4)
+    new = _rand((B, H, D), 5)
+    pos = np.array([2, 2, 2], np.int32)
+    out = np.asarray(run_op("cache_append",
+                            {"Cache": cache, "New": new, "Pos": pos})
+                     ["Out"])
+    np.testing.assert_array_equal(out[:, 2], new)
+
+
+def test_cache_append_out_of_range_pos_clips():
+    """A full slab clips the append instead of crashing (the serving
+    loop also length-caps retirement before this can trigger)."""
+    cache = _rand((B, S, H, D), 4)
+    new = _rand((B, 1, H, D), 5)
+    pos = np.array([S, S + 5, 0], np.int32)
+    out = np.asarray(run_op("cache_append",
+                            {"Cache": cache, "New": new, "Pos": pos})
+                     ["Out"])
+    np.testing.assert_array_equal(out[0, S - 1], new[0, 0])
+
+
+def test_cache_gather():
+    cache = _rand((4, S, H, D), 6)
+    idx = np.array([3, 3, 0, 1, 2], np.int32)
+    out = np.asarray(run_op("cache_gather",
+                            {"Cache": cache, "Index": idx})["Out"])
+    assert out.shape == (5, S, H, D)
+    for i, j in enumerate(idx):
+        np.testing.assert_array_equal(out[i], cache[j])
+
+
+def test_kv_cache_infer_rules():
+    q, k, v = (_rand((B, 1, H, D)), _rand((B, S, H, D)),
+               _rand((B, S, H, D)))
+    lens = np.array([1] * B, np.int32)
+    check_infer("decode_attention",
+                {"Q": q, "KCache": k, "VCache": v, "Lengths": lens})
+    check_infer("cache_append",
+                {"Cache": k, "New": q, "Pos": lens})
+    check_infer("cache_gather",
+                {"Cache": k, "Index": np.array([0, 2, 1], np.int32)})
+
+
+def test_decode_attention_infer_rejects_bad_slab():
+    from paddle_tpu.analysis import get_infer_rule
+    from paddle_tpu.analysis.infer import (
+        InferContext, InferError, VarInfo, _Env, normalize_shape)
+    from tests.op_test import build_one_op_program
+
+    q = _rand((B, 1, H, D))
+    bad_k = _rand((B, S, H + 1, D))  # head-count mismatch
+    v = _rand((B, S, H, D))
+    lens = np.array([1] * B, np.int32)
+    block, op, trace_env, _i, _o = build_one_op_program(
+        "decode_attention",
+        {"Q": q, "KCache": bad_k, "VCache": v, "Lengths": lens})
+    env = _Env()
+    for name, val in trace_env.items():
+        arr = np.asarray(val)
+        env.set(name, VarInfo(normalize_shape(arr.shape),
+                              str(arr.dtype)))
+    with pytest.raises(InferError):
+        get_infer_rule("decode_attention")(InferContext(op, block, env))

@@ -1,0 +1,140 @@
+"""Sampling op battery (ops/sampling.py): greedy/top-k/top-p numerics
+with fixed PRNG keys — support constraints, distribution shape, seed
+determinism — plus the infer-rule cross-checks."""
+import numpy as np
+
+import jax.numpy as jnp
+
+from paddle_tpu.ops import sampling as S
+from tests.op_test import check_infer, run_op
+
+V = 50
+
+
+def _logits(b=4, v=V, seed=0):
+    return np.random.RandomState(seed).randn(b, v).astype(np.float32) * 2
+
+
+def test_greedy_sample_is_argmax():
+    lg = _logits()
+    out = np.asarray(run_op("greedy_sample", {"Logits": lg})["Out"])
+    np.testing.assert_array_equal(out, lg.argmax(axis=1))
+
+
+def test_greedy_sample_accepts_singleton_time_axis():
+    lg = _logits()
+    out = np.asarray(run_op("greedy_sample",
+                            {"Logits": lg[:, None, :]})["Out"])
+    np.testing.assert_array_equal(out, lg.argmax(axis=1))
+
+
+def test_top_k_support_constraint():
+    """Every sampled id must come from its row's top-k set."""
+    lg = _logits(b=8)
+    topk = np.argsort(-lg, axis=1)[:, :5]
+    for seed in range(5):
+        out = np.asarray(run_op(
+            "top_k_sample",
+            {"Logits": lg, "Seed": np.array([seed], np.int64)},
+            attrs={"k": 5})["Out"])
+        for i in range(8):
+            assert out[i] in topk[i], (i, out[i], topk[i])
+
+
+def test_top_k_one_is_greedy():
+    lg = _logits()
+    out = np.asarray(run_op(
+        "top_k_sample", {"Logits": lg, "Seed": np.array([3], np.int64)},
+        attrs={"k": 1})["Out"])
+    np.testing.assert_array_equal(out, lg.argmax(axis=1))
+
+
+def test_top_k_seed_determinism():
+    lg = _logits(b=16)
+    a = np.asarray(run_op("top_k_sample",
+                          {"Logits": lg, "Seed": np.array([7], np.int64)},
+                          attrs={"k": 10})["Out"])
+    b = np.asarray(run_op("top_k_sample",
+                          {"Logits": lg, "Seed": np.array([7], np.int64)},
+                          attrs={"k": 10})["Out"])
+    c = np.asarray(run_op("top_k_sample",
+                          {"Logits": lg, "Seed": np.array([8], np.int64)},
+                          attrs={"k": 10})["Out"])
+    np.testing.assert_array_equal(a, b)  # same seed -> same draw
+    assert (a != c).any()                # different seed -> different draw
+
+
+def test_top_k_distribution_shape():
+    """With a heavily skewed 3-token distribution, sampled frequencies
+    over many fixed-key draws must rank like the probabilities and
+    roughly match them (fixed PRNG — deterministic, no flaky bound)."""
+    n = 600
+    lg = np.tile(np.log(np.array([[0.7, 0.2, 0.1]], np.float32)), (n, 1))
+    out = np.asarray(S.top_k_sample(jnp.asarray(lg),
+                                    jnp.asarray([123], jnp.int32), 3))
+    freq = np.bincount(out, minlength=3) / n
+    assert freq[0] > freq[1] > freq[2], freq
+    np.testing.assert_allclose(freq, [0.7, 0.2, 0.1], atol=0.08)
+
+
+def test_top_k_temperature_sharpens():
+    """Temperature -> 0 concentrates the draw on the argmax."""
+    n = 300
+    lg = np.tile(np.log(np.array([[0.5, 0.3, 0.2]], np.float32)), (n, 1))
+    out = np.asarray(S.top_k_sample(jnp.asarray(lg),
+                                    jnp.asarray([5], jnp.int32), 3,
+                                    temperature=0.05))
+    assert (out == 0).mean() > 0.99
+
+
+def test_top_p_small_p_is_greedy():
+    lg = _logits()
+    out = np.asarray(run_op(
+        "top_p_sample", {"Logits": lg, "Seed": np.array([1], np.int64)},
+        attrs={"p": 1e-9})["Out"])
+    np.testing.assert_array_equal(out, lg.argmax(axis=1))
+
+
+def test_top_p_nucleus_support():
+    """p=0.75 over a known distribution keeps exactly the 2-token
+    nucleus {0.6, 0.3}: token 2 (0.1) must never be drawn."""
+    n = 400
+    lg = np.tile(np.log(np.array([[0.6, 0.3, 0.1]], np.float32)), (n, 1))
+    out = np.asarray(S.top_p_sample(jnp.asarray(lg),
+                                    jnp.asarray([9], jnp.int32), 0.75))
+    assert set(np.unique(out)) <= {0, 1}, np.unique(out)
+    freq = np.bincount(out, minlength=2) / n
+    # renormalized nucleus: 2/3 vs 1/3
+    np.testing.assert_allclose(freq[:2], [2 / 3, 1 / 3], atol=0.08)
+
+
+def test_top_p_full_p_matches_softmax():
+    """p=1 keeps everything: frequencies track the full softmax."""
+    n = 900
+    lg = np.tile(np.log(np.array([[0.5, 0.25, 0.25]], np.float32)),
+                 (n, 1))
+    out = np.asarray(S.top_p_sample(jnp.asarray(lg),
+                                    jnp.asarray([11], jnp.int32), 1.0))
+    freq = np.bincount(out, minlength=3) / n
+    np.testing.assert_allclose(freq, [0.5, 0.25, 0.25], atol=0.08)
+
+
+def test_sampling_without_seed_uses_trace_rng():
+    """Seed omitted: the op draws from the tracer's RNG stream (fixed
+    per executable — documented; decode serving always feeds Seed)."""
+    lg = _logits()
+    out = np.asarray(run_op("top_k_sample", {"Logits": lg},
+                            attrs={"k": 5})["Out"])
+    topk = np.argsort(-lg, axis=1)[:, :5]
+    for i in range(len(out)):
+        assert out[i] in topk[i]
+
+
+def test_sampling_infer_rules():
+    lg = _logits()
+    seed = np.array([1], np.int64)
+    check_infer("greedy_sample", {"Logits": lg})
+    check_infer("top_k_sample", {"Logits": lg, "Seed": seed},
+                attrs={"k": 5})
+    check_infer("top_p_sample", {"Logits": lg, "Seed": seed},
+                attrs={"p": 0.9})

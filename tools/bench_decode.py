@@ -1,0 +1,287 @@
+"""Decode-serving measurements: the O(T^2)-vs-O(T) story, measured.
+
+Two interleaved A/B experiments over the same exported causal LM
+(random-init weights — throughput does not care what the logits say):
+
+1. **KV-cache incremental decode vs full-forward recompute**
+   (``decode_ab``): generate DECODE_STEPS tokens per row at
+   DECODE_BATCH. The kv arm is ``DecodePredictor.generate`` (one
+   prefill + one single-query decode step per token); the full arm
+   replays the serving status quo ante — re-running the SAME compiled
+   prefill executable over the whole growing prefix for every token.
+   Rounds interleave (kv, full, kv, full, ...) so host noise hits both
+   arms equally — the PR-2/3/5/8 discipline.
+
+2. **Continuous vs static batching at mixed request lengths**
+   (``batch_mode``): CONT_REQUESTS generations with alternating short/
+   long ``max_new`` budgets through the same DecodeServer, once with
+   continuous admission (new requests enter free cache slots
+   mid-flight, finished rows retire eagerly) and once gang-scheduled
+   (``continuous=False``: a batch must fully drain before the next is
+   admitted). ``mean_active`` is the measured per-step slot occupancy —
+   the mechanism behind the speedup, not just the outcome.
+
+Prints one JSON line per config / phase:
+  {"phase": "decode_ab", "mode": "kv_cache"|"full_forward", ...}
+  {"phase": "decode_speedup", "speedup": ...}
+  {"phase": "batch_mode", "mode": "continuous"|"static", ...}
+  {"phase": "batching_speedup", "speedup": ...}
+
+Usage:
+  python tools/bench_decode.py                       # CPU (forced)
+  BENCH_DECODE_PLATFORM=device python tools/bench_decode.py  # real chip
+
+Model: DECODE_LAYERS x DECODE_HEADS heads x DECODE_DMODEL (ffn
+DECODE_DINNER) over DECODE_VOCAB tokens; prompts DECODE_PROMPT long.
+Grid: DECODE_BATCH, DECODE_STEPS, DECODE_ROUNDS; continuous phase:
+CONT_REQUESTS, CONT_SLOTS, CONT_MAXNEW_MIX (comma list cycled across
+requests), CONT_ROUNDS.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get("BENCH_DECODE_PLATFORM", "cpu") == "cpu":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+if os.environ.get("BENCH_DECODE_PLATFORM", "cpu") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu as fluid  # noqa: E402
+
+LAYERS = int(os.environ.get("DECODE_LAYERS", 2))
+HEADS = int(os.environ.get("DECODE_HEADS", 4))
+DMODEL = int(os.environ.get("DECODE_DMODEL", 128))
+DINNER = int(os.environ.get("DECODE_DINNER", 256))
+VOCAB = int(os.environ.get("DECODE_VOCAB", 512))
+PROMPT = int(os.environ.get("DECODE_PROMPT", 16))
+BATCH = int(os.environ.get("DECODE_BATCH", 4))
+STEPS = int(os.environ.get("DECODE_STEPS", 128))
+ROUNDS = int(os.environ.get("DECODE_ROUNDS", 3))
+CONT_REQUESTS = int(os.environ.get("CONT_REQUESTS", 24))
+CONT_SLOTS = int(os.environ.get("CONT_SLOTS", 4))
+CONT_MAXNEW_MIX = os.environ.get("CONT_MAXNEW_MIX", "")
+CONT_ROUNDS = int(os.environ.get("CONT_ROUNDS", 5))
+
+
+def emit(rec):
+    print(json.dumps(rec), flush=True)
+
+
+def _export_model(model_dir):
+    from paddle_tpu import layers, optimizer  # noqa: F401
+    from paddle_tpu.models import transformer as T
+    from paddle_tpu.serving.decode import DecodeConfig, save_decode_model
+
+    from paddle_tpu.serving.decode import _pow2_bucket
+
+    max_len = _pow2_bucket(PROMPT + STEPS + 1, floor=16)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 17
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        ids = layers.data(name="ids", shape=[2, 16], dtype="int64",
+                          append_batch_size=False)
+        lbl = layers.data(name="lbl", shape=[2, 16], dtype="int64",
+                          append_batch_size=False)
+        T.transformer_lm(ids, lbl, VOCAB, n_layer=LAYERS, n_head=HEADS,
+                         d_model=DMODEL, d_inner=DINNER, dropout_rate=0.0,
+                         max_len=max_len, fused_head=False)
+    exe = fluid.Executor(fluid.CPUPlace() if os.environ.get(
+        "JAX_PLATFORMS") == "cpu" else None)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        save_decode_model(model_dir, DecodeConfig(
+            vocab_size=VOCAB, n_layer=LAYERS, n_head=HEADS, d_model=DMODEL,
+            d_inner=DINNER, max_len=max_len), exe, scope=scope)
+    return max_len
+
+
+def _prompts(n, rng):
+    return [rng.randint(1, VOCAB, PROMPT).astype(np.int64)
+            for _ in range(n)]
+
+
+def _full_forward_rollout(pred, prompts, steps):
+    """The no-cache baseline: one FULL prefill forward per generated
+    token over the growing prefix (greedy), using the same compiled
+    prefill executable family — and the same bucket policy
+    (serving.decode._pow2_bucket) — the kv arm warms."""
+    from paddle_tpu.serving.decode import _pow2_bucket
+
+    b = len(prompts)
+    bb = _pow2_bucket(b)
+    s = _pow2_bucket(PROMPT + steps, floor=16)
+    tokens = np.zeros((bb, s), np.int64)
+    lens = np.ones((bb,), np.int32)
+    for i, p in enumerate(prompts):
+        tokens[i, :len(p)] = p
+        lens[i] = len(p)
+    rows = np.arange(bb)
+    for _ in range(steps):
+        # honest baseline: the full forward runs at the pow2 bucket of
+        # the CURRENT prefix, not the final one (what a bucketed
+        # full-forward server would actually pay per token)
+        sc = min(_pow2_bucket(int(lens.max()), floor=16), s)
+        pexe, _ = pred.acquire("prefill", bb, sc)
+        outs = pexe({"tokens": tokens[:, :sc], "lengths": lens},
+                    pred._state)
+        nxt = np.asarray(outs[0]).argmax(axis=1)
+        tokens[rows, np.minimum(lens, s - 1)] = nxt
+        lens = np.minimum(lens + 1, s - 1)
+    return tokens
+
+
+def bench_decode_ab(pred):
+    rng = np.random.RandomState(0)
+    prompts = _prompts(BATCH, rng)
+    # one full untimed round per arm: EVERY signature either arm will
+    # touch (all the growing full-forward buckets, the kv prefill + the
+    # (B, S) decode step) compiles/loads outside the measured region
+    pred.generate(prompts, max_new_tokens=STEPS)
+    _full_forward_rollout(pred, prompts, STEPS)
+
+    kv_rates, full_rates = [], []
+    kv_wall = full_wall = 0.0
+    for _ in range(ROUNDS):
+        t0 = time.perf_counter()
+        outs = pred.generate(prompts, max_new_tokens=STEPS)
+        dt = time.perf_counter() - t0
+        kv_wall += dt
+        kv_rates.append(sum(len(o) for o in outs) / dt)
+
+        t0 = time.perf_counter()
+        _full_forward_rollout(pred, prompts, STEPS)
+        dt = time.perf_counter() - t0
+        full_wall += dt
+        full_rates.append(BATCH * STEPS / dt)
+
+    from paddle_tpu.serving.decode import _pow2_bucket
+
+    s = _pow2_bucket(PROMPT + STEPS, floor=16)
+    for mode, rates, wall in (("kv_cache", kv_rates, kv_wall),
+                              ("full_forward", full_rates, full_wall)):
+        emit({"phase": "decode_ab", "mode": mode, "batch": BATCH,
+              "decode_steps": STEPS, "prompt_len": PROMPT,
+              "seq_bucket": s, "rounds": ROUNDS,
+              "tokens": BATCH * STEPS * ROUNDS,
+              "tokens_per_sec": float(np.median(rates)),
+              "tokens_per_sec_rounds": [float(r) for r in rates],
+              "wall_s": float(wall)})
+    kv, full = float(np.median(kv_rates)), float(np.median(full_rates))
+    emit({"phase": "decode_speedup", "batch": BATCH,
+          "decode_steps": STEPS, "kv_tokens_per_sec": kv,
+          "full_tokens_per_sec": full, "speedup": kv / full})
+    return kv / full
+
+
+def bench_batch_modes(model_dir):
+    from paddle_tpu.serving.decode import DecodePredictor, DecodeServer
+
+    rng = np.random.RandomState(1)
+    prompts = _prompts(CONT_REQUESTS, rng)
+    if CONT_MAXNEW_MIX:
+        mix = [int(x) for x in CONT_MAXNEW_MIX.split(",")]
+    else:
+        mix = [max(4, STEPS // 16), STEPS // 2]
+    budgets = [mix[i % len(mix)] for i in range(CONT_REQUESTS)]
+    max_new = max(budgets)
+
+    # ONE predictor (and its executable cache) behind both schedules:
+    # the A/B measures the SCHEDULING policy, not who compiled first
+    pred = DecodePredictor(model_dir)
+    servers = {}
+    for mode in ("continuous", "static"):
+        srv = DecodeServer(pred, slots=CONT_SLOTS,
+                           max_seq=PROMPT + max_new,
+                           max_new_tokens=max_new,
+                           continuous=(mode == "continuous"))
+        srv.start()
+        servers[mode] = srv
+
+    def run_round(mode):
+        srv = servers[mode]
+        t0 = time.perf_counter()
+        futs = [srv.submit((p, np.array([mn], np.int64)))
+                for p, mn in zip(prompts, budgets)]
+        outs = [f.result(timeout=600)[0] for f in futs]
+        return [np.asarray(o) for o in outs], time.perf_counter() - t0
+
+    results = {}
+    rates = {"continuous": [], "static": []}
+    walls = {"continuous": 0.0, "static": 0.0}
+    active = {"continuous": [], "static": []}
+    iters = {}
+    for mode in ("continuous", "static"):  # untimed warm round per arm
+        results[mode], _ = run_round(mode)
+        servers[mode].step_active_counts.clear()
+    for rnd in range(CONT_ROUNDS):
+        # alternate which arm goes first so slow drifts (thermal, other
+        # tenants of this box) hit both equally
+        order = (("continuous", "static") if rnd % 2 == 0
+                 else ("static", "continuous"))
+        for mode in order:
+            outs, dt = run_round(mode)
+            toks = sum(len(o) for o in outs)
+            rates[mode].append(toks / dt)
+            walls[mode] += dt
+    for mode in ("continuous", "static"):
+        srv = servers[mode]
+        if srv.step_active_counts:
+            active[mode].append(float(np.mean(srv.step_active_counts)))
+        # structural, noise-free half of the claim: decode iterations
+        # per round — continuous needs fewer sweeps of the same (slots,
+        # S) executable to emit the same tokens
+        iters[mode] = len(srv.step_active_counts) / float(CONT_ROUNDS)
+        srv.stop()
+    # both schedules must produce identical tokens (greedy, same model)
+    assert all(np.array_equal(a, b) for a, b in
+               zip(results["continuous"], results["static"])), \
+        "continuous and static batching diverged"
+    for mode in ("continuous", "static"):
+        emit({"phase": "batch_mode", "mode": mode, "slots": CONT_SLOTS,
+              "requests": CONT_REQUESTS,
+              "max_new_mix": ",".join(str(m) for m in mix),
+              "rounds": CONT_ROUNDS,
+              "tokens": sum(budgets),
+              "tokens_per_sec": float(np.median(rates[mode])),
+              "tokens_per_sec_rounds": [float(r) for r in rates[mode]],
+              "mean_active": (float(np.mean(active[mode]))
+                              if active[mode] else 0.0),
+              "decode_iters_per_round": float(iters[mode]),
+              "wall_s": float(walls[mode])})
+    cont = float(np.median(rates["continuous"]))
+    stat = float(np.median(rates["static"]))
+    emit({"phase": "batching_speedup", "slots": CONT_SLOTS,
+          "requests": CONT_REQUESTS,
+          "continuous_tokens_per_sec": cont,
+          "static_tokens_per_sec": stat, "speedup": cont / stat,
+          "iters_ratio": float(iters["static"])
+          / max(float(iters["continuous"]), 1.0)})
+    return cont / stat
+
+
+def main():
+    from paddle_tpu.serving.decode import DecodePredictor
+
+    with tempfile.TemporaryDirectory() as model_dir:
+        _export_model(model_dir)
+        pred = DecodePredictor(model_dir)
+        bench_decode_ab(pred)
+        del pred
+        bench_batch_modes(model_dir)
+
+
+if __name__ == "__main__":
+    main()

@@ -88,7 +88,43 @@ def _build_lstm():
     return [words.name, lengths.name, label.name], [avg_cost.name]
 
 
-EXAMPLES = {"mlp": _build_mlp, "deepfm": _build_deepfm, "lstm": _build_lstm}
+def _build_decode():
+    """The per-token KV-cache decode step (serving/decode.py): the graph
+    the DecodeServer compiles once per (slots, slab) signature —
+    decode_attention / cache_append / sampling ops stay lint-clean and
+    infer-covered."""
+    from paddle_tpu import layers
+    from paddle_tpu.models.transformer import transformer_lm_decode
+
+    B, S, V, L, NH, D, DI, ML = 4, 64, 256, 2, 4, 64, 128, 128
+    tokens = layers.data(name="tokens", shape=[B, 1], dtype="int64",
+                         append_batch_size=False)
+    positions = layers.data(name="positions", shape=[B, 1], dtype="int64",
+                            append_batch_size=False)
+    lengths = layers.data(name="lengths", shape=[B], dtype="int32",
+                          append_batch_size=False)
+    seed = layers.data(name="seed", shape=[1], dtype="int64",
+                       append_batch_size=False)
+    kc, vc = [], []
+    for i in range(L):
+        kc.append(layers.data(name="kcache_%d" % i,
+                              shape=[B, S, NH, D // NH], dtype="float32",
+                              append_batch_size=False))
+        vc.append(layers.data(name="vcache_%d" % i,
+                              shape=[B, S, NH, D // NH], dtype="float32",
+                              append_batch_size=False))
+    next_ids, logits, ncaches = transformer_lm_decode(
+        tokens, positions, lengths, kc, vc, V, n_layer=L, n_head=NH,
+        d_model=D, d_inner=DI, max_len=ML, strategy="topk", seed=seed)
+    feeds = (["tokens", "positions", "lengths", "seed"]
+             + [v.name for v in kc] + [v.name for v in vc])
+    fetches = ([next_ids.name, logits.name]
+               + [c.name for pair in ncaches for c in pair])
+    return feeds, fetches
+
+
+EXAMPLES = {"mlp": _build_mlp, "deepfm": _build_deepfm, "lstm": _build_lstm,
+            "decode": _build_decode}
 
 
 def build_example(name: str):
