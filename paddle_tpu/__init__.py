@@ -102,6 +102,11 @@ from .trainer import (  # noqa: F401
     Inferencer,
     Trainer,
 )
+from . import checkpoint  # noqa: F401  (elastic training subsystem)
+from .checkpoint import (  # noqa: F401
+    CheckpointManager,
+    ResumableLoop,
+)
 
 from . import inference  # noqa: F401
 from . import lod_tensor  # noqa: F401

@@ -1,0 +1,40 @@
+"""paddle_tpu.checkpoint — elastic, preemption-proof training state.
+
+The subsystem behind ``Trainer.fit(resumable=True)`` and the raw-loop
+``ResumableLoop``:
+
+- ``layout``: crash-safe on-disk checkpoint format — tmp-dir + fsync +
+  atomic rename + ``_COMPLETE`` sentinel; readers can never observe a
+  half-written checkpoint (a mid-write SIGKILL leaves an invisible
+  ``tmp-`` partial, swept once its writer pid is dead).
+- ``CheckpointManager`` (manager.py): async background writer off the
+  step path with bounded staleness (``max_pending`` queued snapshots,
+  block-don't-drop), retry-with-backoff on transient IO errors
+  degrading to loud synchronous saves, retention GC, and the
+  ``paddle_tpu_ckpt_*`` metric series.
+- ``ResumableLoop`` (resume.py): restore-newest-complete + sample-exact
+  data state (DataLoader epoch/offset) + RNG-stream restore, for
+  loops driving the Executor directly.
+- ``faults``: ``PADDLE_TPU_FAULT_*`` chaos hooks (kill/delay/IO-fail at
+  named barriers) that tools/chaos_train.py arms.
+
+Multi-host sharded state keeps its own orbax path
+(``io.save_sharded_checkpoint``); this package is the single-host
+(or per-host-replicated) dense story.
+"""
+from __future__ import annotations
+
+from . import faults, layout  # noqa: F401
+from .manager import CheckpointManager, CheckpointWriteError  # noqa: F401
+from .resume import (  # noqa: F401
+    CheckpointFingerprintWarning,
+    CheckpointMismatchError,
+    ResumableLoop,
+    check_fingerprint,
+)
+
+__all__ = [
+    "CheckpointManager", "CheckpointWriteError", "ResumableLoop",
+    "CheckpointFingerprintWarning", "CheckpointMismatchError",
+    "check_fingerprint", "layout", "faults",
+]

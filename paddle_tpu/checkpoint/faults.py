@@ -1,0 +1,89 @@
+"""Fault injection for chaos testing (PADDLE_TPU_FAULT_*).
+
+Named barriers (``fault_point("ckpt.before_rename")``) are sprinkled
+through the checkpoint writer; environment variables arm them so a test
+can kill, stall, or fail the process at an EXACT instant instead of
+racing a timer against the scheduler:
+
+- ``PADDLE_TPU_FAULT_KILL=point[:nth]`` — SIGKILL this process the
+  nth time (default first) the point is hit. A real SIGKILL: no atexit,
+  no finally blocks, exactly what a preempted TPU VM sees.
+- ``PADDLE_TPU_FAULT_DELAY=point:seconds`` — sleep at the point
+  (widens race windows for kill-from-outside tests).
+- ``PADDLE_TPU_FAULT_IO=point[:count]`` — raise ``InjectedIOError``
+  (an OSError) at the point for its first ``count`` hits (default 1),
+  then behave normally — the transient-IO-failure retry path.
+
+Several specs are comma-separated within each variable. Hit counters
+are per-process, keyed by point name. The env is re-read on every hit
+so a parent can arm a child through ``subprocess`` env alone; the parse
+is a few string ops — noise next to the IO these barriers decorate.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import Dict
+
+__all__ = ["fault_point", "InjectedIOError", "hits", "reset"]
+
+
+class InjectedIOError(OSError):
+    """The injected transient IO failure (an OSError so real retry
+    paths treat it exactly like disk trouble)."""
+
+
+_HITS: Dict[str, int] = {}
+
+
+def hits(point: str) -> int:
+    """How many times this process has crossed ``point``."""
+    return _HITS.get(point, 0)
+
+
+def reset():
+    """Zero every hit counter — for in-process tests that arm a fault
+    AFTER the point has already been crossed (``nth``/``count`` specs
+    count from process start otherwise). Subprocess chaos runs arm the
+    env before exec and never need this."""
+    _HITS.clear()
+
+
+def _specs(var: str):
+    raw = os.environ.get(var, "")
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, arg = part.partition(":")
+        yield name, arg
+
+
+def fault_point(point: str):
+    """Cross a named barrier: apply any armed delay/IO-failure/kill."""
+    _HITS[point] = n = _HITS.get(point, 0) + 1
+    for name, arg in _specs("PADDLE_TPU_FAULT_DELAY"):
+        if name == point:
+            try:
+                time.sleep(float(arg or 0.1))
+            except ValueError:
+                time.sleep(0.1)
+    for name, arg in _specs("PADDLE_TPU_FAULT_IO"):
+        if name == point:
+            try:
+                count = int(arg) if arg else 1
+            except ValueError:
+                count = 1
+            if n <= count:
+                raise InjectedIOError(
+                    "injected IO failure at %s (hit %d/%d)"
+                    % (point, n, count))
+    for name, arg in _specs("PADDLE_TPU_FAULT_KILL"):
+        if name == point:
+            try:
+                nth = int(arg) if arg else 1
+            except ValueError:
+                nth = 1
+            if n >= nth:
+                os.kill(os.getpid(), signal.SIGKILL)

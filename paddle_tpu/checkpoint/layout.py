@@ -1,0 +1,272 @@
+"""Crash-safe checkpoint directory layout.
+
+One serial-numbered directory per checkpoint, written so that a reader
+can NEVER observe a half-written checkpoint as valid:
+
+    <checkpoint_dir>/
+      checkpoint_12/                 # complete (has the sentinel)
+        __persistables__.npz         # every persistable, one npz
+        meta.json                    # step/epoch/data state/fingerprint
+        _COMPLETE                    # sentinel: written LAST, pre-rename
+      tmp-checkpoint_13.8741.x3f2/   # in-progress or crashed partial
+
+Write protocol (``write_checkpoint``): create a ``tmp-`` sibling, write
+every file into it, fsync each file AND the tmp directory, write the
+``_COMPLETE`` sentinel, then atomically ``os.rename`` the tmp dir onto
+its final serial name and fsync the parent. A crash (SIGKILL included)
+at ANY barrier leaves either a previous complete checkpoint untouched
+plus a ``tmp-`` partial (ignored by every reader, swept once its writer
+pid is dead), or the new complete checkpoint. The sentinel is belt and
+braces on top of the rename: a directory that was *copied* into place
+(rsync without the sentinel file yet, a restored backup cut short)
+is still rejected.
+
+Readers (``complete_serials`` / ``latest_serial``) only ever see
+directories that match the serial pattern AND contain the sentinel —
+legacy sentinel-less partials from the old in-place writer are skipped,
+never loaded, never raised on.
+"""
+from __future__ import annotations
+
+import errno
+import json
+import os
+import re
+import shutil
+import time
+import uuid
+from typing import Callable, Dict, List, Optional, Tuple
+
+from . import faults
+
+CKPT_PREFIX = "checkpoint_"
+TMP_PREFIX = "tmp-"
+SENTINEL = "_COMPLETE"
+PERSISTABLES_FILE = "__persistables__.npz"
+META_FILE = "meta.json"
+
+
+def serial_dir(checkpoint_dir: str, serial: int) -> str:
+    return os.path.join(checkpoint_dir, "%s%d" % (CKPT_PREFIX, serial))
+
+
+def is_complete(path: str) -> bool:
+    """A checkpoint directory counts only once its sentinel exists."""
+    return os.path.isfile(os.path.join(path, SENTINEL))
+
+
+def _fsync_path(path: str):
+    """fsync a file or directory; best-effort on filesystems that refuse
+    directory fds (the rename itself is still atomic there)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def write_file_durable(path: str, data: bytes):
+    """Write + flush + fsync one file (contents durable before any
+    rename publishes the directory)."""
+    with open(path, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def all_serials(checkpoint_dir: str) -> List[int]:
+    """Every numbered directory, complete or not (serial allocation must
+    never reuse a partial's number)."""
+    if not os.path.isdir(checkpoint_dir):
+        return []
+    out = []
+    for entry in os.listdir(checkpoint_dir):
+        m = re.fullmatch(CKPT_PREFIX + r"(\d+)", entry)
+        if m:
+            out.append(int(m.group(1)))
+    return sorted(out)
+
+
+def complete_serials(checkpoint_dir: str) -> List[int]:
+    """Serials safe to load: numbered AND sentinel-complete."""
+    return [s for s in all_serials(checkpoint_dir)
+            if is_complete(serial_dir(checkpoint_dir, s))]
+
+
+def latest_serial(checkpoint_dir: str) -> int:
+    """Newest COMPLETE serial, -1 when none exist. Sentinel-less dirs
+    (legacy in-place partial writes) and tmp- dirs are invisible here —
+    but a directory holding ONLY sentinel-less serials warns loudly:
+    that is either a pre-atomic-writer checkpoint set (inspect/migrate
+    with tools/ckpt_ls.py, never silently restart from scratch) or
+    every save so far has crashed mid-write."""
+    serials = complete_serials(checkpoint_dir)
+    if not serials:
+        legacy = all_serials(checkpoint_dir)
+        if legacy:
+            import warnings
+
+            warnings.warn(
+                "checkpoint dir %s holds %d serial dir(s) but none has "
+                "a %s sentinel — pre-atomic-writer checkpoints or "
+                "crashed saves; they will NOT be loaded (inspect with "
+                "tools/ckpt_ls.py)" % (
+                    checkpoint_dir, len(legacy), SENTINEL))
+        return -1
+    return serials[-1]
+
+
+def next_serial(checkpoint_dir: str) -> int:
+    """Next unused serial (counts partials too, so a crashed slot is
+    never renamed onto)."""
+    serials = all_serials(checkpoint_dir)
+    return (serials[-1] + 1) if serials else 0
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except OSError as e:
+        return e.errno == errno.EPERM  # exists, owned by someone else
+    return True
+
+
+def stale_partials(checkpoint_dir: str) -> List[str]:
+    """tmp- partials whose writer process is gone: crashed mid-write,
+    safe to sweep. A live writer's tmp dir (its pid answers signal 0) is
+    left alone."""
+    if not os.path.isdir(checkpoint_dir):
+        return []
+    out = []
+    for entry in os.listdir(checkpoint_dir):
+        if not entry.startswith(TMP_PREFIX):
+            continue
+        m = re.search(r"\.(\d+)\.[0-9a-f]+$", entry)
+        if m and _pid_alive(int(m.group(1))):
+            continue
+        out.append(os.path.join(checkpoint_dir, entry))
+    return out
+
+
+def sweep_stale_partials(checkpoint_dir: str) -> List[str]:
+    """Remove crashed partials; returns what was removed."""
+    removed = []
+    for path in stale_partials(checkpoint_dir):
+        shutil.rmtree(path, ignore_errors=True)
+        removed.append(path)
+    return removed
+
+
+def write_checkpoint(
+    checkpoint_dir: str,
+    serial: int,
+    files: Dict[str, bytes],
+    *,
+    meta: Optional[dict] = None,
+    fault: Callable[[str], None] = faults.fault_point,
+) -> str:
+    """Write one checkpoint atomically; returns the final directory.
+
+    ``files`` maps file name -> bytes (e.g. the persistables npz).
+    ``meta`` (json-serialized to meta.json) rides along when given.
+    Named fault barriers (``ckpt.before_files`` / ``ckpt.after_files`` /
+    ``ckpt.before_sentinel`` / ``ckpt.before_rename`` /
+    ``ckpt.after_rename``) let the chaos harness kill or delay the
+    writer at every interesting instant.
+    """
+    os.makedirs(checkpoint_dir, exist_ok=True)
+    final = serial_dir(checkpoint_dir, serial)
+    tmp = os.path.join(
+        checkpoint_dir, "%s%s%d.%d.%s" % (
+            TMP_PREFIX, CKPT_PREFIX, serial, os.getpid(),
+            uuid.uuid4().hex[:8]))
+    os.makedirs(tmp)
+    # a CRASH (SIGKILL) anywhere below leaves the tmp partial for
+    # post-mortem (ckpt_ls lists it; sweep_stale_partials retires it
+    # once this pid is gone) — nothing CAN clean up then. A python
+    # EXCEPTION, by contrast, cleans its own tmp dir: a retrying writer
+    # would otherwise strand one full-size partial per failed attempt
+    # for the process lifetime (live-pid partials are never swept).
+    # `final` only ever appears via the rename — the single
+    # publication point.
+    try:
+        fault("ckpt.before_files")
+        nbytes = 0
+        for name, data in files.items():
+            write_file_durable(os.path.join(tmp, name), data)
+            nbytes += len(data)
+        if meta is not None:
+            blob = json.dumps(meta, sort_keys=True).encode()
+            write_file_durable(os.path.join(tmp, META_FILE), blob)
+            nbytes += len(blob)
+        fault("ckpt.after_files")
+        fault("ckpt.before_sentinel")
+        write_file_durable(
+            os.path.join(tmp, SENTINEL),
+            json.dumps({"v": 1, "nbytes": nbytes,
+                        "completed_at": time.time()}).encode())
+        _fsync_path(tmp)
+        fault("ckpt.before_rename")
+        os.rename(tmp, final)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    _fsync_path(checkpoint_dir)
+    fault("ckpt.after_rename")
+    return final
+
+
+def read_meta(path: str) -> dict:
+    with open(os.path.join(path, META_FILE)) as f:
+        return json.load(f)
+
+
+def retention_gc(checkpoint_dir: str, keep: int) -> List[int]:
+    """Delete all but the newest ``keep`` COMPLETE checkpoints, plus
+    crashed ``tmp-`` partials of dead writers; returns the serials
+    removed. Sentinel-less NUMBERED dirs are deliberately left alone:
+    this writer never creates them, so they are either pre-atomic-
+    writer checkpoints (an operator may still want to migrate their
+    contents) or evidence of a crash worth inspecting — ``ckpt_ls``
+    lists them as PARTIAL, readers skip them, and their serial numbers
+    are never reused. Destroying data the new writer did not create is
+    not GC's call."""
+    removed = []
+    complete = complete_serials(checkpoint_dir)
+    for s in complete[:-keep] if keep > 0 else []:
+        shutil.rmtree(serial_dir(checkpoint_dir, s), ignore_errors=True)
+        removed.append(s)
+    sweep_stale_partials(checkpoint_dir)
+    return removed
+
+
+def dir_nbytes(path: str) -> int:
+    total = 0
+    for root, _dirs, names in os.walk(path):
+        for n in names:
+            try:
+                total += os.path.getsize(os.path.join(root, n))
+            except OSError:
+                pass
+    return total
+
+
+def list_entries(checkpoint_dir: str) -> List[Tuple[str, Optional[int], bool]]:
+    """(path, serial_or_None_for_partials, complete) for every numbered
+    dir and tmp- partial — the ckpt_ls enumeration."""
+    if not os.path.isdir(checkpoint_dir):
+        return []
+    out = []
+    for entry in sorted(os.listdir(checkpoint_dir)):
+        path = os.path.join(checkpoint_dir, entry)
+        m = re.fullmatch(CKPT_PREFIX + r"(\d+)", entry)
+        if m:
+            out.append((path, int(m.group(1)), is_complete(path)))
+        elif entry.startswith(TMP_PREFIX):
+            out.append((path, None, False))
+    return out

@@ -1,0 +1,372 @@
+"""Async CheckpointManager: snapshots off the step path, crash-safe.
+
+The training step's only cost is the state SNAPSHOT (a host copy of
+every persistable, ``paddle_tpu_ckpt_save_ms{mode="snapshot"}``); the
+npz encode, fsyncs, and atomic rename happen in a background writer
+thread. Staleness is bounded, not unbounded: at most ``max_pending``
+snapshots may be queued, and a ``save()`` beyond that BLOCKS the
+trainer until the writer drains — a slow disk slows training, it never
+silently drops checkpoints.
+
+Failure ladder (never silent):
+1. each write attempt that raises a transient error is retried up to
+   ``retries`` times with exponential backoff
+   (``paddle_tpu_ckpt_retries_total``);
+2. a snapshot that exhausts its retries is counted
+   (``paddle_tpu_ckpt_failures_total``), warned about, remembered in
+   ``last_error``, and flips the manager into DEGRADED mode;
+3. degraded mode writes synchronously in the caller's thread (the
+   step path pays the IO, so pressure is visible) and RAISES on
+   failure; a success heals back to async.
+
+A save that was queued behind a failed one still writes — each queue
+entry is independent; losing checkpoint N while N+1 lands costs
+nothing (N+1 strictly supersedes it).
+
+Restore (``restore()`` / ``restore_into()``) loads the NEWEST COMPLETE
+serial: partials from a mid-write SIGKILL are invisible by
+construction (layout.py), crashed tmp dirs are swept.
+"""
+from __future__ import annotations
+
+import io as _io
+import os
+import queue
+import threading
+import time
+import warnings
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .. import observability as obs
+from . import faults, layout
+
+__all__ = ["CheckpointManager", "CheckpointWriteError", "device_owned",
+           "device_owned_tree"]
+
+
+def device_owned_tree(arrays: Dict[str, "np.ndarray"]) -> Dict[str, object]:
+    """XLA-owned device copies of every array in ``arrays``. Restored
+    state must enter the scope as buffers XLA allocated itself: the
+    executor's compiled steps DONATE state buffers, and donating a
+    zero-copy view of numpy-owned memory lets XLA free/reuse memory it
+    never allocated — observed as heap corruption or silently garbage
+    parameters on the warm-AOT resume path.
+
+    ``device_put`` usually copies (cheap, no compile); arrays it
+    provably ALIASED instead (alignment-dependent on CPU: 16-byte-
+    aligned host buffers are shared, not copied) are retried from a
+    deliberately MISALIGNED host copy, which device_put must copy — a
+    memcpy instead of a per-shape XLA compile. Anything still aliased
+    after that (or whose ownership can't be verified) goes through one
+    jitted tree-copy, whose outputs XLA allocates by construction."""
+    import jax
+    import jax.numpy as jnp
+
+    def put_checked(host):
+        put = jax.device_put(host)
+        try:
+            return put, put.unsafe_buffer_pointer() == host.ctypes.data
+        except Exception:
+            return put, True  # can't prove ownership: assume the worst
+
+    def misaligned(a):
+        # same bytes at an address that is NOT 16-aligned (but still
+        # itemsize-aligned, as numpy requires). Impossible when the
+        # itemsize is itself a multiple of 16 (complex128: every
+        # itemsize-aligned offset is 16-aligned too) — those fall back
+        # to the jitted copy below.
+        step = max(a.itemsize, 1)
+        if a.nbytes == 0 or step >= 16 or 16 % step != 0:
+            return None
+        buf = np.empty(a.nbytes + 16 + step, np.uint8)
+        off = step
+        while (buf.ctypes.data + off) % 16 == 0:
+            off += step
+        view = buf[off:off + a.nbytes].view(a.dtype).reshape(a.shape)
+        view[...] = a
+        return view
+
+    out = {}
+    still_aliased = {}
+    for name, val in arrays.items():
+        host = np.asarray(val)
+        put, is_aliased = put_checked(host)
+        if is_aliased:
+            retry = misaligned(host)
+            if retry is not None:
+                put, is_aliased = put_checked(retry)
+        if is_aliased:
+            still_aliased[name] = put
+        else:
+            out[name] = put
+    if still_aliased:
+        copied = jax.jit(
+            lambda tree: {k: jnp.copy(v) for k, v in tree.items()}
+        )(still_aliased)
+        out.update(copied)
+    return out
+
+
+def device_owned(val):
+    """Single-array ``device_owned_tree`` (see its docstring)."""
+    return device_owned_tree({"v": val})["v"]
+
+
+class CheckpointWriteError(RuntimeError):
+    """A checkpoint could not be written even after retries."""
+
+
+def _np_name(name: str) -> str:
+    # io/__init__.py convention: var names are filesystem-safe except "/"
+    return name.replace("/", "%2F")
+
+
+def _encode_npz(arrays: Dict[str, np.ndarray]) -> bytes:
+    buf = _io.BytesIO()
+    np.savez(buf, **{_np_name(k): v for k, v in arrays.items()})
+    return buf.getvalue()
+
+
+def _decode_npz(path: str) -> Dict[str, np.ndarray]:
+    with np.load(path) as npz:
+        return {k.replace("%2F", "/"): npz[k] for k in npz.files}
+
+
+class CheckpointManager:
+    """See the module docstring. Constructor arguments:
+
+    directory — the checkpoint root (serial dirs live inside).
+    max_num_checkpoints — retention: complete serials kept on disk.
+    max_pending — queued async snapshots before save() blocks (bounded
+        staleness; 0 = fully synchronous manager).
+    retries / backoff_s — transient-IO retry ladder per write
+        (backoff doubles per attempt).
+    """
+
+    def __init__(self, directory: str, *, max_num_checkpoints: int = 3,
+                 max_pending: int = 2, retries: int = 3,
+                 backoff_s: float = 0.05):
+        self.directory = str(directory)
+        self.max_num_checkpoints = max(int(max_num_checkpoints), 1)
+        self.max_pending = max(int(max_pending), 0)
+        self.retries = max(int(retries), 0)
+        self.backoff_s = float(backoff_s)
+        self._lock = threading.Lock()
+        self._queue: "queue.Queue" = queue.Queue(maxsize=max(self.max_pending, 1))
+        self._writer: Optional[threading.Thread] = None
+        self._degraded = False
+        self._closed = False
+        self.last_error: Optional[BaseException] = None
+        # snapshots accepted but not yet durably on disk: incremented
+        # BEFORE a save enqueues, decremented AFTER its write finishes
+        # — wait() polls this, so it can never return mid-write (an
+        # idle-event design raced: a stale set() landing after a new
+        # save's clear() made wait() return while the writer was still
+        # encoding)
+        self._inflight = 0
+        # serials: never reuse a number any dir (even a partial) holds
+        self._next_serial = layout.next_serial(self.directory)
+        layout.sweep_stale_partials(self.directory)
+
+    # -- snapshot ---------------------------------------------------------
+    @staticmethod
+    def snapshot(program, scope) -> Dict[str, np.ndarray]:
+        """Copy every persistable with a value out of the scope as host
+        numpy arrays — the only work the step path pays for an async
+        save. Explicit copies: the executor donates state buffers back
+        into the scope each step, so the writer thread must never hold
+        views into live training state."""
+        t0 = time.perf_counter()
+        arrays = {}
+        for v in program.list_vars():
+            if not getattr(v, "persistable", False):
+                continue
+            val = scope.find_var(v.name)
+            if val is not None:
+                arrays[v.name] = np.array(val, copy=True)
+        obs.CKPT_SAVE_MS.observe((time.perf_counter() - t0) * 1e3,
+                                 mode="snapshot")
+        return arrays
+
+    # -- save -------------------------------------------------------------
+    def save(self, arrays: Dict[str, np.ndarray], meta: Optional[dict] = None,
+             *, block: bool = False) -> int:
+        """Queue one snapshot for the background writer; returns the
+        serial it will land at. Blocks when ``max_pending`` snapshots
+        are already queued (or always, with ``block=True`` /
+        ``max_pending=0``), and raises ``CheckpointWriteError`` when the
+        manager is degraded and the synchronous write fails too."""
+        if self._closed:
+            raise RuntimeError("CheckpointManager is closed")
+        with self._lock:
+            serial = self._next_serial
+            self._next_serial += 1
+        if block or self.max_pending == 0 or self._degraded:
+            self._write(serial, arrays, meta, mode="sync")
+            return serial
+        self._ensure_writer()
+        with self._lock:
+            self._inflight += 1
+        self._queue.put((serial, arrays, meta))  # blocks at max_pending
+        obs.CKPT_PENDING.set(self._queue.qsize())
+        return serial
+
+    def _ensure_writer(self):
+        with self._lock:
+            if self._writer is None or not self._writer.is_alive():
+                self._writer = threading.Thread(
+                    target=self._writer_main, name="ptpu-ckpt-writer",
+                    daemon=True)
+                self._writer.start()
+
+    def _writer_main(self):
+        while True:
+            try:
+                item = self._queue.get(timeout=0.2)
+            except queue.Empty:
+                if self._closed:
+                    return
+                continue
+            if item is None:  # close() sentinel
+                return
+            serial, arrays, meta = item
+            try:
+                self._write(serial, arrays, meta, mode="async")
+            except BaseException as e:  # noqa: BLE001 — ladder step 2
+                self.last_error = e
+                self._degraded = True
+                obs.CKPT_FAILURES.inc()
+                warnings.warn(
+                    "async checkpoint %d failed after %d retries (%s); "
+                    "degrading to synchronous saves" % (
+                        serial, self.retries, e))
+            finally:
+                with self._lock:
+                    self._inflight -= 1
+                obs.CKPT_PENDING.set(self._queue.qsize())
+
+    def _write(self, serial: int, arrays, meta, *, mode: str):
+        t0 = time.perf_counter()
+        delay = self.backoff_s
+        attempt = 0
+        blob = _encode_npz(arrays)  # attempt-invariant: encode ONCE
+        while True:
+            try:
+                layout.write_checkpoint(
+                    self.directory, serial,
+                    {layout.PERSISTABLES_FILE: blob}, meta=meta or {})
+                break
+            except Exception as e:
+                attempt += 1
+                if attempt > self.retries:
+                    obs.CKPT_SAVES.inc(mode=mode, result="error")
+                    self.last_error = e
+                    if mode == "sync":
+                        obs.CKPT_FAILURES.inc()
+                        raise CheckpointWriteError(
+                            "checkpoint %d could not be written under %s "
+                            "after %d attempts (%s: %s)" % (
+                                serial, self.directory, attempt,
+                                type(e).__name__, e)) from e
+                    raise
+                obs.CKPT_RETRIES.inc()
+                time.sleep(delay)
+                delay *= 2
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        obs.CKPT_SAVE_MS.observe(wall_ms, mode=mode)
+        obs.CKPT_SAVES.inc(mode=mode, result="ok")
+        obs.CKPT_BYTES.inc(
+            layout.dir_nbytes(layout.serial_dir(self.directory, serial)))
+        if mode == "sync" and self._degraded:
+            self._degraded = False  # healed: async resumes next save
+        layout.retention_gc(self.directory, self.max_num_checkpoints)
+
+    # -- drain / lifecycle -----------------------------------------------
+    @property
+    def pending(self) -> int:
+        return self._queue.qsize()
+
+    @property
+    def degraded(self) -> bool:
+        return self._degraded
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until every accepted snapshot is durably on disk (or
+        loudly failed) — True; or the timeout expires — False."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._lock:
+                done = self._inflight == 0
+            if done:
+                return True
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+            time.sleep(0.01)
+
+    def close(self, *, wait: bool = True):
+        """Drain (by default) and stop the writer. Idempotent."""
+        if self._closed:
+            return
+        if wait:
+            self.wait()
+        self._closed = True
+        w = self._writer
+        if w is not None and w.is_alive():
+            try:
+                self._queue.put_nowait(None)
+            except queue.Full:
+                pass
+            w.join(timeout=10.0)
+        self._writer = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- restore ----------------------------------------------------------
+    def latest(self) -> int:
+        """Newest complete serial on disk (-1 = none)."""
+        return layout.latest_serial(self.directory)
+
+    def restore(self, serial: Optional[int] = None
+                ) -> Tuple[Dict[str, np.ndarray], dict]:
+        """(arrays, meta) of the given (default: newest complete)
+        serial; raises FileNotFoundError when none exists."""
+        t0 = time.perf_counter()
+        if serial is None:
+            serial = self.latest()
+        if serial < 0:
+            raise FileNotFoundError(
+                "no complete checkpoint under %s" % self.directory)
+        path = layout.serial_dir(self.directory, serial)
+        if not layout.is_complete(path):
+            raise FileNotFoundError(
+                "checkpoint %d under %s is incomplete (no %s sentinel)"
+                % (serial, self.directory, layout.SENTINEL))
+        faults.fault_point("ckpt.before_restore")
+        arrays = _decode_npz(os.path.join(path, layout.PERSISTABLES_FILE))
+        meta = layout.read_meta(path)
+        # the serial the arrays ACTUALLY came from (re-scanning latest()
+        # later could race a concurrent writer publishing a newer one)
+        meta["_serial"] = serial
+        obs.CKPT_RESTORE_MS.observe((time.perf_counter() - t0) * 1e3)
+        return arrays, meta
+
+    def restore_into(self, scope, *, serial: Optional[int] = None
+                     ) -> Optional[dict]:
+        """Load the newest complete checkpoint's arrays into ``scope``
+        and return its meta; None when no checkpoint exists (a fresh
+        run)."""
+        try:
+            arrays, meta = self.restore(serial=serial)
+        except FileNotFoundError:
+            return None
+        for name, val in device_owned_tree(arrays).items():
+            scope.set_var(name, val)
+        meta = dict(meta)  # "_serial" already set by restore()
+        meta["_restored_names"] = sorted(arrays)
+        return meta

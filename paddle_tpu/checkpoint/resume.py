@@ -1,0 +1,200 @@
+"""ResumableLoop: elastic training for raw ``Executor`` loops.
+
+``Trainer.fit(resumable=True)`` packages the same contract for the
+high-level API; this helper is for code that drives ``Executor.run`` /
+``run_loop`` directly (benches, custom loops, the chaos harness):
+
+    loop = ResumableLoop(exe, program, ckpt_dir, loader=loader,
+                         step_interval=10)
+    for epoch in loop.epochs(num_epochs):
+        for feed in loop.skip(batches_for(epoch)):
+            exe.run(program, feed=feed, fetch_list=[loss])
+            loop.step_done()
+        loop.end_epoch()
+    loop.close()
+
+Construction restores the newest COMPLETE checkpoint when one exists:
+persistables back into the scope, the per-program RNG step fold back
+into the executor (stochastic ops replay the exact stream), the
+DataLoader's epoch/offset state (sample-exact: the resumed epoch
+continues at the next untrained batch), and the epoch/step counters.
+``step_done()`` then async-checkpoints every ``step_interval`` batches
+through the CheckpointManager; a SIGKILL at any instant costs at most
+``step_interval`` batches of recompute and can never corrupt the
+newest checkpoint or duplicate/drop a sample.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import warnings
+from typing import Iterable, Optional
+
+from ..framework.scope import global_scope
+from .manager import CheckpointManager
+
+__all__ = ["ResumableLoop", "CheckpointFingerprintWarning",
+           "CheckpointMismatchError", "check_fingerprint", "build_meta"]
+
+
+def build_meta(program, executor, *, epoch: int, offset: int,
+               global_step: int, loader=None,
+               extra: Optional[dict] = None) -> dict:
+    """The ONE checkpoint-meta schema every resume consumer reads —
+    ResumableLoop and Trainer.fit both write through here, so the
+    fields (epoch / offset / global_step / rng_step / fingerprint /
+    persistable_names / data_state) cannot diverge between writers."""
+    meta = {
+        "epoch": int(epoch),
+        "offset": int(offset),
+        "global_step": int(global_step),
+        "fingerprint": program.fingerprint(),
+        "persistable_names": sorted(
+            v.name for v in program.list_vars()
+            if getattr(v, "persistable", False)),
+    }
+    if hasattr(executor, "program_steps"):
+        meta["rng_step"] = executor.program_steps(program)
+    if loader is not None and hasattr(loader, "state_dict"):
+        meta["data_state"] = loader.state_dict()
+    if extra:
+        meta.update(extra)
+    return meta
+
+
+class CheckpointFingerprintWarning(UserWarning):
+    """Stable category for program-fingerprint mismatches on restore
+    (pin it with ``pytest.warns`` / ``filterwarnings``)."""
+
+
+class CheckpointMismatchError(RuntimeError):
+    """Strict-mode restore refused a checkpoint written by a different
+    program version."""
+
+
+def _strict_env() -> bool:
+    return os.environ.get("PADDLE_TPU_CKPT_STRICT", "0") == "1"
+
+
+def check_fingerprint(meta: dict, program, *, strict: Optional[bool] = None,
+                      saved_names: Optional[Iterable[str]] = None,
+                      current_names: Optional[Iterable[str]] = None):
+    """Compare a checkpoint meta's program fingerprint against the
+    program about to consume it. ``strict=None`` defers to
+    ``PADDLE_TPU_CKPT_STRICT=1``; strict raises CheckpointMismatchError
+    with both fingerprints and the differing persistable names,
+    non-strict warns (CheckpointFingerprintWarning) and loads anyway
+    (var-name matched)."""
+    saved_fp = meta.get("fingerprint")
+    if saved_fp is None:
+        return
+    cur_fp = program.fingerprint()
+    if saved_fp == cur_fp:
+        return
+    if strict is None:
+        strict = _strict_env()
+    saved = set(saved_names or meta.get("persistable_names") or ())
+    cur = set(current_names or
+              (v.name for v in program.list_vars()
+               if getattr(v, "persistable", False)))
+    only_ckpt = sorted(saved - cur)
+    only_prog = sorted(cur - saved)
+    detail = ""
+    if saved:
+        detail = ("; vars only in checkpoint: %s; vars only in program: %s"
+                  % (only_ckpt or "none", only_prog or "none"))
+    msg = ("checkpoint was written by a different program version "
+           "(checkpoint fingerprint %s, current %s)%s" % (
+               saved_fp, cur_fp, detail))
+    if strict:
+        raise CheckpointMismatchError(msg)
+    warnings.warn(msg + "; loading anyway (var-name matched)",
+                  CheckpointFingerprintWarning, stacklevel=3)
+
+
+class ResumableLoop:
+    """See the module docstring."""
+
+    def __init__(self, executor, program, checkpoint_dir: str, *,
+                 scope=None, manager: Optional[CheckpointManager] = None,
+                 loader=None, step_interval: int = 10,
+                 max_num_checkpoints: int = 3, max_pending: int = 2,
+                 strict: Optional[bool] = None):
+        self.exe = executor
+        self.program = program
+        self.scope = scope if scope is not None else global_scope()
+        self.loader = loader
+        self.step_interval = max(int(step_interval), 1)
+        self.manager = manager or CheckpointManager(
+            checkpoint_dir, max_num_checkpoints=max_num_checkpoints,
+            max_pending=max_pending)
+        self.epoch = 0
+        self.offset = 0  # batches completed in the current epoch
+        self.global_step = 0
+        self.resumed_meta = None
+
+        meta = self.manager.restore_into(self.scope)
+        if meta is not None:
+            check_fingerprint(meta, program, strict=strict)
+            self.epoch = int(meta.get("epoch", 0))
+            self.offset = int(meta.get("offset", 0))
+            self.global_step = int(meta.get("global_step", 0))
+            rng_step = meta.get("rng_step")
+            if rng_step is not None and hasattr(executor,
+                                                "set_program_steps"):
+                executor.set_program_steps(program, int(rng_step))
+            data_state = meta.get("data_state")
+            if loader is not None and data_state:
+                loader.load_state_dict(data_state)
+            self.resumed_meta = meta
+
+    # -- iteration --------------------------------------------------------
+    def epochs(self, num_epochs: int):
+        """Epoch ids still to train (resume-aware)."""
+        return range(self.epoch, int(num_epochs))
+
+    def skip(self, batches: Iterable):
+        """Apply the resumed batch offset to a plain per-epoch batch
+        iterable. A DataLoader given at construction already skips
+        inside its workers (load_state_dict), so this is a no-op then —
+        iterate the loader directly."""
+        it = iter(batches)
+        if self.offset and self.loader is None and self.epoch == (
+                self.resumed_meta or {}).get("epoch", -1):
+            it = itertools.islice(it, self.offset, None)
+        return it
+
+    # -- progress ---------------------------------------------------------
+    def _meta(self, extra: Optional[dict] = None) -> dict:
+        return build_meta(self.program, self.exe, epoch=self.epoch,
+                          offset=self.offset,
+                          global_step=self.global_step,
+                          loader=self.loader, extra=extra)
+
+    def step_done(self, batches: int = 1, extra_meta: Optional[dict] = None):
+        """Record ``batches`` trained batches; checkpoints (async) when
+        the global step crosses the step_interval cadence."""
+        before = self.global_step // self.step_interval
+        self.offset += int(batches)
+        self.global_step += int(batches)
+        if self.global_step // self.step_interval != before:
+            self.save_now(extra_meta=extra_meta)
+
+    def end_epoch(self, extra_meta: Optional[dict] = None):
+        """Close the epoch: bump the counter, reset the offset, and
+        checkpoint the boundary (so a restart never replays a finished
+        epoch)."""
+        self.epoch += 1
+        self.offset = 0
+        self.save_now(extra_meta=extra_meta)
+
+    def save_now(self, *, block: bool = False,
+                 extra_meta: Optional[dict] = None) -> int:
+        """Snapshot + queue a checkpoint right now (the cadence-driven
+        path calls this; explicit calls are fine too)."""
+        arrays = self.manager.snapshot(self.program, self.scope)
+        return self.manager.save(arrays, self._meta(extra_meta),
+                                 block=block)
+
+    def close(self, *, wait: bool = True):
+        self.manager.close(wait=wait)

@@ -565,6 +565,18 @@ class Executor:
         self._last_step = cur + n - 1
         return cur
 
+    def program_steps(self, program: Program) -> int:
+        """Steps executed on `program`'s own stream — the per-step RNG
+        fold position. Checkpoint it (checkpoint/ResumableLoop does) so
+        a resumed run replays the exact stochastic-op stream (dropout
+        masks, sampling) the uninterrupted run would have drawn."""
+        return self._steps.get(program, 0)
+
+    def set_program_steps(self, program: Program, n: int):
+        """Restore `program`'s step stream position (the inverse of
+        ``program_steps``, for sample-exact resume)."""
+        self._steps[program] = int(n)
+
     def _read_ops_for(self, program: Program, gb):
         """(Static) read-op list, cached per program version so the hot
         path does not rescan every op each step."""

@@ -20,12 +20,16 @@ from __future__ import annotations
 
 import json
 import os
-import re
-import shutil
 from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..checkpoint import (
+    CheckpointFingerprintWarning,
+    CheckpointMismatchError,
+    check_fingerprint,
+)
+from ..checkpoint import layout as _ckpt_layout
 from ..framework.core import Parameter, Program, Variable, default_main_program
 from ..framework.scope import Scope, global_scope
 
@@ -49,11 +53,13 @@ __all__ = [
     "get_parameter_value_by_name",
     "save_sharded_checkpoint",
     "load_sharded_checkpoint",
+    "CheckpointFingerprintWarning",
+    "CheckpointMismatchError",
     "DataLoader",
 ]
 
 _MODEL_FILE = "__model__"
-_CKPT_PREFIX = "checkpoint_"
+_CKPT_PREFIX = _ckpt_layout.CKPT_PREFIX
 
 
 def is_parameter(var: Variable) -> bool:
@@ -141,8 +147,13 @@ def load_vars(
     filename: Optional[str] = None,
     scope: Optional[Scope] = None,
 ):
-    """Reference: io.py:load_vars. Loaded arrays are set in the Scope and
-    re-land on device at the next jitted step (XLA transfers once)."""
+    """Reference: io.py:load_vars. Loaded arrays are set in the Scope as
+    XLA-owned device buffers (checkpoint.manager.device_owned): compiled
+    training steps DONATE state buffers, and donating memory XLA did not
+    allocate (a zero-copy view of a numpy array) corrupts the heap on
+    the warm-AOT resume path."""
+    from ..checkpoint.manager import device_owned_tree
+
     scope = _scope_of(executor, scope)
     if vars is None:
         program = main_program if main_program is not None else default_main_program()
@@ -151,17 +162,23 @@ def load_vars(
     if filename is not None:
         with np.load(_npz_path(dirname, filename)) as npz:
             data = {k: npz[k] for k in npz.files}
+        wanted = {}
         for name in names:
             key = _np_name(name)
             if key not in data:
                 raise RuntimeError("variable %r not found in %s" % (name, filename))
-            scope.set_var(name, data[key])
+            wanted[name] = data[key]
+        for name, val in device_owned_tree(wanted).items():
+            scope.set_var(name, val)
     else:
+        loaded = {}
         for name in names:
             path = os.path.join(dirname, _np_name(name) + ".npy")
             if not os.path.exists(path):
                 raise RuntimeError("variable file %s does not exist" % path)
-            scope.set_var(name, np.load(path))
+            loaded[name] = np.load(path)
+        for name, val in device_owned_tree(loaded).items():
+            scope.set_var(name, val)
     return sorted(names)
 
 
@@ -263,6 +280,8 @@ def load_inference_model(
 ):
     """Reference: io.py:load_inference_model →
     (program, feed_target_names, fetch_targets)."""
+    from ..checkpoint.manager import device_owned_tree
+
     model_filename = model_filename or _MODEL_FILE
     with open(os.path.join(dirname, model_filename)) as f:
         meta = json.load(f)
@@ -271,8 +290,10 @@ def load_inference_model(
     path = _npz_path(dirname, params_filename or "__params__.npz")
     if os.path.exists(path):
         with np.load(path) as npz:
-            for key in npz.files:
-                scope.set_var(key.replace("%2F", "/"), npz[key])
+            params = {key.replace("%2F", "/"): npz[key]
+                      for key in npz.files}
+        for name, val in device_owned_tree(params).items():
+            scope.set_var(name, val)
     fetch_targets = [program.global_block().var(n) for n in meta["fetch_names"]]
     return program, list(meta["feed_names"]), fetch_targets
 
@@ -291,27 +312,42 @@ def save_checkpoint(
     step: int = 0,
     epoch: int = 0,
     scope: Optional[Scope] = None,
+    extra_meta: Optional[dict] = None,
 ):
     """Reference: trainer.py:save_checkpoint — serial-numbered dirs with
-    retention; stores every persistable + meta (step/epoch/fingerprint)."""
+    retention; stores every persistable + meta (step/epoch/fingerprint).
+
+    Crash-safe: the whole checkpoint is assembled in a ``tmp-`` sibling
+    (files fsynced, ``_COMPLETE`` sentinel last) and atomically renamed
+    into place (checkpoint/layout.py) — a crash mid-save can no longer
+    leave a highest-numbered corrupt serial that bricks the next
+    restart. Readers skip anything without the sentinel."""
+    from ..checkpoint.manager import _encode_npz
+
     program = main_program if main_program is not None else default_main_program()
-    serial = get_latest_checkpoint_serial(checkpoint_dir) + 1
-    cur = os.path.join(checkpoint_dir, _CKPT_PREFIX + "%d" % serial)
-    os.makedirs(cur, exist_ok=True)
-    save_persistables(executor, cur, main_program=program,
-                      filename="__persistables__.npz", scope=scope)
-    with open(os.path.join(cur, "meta.json"), "w") as f:
-        json.dump({
-            "step": step,
-            "epoch": epoch,
-            "trainer_id": trainer_id,
-            "fingerprint": program.fingerprint(),
-        }, f)
-    # retention
-    serials = _checkpoint_serials(checkpoint_dir)
-    for s in serials[:-max_num_checkpoints]:
-        shutil.rmtree(os.path.join(checkpoint_dir, _CKPT_PREFIX + "%d" % s),
-                      ignore_errors=True)
+    scope = _scope_of(executor, scope)
+    arrays: Dict[str, np.ndarray] = {}
+    for v in program.list_vars():
+        if is_persistable(v):
+            val = scope.find_var(v.name)
+            if val is None:
+                raise RuntimeError(
+                    "variable %r has no value in scope" % v.name)
+            arrays[v.name] = np.asarray(val)
+    serial = _ckpt_layout.next_serial(checkpoint_dir)
+    meta = {
+        "step": step,
+        "epoch": epoch,
+        "trainer_id": trainer_id,
+        "fingerprint": program.fingerprint(),
+        "persistable_names": sorted(arrays),
+    }
+    if extra_meta:
+        meta.update(extra_meta)
+    _ckpt_layout.write_checkpoint(
+        checkpoint_dir, serial,
+        {_ckpt_layout.PERSISTABLES_FILE: _encode_npz(arrays)}, meta=meta)
+    _ckpt_layout.retention_gc(checkpoint_dir, max_num_checkpoints)
     return serial
 
 
@@ -321,53 +357,58 @@ def load_checkpoint(
     serial: Optional[int] = None,
     main_program: Optional[Program] = None,
     scope: Optional[Scope] = None,
+    strict: Optional[bool] = None,
 ) -> dict:
     """Reference: trainer.py:load_checkpoint. Returns the meta dict
-    (step/epoch) so training loops can resume counters."""
+    (step/epoch) so training loops can resume counters.
+
+    Only COMPLETE checkpoints load: incomplete or sentinel-less serials
+    (a crash mid-save under the old in-place writer) are skipped when
+    picking the newest, and refused when named explicitly. A program-
+    fingerprint mismatch warns (``CheckpointFingerprintWarning``) by
+    default; ``strict=True`` (or ``PADDLE_TPU_CKPT_STRICT=1``) raises
+    ``CheckpointMismatchError`` with both fingerprints and the
+    differing persistable names — BEFORE any scope mutation."""
     program = main_program if main_program is not None else default_main_program()
     if serial is None:
         serial = get_latest_checkpoint_serial(checkpoint_dir)
     if serial < 0:
-        raise RuntimeError("no checkpoint found under %s" % checkpoint_dir)
-    cur = os.path.join(checkpoint_dir, _CKPT_PREFIX + "%d" % serial)
+        raise RuntimeError(
+            "no complete checkpoint found under %s (partial/corrupt "
+            "saves are skipped)" % checkpoint_dir)
+    cur = _ckpt_layout.serial_dir(checkpoint_dir, serial)
+    if not _ckpt_layout.is_complete(cur):
+        raise RuntimeError(
+            "checkpoint serial %d under %s is incomplete (missing the %s "
+            "sentinel — likely a crashed save); pass serial=None to load "
+            "the newest complete one" % (
+                serial, checkpoint_dir, _ckpt_layout.SENTINEL))
+    meta = _ckpt_layout.read_meta(cur)
+    check_fingerprint(meta, program, strict=strict)
     load_persistables(executor, cur, main_program=program,
                       filename="__persistables__.npz", scope=scope)
-    with open(os.path.join(cur, "meta.json")) as f:
-        meta = json.load(f)
-    if meta.get("fingerprint") not in (None, program.fingerprint()):
-        import warnings
-
-        warnings.warn(
-            "checkpoint was written by a different program version; "
-            "loading anyway (var-name matched)")
     return meta
 
 
 def clean_checkpoint(checkpoint_dir: str, delete_dir: bool = False):
-    """Reference: trainer.py:clean_checkpoint."""
-    for s in _checkpoint_serials(checkpoint_dir):
-        shutil.rmtree(os.path.join(checkpoint_dir, _CKPT_PREFIX + "%d" % s),
+    """Reference: trainer.py:clean_checkpoint (partials included)."""
+    import shutil
+
+    for s in _ckpt_layout.all_serials(checkpoint_dir):
+        shutil.rmtree(_ckpt_layout.serial_dir(checkpoint_dir, s),
                       ignore_errors=True)
+    for path, serial, _complete in _ckpt_layout.list_entries(checkpoint_dir):
+        if serial is None:
+            shutil.rmtree(path, ignore_errors=True)
     if delete_dir and os.path.isdir(checkpoint_dir) and not os.listdir(checkpoint_dir):
         os.rmdir(checkpoint_dir)
 
 
-def _checkpoint_serials(checkpoint_dir: str) -> List[int]:
-    if not os.path.isdir(checkpoint_dir):
-        return []
-    out = []
-    for entry in os.listdir(checkpoint_dir):
-        m = re.fullmatch(_CKPT_PREFIX + r"(\d+)", entry)
-        if m:
-            out.append(int(m.group(1)))
-    return sorted(out)
-
-
 def get_latest_checkpoint_serial(checkpoint_dir: str) -> int:
     """Reference: io.py/trainer.py:get_latest_checkpoint_serial (-1 when
-    none exist)."""
-    serials = _checkpoint_serials(checkpoint_dir)
-    return serials[-1] if serials else -1
+    none exist). Counts COMPLETE checkpoints only — a crashed partial,
+    however high its serial, is invisible."""
+    return _ckpt_layout.latest_serial(checkpoint_dir)
 
 
 # ---------------------------------------------------------------------------
@@ -395,7 +436,18 @@ def save_sharded_checkpoint(
             if val is not None:
                 state[v.name] = val
     path = os.path.abspath(os.path.join(checkpoint_dir, "sharded_%d" % step))
-    ocp.PyTreeCheckpointer().save(path, state)
+    try:
+        os.makedirs(os.path.abspath(checkpoint_dir), exist_ok=True)
+        ocp.PyTreeCheckpointer().save(path, state)
+    except Exception as e:
+        # orbax failures surface as deep tracebacks (asyncio gather over
+        # per-array futures); translate to something actionable
+        raise RuntimeError(
+            "sharded checkpoint save to %r failed (%s: %s) — check that "
+            "%r is writable and has free space; orbax stages shard files "
+            "under the target before an atomic finalize, so nothing "
+            "partial was published" % (
+                path, type(e).__name__, e, checkpoint_dir)) from e
     return path
 
 
@@ -409,8 +461,29 @@ def load_sharded_checkpoint(
 
     scope = scope if scope is not None else global_scope()
     path = os.path.abspath(os.path.join(checkpoint_dir, "sharded_%d" % step))
-    state = ocp.PyTreeCheckpointer().restore(path)
-    for name, val in state.items():
+    if not os.path.isdir(path):
+        import re as _re
+
+        available = sorted(
+            int(m.group(1))
+            for entry in (os.listdir(checkpoint_dir)
+                          if os.path.isdir(checkpoint_dir) else [])
+            for m in [_re.fullmatch(r"sharded_(\d+)", entry)] if m)
+        raise FileNotFoundError(
+            "no sharded checkpoint for step %d under %s (available "
+            "steps: %s)" % (step, checkpoint_dir, available or "none"))
+    try:
+        state = ocp.PyTreeCheckpointer().restore(path)
+    except Exception as e:
+        raise RuntimeError(
+            "sharded checkpoint at %r is unreadable or incomplete "
+            "(%s: %s) — if the writing job was preempted mid-save, fall "
+            "back to an earlier step (available under %s)" % (
+                path, type(e).__name__, e, checkpoint_dir)) from e
+    from ..checkpoint.manager import device_owned_tree
+
+    # XLA-owned buffers: the executor donates state (see load_vars)
+    for name, val in device_owned_tree(dict(state)).items():
         scope.set_var(name, val)
     return sorted(state)
 

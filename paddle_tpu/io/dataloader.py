@@ -32,7 +32,11 @@ loops. Epoch semantics match io/reader.py: `start()` begins an epoch,
 exhaustion raises EOFException on every subsequent `next()` until
 `reset()`, and `ordered=True` (default) replays batches in exact source
 order each epoch; `ordered=False` trades order for latency (a slow batch
-never blocks finished siblings).
+never blocks finished siblings). `state_dict()`/`load_state_dict()`
+capture/restore the epoch + batch-offset position for sample-exact
+resume after preemption (checkpoint/ResumableLoop rides on this): the
+resumed epoch's already-trained batches are skipped inside the workers
+without paying decode.
 
 Worker sharding is deterministic: global batch index i belongs to worker
 i % num_workers, each worker iterating its own copy of the source
@@ -145,10 +149,14 @@ class _Task:
         self.drop_last = drop_last
         self.mapper = mapper
 
-    def batches(self, wid: int, nworkers: int):
+    def batches(self, wid: int, nworkers: int, start_seq: int = 0):
         """Yield (global_seq, rows) for the batches this worker owns.
         Every worker iterates the same source; batch i belongs to worker
-        i % nworkers — deterministic composition identical to serial."""
+        i % nworkers — deterministic composition identical to serial.
+        ``start_seq`` resumes an epoch mid-way (sample-exact restart):
+        earlier batches are stepped over WITHOUT paying mapper/assembly
+        — only the raw source iteration replays, which the DataLoader
+        contract already requires to be cheap."""
         if self.mode == "sample":
             it = self.source()
             seq = 0
@@ -158,7 +166,7 @@ class _Task:
                     return
                 if len(chunk) < self.batch_size and self.drop_last:
                     return
-                if seq % nworkers == wid:
+                if seq % nworkers == wid and seq >= start_seq:
                     if self.mapper is not None:
                         chunk = [self.mapper(s) for s in chunk]
                     chunk = [s if isinstance(s, tuple) else (s,)
@@ -170,7 +178,7 @@ class _Task:
                 seq += 1
         else:
             for seq, item in enumerate(self.source()):
-                if seq % nworkers != wid:
+                if seq % nworkers != wid or seq < start_seq:
                     continue
                 if self.mode == "tensor":
                     rows = [np.ascontiguousarray(np.asarray(a))
@@ -197,7 +205,8 @@ def _attach_shm(name: str):
 
 
 def _worker_main(wid: int, nworkers: int, task: _Task, shm_name: str,
-                 slot_bytes: int, free_q, result_q, stop):
+                 slot_bytes: int, free_q, result_q, stop,
+                 start_seq: int = 0):
     """Worker process body: iterate owned batches, write each into a free
     shared-memory slot (pickle fallback when it cannot ride a frame),
     send one small control message per batch. `busy` seconds (decode +
@@ -242,7 +251,7 @@ def _worker_main(wid: int, nworkers: int, task: _Task, shm_name: str,
 
         t0 = time.perf_counter()
         slot_wait = _SLOT_WAIT_S
-        for seq, rows in task.batches(wid, nworkers):
+        for seq, rows in task.batches(wid, nworkers, start_seq):
             busy_t += time.perf_counter() - t0
             if stop.is_set():
                 return
@@ -383,6 +392,13 @@ class DataLoader(ReaderBase):
         self._task: Optional[_Task] = None
         self._obs_name = "loader%d" % next(_LOADER_IDS)
 
+        # sample-exact resume state (state_dict/load_state_dict):
+        # finished epochs, batches emitted THIS epoch, and a pending
+        # offset the next start() applies as a worker-side skip
+        self._epochs_done = 0
+        self._epoch_batches = 0
+        self._pending_offset = 0
+
         self._shm = None  # created lazily on first start()
         self._procs: Optional[List] = None
         self._free_qs: Optional[List] = None  # per-worker slot pools
@@ -480,6 +496,7 @@ class DataLoader(ReaderBase):
             self._n_pickle += 1
             transport = "pickle"
         self._n_batches += 1
+        self._epoch_batches += 1
         obs.LOADER_BATCHES.inc(loader=self._obs_name, transport=transport)
         return dict(zip(self.var_names, rows))
 
@@ -500,7 +517,9 @@ class DataLoader(ReaderBase):
                 # the worker mode's respawn
                 self._exhausted = False
                 self._errored = None
-                self._inline_iter = self._task.batches(0, 1)
+                offset, self._pending_offset = self._pending_offset, 0
+                self._epoch_batches = offset
+                self._inline_iter = self._task.batches(0, 1, offset)
             return
         if self._procs is not None:
             if self._exhausted or self._errored is not None:
@@ -516,7 +535,9 @@ class DataLoader(ReaderBase):
         self._errored = None
         self._exhausted = False
         self._buffer = {}
-        self._next_seq = 0
+        offset, self._pending_offset = self._pending_offset, 0
+        self._next_seq = offset
+        self._epoch_batches = offset
         self._done = set()
         self._stop = self._ctx.Event()
         self._result_q = self._ctx.Queue(2 * self.capacity)
@@ -531,7 +552,7 @@ class DataLoader(ReaderBase):
                 target=_worker_main,
                 args=(w, self.num_workers, self._task, self._shm.name,
                       self.slot_bytes, self._free_qs[w], self._result_q,
-                      self._stop),
+                      self._stop, offset),
                 daemon=True, name="ptpu-loader-%s-w%d" % (self._obs_name, w))
             for w in range(self.num_workers)]
         try:
@@ -548,11 +569,56 @@ class DataLoader(ReaderBase):
 
     def reset(self):
         """Rewind after (or during) an epoch so the next start() replays
-        the source from the beginning."""
+        the source from the beginning (a pending resume offset is
+        discarded — replay-from-start contradicts mid-epoch resume)."""
         self._teardown()
         self._exhausted = False
         self._errored = None
         self._inline_iter = None
+        self._epoch_batches = 0
+        self._pending_offset = 0
+
+    # -- sample-exact resume ----------------------------------------------
+    def state_dict(self) -> Dict[str, int]:
+        """Position of the NEXT batch to deliver: finished epochs +
+        batches already emitted this epoch. Capture it at a checkpoint
+        boundary; hand it to ``load_state_dict`` on a fresh loader to
+        continue mid-epoch without replaying or skipping a sample.
+        Meaningful for ``ordered=True`` loaders (arrival order is not
+        replayable)."""
+        return {"v": 1, "epoch": self._epochs_done,
+                "offset": self._epoch_batches,
+                "ordered": bool(self.ordered)}
+
+    def load_state_dict(self, state: Dict[str, int]):
+        """Arm the next ``start()`` to resume at ``state``: the first
+        ``offset`` batches of the epoch are skipped INSIDE the workers
+        (mapper/assembly never run for them; only the cheap raw source
+        iteration replays). Call before the epoch starts — a loader
+        mid-epoch must ``reset()`` first."""
+        if not isinstance(state, dict) or "offset" not in state:
+            raise ValueError(
+                "expected a DataLoader state_dict with an 'offset' "
+                "field, got %r" % (state,))
+        offset = int(state.get("offset", 0))
+        if offset < 0:
+            raise ValueError("offset must be >= 0, got %d" % offset)
+        if offset and not self.ordered:
+            raise ValueError(
+                "sample-exact resume requires ordered=True (arrival "
+                "order is not replayable across a restart)")
+        running = ((self._procs is not None
+                    or self._inline_iter is not None)
+                   and not self._exhausted)
+        if running:
+            # a started loader is already delivering the CURRENT epoch
+            # from offset 0 — applying the offset to the NEXT start()
+            # would both retrain this epoch's head and skip the next
+            # epoch's, silently
+            raise RuntimeError(
+                "cannot load state into a running loader; reset() first")
+        self._epochs_done = int(state.get("epoch", 0))
+        self._pending_offset = offset
 
     def close(self):
         """Tear down workers and unlink the shared-memory segment. Live
@@ -654,8 +720,11 @@ class DataLoader(ReaderBase):
             except StopIteration:
                 self._exhausted = True
                 self._inline_iter = None
+                self._epochs_done += 1
+                self._epoch_batches = 0
                 raise EOFException(self._eof_msg) from None
             self._n_batches += 1
+            self._epoch_batches += 1
             obs.LOADER_BATCHES.inc(loader=self._obs_name, transport="inline")
             return dict(zip(self.var_names, rows))
         if self._procs is None:
@@ -683,12 +752,16 @@ class DataLoader(ReaderBase):
                 return msg
             if self._next_seq % self.num_workers in self._done:
                 self._exhausted = True
+                self._epochs_done += 1
+                self._epoch_batches = 0
                 raise EOFException(self._eof_msg)
             return None
         if self._buffer:
             return self._buffer.pop(next(iter(self._buffer)))
         if len(self._done) == self.num_workers:
             self._exhausted = True
+            self._epochs_done += 1
+            self._epoch_batches = 0
             raise EOFException(self._eof_msg)
         return None
 

@@ -46,6 +46,8 @@ __all__ = [
     "FLEET_WORKERS", "FLEET_OUTSTANDING", "FLEET_DISPATCHES",
     "FLEET_REQUEUED", "FLEET_MISVERSIONED", "FLEET_BACKPRESSURE_MS",
     "DECODE_TOKENS", "DECODE_SLOTS", "DECODE_STEP_MS", "DECODE_REQUESTS",
+    "CKPT_SAVES", "CKPT_BYTES", "CKPT_PENDING", "CKPT_SAVE_MS",
+    "CKPT_RESTORE_MS", "CKPT_RETRIES", "CKPT_FAILURES",
 ]
 
 # -- the shared instrument set (registered once, process-wide) -----------
@@ -216,6 +218,36 @@ DECODE_REQUESTS = REGISTRY.counter(
     "paddle_tpu_decode_requests_total",
     "Decode-serving sequences, kind=admitted (entered a cache slot) | "
     "retired (finished and freed it); admitted - retired = in flight")
+CKPT_SAVES = REGISTRY.counter(
+    "paddle_tpu_ckpt_saves_total",
+    "Checkpoint saves, by mode=async|sync and result=ok|error (async = "
+    "background writer off the step path; sync = degraded or explicit)")
+CKPT_BYTES = REGISTRY.counter(
+    "paddle_tpu_ckpt_bytes",
+    "Bytes durably written into complete checkpoints (persistables npz "
+    "+ meta + sentinel)")
+CKPT_PENDING = REGISTRY.gauge(
+    "paddle_tpu_ckpt_pending",
+    "Snapshots queued for the background checkpoint writer right now "
+    "(at max_pending = the trainer blocks: bounded staleness, never "
+    "dropped saves)")
+CKPT_SAVE_MS = REGISTRY.histogram(
+    "paddle_tpu_ckpt_save_ms",
+    "Wall time per checkpoint write, by mode=async (inside the writer "
+    "thread, off the step path) | sync (paid by the training step) | "
+    "snapshot (the on-step-path state copy an async save starts with)")
+CKPT_RESTORE_MS = REGISTRY.histogram(
+    "paddle_tpu_ckpt_restore_ms",
+    "Wall time to load the newest complete checkpoint at resume")
+CKPT_RETRIES = REGISTRY.counter(
+    "paddle_tpu_ckpt_retries_total",
+    "Checkpoint write attempts retried after a transient IO error "
+    "(exponential backoff; exhaustion degrades the manager to "
+    "synchronous saves)")
+CKPT_FAILURES = REGISTRY.counter(
+    "paddle_tpu_ckpt_failures_total",
+    "Checkpoint saves that failed every retry — surfaced as a warning "
+    "+ degraded mode, never silently skipped")
 PROFILER_EVENT_MS = REGISTRY.summary(
     "paddle_tpu_profiler_event_ms",
     "Legacy profiler event table (exact count/sum/min/max per event)")

@@ -398,6 +398,17 @@ class ParallelExecutor:
         instance reports the same aggregate."""
         return run_stats()
 
+    def program_steps(self, program=None) -> int:
+        """RNG step-fold position (Executor.program_steps twin; a
+        ParallelExecutor is bound to ONE program, so the argument is
+        accepted only for signature compatibility with the checkpoint
+        resume surface)."""
+        return self._step
+
+    def set_program_steps(self, program, n: int):
+        """Restore the RNG step-fold position (sample-exact resume)."""
+        self._step = int(n)
+
     def run_loop(self, fetch_list: Sequence, feed=None, steps: int = 1,
                  return_numpy=True):
         """Run `steps` consecutive steps as ONE device-side XLA while-loop

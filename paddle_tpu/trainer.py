@@ -7,12 +7,15 @@ per-GPU graph clones.
 """
 from __future__ import annotations
 
+import itertools
 import os
 from typing import Callable, List, Optional
 
 import numpy as np
 
 from . import io as io_mod
+from .checkpoint import CheckpointManager, check_fingerprint
+from .checkpoint.resume import build_meta
 from . import optimizer as optimizer_mod
 from .data_feeder import DataFeeder
 from .executor import Executor
@@ -53,14 +56,19 @@ class EndStepEvent(object):
 
 
 class CheckpointConfig(object):
-    """reference trainer.py:CheckpointConfig."""
+    """reference trainer.py:CheckpointConfig.
+
+    ``max_pending`` is the async-checkpoint staleness bound used by
+    ``Trainer.fit``: snapshots queued for the background writer before
+    a save blocks the step loop (block-don't-drop)."""
 
     def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
-                 epoch_interval=1, step_interval=10):
+                 epoch_interval=1, step_interval=10, max_pending=2):
         self.checkpoint_dir = checkpoint_dir or os.getcwd()
         self.max_num_checkpoints = max_num_checkpoints
         self.epoch_interval = max(int(epoch_interval), 1)
         self.step_interval = max(int(step_interval), 1)
+        self.max_pending = max(int(max_pending), 0)
         self.epoch_id = 0
         self.step_id = 0
         self.load_serial = None
@@ -92,6 +100,32 @@ def build_feed_var_list(program: Program, feed_order):
     return feed_var_list
 
 
+def _feed_windows(feeder, batch_it, steps_per_loop, start_step=0):
+    """Yield (first_step_id, [feed dicts]) windows of up to
+    steps_per_loop batches. A batch whose feed shapes differ from the
+    window's (e.g. a short final batch) closes the window and starts
+    its own — stacked per-step feeds must be uniform. ``start_step``
+    offsets the step ids (a resumed epoch continues mid-count)."""
+    buf, first = [], 0
+
+    def shapes(feed):
+        return {n: np.asarray(v).shape for n, v in feed.items()}
+
+    for step_id, data in enumerate(batch_it, start=start_step):
+        feed = feeder.feed(data)
+        if buf and shapes(feed) != shapes(buf[0]):
+            yield first, buf
+            buf = []
+        buf.append(feed)
+        if len(buf) == 1:
+            first = step_id
+        if len(buf) == steps_per_loop:
+            yield first, buf
+            buf = []
+    if buf:
+        yield first, buf
+
+
 class Trainer(object):
     """reference trainer.py:Trainer.
 
@@ -108,6 +142,8 @@ class Trainer(object):
         self.parallel = parallel
         self.trainer_id = 0
         self.checkpoint_cfg = checkpoint_config
+        self._restored_meta = None  # __init__-time checkpoint restore,
+        self._restored_serial = None  # reused by fit(resumable=True)
         if self.checkpoint_cfg:
             if not isinstance(self.checkpoint_cfg, CheckpointConfig):
                 raise TypeError("checkpoint_config must be a CheckpointConfig")
@@ -152,6 +188,11 @@ class Trainer(object):
             # run stopped instead of re-running finished epochs
             self.checkpoint_cfg.epoch_id = int(meta.get("epoch", 0))
             self.checkpoint_cfg.step_id = int(meta.get("step", 0))
+            # full meta kept so a subsequent fit(resumable=True) reuses
+            # THIS restore instead of re-reading + re-transferring the
+            # same checkpoint
+            self._restored_meta = meta
+            self._restored_serial = self.checkpoint_cfg.load_serial
 
         self._train_exe = None
         if parallel:
@@ -185,38 +226,14 @@ class Trainer(object):
                              % steps_per_loop)
         feed_var_list = build_feed_var_list(self.train_program, feed_order)
         feeder = DataFeeder(feed_list=feed_var_list, place=self.place)
-        exe = self._train_exe
         start_epoch = (self.checkpoint_cfg.epoch_id
                        if self.checkpoint_cfg else 0)
-
-        def windows(it):
-            """Yield (first_step_id, [feed dicts]) windows of up to
-            steps_per_loop batches. A batch whose feed shapes differ from
-            the window's (e.g. a short final batch) closes the window and
-            starts its own — stacked per-step feeds must be uniform."""
-            buf, first = [], 0
-
-            def shapes(feed):
-                return {n: np.asarray(v).shape for n, v in feed.items()}
-
-            for step_id, data in enumerate(it):
-                feed = feeder.feed(data)
-                if buf and shapes(feed) != shapes(buf[0]):
-                    yield first, buf
-                    buf = []
-                buf.append(feed)
-                if len(buf) == 1:
-                    first = step_id
-                if len(buf) == steps_per_loop:
-                    yield first, buf
-                    buf = []
-            if buf:
-                yield first, buf
 
         with scope_guard(self.scope):
             for epoch_id in range(start_epoch, num_epochs):
                 event_handler(BeginEpochEvent(epoch_id))
-                for step_id, feeds in windows(reader()):
+                for step_id, feeds in _feed_windows(feeder, reader(),
+                                                    steps_per_loop):
                     if self.__stop:
                         if self.checkpoint_cfg:
                             self._clean_checkpoint()
@@ -226,40 +243,154 @@ class Trainer(object):
                     fetch_list = (
                         [v.name for v in self.train_func_outputs]
                         if begin_event.fetch_metrics else [])
-                    if len(feeds) == 1:
-                        feed = feeds[0]
-                        if exe is not None:
-                            metrics = exe.run(feed=feed,
-                                              fetch_list=fetch_list)
-                        else:
-                            metrics = self._exe.run(
-                                self.train_program, feed=feed,
-                                fetch_list=fetch_list)
-                    else:
-                        if exe is not None:
-                            # ParallelExecutor.run_loop has no per-step
-                            # feed support yet: run the window stepwise
-                            # (identical numerics, no device-loop speedup)
-                            for feed in feeds[:-1]:
-                                exe.run(feed=feed, fetch_list=[])
-                            metrics = exe.run(feed=feeds[-1],
-                                              fetch_list=fetch_list)
-                        else:
-                            names = list(feeds[0])
-                            stacked = {
-                                n: np.stack(
-                                    [np.asarray(f[n]) for f in feeds])
-                                for n in names}
-                            metrics = self._exe.run_loop(
-                                self.train_program, feed=stacked,
-                                fetch_list=fetch_list, steps=len(feeds),
-                                per_step_feeds=names)
+                    metrics = self._run_window(feeds, fetch_list)
                     if self.checkpoint_cfg:
                         self._save_checkpoint(epoch_id, step_id)
                     event_handler(EndStepEvent(epoch_id, step_id, metrics))
                 event_handler(EndEpochEvent(epoch_id))
             if self.checkpoint_cfg:
                 self._clean_checkpoint()
+
+    def _run_window(self, feeds, fetch_list):
+        """Dispatch one window of feed dicts: single step, parallel
+        stepwise, or a fused run_loop window (train()'s inner body,
+        shared with fit())."""
+        exe = self._train_exe
+        if len(feeds) == 1:
+            if exe is not None:
+                return exe.run(feed=feeds[0], fetch_list=fetch_list)
+            return self._exe.run(self.train_program, feed=feeds[0],
+                                 fetch_list=fetch_list)
+        if exe is not None:
+            # ParallelExecutor.run_loop has no per-step feed support
+            # yet: run the window stepwise (identical numerics, no
+            # device-loop speedup)
+            for feed in feeds[:-1]:
+                exe.run(feed=feed, fetch_list=[])
+            return exe.run(feed=feeds[-1], fetch_list=fetch_list)
+        names = list(feeds[0])
+        stacked = {n: np.stack([np.asarray(f[n]) for f in feeds])
+                   for n in names}
+        return self._exe.run_loop(
+            self.train_program, feed=stacked, fetch_list=fetch_list,
+            steps=len(feeds), per_step_feeds=names)
+
+    def fit(self, num_epochs: int, event_handler: Callable = None,
+            reader=None, feed_order=None, steps_per_loop: int = 1,
+            resumable: bool = True):
+        """Elastic, preemption-proof train loop (same reader/event
+        contract as train()):
+
+        - checkpoints are ASYNC — every ``step_interval`` batches (and
+          at every epoch boundary) a snapshot of the persistables +
+          optimizer state is queued to a background writer
+          (checkpoint.CheckpointManager) with at most
+          ``CheckpointConfig.max_pending`` in flight, so the step loop
+          never waits on disk unless the writer falls that far behind;
+        - writes are crash-safe (tmp + fsync + atomic rename +
+          ``_COMPLETE`` sentinel): a SIGKILL at ANY instant — including
+          mid-checkpoint-write — cannot corrupt the newest checkpoint;
+        - with ``resumable=True`` a restart loads the newest COMPLETE
+          checkpoint and continues SAMPLE-EXACT: epoch, batch offset
+          (already-trained batches of the resumed epoch are skipped,
+          never retrained), and the per-program RNG stream all restore,
+          so the loss trajectory continues bit-exact vs an
+          uninterrupted run;
+        - unlike train(), checkpoints are KEPT on completion (the
+          elastic contract: re-running a finished fit is a no-op
+          resume, and sweeps can always warm-start).
+
+        Requires a ``checkpoint_config``. Warm process restarts also
+        reuse compiled executables through the persistent AOT cache, so
+        time-to-first-step after preemption is seconds, not a compile.
+        """
+        if self.checkpoint_cfg is None:
+            raise ValueError(
+                "fit() checkpoints through CheckpointConfig — construct "
+                "the Trainer with checkpoint_config=CheckpointConfig(...)")
+        if event_handler is None:
+            event_handler = lambda ev: None  # noqa: E731
+        if steps_per_loop < 1:
+            raise ValueError("steps_per_loop must be >= 1, got %d"
+                             % steps_per_loop)
+        cfg = self.checkpoint_cfg
+        feed_var_list = build_feed_var_list(self.train_program, feed_order)
+        feeder = DataFeeder(feed_list=feed_var_list, place=self.place)
+        manager = CheckpointManager(
+            cfg.checkpoint_dir,
+            max_num_checkpoints=cfg.max_num_checkpoints,
+            max_pending=cfg.max_pending)
+        start_epoch = start_offset = global_step = 0
+        # the executor whose RNG step fold actually advances during
+        # training: the ParallelExecutor when parallel=True (it keeps
+        # its own counter), else the plain Executor
+        rng_exe = self._train_exe if self._train_exe is not None \
+            else self._exe
+
+        def save(epoch_id, offset, gstep):
+            arrays = manager.snapshot(self.train_program, self.scope)
+            meta = build_meta(
+                self.train_program, rng_exe, epoch=epoch_id,
+                offset=offset, global_step=gstep,
+                # legacy keys so load_checkpoint-driven loops resume too
+                extra={"step": gstep, "trainer_id": self.trainer_id})
+            manager.save(arrays, meta)
+
+        with scope_guard(self.scope):
+            if resumable:
+                if (self._restored_meta is not None
+                        and manager.latest() == self._restored_serial):
+                    # __init__ already loaded this exact serial into the
+                    # scope (and checked its fingerprint): reuse it
+                    # instead of re-reading + re-transferring the model
+                    meta = self._restored_meta
+                else:
+                    meta = manager.restore_into(self.scope)
+                    if meta is not None:
+                        check_fingerprint(meta, self.train_program)
+                if meta is not None:
+                    start_epoch = int(meta.get("epoch", 0))
+                    start_offset = int(meta.get("offset", 0))
+                    global_step = int(meta.get("global_step", 0))
+                    rng_step = meta.get("rng_step")
+                    if rng_step is not None:
+                        rng_exe.set_program_steps(self.train_program,
+                                                  int(rng_step))
+            try:
+                for epoch_id in range(start_epoch, num_epochs):
+                    event_handler(BeginEpochEvent(epoch_id))
+                    offset = (start_offset if epoch_id == start_epoch
+                              else 0)
+                    batch_it = reader()
+                    if offset:
+                        # sample-exact: the restored checkpoint already
+                        # trained these batches — skip, never retrain
+                        batch_it = itertools.islice(batch_it, offset,
+                                                    None)
+                    for step_id, feeds in _feed_windows(
+                            feeder, batch_it, steps_per_loop,
+                            start_step=offset):
+                        begin_event = BeginStepEvent(epoch_id, step_id)
+                        event_handler(begin_event)
+                        fetch_list = (
+                            [v.name for v in self.train_func_outputs]
+                            if begin_event.fetch_metrics else [])
+                        metrics = self._run_window(feeds, fetch_list)
+                        before = global_step // cfg.step_interval
+                        offset += len(feeds)
+                        global_step += len(feeds)
+                        if global_step // cfg.step_interval != before:
+                            save(epoch_id, offset, global_step)
+                        event_handler(EndStepEvent(epoch_id, step_id,
+                                                   metrics))
+                        if self.__stop:
+                            save(epoch_id, offset, global_step)
+                            return
+                    # epoch boundary: a restart never replays this epoch
+                    save(epoch_id + 1, 0, global_step)
+                    event_handler(EndEpochEvent(epoch_id))
+            finally:
+                manager.close()  # drain: every queued snapshot lands
 
     def test(self, reader, feed_order=None):
         """Average the train_func outputs over the reader on the test

@@ -30,6 +30,13 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running chaos/bench variants excluded from tier-1 "
+        "(tier-1 runs -m 'not slow')")
+
+
 @pytest.fixture(autouse=True)
 def no_leaked_workers():
     """Tier-1 hygiene: a test that leaks worker PROCESSES (DataLoader

@@ -1,0 +1,444 @@
+"""paddle_tpu.checkpoint: crash-safe layout, async manager, resume.
+
+The subprocess SIGKILL battery lives in test_chaos_train.py; this file
+covers the in-process contracts: atomic write protocol, sentinel
+visibility, retention, bounded-staleness async saves, the transient-IO
+retry/degrade ladder, fault injection, ResumableLoop state round-trips,
+and Trainer.fit resume equivalence.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu import observability as obs
+from paddle_tpu.checkpoint import (
+    CheckpointManager,
+    CheckpointWriteError,
+    ResumableLoop,
+    faults,
+    layout,
+)
+
+
+def _arrays(seed=0, n=3):
+    rs = np.random.RandomState(seed)
+    return {"w%d" % i: rs.randn(4, 3).astype(np.float32) for i in range(n)}
+
+
+# ---------------------------------------------------------------------------
+# layout
+# ---------------------------------------------------------------------------
+
+
+def test_write_checkpoint_atomic_and_complete(tmp_path):
+    ck = str(tmp_path)
+    final = layout.write_checkpoint(ck, 0, {"blob": b"x" * 100},
+                                    meta={"step": 1})
+    assert layout.is_complete(final)
+    assert layout.read_meta(final) == {"step": 1}
+    assert layout.latest_serial(ck) == 0
+    assert layout.all_serials(ck) == [0]
+    # nothing tmp- left behind on the happy path
+    assert not [e for e in os.listdir(ck) if e.startswith(layout.TMP_PREFIX)]
+
+
+def test_latest_serial_skips_sentinelless_and_tmp_dirs(tmp_path):
+    ck = str(tmp_path)
+    layout.write_checkpoint(ck, 3, {"blob": b"ok"}, meta={})
+    # legacy in-place crash artifact: numbered dir, no sentinel
+    os.makedirs(os.path.join(ck, "checkpoint_9"))
+    with open(os.path.join(ck, "checkpoint_9", "meta.json"), "w") as f:
+        f.write("{}")
+    # mid-write partial
+    os.makedirs(os.path.join(ck, "tmp-checkpoint_10.99999.deadbeef"))
+    assert layout.latest_serial(ck) == 3
+    assert layout.complete_serials(ck) == [3]
+    # but serial allocation never reuses the partial's number
+    assert layout.next_serial(ck) == 10
+
+
+def test_retention_gc_keeps_newest_spares_foreign_partials(tmp_path):
+    ck = str(tmp_path)
+    for s in range(5):
+        layout.write_checkpoint(ck, s, {"blob": b"x"}, meta={"step": s})
+    # sentinel-less numbered dirs (one older, one newer than the kept
+    # set): NOT this writer's data — GC must never destroy them
+    os.makedirs(os.path.join(ck, "checkpoint_1000"))
+    os.makedirs(os.path.join(ck, "checkpoint_2"), exist_ok=True)
+    removed = layout.retention_gc(ck, keep=2)
+    assert layout.complete_serials(ck) == [3, 4]
+    assert 0 in removed and 1 in removed and 2 in removed
+    assert os.path.isdir(os.path.join(ck, "checkpoint_1000"))
+
+
+def test_latest_serial_warns_on_legacy_only_dir(tmp_path):
+    """A dir holding ONLY sentinel-less serials (the pre-atomic writer's
+    format) must warn instead of silently reading as empty."""
+    ck = str(tmp_path)
+    os.makedirs(os.path.join(ck, "checkpoint_4"))
+    with pytest.warns(UserWarning, match="NOT be loaded"):
+        assert layout.latest_serial(ck) == -1
+
+
+def test_sweep_stale_partials_pid_liveness(tmp_path):
+    ck = str(tmp_path)
+    dead = os.path.join(ck, "tmp-checkpoint_0.999999.abcd1234")
+    live = os.path.join(ck, "tmp-checkpoint_1.%d.abcd1234" % os.getpid())
+    os.makedirs(dead)
+    os.makedirs(live)
+    removed = layout.sweep_stale_partials(ck)
+    assert dead in removed
+    assert not os.path.isdir(dead)
+    assert os.path.isdir(live)  # this pid is alive: writer in flight
+
+
+# ---------------------------------------------------------------------------
+# fault injection
+# ---------------------------------------------------------------------------
+
+
+def test_fault_io_injection_counts_down(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_FAULT_IO", "t.point:2")
+    faults.reset()
+    with pytest.raises(faults.InjectedIOError):
+        faults.fault_point("t.point")
+    with pytest.raises(faults.InjectedIOError):
+        faults.fault_point("t.point")
+    faults.fault_point("t.point")  # third hit passes
+    assert faults.hits("t.point") == 3
+    faults.fault_point("other.point")  # unarmed points never fire
+
+
+def test_fault_delay(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_FAULT_DELAY", "t.delay:0.05")
+    t0 = time.perf_counter()
+    faults.fault_point("t.delay")
+    assert time.perf_counter() - t0 >= 0.045
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager
+# ---------------------------------------------------------------------------
+
+
+def test_manager_async_save_restore_roundtrip(tmp_path):
+    ck = str(tmp_path / "ck")
+    with CheckpointManager(ck, max_num_checkpoints=2) as m:
+        arrays = _arrays(1)
+        serial = m.save(arrays, {"step": 7})
+        assert m.wait(timeout=10)
+        assert m.latest() == serial
+        got, meta = m.restore()
+        assert meta["step"] == 7
+        for k in arrays:
+            np.testing.assert_array_equal(got[k], arrays[k])
+    # retention across many saves
+    with CheckpointManager(ck, max_num_checkpoints=2) as m:
+        for i in range(4):
+            m.save(_arrays(i), {"step": i})
+        m.wait(timeout=10)
+        assert len(layout.complete_serials(ck)) <= 2
+        _got, meta = m.restore()
+        assert meta["step"] == 3
+
+
+def test_manager_restore_into_owns_buffers(tmp_path):
+    """Restored scope values must be XLA-owned device arrays, not the
+    npz numpy arrays: the executor donates state buffers, and donating
+    numpy-owned memory corrupts the heap (seen as segfault/NaN on the
+    warm-AOT resume path)."""
+    import jax
+
+    ck = str(tmp_path / "ck")
+    with CheckpointManager(ck) as m:
+        m.save(_arrays(2), {"step": 1}, block=True)
+        scope = fluid.Scope()
+        meta = m.restore_into(scope)
+        assert meta["step"] == 1
+        for name in _arrays(2):
+            assert isinstance(scope.find_var(name), jax.Array), name
+
+
+def test_device_owned_handles_every_itemsize(tmp_path):
+    """itemsize-16 dtypes (complex128) can never be itemsize-aligned
+    without being 16-aligned, so the misalignment trick is impossible —
+    they must fall through to the jitted copy, not hang."""
+    import jax
+
+    from paddle_tpu.checkpoint.manager import device_owned_tree
+
+    arrays = {
+        "c": (np.arange(6) + 1j * np.arange(6)).astype(np.complex128),
+        "f": np.ones((3, 2), np.float32),
+        "s": np.float32(2.5).reshape(()),  # 0-d scalar
+        "e": np.zeros((0,), np.float32),  # empty
+    }
+    out = device_owned_tree(arrays)
+    for name, val in arrays.items():
+        assert isinstance(out[name], jax.Array), name
+        np.testing.assert_array_equal(np.asarray(out[name]), val)
+
+
+def test_manager_bounded_staleness_blocks_not_drops(tmp_path):
+    """With max_pending=1 and a slowed writer, save() blocks instead of
+    dropping: every queued snapshot lands on disk."""
+    ck = str(tmp_path / "ck")
+    m = CheckpointManager(ck, max_num_checkpoints=10, max_pending=1)
+    orig = layout.write_checkpoint
+
+    def slow_write(*a, **kw):
+        time.sleep(0.15)
+        return orig(*a, **kw)
+
+    monkeypatch = pytest.MonkeyPatch()
+    monkeypatch.setattr(layout, "write_checkpoint", slow_write)
+    try:
+        t0 = time.perf_counter()
+        for i in range(3):
+            m.save(_arrays(i), {"step": i})
+        blocked = time.perf_counter() - t0
+        m.wait(timeout=10)
+        # 3 saves through a 0.15s writer behind a 1-deep queue: the
+        # caller must have blocked at least one writer cycle
+        assert blocked >= 0.1, blocked
+        assert len(layout.complete_serials(ck)) == 3  # none dropped
+    finally:
+        monkeypatch.undo()
+        m.close()
+
+
+def test_manager_retries_transient_io_then_succeeds(tmp_path, monkeypatch):
+    ck = str(tmp_path / "ck")
+    before = obs.CKPT_RETRIES.total()
+    monkeypatch.setenv("PADDLE_TPU_FAULT_IO",
+                       "ckpt.before_files:2")
+    faults.reset()
+    with CheckpointManager(ck, retries=3, backoff_s=0.01) as m:
+        m.save(_arrays(0), {"step": 0}, block=True)  # sync: raises if dead
+        assert m.latest() == 0
+    assert obs.CKPT_RETRIES.total() - before >= 2
+    monkeypatch.delenv("PADDLE_TPU_FAULT_IO")
+
+
+def test_manager_async_failure_degrades_loudly(tmp_path, monkeypatch):
+    """An async save that exhausts retries warns, counts a failure, and
+    flips the manager to synchronous mode; a sync save that still fails
+    raises CheckpointWriteError; a later success heals back."""
+    ck = str(tmp_path / "ck")
+    before = obs.CKPT_FAILURES.total()
+    m = CheckpointManager(ck, retries=1, backoff_s=0.01, max_pending=4)
+    monkeypatch.setenv("PADDLE_TPU_FAULT_IO", "ckpt.before_files:99")
+    faults.reset()
+    try:
+        with pytest.warns(UserWarning, match="degrading to synchronous"):
+            m.save(_arrays(0), {"step": 0})
+            m.wait(timeout=10)
+        assert m.degraded
+        assert m.last_error is not None
+        assert obs.CKPT_FAILURES.total() > before
+        with pytest.raises(CheckpointWriteError):
+            m.save(_arrays(1), {"step": 1})  # degraded -> sync -> raises
+        monkeypatch.setenv("PADDLE_TPU_FAULT_IO", "")  # disk "recovers"
+        m.save(_arrays(2), {"step": 2})  # sync (still degraded), succeeds
+        assert not m.degraded  # healed: async resumes
+        assert m.latest() >= 0
+    finally:
+        m.close(wait=False)
+
+
+def test_manager_restore_ignores_midwrite_partial(tmp_path):
+    ck = str(tmp_path / "ck")
+    with CheckpointManager(ck) as m:
+        m.save(_arrays(0), {"step": 0}, block=True)
+        # fabricate a newer mid-write partial + a sentinel-less serial
+        os.makedirs(os.path.join(ck, "tmp-checkpoint_5.999999.cafe0001"))
+        os.makedirs(os.path.join(ck, "checkpoint_6"))
+        _got, meta = m.restore()
+        assert meta["step"] == 0
+
+
+def test_ckpt_metric_series_exported():
+    from paddle_tpu.observability import export
+
+    text = export.to_prometheus()
+    for name in ("paddle_tpu_ckpt_saves_total", "paddle_tpu_ckpt_bytes",
+                 "paddle_tpu_ckpt_pending", "paddle_tpu_ckpt_save_ms",
+                 "paddle_tpu_ckpt_restore_ms",
+                 "paddle_tpu_ckpt_retries_total",
+                 "paddle_tpu_ckpt_failures_total"):
+        assert name in text, name
+
+
+# ---------------------------------------------------------------------------
+# ResumableLoop (+ Trainer.fit) — in-process resume equivalence
+# ---------------------------------------------------------------------------
+
+
+def _mini_program():
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = layers.data(name="x", shape=[4])
+            y = layers.data(name="y", shape=[1])
+            loss = layers.mean(layers.square_error_cost(
+                input=layers.fc(x, 1), label=y))
+            fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    return main, startup, scope, loss
+
+
+def _feeds(n=8, batch=4):
+    rs = np.random.RandomState(3)
+    out = []
+    for i in range(n):
+        x = rs.randn(batch, 4).astype(np.float32)
+        out.append({"x": x, "y": (x.sum(1, keepdims=True) * 0.5)
+                    .astype(np.float32)})
+    return out
+
+
+def test_resumable_loop_resumes_sample_and_bit_exact(tmp_path):
+    ck = str(tmp_path / "ck")
+    feeds = _feeds()
+
+    def run(upto=None):
+        main, startup, scope, loss = _mini_program()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            loop = ResumableLoop(exe, main, ck, scope=scope,
+                                 step_interval=2)
+            losses = []
+            for _epoch in loop.epochs(2):
+                for feed in loop.skip(feeds):
+                    (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+                    losses.append((loop.epoch, loop.offset,
+                                   float(np.asarray(lv).ravel()[0])))
+                    loop.step_done()
+                    if upto is not None and loop.global_step >= upto:
+                        loop.close()
+                        return losses, loop
+                loop.end_epoch()
+            loop.close()
+            return losses, loop
+
+    control, _ = run()
+    import shutil
+
+    shutil.rmtree(ck, ignore_errors=True)
+    part1, _ = run(upto=5)  # "preempted" cleanly after step 5 (saved at 4)
+    part2, loop2 = run()
+    assert loop2.resumed_meta is not None
+    resumed_at = int(loop2.resumed_meta["global_step"])
+    assert resumed_at > 0
+    effective = part1[:resumed_at] + part2
+    assert effective == control  # bit-exact losses, exact batch seq
+
+
+def test_resumable_loop_restores_rng_stream(tmp_path):
+    """A program with dropout draws the SAME masks after resume as the
+    uninterrupted run (the per-program step fold is checkpointed)."""
+    ck = str(tmp_path / "ck")
+    feeds = _feeds(n=6)
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope), fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                x = layers.data(name="x", shape=[4])
+                y = layers.data(name="y", shape=[1])
+                h = layers.dropout(layers.fc(x, 8), dropout_prob=0.5)
+                loss = layers.mean(layers.square_error_cost(
+                    input=layers.fc(h, 1), label=y))
+                fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+        return main, startup, scope, loss
+
+    def run(upto=None):
+        main, startup, scope, loss = build()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            loop = ResumableLoop(exe, main, ck, scope=scope,
+                                 step_interval=1)
+            losses = []
+            for feed in loop.skip(feeds):
+                (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+                losses.append(float(np.asarray(lv).ravel()[0]))
+                loop.step_done()
+                if upto and loop.global_step >= upto:
+                    break
+            loop.close()
+            return losses, loop
+
+    control, _ = run()
+    import shutil
+
+    shutil.rmtree(ck, ignore_errors=True)
+    part1, _ = run(upto=3)
+    part2, loop2 = run()
+    resumed_at = int(loop2.resumed_meta["global_step"])
+    assert part1[:resumed_at] + part2 == control
+
+
+def test_trainer_fit_stop_resume_matches_control(tmp_path):
+    rs = np.random.RandomState(0)
+    XS = rs.randn(24, 6).astype(np.float32)
+    YS = (XS.sum(1, keepdims=True) * 0.3).astype(np.float32)
+
+    def train_func():
+        x = layers.data(name="x", shape=[6])
+        y = layers.data(name="y", shape=[1])
+        return layers.mean(layers.square_error_cost(
+            input=layers.fc(x, 1), label=y))
+
+    def opt_func():
+        return fluid.optimizer.Adam(learning_rate=0.05)
+
+    def reader():
+        for i in range(6):
+            yield [(XS[4 * i + j], YS[4 * i + j]) for j in range(4)]
+
+    def run(ckdir, stop_after=None):
+        cfg = fluid.CheckpointConfig(ckdir, step_interval=2)
+        t = fluid.Trainer(train_func, opt_func, checkpoint_config=cfg)
+        losses = []
+
+        def handler(ev):
+            if isinstance(ev, fluid.EndStepEvent):
+                losses.append((ev.epoch, ev.step,
+                               float(np.asarray(ev.metrics[0]).ravel()[0])))
+                if stop_after and len(losses) >= stop_after:
+                    t.stop()
+
+        t.fit(2, handler, reader=reader, feed_order=["x", "y"])
+        return losses
+
+    control = run(str(tmp_path / "c"))
+    part1 = run(str(tmp_path / "k"), stop_after=4)
+    part2 = run(str(tmp_path / "k"))
+    merged = {(e, s): v for e, s, v in part1}
+    merged.update({(e, s): v for e, s, v in part2})
+    assert merged == {(e, s): v for e, s, v in control}
+    # elastic contract: checkpoints KEPT after completion...
+    assert layout.latest_serial(str(tmp_path / "k")) >= 0
+    # ...and re-running a finished fit trains zero extra steps
+    again = run(str(tmp_path / "k"))
+    assert again == []
+
+
+def test_fit_requires_checkpoint_config():
+    def train_func():
+        x = layers.data(name="x", shape=[2])
+        y = layers.data(name="y", shape=[1])
+        return layers.mean(layers.square_error_cost(
+            input=layers.fc(x, 1), label=y))
+
+    t = fluid.Trainer(train_func,
+                      lambda: fluid.optimizer.SGD(learning_rate=0.1))
+    with pytest.raises(ValueError, match="CheckpointConfig"):
+        t.fit(1, None, reader=lambda: iter([]), feed_order=["x", "y"])

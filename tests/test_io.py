@@ -117,6 +117,108 @@ def test_checkpoint_resume_and_retention(tmp_path, rng):
     assert not os.path.exists(ckdir)
 
 
+def test_checkpoint_writes_are_crash_safe(tmp_path, rng):
+    """save_checkpoint goes through tmp+rename+sentinel; readers skip
+    sentinel-less dirs (the legacy in-place writer's crash artifact)
+    instead of loading or raising on them."""
+    from paddle_tpu.checkpoint import layout
+
+    exe = fluid.Executor()
+    _build_and_train(exe, rng)
+    ckdir = str(tmp_path / "ck")
+    serial = fluid.io.save_checkpoint(exe, ckdir, step=1)
+    cur = os.path.join(ckdir, "checkpoint_%d" % serial)
+    assert os.path.isfile(os.path.join(cur, "_COMPLETE"))
+    assert not [e for e in os.listdir(ckdir) if e.startswith("tmp-")]
+
+    # a higher-serial corrupt partial: present but invisible
+    os.makedirs(os.path.join(ckdir, "checkpoint_50"))
+    with open(os.path.join(ckdir, "checkpoint_50",
+                           "__persistables__.npz"), "wb") as f:
+        f.write(b"half a checkpoint")
+    assert fluid.io.get_latest_checkpoint_serial(ckdir) == serial
+    meta = fluid.io.load_checkpoint(exe, ckdir)  # newest COMPLETE
+    assert meta["step"] == 1
+    with pytest.raises(RuntimeError, match="incomplete"):
+        fluid.io.load_checkpoint(exe, ckdir, serial=50)
+    # new saves never rename onto the corrupt slot
+    assert fluid.io.save_checkpoint(exe, ckdir, step=2) == 51
+    assert layout.latest_serial(ckdir) == 51
+
+
+def test_load_checkpoint_fingerprint_strict_and_warning(tmp_path, rng):
+    from paddle_tpu.io import (CheckpointFingerprintWarning,
+                               CheckpointMismatchError)
+
+    exe = fluid.Executor()
+    _build_and_train(exe, rng)
+    ckdir = str(tmp_path / "ck")
+    fluid.io.save_checkpoint(exe, ckdir, step=3)
+
+    # a DIFFERENT program (extra persistable) consuming the checkpoint
+    other = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(other, startup):
+        with fluid.unique_name.guard():
+            x = layers.data(name="x", shape=[8])
+            pred = layers.fc(input=x, size=1,
+                             param_attr=fluid.ParamAttr(name="fc_w_new"))
+    exe.run(startup)
+
+    with pytest.warns(CheckpointFingerprintWarning,
+                      match="different program version"):
+        with pytest.raises(RuntimeError):
+            # warns on the mismatch, then fails var-name matching
+            fluid.io.load_checkpoint(exe, ckdir, main_program=other)
+
+    with pytest.raises(CheckpointMismatchError) as ei:
+        fluid.io.load_checkpoint(exe, ckdir, main_program=other,
+                                 strict=True)
+    msg = str(ei.value)
+    assert "fc_w_new" in msg  # names the differing persistables
+    assert "checkpoint fingerprint" in msg
+
+    # env opt-in has kwarg-default semantics
+    os.environ["PADDLE_TPU_CKPT_STRICT"] = "1"
+    try:
+        with pytest.raises(CheckpointMismatchError):
+            fluid.io.load_checkpoint(exe, ckdir, main_program=other)
+    finally:
+        del os.environ["PADDLE_TPU_CKPT_STRICT"]
+
+
+def test_sharded_checkpoint_failure_modes(tmp_path, rng):
+    """Orbax paths must fail actionably, not with a raw orbax
+    traceback: unwritable target on save, missing/partial step on
+    load."""
+    pytest.importorskip("orbax.checkpoint")
+    exe = fluid.Executor()
+    _build_and_train(exe, rng)
+
+    # unwritable: the "directory" is a regular file
+    blocker = tmp_path / "blocker"
+    blocker.write_text("not a directory")
+    with pytest.raises(RuntimeError, match="writable"):
+        fluid.io.save_sharded_checkpoint(str(blocker / "sub"), step=1)
+
+    # missing step: actionable FileNotFoundError listing what exists
+    good = str(tmp_path / "oc")
+    fluid.io.save_sharded_checkpoint(good, step=2)
+    with pytest.raises(FileNotFoundError, match=r"available steps: \[2\]"):
+        fluid.io.load_sharded_checkpoint(good, step=9)
+
+    # partial/corrupt step: graceful degradation with a pointer back
+    import shutil
+
+    broken = os.path.join(good, "sharded_4")
+    os.makedirs(broken)
+    with open(os.path.join(broken, "junk"), "w") as f:
+        f.write("{")
+    with pytest.raises(RuntimeError, match="unreadable or incomplete"):
+        fluid.io.load_sharded_checkpoint(good, step=4)
+    shutil.rmtree(broken)
+
+
 def test_sharded_checkpoint_orbax(tmp_path, rng):
     pytest.importorskip("orbax.checkpoint")
     exe = fluid.Executor()

@@ -1,0 +1,136 @@
+"""List / inspect / GC a training checkpoint directory.
+
+Enumerates `checkpoint/layout.py` entries: serial, completeness (the
+``_COMPLETE`` sentinel), size, age, and meta (step/epoch/global_step/
+fingerprint), plus in-flight or crashed ``tmp-`` partials. `--keep N`
+applies the same retention GC the CheckpointManager runs after every
+save; `--sweep-stale` removes partials whose writer pid is dead.
+tests/test_ckpt_ls_smoke.py pins the `--json` schema in tier-1
+(the aot_cache_ls pattern), so a field rename fails CI before it
+breaks a cleanup cron.
+
+Usage:
+    python tools/ckpt_ls.py DIR [--json]
+    python tools/ckpt_ls.py DIR --keep 3
+    python tools/ckpt_ls.py DIR --sweep-stale
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SCHEMA = "ckpt_ls/1"
+
+_META_FIELDS = ("step", "epoch", "offset", "global_step", "trainer_id",
+                "fingerprint")
+
+
+def snapshot(checkpoint_dir: str, now=None) -> dict:
+    """The --json payload (also what the smoke test pins)."""
+    from paddle_tpu.checkpoint import layout
+
+    now = time.time() if now is None else now
+    entries = []
+    for path, serial, complete in layout.list_entries(checkpoint_dir):
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            mtime = now
+        entry = {
+            "name": os.path.basename(path),
+            "serial": serial,  # None = tmp- partial
+            "complete": complete,
+            "bytes": layout.dir_nbytes(path),
+            "age_s": max(0.0, now - mtime),
+        }
+        meta = None
+        if complete:
+            try:
+                meta = layout.read_meta(path)
+            except Exception:
+                meta = None
+        entry["meta"] = ({k: meta.get(k) for k in _META_FIELDS}
+                         if isinstance(meta, dict) else None)
+        entries.append(entry)
+    return {
+        "schema": SCHEMA,
+        "dir": os.path.abspath(checkpoint_dir),
+        "latest": layout.latest_serial(checkpoint_dir),
+        "complete": len([e for e in entries if e["complete"]]),
+        "incomplete": len([e for e in entries if not e["complete"]]),
+        "total_bytes": sum(e["bytes"] for e in entries),
+        "entries": entries,
+    }
+
+
+def _fmt_age(s):
+    for unit, div in (("d", 86400.0), ("h", 3600.0), ("m", 60.0)):
+        if s >= div:
+            return "%.1f%s" % (s / div, unit)
+    return "%.0fs" % s
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dir", help="checkpoint directory")
+    ap.add_argument("--json", action="store_true",
+                    help="print the pinned-schema JSON snapshot")
+    ap.add_argument("--keep", type=int, default=None, metavar="N",
+                    help="retention GC: keep only the newest N complete "
+                         "checkpoints (what CheckpointManager does)")
+    ap.add_argument("--sweep-stale", action="store_true",
+                    help="remove tmp- partials whose writer pid is dead")
+    args = ap.parse_args()
+
+    from paddle_tpu.checkpoint import layout
+
+    out = snapshot(args.dir)
+    if args.sweep_stale:
+        out["swept"] = [os.path.basename(p)
+                        for p in layout.sweep_stale_partials(args.dir)]
+        out["entries"] = [e for e in out["entries"]
+                          if e["name"] not in out["swept"]]
+    if args.keep is not None:
+        out["gc_removed"] = layout.retention_gc(args.dir, args.keep)
+        removed = {"%s%d" % (layout.CKPT_PREFIX, s)
+                   for s in out["gc_removed"]}
+        out["entries"] = [e for e in out["entries"]
+                          if e["name"] not in removed
+                          and os.path.exists(
+                              os.path.join(args.dir, e["name"]))]
+        out["latest"] = layout.latest_serial(args.dir)
+
+    if args.json:
+        json.dump(out, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+        return
+
+    print("checkpoint dir: %s  (latest complete serial: %s)"
+          % (out["dir"], out["latest"]))
+    fmt = "%-28s %-9s %10s %8s %6s %6s %-9s"
+    print(fmt % ("NAME", "STATE", "BYTES", "AGE", "EPOCH", "STEP",
+                 "PROGRAM"))
+    for e in out["entries"]:
+        meta = e["meta"] or {}
+        fp = meta.get("fingerprint") or "?"
+        print(fmt % (e["name"],
+                     "complete" if e["complete"] else "PARTIAL",
+                     e["bytes"], _fmt_age(e["age_s"]),
+                     meta.get("epoch", "?"),
+                     meta.get("global_step", meta.get("step", "?")),
+                     fp[:8] if isinstance(fp, str) else fp))
+    print("%d complete, %d incomplete, %d bytes total"
+          % (out["complete"], out["incomplete"], out["total_bytes"]))
+    if args.sweep_stale:
+        print("swept stale partials: %s" % (out["swept"] or "nothing"))
+    if args.keep is not None:
+        print("gc removed serials: %s" % (out["gc_removed"] or "nothing"))
+
+
+if __name__ == "__main__":
+    main()
