@@ -82,6 +82,7 @@ from .transpiler import (  # noqa: F401
     DistributeTranspilerConfig,
     InferenceTranspiler,
     memory_optimize,
+    optimize_program,
     release_memory,
 )
 

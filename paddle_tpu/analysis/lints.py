@@ -28,7 +28,7 @@ from .diagnostics import Report, did_you_mean
 from .infer import PSEUDO_OPS, ProgramInference, render_shape
 
 __all__ = ["register_lint", "run_lints", "LINTS", "LintContext",
-           "DEF_USE_LINTS"]
+           "DEF_USE_LINTS", "backward_liveness"]
 
 # ops that legitimately rewrite an existing var (loop counters, tensor
 # arrays, in-place scatter updates, accumulator-style sums). Audited
@@ -255,17 +255,26 @@ def lint_recompile_risk(ctx: LintContext):
 # -- dead-code analysis ---------------------------------------------------
 
 
-@register_lint("dead-code")
-def lint_dead_code(ctx: LintContext):
-    """Backward liveness from fetch targets + persistable state. Without
-    fetch targets (raw serialized program) every persistable write (and
-    every `fetch` op's input) is the root set. A program with NO roots at
-    all — no fetch names, no fetch ops, nothing persistable written — has
-    nothing to anchor liveness on, so the lint stays silent rather than
-    calling a whole valid forward graph dead."""
-    program = ctx.program
+def backward_liveness(program, fetch_names):
+    """Backward liveness from fetch targets + persistable state over the
+    straight-line global block — the shared core of the ``dead-code``
+    lint AND the optimizing transpiler's dead-op elimination pass
+    (transpiler/passes/dce.py), so the finding and the transform can
+    never disagree about what is dead.
+
+    Returns ``(anchored, dead_ops, live)``: ``anchored`` is False when
+    the program has no liveness roots at all (no fetch names, no fetch
+    ops, nothing persistable written — nothing can be judged dead);
+    ``dead_ops`` is ``[(op_idx, op), ...]`` in reverse block order.
+
+    Correct through ``autodiff`` replay semantics: the autodiff pseudo-op
+    is a root whose loss/params (named in attrs, not input slots) are
+    live, so everything the vjp replay transitively reads stays; an op
+    judged dead is outside every loss's forward cone AND unreachable
+    from any fetch/state write, so dropping it from the replay prefix
+    cannot change any gradient."""
     gb = program.global_block()
-    live: Set[str] = set(ctx.fetch_names)
+    live: Set[str] = set(fetch_names)
     dead_ops: List[tuple] = []
 
     def op_is_root(op, block) -> bool:
@@ -281,7 +290,7 @@ def lint_dead_code(ctx: LintContext):
     anchored = bool(live) or any(
         op_is_root(op, b) for b in program.blocks for op in b.ops)
     if not anchored:
-        return
+        return False, [], live
 
     # anything read inside a sub-block (closure over outer vars) or named
     # as a loop carry is live from the parent's perspective
@@ -309,6 +318,23 @@ def lint_dead_code(ctx: LintContext):
                 live.update(op.attr("param_names") or ())
         else:
             dead_ops.append((op_idx, op))
+    return True, dead_ops, live
+
+
+@register_lint("dead-code")
+def lint_dead_code(ctx: LintContext):
+    """Backward liveness from fetch targets + persistable state. Without
+    fetch targets (raw serialized program) every persistable write (and
+    every `fetch` op's input) is the root set. A program with NO roots at
+    all — no fetch names, no fetch ops, nothing persistable written — has
+    nothing to anchor liveness on, so the lint stays silent rather than
+    calling a whole valid forward graph dead."""
+    program = ctx.program
+    gb = program.global_block()  # the dead-VAR sweep below scans it
+    anchored, dead_ops, _live = backward_liveness(program,
+                                                  ctx.fetch_names)
+    if not anchored:
+        return
 
     for op_idx, op in dead_ops:
         outs = op.output_arg_names

@@ -181,6 +181,68 @@ def _infer_matmul(ctx: InferContext):
     return {"Out": VarInfo(tuple(batch) + (xs[-2], ys[-1]), dt)}
 
 
+def _bias_span(out: Shape, bias: Shape, axis, what: str) -> Shape:
+    """Paddle axis-span broadcast of a bias onto a larger operand (the
+    elementwise Y-convention, see ops/math.py:_broadcast_y): validates
+    the span, returns the (possibly widened) output shape."""
+    if out is None or bias is None:
+        return out
+    if len(bias) > len(out):
+        raise InferError(
+            "%s rank %d exceeds the operand rank %d"
+            % (what, len(bias), len(out)))
+    if len(bias) == len(out):
+        return broadcast_shapes(out, bias, what)
+    a = axis if axis is not None and axis != -1 else len(out) - len(bias)
+    if a < 0 or a + len(bias) > len(out):
+        raise InferError(
+            "axis=%d places %s%s outside the operand%s"
+            % (a, what, render_shape(bias), render_shape(out)))
+    res = list(out)
+    for i, db in enumerate(bias):
+        do = out[a + i]
+        if do is not None and db is not None and do != db and db != 1 \
+                and do != 1:
+            raise InferError(
+                "%s%s does not match the operand%s's dims at axis %d"
+                % (what, render_shape(bias), render_shape(out), a))
+        if do == 1:
+            res[a + i] = db
+    return tuple(res)
+
+
+@register_infer("fused_fc")
+def _infer_fused_fc(ctx: InferContext):
+    """Transpiler-emitted matmul+bias(+act) fusion: Out has the mul/
+    matmul contraction shape (contraction checks included), widened by
+    the bias span; the activation is shape-preserving."""
+    kind = ctx.attr("kind", "mul")
+    if kind == "mul":
+        base = _infer_mul(ctx)["Out"]
+    else:
+        base = _infer_matmul(ctx)["Out"]
+    bias = ctx.in_info("Bias")
+    if not ctx.has_input("Bias"):
+        return {"Out": base}
+    out = _bias_span(base.shape, bias.shape, ctx.attr("axis", -1), "Bias")
+    return {"Out": VarInfo(out, promote_dtypes(base.dtype, bias.dtype))}
+
+
+@register_infer("fused_elemwise_activation")
+def _infer_fused_elemwise_activation(ctx: InferContext):
+    """Binary+unary composition (ops/math.py): Out follows the binary's
+    axis-span broadcast; IntermediateOut keeps Y's own shape in the
+    ("binary","unary") ordering and the binary's shape otherwise."""
+    x, y = ctx.in_info("X"), ctx.in_info("Y")
+    dt = promote_dtypes(x.dtype, y.dtype)
+    out = _bias_span(x.shape, y.shape, ctx.attr("axis", -1), "Y")
+    functors = [str(f).strip() for f in (ctx.attr("functor_list") or ())]
+    inter = (y if functors and functors[0] in
+             ("elementwise_add", "elementwise_mul")
+             else VarInfo(out, dt))
+    return {"Out": VarInfo(out, dt), "IntermediateOut": inter}
+
+
 @register_infer("sum")
 def _infer_sum(ctx: InferContext):
     infos = ctx.in_infos("X")

@@ -205,11 +205,21 @@ class Executor:
     every fetch and updated state var for NaN/Inf after each run — the
     reference's FLAGS_check_nan_inf debug mode (framework/operator.cc)."""
 
-    def __init__(self, place: Optional[Place] = None, check_nan_inf: Optional[bool] = None):
+    def __init__(self, place: Optional[Place] = None,
+                 check_nan_inf: Optional[bool] = None,
+                 opt_level: Optional[int] = None):
         self.place = place if place is not None else CPUPlace()
         if check_nan_inf is None:
             check_nan_inf = os.environ.get("PADDLE_TPU_CHECK_NAN_INF", "0") == "1"
         self.check_nan_inf = check_nan_inf
+        # optimizing transpiler (transpiler/passes/): 0 = off, 1 = exact
+        # structural passes, 2 = + conv_bn fold + feed bucketization.
+        # Explicit arg wins over the PADDLE_TPU_OPT env knob.
+        if opt_level is None:
+            from .transpiler.passes import opt_level_from_env
+
+            opt_level = opt_level_from_env(0)
+        self.opt_level = int(opt_level)
         import weakref
 
         try:
@@ -609,6 +619,61 @@ class Executor:
         does, so on a steady serving/training loop this is a dict hit."""
         return self._engine_for(program).feed_var(name)
 
+    def _maybe_optimize(self, program: Program, scope: Scope, feed_names,
+                        fetch_names) -> Program:
+        """The PADDLE_TPU_OPT step: swap in the Engine-memoized
+        optimized twin. All downstream machinery (compile caches, AOT
+        keys, RNG step streams, reader prefetch slots) keys on the twin
+        itself, so optimized and original executables coexist."""
+        if self.opt_level <= 0:
+            return program
+        return self._engine_for(program).optimized(
+            scope=scope, feed_names=tuple(feed_names),
+            fetch_names=tuple(fetch_names), level=self.opt_level)
+
+    @staticmethod
+    def _bucketize_feeds(program: Program, feed_arrays):
+        """Apply a bucketize stamp (transpiler/passes/bucketize.py) at
+        the feed boundary: pad every stamped feed's batch axis with zero
+        rows up to the next power of two, so the feed SIGNATURE — what
+        the compile/AOT caches key on — is the bucket, not the raw batch
+        size. Returns the real row count to slice fetches back to, or
+        None when the stamp doesn't apply to this call (feeds missing,
+        row counts disagreeing across feeds — the call then runs at its
+        raw signature, still correct)."""
+        bkt = getattr(program, "_bucketize", None)
+        if not bkt:
+            return None
+        names = bkt.get("feeds") or ()
+        rows = set()
+        for name in names:
+            arr = feed_arrays.get(name)
+            if arr is None or getattr(arr, "ndim", 0) < 1:
+                return None
+            rows.add(int(arr.shape[0]))
+        if len(rows) != 1:
+            return None
+        from .transpiler.passes import next_pow2
+
+        n = rows.pop()
+        bucket = next_pow2(n)
+        if bucket != n:
+            for name in names:
+                arr = np.asarray(feed_arrays[name])
+                pad = np.zeros((bucket - n,) + arr.shape[1:], arr.dtype)
+                feed_arrays[name] = np.concatenate([arr, pad], axis=0)
+        return n
+
+    @staticmethod
+    def _slice_bucketized(program: Program, fetch_names, outs, n):
+        """Slice batch-carrying fetches back to the real row count (the
+        stamp lists which fetches carry the feed batch axis)."""
+        if n is None:
+            return outs
+        sliced = set(getattr(program, "_bucketize", {}).get("fetches", ()))
+        return [o[:n] if name in sliced else o
+                for name, o in zip(fetch_names, outs)]
+
     @staticmethod
     def _holder_for(gb, op):
         rvar = gb._find_var_recursive(op.input("Reader")[0])
@@ -785,6 +850,7 @@ class Executor:
         feed = feed or {}
         fetch_list = list(fetch_list or [])
         fetch_names = tuple(_fetch_name(f) for f in fetch_list)
+        program = self._maybe_optimize(program, scope, feed, fetch_names)
 
         gb = program.global_block()
         feed_arrays = {}
@@ -810,6 +876,10 @@ class Executor:
                                                            var)
             obs.READER_PULL_MS.inc((time.perf_counter() - t_pull) * 1e3,
                                    kind="run")
+        # bucketize stamp (opt level 2): pad the dynamic batch axis to
+        # its pow2 bucket BEFORE the signature is derived — churny batch
+        # sizes collapse onto one compile-cache/AOT-cache entry
+        bkt_rows = self._bucketize_feeds(program, feed_arrays)
         feed_sig = tuple(
             (name, arr.shape, str(arr.dtype)) for name, arr in sorted(feed_arrays.items())
         )
@@ -861,7 +931,9 @@ class Executor:
             feed_bytes=obs.nbytes_of(feed_arrays.values()),
             fetch_bytes=obs.nbytes_of(fetches),
             device_ms=wall * 1e3 if fence else None)
-        return self._finish(compiled, fetches, new_state, scope, return_numpy)
+        outs = self._finish(compiled, fetches, new_state, scope,
+                            return_numpy)
+        return self._slice_bucketized(program, fetch_names, outs, bkt_rows)
 
     def run_loop(
         self,
@@ -916,6 +988,9 @@ class Executor:
         feed = feed or {}
         fetch_list = list(fetch_list or [])
         fetch_names = tuple(_fetch_name(f) for f in fetch_list)
+        # same optimize step as run(); the bucketize stamp stays dormant
+        # here (run_loop windows are already shape-stable by contract)
+        program = self._maybe_optimize(program, scope, feed, fetch_names)
 
         per_step_names = set(per_step_feeds or ())
         unknown = per_step_names - set(feed)

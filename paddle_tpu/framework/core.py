@@ -402,13 +402,21 @@ class Program:
         return self.global_block().all_parameters()
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "version": 1,
             "random_seed": self.random_seed,
             "amp": self._amp,
             "amp_level": getattr(self, "_amp_level", "O1"),
             "blocks": [b.to_dict() for b in self.blocks],
         }
+        # bucketization stamp (transpiler/passes/bucketize.py): present
+        # only on stamped programs, so unoptimized programs keep their
+        # exact pre-existing serialization (and content fingerprints —
+        # the AOT cache keys hash this dict)
+        bkt = getattr(self, "_bucketize", None)
+        if bkt:
+            d["bucketize"] = bkt
+        return d
 
     def to_json(self) -> str:
         return json.dumps(self.to_dict())
@@ -423,6 +431,8 @@ class Program:
             raise ValueError(
                 "serialized program has invalid amp_level %r" % (lvl,))
         p._amp_level = lvl
+        if d.get("bucketize"):
+            p._bucketize = d["bucketize"]
         # first pass: blocks
         p.blocks = []
         for bd in d["blocks"]:

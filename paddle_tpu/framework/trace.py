@@ -34,6 +34,18 @@ from .core import Block, Operator, grad_var_name
 # before tracing (the jitted step stays pure).
 _SKIP_OPS = {"feed", "fetch", "read"}
 
+# Per-op RNG keys derive from the op's block position — which the
+# optimizing transpiler perturbs when it deletes or fuses ops. Before its
+# first rewrite, the pass manager stamps every op's PRE-optimization
+# position as this attr (transpiler/passes/manager.py) and the tracer
+# prefers it, so an optimized program draws the exact PRNG stream the
+# original would (parity gating requires bit-equal dropout masks).
+_RNG_IDX_ATTR = "__rng_idx__"
+
+
+def _rng_idx(op: Operator, op_idx: int) -> int:
+    return op.attrs.get(_RNG_IDX_ATTR, op_idx)
+
 # Mixed precision (program.enable_mixed_precision()): matmul-class ops run
 # their float inputs in bf16 — MXU native, half the HBM traffic — while
 # numerically sensitive ops are pinned to fp32. Parameters and optimizer
@@ -43,7 +55,7 @@ _SKIP_OPS = {"feed", "fetch", "read"}
 _AMP_BF16_OPS = {
     "mul", "matmul", "conv2d", "conv3d", "conv2d_transpose",
     "conv3d_transpose", "sequence_conv", "fused_attention",
-    "fused_lm_head_loss",
+    "fused_lm_head_loss", "fused_fc",
 }
 _AMP_FP32_OPS = {
     "softmax_with_cross_entropy", "cross_entropy", "layer_norm",
@@ -279,9 +291,12 @@ def trace_block(block: Block, env: Dict, rng: RngStream) -> Dict:
             continue
         if op.type != "autodiff":
             if first_ad is not None and op_idx < first_ad:
-                forward_ops.append((op, op_idx))  # deferred to the vjp
+                # deferred to the vjp (RNG key by pre-optimization stamp)
+                forward_ops.append((op, _rng_idx(op, op_idx)))
                 continue
-            trace_op(op, block, env, rng.for_op(block.idx, op_idx), subblock_fn)
+            trace_op(op, block, env, rng.for_op(block.idx,
+                                                _rng_idx(op, op_idx)),
+                     subblock_fn)
             continue
 
         # -- autodiff: differentiate loss wrt params over the full forward

@@ -56,13 +56,14 @@ class Predictor:
     """
 
     def __init__(self, model_dir: str, place=None, aot_cache: bool = True,
-                 cache_dir: Optional[str] = None, preload: bool = True):
+                 cache_dir: Optional[str] = None, preload: bool = True,
+                 opt_level: Optional[int] = None):
         from . import io as fluid_io
         from .executor import Executor
 
         self.model_dir = model_dir
         self._scope = Scope()
-        exe = Executor(place)
+        exe = Executor(place, opt_level=0)
         if not aot_cache:
             # aot_cache=False promises NO disk persistence — that covers
             # the loader Executor's own compiles (load/startup programs
@@ -71,6 +72,21 @@ class Predictor:
         self._program, self._feed_names, self._fetch_targets = (
             fluid_io.load_inference_model(model_dir, exe, scope=self._scope))
         self._fetch_names = [t.name for t in self._fetch_targets]
+        # opt-in optimizing transpiler, same knob as the Executor
+        # (PADDLE_TPU_OPT; explicit arg wins). The optimized program has
+        # its own content fingerprint, so its executables coexist with
+        # the raw model's in the model-local AOT cache — and a model
+        # exported with save_inference_model(optimize=...) needs nothing
+        # here (already optimized, already stamped).
+        from .transpiler.passes import opt_level_from_env, optimize_program
+
+        self.opt_level = (opt_level_from_env(0) if opt_level is None
+                          else int(opt_level))
+        if self.opt_level > 0:
+            self._program, _opt_ctx = optimize_program(
+                self._program, scope=self._scope, level=self.opt_level,
+                feed_names=self._feed_names,
+                fetch_names=self._fetch_names)
         self._cache_dir = cache_dir or os.path.join(model_dir, _AOT_DIR)
         # the shared persistent executable store (runtime/aot_cache.py):
         # same layout/GC/quarantine as the training Executor's cache, but
@@ -273,6 +289,11 @@ class Predictor:
                 return False
             feed_arrays[name] = np.zeros(
                 (batch_rows,) + shape[1:], want or np.float32)
+        # bucketized models pad at run(): warm the signature run() will
+        # actually use, not the raw row count
+        from .executor import Executor as _Exe
+
+        _Exe._bucketize_feeds(self._program, feed_arrays)
         self._get_executable(feed_arrays)
         return True
 
@@ -285,8 +306,18 @@ class Predictor:
         # conversion walks the precomputed plan (Engine.convert_feeds —
         # the one feed-plan code path, shared with the Executor's engine)
         feed_arrays = self._engine.convert_feeds(feed, self._feed_plan)
+        # bucketize stamp (optimized/exported models): pad the batch
+        # axis to its pow2 bucket so churny request sizes share one
+        # executable; PredictorServer batches arrive pre-padded to a
+        # bucket, making this a no-op on the serving path
+        from .executor import Executor as _Exe
+
+        bkt_rows = _Exe._bucketize_feeds(self._program, feed_arrays)
         exe = self._get_executable(feed_arrays)
         outs = exe(feed_arrays, self._state)
+        if bkt_rows is not None:
+            outs = _Exe._slice_bucketized(
+                self._program, self._fetch_names, list(outs), bkt_rows)
         outs = ([np.asarray(o) for o in outs] if return_numpy
                 else list(outs))
         # batch latency + fill distribution (per-request latency for the

@@ -244,14 +244,27 @@ def save_inference_model(
     params_filename: Optional[str] = None,
     export_for_deployment: bool = True,
     scope: Optional[Scope] = None,
+    optimize: int = 0,
 ):
     """Reference: io.py:save_inference_model. Writes the pruned inference
-    program as JSON plus the params it needs."""
+    program as JSON plus the params it needs.
+
+    ``optimize=1|2`` additionally runs the optimizing transpiler
+    (transpiler/passes/) over the pruned program before export: folded
+    constants ship as parameters, fused ops ship fused, and at level 2
+    the bucketize stamp rides the program JSON so any Predictor serving
+    the directory buckets its feed signatures."""
     program = main_program if main_program is not None else default_main_program()
     if not isinstance(target_vars, (list, tuple)):
         target_vars = [target_vars]
     target_names = [v.name if isinstance(v, Variable) else str(v) for v in target_vars]
     pruned = _prune_for_targets(program, target_names)
+    if optimize:
+        from ..transpiler.passes import optimize_program
+
+        pruned, _opt_ctx = optimize_program(
+            pruned, scope=_scope_of(executor, scope), level=int(optimize),
+            feed_names=feeded_var_names, fetch_names=target_names)
 
     os.makedirs(dirname, exist_ok=True)
     meta = {

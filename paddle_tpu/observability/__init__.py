@@ -48,6 +48,7 @@ __all__ = [
     "DECODE_TOKENS", "DECODE_SLOTS", "DECODE_STEP_MS", "DECODE_REQUESTS",
     "CKPT_SAVES", "CKPT_BYTES", "CKPT_PENDING", "CKPT_SAVE_MS",
     "CKPT_RESTORE_MS", "CKPT_RETRIES", "CKPT_FAILURES",
+    "TRANSPILE_OPS_REMOVED", "TRANSPILE_OPS_FUSED", "TRANSPILE_PASS_MS",
 ]
 
 # -- the shared instrument set (registered once, process-wide) -----------
@@ -174,6 +175,17 @@ ANALYSIS_COVERAGE = REGISTRY.gauge(
     "paddle_tpu_analysis_infer_coverage",
     "Fraction of a program's op instances covered by a registered "
     "shape/dtype inference rule, per program fingerprint")
+TRANSPILE_OPS_REMOVED = REGISTRY.counter(
+    "paddle_tpu_transpile_ops_removed_total",
+    "Ops deleted by the optimizing transpiler, by pass="
+    "constant_fold|cse|dce|conv_bn_fold (transpiler/passes/)")
+TRANSPILE_OPS_FUSED = REGISTRY.counter(
+    "paddle_tpu_transpile_ops_fused_total",
+    "Source ops folded INTO a fused op by the fusion passes, by pass "
+    "(3 means mul+elementwise_add+relu became one fused_fc)")
+TRANSPILE_PASS_MS = REGISTRY.histogram(
+    "paddle_tpu_transpile_passes_ms",
+    "Wall time per optimizing-transpiler pass invocation, by pass")
 FLEET_WORKERS = REGISTRY.gauge(
     "paddle_tpu_fleet_workers",
     "Router view of worker replicas by state=starting|ready|draining|"

@@ -274,21 +274,28 @@ def _mul_dwt_enabled():
     return os.environ.get("PADDLE_TPU_MUL_DWT", "0") == "1"
 
 
-@register_op("mul")
-def _mul(ctx):
-    """The reference's `mul` op: flatten X to 2-D by x_num_col_dims then
-    matmul (reference: paddle/fluid/operators/mul_op.cc:36)."""
+def _mul_compute(x, y, xnc, ync):
+    """The reference's `mul` computation: flatten X to 2-D by
+    x_num_col_dims then matmul (reference: paddle/fluid/operators/
+    mul_op.cc:36). Shared by the `mul` kernel and the transpiler-emitted
+    `fused_fc` op — they MUST stay one code path so fusion is
+    bit-exact."""
     import math as _math
 
-    x, y = ctx.input("X"), ctx.input("Y")
-    xnc = ctx.attr("x_num_col_dims", 1)
-    ync = ctx.attr("y_num_col_dims", 1)
     xs, ys = x.shape, y.shape
     x2 = x.reshape((_math.prod(xs[:xnc]) if xnc else 1, -1))
     y2 = y.reshape((_math.prod(ys[:ync]), -1))
     out = _mm2d_dwt(x2, y2) if _mul_dwt_enabled() else _mm2d(x2, y2)
-    out_shape = xs[:xnc] + ys[ync:]
-    return {"Out": out.reshape(out_shape)}
+    return out.reshape(xs[:xnc] + ys[ync:])
+
+
+@register_op("mul")
+def _mul(ctx):
+    """The reference's `mul` op: flatten X to 2-D by x_num_col_dims then
+    matmul (reference: paddle/fluid/operators/mul_op.cc:36)."""
+    return {"Out": _mul_compute(ctx.input("X"), ctx.input("Y"),
+                                ctx.attr("x_num_col_dims", 1),
+                                ctx.attr("y_num_col_dims", 1))}
 
 
 @register_op("matmul")
@@ -1114,3 +1121,52 @@ def _fused_elemwise_activation(ctx):
             "(one of %s composed with one of %s)"
             % (functors, sorted(_FEA_BINARY), sorted(_FEA_UNARY)))
     return {"Out": out, "IntermediateOut": intermediate}
+
+
+# activations the fused_fc op reproduces — each entry is the SAME jnp
+# composition the standalone kernel applies at DEFAULT attrs (the fusion
+# pass only fuses attr-less activation ops), so fusing is bit-exact
+_FC_ACTS = {
+    "relu": jax.nn.relu,
+    "tanh": jnp.tanh,
+    "sigmoid": jax.nn.sigmoid,
+    "relu6": lambda x: jnp.clip(x, 0.0, 6.0),
+    "softplus": jax.nn.softplus,
+    "leaky_relu": lambda x: jnp.where(x >= 0, x, 0.02 * x),
+    "swish": lambda x: x * jax.nn.sigmoid(1.0 * x),
+    "square": jnp.square,
+    "abs": jnp.abs,
+    "exp": jnp.exp,
+}
+
+
+@register_op("fused_fc")
+def _fused_fc(ctx):
+    """Transpiler-emitted fused matmul + bias + activation (the
+    reference's `fc` fused op; emitted by transpiler/passes/fusion.py).
+    kind="mul" composes the exact `mul` kernel computation; kind="matmul"
+    the default-attr `matmul`. The bias add uses the same paddle
+    axis-span broadcast as `elementwise_add`, and `act` names one of the
+    default-attr activations in _FC_ACTS — every piece is the identical
+    jnp call chain the three unfused ops would run, so fusion changes
+    nothing numerically."""
+    x, y = ctx.input("X"), ctx.input("Y")
+    kind = ctx.attr("kind", "mul")
+    if kind == "mul":
+        out = _mul_compute(x, y, ctx.attr("x_num_col_dims", 1),
+                           ctx.attr("y_num_col_dims", 1))
+    elif kind == "matmul":
+        out = jnp.matmul(x, y)
+    else:
+        raise ValueError("fused_fc: unknown kind %r" % (kind,))
+    b = ctx.input("Bias")
+    if b is not None:
+        out = jnp.add(out, _broadcast_y(out, b, ctx.attr("axis", -1)))
+    act = ctx.attr("act", "")
+    if act:
+        if act not in _FC_ACTS:
+            raise ValueError(
+                "fused_fc: unsupported act %r (one of %s)"
+                % (act, sorted(_FC_ACTS)))
+        out = _FC_ACTS[act](out)
+    return {"Out": out}

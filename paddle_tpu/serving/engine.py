@@ -61,6 +61,12 @@ class Engine:
         # program._version, same contract as the old
         # Executor._feed_var_for)
         self._feed_vars: Tuple = (None, {})
+        # optimized-twin memo (the PADDLE_TPU_OPT step): (version, level,
+        # feeds, fetches) -> optimized Program. Holding the clone here
+        # keeps it alive exactly as long as its source program's Engine,
+        # so the Executor's weak-keyed compile caches on the clone can't
+        # see id reuse
+        self._optimized: Dict = {}
 
     # -- identity ---------------------------------------------------------
     @property
@@ -71,6 +77,44 @@ class Engine:
     def fingerprint(self) -> str:
         """Short (8-hex) program fingerprint, cached per version."""
         return obs.program_fp(self.program)
+
+    # -- optimizing transpiler --------------------------------------------
+    _OPT_MEMO_MAX = 8
+
+    def optimized(self, scope=None, feed_names: Sequence[str] = (),
+                  fetch_names: Sequence[str] = (), level: int = 1):
+        """The opt-in optimize step (PADDLE_TPU_OPT / explicit API): an
+        optimized CLONE of this engine's program from the transpiler
+        pass manager, memoized per (program version, level, feed set,
+        fetch order). The clone fingerprints differently from the
+        original, so its executables land under their own AOT-cache
+        keys — optimized and original coexist on disk and in memory."""
+        if level <= 0:
+            return self.program
+        import weakref
+
+        key = (self.version, int(level), tuple(sorted(feed_names)),
+               tuple(fetch_names))
+        hit = self._optimized.get(key)
+        if hit is not None:
+            # the twin is only valid with the Scope its passes
+            # materialized folded params into — a different scope must
+            # re-optimize, not inherit state it doesn't hold
+            ref, prog = hit
+            same_scope = (scope is None and ref is None) or (
+                ref is not None and ref() is scope)
+            if same_scope:
+                return prog
+        from ..transpiler.passes import optimize_program
+
+        prog, _ctx = optimize_program(
+            self.program, scope=scope, level=level,
+            feed_names=feed_names, fetch_names=fetch_names)
+        if len(self._optimized) >= self._OPT_MEMO_MAX:
+            self._optimized.pop(next(iter(self._optimized)))
+        self._optimized[key] = (
+            weakref.ref(scope) if scope is not None else None, prog)
+        return prog
 
     # -- feed plan --------------------------------------------------------
     def feed_var(self, name: str):

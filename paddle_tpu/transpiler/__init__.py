@@ -6,14 +6,20 @@
 - memory_optimize / release_memory: the reference does liveness-based
   var reuse; XLA owns buffer assignment here, so this exposes the
   rematerialization policy knob instead (see memory_optimizer.py).
-- InferenceTranspiler: inference-time graph rewrites (BN fold).
+- InferenceTranspiler: inference-time graph rewrites (BN fold) — now a
+  shim over the optimizing transpiler's conv_bn fold.
 - PipelineTranspiler: structural stage-cut pass — the SAME Program that
   runs dp/tp/sp runs pipelined under a pp mesh axis.
+- passes/: the optimizing transpiler — a parity-gated pass manager
+  (constant folding, CSE, dead-op elimination, fc/conv+bn fusion, feed
+  bucketization) behind ``optimize_program`` and ``PADDLE_TPU_OPT``.
 """
 from .distribute_transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
 from .memory_optimizer import memory_optimize, release_memory  # noqa: F401
 from .inference_transpiler import InferenceTranspiler  # noqa: F401
 from .pipeline_transpiler import PipelineTranspiler  # noqa: F401
+from . import passes  # noqa: F401
+from .passes import PassManager, optimize_program  # noqa: F401
 
 __all__ = [
     "DistributeTranspiler",
@@ -22,4 +28,7 @@ __all__ = [
     "release_memory",
     "InferenceTranspiler",
     "PipelineTranspiler",
+    "PassManager",
+    "optimize_program",
+    "passes",
 ]

@@ -1,19 +1,21 @@
-"""InferenceTranspiler: inference-time graph rewrites.
+"""InferenceTranspiler: inference-time graph rewrites (legacy shim).
 
 Reference: python/paddle/fluid/transpiler/inference_transpiler.py — folds
-batch_norm into the preceding conv2d (adjusting the conv filter/bias in the
-Scope) and drops the bn op, plus relu/bn reordering for MKLDNN.
+batch_norm into the preceding conv2d (adjusting the conv filter/bias in
+the Scope) and drops the bn op, plus relu/bn reordering for MKLDNN.
 
-On TPU the XLA fuser already fuses the bn arithmetic into the conv epilogue
-at runtime, so the fold is a compile-time simplification rather than a
-perf necessity — but it still shrinks the program and removes 4 state
-tensors per conv, and keeps parity with reference deployment flows.
+The fold now lives in the optimizing transpiler
+(``transpiler/passes/fusion.py:fold_conv_bn``), where it also runs as the
+pass-manager's ``conv_bn_fold`` pass (level 2) — there it materializes
+folded weights under fresh ``.bnfold`` names so the original program
+keeps working. THIS class keeps the reference's historical contract
+exactly: it rewrites the given program in place AND overwrites the
+existing filter/bias values in the Scope (test-pinned), with no
+``is_test`` gate — callers fold ``clone(for_test=True)`` programs.
 """
 from __future__ import annotations
 
 from typing import Optional
-
-import numpy as np
 
 from ..framework.core import Program
 from ..framework.scope import Scope, global_scope
@@ -23,104 +25,12 @@ __all__ = ["InferenceTranspiler"]
 
 class InferenceTranspiler:
     def transpile(self, program: Program, place=None, scope: Optional[Scope] = None):
-        """Fold conv2d+batch_norm pairs in-place (program AND scope params).
+        """Fold conv2d+batch_norm pairs in-place (program AND scope
+        params). Only folds when the conv output feeds exactly the bn
+        and nothing else, mirroring the reference's adjacency check."""
+        from .passes.fusion import fold_conv_bn
 
-        Only folds when the conv output feeds exactly the bn and nothing
-        else, mirroring the reference's adjacency check.
-        """
         scope = scope if scope is not None else global_scope()
-        block = program.global_block()
-
-        # count readers of every var so we only fold single-consumer convs
-        readers = {}
-        for op in block.ops:
-            for name in op.input_arg_names:
-                readers[name] = readers.get(name, 0) + 1
-
-        def _bn_constants(bn):
-            scale = np.asarray(scope.find_var(bn.input("Scale")[0]))
-            beta = np.asarray(scope.find_var(bn.input("Bias")[0]))
-            mean = np.asarray(scope.find_var(bn.input("Mean")[0]))
-            var = np.asarray(scope.find_var(bn.input("Variance")[0]))
-            k = scale / np.sqrt(var + bn.attr("epsilon", 1e-5))
-            return k, beta, mean
-
-        i = 0
-        while i < len(block.ops):
-            conv = block.ops[i]
-            if conv.type != "conv2d":
-                i += 1
-                continue
-            conv_out = conv.output("Output")[0]
-            w_name = conv.input("Filter")[0]
-
-            # pattern A: conv2d -> batch_norm
-            # pattern B: conv2d -> elementwise_add(bias) -> batch_norm
-            #            (layers.conv2d with bias_attr emits the add)
-            nxt = block.ops[i + 1] if i + 1 < len(block.ops) else None
-            nxt2 = block.ops[i + 2] if i + 2 < len(block.ops) else None
-            if (
-                nxt is not None
-                and nxt.type == "batch_norm"
-                and nxt.input("X") == [conv_out]
-                and readers.get(conv_out, 0) == 1
-            ):
-                bn, bn_idx, bias_name = nxt, i + 1, None
-            elif (
-                nxt is not None
-                and nxt2 is not None
-                and nxt.type == "elementwise_add"
-                and nxt.input("X") == [conv_out]
-                and nxt2.type == "batch_norm"
-                and nxt2.input("X") == nxt.output("Out")
-                and readers.get(conv_out, 0) == 1
-                and readers.get(nxt.output("Out")[0], 0) == 1
-            ):
-                bn, bn_idx, bias_name = nxt2, i + 2, nxt.input("Y")[0]
-            else:
-                i += 1
-                continue
-
-            wvar = block._find_var_recursive(w_name)
-            if wvar is not None and not wvar.persistable:
-                # the Filter is a derived in-graph variable, not a stored
-                # parameter (e.g. the ResNet space-to-depth stem transforms
-                # its canonical 7x7 weight in-graph) — leave this BN unfused
-                i = bn_idx + 1
-                continue
-            wval = scope.find_var(w_name)
-            if wval is None:
-                raise RuntimeError(
-                    "conv filter %r has no value in scope; run the startup "
-                    "program before transpiling" % w_name)
-            k, beta, mean = _bn_constants(bn)
-            w = np.asarray(wval)
-            scope.set_var(w_name, (w * k[:, None, None, None]).astype(w.dtype))
-            bn_out = bn.output("Y")[0]
-
-            if bias_name is not None:
-                # fold into the existing bias: y = (conv + b - mean)*k + beta
-                b = np.asarray(scope.find_var(bias_name))
-                scope.set_var(
-                    bias_name, ((b - mean) * k + beta).astype(b.dtype))
-                add = block.ops[bn_idx - 1]
-                add.outputs["Out"] = [bn_out]
-                block.ops.pop(bn_idx)
-            else:
-                # biasless conv: add a folded-bias elementwise_add in the
-                # bn's place
-                bias_name = w_name + ".bnfold_bias"
-                block.create_var(name=bias_name, shape=(len(k),),
-                                 dtype="float32", persistable=True)
-                scope.set_var(bias_name, (beta - mean * k).astype(np.float32))
-                block.ops.pop(bn_idx)
-                block.insert_op(
-                    bn_idx,
-                    type="elementwise_add",
-                    inputs={"X": conv_out, "Y": bias_name},
-                    outputs={"Out": bn_out},
-                    attrs={"axis": 1},
-                )
-            program._bump()
-            i = bn_idx + 1
+        fold_conv_bn(program, scope, keep=(), require_is_test=False,
+                     in_place_params=True)
         return program

@@ -1,50 +1,9 @@
-"""Memory optimization.
+"""Memory optimization — absorbed into the optimizing transpiler.
 
-Reference: python/paddle/fluid/transpiler/memory_optimization_transpiler.py
-— liveness analysis + in-place var reuse inside the C++ executor's Scope.
-On TPU, XLA's buffer assignment already does liveness-based reuse and the
-executor donates state buffers, so the reference's pass is structurally
-unnecessary. What IS worth controlling is rematerialization: trading
-recompute FLOPs for activation memory in the fused fwd+bwd step. This
-module maps the reference API onto a `jax.checkpoint` policy applied to
-the autodiff replay (framework/trace.py honors `program._remat_policy`).
-"""
-from __future__ import annotations
-
-from typing import Optional
-
-from ..framework.core import Program, default_main_program
+The implementation lives in ``transpiler/passes/remat.py`` (the
+reference's memory_optimization_transpiler.py maps onto a jax.checkpoint
+remat policy here; in-graph dead code is the pass manager's ``dce``
+pass). This module survives as the import-compatible shim."""
+from .passes.remat import memory_optimize, release_memory  # noqa: F401
 
 __all__ = ["memory_optimize", "release_memory"]
-
-_POLICIES = {
-    # level 0 (reference default): keep matmul/conv outputs, recompute the
-    # cheap elementwise chains — the sweet spot on HBM-bound TPUs.
-    0: "dots_with_no_batch_dims_saveable",
-    # level 1: save nothing, recompute everything (max memory savings)
-    1: "nothing_saveable",
-}
-
-
-def memory_optimize(
-    input_program: Optional[Program] = None,
-    skip_opt_set=None,
-    print_log: bool = False,
-    level: int = 0,
-):
-    """Enable rematerialization for the program's backward pass."""
-    if level not in _POLICIES:
-        raise ValueError("level must be 0 or 1, got %r" % level)
-    program = input_program if input_program is not None else default_main_program()
-    program._remat_policy = _POLICIES[level]
-    program._bump()  # invalidate compile caches
-    if print_log:
-        print("memory_optimize: remat policy = %s" % program._remat_policy)
-    return program
-
-
-def release_memory(input_program: Optional[Program] = None, skip_opt_set=None):
-    """Reference parity (transpiler/memory_optimization_transpiler.py:
-    release_memory). Buffer release is XLA's job; this is a no-op kept so
-    reference scripts run unchanged."""
-    return input_program

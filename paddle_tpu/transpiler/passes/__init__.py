@@ -1,0 +1,31 @@
+"""Optimizing transpiler: a parity-gated pass manager over the Program
+IR (reference: the one-off transpilers under python/paddle/fluid/
+transpiler/, rebuilt as a composable pipeline on the PR-6 analyzer).
+
+    from paddle_tpu.transpiler.passes import optimize_program
+    optimized, ctx = optimize_program(program, scope=scope, level=1,
+                                      fetch_names=[loss.name])
+
+or implicitly: ``PADDLE_TPU_OPT=1|2`` makes Executor/Predictor optimize
+every program they compile (keyed into the AOT cache by the optimized
+program's own content fingerprint, so original and optimized
+executables coexist).
+
+Passes (manager.py has the level/parity contract):
+level 1 — constant_fold, cse, fuse_fc, fuse_elemwise_act, dce (bit-exact);
+level 2 — + conv_bn_fold (tolerance-parity), bucketize (pow2 feed
+buckets, bit-exact on the real rows).
+"""
+from .manager import (  # noqa: F401
+    PASSES, PassContext, PassManager, RNG_IDX_ATTR, opt_level_from_env,
+    optimize_program, register_pass,
+)
+from . import fold, cse, dce, fusion, bucketize  # noqa: F401 — register
+from .bucketize import next_pow2  # noqa: F401
+from .fusion import fold_conv_bn  # noqa: F401
+
+__all__ = [
+    "PASSES", "PassContext", "PassManager", "RNG_IDX_ATTR",
+    "opt_level_from_env", "optimize_program", "register_pass",
+    "next_pow2", "fold_conv_bn",
+]

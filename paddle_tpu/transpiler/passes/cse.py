@@ -1,0 +1,149 @@
+"""Common-subexpression elimination over the global block.
+
+Two ops compute the same value when they have the same type, the same
+attrs, and the same input VALUES. Input names stand in for values only
+while every one of them has exactly one writer (the verifier's
+write-once discipline makes this the common case); anything touched by
+a rewriting op (``assign``/``increment``/scatter loops) is excluded, as
+is anything impure (RNG, side effects, sub-blocks, persistable writes).
+
+The duplicate op is deleted and all later references to its outputs are
+renamed to the canonical op's outputs. A duplicate whose output name
+must stay addressable (fetch target / sub-block closure) is rewritten
+to a single ``assign`` from the canonical value instead — the value is
+computed once either way (and XLA aliases the assign away).
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from ... import observability as obs
+from .manager import PLUMBING_OPS, register_pass, rewrite_inputs
+
+# never CSE: nondeterministic, stateful, structural, or
+# output-name-sensitive ops ("assign" is how WE preserve kept names — a
+# second CSE round must not collapse two kept-name assigns into one)
+_IMPURE = PLUMBING_OPS | {
+    "autodiff", "assign", "print", "while", "conditional_block", "switch",
+    "static_rnn", "dynamic_rnn", "beam_search", "write_to_array",
+    "read_from_array", "create_array", "increment", "scatter",
+    "dropout", "uniform_random", "gaussian_random",
+    "uniform_random_batch_size_like", "gaussian_random_batch_size_like",
+    "truncated_gaussian_random", "sampling_id", "random_crop",
+    "top_k_sample", "top_p_sample", "load_file",
+}
+
+# attrs that are bookkeeping, not semantics
+_KEY_IGNORED_ATTRS = {"__rng_idx__"}
+
+
+def _attr_key(attrs: dict):
+    items = []
+    for k in sorted(attrs):
+        if k in _KEY_IGNORED_ATTRS:
+            continue
+        v = attrs[k]
+        if isinstance(v, np.ndarray):
+            v = ("__nd__", str(v.dtype), v.shape, v.tobytes())
+        elif isinstance(v, (list, tuple)):
+            v = tuple(v)
+        items.append((k, v))
+    return tuple(items)
+
+
+@register_pass("cse", level=1, exact=True)
+def cse(ctx) -> int:
+    program = ctx.program
+    gb = program.global_block()
+    writers = ctx.writer_counts()
+    keep = ctx.keep_names()
+
+    def persistable(name: str) -> bool:
+        var = gb._find_var_recursive(name)
+        return var is not None and var.persistable
+
+    # write positions per name: the trace env is imperative, so two
+    # identical reads are the same VALUE only if no write to any input
+    # lands between them (optimizer ops rewriting a persistable — e.g. a
+    # decayed learning rate — would otherwise be conflated across the
+    # update; the verifier's write-once rule doesn't cover persistables)
+    write_pos: Dict[str, list] = {}
+    for idx, op in enumerate(gb.ops):
+        for n in op.output_arg_names:
+            write_pos.setdefault(n, []).append(idx)
+
+    def value_stable(names, i_canon, i_dup):
+        return not any(i_canon < p <= i_dup
+                       for n in names for p in write_pos.get(n, ()))
+
+    seen = {}
+    rename = {}
+    new_ops = []
+    removed = 0
+    for op_idx, op in enumerate(gb.ops):
+        # apply pending renames to THIS op's inputs before keying it
+        for slot, names in op.inputs.items():
+            op.inputs[slot] = [rename.get(n, n) for n in names]
+        eligible = (
+            op.type not in _IMPURE
+            and op.attr("sub_block") is None
+            and all(writers.get(n, 0) <= 1 for n in op.input_arg_names)
+            and all(writers.get(n, 0) == 1 and not persistable(n)
+                    for n in op.output_arg_names)
+            and op.output_arg_names
+        )
+        if not eligible:
+            new_ops.append(op)
+            continue
+        key = (
+            op.type,
+            _attr_key(op.attrs),
+            tuple((slot, tuple(op.inputs[slot]))
+                  for slot in sorted(op.inputs)),
+            tuple(sorted(op.outputs)),
+            tuple(len(op.outputs[slot]) for slot in sorted(op.outputs)),
+        )
+        entry = seen.get(key)
+        if entry is None:
+            seen[key] = (op, op_idx)
+            new_ops.append(op)
+            continue
+        canon, canon_idx = entry
+        if not value_stable(op.input_arg_names, canon_idx, op_idx):
+            new_ops.append(op)  # an input was rewritten in between
+            continue
+        kept_outs = [n for n in op.output_arg_names if n in keep]
+        if kept_outs:
+            if len(op.output_arg_names) != 1:
+                new_ops.append(op)  # partial-keep multi-output: leave it
+                continue
+            # keep the name, drop the recompute: one assign from the
+            # canonical value
+            src = canon.output_arg_names[
+                op.output_arg_names.index(kept_outs[0])]
+            op.type = "assign"
+            op.inputs = {"X": [src]}
+            op.outputs = {"Out": [kept_outs[0]]}
+            op.attrs = {k: v for k, v in op.attrs.items()
+                        if k in _KEY_IGNORED_ATTRS}
+            new_ops.append(op)
+            removed += 1
+            ctx.count("cse", "ops_deduped")
+            obs.TRANSPILE_OPS_REMOVED.inc(**{"pass": "cse"})
+            continue
+        for slot in op.outputs:
+            c_names = canon.outputs.get(slot, [])
+            for dup_name, c_name in zip(op.outputs[slot], c_names):
+                rename[dup_name] = rename.get(c_name, c_name)
+        removed += 1
+        ctx.count("cse", "ops_deduped")
+        obs.TRANSPILE_OPS_REMOVED.inc(**{"pass": "cse"})
+    if removed:
+        gb.ops[:] = new_ops
+        rewrite_inputs(gb, rename)
+        # renamed-away outputs may appear in later fetch-independent
+        # declarations only; dead-var pruning (dce) sweeps them
+        program._bump()
+    return removed

@@ -1,0 +1,46 @@
+"""Dead-op / dead-var elimination: the PR-6 dead-code LINT as a
+transform.
+
+The liveness analysis is literally the lint's (analysis/lints.py:
+backward_liveness — one shared function, so the finding and the fix can
+never disagree): ops unreachable backward from any fetch target or
+persistable write are deleted, then var declarations nothing references
+are swept. Autodiff replay stays correct by the liveness contract — a
+dead op is outside every loss's forward cone, so removing it from the
+vjp replay prefix changes no gradient (and the ``__rng_idx__`` stamps
+keep every surviving stochastic op's PRNG stream identical)."""
+from __future__ import annotations
+
+from ... import observability as obs
+from .manager import prune_dead_vars, register_pass
+
+
+@register_pass("dce", level=1, exact=True)
+def dce(ctx) -> int:
+    from ...analysis.lints import backward_liveness
+
+    program = ctx.program
+    gb = program.global_block()
+    # fetch names root liveness; feeds are inputs, not roots — but an
+    # explicitly kept name must survive even if nothing reads it
+    anchored, dead_ops, _live = backward_liveness(program,
+                                                  ctx.fetch_names)
+    if not anchored:
+        return 0
+    keep = ctx.keep_names()
+    dead_idx = {idx for idx, op in dead_ops
+                if not (set(op.output_arg_names) & keep)}
+    removed = 0
+    if dead_idx:
+        gb.ops[:] = [op for i, op in enumerate(gb.ops)
+                     if i not in dead_idx]
+        removed = len(dead_idx)
+        program._bump()
+        ctx.count("dce", "ops_removed", removed)
+        obs.TRANSPILE_OPS_REMOVED.inc(removed, **{"pass": "dce"})
+    swept = prune_dead_vars(program, keep)
+    if swept:
+        ctx.count("dce", "vars_removed", swept)
+    # var sweeps alone must not extend the fixpoint loop (they cannot
+    # unlock further rewrites)
+    return removed

@@ -1,0 +1,213 @@
+"""Constant folding: evaluate transpile-time-constant ops at build time.
+
+An op folds when every input is a known constant: either a *scope
+constant* — a persistable var with a Scope value that NO op in the
+program writes (training params are optimizer-written, so they never
+qualify in a training program) — or the output of an already-folded op
+(``fill_constant``-style sources seed the lattice with zero inputs).
+Folded ops are evaluated eagerly through their real kernels (the same
+functions the tracer calls) and deleted; whole chains collapse in one
+sweep.
+
+Where the result lands depends on what ROOTED the chain, because parity
+must be bit-exact:
+
+- chains touching any scope constant are *runtime* values in both the
+  raw program (state enters as an executor input) and the optimized one
+  — the result materializes as a persistable parameter (XLA-owned
+  buffer, the PR-10 donation lesson);
+- chains rooted ONLY in attr-embedded constants were *compile-time*
+  constants in the raw program (XLA constant-folds them into the
+  computation), so they must STAY compile-time constants: the chain
+  collapses to one ``assign_value`` op carrying the evaluated array as
+  an attr. Materializing these as parameters instead measurably changes
+  XLA's simplification (a state input can't be algebraically folded the
+  way a literal can) — observed as last-ulp output drift.
+
+Exactness: the whitelist is restricted to ops whose eager evaluation is
+bit-identical to their in-graph execution (structural/elementwise/
+reduction kernels). Under AMP, ops the tracer would cast (trace.py
+bf16 sets) are excluded — folding would compute them at fp32.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import observability as obs
+from .manager import PLUMBING_OPS, register_pass
+
+# evaluation-safe op set (no RNG, no side effects, no data-dependent
+# output shapes beyond what the attrs pin, bit-stable eager-vs-traced)
+FOLDABLE = {
+    # sources
+    "fill_constant", "fill", "assign_value", "fill_zeros_like",
+    # structural
+    "assign", "cast", "shape", "concat", "reshape", "transpose",
+    "stack", "unstack", "squeeze", "unsqueeze", "split", "expand",
+    "one_hot", "flatten", "reverse",
+    # elementwise
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "elementwise_max", "elementwise_min",
+    "elementwise_pow", "elementwise_mod", "scale", "clip", "sum",
+    "sigmoid", "logsigmoid", "exp", "relu", "tanh", "tanh_shrink",
+    "sqrt", "abs", "ceil", "floor", "cos", "sin", "round",
+    "reciprocal", "square", "softplus", "softsign", "log", "sign",
+    "relu6", "leaky_relu", "elu", "brelu", "soft_relu", "pow", "stanh",
+    "hard_sigmoid", "swish", "thresholded_relu", "hard_shrink",
+    "softshrink", "cumsum", "minus",
+    # reductions (same shape eager and traced -> same reduction order)
+    "reduce_sum", "reduce_mean", "reduce_max", "reduce_min",
+    "reduce_prod",
+}
+
+# ops AMP never touches: the only foldable set when program._amp is on
+_AMP_NEUTRAL = {
+    "fill_constant", "fill", "assign_value", "fill_zeros_like", "assign",
+    "cast", "shape", "concat", "reshape", "transpose", "stack",
+    "unstack", "squeeze", "unsqueeze", "split", "expand", "one_hot",
+    "flatten", "reverse",
+}
+
+# marker on the assign_value ops THIS pass emits, so a later run neither
+# re-folds them (churn) nor seeds from them (already terminal)
+_FOLDED_ATTR = "__folded__"
+
+# don't materialize constants bigger than this (elements): a folded
+# giant would bloat exports/attrs for a negligible per-step win
+_MAX_FOLD_ELEMS = 1 << 22
+
+
+def _no_rng():
+    raise RuntimeError("foldable ops must not draw RNG")
+
+
+@register_pass("constant_fold", level=1, exact=True, needs_scope=True)
+def constant_fold(ctx) -> int:
+    """One forward sweep over the global block; folded outputs become
+    constants for later ops, so chains collapse in a single invocation
+    (the manager's fixpoint loop catches anything order-dependent)."""
+    import jax.numpy as jnp
+
+    from ...framework.core import Operator
+    from ...framework.trace import trace_op
+
+    program, scope = ctx.program, ctx.scope
+    gb = program.global_block()
+    writers = ctx.writer_counts()
+    keep = ctx.keep_names()
+    allowed = _AMP_NEUTRAL if getattr(program, "_amp", False) else FOLDABLE
+
+    # seed: persistable vars with a scope value and no writer in the
+    # program (frozen state — the optimize_program docstring contract)
+    const_vals, const_kind = {}, {}
+    for block in program.blocks:
+        for name, var in block.vars.items():
+            if (var.persistable and writers.get(name, 0) == 0
+                    and name not in ctx.feed_names):
+                val = scope.find_var(name)
+                if val is not None:
+                    const_vals[name] = val
+                    const_kind[name] = "state"
+
+    folded_ops = 0
+    new_ops = []
+    produced = []  # folded names in production order
+    for op in gb.ops:
+        t = op.type
+        foldable = (
+            t in allowed and t not in PLUMBING_OPS
+            and not op.attr(_FOLDED_ATTR, False)
+            and op.attr("sub_block") is None
+            # pure sources (fill_constant) have no inputs: all() is True
+            and all(n in const_vals for n in op.input_arg_names)
+            and all(writers.get(n, 0) == 1 for n in op.output_arg_names)
+            and not any(
+                gb._find_var_recursive(n) is not None
+                and gb._find_var_recursive(n).persistable
+                for n in op.output_arg_names)
+            and op.output_arg_names
+        )
+        if not foldable:
+            new_ops.append(op)
+            continue
+        env = {n: jnp.asarray(np.asarray(const_vals[n]))
+               for n in op.input_arg_names}
+        try:
+            trace_op(op, gb, env, _no_rng)
+        except Exception:
+            # a kernel that can't evaluate eagerly (exotic attrs) simply
+            # stays in the graph — folding is an optimization, not a
+            # correctness requirement
+            new_ops.append(op)
+            continue
+        outs = {n: np.asarray(env[n]) for n in op.output_arg_names
+                if n in env}
+        if (len(outs) != len(op.output_arg_names)
+                or sum(v.size for v in outs.values()) > _MAX_FOLD_ELEMS):
+            new_ops.append(op)
+            continue
+        kind = ("state" if any(const_kind[n] == "state"
+                               for n in op.input_arg_names) else "attr")
+        if kind == "state" and any(n in keep for n in op.output_arg_names):
+            # a kept name (fetch target / sub-block closure) must stay
+            # PRODUCED by the graph: state-kind results materialize as
+            # scope values no op reads, which analyze_state would never
+            # upload and the step could never fetch. Keep the terminal
+            # op; its (const) inputs still fold upstream.
+            new_ops.append(op)
+            continue
+        for name, val in outs.items():
+            const_vals[name] = val
+            const_kind[name] = kind
+            produced.append(name)
+        folded_ops += 1
+    if not folded_ops:
+        return 0
+
+    # materialize the folded names something still reads
+    still_read = set(keep)
+    for op in new_ops:
+        still_read.update(op.input_arg_names)
+        if op.type == "autodiff":
+            still_read.add(op.attr("loss_name"))
+            still_read.update(op.attr("param_names") or ())
+    from .manager import RNG_IDX_ATTR
+
+    emitted = []
+    state_names = []
+    for name in produced:
+        if name not in still_read:
+            continue  # chain intermediate: vanishes entirely
+        val = const_vals[name]
+        if const_kind[name] == "state":
+            state_names.append(name)
+            var = gb._find_var_recursive(name)
+            if var is not None:
+                var.persistable = True
+        else:
+            emitted.append(Operator(
+                gb, type="assign_value", inputs={},
+                outputs={"Out": [name]},
+                attrs={"values": np.asarray(val), "shape": list(val.shape),
+                       "dtype": str(val.dtype), _FOLDED_ATTR: True,
+                       # pre-stamped at the position it will occupy, so a
+                       # re-run's stamping pass is a no-op (idempotence)
+                       RNG_IDX_ATTR: len(emitted)}))
+    if state_names:
+        # runtime state the executor will DONATE: must be XLA-owned
+        # buffers, never numpy-owned memory (the PR-10 heap-corruption
+        # lesson — checkpoint/manager.py device_owned_tree)
+        from ...checkpoint.manager import device_owned_tree
+
+        owned = device_owned_tree({n: const_vals[n] for n in state_names})
+        for name in state_names:
+            scope.set_var(name, owned[name])
+    gb.ops[:] = emitted + new_ops
+    for op in emitted:
+        gb._note_writes(op)
+    program._bump()
+    removed = folded_ops - len(emitted)
+    ctx.count("constant_fold", "ops_removed", max(removed, 0))
+    if removed > 0:
+        obs.TRANSPILE_OPS_REMOVED.inc(removed, **{"pass": "constant_fold"})
+    return folded_ops

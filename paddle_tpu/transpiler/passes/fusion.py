@@ -1,0 +1,349 @@
+"""Fusion passes.
+
+- ``fuse_fc`` (level 1, exact): ``mul``/``matmul`` -> ``elementwise_add``
+  (-> activation) chains — what every ``layers.fc`` call emits — become
+  ONE ``fused_fc`` op. The fused kernel (ops/math.py) composes the exact
+  same jnp calls in the same order, so outputs and gradients are
+  bit-identical; the win is transpile-side: fewer ops to trace, smaller
+  HLO to compile, one op where three were.
+- ``fuse_elemwise_act`` (level 1, exact): leftover
+  ``elementwise_add|mul -> relu`` pairs become the reference's existing
+  ``fused_elemwise_activation`` op.
+- ``conv_bn_fold`` (level 2, tolerance-parity): the InferenceTranspiler
+  conv+batch_norm fold generalized into a pass. Unlike the legacy
+  in-place transpiler it does NOT mutate the original parameters — the
+  folded filter/bias are materialized under fresh ``.bnfold`` names, so
+  the unoptimized program (sharing the same Scope) keeps computing the
+  original values and the two executables coexist.
+
+Fusion is skipped under AMP: the tracer casts ``mul`` to bf16 but the
+bias add stays fp32 at O1, so a fused kernel could not reproduce the
+unfused rounding.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import observability as obs
+from .manager import RNG_IDX_ATTR, register_pass
+
+# activations the fused_fc kernel reproduces exactly (ops/math.py _FC_ACTS)
+FC_ACTS = ("relu", "tanh", "sigmoid", "relu6", "softplus", "leaky_relu",
+           "swish", "square", "abs", "exp")
+
+
+def _single_reader(ctx, readers, keep, writers, name: str) -> bool:
+    """An intermediate a fusion may erase: single-written, read only by
+    the next pattern op, not a kept name, and NOT persistable — erasing
+    a persistable's producing op would silently freeze its scope value
+    (persistable writes are liveness roots; cse/fold guard likewise)."""
+    var = ctx.program.global_block()._find_var_recursive(name)
+    return (readers.get(name, 0) == 1 and name not in keep
+            and writers.get(name, 0) == 1
+            and not (var is not None and var.persistable))
+
+
+def _owned(val):
+    """Scope values the executor may DONATE must be XLA-owned buffers,
+    never numpy-owned memory (checkpoint/manager.py device_owned_tree —
+    the PR-10 heap-corruption lesson)."""
+    from ...checkpoint.manager import device_owned_tree
+
+    return device_owned_tree({"v": val})["v"]
+
+
+@register_pass("fuse_fc", level=1, exact=True)
+def fuse_fc(ctx) -> int:
+    program = ctx.program
+    if getattr(program, "_amp", False):
+        return 0
+    gb = program.global_block()
+    readers = ctx.reader_counts()
+    writers = ctx.writer_counts()
+    keep = ctx.keep_names()
+
+    def batch_free_def(name: str, before: int) -> bool:
+        """Bias must be usable at the matmul's position: persistable,
+        data, or produced by an earlier op."""
+        var = gb._find_var_recursive(name)
+        if var is not None and (var.persistable or var.is_data):
+            return True
+        if name in ctx.feed_names:
+            return True
+        for idx, op in enumerate(gb.ops[:before]):
+            if name in op.output_arg_names:
+                return True
+        return False
+
+    fused = 0
+    i = 0
+    while i < len(gb.ops):
+        m = gb.ops[i]
+        if m.type not in ("mul", "matmul"):
+            i += 1
+            continue
+        if m.type == "matmul" and (
+                m.attr("transpose_X", False) or m.attr("transpose_Y", False)
+                or m.attr("alpha", 1.0) != 1.0):
+            i += 1
+            continue
+        if len(m.input("X")) != 1 or len(m.input("Y")) != 1 \
+                or len(m.output("Out")) != 1:
+            i += 1
+            continue
+        m_out = m.output("Out")[0]
+        add = gb.ops[i + 1] if i + 1 < len(gb.ops) else None
+        if (add is None or add.type != "elementwise_add"
+                or add.input("X") != [m_out]
+                or len(add.input("Y")) != 1
+                or not _single_reader(ctx, readers, keep, writers, m_out)
+                or not batch_free_def(add.input("Y")[0], i)):
+            i += 1
+            continue
+        add_out = add.output("Out")[0]
+        act = gb.ops[i + 2] if i + 2 < len(gb.ops) else None
+        act_type = ""
+        final_out = add_out
+        drop = 2
+        if (act is not None and act.type in FC_ACTS
+                and act.input("X") == [add_out] and not act.attrs.keys()
+                - {RNG_IDX_ATTR}
+                and _single_reader(ctx, readers, keep, writers, add_out)):
+            act_type = act.type
+            final_out = act.output("Out")[0]
+            drop = 3
+        attrs = {
+            "kind": m.type,
+            "x_num_col_dims": m.attr("x_num_col_dims", 1),
+            "y_num_col_dims": m.attr("y_num_col_dims", 1),
+            "axis": add.attr("axis", -1),
+            "act": act_type,
+        }
+        if RNG_IDX_ATTR in m.attrs:
+            attrs[RNG_IDX_ATTR] = m.attrs[RNG_IDX_ATTR]
+        from ...framework.core import Operator
+
+        fused_op = Operator(
+            gb, type="fused_fc",
+            inputs={"X": m.input("X"), "Y": m.input("Y"),
+                    "Bias": add.input("Y")},
+            outputs={"Out": [final_out]}, attrs=attrs)
+        gb.ops[i:i + drop] = [fused_op]
+        gb._note_writes(fused_op)
+        for name in (m_out, add_out):
+            if name != final_out and name in gb.vars \
+                    and not gb.vars[name].persistable:
+                del gb.vars[name]
+        program._bump()
+        fused += 1
+        ctx.count("fuse_fc", "ops_fused", drop)
+        obs.TRANSPILE_OPS_FUSED.inc(drop, **{"pass": "fuse_fc"})
+        i += 1
+    return fused
+
+
+@register_pass("fuse_elemwise_act", level=1, exact=True)
+def fuse_elemwise_act(ctx) -> int:
+    """Adjacent elementwise_add|mul -> relu pairs into the existing
+    ``fused_elemwise_activation`` op (functor_list=["relu", binary]).
+    The kernel composes the identical jnp calls, so this is exact."""
+    program = ctx.program
+    if getattr(program, "_amp", False):
+        return 0
+    gb = program.global_block()
+    readers = ctx.reader_counts()
+    writers = ctx.writer_counts()
+    keep = ctx.keep_names()
+
+    fused = 0
+    i = 0
+    while i < len(gb.ops):
+        b = gb.ops[i]
+        if b.type not in ("elementwise_add", "elementwise_mul") \
+                or len(b.output("Out")) != 1:
+            i += 1
+            continue
+        b_out = b.output("Out")[0]
+        a = gb.ops[i + 1] if i + 1 < len(gb.ops) else None
+        if (a is None or a.type != "relu" or a.input("X") != [b_out]
+                or not _single_reader(ctx, readers, keep, writers, b_out)):
+            i += 1
+            continue
+        from ...framework.core import Operator
+
+        attrs = {"functor_list": ["relu", b.type],
+                 "axis": b.attr("axis", -1), "scale": 1.0}
+        if RNG_IDX_ATTR in b.attrs:
+            attrs[RNG_IDX_ATTR] = b.attrs[RNG_IDX_ATTR]
+        fused_op = Operator(
+            gb, type="fused_elemwise_activation",
+            inputs={"X": b.input("X"), "Y": b.input("Y")},
+            outputs={"Out": [a.output("Out")[0]]}, attrs=attrs)
+        gb.ops[i:i + 2] = [fused_op]
+        gb._note_writes(fused_op)
+        if b_out in gb.vars and not gb.vars[b_out].persistable:
+            del gb.vars[b_out]
+        program._bump()
+        fused += 1
+        ctx.count("fuse_elemwise_act", "ops_fused", 2)
+        obs.TRANSPILE_OPS_FUSED.inc(2, **{"pass": "fuse_elemwise_act"})
+        i += 1
+    return fused
+
+
+# -- conv + batch_norm folding --------------------------------------------
+
+
+def fold_conv_bn(program, scope, keep=(), require_is_test: bool = True,
+                 in_place_params: bool = False) -> int:
+    """Fold conv2d (+bias add) + batch_norm pairs: the conv filter is
+    pre-scaled by the bn's gamma/sqrt(var+eps) and the bn collapses into
+    one bias add. Returns the number of bn ops folded.
+
+    ``in_place_params=True`` is the legacy InferenceTranspiler contract:
+    the existing filter/bias values are OVERWRITTEN in the Scope (the
+    original program's numbers change with them). The pass-manager mode
+    (False) materializes the folded values under fresh ``.bnfold``
+    names, leaving the original parameters untouched.
+
+    ``require_is_test`` gates folding to inference-mode bn ops — a
+    training-mode bn computes batch statistics and updates running
+    state, which no constant fold can reproduce. The legacy shim keeps
+    its historical behavior (no gate; callers fold for_test clones).
+    """
+    block = program.global_block()
+    keep = set(keep)
+
+    readers = {}
+    for op in block.ops:
+        for name in op.input_arg_names:
+            readers[name] = readers.get(name, 0) + 1
+
+    def _bn_constants(bn):
+        scale = np.asarray(scope.find_var(bn.input("Scale")[0]))
+        beta = np.asarray(scope.find_var(bn.input("Bias")[0]))
+        mean = np.asarray(scope.find_var(bn.input("Mean")[0]))
+        var = np.asarray(scope.find_var(bn.input("Variance")[0]))
+        k = scale / np.sqrt(var + bn.attr("epsilon", 1e-5))
+        return k, beta, mean
+
+    def _fresh(name: str) -> str:
+        cand = name
+        while block._find_var_recursive(cand) is not None:
+            cand += "_"
+        return cand
+
+    folded = 0
+    i = 0
+    while i < len(block.ops):
+        conv = block.ops[i]
+        if conv.type != "conv2d":
+            i += 1
+            continue
+        conv_out = conv.output("Output")[0]
+        w_name = conv.input("Filter")[0]
+
+        # pattern A: conv2d -> batch_norm
+        # pattern B: conv2d -> elementwise_add(bias) -> batch_norm
+        #            (layers.conv2d with bias_attr emits the add)
+        nxt = block.ops[i + 1] if i + 1 < len(block.ops) else None
+        nxt2 = block.ops[i + 2] if i + 2 < len(block.ops) else None
+        if (
+            nxt is not None
+            and nxt.type == "batch_norm"
+            and nxt.input("X") == [conv_out]
+            and readers.get(conv_out, 0) == 1
+            and conv_out not in keep
+        ):
+            bn, bn_idx, bias_name = nxt, i + 1, None
+        elif (
+            nxt is not None
+            and nxt2 is not None
+            and nxt.type == "elementwise_add"
+            and nxt.input("X") == [conv_out]
+            and nxt2.type == "batch_norm"
+            and nxt2.input("X") == nxt.output("Out")
+            and readers.get(conv_out, 0) == 1
+            and readers.get(nxt.output("Out")[0], 0) == 1
+            and conv_out not in keep
+            and nxt.output("Out")[0] not in keep
+        ):
+            bn, bn_idx, bias_name = nxt2, i + 2, nxt.input("Y")[0]
+        else:
+            i += 1
+            continue
+
+        if require_is_test and not bn.attr("is_test", False):
+            i = bn_idx + 1
+            continue
+        wvar = block._find_var_recursive(w_name)
+        if wvar is not None and not wvar.persistable:
+            # the Filter is a derived in-graph variable, not a stored
+            # parameter (e.g. the ResNet space-to-depth stem transforms
+            # its canonical 7x7 weight in-graph) — leave this BN unfused
+            i = bn_idx + 1
+            continue
+        wval = scope.find_var(w_name)
+        if wval is None:
+            raise RuntimeError(
+                "conv filter %r has no value in scope; run the startup "
+                "program before transpiling" % w_name)
+        k, beta, mean = _bn_constants(bn)
+        w = np.asarray(wval)
+        w_folded = (w * k[:, None, None, None]).astype(w.dtype)
+        if in_place_params:
+            scope.set_var(w_name, _owned(w_folded))
+        else:
+            new_w = _fresh(w_name + ".bnfold")
+            block.create_var(name=new_w, shape=tuple(w.shape),
+                             dtype=str(w.dtype), persistable=True)
+            scope.set_var(new_w, _owned(w_folded))
+            conv.inputs["Filter"] = [new_w]
+        bn_out = bn.output("Y")[0]
+
+        if bias_name is not None:
+            # fold into the bias: y = (conv + b - mean)*k + beta
+            b = np.asarray(scope.find_var(bias_name))
+            b_folded = ((b - mean) * k + beta).astype(b.dtype)
+            add = block.ops[bn_idx - 1]
+            if in_place_params:
+                scope.set_var(bias_name, _owned(b_folded))
+            else:
+                new_b = _fresh(bias_name + ".bnfold")
+                block.create_var(name=new_b, shape=tuple(b.shape),
+                                 dtype=str(b.dtype), persistable=True)
+                scope.set_var(new_b, _owned(b_folded))
+                add.inputs["Y"] = [new_b]
+            add.outputs["Out"] = [bn_out]
+            block.ops.pop(bn_idx)
+        else:
+            # biasless conv: add a folded-bias elementwise_add in the
+            # bn's place
+            new_b = _fresh(w_name + ".bnfold_bias")
+            block.create_var(name=new_b, shape=(len(k),),
+                             dtype="float32", persistable=True)
+            scope.set_var(new_b, _owned((beta - mean * k).astype(np.float32)))
+            rng_attr = ({RNG_IDX_ATTR: bn.attrs[RNG_IDX_ATTR]}
+                        if RNG_IDX_ATTR in bn.attrs else {})
+            block.ops.pop(bn_idx)
+            block.insert_op(
+                bn_idx,
+                type="elementwise_add",
+                inputs={"X": conv_out, "Y": new_b},
+                outputs={"Out": bn_out},
+                attrs=dict({"axis": 1}, **rng_attr),
+            )
+        program._bump()
+        folded += 1
+        i = bn_idx + 1
+    return folded
+
+
+@register_pass("conv_bn_fold", level=2, exact=False, needs_scope=True)
+def conv_bn_fold(ctx) -> int:
+    n = fold_conv_bn(ctx.program, ctx.scope, keep=ctx.keep_names(),
+                     require_is_test=True, in_place_params=False)
+    if n:
+        ctx.count("conv_bn_fold", "bn_folded", n)
+        obs.TRANSPILE_OPS_REMOVED.inc(n, **{"pass": "conv_bn_fold"})
+    return n

@@ -1,0 +1,303 @@
+"""Pass manager over the Program IR: the optimizing transpiler core.
+
+The reference ships graph REWRITE passes as one-off transpilers
+(inference_transpiler.py conv+bn fold, memory_optimization_transpiler.py);
+PR-6 rebuilt the ANALYSIS layer (analysis/: shape/dtype inference lattice
++ lints) but nothing could act on its findings. This module is the
+transform engine on top of it: small registered passes that mutate a
+Program in place, orchestrated to a fixpoint, with the analyzer's
+inference facts as the legality oracle (a pass may only rewrite what the
+lattice PROVES safe — unknown degrades to "don't touch").
+
+Contracts every pass must honor:
+
+- **Parity.** An optimized program must produce outputs exactly equal to
+  the original (the OpTest/example/randomized batteries pin this).
+  Passes that cannot be bit-exact (conv+bn constant refactoring changes
+  float rounding) are marked ``exact=False`` and only run at level 2.
+- **RNG stability.** The tracer keys each op's PRNG stream on its block
+  position, so deleting/reordering ops would silently redraw every
+  dropout mask downstream. Before the first mutation the manager stamps
+  every op with ``__rng_idx__`` (its pre-optimization position);
+  framework/trace.py prefers the stamp over the live index, so streams
+  survive any structural rewrite.
+- **Keep-set.** Fetch targets, feeds, vars read by sub-blocks, and loop
+  carries keep their names: a pass may rewrite how a kept name is
+  computed but never remove or rename it.
+- **Idempotence.** Running the pipeline on its own output changes
+  nothing (the randomized battery asserts optimize(optimize(p)) ==
+  optimize(p) structurally).
+
+Levels (``PADDLE_TPU_OPT`` / explicit API):
+
+- 0: off;
+- 1: bit-exact structural passes — constant folding, CSE, fc fusion,
+  elementwise+activation fusion, dead-op/dead-var elimination;
+- 2: level 1 + conv+bn folding (inference graphs, tolerance-parity) and
+  feed bucketization (stamps pow2-bucket metadata the Executor/Predictor
+  apply at the feed boundary).
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from ... import observability as obs
+from ...framework.core import Program
+from ...framework.scope import Scope
+
+__all__ = [
+    "PassContext", "PassManager", "register_pass", "optimize_program",
+    "opt_level_from_env", "PASSES", "RNG_IDX_ATTR",
+]
+
+# the op attr carrying an op's PRE-optimization block position: the
+# tracer's per-op PRNG key derivation reads it (framework/trace.py), so
+# removing/reordering ops cannot perturb any stochastic op's stream
+RNG_IDX_ATTR = "__rng_idx__"
+
+# ops the manager must never touch: executor plumbing + the autodiff
+# pseudo-op (its replay set is positional; passes treat it as a barrier
+# only DCE understands)
+PLUMBING_OPS = {"feed", "fetch", "read"}
+
+
+def opt_level_from_env(default: int = 0) -> int:
+    """PADDLE_TPU_OPT=0|1|2 (malformed values fall back, never crash)."""
+    raw = os.environ.get("PADDLE_TPU_OPT")
+    if raw is None:
+        return default
+    try:
+        lvl = int(raw)
+    except ValueError:
+        return default
+    return min(max(lvl, 0), 2)
+
+
+class _Pass:
+    __slots__ = ("name", "fn", "level", "exact", "needs_scope")
+
+    def __init__(self, name, fn, level, exact, needs_scope):
+        self.name = name
+        self.fn = fn
+        self.level = level
+        self.exact = exact
+        self.needs_scope = needs_scope
+
+
+# ordered: folding exposes CSE opportunities, fusion runs on the
+# deduplicated graph, DCE sweeps the leftovers, bucketize stamps last
+PASSES: "Dict[str, _Pass]" = {}
+PASS_ORDER: List[str] = []
+
+
+def register_pass(name: str, level: int = 1, exact: bool = True,
+                  needs_scope: bool = False):
+    """``@register_pass("cse")`` — fn(ctx) -> int (number of rewrites
+    applied; 0 = fixpoint for this pass)."""
+
+    def deco(fn):
+        if name in PASSES:
+            raise ValueError("duplicate pass %r" % name)
+        PASSES[name] = _Pass(name, fn, level, exact, needs_scope)
+        PASS_ORDER.append(name)
+        return fn
+
+    return deco
+
+
+class PassContext:
+    """Shared state for one optimization run over one Program."""
+
+    def __init__(self, program: Program, scope: Optional[Scope],
+                 feed_names: Sequence[str], fetch_names: Sequence[str],
+                 level: int):
+        self.program = program
+        self.scope = scope
+        self.feed_names = set(feed_names)
+        self.fetch_names = list(fetch_names)
+        self.level = level
+        self.stats: Dict[str, Dict] = {}
+        self.notes: List[str] = []
+        self._inference = None
+        self._inference_version = None
+
+    # -- legality oracle --------------------------------------------------
+    @property
+    def inference(self):
+        """The analyzer's whole-program (shape, dtype) facts, recomputed
+        lazily whenever a pass mutated the program since the last look —
+        a stale lattice must never prove a rewrite legal."""
+        if (self._inference is None
+                or self._inference_version != self.program._version):
+            from ...analysis.infer import infer_program
+
+            self._inference = infer_program(
+                self.program, feed_names=tuple(self.feed_names),
+                attach=False)
+            self._inference_version = self.program._version
+        return self._inference
+
+    # -- graph views (recomputed per call: passes mutate freely) ----------
+    def keep_names(self) -> Set[str]:
+        """Names whose computed VALUE must stay addressable by that name:
+        fetch targets, feeds, everything a sub-block reads (closure), and
+        loop carries. Persistable vars are handled separately (their
+        writes are liveness roots, but a pass may still rewire reads)."""
+        keep = set(self.fetch_names) | set(self.feed_names)
+        for block in self.program.blocks[1:]:
+            for op in block.ops:
+                keep.update(op.input_arg_names)
+        for op in self.program.global_block().ops:
+            if op.attr("sub_block") is not None:
+                keep.update(op.attr("carried_names") or ())
+                keep.update(op.input_arg_names)
+                keep.update(op.output_arg_names)
+        return keep
+
+    def reader_counts(self) -> Dict[str, int]:
+        """name -> number of reading ops across ALL blocks."""
+        readers: Dict[str, int] = {}
+        for block in self.program.blocks:
+            for op in block.ops:
+                for name in op.input_arg_names:
+                    readers[name] = readers.get(name, 0) + 1
+        for name in self.fetch_names:
+            readers[name] = readers.get(name, 0) + 1
+        return readers
+
+    def writer_counts(self) -> Dict[str, int]:
+        writers: Dict[str, int] = {}
+        for block in self.program.blocks:
+            for op in block.ops:
+                for name in op.output_arg_names:
+                    writers[name] = writers.get(name, 0) + 1
+        return writers
+
+    # -- bookkeeping ------------------------------------------------------
+    def note(self, msg: str):
+        self.notes.append(msg)
+
+    def count(self, pass_name: str, key: str, n: int = 1):
+        self.stats.setdefault(pass_name, {})[key] = (
+            self.stats.get(pass_name, {}).get(key, 0) + n)
+
+
+def stamp_rng_indices(program: Program) -> None:
+    """Pin every op's pre-optimization position as ``__rng_idx__`` so the
+    tracer's RNG keys survive structural rewrites. setdefault keeps the
+    stamp stable across repeated optimization runs (idempotence)."""
+    for block in program.blocks:
+        for idx, op in enumerate(block.ops):
+            op.attrs.setdefault(RNG_IDX_ATTR, idx)
+
+
+def rewrite_inputs(block, rename: Dict[str, str], start: int = 0):
+    """Rename op input references in ``block.ops[start:]``."""
+    if not rename:
+        return
+    for op in block.ops[start:]:
+        for slot, names in op.inputs.items():
+            op.inputs[slot] = [rename.get(n, n) for n in names]
+
+
+def prune_dead_vars(program: Program, keep: Set[str]) -> int:
+    """Drop var DECLARATIONS nothing references: not persistable, not
+    data, not in the keep set, and named by no op in any block. Purely a
+    size/serialization win — values never existed for these names."""
+    referenced: Set[str] = set(keep)
+    for block in program.blocks:
+        for op in block.ops:
+            referenced.update(op.input_arg_names)
+            referenced.update(op.output_arg_names)
+            if op.type == "autodiff":
+                referenced.add(op.attr("loss_name"))
+                referenced.update(op.attr("param_names") or ())
+    removed = 0
+    for block in program.blocks:
+        for name in list(block.vars):
+            var = block.vars[name]
+            if (name not in referenced and not var.persistable
+                    and not var.is_data):
+                del block.vars[name]
+                removed += 1
+    if removed:
+        program._bump()
+    return removed
+
+
+class PassManager:
+    """Runs the registered passes (filtered by level) to a fixpoint."""
+
+    _MAX_ROUNDS = 5
+
+    def __init__(self, level: int = 1,
+                 passes: Optional[Sequence[str]] = None):
+        self.level = int(level)
+        if passes is None:
+            names = [n for n in PASS_ORDER
+                     if PASSES[n].level <= self.level]
+        else:
+            unknown = [n for n in passes if n not in PASSES]
+            if unknown:
+                raise ValueError(
+                    "unknown passes %s (registered: %s)"
+                    % (unknown, sorted(PASSES)))
+            names = list(passes)
+        self.pass_names = names
+
+    def run(self, program: Program, scope: Optional[Scope] = None,
+            feed_names: Sequence[str] = (),
+            fetch_names: Sequence[str] = ()) -> PassContext:
+        """Mutates ``program`` in place; returns the PassContext with
+        per-pass stats. Use :func:`optimize_program` for the cloning
+        front door."""
+        ctx = PassContext(program, scope, feed_names, fetch_names,
+                          self.level)
+        if self.level <= 0 or not self.pass_names:
+            return ctx
+        stamp_rng_indices(program)
+        for _round in range(self._MAX_ROUNDS):
+            changed = 0
+            for name in self.pass_names:
+                p = PASSES[name]
+                if p.needs_scope and ctx.scope is None:
+                    continue
+                t0 = time.perf_counter()
+                n = p.fn(ctx)
+                ms = (time.perf_counter() - t0) * 1e3
+                st = ctx.stats.setdefault(name, {})
+                st["ms"] = st.get("ms", 0.0) + ms
+                st["applied"] = st.get("applied", 0) + int(n or 0)
+                obs.TRANSPILE_PASS_MS.observe(ms, **{"pass": name})
+                changed += int(n or 0)
+            if not changed:
+                break
+        return ctx
+
+
+def optimize_program(program: Program, scope: Optional[Scope] = None,
+                     level: int = 1, feed_names: Sequence[str] = (),
+                     fetch_names: Sequence[str] = (),
+                     passes: Optional[Sequence[str]] = None,
+                     ) -> Tuple[Program, PassContext]:
+    """THE front door: returns an optimized CLONE of ``program`` (the
+    original is untouched, so optimized and original executables coexist
+    — they fingerprint differently, giving them distinct AOT-cache
+    keys) plus the PassContext with per-pass stats.
+
+    ``scope`` is where constant folding materializes evaluated results
+    as parameters and where conv+bn folding reads batch-norm statistics;
+    without one, scope-dependent passes skip. Fold freezes the CURRENT
+    scope values of unwritten persistables into the optimized program —
+    re-optimize after mutating such state out-of-band (the same contract
+    as the reference InferenceTranspiler).
+    """
+    from . import fold, cse, fusion, dce, bucketize  # noqa: F401 — register
+
+    optimized = program.clone()
+    mgr = PassManager(level=level, passes=passes)
+    ctx = mgr.run(optimized, scope=scope, feed_names=feed_names,
+                  fetch_names=fetch_names)
+    return optimized, ctx

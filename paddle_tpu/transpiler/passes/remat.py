@@ -1,0 +1,56 @@
+"""Rematerialization knob (absorbed from transpiler/memory_optimizer.py).
+
+Reference: python/paddle/fluid/transpiler/memory_optimization_transpiler.py
+— liveness analysis + in-place var reuse inside the C++ executor's Scope.
+On TPU, XLA's buffer assignment already does liveness-based reuse and the
+executor donates state buffers, so the reference's pass is structurally
+unnecessary (in-graph dead code is the optimizing transpiler's ``dce``
+pass). What IS worth controlling is rematerialization: trading recompute
+FLOPs for activation memory in the fused fwd+bwd step. ``memory_optimize``
+maps the reference API onto a ``jax.checkpoint`` policy applied to the
+autodiff replay (framework/trace.py honors ``program._remat_policy``).
+
+Not a registered pass: the policy changes the backward's numerics
+(recomputed activations round identically, but the HLO differs), it is a
+memory/VRAM knob the user opts into per program — orthogonal to the
+parity-gated PADDLE_TPU_OPT pipeline.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ...framework.core import Program, default_main_program
+
+__all__ = ["memory_optimize", "release_memory"]
+
+_POLICIES = {
+    # level 0 (reference default): keep matmul/conv outputs, recompute the
+    # cheap elementwise chains — the sweet spot on HBM-bound TPUs.
+    0: "dots_with_no_batch_dims_saveable",
+    # level 1: save nothing, recompute everything (max memory savings)
+    1: "nothing_saveable",
+}
+
+
+def memory_optimize(
+    input_program: Optional[Program] = None,
+    skip_opt_set=None,
+    print_log: bool = False,
+    level: int = 0,
+):
+    """Enable rematerialization for the program's backward pass."""
+    if level not in _POLICIES:
+        raise ValueError("level must be 0 or 1, got %r" % level)
+    program = input_program if input_program is not None else default_main_program()
+    program._remat_policy = _POLICIES[level]
+    program._bump()  # invalidate compile caches
+    if print_log:
+        print("memory_optimize: remat policy = %s" % program._remat_policy)
+    return program
+
+
+def release_memory(input_program: Optional[Program] = None, skip_opt_set=None):
+    """Reference parity (transpiler/memory_optimization_transpiler.py:
+    release_memory). Buffer release is XLA's job; this is a no-op kept so
+    reference scripts run unchanged."""
+    return input_program

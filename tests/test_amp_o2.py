@@ -75,6 +75,7 @@ def _train_lm(level, steps=6):
     return vals
 
 
+@pytest.mark.slow  # ~29s on the 2-core box; tier-1 no longer fits its 870 s window (PR-11 durations triage)
 def test_o2_trains_and_tracks_o1():
     v1 = _train_lm("O1")
     v2 = _train_lm("O2")

@@ -237,6 +237,7 @@ def test_multi_box_head_and_ssd_pipeline():
     assert np.isfinite(o[0]).all() and np.isfinite(o[1]).all()
 
 
+@pytest.mark.slow  # ~30s on the 2-core box; tier-1 no longer fits its 870 s window (PR-11 durations triage)
 def test_se_resnext_forward():
     from paddle_tpu import models
 

@@ -4,6 +4,7 @@ dropping behaves as specified, and gradients flow. SURVEY §2 parallel
 commitment (expert parallel for MoE)."""
 from __future__ import annotations
 
+import pytest
 import numpy as np
 
 import jax
@@ -59,6 +60,7 @@ def test_expert_parallel_matches_local():
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow  # ~37s on the 2-core box; tier-1 no longer fits its 870 s window (PR-11 durations triage)
 def test_expert_parallel_gradients():
     n_dev = 2
     mesh = make_mesh([n_dev], ("ep",), devices=jax.devices()[:n_dev])
@@ -157,6 +159,7 @@ def test_moe_lm_program_api():
     np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow  # ~22s on the 2-core box; tier-1 no longer fits its 870 s window (PR-11 durations triage)
 def test_moe_bf16_tracks_f32():
     """bf16 inputs run bf16 MXU matmuls with f32 accumulation (and bf16
     expert buffers on the wire in the ep path); outputs must track the

@@ -7,6 +7,7 @@ doc for the measured motivation). These tests pin the contract: the SAME
 parameters and the SAME NCHW feed must produce bit-comparable results in
 either layout, forward and backward.
 """
+import pytest
 import numpy as np
 
 import paddle_tpu as fluid
@@ -130,6 +131,7 @@ def _resnet_loss(layout, steps=2):
     return out
 
 
+@pytest.mark.slow  # ~29s on the 2-core box; tier-1 no longer fits its 870 s window (PR-11 durations triage)
 def test_resnet_nhwc_full_model_parity():
     a = _resnet_loss("NCHW")
     b = _resnet_loss("NHWC")

@@ -642,6 +642,14 @@ COVERED_ELSEWHERE = {
     "anchor_generator", "rpn_target_assign", "generate_proposals",
     # attention/fused: tests/test_attention.py, tests/test_fused_loss.py
     "fused_attention", "fused_lm_head_loss",
+    # transpiler-emitted fusion: tests/test_passes.py
+    # (test_fused_fc_numeric_matches_unfused pins it against the
+    # unfused mul+elementwise_add+relu chain bit-for-bit)
+    "fused_fc",
+    # KV-cache decode ops: tests/test_kv_cache_ops.py
+    "decode_attention", "cache_append", "cache_gather",
+    # in-graph sampling: tests/test_sampling_ops.py
+    "greedy_sample", "top_k_sample", "top_p_sample",
     # metrics: tests/test_aux.py
     "accuracy", "auc",
     # sequence (dense+lengths): tests/test_sequence_ops.py

@@ -1,0 +1,677 @@
+"""Optimizing transpiler (transpiler/passes/): per-pass units, executor/
+predictor integration, and the bit-exact parity gates on the bundled
+examples. The randomized parity battery lives in
+test_passes_random.py."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.transpiler.passes import (
+    PASSES, PassManager, next_pow2, optimize_program,
+)
+
+
+def _gb_ops(program):
+    return [op.type for op in program.global_block().ops]
+
+
+def test_registry_has_the_five_passes():
+    for name in ("constant_fold", "cse", "dce", "fuse_fc", "bucketize",
+                 "conv_bn_fold", "fuse_elemwise_act"):
+        assert name in PASSES
+    # level filtering: level-1 managers never run the approx/level-2 set
+    lvl1 = PassManager(level=1).pass_names
+    assert "conv_bn_fold" not in lvl1 and "bucketize" not in lvl1
+    assert "constant_fold" in lvl1 and "dce" in lvl1
+
+
+def test_next_pow2():
+    assert [next_pow2(n) for n in (1, 2, 3, 5, 8, 9, 17)] == \
+        [1, 2, 4, 8, 8, 16, 32]
+
+
+# -- constant folding ------------------------------------------------------
+
+
+def test_constant_fold_collapses_attr_chain_to_assign_value(rng):
+    """A chain rooted only in attr constants (fill_constant) stays a
+    COMPILE-TIME constant: it collapses to one assign_value op (not a
+    parameter — a state input would change what XLA can algebraically
+    fold, breaking bit parity)."""
+    scope = fluid.Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4])
+        c = layers.fill_constant(shape=[4], dtype="float32", value=3.0)
+        c2 = layers.scale(c, scale=2.0)  # folds through the chain
+        out = layers.elementwise_add(x, c2)
+    opt, ctx = optimize_program(main, scope=scope, level=1,
+                                feed_names=["x"], fetch_names=[out.name])
+    assert "fill_constant" not in _gb_ops(opt)
+    assert "scale" not in _gb_ops(opt)
+    assert _gb_ops(opt).count("assign_value") == 1
+    av = next(op for op in opt.global_block().ops
+              if op.type == "assign_value")
+    np.testing.assert_array_equal(np.asarray(av.attr("values")),
+                                  np.full((4,), 6.0, np.float32))
+    # parity
+    exe = fluid.Executor()
+    xs = rng.randn(2, 4).astype(np.float32)
+    with fluid.scope_guard(scope):
+        (a,) = exe.run(main, feed={"x": xs}, fetch_list=[out])
+        (b,) = fluid.Executor().run(opt, feed={"x": xs},
+                                    fetch_list=[out.name], scope=scope)
+    np.testing.assert_array_equal(a, b)
+    # original program untouched
+    assert "fill_constant" in _gb_ops(main)
+
+
+def test_constant_fold_materializes_state_chain_as_param(rng):
+    """A chain touching a scope constant (an unwritten persistable) is a
+    runtime value either way — it materializes as a parameter."""
+    scope = fluid.Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4])
+        w = main.global_block().create_parameter(
+            name="w_const", shape=[4], dtype="float32")
+        scope.set_var("w_const", np.arange(4, dtype=np.float32))
+        c2 = layers.scale(w, scale=2.0)
+        out = layers.elementwise_add(x, c2)
+    opt, ctx = optimize_program(main, scope=scope, level=1,
+                                feed_names=["x"], fetch_names=[out.name])
+    assert "scale" not in _gb_ops(opt)
+    folded = opt.global_block()._find_var_recursive(c2.name)
+    assert folded is not None and folded.persistable
+    np.testing.assert_array_equal(
+        np.asarray(scope.find_var(c2.name)),
+        np.arange(4, dtype=np.float32) * 2.0)
+    xs = rng.randn(2, 4).astype(np.float32)
+    with fluid.scope_guard(scope):
+        (a,) = fluid.Executor().run(main, feed={"x": xs},
+                                    fetch_list=[out])
+        (b,) = fluid.Executor().run(opt, feed={"x": xs},
+                                    fetch_list=[out.name], scope=scope)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_constant_fold_skips_feeds_and_written_params(rng):
+    scope = fluid.Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4])
+        y = layers.data(name="y", shape=[1])
+        h = layers.fc(x, 4)
+        loss = layers.mean(layers.square(h - y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    with fluid.scope_guard(scope):
+        fluid.Executor().run(startup)
+    opt, ctx = optimize_program(main, scope=scope, level=1,
+                                feed_names=["x", "y"],
+                                fetch_names=[loss.name])
+    # params are optimizer-written -> never constants; nothing to fold
+    assert ctx.stats.get("constant_fold", {}).get("applied", 0) == 0
+
+
+def test_constant_fold_keeps_fetched_state_chain_producible(rng):
+    """A fetch target rooted entirely in scope constants must stay
+    PRODUCED by the graph (code-review regression: folding it to a
+    scope value no op reads made the fetch a trace-time KeyError)."""
+    scope = fluid.Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4])  # unused: keeps feeds real
+        w = main.global_block().create_parameter(
+            name="w_tbl", shape=[4], dtype="float32")
+        scope.set_var("w_tbl", np.arange(4, dtype=np.float32))
+        y = layers.relu(layers.scale(w, scale=2.0))
+    opt, _ = optimize_program(main, scope=scope, level=1,
+                              feed_names=["x"], fetch_names=[y.name])
+    with fluid.scope_guard(scope):
+        (raw,) = fluid.Executor().run(
+            main, feed={"x": np.zeros((1, 4), np.float32)},
+            fetch_list=[y.name])
+        (got,) = fluid.Executor().run(
+            opt, feed={"x": np.zeros((1, 4), np.float32)},
+            fetch_list=[y.name], scope=scope)
+    np.testing.assert_array_equal(raw, got)
+
+
+# -- CSE -------------------------------------------------------------------
+
+
+def test_cse_dedups_and_keeps_fetch_names(rng):
+    scope = fluid.Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4])
+        a = layers.scale(x, scale=2.0)
+        b = layers.scale(x, scale=2.0)  # duplicate of a
+        out = layers.elementwise_add(a, b)
+    opt, ctx = optimize_program(main, scope=scope, level=1,
+                                feed_names=["x"], fetch_names=[out.name])
+    assert ctx.stats["cse"]["applied"] >= 1
+    assert _gb_ops(opt).count("scale") == 1
+    exe = fluid.Executor()
+    xs = rng.randn(3, 4).astype(np.float32)
+    with fluid.scope_guard(scope):
+        (raw,) = exe.run(main, feed={"x": xs}, fetch_list=[out])
+        (got,) = fluid.Executor().run(opt, feed={"x": xs},
+                                      fetch_list=[out.name], scope=scope)
+    np.testing.assert_array_equal(raw, got)
+
+    # a FETCHED duplicate keeps its name via an assign
+    scope2 = fluid.Scope()
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.scope_guard(scope2), fluid.program_guard(main2, startup2):
+        x = layers.data(name="x", shape=[4])
+        a = layers.scale(x, scale=2.0)
+        b = layers.scale(x, scale=2.0)
+    opt2, _ = optimize_program(main2, scope=scope2, level=1,
+                               feed_names=["x"],
+                               fetch_names=[a.name, b.name])
+    assert _gb_ops(opt2).count("scale") == 1
+    assert "assign" in _gb_ops(opt2)
+    with fluid.scope_guard(scope2):
+        ra = fluid.Executor().run(main2, feed={"x": xs},
+                                  fetch_list=[a.name, b.name])
+        ro = fluid.Executor().run(opt2, feed={"x": xs},
+                                  fetch_list=[a.name, b.name])
+    for va, vo in zip(ra, ro):
+        np.testing.assert_array_equal(va, vo)
+
+
+def test_cse_respects_writes_between_reads(rng):
+    """Two identical reads straddling a rewrite of their (persistable)
+    input are different VALUES and must not dedup (code-review
+    regression: the trace env is imperative)."""
+    scope = fluid.Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4])
+        lr = main.global_block().create_var(
+            name="lr_state", shape=[1], dtype="float32",
+            persistable=True)
+        scope.set_var("lr_state", np.ones(1, np.float32))
+        a = layers.scale(lr, scale=3.0)        # reads pre-write value
+        gb = main.global_block()
+        gb.append_op(type="assign_value",
+                     outputs={"Out": ["lr_state"]},
+                     attrs={"values": [0.5], "shape": [1],
+                            "dtype": "float32"})
+        b = layers.scale(lr, scale=3.0)        # reads post-write value
+        out = layers.elementwise_add(x, layers.elementwise_add(a, b))
+    opt, _ = optimize_program(main, scope=scope, level=1,
+                              feed_names=["x"],
+                              fetch_names=[out.name, a.name, b.name])
+    xs = rng.randn(2, 4).astype(np.float32)
+    with fluid.scope_guard(scope):
+        raw = fluid.Executor().run(
+            main, feed={"x": xs}, fetch_list=[out.name, a.name, b.name])
+        scope.set_var("lr_state", np.ones(1, np.float32))  # reset
+        got = fluid.Executor().run(
+            opt, feed={"x": xs}, fetch_list=[out.name, a.name, b.name],
+            scope=scope)
+    for va, vb in zip(raw, got):
+        np.testing.assert_array_equal(va, vb)
+    assert float(raw[1][0]) == 3.0 and float(raw[2][0]) == 1.5
+
+
+# -- DCE -------------------------------------------------------------------
+
+
+def test_dce_removes_dead_ops_and_vars(rng):
+    scope = fluid.Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4])
+        live = layers.relu(x)
+        dead = layers.fc(x, 8)  # nothing reads it
+    with fluid.scope_guard(scope):
+        fluid.Executor().run(startup)
+    n_before = len(main.global_block().ops)
+    opt, ctx = optimize_program(main, scope=scope, level=1,
+                                feed_names=["x"],
+                                fetch_names=[live.name])
+    assert ctx.stats["dce"]["applied"] >= 1
+    assert len(opt.global_block().ops) < n_before
+    assert "mul" not in _gb_ops(opt) and "fused_fc" not in _gb_ops(opt)
+    # dead declarations swept too
+    assert opt.global_block()._find_var_recursive(dead.name) is None
+    xs = rng.randn(2, 4).astype(np.float32)
+    with fluid.scope_guard(scope):
+        (raw,) = fluid.Executor().run(main, feed={"x": xs},
+                                      fetch_list=[live.name])
+        (got,) = fluid.Executor().run(opt, feed={"x": xs},
+                                      fetch_list=[live.name], scope=scope)
+    np.testing.assert_array_equal(raw, got)
+
+
+# -- fusion ----------------------------------------------------------------
+
+
+def test_fuse_fc_chain_is_one_op_and_exact(rng):
+    scope = fluid.Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[16])
+        out = layers.fc(layers.fc(x, 32, act="relu"), 2)
+    with fluid.scope_guard(scope):
+        fluid.Executor().run(startup)
+    opt, ctx = optimize_program(main, scope=scope, level=1,
+                                feed_names=["x"], fetch_names=[out.name])
+    assert _gb_ops(opt) == ["fused_fc", "fused_fc"]
+    xs = rng.randn(5, 16).astype(np.float32)
+    with fluid.scope_guard(scope):
+        (raw,) = fluid.Executor().run(main, feed={"x": xs},
+                                      fetch_list=[out])
+        (got,) = fluid.Executor().run(opt, feed={"x": xs},
+                                      fetch_list=[out.name], scope=scope)
+    np.testing.assert_array_equal(raw, got)
+
+
+def test_fuse_fc_respects_fetched_intermediate(rng):
+    scope = fluid.Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[8])
+        h = layers.fc(x, 4, act="relu")
+    with fluid.scope_guard(scope):
+        fluid.Executor().run(startup)
+    # the PRE-activation add output is an internal name; fetching the
+    # MUL output must block the fusion that would erase it
+    mul_out = main.global_block().ops[0].output("Out")[0]
+    opt, _ = optimize_program(main, scope=scope, level=1,
+                              feed_names=["x"],
+                              fetch_names=[h.name, mul_out])
+    assert "mul" in _gb_ops(opt)  # not fused away
+    xs = rng.randn(3, 8).astype(np.float32)
+    with fluid.scope_guard(scope):
+        raw = fluid.Executor().run(main, feed={"x": xs},
+                                   fetch_list=[h.name, mul_out])
+        got = fluid.Executor().run(opt, feed={"x": xs},
+                                   fetch_list=[h.name, mul_out],
+                                   scope=scope)
+    for a, b in zip(raw, got):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_fuse_elemwise_act_pair(rng):
+    scope = fluid.Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[6])
+        y = layers.data(name="y", shape=[6])
+        out = layers.relu(layers.elementwise_add(x, y))
+    opt, ctx = optimize_program(main, scope=scope, level=1,
+                                feed_names=["x", "y"],
+                                fetch_names=[out.name])
+    assert _gb_ops(opt) == ["fused_elemwise_activation"]
+    xs = rng.randn(4, 6).astype(np.float32)
+    ys = rng.randn(4, 6).astype(np.float32)
+    with fluid.scope_guard(scope):
+        (raw,) = fluid.Executor().run(main, feed={"x": xs, "y": ys},
+                                      fetch_list=[out])
+        (got,) = fluid.Executor().run(opt, feed={"x": xs, "y": ys},
+                                      fetch_list=[out.name], scope=scope)
+    np.testing.assert_array_equal(raw, got)
+
+
+def test_conv_bn_pass_does_not_mutate_original_params(rng):
+    scope = fluid.Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[3, 8, 8])
+        c = layers.conv2d(input=x, num_filters=4, filter_size=3, padding=1)
+        b = layers.batch_norm(input=c)
+        out = layers.reduce_mean(b)
+    exe = fluid.Executor()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    for op in main.global_block().ops:
+        if op.type == "batch_norm":
+            scope.set_var(op.input("Mean")[0],
+                          rng.randn(4).astype(np.float32))
+            scope.set_var(op.input("Variance")[0],
+                          rng.rand(4).astype(np.float32) + 0.5)
+    infer = main.clone(for_test=True)
+    w_name = infer.global_block().ops[0].input("Filter")[0]
+    w_before = np.asarray(scope.find_var(w_name)).copy()
+    xs = rng.randn(2, 3, 8, 8).astype(np.float32)
+    with fluid.scope_guard(scope):
+        (raw,) = exe.run(infer, feed={"x": xs}, fetch_list=[out])
+    opt, ctx = optimize_program(infer, scope=scope, level=2,
+                                feed_names=["x"], fetch_names=[out.name])
+    assert ctx.stats.get("conv_bn_fold", {}).get("applied", 0) == 1
+    assert "batch_norm" not in _gb_ops(opt)
+    # the ORIGINAL weight is untouched (the legacy InferenceTranspiler
+    # overwrote it) — raw and optimized executables coexist on one scope
+    np.testing.assert_array_equal(np.asarray(scope.find_var(w_name)),
+                                  w_before)
+    with fluid.scope_guard(scope):
+        (raw2,) = exe.run(infer, feed={"x": xs}, fetch_list=[out])
+        (got,) = fluid.Executor().run(opt, feed={"x": xs},
+                                      fetch_list=[out.name], scope=scope)
+    np.testing.assert_array_equal(raw, raw2)  # original still original
+    np.testing.assert_allclose(got, raw, rtol=1e-4, atol=1e-5)
+
+
+def test_conv_bn_pass_skips_training_mode_bn(rng):
+    scope = fluid.Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[3, 8, 8])
+        c = layers.conv2d(input=x, num_filters=4, filter_size=3, padding=1)
+        b = layers.batch_norm(input=c)  # is_test False: batch statistics
+        out = layers.reduce_mean(b)
+    with fluid.scope_guard(scope):
+        fluid.Executor().run(startup)
+    opt, ctx = optimize_program(main, scope=scope, level=2,
+                                feed_names=["x"], fetch_names=[out.name])
+    assert "batch_norm" in _gb_ops(opt)
+
+
+# -- bucketize -------------------------------------------------------------
+
+
+def test_bucketize_stamps_rowwise_graphs_only():
+    scope = fluid.Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[8])
+        h = layers.fc(x, 4, act="relu")
+        m = layers.mean(h)
+    with fluid.scope_guard(scope):
+        fluid.Executor().run(startup)
+    # row-wise fetch: stamped
+    opt, _ = optimize_program(main, scope=scope, level=2,
+                              feed_names=["x"], fetch_names=[h.name])
+    assert getattr(opt, "_bucketize", None) == {"feeds": ["x"],
+                                                "fetches": [h.name]}
+    # row-mixing fetch (mean): NOT stamped
+    opt2, ctx2 = optimize_program(main, scope=scope, level=2,
+                                  feed_names=["x"], fetch_names=[m.name])
+    assert getattr(opt2, "_bucketize", None) is None
+    assert any("mixes rows" in n for n in ctx2.notes)
+    # training program: NOT stamped
+    scope3 = fluid.Scope()
+    m3, st3 = fluid.Program(), fluid.Program()
+    with fluid.scope_guard(scope3), fluid.program_guard(m3, st3):
+        x = layers.data(name="x", shape=[8])
+        y = layers.data(name="y", shape=[1])
+        loss = layers.mean(layers.square(layers.fc(x, 1) - y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    with fluid.scope_guard(scope3):
+        fluid.Executor().run(st3)
+    opt3, _ = optimize_program(m3, scope=scope3, level=2,
+                               feed_names=["x", "y"],
+                               fetch_names=[loss.name])
+    assert getattr(opt3, "_bucketize", None) is None
+
+
+def test_bucketize_executor_cuts_compiles_exactly(rng):
+    scope = fluid.Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[16])
+        out = layers.fc(layers.fc(x, 32, act="relu"), 2)
+    infer = main.clone(for_test=True)
+    exe0 = fluid.Executor(opt_level=0)
+    exe2 = fluid.Executor(opt_level=2)
+    with fluid.scope_guard(scope):
+        fluid.Executor().run(startup)  # keep the arms' caches clean
+    sizes = (3, 5, 6, 7, 9, 3)
+
+    def arm(exe):
+        rs, outs = np.random.RandomState(7), []
+        with fluid.scope_guard(scope):
+            for n in sizes:
+                xs = rs.randn(n, 16).astype(np.float32)
+                (o,) = exe.run(infer, feed={"x": xs}, fetch_list=[out])
+                outs.append(o)
+        return outs
+
+    raw = arm(exe0)
+    opt = arm(exe2)
+    # every distinct raw size compiled; bucketized sizes share pow2 sigs
+    assert len(exe0._cache) == 5       # 3,5,6,7,9
+    assert len(exe2._cache) == 3       # buckets 4,8,16
+    for a, b in zip(raw, opt):
+        assert a.shape == b.shape       # sliced back to real rows
+        # padded-path rows are exact math; bitwise they can drift by
+        # GEMM reduction-order ulps when the batch dim changes
+        # (bucketize.py docstring) — tiny nets like this one are
+        # bit-stable on the CPU backend, but pin the CONTRACT, not the
+        # backend's current tiling choice
+        np.testing.assert_allclose(a, b, rtol=2e-6, atol=1e-7)
+
+
+def test_bucketize_rejects_static_batch_operand(rng):
+    """An elementwise operand with a STATIC batch-sized axis 0 blocks
+    the stamp: padding the dynamic feed would shape-error against it
+    (code-review regression)."""
+    scope = fluid.Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4])
+        tbl = main.global_block().create_parameter(
+            name="tbl_n", shape=[6, 4], dtype="float32")
+        scope.set_var("tbl_n", np.zeros((6, 4), np.float32))
+        out = layers.elementwise_add(x, tbl)
+    opt, _ = optimize_program(main, scope=scope, level=2,
+                              feed_names=["x"], fetch_names=[out.name])
+    assert getattr(opt, "_bucketize", None) is None
+
+
+def test_bucketize_never_slices_bn_stat_fetches(rng):
+    """Only batch_norm's Y carries the batch; fetched (C,) running
+    stats must not land in the slice list (code-review regression)."""
+    scope = fluid.Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[3, 8, 8])
+        c = layers.conv2d(input=x, num_filters=4, filter_size=3,
+                          padding=1)
+        b = layers.batch_norm(input=c)
+    infer = main.clone(for_test=True)
+    bn = next(op for op in infer.global_block().ops
+              if op.type == "batch_norm")
+    stat = bn.output("MeanOut")[0] if bn.output("MeanOut") else None
+    with fluid.scope_guard(scope):
+        fluid.Executor().run(startup)
+    fetches = [b.name] + ([stat] if stat else [])
+    opt, _ = optimize_program(infer, scope=scope, level=2,
+                              feed_names=["x"], fetch_names=fetches,
+                              passes=["bucketize"])
+    bkt = getattr(opt, "_bucketize", None)
+    if bkt is not None and stat is not None:
+        assert stat not in bkt["fetches"]
+        assert b.name in bkt["fetches"]
+
+
+def test_engine_optimized_memo_is_scope_bound(rng):
+    """A different Scope must re-optimize, not inherit a twin whose
+    folded params live in another scope (code-review regression)."""
+    s1, s2 = fluid.Scope(), fluid.Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.scope_guard(s1), fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4])
+        out = layers.fc(x, 2, act="relu")
+    exe = fluid.Executor(opt_level=1)
+    with fluid.scope_guard(s1):
+        fluid.Executor().run(startup)
+    with fluid.scope_guard(s2):
+        fluid.Executor().run(startup)
+    eng = exe._engine_for(main)
+    p1 = eng.optimized(scope=s1, feed_names=("x",),
+                       fetch_names=(out.name,), level=1)
+    p1b = eng.optimized(scope=s1, feed_names=("x",),
+                        fetch_names=(out.name,), level=1)
+    p2 = eng.optimized(scope=s2, feed_names=("x",),
+                       fetch_names=(out.name,), level=1)
+    assert p1 is p1b
+    assert p2 is not p1
+
+
+def test_bucketize_serializes_with_the_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4])
+        h = layers.relu(x)
+    opt, _ = optimize_program(main, scope=fluid.Scope(), level=2,
+                              feed_names=["x"], fetch_names=[h.name])
+    assert getattr(opt, "_bucketize", None)
+    rt = fluid.Program.from_json(opt.to_json())
+    assert rt._bucketize == opt._bucketize
+    # unstamped programs serialize byte-identically to before
+    assert "bucketize" not in main.to_dict()
+
+
+# -- manager contracts -----------------------------------------------------
+
+
+def test_optimize_is_idempotent(rng):
+    scope = fluid.Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[16])
+        c = layers.fill_constant(shape=[32], dtype="float32", value=0.5)
+        h = layers.fc(x, 32, act="relu")
+        h = layers.elementwise_add(h, c)
+        dead = layers.fc(h, 4)
+        out = layers.fc(h, 2)
+    with fluid.scope_guard(scope):
+        fluid.Executor().run(startup)
+    for level in (1, 2):
+        once, _ = optimize_program(main, scope=scope, level=level,
+                                   feed_names=["x"],
+                                   fetch_names=[out.name])
+        twice, ctx2 = optimize_program(once, scope=scope, level=level,
+                                       feed_names=["x"],
+                                       fetch_names=[out.name])
+        assert once.to_dict() == twice.to_dict(), \
+            "level %d not idempotent" % level
+
+
+def test_env_knob_and_engine_memo(rng, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_OPT", "1")
+    exe = fluid.Executor()
+    assert exe.opt_level == 1
+    monkeypatch.setenv("PADDLE_TPU_OPT", "bogus")
+    assert fluid.Executor().opt_level == 0
+    monkeypatch.delenv("PADDLE_TPU_OPT")
+    assert fluid.Executor().opt_level == 0
+    assert fluid.Executor(opt_level=2).opt_level == 2
+
+    # the Engine memoizes the optimized twin per (version, level, io)
+    scope = fluid.Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4])
+        out = layers.fc(x, 2)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    eng = exe._engine_for(main)
+    p1 = eng.optimized(scope=scope, feed_names=("x",),
+                       fetch_names=(out.name,), level=1)
+    p2 = eng.optimized(scope=scope, feed_names=("x",),
+                       fetch_names=(out.name,), level=1)
+    assert p1 is p2
+    p3 = eng.optimized(scope=scope, feed_names=("x",),
+                       fetch_names=(out.name,), level=2)
+    assert p3 is not p1
+
+
+def test_optimized_and_raw_aot_keys_differ(rng):
+    """Optimized executables must coexist with raw ones in the AOT
+    cache: the content fingerprints (the key's program field) differ."""
+    scope = fluid.Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4])
+        out = layers.fc(x, 2, act="relu")
+    with fluid.scope_guard(scope):
+        fluid.Executor().run(startup)
+    opt, _ = optimize_program(main, scope=scope, level=1,
+                              feed_names=["x"], fetch_names=[out.name])
+    assert opt.fingerprint() != main.fingerprint()
+
+
+# -- save_inference_model / Predictor -------------------------------------
+
+
+def test_save_inference_model_optimized_and_predictor(tmp_path, rng):
+    scope = fluid.Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[8])
+        prob = layers.fc(layers.fc(x, 16, act="relu"), 2, act="relu")
+    exe = fluid.Executor()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        raw_dir, opt_dir = str(tmp_path / "raw"), str(tmp_path / "opt")
+        fluid.io.save_inference_model(raw_dir, ["x"], [prob], exe,
+                                      main_program=main, scope=scope)
+        fluid.io.save_inference_model(opt_dir, ["x"], [prob], exe,
+                                      main_program=main, scope=scope,
+                                      optimize=2)
+    from paddle_tpu.inference import Predictor
+
+    p_raw = Predictor(raw_dir, aot_cache=False)
+    p_opt = Predictor(opt_dir, aot_cache=False)
+    assert any(op.type == "fused_fc"
+               for op in p_opt._program.global_block().ops)
+    assert getattr(p_opt._program, "_bucketize", None)
+    xs = rng.randn(5, 8).astype(np.float32)  # 5 pads to bucket 8
+    (a,) = p_raw.run({"x": xs})
+    (b,) = p_opt.run({"x": xs})
+    assert b.shape == a.shape
+    # padded path: ulp tolerance (GEMM reduction order, see bucketize.py)
+    np.testing.assert_allclose(a, b, rtol=2e-6, atol=1e-7)
+
+    # a raw export served with opt_level 1 (no padding) matches EXACTLY
+    p_opt2 = Predictor(raw_dir, aot_cache=False, opt_level=1)
+    (c,) = p_opt2.run({"x": xs})
+    np.testing.assert_array_equal(a, c)
+
+
+# -- infer rules for the fused forms --------------------------------------
+
+
+def test_fused_op_infer_rules_match_kernels(rng):
+    from op_test import check_infer
+
+    x = rng.randn(4, 8).astype(np.float32)
+    w = rng.randn(8, 3).astype(np.float32)
+    b = rng.randn(3).astype(np.float32)
+    check_infer("fused_fc", {"X": x, "Y": w, "Bias": b},
+                attrs={"kind": "mul", "x_num_col_dims": 1,
+                       "y_num_col_dims": 1, "axis": 1, "act": "relu"})
+    check_infer("fused_fc", {"X": x, "Y": w, "Bias": b},
+                attrs={"kind": "matmul", "axis": -1, "act": ""})
+    y = rng.randn(8).astype(np.float32)
+    check_infer("fused_elemwise_activation",
+                {"X": x, "Y": y},
+                attrs={"functor_list": ["relu", "elementwise_add"],
+                       "axis": 1, "scale": 1.0})
+
+
+def test_fused_fc_numeric_matches_unfused(rng):
+    from op_test import run_op
+
+    x = rng.randn(4, 8).astype(np.float32)
+    w = rng.randn(8, 3).astype(np.float32)
+    b = rng.randn(3).astype(np.float32)
+    fused = run_op("fused_fc", {"X": x, "Y": w, "Bias": b},
+                   attrs={"kind": "mul", "x_num_col_dims": 1,
+                          "y_num_col_dims": 1, "axis": 1,
+                          "act": "relu"})["Out"]
+    mm = run_op("mul", {"X": x, "Y": w},
+                attrs={"x_num_col_dims": 1, "y_num_col_dims": 1})["Out"]
+    add = run_op("elementwise_add", {"X": np.asarray(mm), "Y": b},
+                 attrs={"axis": 1})["Out"]
+    ref = run_op("relu", {"X": np.asarray(add)})["Out"]
+    np.testing.assert_array_equal(np.asarray(fused), np.asarray(ref))

@@ -1,0 +1,226 @@
+"""Parity gates for the optimizing transpiler: the three bundled example
+programs (the same graphs tools/program_lint.py and the benches build)
+trained raw vs optimized at every opt level, plus a randomized battery of
+small programs drawn from the layer/OpTest op pool — every one must be
+BIT-exact (losses, fetches, and final parameters) and the pipeline must
+be idempotent (optimizing its own output is a no-op)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.transpiler.passes import optimize_program
+
+STEPS = 3
+
+
+def _build_mlp():
+    from paddle_tpu.models.mnist import mlp_model
+
+    img = layers.data(name="pixel", shape=[784], dtype="float32")
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    predict = mlp_model(img)
+    cost = layers.cross_entropy(input=predict, label=label)
+    avg_cost = layers.mean(cost)
+    acc = layers.accuracy(input=predict, label=label)
+    fluid.optimizer.SGD(learning_rate=0.01).minimize(avg_cost)
+    rs = np.random.RandomState(0)
+    feed = {"pixel": rs.rand(8, 784).astype(np.float32),
+            "label": rs.randint(0, 10, (8, 1)).astype(np.int64)}
+    return feed, [avg_cost.name, acc.name]
+
+
+def _build_deepfm():
+    from paddle_tpu.models.deepfm import deepfm_net
+
+    feat_ids = layers.data(name="feat_ids", shape=[10], dtype="int64")
+    dense = layers.data(name="dense", shape=[13], dtype="float32")
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    avg_cost, prob = deepfm_net(feat_ids, dense, label,
+                                num_features=1000, num_fields=10)
+    fluid.optimizer.Adam(learning_rate=1e-3).minimize(avg_cost)
+    rs = np.random.RandomState(0)
+    feed = {"feat_ids": rs.randint(0, 1000, (8, 10)).astype(np.int64),
+            "dense": rs.rand(8, 13).astype(np.float32),
+            "label": rs.randint(0, 2, (8, 1)).astype(np.int64)}
+    return feed, [avg_cost.name, prob.name]
+
+
+def _build_lstm():
+    from paddle_tpu.models.stacked_lstm import stacked_lstm_net
+
+    words = layers.data(name="words", shape=[80], dtype="int64")
+    lengths = layers.data(name="lengths", shape=[], dtype="int32")
+    label = layers.data(name="label", shape=[1], dtype="int64")
+    predict = stacked_lstm_net(words, lengths, dict_dim=3000,
+                               emb_dim=64, hid_dim=64, stacked_num=2)
+    cost = layers.cross_entropy(input=predict, label=label)
+    avg_cost = layers.mean(cost)
+    fluid.optimizer.Adam(learning_rate=1e-3).minimize(avg_cost)
+    rs = np.random.RandomState(0)
+    feed = {"words": rs.randint(0, 3000, (4, 80)).astype(np.int64),
+            "lengths": rs.randint(8, 80, (4,)).astype(np.int32),
+            "label": rs.randint(0, 2, (4, 1)).astype(np.int64)}
+    return feed, [avg_cost.name]
+
+
+_EXAMPLES = {"mlp": _build_mlp, "deepfm": _build_deepfm,
+             "lstm": _build_lstm}
+
+
+def _train_arm(builder, opt_level):
+    """Build the example fresh (own programs + scope + executor), run
+    STEPS training steps, return (per-step fetches, final params)."""
+    scope = fluid.Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            feed, fetches = builder()
+    exe = fluid.Executor(opt_level=opt_level)
+    results = []
+    with fluid.scope_guard(scope):
+        fluid.Executor().run(startup)
+        for _ in range(STEPS):
+            results.append(exe.run(main, feed=feed, fetch_list=fetches))
+    params = {p.name: np.asarray(scope.find_var(p.name))
+              for p in main.all_parameters()}
+    return results, params
+
+
+def _assert_arms_equal(name, raw, opt):
+    raw_res, raw_params = raw
+    opt_res, opt_params = opt
+    for step, (a, b) in enumerate(zip(raw_res, opt_res)):
+        for va, vb in zip(a, b):
+            assert np.array_equal(va, vb), \
+                "%s: fetch diverged at step %d" % (name, step)
+    assert set(raw_params) == set(opt_params)
+    for pname in raw_params:
+        assert np.array_equal(raw_params[pname], opt_params[pname]), \
+            "%s: param %r diverged" % (name, pname)
+
+
+@pytest.mark.parametrize("name", ["mlp", "deepfm"])
+def test_bundled_example_parity(name):
+    raw = _train_arm(_EXAMPLES[name], 0)
+    for level in (1, 2):
+        _assert_arms_equal(name, raw, _train_arm(_EXAMPLES[name], level))
+
+
+@pytest.mark.slow
+def test_bundled_example_parity_lstm():
+    raw = _train_arm(_build_lstm, 0)
+    for level in (1, 2):
+        _assert_arms_equal("lstm", raw, _train_arm(_build_lstm, level))
+
+
+# -- randomized battery ----------------------------------------------------
+
+
+def _random_program(seed):
+    """A small random program from the layer/OpTest pool. Returns
+    (main, startup, feed, fetch_names, train). Shapes stay tiny — the
+    battery's job is structural coverage, not compute."""
+    rs = np.random.RandomState(seed)
+    d = int(rs.randint(3, 9))
+    batch = int(rs.randint(3, 7))
+    train = bool(rs.rand() < 0.5)
+    main, startup = fluid.Program(), fluid.Program()
+    feed = {}
+    with fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = layers.data(name="x", shape=[d])
+            feed["x"] = rs.randn(batch, d).astype(np.float32)
+            h = x
+            for _ in range(int(rs.randint(2, 6))):
+                k = rs.randint(0, 8)
+                if k == 0:
+                    w = int(rs.randint(3, 12))
+                    act = [None, "relu", "tanh", "sigmoid"][
+                        rs.randint(0, 4)]
+                    h = layers.fc(h, w, act=act)
+                elif k == 1:
+                    h = layers.scale(h, scale=float(rs.uniform(0.5, 2.0)))
+                elif k == 2:
+                    h = [layers.relu, layers.tanh, layers.sigmoid,
+                         layers.square][rs.randint(0, 4)](h)
+                elif k == 3:
+                    # CSE bait: identical twin subexpressions
+                    a = layers.scale(h, scale=1.5)
+                    b = layers.scale(h, scale=1.5)
+                    h = layers.elementwise_add(a, b)
+                elif k == 4:
+                    # DCE bait: a layer nothing consumes
+                    layers.fc(h, 4)
+                elif k == 5:
+                    # fold bait: a constant chain joining the stream
+                    hd = int(h.shape[-1])
+                    c = layers.fill_constant(shape=[hd], dtype="float32",
+                                             value=float(rs.uniform(1)))
+                    c = layers.scale(c, scale=2.0)
+                    h = layers.elementwise_add(h, c)
+                elif k == 6:
+                    h = layers.dropout(h, dropout_prob=0.25)
+                else:
+                    h = layers.softmax(h)
+            fetches = [h.name]
+            if train:
+                y = layers.data(name="y", shape=[1])
+                feed["y"] = rs.randn(batch, 1).astype(np.float32)
+                loss = layers.mean(
+                    layers.square(layers.fc(h, 1) - y))
+                fluid.optimizer.SGD(0.05).minimize(loss)
+                fetches = [loss.name]
+    return main, startup, feed, fetches, train
+
+
+def _battery(seeds):
+    for seed in seeds:
+        main, startup, feed, fetches, train = _random_program(seed)
+        steps = STEPS if train else 1
+        arms = {}
+        for level in (0, 1, 2):
+            scope = fluid.Scope()
+            exe = fluid.Executor(opt_level=level)
+            with fluid.scope_guard(scope):
+                fluid.Executor().run(startup)
+                arms[level] = [
+                    exe.run(main, feed=feed, fetch_list=fetches)
+                    for _ in range(steps)]
+        for level in (1, 2):
+            for step, (a, b) in enumerate(zip(arms[0], arms[level])):
+                for va, vb in zip(a, b):
+                    if np.array_equal(va, vb):
+                        continue
+                    # level 2 may run PADDED (bucketize): rows are exact
+                    # math but XLA's GEMM can reduce in a different
+                    # order at a different batch dim — ulp class only
+                    # (transpiler/passes/bucketize.py docstring)
+                    assert level == 2, (
+                        "seed %d level %d: output diverged at step %d"
+                        % (seed, level, step))
+                    np.testing.assert_allclose(
+                        va, vb, rtol=2e-6, atol=1e-7,
+                        err_msg="seed %d level 2 step %d" % (seed, step))
+        # idempotence: optimizing the optimized program changes nothing
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            fluid.Executor().run(startup)
+        for level in (1, 2):
+            once, _ = optimize_program(
+                main, scope=scope, level=level,
+                feed_names=list(feed), fetch_names=fetches)
+            twice, _ = optimize_program(
+                once, scope=scope, level=level,
+                feed_names=list(feed), fetch_names=fetches)
+            assert once.to_dict() == twice.to_dict(), \
+                "seed %d level %d: not idempotent" % (seed, level)
+
+
+def test_randomized_parity_battery():
+    _battery(range(6))
+
+
+@pytest.mark.slow
+def test_randomized_parity_battery_full():
+    _battery(range(6, 34))

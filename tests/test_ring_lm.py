@@ -166,6 +166,11 @@ def _run_sp(monkeypatch, chunk_env, seed=3):
         return float(pexe.run(feed=_feed(), fetch_list=[loss])[0])
 
 
+@pytest.mark.skipif(
+    not (hasattr(jax.lax, "pvary") or hasattr(jax.lax, "pcast")),
+    reason="explicit ring chunking needs lax.pvary/pcast for its loop "
+           "carries (present from jax 0.6; this box runs 0.4.37) — "
+           "known non-regression, see test_parallel's chunked gate")
 def test_ring_chunk_env_override(monkeypatch):
     """PADDLE_TPU_RING_CHUNK through the op route on an sp mesh: 0 means
     auto (not a crash), an explicit chunk is numerically invisible, junk

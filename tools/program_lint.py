@@ -208,6 +208,11 @@ def main(argv=None) -> int:
                     help="a graph-building python script to execute+lint")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable output")
+    ap.add_argument("--optimize", type=int, default=0, metavar="LEVEL",
+                    help="additionally lint each target AFTER the "
+                         "optimizing transpiler at LEVEL (1|2) — the "
+                         "pass manager must keep programs lint-clean "
+                         "and fully infer-covered")
     ap.add_argument("--strict", action="store_true",
                     help="exit nonzero on warnings too")
     ap.add_argument("--min-severity", default="info",
@@ -239,12 +244,31 @@ def main(argv=None) -> int:
             else:
                 print("== %s: FAILED to load/build: %s" % (label, e))
             continue
-        doc, rep = lint_one(program, feeds, fetches, label,
-                            args.min_severity, args.as_json)
-        if doc is not None:
-            json_docs.append(doc)
-        if rep.errors or (args.strict and rep.warnings):
-            failed = True
+        variants = [(label, program)]
+        if args.optimize:
+            from paddle_tpu.framework.scope import Scope
+            from paddle_tpu.transpiler.passes import optimize_program
+
+            try:
+                opt, _ctx = optimize_program(
+                    program, scope=Scope(), level=args.optimize,
+                    feed_names=feeds, fetch_names=fetches)
+                variants.append(
+                    ("%s+O%d" % (label, args.optimize), opt))
+            except Exception as e:
+                failed = True
+                if args.as_json:
+                    json_docs.append({"name": label + "+opt",
+                                      "load_error": str(e)})
+                else:
+                    print("== %s: FAILED to optimize: %s" % (label, e))
+        for vlabel, vprogram in variants:
+            doc, rep = lint_one(vprogram, feeds, fetches, vlabel,
+                                args.min_severity, args.as_json)
+            if doc is not None:
+                json_docs.append(doc)
+            if rep.errors or (args.strict and rep.warnings):
+                failed = True
     if args.as_json:
         print(json.dumps({"programs": json_docs}, indent=2))
     return 1 if failed else 0
