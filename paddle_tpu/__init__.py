@@ -34,6 +34,7 @@ from .ops import attention as _k_attention  # noqa: F401
 from .ops import fused_loss as _k_fused_loss  # noqa: F401
 from .ops import kv_cache as _k_kv_cache  # noqa: F401
 from .ops import sampling as _k_sampling  # noqa: F401
+from .ops import quant as _k_quant  # noqa: F401
 from .ops import detection as _k_detection  # noqa: F401
 
 from .framework import (  # noqa: F401
@@ -108,6 +109,7 @@ from .checkpoint import (  # noqa: F401
     CheckpointManager,
     ResumableLoop,
 )
+from . import quant  # noqa: F401  (int8 post-training quantization tier)
 
 from . import inference  # noqa: F401
 from . import lod_tensor  # noqa: F401

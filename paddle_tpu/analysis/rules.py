@@ -1145,3 +1145,119 @@ def _infer_accuracy(ctx: InferContext):
             % (ind[0], lbl[0]))
     return {"Accuracy": info((), "float32"),
             "Correct": info((), "int32"), "Total": info((), "int32")}
+
+
+# ---------------------------------------------------------------------------
+# int8 quantization ops (ops/quant.py; emitted by transpiler/passes/
+# quantize.py and the DecodeServer's int8 KV-slab graphs)
+# ---------------------------------------------------------------------------
+
+
+@register_infer("quantize_linear")
+def _infer_quantize_linear(ctx: InferContext):
+    """Symmetric int8 quantization: X's shape, int8 out."""
+    return {"Out": VarInfo(ctx.in_shape("X"), "int8")}
+
+
+@register_infer("dequantize_linear")
+def _infer_dequantize_linear(ctx: InferContext):
+    return {"Out": VarInfo(ctx.in_shape("X"),
+                           convert_dtype(ctx.attr("out_dtype", "float32")))}
+
+
+@register_infer("quantized_matmul")
+def _infer_quantized_matmul(ctx: InferContext):
+    """Quantized fc: the mul contraction (int8 weight in its original
+    layout, flattened by the num_col_dims attrs — contraction checks
+    included), widened by the fused_fc bias span; Out keeps the FLOAT
+    activation's dtype (the int32 accumulator dequantizes in-op)."""
+    base = _infer_mul(ctx)["Out"]
+    dt = ctx.in_dtype("X") or "float32"
+    if not ctx.has_input("Bias"):
+        return {"Out": VarInfo(base.shape, dt)}
+    bias = ctx.in_info("Bias")
+    out = _bias_span(base.shape, bias.shape, ctx.attr("axis", -1), "Bias")
+    return {"Out": VarInfo(out, dt)}
+
+
+@register_infer("quantized_conv2d")
+def _infer_quantized_conv2d(ctx: InferContext):
+    """conv2d spatial arithmetic with an int8 filter; Output keeps the
+    float Input dtype (per-channel dequant is fused into the op)."""
+    base = _infer_conv2d(ctx)["Output"]
+    return {"Output": VarInfo(base.shape,
+                              ctx.in_dtype("Input") or base.dtype)}
+
+
+@register_infer("cache_append_quant")
+def _infer_cache_append_quant(ctx: InferContext):
+    """Quantized slab append: Out echoes the int8 Cache, OutScales the
+    (B, S) Scales; New rows must match the slab row shape (the
+    cache_append contract)."""
+    c = ctx.in_info("Cache")
+    s = ctx.in_info("Scales")
+    n = ctx.in_shape("New")
+    if c.shape is not None and n is not None:
+        if len(n) == len(c.shape) and n[1] is not None and n[1] != 1:
+            raise InferError(
+                "cache_append_quant appends ONE row per sequence; New "
+                "has time dim %d" % n[1])
+        tail = n[2:] if len(n) == len(c.shape) else n[1:]
+        want = tuple(c.shape[2:])
+        if (len(tail) != len(want)
+            or any(a is not None and b is not None and a != b
+                   for a, b in zip(tail, want))):
+            raise InferError(
+                "New%s row shape does not match Cache%s rows"
+                % (render_shape(n), render_shape(c.shape)))
+    if c.shape is not None and s.shape is not None:
+        if (len(s.shape) != 2
+            or any(a is not None and b is not None and a != b
+                   for a, b in zip(s.shape, c.shape[:2]))):
+            raise InferError(
+                "Scales%s must be (B, S) matching Cache%s's slot/seq "
+                "dims" % (render_shape(s.shape), render_shape(c.shape)))
+    return {"Out": VarInfo(c.shape, c.dtype),
+            "OutScales": VarInfo(s.shape, s.dtype)}
+
+
+@register_infer("decode_attention_quant")
+def _infer_decode_attention_quant(ctx: InferContext):
+    """Single-query attention over int8 slabs: Out = Q's shape/dtype;
+    slab and scale dims must agree with the query (the decode_attention
+    contract plus the (B, S) scale layout)."""
+    q = ctx.in_info("Q")
+    qs = q.shape
+    if qs is not None and len(qs) != 4:
+        raise InferError("Q must be rank 4 (B, 1, H, Dh), got rank %d"
+                         % len(qs))
+    if qs is not None and qs[1] not in (None, 1):
+        raise InferError(
+            "decode_attention_quant takes ONE query per sequence; Q%s "
+            "has time dim %s" % (render_shape(qs), qs[1]))
+    for slot in ("KCache", "VCache"):
+        c = ctx.in_shape(slot)
+        if qs is None or c is None:
+            continue
+        if len(c) != 4:
+            raise InferError("%s must be rank 4 (B, S, H, Dh), got rank "
+                             "%d" % (slot, len(c)))
+        for qi, ci, label in ((0, 0, "batch"), (2, 2, "head"),
+                              (3, 3, "depth")):
+            if qs[qi] is not None and c[ci] is not None \
+                    and qs[qi] != c[ci]:
+                raise InferError(
+                    "%s %s dim %d does not match Q%s"
+                    % (slot, label, c[ci], render_shape(qs)))
+    for cslot, sslot in (("KCache", "KScales"), ("VCache", "VScales")):
+        c = ctx.in_shape(cslot)
+        s = ctx.in_shape(sslot)
+        if c is None or s is None:
+            continue
+        if (len(s) != 2
+            or any(a is not None and b is not None and a != b
+                   for a, b in zip(s, c[:2]))):
+            raise InferError(
+                "%s%s must be (B, S) matching %s%s"
+                % (sslot, render_shape(s), cslot, render_shape(c)))
+    return {"Out": VarInfo(qs, q.dtype)}

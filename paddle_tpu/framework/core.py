@@ -416,6 +416,13 @@ class Program:
         bkt = getattr(self, "_bucketize", None)
         if bkt:
             d["bucketize"] = bkt
+        # quantization stamp (transpiler/passes/quantize.py): rides the
+        # JSON so an exported int8 model is identifiable wherever it is
+        # served (Engine.meta tier, aot_cache_ls); same present-only
+        # contract as the bucketize stamp
+        q = getattr(self, "_quantized", None)
+        if q:
+            d["quantized"] = q
         return d
 
     def to_json(self) -> str:
@@ -433,6 +440,8 @@ class Program:
         p._amp_level = lvl
         if d.get("bucketize"):
             p._bucketize = d["bucketize"]
+        if d.get("quantized"):
+            p._quantized = d["quantized"]
         # first pass: blocks
         p.blocks = []
         for bd in d["blocks"]:

@@ -245,6 +245,7 @@ def save_inference_model(
     export_for_deployment: bool = True,
     scope: Optional[Scope] = None,
     optimize: int = 0,
+    quantize=None,
 ):
     """Reference: io.py:save_inference_model. Writes the pruned inference
     program as JSON plus the params it needs.
@@ -253,13 +254,34 @@ def save_inference_model(
     (transpiler/passes/) over the pruned program before export: folded
     constants ship as parameters, fused ops ship fused, and at level 2
     the bucketize stamp rides the program JSON so any Predictor serving
-    the directory buckets its feed signatures."""
+    the directory buckets its feed signatures.
+
+    ``quantize=CalibrationTable`` (paddle_tpu.quant) exports the int8
+    post-training-quantized program instead: the full level-3 pipeline
+    runs (fuse -> quantize -> bucketize), int8 weights ship as the
+    exported params (the float originals are dropped from the export),
+    and the quantized stamp rides the JSON. The source program and
+    Scope keep their float values — raw and quantized exports of one
+    model coexist, as do their AOT-cached executables."""
     program = main_program if main_program is not None else default_main_program()
     if not isinstance(target_vars, (list, tuple)):
         target_vars = [target_vars]
     target_names = [v.name if isinstance(v, Variable) else str(v) for v in target_vars]
     pruned = _prune_for_targets(program, target_names)
-    if optimize:
+    if quantize is not None:
+        from ..transpiler.passes import optimize_program
+
+        pruned, _opt_ctx = optimize_program(
+            pruned, scope=_scope_of(executor, scope),
+            level=max(int(optimize), 3), feed_names=feeded_var_names,
+            fetch_names=target_names, calib=quantize)
+        if not getattr(pruned, "_quantized", None):
+            raise ValueError(
+                "quantize= was given but no op quantized — the "
+                "calibration table covers none of this program's "
+                "fc/conv activations (calibrate against the same "
+                "inference program you export)")
+    elif optimize:
         from ..transpiler.passes import optimize_program
 
         pruned, _opt_ctx = optimize_program(

@@ -109,7 +109,9 @@ __all__ = [
     "moe_ffn",
     "fused_lm_head_loss",
     "decode_attention",
+    "decode_attention_quant",
     "cache_append",
+    "cache_append_quant",
     "cache_gather",
     "greedy_sample",
     "top_k_sample",
@@ -2158,6 +2160,46 @@ def cache_append(cache, new, pos, name=None):
         inputs={"Cache": [cache], "New": [new], "Pos": [pos]},
         outputs={"Out": [out]},
         attrs={},
+    )
+    return out
+
+
+def cache_append_quant(cache, scales, new, pos, name=None):
+    """Quantized slab append: the float row ``new`` (B, 1, ...) lands in
+    the int8 slab ``cache`` (B, S, ...) at row ``pos[b]``, quantized
+    against a fresh per-row scale stored in ``scales`` (B, S) at the
+    same position. Returns (new_cache, new_scales); kernel:
+    ops/quant.py (the int8 KV-slab opt-in — PADDLE_TPU_QUANT)."""
+    helper = LayerHelper("cache_append_quant", name=name)
+    out = helper.create_variable_for_type_inference(
+        cache.dtype, shape=cache.shape)
+    out_scales = helper.create_variable_for_type_inference(
+        scales.dtype, shape=scales.shape)
+    helper.append_op(
+        type="cache_append_quant",
+        inputs={"Cache": [cache], "Scales": [scales], "New": [new],
+                "Pos": [pos]},
+        outputs={"Out": [out], "OutScales": [out_scales]},
+        attrs={},
+    )
+    return out, out_scales
+
+
+def decode_attention_quant(q, k_cache, k_scales, v_cache, v_scales,
+                           lengths, scale=None, block_s=None, name=None):
+    """``decode_attention`` over int8 K/V slabs with per-(slot,
+    position) scales: rows dequantize on read, then the regular decode
+    dispatch runs (Pallas on TPU, exact lax fallback elsewhere; kernel:
+    ops/quant.py)."""
+    helper = LayerHelper("decode_attention_quant", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype, shape=q.shape)
+    helper.append_op(
+        type="decode_attention_quant",
+        inputs={"Q": [q], "KCache": [k_cache], "KScales": [k_scales],
+                "VCache": [v_cache], "VScales": [v_scales],
+                "Lengths": [lengths]},
+        outputs={"Out": [out]},
+        attrs={"scale": scale, "block_s": block_s or _DEFAULT_ATTN_BLOCK_K},
     )
     return out
 

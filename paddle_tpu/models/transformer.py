@@ -342,7 +342,8 @@ def transformer_lm(
 
 
 def _cached_self_attention(h, n_head, d_model, name, k_cache=None,
-                           v_cache=None, lengths=None, kv_lengths=None):
+                           v_cache=None, lengths=None, kv_lengths=None,
+                           k_scale=None, v_scale=None):
     """transformer_lm's self-attention with its K/V exposed.
 
     Prefill mode (no caches): full causal flash attention over (B, S);
@@ -351,7 +352,11 @@ def _cached_self_attention(h, n_head, d_model, name, k_cache=None,
     given): h is (B, 1, D); the step's k/v rows append into the slabs
     at ``lengths`` and a single-query decode_attention runs against the
     updated slabs up to ``kv_lengths`` valid rows; returns
-    (out, new_k_cache, new_v_cache). Parameter names and creation order
+    (out, new_k_cache, new_v_cache). With ``k_scale``/``v_scale``
+    (B, S) tensors the slabs are INT8 (the quantized-KV serving
+    opt-in): appends quantize each fresh row against its own scale and
+    attention dequantizes on read; returns (out, new_k, new_v,
+    new_k_scale, new_v_scale). Parameter names and creation order
     match multi_head_attention(fused_qkv=False) verbatim."""
     B, T, _ = h.shape
     d_head = d_model // n_head
@@ -366,6 +371,16 @@ def _cached_self_attention(h, n_head, d_model, name, k_cache=None,
         out = _linear(layers.reshape(ctx, shape=[B, T, d_model]),
                       d_model, name + ".out")
         return out, k, v
+    if k_scale is not None:
+        new_k, new_ks = layers.cache_append_quant(k_cache, k_scale, k,
+                                                  lengths)
+        new_v, new_vs = layers.cache_append_quant(v_cache, v_scale, v,
+                                                  lengths)
+        ctx = layers.decode_attention_quant(q, new_k, new_ks, new_v,
+                                            new_vs, kv_lengths)
+        out = _linear(layers.reshape(ctx, shape=[B, T, d_model]),
+                      d_model, name + ".out")
+        return out, new_k, new_v, new_ks, new_vs
     new_k = layers.cache_append(k_cache, k, lengths)
     new_v = layers.cache_append(v_cache, v, lengths)
     ctx = layers.decode_attention(q, new_k, new_v, kv_lengths)
@@ -434,6 +449,7 @@ def transformer_lm_decode(
     n_layer=4, n_head=8, d_model=512, d_inner=2048, max_len=2048,
     tie_embeddings=False, prefix="lm", strategy="greedy", seed=None,
     sample_k=40, sample_p=0.9, temperature=1.0,
+    k_scales=None, v_scales=None,
 ):
     """One incremental decode step: ``tokens`` (B, 1) int64 (the
     previously sampled token per slot), ``positions`` (B, 1) int64 (its
@@ -447,7 +463,12 @@ def transformer_lm_decode(
     ``strategy`` ("greedy" | "topk" | "topp" | "logits" — the last
     skips sampling for host-side beam search), logits (B, V), and the
     updated slabs to thread into the next step (donated in place on
-    TPU)."""
+    TPU).
+
+    With ``k_scales``/``v_scales`` (per-layer (B, S) tensors) the slabs
+    are INT8 and each ``new_caches`` entry is the 4-tuple (k, v,
+    k_scales, v_scales) — the quantized-KV serving graph (ops/quant.py;
+    2x sequences per slab byte budget)."""
     B = tokens.shape[0]
     # embedding squeezes the trailing ids dim of 1 (LoD convention):
     # (B, 1) ids -> (B, D); restore the singleton time axis explicitly
@@ -467,11 +488,19 @@ def transformer_lm_decode(
     new_caches = []
     for i in range(n_layer):
         h = _pre_norm(x)
-        attn, nk, nv = _cached_self_attention(
-            h, n_head, d_model, "%s.l%d.self" % (prefix, i),
-            k_cache=k_caches[i], v_cache=v_caches[i], lengths=lengths,
-            kv_lengths=kv_lengths)
-        new_caches.append((nk, nv))
+        if k_scales is not None:
+            attn, nk, nv, nks, nvs = _cached_self_attention(
+                h, n_head, d_model, "%s.l%d.self" % (prefix, i),
+                k_cache=k_caches[i], v_cache=v_caches[i], lengths=lengths,
+                kv_lengths=kv_lengths, k_scale=k_scales[i],
+                v_scale=v_scales[i])
+            new_caches.append((nk, nv, nks, nvs))
+        else:
+            attn, nk, nv = _cached_self_attention(
+                h, n_head, d_model, "%s.l%d.self" % (prefix, i),
+                k_cache=k_caches[i], v_cache=v_caches[i], lengths=lengths,
+                kv_lengths=kv_lengths)
+            new_caches.append((nk, nv))
         x = layers.elementwise_add(x, attn)
         ffn = positionwise_ffn(_pre_norm(x), d_inner, d_model, 0.0,
                                name="%s.l%d.ffn" % (prefix, i))

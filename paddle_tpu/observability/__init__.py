@@ -49,6 +49,7 @@ __all__ = [
     "CKPT_SAVES", "CKPT_BYTES", "CKPT_PENDING", "CKPT_SAVE_MS",
     "CKPT_RESTORE_MS", "CKPT_RETRIES", "CKPT_FAILURES",
     "TRANSPILE_OPS_REMOVED", "TRANSPILE_OPS_FUSED", "TRANSPILE_PASS_MS",
+    "QUANT_CALIB_BATCHES", "QUANT_OPS", "QUANT_PARITY",
 ]
 
 # -- the shared instrument set (registered once, process-wide) -----------
@@ -186,6 +187,19 @@ TRANSPILE_OPS_FUSED = REGISTRY.counter(
 TRANSPILE_PASS_MS = REGISTRY.histogram(
     "paddle_tpu_transpile_passes_ms",
     "Wall time per optimizing-transpiler pass invocation, by pass")
+QUANT_CALIB_BATCHES = REGISTRY.counter(
+    "paddle_tpu_quant_calib_batches_total",
+    "Sample batches streamed through quant.calibrate (activation-amax "
+    "collection for int8 post-training quantization)")
+QUANT_OPS = REGISTRY.counter(
+    "paddle_tpu_quant_quantized_ops_total",
+    "Ops the level-3 quantize pass rewrote onto int8 kernels, by the "
+    "source op type (op=mul|matmul|fused_fc|conv2d)")
+QUANT_PARITY = REGISTRY.gauge(
+    "paddle_tpu_quant_parity_max_abs_diff",
+    "Max abs logits difference of the last quant.parity_report run "
+    "(quantized vs float on the same feeds) — the drift the int8 tier "
+    "is currently serving at")
 FLEET_WORKERS = REGISTRY.gauge(
     "paddle_tpu_fleet_workers",
     "Router view of worker replicas by state=starting|ready|draining|"

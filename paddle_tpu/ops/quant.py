@@ -1,0 +1,284 @@
+"""Int8 post-training quantization kernels.
+
+The serving raw-speed lever (ROADMAP item 2, in the spirit of
+integer-arithmetic-only inference — Jacob et al., CVPR 2018): weights
+ship as int8 with per-output-channel scales, activations quantize
+per-tensor at the op boundary, the contraction accumulates in int32
+(``preferred_element_type=jnp.int32`` — the MXU's int8 path on TPU, an
+exact integer GEMM on the CPU backend), and the scale/dequant epilogue
+(+ bias + activation) fuses into the same op so no f32 intermediate of
+the unquantized width ever materializes.
+
+Symmetric quantization throughout: ``q = clip(round(x / scale), -127,
+127)``, ``x ≈ q * scale``. Scales ride as op ATTRS (per-channel scales
+are small (N,) arrays), so a quantized program is self-contained — the
+int8 weights are ordinary persistable params and the program JSON
+carries everything else.
+
+Ops:
+
+- ``quantize_linear`` / ``dequantize_linear``: standalone helpers
+  (per-tensor or per-axis scale), the building blocks tests and
+  calibration tooling compose;
+- ``quantized_matmul``: the quantized twin of ``mul``/``matmul``/
+  ``fused_fc`` — int8 x int8 -> int32 contraction with the fc epilogue
+  (dequant, axis-span bias add, activation) fused in;
+- ``quantized_conv2d``: conv2d with an int8 filter (per-output-channel
+  scales) and int8-quantized input, int32 accumulation;
+- ``cache_append_quant`` / ``decode_attention_quant``: the int8 KV-slab
+  pair for decode serving — each appended K/V row quantizes against its
+  own per-(slot, position) scale, and attention dequantizes on read
+  (the slab lives at 1 byte/element, halving the HBM a bf16 slab needs,
+  so one slab budget holds 2x the sequences). Exact CPU fallback by
+  construction: dequant-then-attend reuses ``decode_attention``'s
+  dispatch (Pallas on TPU, pure-lax reference elsewhere).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from .kv_cache import decode_attention
+from .math import _FC_ACTS, _broadcast_y
+from .registry import register_op
+
+# symmetric int8 range: +-127 keeps the scale sign-symmetric (the -128
+# code is never produced, matching the reference's int8 convention)
+Q_MAX = 127.0
+# scale floor: an all-zero tensor quantizes to zeros with a unit-free
+# tiny scale instead of dividing by zero
+SCALE_EPS = 1e-8
+
+
+def quantize_symmetric(x, scale):
+    """``clip(round(x / scale), -127, 127)`` as int8; ``scale`` is a
+    scalar or broadcasts against ``x``."""
+    q = jnp.round(x / scale)
+    return jnp.clip(q, -Q_MAX, Q_MAX).astype(jnp.int8)
+
+
+def scale_for_amax(amax):
+    """amax -> symmetric scale (floored so zeros stay safe)."""
+    return np.maximum(np.asarray(amax, np.float64), SCALE_EPS) / Q_MAX
+
+
+def weight_scales_2d(w2: np.ndarray) -> np.ndarray:
+    """Per-output-channel (column) scales of a (K, N) weight."""
+    amax = np.max(np.abs(np.asarray(w2, np.float64)), axis=0)
+    return scale_for_amax(amax)
+
+
+def quantize_weight_2d(w2: np.ndarray):
+    """(K, N) float weight -> (int8 weight, (N,) float32 scales)."""
+    s = weight_scales_2d(w2)
+    q = np.clip(np.round(np.asarray(w2, np.float64) / s[None, :]),
+                -Q_MAX, Q_MAX).astype(np.int8)
+    return q, s.astype(np.float32)
+
+
+def quantize_conv_filter(w: np.ndarray):
+    """OIHW float filter -> (int8 filter, (O,) float32 scales)."""
+    flat = np.abs(np.asarray(w, np.float64)).reshape(w.shape[0], -1)
+    s = scale_for_amax(np.max(flat, axis=1))
+    q = np.clip(np.round(np.asarray(w, np.float64)
+                         / s[:, None, None, None]),
+                -Q_MAX, Q_MAX).astype(np.int8)
+    return q, s.astype(np.float32)
+
+
+def _axis_broadcast(scale, ndim: int, axis: int):
+    """A (C,) scale vector shaped to broadcast along ``axis`` of a
+    rank-``ndim`` tensor; scalars pass through."""
+    s = jnp.asarray(scale, jnp.float32)
+    if s.ndim == 0:
+        return s
+    shape = [1] * ndim
+    shape[axis % ndim] = s.shape[0]
+    return s.reshape(shape)
+
+
+@register_op("quantize_linear")
+def _quantize_linear(ctx):
+    """X -> int8 by the symmetric scheme. attrs: ``scale`` (float or
+    per-channel ndarray), ``axis`` (channel axis for vector scales,
+    default -1)."""
+    x = ctx.input("X")
+    s = _axis_broadcast(ctx.attr("scale", 1.0), x.ndim,
+                        int(ctx.attr("axis", -1)))
+    return {"Out": quantize_symmetric(x, s)}
+
+
+@register_op("dequantize_linear")
+def _dequantize_linear(ctx):
+    """int8 X -> float by the same scale layout; attr ``out_dtype``
+    (default float32)."""
+    x = ctx.input("X")
+    s = _axis_broadcast(ctx.attr("scale", 1.0), x.ndim,
+                        int(ctx.attr("axis", -1)))
+    dt = jnp.dtype(ctx.attr("out_dtype", "float32"))
+    return {"Out": (x.astype(jnp.float32) * s).astype(dt)}
+
+
+@register_op("quantized_matmul")
+def _quantized_matmul(ctx):
+    """Quantized fc: X (float) x Y (int8 weight, stored in its original
+    layout) -> float Out, with the whole epilogue fused.
+
+    attrs: ``kind`` ("mul" | "matmul" — the op it replaced; both flatten
+    by ``x_num_col_dims``/``y_num_col_dims``, the transpiler only emits
+    matmul-kind for plain 2-D operands where that is the same
+    contraction), ``x_scale`` (per-tensor activation scale from
+    calibration), ``y_scale`` ((N,) per-output-channel weight scales
+    over the FLATTENED output span), ``axis``/``act`` (the fused_fc
+    bias/activation contract). Accumulation is int32; the dequant is
+    one row-vector multiply on the (M, N) accumulator.
+    """
+    import math as _math
+
+    x = ctx.input("X")
+    w = ctx.input("Y")
+    xnc = int(ctx.attr("x_num_col_dims", 1))
+    ync = int(ctx.attr("y_num_col_dims", 1))
+    x_scale = float(ctx.attr("x_scale", 1.0))
+    y_scale = jnp.asarray(ctx.attr("y_scale", 1.0), jnp.float32)
+    xs, ws = x.shape, w.shape
+    x2 = x.reshape((_math.prod(xs[:xnc]) if xnc else 1, -1))
+    w2 = w.reshape((_math.prod(ws[:ync]), -1))
+    xq = quantize_symmetric(x2, jnp.asarray(x_scale, x2.dtype))
+    acc = jnp.matmul(xq, w2, preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * (y_scale * x_scale)
+    out = out.reshape(xs[:xnc] + ws[ync:]).astype(x.dtype)
+    b = ctx.input("Bias")
+    if b is not None:
+        out = jnp.add(out, _broadcast_y(out, b, ctx.attr("axis", -1)))
+    act = ctx.attr("act", "")
+    if act:
+        if act not in _FC_ACTS:
+            raise ValueError(
+                "quantized_matmul: unsupported act %r (one of %s)"
+                % (act, sorted(_FC_ACTS)))
+        out = _FC_ACTS[act](out)
+    return {"Out": out}
+
+
+@register_op("quantized_conv2d")
+def _quantized_conv2d(ctx):
+    """conv2d with an int8 OIHW filter: the input quantizes per-tensor
+    (attr ``x_scale``), the convolution accumulates int32, and the
+    per-output-channel dequant (attr ``w_scale``, shape (O,)) applies on
+    the channel axis of the declared ``data_format``. Conv attrs
+    (strides/paddings/dilations/groups) pass through unchanged."""
+    x = ctx.input("Input")
+    w = ctx.input("Filter")  # int8 OIHW
+    from .nn import _pair
+
+    strides = _pair(ctx.attr("strides", [1, 1]))
+    pads = _pair(ctx.attr("paddings", [0, 0]))
+    dilations = _pair(ctx.attr("dilations", [1, 1]))
+    groups = ctx.attr("groups", 1) or 1
+    fmt = ctx.attr("data_format", "NCHW") or "NCHW"
+    x_scale = float(ctx.attr("x_scale", 1.0))
+    w_scale = jnp.asarray(ctx.attr("w_scale", 1.0), jnp.float32)
+    xq = quantize_symmetric(x, jnp.asarray(x_scale, x.dtype))
+    acc = lax.conv_general_dilated(
+        xq, w,
+        window_strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dilations,
+        dimension_numbers=(fmt, "OIHW", fmt),
+        feature_group_count=groups,
+        preferred_element_type=jnp.int32,
+    )
+    chan_axis = 3 if fmt == "NHWC" else 1
+    deq = acc.astype(jnp.float32) * _axis_broadcast(
+        w_scale * x_scale, acc.ndim, chan_axis)
+    return {"Output": deq.astype(x.dtype)}
+
+
+# ---------------------------------------------------------------------------
+# int8 KV slab (serving/decode.py opt-in: PADDLE_TPU_QUANT / kv_dtype)
+# ---------------------------------------------------------------------------
+
+
+def quantize_kv_rows(rows):
+    """(..., H, Dh) float rows -> (int8 rows, scales over the leading
+    dims): one symmetric scale per row (amax over the trailing two
+    dims). The slab-side building block ``cache_append_quant`` and the
+    DecodeServer's prefill-scatter share."""
+    amax = jnp.max(jnp.abs(rows), axis=(-2, -1))
+    scale = jnp.maximum(amax / Q_MAX, SCALE_EPS)
+    q = quantize_symmetric(rows, scale[..., None, None])
+    return q, scale.astype(jnp.float32)
+
+
+def cache_append_quant(cache, scales, new, pos):
+    """Quantized twin of ``cache_append``: ``new`` (B, 1, H, Dh) or
+    (B, H, Dh) float rows scatter into the int8 slab ``cache``
+    (B, S, H, Dh) at row ``pos[b]``, each row quantized against its own
+    fresh scale which lands in ``scales`` (B, S) at the same position.
+    Functional; donation updates both in place on device backends."""
+    b, s = cache.shape[0], cache.shape[1]
+    if new.ndim == cache.ndim:
+        if new.shape[1] != 1:
+            raise ValueError(
+                "cache_append_quant appends ONE row per sequence; New "
+                "has time dim %d" % new.shape[1])
+        new = new[:, 0]
+    pos = jnp.clip(pos.reshape(-1).astype(jnp.int32), 0, s - 1)
+    q, scale = quantize_kv_rows(new)
+    rows = jnp.arange(b)
+    return (cache.at[rows, pos].set(q),
+            scales.at[rows, pos].set(scale.astype(scales.dtype)))
+
+
+def dequantize_slab(cache, scales, dtype=jnp.float32):
+    """int8 slab (B, S, H, Dh) x per-(slot, position) scales (B, S) ->
+    float slab. One VPU multiply; XLA fuses it into the attention read."""
+    return (cache.astype(jnp.float32)
+            * scales[:, :, None, None]).astype(dtype)
+
+
+def decode_attention_quant(q, k_cache, k_scales, v_cache, v_scales,
+                           lengths, scale=None, block_s=512):
+    """Single-query attention against int8 K/V slabs: rows dequantize
+    against their per-(slot, position) scales, then the regular
+    ``decode_attention`` dispatch runs (Pallas on TPU, exact pure-lax
+    fallback on CPU) — numerics are exactly attention over the
+    dequantized slab."""
+    kf = dequantize_slab(k_cache, k_scales, q.dtype)
+    vf = dequantize_slab(v_cache, v_scales, q.dtype)
+    return decode_attention(q, kf, vf, lengths, scale=scale,
+                            block_s=block_s)
+
+
+@register_op("cache_append_quant")
+def _cache_append_quant_op(ctx):
+    """Inputs Cache (B, S, H, Dh) int8, Scales (B, S) float32, New
+    (B, 1, H, Dh) or (B, H, Dh) float, Pos (B,) int32 -> Out (updated
+    int8 slab), OutScales (updated scales)."""
+    out, out_scales = cache_append_quant(
+        ctx.input("Cache"), ctx.input("Scales"), ctx.input("New"),
+        ctx.input("Pos"))
+    return {"Out": out, "OutScales": out_scales}
+
+
+@register_op("decode_attention_quant")
+def _decode_attention_quant_op(ctx):
+    """Inputs Q (B, 1, H, Dh) float, KCache/VCache (B, S, H, Dh) int8,
+    KScales/VScales (B, S) float32, Lengths (B,) -> Out like Q; attrs
+    scale, block_s (the decode_attention contract)."""
+    return {"Out": decode_attention_quant(
+        ctx.input("Q"), ctx.input("KCache"), ctx.input("KScales"),
+        ctx.input("VCache"), ctx.input("VScales"), ctx.input("Lengths"),
+        scale=ctx.attr("scale", None),
+        block_s=int(ctx.attr("block_s", 512)))}
+
+
+__all__ = [
+    "Q_MAX", "SCALE_EPS", "quantize_symmetric", "scale_for_amax",
+    "weight_scales_2d", "quantize_weight_2d", "quantize_conv_filter",
+    "quantize_kv_rows", "cache_append_quant", "dequantize_slab",
+    "decode_attention_quant",
+]

@@ -1,0 +1,228 @@
+"""Calibration: collect the ranges int8 quantization scales come from.
+
+Post-training quantization needs two kinds of ranges: per-channel
+weight amax (static — read straight from the Scope) and per-tensor
+activation amax (dynamic — observed by streaming a representative
+sample through the program). ``calibrate`` runs the inference program
+batch by batch over any feed source (a DataLoader, a reader, a list of
+feed dicts), fetching exactly the activation tensors the quantize pass
+will need and folding their amax into a running table; each batch also
+ticks ``paddle_tpu_quant_calib_batches_total`` so a calibration job is
+observable like any other run.
+
+The product is a :class:`CalibrationTable` — a small, JSON-serializable
+artifact that can be saved next to the model and replayed into
+``save_inference_model(quantize=table)`` or
+``optimize_program(level=3, calib=table)`` later, on another host.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import observability as obs
+from ..ops.quant import Q_MAX, scale_for_amax
+
+__all__ = ["CalibrationTable", "activation_targets", "calibrate",
+           "quantizable_targets"]
+
+# op types the quantize pass rewrites, and where their activation /
+# weight live (input slot names)
+_QUANT_OPS: Dict[str, Tuple[str, str]] = {
+    "mul": ("X", "Y"),
+    "matmul": ("X", "Y"),
+    "fused_fc": ("X", "Y"),
+    "conv2d": ("Input", "Filter"),
+}
+
+
+class CalibrationTable:
+    """Serializable amax ranges: ``activations`` maps var name ->
+    per-tensor amax; ``weights`` maps param name -> per-output-channel
+    amax list (flattened output span for fc weights, O for conv
+    filters). ``batches`` records how many sample batches produced the
+    activation ranges."""
+
+    VERSION = 1
+
+    def __init__(self, activations: Optional[Dict[str, float]] = None,
+                 weights: Optional[Dict[str, List[float]]] = None,
+                 batches: int = 0):
+        self.activations = dict(activations or {})
+        self.weights = {k: list(map(float, v))
+                        for k, v in (weights or {}).items()}
+        self.batches = int(batches)
+
+    # -- range folding ----------------------------------------------------
+    def observe_activation(self, name: str, value) -> None:
+        amax = float(np.max(np.abs(np.asarray(value, np.float64))) or 0.0)
+        self.activations[name] = max(self.activations.get(name, 0.0), amax)
+
+    def scale_for(self, name: str) -> Optional[float]:
+        """Per-tensor symmetric scale for an activation, or None when
+        the name was never observed (the pass then skips that op).
+        Shares ops.quant.scale_for_amax so table-side and kernel-side
+        scale conventions can never diverge."""
+        amax = self.activations.get(name)
+        if amax is None:
+            return None
+        return float(scale_for_amax(amax))
+
+    # -- serialization ----------------------------------------------------
+    def to_dict(self) -> Dict:
+        return {"version": self.VERSION, "batches": self.batches,
+                "activations": {k: float(v)
+                                for k, v in sorted(self.activations.items())},
+                "weights": {k: list(map(float, v))
+                            for k, v in sorted(self.weights.items())}}
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "CalibrationTable":
+        return cls(activations=d.get("activations"),
+                   weights=d.get("weights"),
+                   batches=d.get("batches", 0))
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=2, sort_keys=True)
+
+    @classmethod
+    def load(cls, path: str) -> "CalibrationTable":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    def __repr__(self):
+        return ("CalibrationTable(activations=%d, weights=%d, batches=%d)"
+                % (len(self.activations), len(self.weights), self.batches))
+
+
+def quantizable_targets(program):
+    """Walk the global block for quantizable ops: returns
+    ``[(op, activation_name, weight_name)]`` for every
+    mul/matmul/fused_fc/conv2d whose weight input names a persistable
+    var (training-graph ops whose "weight" is itself an activation are
+    skipped here and by the pass alike)."""
+    gb = program.global_block()
+    out = []
+    for op in gb.ops:
+        slots = _QUANT_OPS.get(op.type)
+        if slots is None:
+            continue
+        a_slot, w_slot = slots
+        a_in, w_in = op.input(a_slot), op.input(w_slot)
+        if not a_in or not w_in:
+            continue
+        wvar = gb._find_var_recursive(w_in[0])
+        if wvar is None or not wvar.persistable:
+            continue
+        out.append((op, a_in[0], w_in[0]))
+    return out
+
+
+def activation_targets(program) -> List[str]:
+    """The activation var names ``calibrate`` observes for ``program``
+    (deduped, first-seen order) — what a synthetic table must cover."""
+    seen, names = set(), []
+    for _op, a_name, _w in quantizable_targets(program):
+        if a_name not in seen:
+            seen.add(a_name)
+            names.append(a_name)
+    return names
+
+
+def _as_feed_dict(batch, feed_names: Sequence[str]) -> Dict:
+    if isinstance(batch, dict):
+        return batch
+    if isinstance(batch, (list, tuple)):
+        if len(batch) != len(feed_names):
+            raise ValueError(
+                "calibration batch has %d slots; program expects %d "
+                "feeds %s" % (len(batch), len(feed_names),
+                              list(feed_names)))
+        return dict(zip(feed_names, batch))
+    raise TypeError(
+        "calibration batches must be dicts or per-feed tuples, got %s"
+        % type(batch).__name__)
+
+
+def calibrate(program, scope, feed_names: Sequence[str],
+              sample_source: Iterable, max_batches: int = 8,
+              place=None) -> CalibrationTable:
+    """Stream ``max_batches`` batches from ``sample_source`` (a
+    DataLoader, reader, or any iterable of feed dicts / per-feed
+    tuples) through ``program`` and collect the quantization ranges.
+
+    The program should be the INFERENCE form that will be quantized
+    (``clone(for_test=True)`` / the ``save_inference_model`` pruned
+    graph) so activation names line up with what the quantize pass
+    sees. Only the slice of the program feeding the quantizable
+    activations actually runs (a loss cone still hanging off a
+    ``clone(for_test=True)`` is pruned away, so label-style feeds its
+    ops would need are not required — extra keys in the batches are
+    ignored). Weight amax is read from ``scope`` per output channel;
+    activation amax is per tensor, folded max-wise across batches."""
+    from .. import scope_guard
+    from ..executor import Executor
+    from ..io import _prune_for_targets
+    from ..ops.quant import quantize_conv_filter, weight_scales_2d
+
+    targets = quantizable_targets(program)
+    table = CalibrationTable()
+    if not targets:
+        return table
+    act_names = activation_targets(program)
+    # activations that ARE feeds range directly off the sample batches;
+    # the rest come from running ONLY the backward slice that produces
+    # them — the quantizable cone never needs the label-style feeds a
+    # training clone's loss ops would demand
+    feed_set = set(feed_names)
+    feed_acts = [n for n in act_names if n in feed_set]
+    computed_acts = [n for n in act_names if n not in feed_set]
+    sliced = (_prune_for_targets(program, computed_acts)
+              if computed_acts else None)
+    used_feeds = set(feed_acts)
+    if sliced is not None:
+        for op in sliced.global_block().ops:
+            used_feeds.update(n for n in op.input_arg_names
+                              if n in feed_set)
+
+    exe = Executor(place, opt_level=0)
+    exe._disk.enabled = False  # calibration never pollutes the AOT cache
+    with scope_guard(scope):
+        for batch in itertools.islice(iter(sample_source), max_batches):
+            feed = _as_feed_dict(batch, feed_names)
+            feed = {k: v for k, v in feed.items() if k in used_feeds}
+            for name in feed_acts:
+                table.observe_activation(name, feed[name])
+            if sliced is not None:
+                outs = exe.run(sliced, feed=feed,
+                               fetch_list=list(computed_acts))
+                for name, val in zip(computed_acts, outs):
+                    table.observe_activation(name, val)
+            table.batches += 1
+            obs.QUANT_CALIB_BATCHES.inc()
+    if table.batches == 0:
+        raise ValueError("calibration source yielded no batches")
+
+    # weight ranges: static, per output channel, straight from the scope
+    import math as _math
+
+    for op, _a, w_name in targets:
+        if w_name in table.weights:
+            continue
+        val = scope.find_var(w_name)
+        if val is None:
+            continue  # uninitialized param: the pass will skip this op
+        w = np.asarray(val)
+        if op.type == "conv2d":
+            _q, s = quantize_conv_filter(w)
+            amax = s * Q_MAX
+        else:
+            ync = int(op.attr("y_num_col_dims", 1))
+            w2 = w.reshape((_math.prod(w.shape[:ync]), -1))
+            amax = weight_scales_2d(w2) * Q_MAX
+        table.weights[w_name] = [float(v) for v in np.asarray(amax)]
+    return table

@@ -56,7 +56,7 @@ from ..runtime import aot_cache as _aot
 from ..runtime import recordio as _rio
 
 __all__ = ["DecodeConfig", "save_decode_model", "DecodePredictor",
-           "DecodeServer"]
+           "DecodeServer", "kv_slab_slots"]
 
 _DECODE_MANIFEST = "__decode__.json"
 _AOT_DIR = "__aot_cache__"
@@ -67,6 +67,35 @@ def _pow2_bucket(n: int, floor: int = 1) -> int:
     while b < n:
         b *= 2
     return b
+
+
+# bytes per slab element by kv dtype (int8 additionally pays a float32
+# scale PER (slot, position) — 4 bytes per seq position per K/V slab)
+_KV_ITEMSIZE = {"float32": 4, "bfloat16": 2, "int8": 1}
+
+
+def kv_slab_slots(budget_bytes: int, config: "DecodeConfig", seq: int,
+                  kv_dtype: str = "float32") -> int:
+    """How many cache slots one KV slab byte budget holds at ``seq``
+    positions — the continuous-batching capacity arithmetic behind the
+    int8 slab: per slot, 2*n_layer slabs of seq*n_head*d_head elements
+    (plus the per-position scales when int8). int8 rows cost 1 byte +
+    4/(n_head*d_head) of scale vs bf16's 2 — at realistic head widths
+    one budget holds ~2x the sequences."""
+    if kv_dtype not in _KV_ITEMSIZE:
+        raise ValueError("kv_dtype must be one of %s, got %r"
+                         % (sorted(_KV_ITEMSIZE), kv_dtype))
+    per_pos = config.n_head * config.d_head * _KV_ITEMSIZE[kv_dtype]
+    if kv_dtype == "int8":
+        per_pos += 4  # the (slot, position) float32 scale
+    per_slot = 2 * config.n_layer * int(seq) * per_pos
+    return max(int(budget_bytes) // per_slot, 0)
+
+
+def _kv_dtype_from_env() -> str:
+    """PADDLE_TPU_QUANT=kv8|int8 opts DecodeServer slabs into int8."""
+    raw = (os.environ.get("PADDLE_TPU_QUANT") or "").strip().lower()
+    return "int8" if raw in ("kv8", "int8") else "float32"
 
 
 class DecodeConfig:
@@ -206,11 +235,17 @@ class DecodePredictor:
         return obs.program_fp(self._program)
 
     # -- graph building ---------------------------------------------------
-    def _build(self, kind: str, batch: int, seq: int, strategy: str):
+    def _build(self, kind: str, batch: int, seq: int, strategy: str,
+               kv_dtype: str = "float32"):
         """Build the (batch, seq) prefill or decode Program; returns
         (program, feed_names, fetch_names). Deterministic for given
         arguments, so the program content fingerprint (and with it the
-        AOT key) is stable across processes."""
+        AOT key) is stable across processes. ``kv_dtype="int8"`` builds
+        the quantized-slab decode step: int8 cache feeds plus per-layer
+        (batch, seq) ``kscale_i``/``vscale_i`` scale feeds, with each
+        layer's updated (cache, cache, scales, scales) fetched back —
+        the slab bytes halve vs bf16, so one slab budget holds 2x the
+        sequences (ops/quant.py)."""
         from .. import Program, layers, program_guard, unique_name
         from ..models import transformer as _T
 
@@ -248,16 +283,27 @@ class DecodePredictor:
                     seed = layers.data(name="seed", shape=[1],
                                        dtype="int64",
                                        append_batch_size=False)
-                    kc, vc = [], []
+                    cache_dt = ("int8" if kv_dtype == "int8"
+                                else "float32")
+                    kc, vc, ks, vs = [], [], [], []
                     for i in range(cfg.n_layer):
                         kc.append(layers.data(
                             name="kcache_%d" % i,
                             shape=[batch, seq, cfg.n_head, cfg.d_head],
-                            dtype="float32", append_batch_size=False))
+                            dtype=cache_dt, append_batch_size=False))
                         vc.append(layers.data(
                             name="vcache_%d" % i,
                             shape=[batch, seq, cfg.n_head, cfg.d_head],
-                            dtype="float32", append_batch_size=False))
+                            dtype=cache_dt, append_batch_size=False))
+                        if kv_dtype == "int8":
+                            ks.append(layers.data(
+                                name="kscale_%d" % i, shape=[batch, seq],
+                                dtype="float32",
+                                append_batch_size=False))
+                            vs.append(layers.data(
+                                name="vscale_%d" % i, shape=[batch, seq],
+                                dtype="float32",
+                                append_batch_size=False))
                     next_ids, logits, ncaches = _T.transformer_lm_decode(
                         tokens, positions, lengths, kc, vc, cfg.vocab_size,
                         n_layer=cfg.n_layer, n_head=cfg.n_head,
@@ -266,12 +312,15 @@ class DecodePredictor:
                         tie_embeddings=cfg.tie_embeddings,
                         prefix=cfg.prefix, strategy=strategy, seed=seed,
                         sample_k=self.sample_k, sample_p=self.sample_p,
-                        temperature=self.temperature)
+                        temperature=self.temperature,
+                        k_scales=ks or None, v_scales=vs or None)
                     feeds = (["tokens", "positions", "lengths", "seed"]
                              + [v.name for v in kc]
-                             + [v.name for v in vc])
+                             + [v.name for v in vc]
+                             + [v.name for v in ks]
+                             + [v.name for v in vs])
                     fetches = [logits.name] + [
-                        c.name for pair in ncaches for c in pair]
+                        c.name for tup in ncaches for c in tup]
                     if next_ids is not None:
                         fetches = [next_ids.name] + fetches
         return prog, feeds, fetches
@@ -288,12 +337,19 @@ class DecodePredictor:
         return structs
 
     def acquire(self, kind: str, batch: int, seq: int,
-                strategy: Optional[str] = None):
-        """Executable for one (kind, batch, seq, strategy) signature:
-        memory hit, else the shared Engine's disk-load-or-compile path.
-        Returns (executable, fetch_names)."""
+                strategy: Optional[str] = None,
+                kv_dtype: str = "float32"):
+        """Executable for one (kind, batch, seq, strategy, kv_dtype)
+        signature: memory hit, else the shared Engine's
+        disk-load-or-compile path. Returns (executable, fetch_names).
+        ``kv_dtype`` only shapes decode steps (int8 slabs + scale
+        feeds); prefill always emits float slabs the caller quantizes
+        at scatter time."""
         strategy = strategy or self.strategy
-        ck = (kind, batch, seq, strategy if kind == "decode" else "")
+        if kind != "decode":
+            kv_dtype = "float32"
+        ck = (kind, batch, seq, strategy if kind == "decode" else "",
+              kv_dtype)
         with self._lock:
             hit = self._compiled.get(ck)
         if hit is not None:
@@ -304,7 +360,7 @@ class DecodePredictor:
         from ..framework.trace import RngStream, trace_block
 
         program, feed_names, fetch_names = self._build(
-            kind, batch, seq, strategy)
+            kind, batch, seq, strategy, kv_dtype=kv_dtype)
         engine = Engine(program, disk=self._disk, feed_names=feed_names,
                         fetch_names=fetch_names)
         feed_structs = self._feed_structs(program, feed_names)
@@ -591,13 +647,24 @@ class DecodeServer:
                  max_seq: Optional[int] = None, max_new_tokens: int = 32,
                  strategy: Optional[str] = None, capacity: int = 256,
                  eos_id: Optional[int] = None, continuous: bool = True,
-                 prewarm: bool = True):
+                 prewarm: bool = True, kv_dtype: Optional[str] = None):
         from ..runtime.recordio import Channel
 
         if slots < 1:
             raise ValueError("slots must be >= 1, got %d" % slots)
         self.predictor = predictor
         self.slots = int(slots)
+        # int8 KV slabs (opt-in; PADDLE_TPU_QUANT=kv8 is the env knob):
+        # rows quantize at append against per-(slot, position) scales
+        # and dequantize on attention read — slab bytes drop 2x vs bf16
+        # (4x vs these float32 slabs), so one slab budget holds 2x the
+        # sequences (kv_slab_slots has the arithmetic)
+        self.kv_dtype = kv_dtype if kv_dtype is not None \
+            else _kv_dtype_from_env()
+        if self.kv_dtype not in ("float32", "int8"):
+            raise ValueError(
+                "kv_dtype must be 'float32' or 'int8', got %r"
+                % (self.kv_dtype,))
         cfg = predictor.config
         want = max_seq or cfg.max_len
         self.seq = min(_pow2_bucket(want, floor=16),
@@ -629,6 +696,17 @@ class DecodeServer:
 
         self.step_active_counts: "collections.deque" = collections.deque(
             maxlen=100_000)
+        # cache feed names in the SAME per-layer order the decode
+        # graph's fetch list flattens its updated tensors: (k, v) per
+        # layer, plus (kscale, vscale) when the slab is int8 — so
+        # zip(self._cache_feed_names, outs[2:]) rethreads each step
+        names = []
+        for i in range(cfg.n_layer):
+            names += ["kcache_%d" % i, "vcache_%d" % i]
+            if self.kv_dtype == "int8":
+                names += ["kscale_%d" % i, "vscale_%d" % i]
+        self._cache_feed_names = names
+        self._cache_per_layer = 4 if self.kv_dtype == "int8" else 2
 
     # -- submission (PredictorServer-compatible surface) -------------------
     def submit(self, sample: Sequence[np.ndarray]):
@@ -690,7 +768,7 @@ class DecodeServer:
             # own bucket on first arrival)
             t0 = time.perf_counter()
             self.predictor.acquire("decode", self.slots, self.seq,
-                                   self.strategy)
+                                   self.strategy, kv_dtype=self.kv_dtype)
             sp = min(16, self.seq)
             self.predictor.acquire("prefill", 1, sp)
             if self.slots > 1:
@@ -810,11 +888,29 @@ class DecodeServer:
                 first[i] = self.predictor._sample_host(
                     outs[0][i:i + 1], self.strategy, seed)[0]
         slot_idx = jnp.asarray(np.array(free[:n], np.int32))
-        sub = list(outs[1:])
+        sub = list(outs[1:])  # (k, v) float sub-slabs per layer
         # scatter the (n, sp, H, Dh) prefill rows into the slab's first
         # sp positions; rows past sp keep old garbage, masked by length
-        caches = [c.at[slot_idx, :sp].set(jnp.asarray(s)[:n])
-                  for c, s in zip(caches, sub)]
+        if self.kv_dtype == "int8":
+            # prefill emits float rows; quantize per (slot, position)
+            # at scatter time — the same row-scale scheme the in-graph
+            # cache_append_quant applies to decoded rows
+            from ..ops.quant import quantize_kv_rows
+
+            per = self._cache_per_layer
+            caches = list(caches)
+            for li in range(len(sub) // 2):
+                for j in (0, 1):  # K then V
+                    rows = jnp.asarray(sub[2 * li + j])[:n]
+                    q, sc = quantize_kv_rows(rows)
+                    caches[per * li + j] = (
+                        caches[per * li + j].at[slot_idx, :sp].set(q))
+                    caches[per * li + 2 + j] = (
+                        caches[per * li + 2 + j].at[slot_idx, :sp]
+                        .set(sc))
+        else:
+            caches = [c.at[slot_idx, :sp].set(jnp.asarray(s)[:n])
+                      for c, s in zip(caches, sub)]
         for i, (rid, prompt, max_new, seed) in enumerate(batch):
             slot = free[i]
             tok = int(first[i])
@@ -831,16 +927,30 @@ class DecodeServer:
                 lens[slot] = 0
         return caches
 
-    def _loop(self):
+    def _fresh_slabs(self):
+        """Zeroed cache arrays in ``self._cache_feed_names`` order."""
         cfg = self.predictor.config
         shape = (self.slots, self.seq, cfg.n_head, cfg.d_head)
-        caches = [jnp.zeros(shape, jnp.float32)
-                  for _ in range(2 * cfg.n_layer)]
+        dt = jnp.int8 if self.kv_dtype == "int8" else jnp.float32
+        arrs = []
+        for _ in range(cfg.n_layer):
+            arrs.append(jnp.zeros(shape, dt))
+            arrs.append(jnp.zeros(shape, dt))
+            if self.kv_dtype == "int8":
+                arrs.append(jnp.zeros((self.slots, self.seq),
+                                      jnp.float32))
+                arrs.append(jnp.zeros((self.slots, self.seq),
+                                      jnp.float32))
+        return arrs
+
+    def _loop(self):
+        caches = self._fresh_slabs()
         lens = np.zeros((self.slots,), np.int32)
         active: List[Optional[dict]] = [None] * self.slots
         pending: List[tuple] = []
         dexe, _ = self.predictor.acquire("decode", self.slots, self.seq,
-                                         self.strategy)
+                                         self.strategy,
+                                         kv_dtype=self.kv_dtype)
         closed = False
         while True:
             n_active = sum(1 for a in active if a is not None)
@@ -898,9 +1008,7 @@ class DecodeServer:
                      "lengths": lens.copy(),
                      "seed": np.array([self._seed_ctr], np.int64)}
             self._seed_ctr += 1
-            for i in range(cfg.n_layer):
-                feeds["kcache_%d" % i] = caches[2 * i]
-                feeds["vcache_%d" % i] = caches[2 * i + 1]
+            feeds.update(zip(self._cache_feed_names, caches))
             try:
                 t0 = time.perf_counter()
                 outs = dexe(feeds, self.predictor._state)
@@ -921,8 +1029,7 @@ class DecodeServer:
                 # (donate_argnums on device backends) — reusing them
                 # next iteration would poison every future step.
                 # Lengths are all 0 now, so fresh zeros are correct.
-                caches = [jnp.zeros(shape, jnp.float32)
-                          for _ in range(2 * cfg.n_layer)]
+                caches = self._fresh_slabs()
                 self._set_slot_gauges(0)
                 continue
             obs.DECODE_STEP_MS.observe((time.perf_counter() - t0) * 1e3,

@@ -184,9 +184,29 @@ class Engine:
         return self.disk.key(self.key_fields(kind, feed_sig, fetch_names,
                                              *extra))
 
+    def tier(self) -> str:
+        """Transpile/quantization tier of this engine's program, from
+        its stamps: "int8" (quantize stamp — serialized, so exported
+        int8 models keep it), "O<level>" (the in-process marker
+        optimize_program leaves on its clones), "O2" (a deserialized
+        bucketize-stamped export), else "raw". Best-effort: an O1
+        export carries no stamp and reloads as "raw"."""
+        p = self.program
+        if getattr(p, "_quantized", None):
+            return "int8"
+        lvl = getattr(p, "_opt_level", None)
+        if lvl:
+            return "O%d" % int(lvl)
+        if getattr(p, "_bucketize", None):
+            return "O2"
+        return "raw"
+
     def meta(self, kind: str, feed_sig, fetch_names) -> Dict:
-        """Sidecar metadata for preload scans and aot_cache_ls."""
+        """Sidecar metadata for preload scans and aot_cache_ls: the
+        ``tier`` field is what distinguishes coexisting raw, optimized,
+        and quantized executables of one model in the cache listing."""
         return {"kind": kind, "program": self.fingerprint(),
+                "tier": self.tier(),
                 "feed_sig": feed_sig, "fetch_names": tuple(fetch_names),
                 "env": _aot.env_fingerprint(), "created": time.time()}
 

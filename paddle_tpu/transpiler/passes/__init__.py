@@ -14,13 +14,19 @@ executables coexist).
 Passes (manager.py has the level/parity contract):
 level 1 — constant_fold, cse, fuse_fc, fuse_elemwise_act, dce (bit-exact);
 level 2 — + conv_bn_fold (tolerance-parity), bucketize (pow2 feed
-buckets, bit-exact on the real rows).
+buckets, bit-exact on the real rows);
+level 3 — + quantize (int8 post-training quantization; only rewrites
+when ``optimize_program(..., calib=CalibrationTable)`` supplies
+calibration ranges — see paddle_tpu/quant/).
 """
 from .manager import (  # noqa: F401
     PASSES, PassContext, PassManager, RNG_IDX_ATTR, opt_level_from_env,
     optimize_program, register_pass,
 )
-from . import fold, cse, dce, fusion, bucketize  # noqa: F401 — register
+# registration order = pass order within a manager round: quantize runs
+# after the fusion passes (fc chains arrive fused) and before bucketize
+# (the stamp must prove row-wise THROUGH quantized_matmul)
+from . import fold, cse, dce, fusion, quantize, bucketize  # noqa: F401 — register
 from .bucketize import next_pow2  # noqa: F401
 from .fusion import fold_conv_bn  # noqa: F401
 

@@ -139,7 +139,11 @@ def _carrying_outputs(ctx, op, carrying: Set[str]) -> Optional[Set[str]]:
         return set(op.output("Y"))
     if t == "layer_norm":
         return outs if int(op.attr("begin_norm_axis", 1)) >= 1 else None
-    if t in ("mul", "fused_fc"):
+    if t in ("mul", "fused_fc", "quantized_matmul"):
+        # quantized_matmul is row-wise exactly like fused_fc: the
+        # per-tensor activation scale is an attr (pad rows quantize to
+        # zero codes, contributing nothing), the int8 weight/bias are
+        # batch-free state
         if op.input("Y")[0] in carrying or (
                 op.input("Bias") and op.input("Bias")[0] in carrying):
             return None
@@ -147,7 +151,7 @@ def _carrying_outputs(ctx, op, carrying: Set[str]) -> Optional[Set[str]]:
             return None
         if int(op.attr("x_num_col_dims", 1)) < 1:
             return None
-        if t == "fused_fc" and op.input("Bias"):
+        if t in ("fused_fc", "quantized_matmul") and op.input("Bias"):
             # bias span must not touch the (growing) batch axis
             out_s = ctx.inference.shape(op.output("Out")[0])
             b_s = ctx.inference.shape(op.input("Bias")[0])
@@ -161,7 +165,8 @@ def _carrying_outputs(ctx, op, carrying: Set[str]) -> Optional[Set[str]]:
                     return None
             elif not (len(b_s) == len(out_s) and b_s[0] == 1):
                 return None
-        if t == "fused_fc" and op.attr("kind", "mul") == "matmul":
+        if t in ("fused_fc", "quantized_matmul") \
+                and op.attr("kind", "mul") == "matmul":
             # the fusion pass only emits non-transposed matmuls, where
             # axis 0 stays the row axis at any known rank
             if _rank(ctx, op.input("X")[0]) is None:

@@ -35,7 +35,12 @@ Levels (``PADDLE_TPU_OPT`` / explicit API):
   elementwise+activation fusion, dead-op/dead-var elimination;
 - 2: level 1 + conv+bn folding (inference graphs, tolerance-parity) and
   feed bucketization (stamps pow2-bucket metadata the Executor/Predictor
-  apply at the feed boundary).
+  apply at the feed boundary);
+- 3: level 2 + int8 post-training quantization (transpiler/passes/
+  quantize.py) — only rewrites anything when the context carries a
+  ``quant.CalibrationTable`` (``optimize_program(..., calib=table)`` /
+  ``save_inference_model(quantize=table)``); the env knob alone never
+  changes numerics.
 """
 from __future__ import annotations
 
@@ -64,7 +69,7 @@ PLUMBING_OPS = {"feed", "fetch", "read"}
 
 
 def opt_level_from_env(default: int = 0) -> int:
-    """PADDLE_TPU_OPT=0|1|2 (malformed values fall back, never crash)."""
+    """PADDLE_TPU_OPT=0|1|2|3 (malformed values fall back, never crash)."""
     raw = os.environ.get("PADDLE_TPU_OPT")
     if raw is None:
         return default
@@ -72,7 +77,7 @@ def opt_level_from_env(default: int = 0) -> int:
         lvl = int(raw)
     except ValueError:
         return default
-    return min(max(lvl, 0), 2)
+    return min(max(lvl, 0), 3)
 
 
 class _Pass:
@@ -112,12 +117,15 @@ class PassContext:
 
     def __init__(self, program: Program, scope: Optional[Scope],
                  feed_names: Sequence[str], fetch_names: Sequence[str],
-                 level: int):
+                 level: int, calib=None):
         self.program = program
         self.scope = scope
         self.feed_names = set(feed_names)
         self.fetch_names = list(fetch_names)
         self.level = level
+        # quant.CalibrationTable (or None): the level-3 quantize pass
+        # only rewrites when calibration ranges are present
+        self.calib = calib
         self.stats: Dict[str, Dict] = {}
         self.notes: List[str] = []
         self._inference = None
@@ -249,12 +257,12 @@ class PassManager:
 
     def run(self, program: Program, scope: Optional[Scope] = None,
             feed_names: Sequence[str] = (),
-            fetch_names: Sequence[str] = ()) -> PassContext:
+            fetch_names: Sequence[str] = (), calib=None) -> PassContext:
         """Mutates ``program`` in place; returns the PassContext with
         per-pass stats. Use :func:`optimize_program` for the cloning
         front door."""
         ctx = PassContext(program, scope, feed_names, fetch_names,
-                          self.level)
+                          self.level, calib=calib)
         if self.level <= 0 or not self.pass_names:
             return ctx
         stamp_rng_indices(program)
@@ -281,6 +289,7 @@ def optimize_program(program: Program, scope: Optional[Scope] = None,
                      level: int = 1, feed_names: Sequence[str] = (),
                      fetch_names: Sequence[str] = (),
                      passes: Optional[Sequence[str]] = None,
+                     calib=None,
                      ) -> Tuple[Program, PassContext]:
     """THE front door: returns an optimized CLONE of ``program`` (the
     original is untouched, so optimized and original executables coexist
@@ -293,11 +302,18 @@ def optimize_program(program: Program, scope: Optional[Scope] = None,
     scope values of unwritten persistables into the optimized program —
     re-optimize after mutating such state out-of-band (the same contract
     as the reference InferenceTranspiler).
+
+    ``calib`` (a ``quant.CalibrationTable``) arms the level-3 quantize
+    pass; without it level 3 behaves exactly like level 2.
     """
-    from . import fold, cse, fusion, dce, bucketize  # noqa: F401 — register
+    from . import fold, cse, fusion, dce, quantize, bucketize  # noqa: F401 — register
 
     optimized = program.clone()
     mgr = PassManager(level=level, passes=passes)
     ctx = mgr.run(optimized, scope=scope, feed_names=feed_names,
-                  fetch_names=fetch_names)
+                  fetch_names=fetch_names, calib=calib)
+    # tier marker for Engine.meta / tools/aot_cache_ls.py: which
+    # transpile tier produced this clone (process-local; the quantize
+    # and bucketize stamps additionally ride the serialized JSON)
+    optimized._opt_level = int(level)
     return optimized, ctx

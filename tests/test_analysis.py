@@ -95,8 +95,8 @@ def test_program_lint_cli_json_and_exit_code(capsys):
     import json
 
     doc = json.loads(out)
-    # mlp + deepfm + lstm + the PR-9 decode step
-    assert len(doc["programs"]) == 4
+    # mlp + deepfm + lstm + the PR-9 decode step + the int8 quant example
+    assert len(doc["programs"]) == 5
     for p in doc["programs"]:
         assert p["counts"]["error"] == 0
         assert p["infer_coverage"] == 1.0

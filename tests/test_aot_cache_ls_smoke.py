@@ -47,8 +47,9 @@ def _populate(cache_dir):
 # a deliberate, test-updating change
 _TOP_FIELDS = ("schema", "dir", "enabled", "max_bytes", "total_bytes",
                "entries")
-_ENTRY_FIELDS = ("key", "bytes", "mtime", "age_s", "kind", "program",
-                 "feed_sig", "fetch_names", "env", "created", "meta_v")
+_ENTRY_FIELDS = ("key", "bytes", "mtime", "age_s", "kind", "tier",
+                 "program", "feed_sig", "fetch_names", "env", "created",
+                 "meta_v")
 
 
 def test_snapshot_schema(tmp_path):
@@ -69,6 +70,8 @@ def test_snapshot_schema(tmp_path):
                 and e["feed_sig"])
     assert step["env"]["backend"] == "cpu"
     assert ["x", [2, 6], "float32"] in step["feed_sig"]
+    # unoptimized executor programs carry the raw tier marker
+    assert step["tier"] == "raw"
 
 
 def test_gc_and_rm_via_snapshot(tmp_path):

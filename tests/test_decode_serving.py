@@ -240,8 +240,8 @@ def test_server_survives_step_failure(model_dir):
     boom = {"armed": True}
     real_acquire = p.acquire
 
-    def flaky_acquire(kind, batch, seq, strategy=None):
-        exe, fetch = real_acquire(kind, batch, seq, strategy)
+    def flaky_acquire(kind, batch, seq, strategy=None, **kw):
+        exe, fetch = real_acquire(kind, batch, seq, strategy, **kw)
         if kind != "decode":
             return exe, fetch
 

@@ -687,6 +687,10 @@ COVERED_ELSEWHERE = {
     # parallel kernels: tests/test_moe.py, tests/test_ring_lm.py (and
     # ring-vs-full parity in tests/test_attention.py)
     "moe_ffn", "ring_attention",
+    # int8 quantization tier: tests/test_quant.py (integer-reference
+    # batteries) + tests/test_quant_decode.py (slab ops)
+    "quantize_linear", "dequantize_linear", "quantized_matmul",
+    "quantized_conv2d", "cache_append_quant", "decode_attention_quant",
 }
 
 # covered directly in this file

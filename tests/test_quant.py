@@ -1,0 +1,503 @@
+"""Int8 post-training quantization tier (paddle_tpu/quant/ + ops/quant.py
++ transpiler/passes/quantize.py): op numerics against explicit integer
+references, infer-rule coverage, calibration, the level-3 quantize pass,
+quantized export -> Predictor serving through the shared AOT cache, and
+the parity harness."""
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.ops.quant import (
+    Q_MAX, quantize_weight_2d, quantize_conv_filter)
+from paddle_tpu.quant import (
+    CalibrationTable, activation_targets, calibrate, parity_report)
+from paddle_tpu.transpiler.passes import optimize_program
+
+from op_test import check_infer, run_op
+
+
+def _np_quant(x, scale):
+    return np.clip(np.round(np.asarray(x, np.float64) / scale),
+                   -Q_MAX, Q_MAX).astype(np.int8)
+
+
+# ---------------------------------------------------------------------------
+# op numerics: the kernels against explicit integer math
+# ---------------------------------------------------------------------------
+
+
+def test_quantize_dequantize_linear_roundtrip():
+    rs = np.random.RandomState(0)
+    x = (rs.rand(4, 8).astype(np.float32) - 0.5) * 3
+    scale = float(np.abs(x).max() / Q_MAX)
+    q = run_op("quantize_linear", {"X": x}, {"scale": scale})["Out"]
+    assert np.asarray(q).dtype == np.int8
+    np.testing.assert_array_equal(np.asarray(q), _np_quant(x, scale))
+    d = run_op("dequantize_linear", {"X": np.asarray(q)},
+               {"scale": scale})["Out"]
+    # dequantized values are within half a quantization step
+    assert np.max(np.abs(np.asarray(d) - x)) <= scale * 0.5 + 1e-7
+
+
+def test_quantize_linear_per_channel_axis():
+    rs = np.random.RandomState(1)
+    x = rs.randn(5, 3).astype(np.float32)
+    scales = np.abs(x).max(axis=0) / Q_MAX
+    q = run_op("quantize_linear", {"X": x},
+               {"scale": scales.astype(np.float32), "axis": 1})["Out"]
+    np.testing.assert_array_equal(
+        np.asarray(q), _np_quant(x, scales[None, :]))
+
+
+def test_quantized_matmul_matches_integer_reference():
+    rs = np.random.RandomState(2)
+    x = rs.randn(6, 16).astype(np.float32)
+    w = rs.randn(16, 4).astype(np.float32)
+    bias = rs.randn(4).astype(np.float32)
+    wq, y_scale = quantize_weight_2d(w)
+    x_scale = float(np.abs(x).max() / Q_MAX)
+    got = run_op(
+        "quantized_matmul", {"X": x, "Y": wq, "Bias": bias},
+        {"kind": "mul", "x_num_col_dims": 1, "y_num_col_dims": 1,
+         "x_scale": x_scale, "y_scale": y_scale, "axis": -1,
+         "act": "relu"})["Out"]
+    xq = _np_quant(x, x_scale).astype(np.int64)
+    acc = xq @ wq.astype(np.int64)
+    ref = acc.astype(np.float64) * (y_scale.astype(np.float64) * x_scale)
+    ref = np.maximum(ref + bias, 0.0)
+    np.testing.assert_allclose(np.asarray(got, np.float64), ref,
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_quantized_matmul_x_num_col_dims_flatten():
+    rs = np.random.RandomState(3)
+    x = rs.randn(2, 3, 8).astype(np.float32)  # flattens to (6, 8)
+    w = rs.randn(8, 5).astype(np.float32)
+    wq, y_scale = quantize_weight_2d(w)
+    x_scale = float(np.abs(x).max() / Q_MAX)
+    got = run_op(
+        "quantized_matmul", {"X": x, "Y": wq},
+        {"kind": "mul", "x_num_col_dims": 2, "y_num_col_dims": 1,
+         "x_scale": x_scale, "y_scale": y_scale})["Out"]
+    assert np.asarray(got).shape == (2, 3, 5)
+    xq = _np_quant(x, x_scale).reshape(6, 8).astype(np.int64)
+    ref = (xq @ wq.astype(np.int64)).astype(np.float64) \
+        * (y_scale.astype(np.float64) * x_scale)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float64).reshape(6, 5), ref, rtol=1e-6,
+        atol=1e-6)
+
+
+def test_quantized_conv2d_matches_integer_reference():
+    from jax import lax
+
+    rs = np.random.RandomState(4)
+    x = rs.randn(2, 3, 8, 8).astype(np.float32)
+    w = rs.randn(4, 3, 3, 3).astype(np.float32)
+    wq, w_scale = quantize_conv_filter(w)
+    x_scale = float(np.abs(x).max() / Q_MAX)
+    got = run_op(
+        "quantized_conv2d", {"Input": x, "Filter": wq},
+        {"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
+         "groups": 1, "data_format": "NCHW", "x_scale": x_scale,
+         "w_scale": w_scale}, outs=("Output",))["Output"]
+    xq = _np_quant(x, x_scale)
+    acc = np.asarray(lax.conv_general_dilated(
+        xq.astype(np.float64), wq.astype(np.float64), (1, 1),
+        [(1, 1), (1, 1)], dimension_numbers=("NCHW", "OIHW", "NCHW")))
+    ref = acc * (w_scale.astype(np.float64) * x_scale)[None, :, None,
+                                                       None]
+    np.testing.assert_allclose(np.asarray(got, np.float64), ref,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_quant_op_infer_rules():
+    """check_infer: the analysis rules match the traced kernel shapes/
+    dtypes for every quant op (the 100%-coverage satellite)."""
+    rs = np.random.RandomState(5)
+    x = rs.randn(4, 8).astype(np.float32)
+    w = rs.randn(8, 3).astype(np.float32)
+    wq, y_scale = quantize_weight_2d(w)
+    check_infer("quantize_linear", {"X": x}, {"scale": 0.01})
+    check_infer("dequantize_linear", {"X": _np_quant(x, 0.01)},
+                {"scale": 0.01})
+    check_infer("quantized_matmul",
+                {"X": x, "Y": wq, "Bias": rs.randn(3).astype(np.float32)},
+                {"kind": "mul", "x_num_col_dims": 1, "y_num_col_dims": 1,
+                 "x_scale": 0.01, "y_scale": y_scale, "axis": -1})
+    cw, cs = quantize_conv_filter(rs.randn(4, 3, 3, 3).astype(np.float32))
+    check_infer("quantized_conv2d",
+                {"Input": rs.randn(2, 3, 8, 8).astype(np.float32),
+                 "Filter": cw},
+                {"strides": [1, 1], "paddings": [0, 0],
+                 "dilations": [1, 1], "groups": 1, "x_scale": 0.01,
+                 "w_scale": cs}, outs=("Output",))
+
+
+# ---------------------------------------------------------------------------
+# calibration
+# ---------------------------------------------------------------------------
+
+
+def _tiny_mlp(dim=16, hidden=8, classes=4):
+    scope = fluid.Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = layers.data(name="x", shape=[dim])
+            h = layers.fc(x, hidden, act="relu")
+            out = layers.fc(h, classes, act="softmax")
+        exe = fluid.Executor()
+        exe.run(startup)
+    return main.clone(for_test=True), scope, out.name
+
+
+def test_calibrate_collects_amax_and_serializes(tmp_path):
+    rs = np.random.RandomState(0)
+    infer, scope, out_name = _tiny_mlp()
+    feeds = [{"x": rs.rand(4, 16).astype(np.float32) * (i + 1)}
+             for i in range(3)]
+    table = calibrate(infer, scope, ["x"], feeds, max_batches=3)
+    assert table.batches == 3
+    # the feed itself is the first quantizable activation; its amax is
+    # the max over every calibration batch
+    want = max(float(np.abs(f["x"]).max()) for f in feeds)
+    assert table.activations["x"] == pytest.approx(want)
+    assert len(activation_targets(infer)) == 2  # feed + relu output
+    assert len(table.weights) == 2
+    path = str(tmp_path / "calib.json")
+    table.save(path)
+    loaded = CalibrationTable.load(path)
+    assert loaded.activations == pytest.approx(table.activations)
+    assert loaded.batches == 3
+
+
+def test_calibrate_accepts_tuple_batches_and_counts_metric():
+    from paddle_tpu import observability as obs
+
+    rs = np.random.RandomState(1)
+    infer, scope, _ = _tiny_mlp()
+    before = obs.QUANT_CALIB_BATCHES.value()
+    table = calibrate(infer, scope, ["x"],
+                      [(rs.rand(2, 16).astype(np.float32),)],
+                      max_batches=4)
+    assert table.batches == 1
+    assert obs.QUANT_CALIB_BATCHES.value() == before + 1
+
+
+# ---------------------------------------------------------------------------
+# the level-3 quantize pass
+# ---------------------------------------------------------------------------
+
+
+def test_quantize_pass_rewrites_fc_chains_and_stamps():
+    rs = np.random.RandomState(2)
+    infer, scope, out_name = _tiny_mlp()
+    feeds = [{"x": rs.rand(4, 16).astype(np.float32)}]
+    table = calibrate(infer, scope, ["x"], feeds, max_batches=1)
+    opt, ctx = optimize_program(infer, scope=scope, level=3,
+                                feed_names=["x"], fetch_names=[out_name],
+                                calib=table)
+    types = [o.type for o in opt.global_block().ops]
+    assert types.count("quantized_matmul") == 2
+    assert "mul" not in types and "fused_fc" not in types
+    assert getattr(opt, "_quantized", None) == {"ops": 2, "version": 1}
+    # the stamp rides the serialized program
+    p2 = fluid.Program.from_dict(json.loads(opt.to_json()))
+    assert getattr(p2, "_quantized", None) == {"ops": 2, "version": 1}
+    # float weight declarations are gone from the quantized CLONE,
+    # int8 twins are declared int8; the raw program is untouched
+    opt_vars = opt.global_block().vars
+    int8_vars = [n for n in opt_vars if n.endswith(".int8")]
+    assert len(int8_vars) == 2
+    for n in int8_vars:
+        assert opt_vars[n].dtype == "int8"
+        assert n[:-len(".int8")] not in opt_vars
+        assert n[:-len(".int8")] in infer.global_block().vars
+    # bucketize still proves row-wise THROUGH quantized_matmul
+    assert getattr(opt, "_bucketize", None)
+    # quantized programs keep full infer coverage (lint satellite)
+    from paddle_tpu.analysis import analyze_program
+
+    rep = analyze_program(opt, feed_names=["x"],
+                          fetch_names=[out_name]).report
+    assert rep.coverage == 1.0
+    assert not rep.errors
+
+
+def test_quantize_pass_outputs_close_to_float():
+    rs = np.random.RandomState(3)
+    infer, scope, out_name = _tiny_mlp()
+    feeds = [{"x": rs.rand(8, 16).astype(np.float32)}
+             for _ in range(2)]
+    table = calibrate(infer, scope, ["x"], feeds, max_batches=2)
+    opt, _ = optimize_program(infer, scope=scope, level=3,
+                              feed_names=["x"], fetch_names=[out_name],
+                              calib=table)
+    exe = fluid.Executor(opt_level=0)
+    exe._disk.enabled = False
+    with fluid.scope_guard(scope):
+        raw = exe.run(infer, feed=feeds[0], fetch_list=[out_name])
+        qnt = exe.run(opt, feed=feeds[0], fetch_list=[out_name])
+    diff = np.max(np.abs(np.asarray(raw[0], np.float64)
+                         - np.asarray(qnt[0], np.float64)))
+    assert diff < 0.05  # softmax probs drift stays in the int8 class
+    assert np.array_equal(np.argmax(raw[0], -1), np.argmax(qnt[0], -1))
+
+
+def test_level3_without_calib_behaves_like_level2():
+    infer, scope, out_name = _tiny_mlp()
+    o3, ctx3 = optimize_program(infer, scope=scope, level=3,
+                                feed_names=["x"], fetch_names=[out_name])
+    assert not any(o.type.startswith("quantized") for o in
+                   o3.global_block().ops)
+    assert getattr(o3, "_quantized", None) is None
+    assert "quantize" not in {k for k, v in ctx3.stats.items()
+                              if v.get("applied")}
+
+
+def test_quantize_pass_skips_amp_programs():
+    rs = np.random.RandomState(4)
+    infer, scope, out_name = _tiny_mlp()
+    feeds = [{"x": rs.rand(2, 16).astype(np.float32)}]
+    table = calibrate(infer, scope, ["x"], feeds, max_batches=1)
+    infer.enable_mixed_precision(True)
+    opt, _ = optimize_program(infer, scope=scope, level=3,
+                              feed_names=["x"], fetch_names=[out_name],
+                              calib=table)
+    assert not any(o.type.startswith("quantized") for o in
+                   opt.global_block().ops)
+
+
+def test_quantize_pass_conv2d():
+    rs = np.random.RandomState(5)
+    scope = fluid.Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            img = layers.data(name="img", shape=[3, 8, 8])
+            conv = layers.conv2d(img, num_filters=4, filter_size=3,
+                                 act="relu")
+            out = layers.fc(conv, 4, act="softmax")
+        exe = fluid.Executor()
+        exe.run(startup)
+    infer = main.clone(for_test=True)
+    feeds = [{"img": rs.rand(2, 3, 8, 8).astype(np.float32)}]
+    table = calibrate(infer, scope, ["img"], feeds, max_batches=1)
+    opt, _ = optimize_program(infer, scope=scope, level=3,
+                              feed_names=["img"],
+                              fetch_names=[out.name], calib=table)
+    types = [o.type for o in opt.global_block().ops]
+    assert "quantized_conv2d" in types
+    assert "conv2d" not in types
+    exe2 = fluid.Executor(opt_level=0)
+    exe2._disk.enabled = False
+    with fluid.scope_guard(scope):
+        raw = exe2.run(infer, feed=feeds[0], fetch_list=[out.name])
+        qnt = exe2.run(opt, feed=feeds[0], fetch_list=[out.name])
+    assert np.max(np.abs(np.asarray(raw[0], np.float64)
+                         - np.asarray(qnt[0], np.float64))) < 0.1
+
+
+# ---------------------------------------------------------------------------
+# export -> Predictor -> AOT cache -> parity (the serving acceptance)
+# ---------------------------------------------------------------------------
+
+
+def _export_pair(tmp_path, rs):
+    from paddle_tpu.inference import Predictor
+
+    infer, scope, out_name = _tiny_mlp()
+    feeds = [{"x": rs.rand(8, 16).astype(np.float32)}
+             for _ in range(3)]
+    table = calibrate(infer, scope, ["x"], feeds, max_batches=3)
+    raw_dir = str(tmp_path / "raw")
+    q_dir = str(tmp_path / "quant")
+    exe = fluid.Executor()
+    with fluid.scope_guard(scope):
+        fluid.io.save_inference_model(raw_dir, ["x"], [out_name], exe,
+                                      main_program=infer, scope=scope)
+        fluid.io.save_inference_model(q_dir, ["x"], [out_name], exe,
+                                      main_program=infer, scope=scope,
+                                      quantize=table)
+    return raw_dir, q_dir, feeds, Predictor
+
+
+def test_quantized_export_serves_and_warm_process_compiles_nothing(
+        tmp_path):
+    rs = np.random.RandomState(6)
+    raw_dir, q_dir, feeds, Predictor = _export_pair(tmp_path, rs)
+    # the exported params are the int8 twins, floats dropped
+    with np.load(os.path.join(q_dir, "__params__.npz")) as npz:
+        dtypes = {k: str(npz[k].dtype) for k in npz.files}
+    assert sorted(v for k, v in dtypes.items() if k.endswith(".int8")) \
+        == ["int8", "int8"]
+    assert not any(k.endswith(".w_0") for k in dtypes)
+    p1 = Predictor(q_dir)
+    out1 = p1.run(feeds[0])
+    assert p1.traces == 1
+    # a warm Predictor on the same dir deserializes from the model-local
+    # AOT cache: ZERO traces, identical outputs
+    p2 = Predictor(q_dir)
+    out2 = p2.run(feeds[0])
+    assert p2.traces == 0
+    np.testing.assert_array_equal(np.asarray(out1[0]),
+                                  np.asarray(out2[0]))
+    # the cache sidecars carry tier="int8" (aot_cache_ls satellite),
+    # and a raw Predictor's entries in ITS model dir say "raw"
+    from paddle_tpu.runtime import aot_cache
+
+    tiers = {(e["meta"] or {}).get("tier")
+             for e in aot_cache.AotDiskCache(
+                 cache_dir=os.path.join(q_dir, "__aot_cache__")).entries()}
+    assert tiers == {"int8"}
+
+
+def test_parity_report_mlp(tmp_path):
+    from paddle_tpu import observability as obs
+
+    rs = np.random.RandomState(7)
+    raw_dir, q_dir, feeds, Predictor = _export_pair(tmp_path, rs)
+    rep = parity_report(raw_dir, q_dir, feeds, logits_tol=0.05,
+                        metric_tol=0.05)
+    assert rep["ok"], rep
+    assert rep["batches"] == len(feeds)
+    assert 0.0 < rep["max_abs_diff"] < 0.05
+    assert rep["metric_agreement"] >= 0.95
+    # the gauge carries the observed drift
+    assert obs.QUANT_PARITY.value() == pytest.approx(
+        rep["max_abs_diff"])
+
+
+def test_save_inference_model_quantize_requires_coverage(tmp_path):
+    infer, scope, out_name = _tiny_mlp()
+    exe = fluid.Executor()
+    with fluid.scope_guard(scope):
+        with pytest.raises(ValueError, match="no op quantized"):
+            fluid.io.save_inference_model(
+                str(tmp_path / "q"), ["x"], [out_name], exe,
+                main_program=infer, scope=scope,
+                quantize=CalibrationTable())  # empty table: no ranges
+
+
+def test_parity_harness_deepfm():
+    """DeepFM through the level-3 pipeline: quantized vs float prob
+    outputs stay within tolerance at full agreement (the second half
+    of the MLP/DeepFM acceptance)."""
+    from paddle_tpu.models.deepfm import deepfm_net
+
+    rs = np.random.RandomState(8)
+    scope = fluid.Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            feat_ids = layers.data(name="feat_ids", shape=[10],
+                                   dtype="int64")
+            dense = layers.data(name="dense", shape=[13])
+            label = layers.data(name="label", shape=[1], dtype="int64")
+            _cost, prob = deepfm_net(feat_ids, dense, label,
+                                     num_features=200, num_fields=10)
+        exe = fluid.Executor()
+        exe.run(startup)
+    infer = main.clone(for_test=True)
+
+    def feed():
+        return {"feat_ids": rs.randint(0, 200, (8, 10)).astype(np.int64),
+                "dense": rs.rand(8, 13).astype(np.float32),
+                "label": rs.randint(0, 2, (8, 1)).astype(np.int64)}
+
+    feeds = [feed() for _ in range(3)]
+    fd_names = ["feat_ids", "dense", "label"]
+    table = calibrate(infer, scope, fd_names, feeds, max_batches=3)
+    opt, _ = optimize_program(infer, scope=scope, level=3,
+                              feed_names=fd_names,
+                              fetch_names=[prob.name], calib=table)
+    assert any(o.type == "quantized_matmul"
+               for o in opt.global_block().ops)
+    exe2 = fluid.Executor(opt_level=0)
+    exe2._disk.enabled = False
+    with fluid.scope_guard(scope):
+        raw = exe2.run(infer, feed=feeds[0], fetch_list=[prob.name])
+        qnt = exe2.run(opt, feed=feeds[0], fetch_list=[prob.name])
+    diff = np.max(np.abs(np.asarray(raw[0], np.float64)
+                         - np.asarray(qnt[0], np.float64)))
+    assert diff < 0.05, diff
+
+
+def test_quantize_pass_skips_rank3_fused_matmul():
+    """A rank-3 matmul + bias chain fuses to fused_fc(kind="matmul");
+    quantization must SKIP it (the int8 kernel's mul-flatten is only
+    the matmul contraction for 2-D operands) and the optimized program
+    must still run bit-equal to raw (code-review regression)."""
+    rs = np.random.RandomState(9)
+    scope = fluid.Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            x = fluid.layers.data(name="x3", shape=[2, 4, 8],
+                                  dtype="float32",
+                                  append_batch_size=False)
+            w = fluid.layers.create_parameter(shape=[8, 6],
+                                              dtype="float32", name="w3")
+            b = fluid.layers.create_parameter(shape=[6],
+                                              dtype="float32", name="b3")
+            mm = layers.matmul(x, w)
+            out = layers.elementwise_add(mm, b)
+        exe = fluid.Executor()
+        exe.run(startup)
+    infer = main.clone(for_test=True)
+    feeds = [{"x3": rs.randn(2, 4, 8).astype(np.float32)}]
+    table = calibrate(infer, scope, ["x3"], feeds, max_batches=1)
+    opt, _ = optimize_program(infer, scope=scope, level=3,
+                              feed_names=["x3"], fetch_names=[out.name],
+                              calib=table)
+    types = [o.type for o in opt.global_block().ops]
+    assert "quantized_matmul" not in types  # rank-3: stays float
+    exe2 = fluid.Executor(opt_level=0)
+    exe2._disk.enabled = False
+    with fluid.scope_guard(scope):
+        raw = exe2.run(infer, feed=feeds[0], fetch_list=[out.name])
+        opt_o = exe2.run(opt, feed=feeds[0], fetch_list=[out.name])
+    np.testing.assert_array_equal(np.asarray(raw[0]),
+                                  np.asarray(opt_o[0]))
+
+
+def test_quantize_pass_shares_int8_twin_for_tied_weight():
+    """Two fc ops reading ONE persistable weight materialize ONE int8
+    twin, not one per reader (code-review regression: the export must
+    not ship duplicate int8 copies of a tied weight)."""
+    rs = np.random.RandomState(10)
+    scope = fluid.Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            a = fluid.layers.data(name="a", shape=[8])
+            c = fluid.layers.data(name="c", shape=[8])
+            from paddle_tpu.param_attr import ParamAttr
+
+            o1 = layers.fc(a, 8, param_attr=ParamAttr(name="tied_w"))
+            o2 = layers.fc(c, 8, param_attr=ParamAttr(name="tied_w"))
+            out = layers.elementwise_add(o1, o2)
+        exe = fluid.Executor()
+        exe.run(startup)
+    infer = main.clone(for_test=True)
+    feeds = [{"a": rs.rand(4, 8).astype(np.float32),
+              "c": rs.rand(4, 8).astype(np.float32)}]
+    table = calibrate(infer, scope, ["a", "c"], feeds, max_batches=1)
+    opt, _ = optimize_program(infer, scope=scope, level=3,
+                              feed_names=["a", "c"],
+                              fetch_names=[out.name], calib=table)
+    q_ops = [o for o in opt.global_block().ops
+             if o.type == "quantized_matmul"]
+    assert len(q_ops) == 2
+    twins = {o.input("Y")[0] for o in q_ops}
+    assert len(twins) == 1  # ONE materialized int8 twin, shared
+    int8_vars = [n for n in opt.global_block().vars
+                 if n.startswith("tied_w.int8")]
+    assert int8_vars == list(twins)

@@ -1,0 +1,226 @@
+"""Int8 KV slab (ops/quant.py + serving/decode.py kv_dtype): slab-op
+numerics against the dequantized reference, infer coverage, the
+slab-capacity arithmetic (2x sequences per budget vs bf16), and the
+continuous-batching DecodeServer round trip on int8 slabs."""
+from __future__ import annotations
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu.ops.kv_cache import decode_attention_reference
+from paddle_tpu.ops.quant import (
+    Q_MAX, SCALE_EPS, cache_append_quant, decode_attention_quant,
+    dequantize_slab, quantize_kv_rows)
+from paddle_tpu.serving.decode import DecodeConfig, kv_slab_slots
+
+from op_test import check_infer, run_op
+
+
+def _rand_slab(rs, b=3, s=8, h=2, d=4):
+    cache = rs.randint(-127, 128, (b, s, h, d)).astype(np.int8)
+    scales = (rs.rand(b, s).astype(np.float32) * 0.1) + SCALE_EPS
+    return cache, scales
+
+
+def test_quantize_kv_rows_per_row_scales():
+    rs = np.random.RandomState(0)
+    rows = rs.randn(3, 2, 4).astype(np.float32) * 5
+    q, s = quantize_kv_rows(jnp.asarray(rows))
+    q, s = np.asarray(q), np.asarray(s)
+    assert q.dtype == np.int8 and s.shape == (3,)
+    for i in range(3):
+        want_s = max(np.abs(rows[i]).max() / Q_MAX, SCALE_EPS)
+        assert s[i] == pytest.approx(want_s, rel=1e-5)
+        np.testing.assert_array_equal(
+            q[i], np.clip(np.round(rows[i] / s[i]), -Q_MAX, Q_MAX)
+            .astype(np.int8))
+
+
+def test_cache_append_quant_scatters_row_and_scale():
+    rs = np.random.RandomState(1)
+    cache, scales = _rand_slab(rs)
+    new = rs.randn(3, 1, 2, 4).astype(np.float32)
+    pos = np.array([0, 3, 7], np.int32)
+    out, out_s = cache_append_quant(jnp.asarray(cache),
+                                    jnp.asarray(scales),
+                                    jnp.asarray(new), jnp.asarray(pos))
+    out, out_s = np.asarray(out), np.asarray(out_s)
+    q, s = quantize_kv_rows(jnp.asarray(new[:, 0]))
+    for b in range(3):
+        np.testing.assert_array_equal(out[b, pos[b]], np.asarray(q)[b])
+        assert out_s[b, pos[b]] == pytest.approx(float(np.asarray(s)[b]))
+        # untouched rows/scales survive verbatim
+        mask = np.arange(8) != pos[b]
+        np.testing.assert_array_equal(out[b, mask], cache[b, mask])
+        np.testing.assert_allclose(out_s[b, mask], scales[b, mask])
+
+
+def test_cache_append_quant_rejects_multirow():
+    rs = np.random.RandomState(2)
+    cache, scales = _rand_slab(rs)
+    with pytest.raises(ValueError, match="ONE row"):
+        cache_append_quant(jnp.asarray(cache), jnp.asarray(scales),
+                           jnp.ones((3, 2, 2, 4), jnp.float32),
+                           jnp.zeros((3,), jnp.int32))
+
+
+def test_decode_attention_quant_equals_dequantized_reference():
+    """The quantized attention op must be EXACTLY attention over the
+    dequantized slab (the CPU-fallback-is-exact contract)."""
+    rs = np.random.RandomState(3)
+    b, s, h, d = 3, 8, 2, 4
+    kc, ks = _rand_slab(rs, b, s, h, d)
+    vc, vs = _rand_slab(rs, b, s, h, d)
+    q = rs.randn(b, 1, h, d).astype(np.float32)
+    lengths = np.array([1, 5, 8], np.int32)
+    got = np.asarray(decode_attention_quant(
+        jnp.asarray(q), jnp.asarray(kc), jnp.asarray(ks),
+        jnp.asarray(vc), jnp.asarray(vs), jnp.asarray(lengths)))
+    ref = np.asarray(decode_attention_reference(
+        jnp.asarray(q), dequantize_slab(jnp.asarray(kc), jnp.asarray(ks)),
+        dequantize_slab(jnp.asarray(vc), jnp.asarray(vs)),
+        jnp.asarray(lengths)))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_quant_kv_op_infer_rules():
+    rs = np.random.RandomState(4)
+    kc, ks = _rand_slab(rs)
+    vc, vs = _rand_slab(rs)
+    check_infer("cache_append_quant",
+                {"Cache": kc, "Scales": ks,
+                 "New": rs.randn(3, 1, 2, 4).astype(np.float32),
+                 "Pos": np.zeros(3, np.int32)},
+                outs=("Out", "OutScales"))
+    check_infer("decode_attention_quant",
+                {"Q": rs.randn(3, 1, 2, 4).astype(np.float32),
+                 "KCache": kc, "KScales": ks, "VCache": vc,
+                 "VScales": vs,
+                 "Lengths": np.array([1, 2, 8], np.int32)})
+
+
+def test_quant_kv_ops_through_one_op_program():
+    """The layer-emitted op forms (what the decode graph traces) agree
+    with the direct function forms."""
+    rs = np.random.RandomState(5)
+    kc, ks = _rand_slab(rs)
+    new = rs.randn(3, 1, 2, 4).astype(np.float32)
+    pos = np.array([2, 0, 5], np.int32)
+    got = run_op("cache_append_quant",
+                 {"Cache": kc, "Scales": ks, "New": new, "Pos": pos},
+                 outs=("Out", "OutScales"))
+    want, want_s = cache_append_quant(jnp.asarray(kc), jnp.asarray(ks),
+                                      jnp.asarray(new), jnp.asarray(pos))
+    np.testing.assert_array_equal(np.asarray(got["Out"]),
+                                  np.asarray(want))
+    np.testing.assert_array_equal(np.asarray(got["OutScales"]),
+                                  np.asarray(want_s))
+
+
+# ---------------------------------------------------------------------------
+# slab capacity: the 2x-sequences-per-budget claim
+# ---------------------------------------------------------------------------
+
+
+def test_kv_slab_slots_int8_doubles_bf16_capacity():
+    cfg = DecodeConfig(vocab_size=32768, n_layer=12, n_head=8,
+                       d_model=1024, d_inner=4096, max_len=2048)
+    budget = 256 << 20
+    i8 = kv_slab_slots(budget, cfg, 1024, "int8")
+    bf = kv_slab_slots(budget, cfg, 1024, "bfloat16")
+    f32 = kv_slab_slots(budget, cfg, 1024, "float32")
+    assert i8 == 2 * bf  # the capacity acceptance pin
+    assert bf >= 2 * f32
+    assert i8 == 10 and bf == 5 and f32 == 2  # exact at this budget
+
+
+def test_kv_slab_slots_rejects_unknown_dtype():
+    cfg = DecodeConfig(vocab_size=16, n_layer=1)
+    with pytest.raises(ValueError, match="kv_dtype"):
+        kv_slab_slots(1 << 20, cfg, 64, "fp8")
+
+
+# ---------------------------------------------------------------------------
+# the int8-slab DecodeServer round trip (tier-1 acceptance)
+# ---------------------------------------------------------------------------
+
+
+def _tiny_decode_model(tmpdir):
+    from paddle_tpu import layers
+    from paddle_tpu.models import transformer as _T
+    from paddle_tpu.serving.decode import save_decode_model
+
+    cfg = DecodeConfig(vocab_size=64, n_layer=1, n_head=2, d_model=16,
+                       d_inner=32, max_len=64)
+    scope = fluid.Scope()
+    mdir = os.path.join(tmpdir, "m")
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            with fluid.unique_name.guard():
+                tokens = layers.data(name="tokens", shape=[2, 16],
+                                     dtype="int64",
+                                     append_batch_size=False)
+                lengths = layers.data(name="lengths", shape=[2],
+                                      dtype="int32",
+                                      append_batch_size=False)
+                _T.transformer_lm_prefill(
+                    tokens, lengths, cfg.vocab_size, n_layer=cfg.n_layer,
+                    n_head=cfg.n_head, d_model=cfg.d_model,
+                    d_inner=cfg.d_inner, max_len=cfg.max_len)
+        exe.run(startup)
+        save_decode_model(mdir, cfg, exe, scope=scope)
+    return mdir, cfg
+
+
+def test_int8_slab_decode_server_roundtrip_at_budget():
+    """One slab byte budget -> 2x the bf16 slot count on int8 slabs, and
+    a DecodeServer actually serving that many concurrent sequences to
+    completion through ONE compiled int8-slab decode step."""
+    from paddle_tpu.serving.decode import DecodePredictor, DecodeServer
+
+    with tempfile.TemporaryDirectory() as td:
+        mdir, cfg = _tiny_decode_model(td)
+        seq = 32
+        budget = 4 * 2 * cfg.n_layer * seq * (cfg.n_head * cfg.d_head + 4)
+        slots_i8 = kv_slab_slots(budget, cfg, seq, "int8")
+        slots_bf = kv_slab_slots(budget, cfg, seq, "bfloat16")
+        assert slots_i8 == 4 and slots_bf == 2
+        assert slots_i8 == 2 * slots_bf
+        pred = DecodePredictor(mdir, aot_cache=False)
+        srv = DecodeServer(pred, slots=slots_i8, max_seq=seq,
+                           max_new_tokens=4, strategy="greedy",
+                           prewarm=False, kv_dtype="int8")
+        assert srv.kv_dtype == "int8"
+        srv.start()
+        try:
+            prompts = [np.arange(1, 3 + i) % 60 + 1
+                       for i in range(slots_i8)]
+            futs = [srv.submit((p,)) for p in prompts]
+            outs = [f.result(timeout=240)[0] for f in futs]
+        finally:
+            srv.stop()
+        assert len(outs) == slots_i8
+        for o in outs:
+            assert o.dtype == np.int64 and len(o) == 4
+            assert np.all((o >= 0) & (o < cfg.vocab_size))
+
+
+def test_kv_dtype_env_knob(monkeypatch):
+    from paddle_tpu.serving.decode import _kv_dtype_from_env
+
+    monkeypatch.delenv("PADDLE_TPU_QUANT", raising=False)
+    assert _kv_dtype_from_env() == "float32"
+    monkeypatch.setenv("PADDLE_TPU_QUANT", "kv8")
+    assert _kv_dtype_from_env() == "int8"
+    monkeypatch.setenv("PADDLE_TPU_QUANT", "int8")
+    assert _kv_dtype_from_env() == "int8"
+    monkeypatch.setenv("PADDLE_TPU_QUANT", "0")
+    assert _kv_dtype_from_env() == "float32"

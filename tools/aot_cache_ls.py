@@ -3,7 +3,9 @@
 Enumerates `runtime/aot_cache.py` entries (training Executor dir by
 default; point --dir at a model's `__aot_cache__/` for serving caches):
 key, size, age, and the sidecar's key fields (kind, program fingerprint,
-feed signature, jax/jaxlib/backend environment). `--gc` applies the same
+transpile/quant tier [raw|O1|O2|int8 — one model's raw, optimized, and
+quantized executables coexist and this column tells them apart], feed
+signature, jax/jaxlib/backend environment). `--gc` applies the same
 mtime-LRU the executor runs after every store, against `--max-bytes` (or
 `PADDLE_TPU_AOT_CACHE_MAX_BYTES` / the 1 GiB default); `--rm KEY` drops
 one entry. tests/test_aot_cache_ls_smoke.py pins the `--json` schema in
@@ -63,6 +65,10 @@ def snapshot(cache, now=None):
             "mtime": e["mtime"],
             "age_s": max(0.0, now - e["mtime"]),
             "kind": meta.get("kind"),
+            # transpile/quant tier (Engine.meta): raw | O1 | O2 | int8 —
+            # what distinguishes one model's coexisting raw, optimized,
+            # and quantized executables; pre-tier sidecars show None
+            "tier": meta.get("tier"),
             "program": meta.get("program"),
             "feed_sig": _jsonable(meta.get("feed_sig")),
             "fetch_names": _jsonable(meta.get("fetch_names")),
@@ -133,12 +139,14 @@ def main():
     print("cache dir: %s  (enabled=%s, bound=%s)"
           % (out["dir"], out["enabled"],
              "unbounded" if out["max_bytes"] <= 0 else out["max_bytes"]))
-    fmt = "%-26s %10s %8s %-8s %-9s %-10s %s"
-    print(fmt % ("KEY", "BYTES", "AGE", "KIND", "PROGRAM", "JAX", "BACKEND"))
+    fmt = "%-26s %10s %8s %-8s %-5s %-9s %-10s %s"
+    print(fmt % ("KEY", "BYTES", "AGE", "KIND", "TIER", "PROGRAM", "JAX",
+                 "BACKEND"))
     for e in out["entries"]:
         env = e["env"] or {}
         print(fmt % (e["key"], e["bytes"], _fmt_age(e["age_s"]),
-                     e["kind"] or "?", e["program"] or "?",
+                     e["kind"] or "?", e["tier"] or "?",
+                     e["program"] or "?",
                      env.get("jax", "?"), env.get("backend", "?")))
     print("%d entries, %d bytes total" % (len(out["entries"]),
                                           out["total_bytes"]))

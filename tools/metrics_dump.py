@@ -73,12 +73,21 @@ def tiny_train_loop(steps: int):
 
 
 def predict_roundtrip(tmpdir: str):
+    """Predictor round trip PLUS the quant tier's calibrate ->
+    quantized-export -> parity flow, so the
+    ``paddle_tpu_quant_{calib_batches,quantized_ops,parity_max_abs_diff}``
+    series ship samples through the same pinned exposition."""
+    import os
+
     import numpy as np
 
     import paddle_tpu as fluid
     from paddle_tpu import layers
     from paddle_tpu.inference import Predictor
+    from paddle_tpu.quant import calibrate, parity_report
 
+    raw_dir = os.path.join(tmpdir, "raw")
+    quant_dir = os.path.join(tmpdir, "quant")
     main, startup = fluid.Program(), fluid.Program()
     scope = fluid.Scope()
     with fluid.scope_guard(scope), fluid.program_guard(main, startup):
@@ -87,10 +96,18 @@ def predict_roundtrip(tmpdir: str):
             out = layers.fc(x, 3, act="softmax")
         exe = fluid.Executor(fluid.CPUPlace())
         exe.run(startup)
-        fluid.io.save_inference_model(tmpdir, ["x"], [out], exe,
+        feeds = [{"x": np.random.RandomState(i).rand(2, 8)
+                  .astype(np.float32)} for i in range(2)]
+        table = calibrate(main, scope, ["x"], feeds, max_batches=2)
+        fluid.io.save_inference_model(raw_dir, ["x"], [out], exe,
                                       main_program=main, scope=scope)
-    p = Predictor(tmpdir, aot_cache=False)
+        fluid.io.save_inference_model(quant_dir, ["x"], [out], exe,
+                                      main_program=main, scope=scope,
+                                      quantize=table)
+    p = Predictor(raw_dir, aot_cache=False)
     p.run({"x": np.ones((2, 8), np.float32)})
+    q = Predictor(quant_dir, aot_cache=False)
+    parity_report(p, q, feeds, logits_tol=0.1)
 
 
 def merge_dumps(paths):

@@ -123,8 +123,50 @@ def _build_decode():
     return feeds, fetches
 
 
+def _build_quant():
+    """The int8 post-training-quantized serving graph (paddle_tpu/quant/
+    + transpiler/passes/quantize.py): an fc stack initialized, run
+    through the level-3 quantize pass with a synthetic calibration
+    table (unit amax per activation — linting needs ranges to exist,
+    not to be accurate), returned as the QUANTIZED program — so
+    quantized_matmul stays lint-clean and infer-covered in CI.
+
+    Unlike the other builders this returns the (program, feeds,
+    fetches) triple directly: the quantized program is a transformed
+    clone, not what program_guard accumulated."""
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.quant import CalibrationTable, activation_targets
+    from paddle_tpu.transpiler.passes import optimize_program
+
+    scope = fluid.Scope()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            img = layers.data(name="pixel", shape=[784], dtype="float32")
+            from paddle_tpu.models.mnist import mlp_model
+
+            predict = mlp_model(img)
+        exe = fluid.Executor()
+        exe.run(startup)
+    infer = main.clone(for_test=True)
+    calib = CalibrationTable(
+        activations={n: 1.0 for n in activation_targets(infer)},
+        batches=1)
+    quantized, _ctx = optimize_program(
+        infer, scope=scope, level=3, feed_names=["pixel"],
+        fetch_names=[predict.name], calib=calib)
+    assert getattr(quantized, "_quantized", None), \
+        "quant example failed to quantize any op"
+    return quantized, ["pixel"], [predict.name]
+
+
 EXAMPLES = {"mlp": _build_mlp, "deepfm": _build_deepfm, "lstm": _build_lstm,
             "decode": _build_decode}
+# builders that return the (program, feeds, fetches) triple themselves
+# (transformed clones rather than ambient default-program graphs)
+PROGRAM_EXAMPLES = {"quant": _build_quant}
+ALL_EXAMPLES = sorted(set(EXAMPLES) | set(PROGRAM_EXAMPLES))
 
 
 def build_example(name: str):
@@ -132,6 +174,8 @@ def build_example(name: str):
     (program, feed_names, fetch_names)."""
     import paddle_tpu as fluid
 
+    if name in PROGRAM_EXAMPLES:
+        return PROGRAM_EXAMPLES[name]()
     prog, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(prog, startup):
         feeds, fetches = EXAMPLES[name]()
@@ -202,7 +246,7 @@ def main(argv=None) -> int:
     ap.add_argument("paths", nargs="*",
                     help="serialized program JSON / model dir")
     ap.add_argument("--example", action="append", default=[],
-                    choices=sorted(EXAMPLES) + ["all"],
+                    choices=ALL_EXAMPLES + ["all"],
                     help="lint a bundled example program (repeatable)")
     ap.add_argument("--script", action="append", default=[],
                     help="a graph-building python script to execute+lint")
@@ -221,7 +265,7 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     targets = []
-    examples = sorted(EXAMPLES) if "all" in args.example else args.example
+    examples = ALL_EXAMPLES if "all" in args.example else args.example
     for name in examples:
         targets.append(("example:" + name,
                         lambda n=name: build_example(n) + ("example:" + n,)))
