@@ -45,6 +45,7 @@ __all__ = [
     "ANALYSIS_COVERAGE", "set_replica", "process_labels",
     "FLEET_WORKERS", "FLEET_OUTSTANDING", "FLEET_DISPATCHES",
     "FLEET_REQUEUED", "FLEET_MISVERSIONED", "FLEET_BACKPRESSURE_MS",
+    "FLEET_SHED", "FLEET_PENDING", "FLEET_AUTOSCALE",
     "DECODE_TOKENS", "DECODE_SLOTS", "DECODE_STEP_MS", "DECODE_REQUESTS",
     "CKPT_SAVES", "CKPT_BYTES", "CKPT_PENDING", "CKPT_SAVE_MS",
     "CKPT_RESTORE_MS", "CKPT_RETRIES", "CKPT_FAILURES",
@@ -227,6 +228,21 @@ FLEET_BACKPRESSURE_MS = REGISTRY.counter(
     "Router dispatch time blocked because every routable replica was at "
     "max_outstanding (rivaling wall time = add replicas or raise the "
     "window)")
+FLEET_SHED = REGISTRY.counter(
+    "paddle_tpu_fleet_shed_total",
+    "Requests rejected by bounded-latency load shedding, by SLO class — "
+    "every shed is an explicit structured RejectedError to the client, "
+    "never a timeout (nonzero = the fleet is declining work to protect "
+    "deadlines: add replicas or lower the offered load)")
+FLEET_PENDING = REGISTRY.gauge(
+    "paddle_tpu_fleet_pending",
+    "Requests waiting in the router's priority dispatch queue right now, "
+    "by SLO class (growing while replicas idle = dispatch-bound; growing "
+    "at max_outstanding everywhere = fleet saturated)")
+FLEET_AUTOSCALE = REGISTRY.counter(
+    "paddle_tpu_fleet_autoscale_total",
+    "Autoscaler actions, by direction=up (replica added) | down "
+    "(drain-shrink) | heal (dead replica reaped and replaced)")
 DECODE_TOKENS = REGISTRY.counter(
     "paddle_tpu_decode_tokens_total",
     "Tokens generated by the KV-cache decode path, by kind=prefill "

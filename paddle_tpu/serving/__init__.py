@@ -24,6 +24,14 @@ single-process ``inference.PredictorServer`` cannot provide by itself.
   metrics. Graceful ``drain_restart`` of one worker loses zero
   requests; a crashed worker's in-flight frames are re-dispatched.
 
+- ``slo.SLOClass`` / ``slo.RejectedError`` — per-request latency
+  contracts: priority dispatch classes, deadlines, and the structured
+  reject bounded-latency load shedding answers with (never a timeout).
+- ``autoscale.Autoscaler`` — the control loop over the Router's
+  elastic-fleet knobs (``add_replica``/``remove_replica``/``reap_dead``):
+  utilization+shed-driven scale-up, hysteretic drain-shrink, cooldown,
+  and crash healing.
+
 Import policy: ``Engine`` is imported eagerly (executor.py depends on
 it); ``Router``/``ShardedPredictor`` resolve lazily so importing the
 engine from the executor does not drag the inference stack (and its
@@ -35,7 +43,8 @@ from .engine import Engine  # noqa: F401
 
 __all__ = ["Engine", "Router", "ShardedPredictor", "worker_main",
            "DecodeConfig", "DecodePredictor", "DecodeServer",
-           "save_decode_model"]
+           "save_decode_model", "Autoscaler", "SLOClass", "RejectedError",
+           "default_slo_classes"]
 
 _LAZY = {
     "Router": ("router", "Router"),
@@ -45,6 +54,10 @@ _LAZY = {
     "DecodePredictor": ("decode", "DecodePredictor"),
     "DecodeServer": ("decode", "DecodeServer"),
     "save_decode_model": ("decode", "save_decode_model"),
+    "Autoscaler": ("autoscale", "Autoscaler"),
+    "SLOClass": ("slo", "SLOClass"),
+    "RejectedError": ("slo", "RejectedError"),
+    "default_slo_classes": ("slo", "default_classes"),
 }
 
 

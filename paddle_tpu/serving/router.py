@@ -6,6 +6,19 @@ channel (``ptrt_chan_recv_batch`` behind ``Channel.recv_batch``) — and
 a dispatch loop forwards each frame VERBATIM to a worker process over
 its pipe. Policy:
 
+- **SLO classes + priority dispatch**: a request may carry an SLO class
+  (priority + optional deadline, ``serving/slo.py``) in its wire frame
+  (``wire.pack_slo``); the dispatch loop holds drained frames in a
+  strict-priority queue (lower priority number first, FIFO within a
+  class), so interactive traffic overtakes batch traffic at the moment
+  of dispatch, not merely on average.
+- **bounded-latency load shedding**: a queued request that can no
+  longer meet its deadline — it expired while waiting, or its remaining
+  budget is below the observed (EWMA) dispatch-to-response time — is
+  REJECTED immediately with a structured ``RejectedError`` carrying
+  queue-depth context, never left to time out
+  (``paddle_tpu_fleet_shed_total{class=...}``). Requests without a
+  deadline are never shed.
 - **least outstanding work**: each frame goes to the routable replica
   with the fewest unanswered requests (outstanding map, not a counter —
   the map also holds the frame bytes so a dead worker's in-flight
@@ -26,10 +39,15 @@ its pipe. Policy:
 
 Lifecycle: ``drain_restart(i)`` marks one replica unroutable, waits for
 its outstanding responses, stops it gracefully (the worker's
-``server.stop()`` flushes its stacking queue — zero drops), respawns,
-and waits ready. A worker that DIES instead of draining has its
-in-flight frames re-dispatched to the survivors (predict is stateless,
-replay is safe; ``paddle_tpu_fleet_requeued_total``).
+``server.stop()`` flushes its stacking queue — zero drops), respawns
+(retrying a replacement that dies during boot, ``spawn_retries``), and
+waits ready. A worker that DIES instead of draining has its in-flight
+frames re-dispatched to the survivors (predict is stateless, replay is
+safe; ``paddle_tpu_fleet_requeued_total``). The fleet also resizes
+live: ``add_replica()`` grows it (warm AOT cache makes the spawn
+cheap), ``remove_replica()`` drain-shrinks with the same zero-drop
+contract, ``reap_dead()`` clears crashed replicas — the knobs
+``serving/autoscale.py`` turns.
 
 Observability: the router process records request latency under
 ``path="router"`` plus the fleet gauges/counters; ``health()`` is the
@@ -41,6 +59,7 @@ aggregated fleet registry) and ``/health.json``.
 """
 from __future__ import annotations
 
+import heapq
 import pickle
 import queue
 import struct
@@ -51,8 +70,25 @@ from typing import Dict, List, Optional
 from .. import observability as obs
 from ..inference import _Future, _encode_sample
 from ..runtime import recordio as _rio
+from . import slo as _slo
 
 __all__ = ["Router"]
+
+
+class _Req:
+    """One drained request in the dispatch loop: the raw (possibly
+    SLO-prefixed) bytes for crash-requeue, the inner frame the worker
+    receives, and the resolved SLO fields."""
+
+    __slots__ = ("rid", "raw", "inner", "klass", "priority", "deadline")
+
+    def __init__(self, rid, raw, inner, klass, priority, deadline):
+        self.rid = rid
+        self.raw = raw
+        self.inner = inner
+        self.klass = klass
+        self.priority = priority
+        self.deadline = deadline
 
 
 class _Worker:
@@ -110,7 +146,12 @@ class Router:
                  decode_slots: int = 4,
                  decode_max_seq: Optional[int] = None,
                  max_new_tokens: int = 32,
-                 strategy: Optional[str] = None):
+                 strategy: Optional[str] = None,
+                 slo_classes: Optional[Dict[str, "_slo.SLOClass"]] = None,
+                 default_slo: str = _slo.DEFAULT_CLASS,
+                 max_pending: Optional[int] = None,
+                 shed_interval_ms: float = 50.0,
+                 spawn_retries: int = 1):
         from ..runtime.recordio import Channel
 
         if replicas < 1:
@@ -128,6 +169,36 @@ class Router:
         self.shard = int(shard)
         self.start_timeout = float(start_timeout)
         self.dispatch_batch = int(dispatch_batch)
+        self.spawn_retries = max(0, int(spawn_retries))
+        # SLO surface: classes, the default for bare submits, and the
+        # dispatch-queue bound. pending + channel capacity together
+        # bound router-side memory: once both fill, submit() blocks
+        # (the same backpressure contract as before, one queue deeper)
+        self.slo_classes = dict(slo_classes if slo_classes is not None
+                                else _slo.default_classes())
+        if default_slo not in self.slo_classes:
+            self.slo_classes[default_slo] = _slo.SLOClass(default_slo, 1)
+        self.default_slo = default_slo
+        self.max_pending = (int(max_pending) if max_pending
+                            else int(capacity))
+        self._shed_interval_s = max(0.001, float(shed_interval_ms) / 1e3)
+        # EWMA of dispatch->response wall time (ms): the service-time
+        # estimate behind "cannot meet its deadline" shedding. None
+        # until the first response lands — until then only requests
+        # whose deadline has ALREADY expired are shed.
+        self._svc_ewma_ms: Optional[float] = None
+        self._pending_depth = 0
+        # THIS router's shed count (the Autoscaler's overload signal —
+        # the obs.FLEET_SHED series is process-global, and another
+        # fleet's sheds must not scale this one)
+        self._shed_count = 0
+        self._gauged_classes: set = set()
+        # False (default): a fleet whose EVERY replica crashed fails
+        # held requests fast (nothing will ever serve them). True (the
+        # Autoscaler arms this when healing is on): hold them — a
+        # replacement is coming, and deadline-carrying requests are
+        # still bounded by the shed sweep
+        self.hold_when_dead = False
         # per-replica in-flight window: enough to keep the worker's
         # stacking + device stages full (one bucket building while
         # in_flight batches queue) without hoarding requests a draining
@@ -167,6 +238,9 @@ class Router:
         self._ctx = mp.get_context(start_method)
         self._chan = Channel(capacity)
         self._workers: List[_Worker] = []
+        # monotone name source: replica names stay unique through
+        # add/remove cycles (drain_restart reuses its replica's name)
+        self._name_seq = self.replicas - 1
         self._futures: Dict[int, _Future] = {}
         self._next_id = 0
         self._lock = threading.Lock()          # futures + rid allocation
@@ -218,7 +292,12 @@ class Router:
         the ones being waited on) — a failed drain_restart respawn must
         never take down the healthy replicas still serving traffic."""
         scope = workers if abort_scope is None else abort_scope
-        deadline = time.monotonic() + (timeout or self.start_timeout)
+        # the message must name the budget actually enforced: a per-call
+        # timeout (e.g. drain_restart's remaining deadline) can be much
+        # shorter than start_timeout, and naming the wrong one sends the
+        # operator tuning the wrong knob
+        effective = timeout if timeout is not None else self.start_timeout
+        deadline = time.monotonic() + effective
         for w in workers:
             # poll so a worker that DIES during bootstrap (bad model
             # dir, spawn outside a __main__ guard, import crash) fails
@@ -227,8 +306,12 @@ class Router:
                 if time.monotonic() >= deadline:
                     self._abort_workers(scope)
                     raise RuntimeError(
-                        "fleet worker %s did not become ready within %.0fs"
-                        % (w.name, self.start_timeout))
+                        "fleet worker %s did not become ready within "
+                        "%.1fs%s" % (w.name, effective,
+                                     "" if effective == self.start_timeout
+                                     else " (per-call deadline; "
+                                     "start_timeout is %.0fs)"
+                                     % self.start_timeout))
                 if w.proc is not None and not w.proc.is_alive():
                     self._abort_workers(scope)
                     raise RuntimeError(
@@ -252,10 +335,32 @@ class Router:
         self._refresh_worker_gauge()
 
     # -- submission --------------------------------------------------------
-    def submit(self, sample) -> _Future:
+    def submit(self, sample, slo: Optional[str] = None,
+               deadline_ms: Optional[float] = None,
+               priority: Optional[int] = None) -> _Future:
         """sample: one array per feed slot (a single row, no batch dim)
         — identical contract to ``PredictorServer.submit``, same wire
-        frame (``inference._encode_sample``)."""
+        frame (``inference._encode_sample``).
+
+        ``slo`` names a class from ``slo_classes`` (priority + default
+        deadline); ``deadline_ms``/``priority`` override per call. A
+        request with a deadline may be SHED: its future then raises
+        ``serving.RejectedError`` (an explicit structured answer, never
+        a timeout). Bare submits resolve to the default class with no
+        deadline — wire-compatible with the pre-SLO form and never
+        shed."""
+        annotated = (slo is not None or deadline_ms is not None
+                     or priority is not None)
+        klass = self.slo_classes.get(slo if slo is not None
+                                     else self.default_slo)
+        if klass is None:
+            raise ValueError(
+                "unknown SLO class %r (configured: %s)"
+                % (slo, ", ".join(sorted(self.slo_classes))))
+        prio = klass.priority if priority is None else int(priority)
+        if deadline_ms is None:
+            deadline_ms = klass.deadline_ms
+            annotated = annotated or deadline_ms is not None
         fut = _Future()
         fut._t0 = time.perf_counter()
         with self._lock:
@@ -263,8 +368,26 @@ class Router:
             self._next_id += 1
             self._futures[rid] = fut
         fut._bind(self, rid)
+        if deadline_ms is not None and deadline_ms <= 0:
+            # already unmeetable at admission: the explicit reject, now
+            self._pop(rid)
+            with self._lock:
+                self._shed_count += 1
+            obs.FLEET_SHED.inc(**{"class": klass.name})
+            fut.set_exception(_slo.rejected(
+                klass.name, prio, "expired", float(deadline_ms),
+                self._pending_depth + self._chan.qsize(),
+                sum(len(w.outstanding) for w in self._workers)))
+            return fut
         try:
-            sent = self._chan.send(_encode_sample(rid, sample))
+            frame = _encode_sample(rid, sample)
+            if annotated:
+                from . import wire
+
+                deadline = (None if deadline_ms is None
+                            else time.monotonic() + deadline_ms / 1e3)
+                frame = wire.pack_slo(frame, prio, deadline, klass.name)
+            sent = self._chan.send(frame)
         except BaseException:
             with self._lock:
                 self._futures.pop(rid, None)
@@ -280,40 +403,162 @@ class Router:
             return self._futures.pop(rid, None)
 
     # -- dispatch ----------------------------------------------------------
-    def _dispatch_loop(self):
+    def _parse_request(self, msg) -> _Req:
         from . import wire
 
+        prio, deadline, klass, inner = wire.read_slo(msg)
+        if prio is None:  # bare pre-SLO frame: default class, no deadline
+            klass = self.default_slo
+            prio = self.slo_classes[klass].priority
+        return _Req(_rio.frame_tag(inner), msg, inner, klass, prio,
+                    deadline)
+
+    def _dispatch_loop(self):
+        """Drain the front channel into a strict-priority pending queue
+        (lower priority number first, FIFO within a class via ``seq``),
+        shed queued requests whose deadline can no longer be met, and
+        assign the rest least-outstanding. Each worker's burst ships as
+        ONE coalesced pipe message — at load the pipe hop is per-burst,
+        not per-request. Bounded memory end to end: pending is capped at
+        ``max_pending``, behind it the channel (``capacity``) fills, and
+        behind THAT ``submit()`` blocks."""
+        from . import wire
+
+        pending: list = []  # heap of (priority, seq, _Req)
+        seq = 0
+        closed = False
         while True:
-            batch = self._chan.recv_batch(self.dispatch_batch, None)
-            if batch is None:
-                return  # closed and drained
-            # assign every drained frame, then ship each worker ITS
-            # frames as ONE coalesced pipe message — at load the pipe
-            # hop is per-burst, not per-request. Assignment greedily
-            # avoids blocking: when capacity runs out mid-burst, what is
-            # already grouped is flushed first (no head-of-line wait),
-            # then the rest dispatches one by one through the blocking
-            # path.
-            groups: Dict[int, list] = {}
-            rest = None
-            for i, msg in enumerate(batch):
-                w = self._assign(msg, block=False)
+            if not closed and len(pending) < self.max_pending:
+                # block for the first frame only while nothing is
+                # queued: with deadlines pending the loop must keep
+                # sweeping, so the drain is the non-blocking form
+                batch = self._chan.recv_batch(
+                    self.dispatch_batch, 0 if pending else None)
+                if batch is None:
+                    closed = True
+                else:
+                    for msg in batch:
+                        req = self._parse_request(msg)
+                        heapq.heappush(pending, (req.priority, seq, req))
+                        seq += 1
+            if pending:
+                pending = self._shed_sweep(pending)
+            self._update_pending_gauges(pending)
+            progressed = False
+            groups: Dict[str, tuple] = {}
+            while pending:
+                req = pending[0][2]
+                w = self._assign(req, block=False)
+                if w is None:
+                    break  # nothing routable: park below, keep sweeping
+                heapq.heappop(pending)
+                progressed = True
                 if w is False:
                     continue  # failed (fleet dead/stopping), future set
-                if w is None:
-                    rest = batch[i:]
-                    break
-                groups.setdefault(w.idx, (w, []))[1].append(msg)
-            self._flush_groups(wire, groups)
-            for msg in rest or ():
-                w = self._assign(msg, block=True)
-                if w in (None, False):
-                    continue
-                self._send_to(w, msg)
+                groups.setdefault(w.name, (w, []))[1].append(req.inner)
+            if progressed:
+                # re-read AFTER assignment too: an idle fleet must gauge
+                # pending 0, not the depth of the batch it just drained
+                self._update_pending_gauges(pending)
+            if groups:
+                self._flush_groups(wire, groups)
+            if closed and not pending:
+                return
+            if pending and not progressed and not closed:
+                # saturated (or mid-restart): park briefly — capacity
+                # frees notify _cond, and the bounded wait keeps the
+                # deadline sweep live so queued requests are shed the
+                # moment they become hopeless, never left to time out
+                t0 = time.perf_counter()
+                with self._cond:
+                    if not self._eligible():
+                        self._cond.wait(self._shed_interval_s)
+                obs.FLEET_BACKPRESSURE_MS.inc(
+                    (time.perf_counter() - t0) * 1e3)
+            elif closed and pending:
+                # stop(): everything already accepted still goes out —
+                # blocking assigns, with the shed check before each so
+                # a deadline that lapsed during the drain still gets
+                # its explicit reject
+                while pending:
+                    _p, _s, req = heapq.heappop(pending)
+                    self._update_pending_gauges(pending)
+                    if (req.deadline is not None
+                            and time.monotonic() >= req.deadline):
+                        self._shed(req, "expired")
+                        continue
+                    w = self._assign(req, block=True)
+                    if w in (None, False):
+                        continue
+                    self._send_to(w, req.inner)
+                return
 
     def _flush_groups(self, wire, groups):
         for w, msgs in groups.values():
             self._send_to(w, wire.pack(msgs))
+
+    # -- shedding ----------------------------------------------------------
+    def _shed_sweep(self, pending: list) -> list:
+        """Reject every queued request that can no longer meet its
+        deadline: expired outright, or remaining budget below the
+        observed dispatch-to-response time (shedding NOW is strictly
+        better than a guaranteed timeout later). Returns the surviving
+        heap; untouched when nothing sheds (the common case)."""
+        now = time.monotonic()
+        est = self._svc_ewma_ms
+        # the estimate only updates on COMPLETIONS: with nothing in
+        # flight it cannot self-correct, so an idle fleet never sheds
+        # on it — the request dispatches immediately and its completion
+        # re-seeds the estimate. (Otherwise one pathological cold-start
+        # latency could freeze the oracle above every deadline and the
+        # fleet would reject 100% of traffic forever.)
+        if est is not None and not any(w.outstanding
+                                       for w in self._workers):
+            est = None
+        shed = None
+        for item in pending:
+            req = item[2]
+            if req.deadline is None:
+                continue
+            remaining_ms = (req.deadline - now) * 1e3
+            if remaining_ms <= 0:
+                shed = shed or []
+                shed.append((item, "expired"))
+            elif est is not None and remaining_ms < est:
+                shed = shed or []
+                shed.append((item, "hopeless"))
+        if not shed:
+            return pending
+        doomed = {id(item) for item, _r in shed}
+        kept = [item for item in pending if id(item) not in doomed]
+        heapq.heapify(kept)
+        for item, reason in shed:
+            self._shed(item[2], reason)
+        return kept
+
+    def _shed(self, req: _Req, reason: str):
+        with self._lock:
+            self._shed_count += 1
+        obs.FLEET_SHED.inc(**{"class": req.klass})
+        fut = self._pop(req.rid)
+        if fut is None:
+            return  # abandoned via cancel/timeout
+        remaining = (None if req.deadline is None
+                     else (req.deadline - time.monotonic()) * 1e3)
+        with self._cond:
+            outstanding = sum(len(w.outstanding) for w in self._workers)
+        fut.set_exception(_slo.rejected(
+            req.klass, req.priority, reason, remaining,
+            self._pending_depth, outstanding))
+
+    def _update_pending_gauges(self, pending: list):
+        self._pending_depth = len(pending)
+        counts: Dict[str, int] = {}
+        for _p, _s, req in pending:
+            counts[req.klass] = counts.get(req.klass, 0) + 1
+        for k in self._gauged_classes | set(counts):
+            obs.FLEET_PENDING.set(counts.get(k, 0), **{"class": k})
+        self._gauged_classes |= set(counts)
 
     def _send_to(self, w: _Worker, payload):
         try:
@@ -335,12 +580,11 @@ class Router:
         return [w for w in self._workers
                 if w.state in ("starting", "ready", "draining")]
 
-    def _assign(self, msg, block: bool):
-        """Record `msg` against the least-outstanding routable replica.
+    def _assign(self, req: _Req, block: bool):
+        """Record `req` against the least-outstanding routable replica.
         Returns the worker, None when nothing is routable and
-        ``block=False`` (caller flushes and retries blocking), or False
-        when the request had to be FAILED (fleet stopping / all dead)."""
-        rid = _rio.frame_tag(msg)
+        ``block=False`` (caller parks and retries), or False when the
+        request had to be FAILED (fleet stopping / all dead)."""
         t0 = time.perf_counter()
         waited = False
         with self._cond:
@@ -351,15 +595,18 @@ class Router:
                 # park while saturated or mid-restart; give up only when
                 # the fleet is stopping or EVERY replica crashed (a
                 # gracefully "stopped" replica means a restart is in
-                # flight — hold the request, don't fail it)
+                # flight, an EMPTY list means the autoscaler is mid-heal,
+                # and hold_when_dead means a healer is attached — hold
+                # the request, don't fail it)
                 if self._stopping or (
-                        not self._alive()
+                        not self._alive() and self._workers
+                        and not self.hold_when_dead
                         and all(w.state == "dead" for w in self._workers)):
-                    fut = self._pop(rid)
+                    fut = self._pop(req.rid)
                     if fut is not None:
                         fut.set_exception(RuntimeError(
                             "no serving replica available for request %d"
-                            % rid))
+                            % req.rid))
                         obs.PREDICT_FAILURES.inc(path="router")
                     return False
                 if not block:
@@ -368,7 +615,8 @@ class Router:
                 self._cond.wait(0.5)
             # least outstanding work
             w = min(elig, key=lambda w: len(w.outstanding))
-            w.outstanding[rid] = (msg, self.active_version)
+            w.outstanding[req.rid] = (req, self.active_version,
+                                      time.perf_counter())
             w.dispatched += 1
             obs.FLEET_OUTSTANDING.set(len(w.outstanding), replica=w.name)
         if waited:
@@ -440,6 +688,14 @@ class Router:
             entry = w.outstanding.pop(rid, None)
             obs.FLEET_OUTSTANDING.set(len(w.outstanding), replica=w.name)
             self._cond.notify_all()  # capacity freed / drain progressed
+        if entry is not None and exc is None:
+            # dispatch->response wall time feeds the shedding oracle:
+            # deliberately includes the worker-side queue (that IS the
+            # latency a newly dispatched request would see right now)
+            svc_ms = (time.perf_counter() - entry[2]) * 1e3
+            prev = self._svc_ewma_ms
+            self._svc_ewma_ms = (svc_ms if prev is None
+                                 else 0.8 * prev + 0.2 * svc_ms)
         fut = self._pop(rid)
         if fut is None:
             return  # abandoned via cancel/timeout
@@ -475,11 +731,13 @@ class Router:
         self._refresh_worker_gauge()
         if not entries:
             return
-        for rid, (msg, _ver) in entries:
+        for rid, (req, _ver, _t) in entries:
             obs.FLEET_REQUEUED.inc()
-            # back through the front channel: the dispatch loop re-routes
-            # to a live replica (predict is stateless — replay is safe)
-            if not self._chan.send(msg):
+            # back through the front channel, SLO header and all: the
+            # dispatch loop re-routes to a live replica (predict is
+            # stateless — replay is safe) and a deadline that lapsed
+            # during the crash still gets its explicit reject
+            if not self._chan.send(req.raw):
                 fut = self._pop(rid)
                 if fut is not None:
                     fut.set_exception(RuntimeError(
@@ -496,13 +754,9 @@ class Router:
             self.active_version = version
             self._cond.notify_all()
 
-    def drain_restart(self, idx: int, timeout: float = 300.0):
-        """Gracefully recycle one replica with ZERO dropped requests:
-        unroute it, wait out its in-flight responses, stop it (the
-        worker flushes its own stacking queue before exiting), respawn,
-        wait ready. The rest of the fleet keeps serving throughout."""
-        w = self._workers[idx]
-        deadline = time.monotonic() + timeout
+    def _drain_out(self, w: _Worker, deadline: float) -> int:
+        """Unroute `w` and wait out its in-flight responses. Returns
+        the count still outstanding at the deadline (0 = drained)."""
         with self._cond:
             if w.state == "ready":
                 w.state = "draining"
@@ -511,20 +765,185 @@ class Router:
         with self._cond:
             while w.outstanding and time.monotonic() < deadline:
                 self._cond.wait(0.5)
-            pending = len(w.outstanding)
+            return len(w.outstanding)
+
+    def _replace_worker(self, old: _Worker, new: _Worker):
+        """Swap `old`'s fleet slot for `new` by IDENTITY: a concurrent
+        remove_replica/reap_dead (the autoscaler's knobs) shifts list
+        positions, so a positional write could evict a healthy
+        neighbour's handle mid-restart."""
+        with self._cond:
+            try:
+                self._workers[self._workers.index(old)] = new
+            except ValueError:  # old was reaped meanwhile: still grow
+                self._workers.append(new)
+            self._cond.notify_all()
+
+    def drain_restart(self, idx: int, timeout: float = 300.0):
+        """Gracefully recycle one replica with ZERO dropped requests:
+        unroute it, wait out its in-flight responses, stop it (the
+        worker flushes its own stacking queue before exiting), respawn,
+        wait ready. The rest of the fleet keeps serving throughout."""
+        w = self._workers[idx]
+        deadline = time.monotonic() + timeout
+        pending = self._drain_out(w, deadline)
         if pending:
             raise RuntimeError(
                 "replica %s still has %d outstanding requests after %.0fs"
                 % (w.name, pending, timeout))
         self._stop_worker(w, deadline)
-        nw = self._spawn(idx, name=w.name)
-        self._workers[idx] = nw
-        self._wait_ready([nw], timeout=max(1.0, deadline - time.monotonic()))
+        # a replacement that dies during boot (transient: OOM, a cache
+        # race, a preempted host) is retried before giving up — and a
+        # failed restart NEVER takes down the survivors, which keep
+        # serving throughout; on exhaustion the dead replacement stays
+        # visible in health() for reap_dead()/the autoscaler to heal
+        attempts = 1 + self.spawn_retries
+        last_err = None
+        cur = w
+        for attempt in range(attempts):
+            nw = self._spawn(idx, name=w.name)
+            self._replace_worker(cur, nw)
+            cur = nw
+            try:
+                self._wait_ready(
+                    [nw], timeout=max(1.0, deadline - time.monotonic()))
+                last_err = None
+                break
+            except RuntimeError as e:
+                last_err = e
+        if last_err is not None:
+            self._refresh_worker_gauge()
+            raise RuntimeError(
+                "replica %s could not be respawned (%d attempt%s; the "
+                "rest of the fleet keeps serving — reap_dead()/the "
+                "autoscaler can replace it): %s"
+                % (w.name, attempts, "s" if attempts != 1 else "",
+                   last_err)) from last_err
         self._refresh_worker_gauge()
         with self._cond:
             self._cond.notify_all()
 
+    # -- elastic fleet (the autoscaler's knobs) ----------------------------
+    def add_replica(self, timeout: Optional[float] = None) -> str:
+        """Grow the fleet by one replica and wait until it is ready and
+        routable (the warm AOT cache makes the spawn nearly
+        compile-free). Returns the new replica's name."""
+        with self._cond:
+            if self._stopping:
+                raise RuntimeError("serving fleet is stopping")
+            self._name_seq += 1
+            name = "replica%d" % self._name_seq
+        w = self._spawn(len(self._workers), name=name)
+        # readiness is proven BEFORE the fleet list grows: a spawn that
+        # dies never pollutes health()/dispatch
+        self._wait_ready([w], timeout=timeout)
+        with self._cond:
+            # re-check: stop() may have swept the fleet while the spawn
+            # booted — appending now would leak a live worker process
+            # no stop will ever visit
+            stopping = self._stopping
+            if not stopping:
+                self._workers.append(w)
+                self._cond.notify_all()
+        if stopping:
+            self._abort_workers([w])
+            raise RuntimeError("serving fleet is stopping")
+        self._refresh_worker_gauge()
+        return name
+
+    def remove_replica(self, idx: Optional[int] = None,
+                       timeout: float = 300.0) -> str:
+        """Drain-shrink: unroute one replica (default: the least-loaded
+        ready one), wait out its in-flight responses, stop it gracefully
+        (the worker flushes its queue — ZERO dropped requests), and drop
+        it from the fleet. Returns the removed replica's name."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            ready = [x for x in self._workers if x.state == "ready"]
+            if idx is None:
+                if len(ready) <= 1:
+                    raise RuntimeError(
+                        "refusing to remove the last ready replica")
+                w = min(ready, key=lambda x: len(x.outstanding))
+            else:
+                w = self._workers[idx]
+                # the guard holds on the explicit-index path too: an
+                # emptied fleet wedges every later submit (nothing will
+                # ever serve, and no error is coming)
+                if w.state == "ready" and len(ready) <= 1:
+                    raise RuntimeError(
+                        "refusing to remove the last ready replica")
+        pending = self._drain_out(w, deadline)
+        if pending:
+            with self._cond:  # put it back in service rather than leak
+                if w.state == "draining":
+                    w.state = "ready"
+                self._cond.notify_all()
+            self._refresh_worker_gauge()
+            raise RuntimeError(
+                "replica %s still has %d outstanding requests after "
+                "%.0fs; returned to service" % (w.name, pending, timeout))
+        self._stop_worker(w, deadline)
+        with self._cond:
+            if w in self._workers:
+                self._workers.remove(w)
+            self._cond.notify_all()
+        self._refresh_worker_gauge()
+        return w.name
+
+    def reap_dead(self) -> List[str]:
+        """Drop crashed replicas from the fleet list (their in-flight
+        frames were already requeued by the reader's exit path). Returns
+        the reaped names — the autoscaler heals by spawning that many
+        replacements."""
+        with self._cond:
+            dead = [w for w in self._workers if w.state == "dead"]
+            for w in dead:
+                self._workers.remove(w)
+            self._cond.notify_all()
+        for w in dead:
+            if w.proc is not None:
+                w.proc.join(timeout=5)
+            try:
+                w.conn.close()
+            except (OSError, ValueError):
+                pass
+        self._refresh_worker_gauge()
+        return [w.name for w in dead]
+
+    def stats(self) -> Dict:
+        """The autoscaler's one-call signal snapshot: replica states,
+        total in-flight work, the per-replica window, and the dispatch
+        queue depth."""
+        with self._cond:
+            states: Dict[str, int] = {}
+            for w in self._workers:
+                states[w.state] = states.get(w.state, 0) + 1
+            return {
+                "replicas": len(self._workers),
+                "ready": states.get("ready", 0),
+                "starting": states.get("starting", 0),
+                "draining": states.get("draining", 0),
+                "dead": states.get("dead", 0),
+                "outstanding": sum(len(w.outstanding)
+                                   for w in self._workers),
+                "max_outstanding": self.max_outstanding,
+                "pending": self._pending_depth,
+                "queued": self._chan.qsize(),
+                "shed": self._shed_count,
+            }
+
     def _stop_worker(self, w: _Worker, deadline=None):
+        if w.proc is not None and not w.proc.is_alive():
+            # already dead (crashed replica, failed respawn): there is
+            # no "stopped" status to wait for — reap without eating the
+            # drain deadline
+            w.proc.join(timeout=5)
+            with self._cond:
+                self._cond.notify_all()
+            if w.reader is not None:
+                w.reader.join(timeout=5)
+            return
         try:
             with w.send_lock:
                 w.conn.send_bytes(b"C" + pickle.dumps({"cmd": "stop"},

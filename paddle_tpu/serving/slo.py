@@ -1,0 +1,117 @@
+"""SLO classes and structured load-shedding rejects for the fleet.
+
+A production front door cannot promise every caller the same latency:
+an interactive user request, a background re-rank, and a bulk backfill
+have different urgency AND different tolerance for being turned away.
+An ``SLOClass`` names that contract — a dispatch priority (lower number
+dispatches first) and an optional default deadline — and the Router
+carries both on the wire frame (``wire.pack_slo``) so its dispatch loop
+can run strict-priority queues and bounded-latency shedding without a
+side table.
+
+The shedding contract: a request the fleet can no longer serve within
+its deadline is REJECTED with a structured ``RejectedError`` the moment
+that becomes knowable — at admission (deadline already expired), or in
+the dispatch loop's sweep (expired while queued, or the remaining
+budget is below the observed service time). The client gets queue-depth
+context and a decision point immediately instead of a timeout later;
+``paddle_tpu_fleet_shed_total{class=...}`` counts every shed. A shed is
+an explicit answer, not a failure — it does not touch
+``paddle_tpu_predict_failures_total``.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+__all__ = ["SLOClass", "RejectedError", "default_classes",
+           "DEFAULT_CLASS", "rejected"]
+
+# the class an un-annotated submit() resolves to: mid priority, no
+# deadline — pre-SLO callers see byte-identical wire frames and can
+# never be shed
+DEFAULT_CLASS = "standard"
+
+
+class SLOClass:
+    """One latency contract: ``priority`` orders dispatch (0 is most
+    urgent), ``deadline_ms`` (optional) arms shedding for every request
+    submitted under the class unless the caller overrides per call."""
+
+    __slots__ = ("name", "priority", "deadline_ms")
+
+    def __init__(self, name: str, priority: int,
+                 deadline_ms: Optional[float] = None):
+        self.name = str(name)
+        self.priority = int(priority)
+        self.deadline_ms = None if deadline_ms is None else float(deadline_ms)
+
+    def __repr__(self):
+        return ("SLOClass(%r, priority=%d, deadline_ms=%r)"
+                % (self.name, self.priority, self.deadline_ms))
+
+
+def default_classes() -> Dict[str, SLOClass]:
+    """The stock three-tier ladder. ``interactive`` preempts everything
+    in the dispatch queue; ``batch`` yields to both and never sheds
+    (no deadline) — it absorbs the queueing that shedding protects the
+    urgent tiers from. Deadlines default to None everywhere: shedding
+    is armed per class or per request, never by surprise."""
+    return {
+        "interactive": SLOClass("interactive", 0),
+        "standard": SLOClass("standard", 1),
+        "batch": SLOClass("batch", 2),
+    }
+
+
+class RejectedError(RuntimeError):
+    """Structured load-shed reject (NOT a timeout, NOT a server error).
+
+    Raised from ``future.result()`` for a request the fleet declined —
+    the deadline expired while queued, or the remaining budget is below
+    what service currently takes. Fields give the client enough context
+    to decide (back off, relax the deadline, drop the work):
+
+    - ``slo`` / ``priority``: the class the request was submitted under
+    - ``reason``: ``"expired"`` (deadline passed while queued) or
+      ``"hopeless"`` (budget < observed service time — rejecting now
+      beats timing out later)
+    - ``deadline_remaining_ms``: budget left at shed time (<= 0 for
+      ``expired``)
+    - ``queue_depth`` / ``outstanding``: fleet pressure at shed time
+    """
+
+    def __init__(self, message: str = "request shed",
+                 slo: Optional[str] = None,
+                 priority: Optional[int] = None,
+                 reason: str = "overload",
+                 deadline_remaining_ms: Optional[float] = None,
+                 queue_depth: Optional[int] = None,
+                 outstanding: Optional[int] = None):
+        super().__init__(message)
+        self.slo = slo
+        self.priority = priority
+        self.reason = reason
+        self.deadline_remaining_ms = deadline_remaining_ms
+        self.queue_depth = queue_depth
+        self.outstanding = outstanding
+
+
+def rejected(klass: str, priority: int, reason: str,
+             deadline_remaining_ms: Optional[float],
+             queue_depth: int, outstanding: int) -> RejectedError:
+    """Build the structured reject with a message that carries the whole
+    context (the exception repr is what most clients will log)."""
+    if reason == "expired":
+        why = "deadline exceeded while queued"
+    else:
+        why = "remaining deadline budget is below the current service time"
+    remaining = ("" if deadline_remaining_ms is None
+                 else ", %.1fms of deadline remaining" % deadline_remaining_ms)
+    return RejectedError(
+        "request shed (%s): class %r %s (queue depth %d, %d requests "
+        "outstanding%s) — lower the offered load, relax the deadline, or "
+        "scale the fleet" % (reason, klass, why, queue_depth, outstanding,
+                             remaining),
+        slo=klass, priority=priority, reason=reason,
+        deadline_remaining_ms=deadline_remaining_ms,
+        queue_depth=queue_depth, outstanding=outstanding)

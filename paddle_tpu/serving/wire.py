@@ -1,4 +1,4 @@
-"""Pipe message coalescing for the router <-> worker hop.
+"""Pipe message coalescing + SLO framing for the router <-> worker hop.
 
 One multiprocessing ``send_bytes`` is one syscall plus a GIL round trip
 on each side; at fleet throughput the per-REQUEST pipe hop dominates
@@ -11,16 +11,34 @@ into one ``b"M"``-prefixed multi-message:
 low-load case); ``iter_messages`` yields the constituent payloads of
 either form, as memoryview slices over the received buffer (zero copy —
 request frames decode straight out of them).
+
+SLO header (``pack_slo`` / ``read_slo``): a request submitted with a
+priority/deadline/class carries them ON the wire frame — the request is
+self-describing through the front channel and across a crash-requeue,
+so the dispatch loop's priority queues and deadline shedding never need
+a side table keyed by request id:
+
+    b"Q" | u8 priority | u8 class_len | class ascii | f64 deadline | frame
+
+``deadline`` is an absolute ``time.monotonic()`` timestamp (0.0 = no
+deadline) — the header only ever travels within the router process
+(submit -> channel -> dispatch; workers receive the INNER frame), so a
+process-local clock is the right one. A bare (un-prefixed) frame means
+default class / default priority / no deadline — the pre-SLO wire form
+is still valid, byte for byte.
 """
 from __future__ import annotations
 
 import struct
-from typing import Iterator, List, Sequence
+from typing import Iterator, List, Optional, Sequence, Tuple
 
-__all__ = ["pack", "iter_messages"]
+__all__ = ["pack", "iter_messages", "pack_slo", "read_slo"]
 
 _MULTI = 0x4D  # b"M"
 _LEN = struct.Struct("<I")
+_SLO = b"Q"
+_SLO_HDR = struct.Struct("<BB")  # priority, class name length
+_SLO_DL = struct.Struct("<d")    # absolute monotonic deadline (0 = none)
 
 
 def pack(msgs: Sequence[bytes]) -> bytes:
@@ -48,3 +66,36 @@ def iter_messages(payload) -> Iterator:
         off += _LEN.size
         yield mv[off:off + n]
         off += n
+
+
+def pack_slo(frame: bytes, priority: int, deadline: Optional[float],
+             klass: str) -> bytes:
+    """Prefix a request frame with its SLO header (see module doc)."""
+    k = klass.encode("ascii")
+    if len(k) > 255:
+        raise ValueError("SLO class name too long: %r" % klass)
+    if not 0 <= int(priority) <= 255:
+        # a u8 on the wire: masking would silently INVERT dispatch
+        # order (-1 -> 255 dispatches last, 256 -> 0 dispatches first)
+        raise ValueError("SLO priority must be in [0, 255], got %r"
+                         % (priority,))
+    return (_SLO + _SLO_HDR.pack(int(priority), len(k)) + k
+            + _SLO_DL.pack(float(deadline) if deadline else 0.0) + frame)
+
+
+def read_slo(msg) -> Tuple[Optional[int], Optional[float], Optional[str],
+                           object]:
+    """``(priority, deadline, class, inner_frame)`` from a request
+    message. A bare frame (no ``b"Q"`` prefix) returns
+    ``(None, None, None, msg)`` — the caller applies its defaults. The
+    inner frame is a zero-copy memoryview slice."""
+    if bytes(msg[:1]) != _SLO:
+        return None, None, None, msg
+    mv = memoryview(msg)
+    prio, klen = _SLO_HDR.unpack_from(mv, 1)
+    off = 1 + _SLO_HDR.size
+    klass = bytes(mv[off:off + klen]).decode("ascii")
+    off += klen
+    (deadline,) = _SLO_DL.unpack_from(mv, off)
+    off += _SLO_DL.size
+    return prio, (deadline if deadline > 0.0 else None), klass, mv[off:]

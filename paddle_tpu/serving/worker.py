@@ -66,6 +66,22 @@ def worker_main(conn, options):
     ``options`` is a plain picklable dict (see Router._spawn)."""
     _apply_env(options)
 
+    # chaos barriers (checkpoint/faults.py, armed via PADDLE_TPU_FAULT_*
+    # in worker_env): "serving.worker_boot" models a replica dying
+    # during bootstrap (the drain_restart double-fault), and
+    # "serving.request" — armed with a DELAY — models a slow replica so
+    # shedding/priority tests are deterministic instead of racing the
+    # scheduler. The env is fixed at spawn for a worker process, so an
+    # unarmed worker skips the barrier entirely (zero hot-path cost).
+    from ..checkpoint.faults import fault_point
+
+    faults_armed = any(
+        os.environ.get(k) for k in ("PADDLE_TPU_FAULT_KILL",
+                                    "PADDLE_TPU_FAULT_DELAY",
+                                    "PADDLE_TPU_FAULT_IO"))
+    if faults_armed:
+        fault_point("serving.worker_boot")
+
     import jax
 
     if options.get("jax_platform"):
@@ -211,7 +227,8 @@ def worker_main(conn, options):
                     if op == "ping":
                         send(b"S" + pickle.dumps(
                             {"pong": True, "version": version,
-                             "pid": os.getpid()}, protocol=4))
+                             "pid": os.getpid(),
+                             "depth": len(server._results)}, protocol=4))
                     elif op == "metrics":
                         from ..observability import export
 
@@ -219,6 +236,14 @@ def worker_main(conn, options):
                             {"metrics": export.to_json(
                                 include_timeline=False)}, protocol=4))
                     continue
+                if kind == b"Q":
+                    # belt-and-braces: the router strips the SLO header
+                    # before forwarding, but a direct caller (or a
+                    # future router that forwards deadlines) must not
+                    # wedge the replica on an unknown prefix
+                    msg = wire.read_slo(msg)[3]
+                if faults_armed:
+                    fault_point("serving.request")
                 # request frame: submit as-is (bytes — the C channel
                 # copies from a bytes payload); the response streams
                 # back from the completing server thread via the done

@@ -1,0 +1,293 @@
+"""Cheap (no worker process) units for the traffic-shaped fleet layer:
+the SLO wire header, SLOClass/RejectedError semantics, admission-time
+shedding through a never-started Router, the _wait_ready effective-
+deadline message (ISSUE 13 satellite), and the Autoscaler control loop
+driven tick-by-tick against a fake router — hysteresis, cooldown,
+shed-triggered scale-up, and crash healing, all without spawning a
+single replica."""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import observability as obs
+from paddle_tpu.serving import (
+    Autoscaler, RejectedError, SLOClass, default_slo_classes, slo, wire,
+)
+from paddle_tpu.serving.router import Router, _Worker
+
+
+# -- wire SLO header ------------------------------------------------------
+
+def test_slo_header_roundtrip_and_bare_frame():
+    frame = b"Zfake-frame-bytes"
+    dl = time.monotonic() + 0.5
+    msg = wire.pack_slo(frame, 3, dl, "interactive")
+    prio, deadline, klass, inner = wire.read_slo(msg)
+    assert (prio, klass) == (3, "interactive")
+    assert deadline == pytest.approx(dl)
+    assert bytes(inner) == frame
+    # no deadline encodes as 0.0 -> reads back None
+    prio, deadline, klass, inner = wire.read_slo(
+        wire.pack_slo(frame, 0, None, "batch"))
+    assert (prio, deadline, klass) == (0, None, "batch")
+    assert bytes(inner) == frame
+    # a bare (pre-SLO) frame passes through untouched with no defaults
+    # applied here — the router applies its own
+    assert wire.read_slo(frame) == (None, None, None, frame)
+    # priority is a u8 on the wire: out-of-range raises instead of
+    # silently wrapping (which would invert dispatch order)
+    for bad in (-1, 256):
+        with pytest.raises(ValueError, match="priority"):
+            wire.pack_slo(frame, bad, None, "interactive")
+    # header survives the coalescing pack/iter hop
+    packed = wire.pack([msg, frame])
+    got = [bytes(m) for m in wire.iter_messages(packed)]
+    assert got == [msg, frame]
+
+
+def test_slo_classes_and_rejected_error_fields():
+    classes = default_slo_classes()
+    assert classes["interactive"].priority < classes["standard"].priority \
+        < classes["batch"].priority
+    assert all(c.deadline_ms is None for c in classes.values())
+    e = slo.rejected("interactive", 0, "expired", -12.5, 37, 16)
+    assert isinstance(e, RejectedError) and isinstance(e, RuntimeError)
+    assert e.slo == "interactive" and e.priority == 0
+    assert e.reason == "expired" and e.queue_depth == 37
+    assert e.outstanding == 16
+    assert "interactive" in str(e) and "queue depth 37" in str(e)
+    # picklable with defaulted ctor args (a client may re-raise across
+    # its own process boundary)
+    import pickle
+
+    e2 = pickle.loads(pickle.dumps(e))
+    assert isinstance(e2, RejectedError)
+
+
+# -- admission shedding (no workers needed) --------------------------------
+
+def test_submit_expired_deadline_is_immediate_structured_reject():
+    router = Router("/nonexistent", replicas=1)  # never started
+    before = obs.FLEET_SHED.value(**{"class": "interactive"})
+    fut = router.submit((np.zeros(4, np.float32),), slo="interactive",
+                        deadline_ms=0)
+    t0 = time.perf_counter()
+    with pytest.raises(RejectedError) as ei:
+        fut.result(timeout=5)
+    # an explicit reject, essentially instant — NOT a timeout
+    assert time.perf_counter() - t0 < 1.0
+    assert ei.value.reason == "expired"
+    assert ei.value.slo == "interactive"
+    assert ei.value.queue_depth is not None
+    assert obs.FLEET_SHED.value(**{"class": "interactive"}) - before == 1
+    # the shed is not a predict failure (rejects are answers, not errors)
+    line = [ln for ln in obs.export.to_prometheus().splitlines()
+            if ln.startswith('paddle_tpu_fleet_shed_total{class="interactive"}')]
+    assert line, "shed exposition line missing"
+
+
+def test_submit_unknown_slo_class_raises():
+    router = Router("/nonexistent", replicas=1)
+    with pytest.raises(ValueError, match="unknown SLO class"):
+        router.submit((np.zeros(2, np.float32),), slo="no-such-class")
+
+
+def test_custom_classes_and_class_default_deadline():
+    classes = {"rt": SLOClass("rt", 0, deadline_ms=0.0)}
+    router = Router("/nonexistent", replicas=1, slo_classes=classes,
+                    default_slo="rt")
+    # the class's own deadline arms shedding with no per-call argument
+    with pytest.raises(RejectedError):
+        router.submit((np.zeros(2, np.float32),)).result(timeout=5)
+
+
+# -- _wait_ready names the effective deadline (satellite fix) -------------
+
+def test_wait_ready_error_names_effective_deadline():
+    router = Router("/nonexistent", replicas=1, start_timeout=300.0)
+    w = _Worker(0, "replica0")  # never spawned: ready_ev never fires
+    t0 = time.perf_counter()
+    with pytest.raises(RuntimeError) as ei:
+        router._wait_ready([w], timeout=0.3)
+    assert time.perf_counter() - t0 < 5.0
+    msg = str(ei.value)
+    # the message names the 0.3s per-call budget, NOT start_timeout
+    assert "0.3s" in msg and "300" not in msg.split("start_timeout")[0], msg
+    assert "per-call deadline" in msg
+    # the default path still names start_timeout without the suffix
+    router2 = Router("/nonexistent", replicas=1, start_timeout=0.3)
+    with pytest.raises(RuntimeError) as ei2:
+        router2._wait_ready([_Worker(0, "replica0")])
+    assert "per-call deadline" not in str(ei2.value)
+
+
+def test_replace_worker_swaps_by_identity_not_position():
+    """A concurrent remove_replica/reap_dead shifts list positions
+    mid-drain_restart: the replacement swap must follow the drained
+    worker's IDENTITY, and append when it was reaped meanwhile."""
+    router = Router("/nonexistent", replicas=3)  # never started
+    a, b, c = _Worker(0, "replica0"), _Worker(1, "replica1"), \
+        _Worker(2, "replica2")
+    router._workers = [a, b, c]
+    nw = _Worker(2, "replica2")
+    del router._workers[0]  # autoscaler drain-shrank the neighbour
+    router._replace_worker(c, nw)
+    assert router._workers == [b, nw]
+    # old already reaped from the list: the fleet still grows back
+    nw2 = _Worker(1, "replica1")
+    router._workers = [nw]
+    router._replace_worker(b, nw2)
+    assert router._workers == [nw, nw2]
+
+
+# -- Autoscaler control loop ----------------------------------------------
+
+class FakeRouter:
+    """Duck-typed Router: just the knobs/signals the Autoscaler uses."""
+
+    def __init__(self, ready=1, max_outstanding=8):
+        self.st = {"replicas": ready, "ready": ready, "starting": 0,
+                   "draining": 0, "dead": 0, "outstanding": 0,
+                   "max_outstanding": max_outstanding, "pending": 0,
+                   "queued": 0, "shed": 0}
+        self.added = 0
+        self.removed = 0
+        self.reaps = 0
+        self.hold_when_dead = False
+
+    def stats(self):
+        return dict(self.st)
+
+    def add_replica(self, timeout=None):
+        self.added += 1
+        self.st["ready"] += 1
+        self.st["replicas"] += 1
+        return "replica%d" % self.st["ready"]
+
+    def remove_replica(self, idx=None, timeout=300.0):
+        self.removed += 1
+        self.st["ready"] -= 1
+        self.st["replicas"] -= 1
+        return "gone"
+
+    def reap_dead(self):
+        self.reaps += 1
+        n = self.st["dead"]
+        self.st["dead"] = 0
+        self.st["replicas"] -= n
+        return ["deadreplica"] * n
+
+
+def test_autoscaler_validates_config():
+    r = FakeRouter()
+    with pytest.raises(ValueError):
+        Autoscaler(r, min_replicas=0)
+    with pytest.raises(ValueError):
+        Autoscaler(r, min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        Autoscaler(r, low_util=0.8, high_util=0.5)
+
+
+def test_autoscaler_arms_hold_when_dead_only_while_running():
+    r = FakeRouter()
+    a = Autoscaler(r, heal=True)
+    # construction alone must NOT revoke the router's fast-fail
+    # contract — only a RUNNING healer makes an all-dead fleet a
+    # transient worth holding requests for
+    assert r.hold_when_dead is False
+    a.start()
+    assert r.hold_when_dead is True
+    a.stop()
+    assert r.hold_when_dead is False
+    r2 = FakeRouter()
+    a2 = Autoscaler(r2, heal=False)
+    a2.start()
+    assert r2.hold_when_dead is False
+    a2.stop()
+
+
+def test_scale_up_needs_consecutive_high_ticks_then_cooldown():
+    r = FakeRouter(ready=1, max_outstanding=8)
+    a = Autoscaler(r, min_replicas=1, max_replicas=3, up_ticks=2,
+                   cooldown_s=10.0, high_util=0.75, low_util=0.2)
+    r.st["outstanding"] = 8  # util 1.0
+    assert a.tick(now=0.0) is None      # streak 1 of 2
+    assert a.tick(now=1.0) == "up"      # streak 2 -> action
+    assert r.added == 1
+    r.st["outstanding"] = 16            # still saturated at 2 replicas
+    assert a.tick(now=2.0) is None      # cooldown gates the action...
+    assert a.tick(now=3.0) is None
+    assert a.tick(now=12.0) == "up"     # ...until it elapses
+    assert r.added == 2
+    r.st["outstanding"] = 48
+    a2 = [a.tick(now=t) for t in (30.0, 31.0)]
+    assert a2[-1] is None and r.added == 2  # max_replicas respected
+
+
+def test_shed_delta_is_an_immediate_overload_signal():
+    r = FakeRouter(ready=1)
+    a = Autoscaler(r, min_replicas=1, max_replicas=2, up_ticks=1,
+                   cooldown_s=0.0)
+    # the signal is THIS router's stats()["shed"] delta, not the
+    # process-global obs series (another fleet's sheds must not scale
+    # this one) — and the first tick only establishes the baseline
+    assert a.tick(now=0.0) is None
+    r.st["shed"] += 1  # idle utilization, but a shed since last tick
+    assert a.tick(now=1.0) == "up"
+    assert r.added == 1
+
+
+def test_drain_shrink_needs_long_low_streak_and_respects_min():
+    r = FakeRouter(ready=3, max_outstanding=8)
+    a = Autoscaler(r, min_replicas=1, max_replicas=3, down_ticks=3,
+                   cooldown_s=0.0, low_util=0.2)
+    r.st["outstanding"] = 0
+    assert a.tick(now=0.0) is None
+    assert a.tick(now=1.0) is None
+    assert a.tick(now=2.0) == "down"
+    assert r.removed == 1
+    # a busy tick resets the streak
+    assert a.tick(now=3.0) is None
+    r.st["outstanding"] = 16
+    assert a.tick(now=4.0) is None      # busy: streak resets
+    r.st["outstanding"] = 0
+    assert a.tick(now=5.0) is None
+    assert a.tick(now=6.0) is None
+    assert a.tick(now=7.0) == "down"
+    assert r.st["ready"] == 1
+    # at the floor: never below min_replicas
+    for t in (8.0, 9.0, 10.0, 11.0):
+        assert a.tick(now=t) is None
+    assert r.st["ready"] == 1
+
+
+def test_heal_reaps_dead_and_restores_floor_ignoring_cooldown():
+    r = FakeRouter(ready=2, max_outstanding=8)
+    a = Autoscaler(r, min_replicas=2, max_replicas=3, cooldown_s=100.0,
+                   up_ticks=1)
+    r.st["outstanding"] = 16
+    assert a.tick(now=0.0) == "up"      # action starts the cooldown
+    # replicas crash below the floor: heal acts DESPITE the cooldown
+    r.st["ready"] = 1
+    r.st["dead"] = 2
+    assert a.tick(now=1.0) == "heal"
+    assert r.reaps >= 1 and r.st["dead"] == 0
+    assert r.st["ready"] == 2
+
+
+def test_failed_action_does_not_kill_the_loop():
+    class Exploding(FakeRouter):
+        def add_replica(self, timeout=None):
+            raise RuntimeError("spawn failed")
+
+    r = Exploding(ready=1)
+    a = Autoscaler(r, min_replicas=1, max_replicas=2, up_ticks=1,
+                   cooldown_s=0.0)
+    r.st["outstanding"] = 8
+    assert a.tick(now=0.0) is None      # swallowed, no action recorded
+    assert a.actions == []
+    # still willing to retry next tick
+    assert a.tick(now=1.0) is None

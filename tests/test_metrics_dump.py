@@ -31,6 +31,9 @@ _REQUIRED_SERIES = (
     "paddle_tpu_quant_calib_batches_total",
     "paddle_tpu_quant_quantized_ops_total",
     "paddle_tpu_quant_parity_max_abs_diff",
+    # bounded-latency load shedding (ISSUE 13): every shed is an
+    # explicit reject AND a tick of this per-class series
+    "paddle_tpu_fleet_shed_total",
 )
 
 
@@ -55,6 +58,9 @@ def test_prometheus_exposition_contains_required_series(dump_output):
     assert "# TYPE paddle_tpu_compile_total counter" in text
     assert "# TYPE paddle_tpu_step_latency_ms histogram" in text
     assert 'le="+Inf"' in text
+    # the shed series carries its SLO class as a label, exactly this
+    # exposition line (dashboards/alerts key on it)
+    assert 'paddle_tpu_fleet_shed_total{class="interactive"} 1' in text
 
 
 def test_histogram_buckets_are_cumulative_and_consistent(dump_output):
@@ -120,6 +126,12 @@ def test_replica_label_and_merge(tmp_path):
         assert snap["replica"] == name
         steps = snap["metrics"]["paddle_tpu_steps_total"]["series"]
         assert all(s["labels"]["replica"] == name for s in steps)
+        # the shed series rides every worker dump too (ISSUE 13): one
+        # admission-path shed, labeled by class AND this replica
+        shed = snap["metrics"]["paddle_tpu_fleet_shed_total"]["series"]
+        assert [s["labels"] for s in shed] == [
+            {"class": "interactive", "replica": name}]
+        assert [s["value"] for s in shed] == [1]
         path = tmp_path / ("%s.json" % name)
         path.write_text(proc.stdout)
         dumps.append((str(path), snap))
@@ -140,6 +152,12 @@ def test_replica_label_and_merge(tmp_path):
     want = sum(total(s["metrics"]["paddle_tpu_steps_total"]["series"])
                for _p, s in dumps)
     assert total(series) == want
+    # fleet_shed_total merges collision-free too: per-replica series
+    # stay addressable, the fleet-wide shed count is their sum
+    shed = merged["metrics"]["paddle_tpu_fleet_shed_total"]["series"]
+    assert sorted(s["labels"]["replica"] for s in shed) == ["w0", "w1"]
+    assert all(s["labels"]["class"] == "interactive" for s in shed)
+    assert total(shed) == 2
 
 
 def test_unlabeled_export_format_unchanged():

@@ -248,6 +248,104 @@ def test_worker_crash_requeues_in_flight(model):
     assert obs.FLEET_REQUEUED.total() >= req0
 
 
+def test_double_fault_replacement_killed_during_drain_restart(model):
+    """Double fault (ISSUE 13 satellite): the REPLACEMENT worker is
+    SIGKILLed during ``drain_restart`` — at the ``serving.worker_boot``
+    fault barrier, before it ever reports ready. The Router must retry
+    the spawn (phase 1: the retry boots clean and the restart succeeds)
+    or, with every attempt exhausted, raise actionably while the
+    survivor keeps serving (phase 2) — zero dropped, zero misversioned
+    requests throughout either way."""
+    model_dir, feed, want = model
+    router = Router(model_dir, replicas=2, max_batch=4,
+                    jax_platform="cpu", start_timeout=300,
+                    spawn_retries=1)
+    router.start()
+    mis0 = obs.FLEET_MISVERSIONED.total()
+    stop = threading.Event()
+    errs, served = [], [0]
+
+    def client(cid):
+        try:
+            rs = np.random.RandomState(cid)
+            while not stop.is_set():
+                i = rs.randint(0, 5)
+                row = router.submit((feed[i],)).result(timeout=120)
+                if not np.allclose(row[0], want[i], rtol=1e-4, atol=1e-5):
+                    errs.append("client %d row %d diverged" % (cid, i))
+                served[0] += 1
+        except Exception as e:  # pragma: no cover - failure reporting
+            errs.append("client %d: %r" % (cid, e))
+
+    def unarm_after_first_replacement(orig_proc, unarmed):
+        # the kill spec rides _opts["env"] (read at each _spawn), so
+        # dropping it the moment attempt 1 exists makes attempt 2 boot
+        # clean — attempt 1 itself already inherited the armed env and
+        # dies inside its boot DELAY window, deterministically
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            w = router._workers[0]
+            if w.proc is not None and w.proc is not orig_proc:
+                router._opts["env"].pop("PADDLE_TPU_FAULT_KILL", None)
+                router._opts["env"].pop("PADDLE_TPU_FAULT_DELAY", None)
+                unarmed.set()
+                return
+            time.sleep(0.02)
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(2)]
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(0.3)  # load established
+        # -- phase 1: first replacement dies at boot, the retry serves --
+        router._opts["env"]["PADDLE_TPU_FAULT_KILL"] = "serving.worker_boot"
+        router._opts["env"]["PADDLE_TPU_FAULT_DELAY"] = \
+            "serving.worker_boot:2.0"
+        unarmed = threading.Event()
+        orig = router._workers[0].proc
+        watcher = threading.Thread(
+            target=unarm_after_first_replacement, args=(orig, unarmed))
+        watcher.start()
+        router.drain_restart(0, timeout=300)
+        watcher.join(timeout=120)
+        assert unarmed.is_set(), "watcher never saw the first replacement"
+        states = [w["state"] for w in router.health()]
+        assert states == ["ready", "ready"], states
+        # -- phase 2: kill EVERY attempt -> actionable raise, survivor
+        # unharmed (no boot delay: dead attempts should fail fast) --
+        router._opts["env"]["PADDLE_TPU_FAULT_KILL"] = "serving.worker_boot"
+        with pytest.raises(RuntimeError) as ei:
+            router.drain_restart(0, timeout=300)
+        msg = str(ei.value)
+        assert "could not be respawned" in msg
+        assert "2 attempts" in msg
+        assert "reap_dead" in msg  # the heal path, named for the operator
+        # the reader thread marks the dead replacement on EOF — poll
+        deadline = time.monotonic() + 30
+        while (time.monotonic() < deadline
+               and [w["state"] for w in router.health()]
+               != ["dead", "ready"]):
+            time.sleep(0.05)
+        states = [w["state"] for w in router.health()]
+        assert states == ["dead", "ready"], states
+        time.sleep(0.3)  # survivor keeps serving through the outage
+        # -- heal: reap the dead replacement, grow back to 2 ---------------
+        router._opts["env"].pop("PADDLE_TPU_FAULT_KILL", None)
+        assert router.reap_dead() == ["replica0"]
+        router.add_replica(timeout=300)
+        assert [w["state"] for w in router.health()] == ["ready", "ready"]
+        time.sleep(0.3)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=120)
+        router.stop()
+    assert not errs, errs[:5]
+    assert served[0] > 0
+    assert obs.FLEET_MISVERSIONED.total() - mis0 == 0
+
+
 # -- sharded (tp) serving -------------------------------------------------
 
 @pytest.mark.skipif(jax.device_count() < 2,

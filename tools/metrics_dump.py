@@ -110,6 +110,24 @@ def predict_roundtrip(tmpdir: str):
     parity_report(p, q, feeds, logits_tol=0.1)
 
 
+def shed_round():
+    """One load-shed through the REAL admission path (Router.submit with
+    an already-expired deadline needs no worker processes), so the
+    ``paddle_tpu_fleet_shed_total{class=...}`` exposition line ships
+    through the same pinned format — a rename or label change fails
+    tier-1 before it breaks a fleet dashboard."""
+    import numpy as np
+
+    from paddle_tpu.serving import RejectedError, Router
+
+    router = Router("/nonexistent-model-dir", replicas=1)
+    try:
+        router.submit((np.zeros(2, np.float32),), slo="interactive",
+                      deadline_ms=0).result(timeout=1)
+    except RejectedError:
+        pass
+
+
 def merge_dumps(paths):
     """Load each JSON dump and print the aggregated snapshot. Stays off
     the jax import path ENTIRELY: merging is pure dict arithmetic
@@ -168,6 +186,7 @@ def main():
 
         obs.set_replica(args.replica)
     tiny_train_loop(args.steps)
+    shed_round()
     if not args.no_predict:
         import tempfile
 
