@@ -34,6 +34,7 @@ from .ops import attention as _k_attention  # noqa: F401
 from .ops import fused_loss as _k_fused_loss  # noqa: F401
 from .ops import kv_cache as _k_kv_cache  # noqa: F401
 from .ops import sampling as _k_sampling  # noqa: F401
+from .ops import speculative as _k_speculative  # noqa: F401
 from .ops import quant as _k_quant  # noqa: F401
 from .ops import detection as _k_detection  # noqa: F401
 

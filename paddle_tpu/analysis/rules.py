@@ -1118,6 +1118,78 @@ def _infer_cache_gather(ctx: InferContext):
     return {"Out": VarInfo((n,) + tuple(c.shape[1:]), c.dtype)}
 
 
+@register_infer("cache_append_window")
+def _infer_cache_append_window(ctx: InferContext):
+    """Windowed slab append (speculative verify / prefix extension):
+    Out is Cache's shape/dtype; New (B, T, ...) rows must match Cache's
+    row shape (any T — the window width is the free axis)."""
+    c = ctx.in_info("Cache")
+    n = ctx.in_shape("New")
+    if c.shape is not None and n is not None:
+        if len(n) != len(c.shape):
+            raise InferError(
+                "New%s rank does not match Cache%s (window appends are "
+                "(B, T, ...) against (B, S, ...))"
+                % (render_shape(n), render_shape(c.shape)))
+        tail, want = n[2:], tuple(c.shape[2:])
+        if (len(tail) != len(want)
+            or any(a is not None and b is not None and a != b
+                   for a, b in zip(tail, want))):
+            raise InferError(
+                "New%s row shape does not match Cache%s rows"
+                % (render_shape(n), render_shape(c.shape)))
+    return {"Out": VarInfo(c.shape, c.dtype)}
+
+
+@register_infer("decode_attention_window")
+def _infer_decode_attention_window(ctx: InferContext):
+    """Q (B, T, H, Dh) x KCache/VCache (B, S, H, Dh) -> Out = Q shape
+    (the decode_attention contract with a free window width T)."""
+    q = ctx.in_info("Q")
+    qs = q.shape
+    if qs is not None and len(qs) != 4:
+        raise InferError("Q must be rank 4 (B, T, H, Dh), got rank %d"
+                         % len(qs))
+    for slot in ("KCache", "VCache"):
+        c = ctx.in_shape(slot)
+        if qs is None or c is None:
+            continue
+        if len(c) != 4:
+            raise InferError("%s must be rank 4 (B, S, H, Dh), got rank "
+                             "%d" % (slot, len(c)))
+        for qi, ci, label in ((0, 0, "batch"), (2, 2, "head"),
+                              (3, 3, "depth")):
+            if qs[qi] is not None and c[ci] is not None \
+                    and qs[qi] != c[ci]:
+                raise InferError(
+                    "%s %s dim %d does not match Q%s"
+                    % (slot, label, c[ci], render_shape(qs)))
+    return {"Out": VarInfo(qs, q.dtype)}
+
+
+@register_infer("spec_accept")
+def _infer_spec_accept(ctx: InferContext):
+    """Proposed (B, T) window tokens x Logits (B, T, V) -> NextIds
+    (B, T) int64 + Accept (B,) int32; the leading (B, T) dims must
+    agree."""
+    p = ctx.in_shape("Proposed")
+    lg = ctx.in_shape("Logits")
+    if p is not None and len(p) != 2:
+        raise InferError("Proposed must be (B, T), got rank %d" % len(p))
+    if lg is not None and len(lg) != 3:
+        raise InferError("Logits must be (B, T, V), got rank %d" % len(lg))
+    if p is not None and lg is not None:
+        for i, label in ((0, "batch"), (1, "window")):
+            if p[i] is not None and lg[i] is not None and p[i] != lg[i]:
+                raise InferError(
+                    "Logits %s dim %d does not match Proposed%s"
+                    % (label, lg[i], render_shape(p)))
+    b = p[0] if p is not None else (lg[0] if lg is not None else None)
+    t = p[1] if p is not None else (lg[1] if lg is not None else None)
+    return {"NextIds": VarInfo((b, t), "int64"),
+            "Accept": VarInfo((b,), "int32")}
+
+
 @register_infer("greedy_sample", "top_k_sample", "top_p_sample")
 def _infer_sample(ctx: InferContext):
     """(B, V) or (B, 1, V) logits -> (B,) int64 sampled ids."""

@@ -110,9 +110,12 @@ __all__ = [
     "fused_lm_head_loss",
     "decode_attention",
     "decode_attention_quant",
+    "decode_attention_window",
     "cache_append",
     "cache_append_quant",
+    "cache_append_window",
     "cache_gather",
+    "spec_accept",
     "greedy_sample",
     "top_k_sample",
     "top_p_sample",
@@ -2230,6 +2233,64 @@ def cache_gather(cache, index, name=None):
         attrs={},
     )
     return out
+
+
+def cache_append_window(cache, new, pos, name=None):
+    """Append T rows per sequence into a KV slab: ``new`` (B, T, ...)
+    lands at rows ``pos[b]..pos[b]+T-1`` of ``cache`` (B, S, ...) — the
+    speculative verify / prefix suffix-extension widening of
+    ``cache_append`` (kernel: ops/speculative.py)."""
+    helper = LayerHelper("cache_append_window", name=name)
+    out = helper.create_variable_for_type_inference(
+        cache.dtype, shape=cache.shape)
+    helper.append_op(
+        type="cache_append_window",
+        inputs={"Cache": [cache], "New": [new], "Pos": [pos]},
+        outputs={"Out": [out]},
+        attrs={},
+    )
+    return out
+
+
+def decode_attention_window(q, k_cache, v_cache, lengths, scale=None,
+                            name=None):
+    """T-query decode attention with the staircase window mask: window
+    query i attends ``lengths[b] + i + 1`` slab rows — what T
+    sequential ``decode_attention`` steps would see, in ONE call (the
+    speculative verify step; kernel: ops/speculative.py)."""
+    helper = LayerHelper("decode_attention_window", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype, shape=q.shape)
+    helper.append_op(
+        type="decode_attention_window",
+        inputs={"Q": [q], "KCache": [k_cache], "VCache": [v_cache],
+                "Lengths": [lengths]},
+        outputs={"Out": [out]},
+        attrs={"scale": scale},
+    )
+    return out
+
+
+def spec_accept(proposed, logits, name=None):
+    """In-graph speculative accept/reject: window tokens ``proposed``
+    (B, T) vs target ``logits`` (B, T, V) -> (next_ids (B, T) int64,
+    accept (B,) int32 longest-matching-prefix count). The caller emits
+    ``next_ids[b, :accept[b]+1]`` and rolls rejected slab rows back by
+    length truncation (kernel: ops/speculative.py)."""
+    helper = LayerHelper("spec_accept", name=name)
+    b = proposed.shape[0] if proposed.shape else None
+    t = proposed.shape[1] if proposed.shape and len(proposed.shape) > 1 \
+        else None
+    next_ids = helper.create_variable_for_type_inference(
+        "int64", shape=(b, t))
+    accept = helper.create_variable_for_type_inference(
+        "int32", shape=(b,))
+    helper.append_op(
+        type="spec_accept",
+        inputs={"Proposed": [proposed], "Logits": [logits]},
+        outputs={"NextIds": [next_ids], "Accept": [accept]},
+        attrs={},
+    )
+    return next_ids, accept
 
 
 def greedy_sample(logits, name=None):

@@ -343,7 +343,8 @@ def transformer_lm(
 
 def _cached_self_attention(h, n_head, d_model, name, k_cache=None,
                            v_cache=None, lengths=None, kv_lengths=None,
-                           k_scale=None, v_scale=None):
+                           k_scale=None, v_scale=None, use_ring=False,
+                           sp_axis="sp", window=False):
     """transformer_lm's self-attention with its K/V exposed.
 
     Prefill mode (no caches): full causal flash attention over (B, S);
@@ -357,7 +358,21 @@ def _cached_self_attention(h, n_head, d_model, name, k_cache=None,
     opt-in): appends quantize each fresh row against its own scale and
     attention dequantizes on read; returns (out, new_k, new_v,
     new_k_scale, new_v_scale). Parameter names and creation order
-    match multi_head_attention(fused_qkv=False) verbatim."""
+    match multi_head_attention(fused_qkv=False) verbatim.
+
+    ``use_ring=True`` (prefill mode only) routes the causal attention
+    through the sequence-parallel ring op instead of fused flash
+    attention — the long-context prefill path: under a ParallelExecutor
+    whose mesh has ``sp_axis`` the sequence dim shards across chips; on
+    a single device the ring op falls back to exact attention, so the
+    Program stays portable. The returned K/V slabs are the SAME
+    (B, S, H, Dh) BTHD tensors either way — decode always runs dense.
+
+    ``window=True`` (decode mode, T > 1): the speculative verify /
+    prefix-extension step — T fresh rows append per slot
+    (cache_append_window) and T queries attend with the staircase mask
+    (decode_attention_window), so verifying k draft tokens is ONE call
+    instead of k sequential steps."""
     B, T, _ = h.shape
     d_head = d_model // n_head
     q = _linear(h, d_model, name + ".q")
@@ -367,10 +382,28 @@ def _cached_self_attention(h, n_head, d_model, name, k_cache=None,
     k = layers.reshape(k, shape=[B, T, n_head, d_head])
     v = layers.reshape(v, shape=[B, T, n_head, d_head])
     if k_cache is None:
-        ctx = layers.fused_attention(q, k, v, causal=True, layout="bthd")
+        if use_ring:
+            # ring attention keeps BHTD (its sequence axis is the
+            # ppermute'd one); the slabs stay the BTHD projections
+            qr = layers.transpose(q, perm=[0, 2, 1, 3])
+            kr = layers.transpose(k, perm=[0, 2, 1, 3])
+            vr = layers.transpose(v, perm=[0, 2, 1, 3])
+            ctx = layers.ring_attention(qr, kr, vr, causal=True,
+                                        sp_axis=sp_axis)
+            ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
+        else:
+            ctx = layers.fused_attention(q, k, v, causal=True,
+                                         layout="bthd")
         out = _linear(layers.reshape(ctx, shape=[B, T, d_model]),
                       d_model, name + ".out")
         return out, k, v
+    if window:
+        new_k = layers.cache_append_window(k_cache, k, lengths)
+        new_v = layers.cache_append_window(v_cache, v, lengths)
+        ctx = layers.decode_attention_window(q, new_k, new_v, lengths)
+        out = _linear(layers.reshape(ctx, shape=[B, T, d_model]),
+                      d_model, name + ".out")
+        return out, new_k, new_v
     if k_scale is not None:
         new_k, new_ks = layers.cache_append_quant(k_cache, k_scale, k,
                                                   lengths)
@@ -409,6 +442,7 @@ def _lm_head_logits(x, vocab_size, tie_embeddings, prefix):
 def transformer_lm_prefill(
     tokens, lengths, vocab_size, n_layer=4, n_head=8, d_model=512,
     d_inner=2048, max_len=2048, tie_embeddings=False, prefix="lm",
+    use_ring_attention=False, sp_axis="sp",
 ):
     """Prefill graph: run the full causal forward over padded prompts
     ``tokens`` (B, S) with ``lengths`` (B,) valid tokens, POPULATING the
@@ -420,14 +454,25 @@ def transformer_lm_prefill(
     materializes), caches is [(k_0, v_0), ...] per layer in the
     (B, S, H, Dh) slab layout. Positions past a row's length hold
     garbage K/V — decode_attention masks them by length, so they are
-    never read."""
+    never read.
+
+    ``use_ring_attention=True`` is the LONG-CONTEXT prefill: every
+    self-attention runs the sequence-parallel ring (layers.
+    ring_attention), so compiling under a mesh with ``sp_axis`` shards
+    the prompt's sequence dim across chips — prompts far beyond one
+    chip's dense-bucket range prefill sharded, then decode continues
+    from the same dense (B, S, H, Dh) slabs. On a single device the
+    ring op falls back to exact attention, so the graph is portable
+    (and CPU-testable; the multi-chip chunked path needs lax.pvary —
+    jax >= 0.5 — and is gated accordingly in tests)."""
     x = _embed(tokens, vocab_size, d_model, max_len, prefix)
     B, S = tokens.shape
     caches = []
     for i in range(n_layer):
         h = _pre_norm(x)
         attn, k, v = _cached_self_attention(
-            h, n_head, d_model, "%s.l%d.self" % (prefix, i))
+            h, n_head, d_model, "%s.l%d.self" % (prefix, i),
+            use_ring=use_ring_attention, sp_axis=sp_axis)
         caches.append((k, v))
         x = layers.elementwise_add(x, attn)
         ffn = positionwise_ffn(_pre_norm(x), d_inner, d_model, 0.0,
@@ -522,6 +567,74 @@ def transformer_lm_decode(
         raise ValueError("unknown decode strategy %r (greedy | topk | "
                          "topp | logits)" % (strategy,))
     return next_ids, logits, new_caches
+
+
+def transformer_lm_verify(
+    tokens, positions, lengths, last_idx, k_caches, v_caches, vocab_size,
+    n_layer=4, n_head=8, d_model=512, d_inner=2048, max_len=2048,
+    tie_embeddings=False, prefix="lm",
+):
+    """One speculative VERIFY window (also the shared-prefix suffix
+    extension step): ``tokens`` (B, T) int64 — window slot 0 is each
+    sequence's committed current token, slots 1..T-1 the draft's
+    proposals — at ``positions`` (B, T), with ``lengths`` (B,) valid
+    cache rows BEFORE the window and per-layer K/V slabs (B, S, H, Dh).
+
+    Every layer appends its T fresh K/V rows at lengths..lengths+T-1
+    (cache_append_window) and runs T-query staircase attention
+    (decode_attention_window) — the whole window is ONE executable, not
+    T sequential decode steps. Returns (next_ids, accept, last_logits,
+    new_caches):
+
+    - next_ids (B, T) int64: the target's next token after each window
+      position (greedy argmax — the accept test AND the emitted
+      tokens);
+    - accept (B,) int32: accepted-proposal count per slot (longest
+      matching prefix; the caller emits next_ids[b, :accept[b]+1] and
+      advances the slot length by accept[b]+1 — rejected slab rows roll
+      back by length truncation, never by scatter-undo);
+    - last_logits (B, V): the logits row at window position
+      ``last_idx[b]`` per slot — the suffix-extension path samples its
+      first token from this exactly as a private prefill would from its
+      last-position logits.
+
+    Parameter names match transformer_lm / the other decode builders,
+    so the same loaded state drives all graph kinds."""
+    B, T = tokens.shape
+    if T < 2:
+        raise ValueError(
+            "verify windows need T >= 2 (one committed token + at least "
+            "one proposal); got T=%d" % T)
+    tok = layers.embedding(
+        input=tokens, size=[vocab_size, d_model],
+        param_attr=ParamAttr(name=prefix + ".tok_emb",
+                             initializer=NormalInitializer(0.0, 0.02)))
+    pos = layers.embedding(
+        input=positions, size=[max_len, d_model],
+        param_attr=ParamAttr(name=prefix + ".pos_emb",
+                             initializer=NormalInitializer(0.0, 0.02)))
+    x = layers.elementwise_add(tok, pos)                   # (B, T, D)
+    new_caches = []
+    for i in range(n_layer):
+        h = _pre_norm(x)
+        attn, nk, nv = _cached_self_attention(
+            h, n_head, d_model, "%s.l%d.self" % (prefix, i),
+            k_cache=k_caches[i], v_cache=v_caches[i], lengths=lengths,
+            window=True)
+        new_caches.append((nk, nv))
+        x = layers.elementwise_add(x, attn)
+        ffn = positionwise_ffn(_pre_norm(x), d_inner, d_model, 0.0,
+                               name="%s.l%d.ffn" % (prefix, i))
+        x = layers.elementwise_add(x, ffn)
+    x = _pre_norm(x)
+    flat = layers.reshape(x, shape=[B * T, d_model])
+    logits = _lm_head_logits(flat, vocab_size, tie_embeddings, prefix)
+    logits3 = layers.reshape(logits, shape=[B, T, vocab_size])
+    next_ids, accept = layers.spec_accept(tokens, logits3)
+    base = layers.assign((np.arange(B, dtype=np.int32) * T).reshape(B))
+    idx = layers.elementwise_add(layers.cast(last_idx, "int32"), base)
+    last_logits = layers.gather(logits, idx)               # (B, V)
+    return next_ids, accept, last_logits, new_caches
 
 
 def get_model(

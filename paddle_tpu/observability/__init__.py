@@ -47,6 +47,8 @@ __all__ = [
     "FLEET_REQUEUED", "FLEET_MISVERSIONED", "FLEET_BACKPRESSURE_MS",
     "FLEET_SHED", "FLEET_PENDING", "FLEET_AUTOSCALE",
     "DECODE_TOKENS", "DECODE_SLOTS", "DECODE_STEP_MS", "DECODE_REQUESTS",
+    "DECODE_PREFIX_QUERIES", "DECODE_PREFIX_HITS", "DECODE_PREFIX_BYTES",
+    "DECODE_SPEC_PROPOSED", "DECODE_SPEC_ACCEPTED",
     "CKPT_SAVES", "CKPT_BYTES", "CKPT_PENDING", "CKPT_SAVE_MS",
     "CKPT_RESTORE_MS", "CKPT_RETRIES", "CKPT_FAILURES",
     "TRANSPILE_OPS_REMOVED", "TRANSPILE_OPS_FUSED", "TRANSPILE_PASS_MS",
@@ -260,6 +262,29 @@ DECODE_REQUESTS = REGISTRY.counter(
     "paddle_tpu_decode_requests_total",
     "Decode-serving sequences, kind=admitted (entered a cache slot) | "
     "retired (finished and freed it); admitted - retired = in flight")
+DECODE_PREFIX_QUERIES = REGISTRY.counter(
+    "paddle_tpu_decode_prefix_queries_total",
+    "Shared-prefix store lookups at admission (one per admitted "
+    "prompt when prefix sharing is on)")
+DECODE_PREFIX_HITS = REGISTRY.counter(
+    "paddle_tpu_decode_prefix_hits_total",
+    "Shared-prefix store hits, by kind=full (whole prompt served from "
+    "cached K/V rows) | partial (cached header + suffix extension) | "
+    "batch (deduped against an identical prompt admitted in the same "
+    "sub-batch); hit rate = hits / queries — the ROADMAP-named signal")
+DECODE_PREFIX_BYTES = REGISTRY.gauge(
+    "paddle_tpu_decode_prefix_bytes",
+    "Resident bytes of prefilled K/V rows in the shared-prefix store "
+    "(bounded by PADDLE_TPU_PREFIX_CACHE_MAX_BYTES; refcounted entries "
+    "are eviction-exempt while sequences decode from them)")
+DECODE_SPEC_PROPOSED = REGISTRY.counter(
+    "paddle_tpu_decode_spec_proposed_total",
+    "Draft tokens proposed to speculative verify windows")
+DECODE_SPEC_ACCEPTED = REGISTRY.counter(
+    "paddle_tpu_decode_spec_accepted_total",
+    "Draft tokens the target accepted; acceptance rate = accepted / "
+    "proposed — the signal that decides whether speculation pays "
+    "(each verified round also emits one bonus token not counted here)")
 CKPT_SAVES = REGISTRY.counter(
     "paddle_tpu_ckpt_saves_total",
     "Checkpoint saves, by mode=async|sync and result=ok|error (async = "

@@ -43,8 +43,8 @@ from .engine import Engine  # noqa: F401
 
 __all__ = ["Engine", "Router", "ShardedPredictor", "worker_main",
            "DecodeConfig", "DecodePredictor", "DecodeServer",
-           "save_decode_model", "Autoscaler", "SLOClass", "RejectedError",
-           "default_slo_classes"]
+           "save_decode_model", "PrefixStore", "Autoscaler", "SLOClass",
+           "RejectedError", "default_slo_classes"]
 
 _LAZY = {
     "Router": ("router", "Router"),
@@ -54,6 +54,7 @@ _LAZY = {
     "DecodePredictor": ("decode", "DecodePredictor"),
     "DecodeServer": ("decode", "DecodeServer"),
     "save_decode_model": ("decode", "save_decode_model"),
+    "PrefixStore": ("prefix", "PrefixStore"),
     "Autoscaler": ("autoscale", "Autoscaler"),
     "SLOClass": ("slo", "SLOClass"),
     "RejectedError": ("slo", "RejectedError"),

@@ -190,7 +190,9 @@ class DecodePredictor:
     def __init__(self, model_dir: str, place=None, aot_cache: bool = True,
                  cache_dir: Optional[str] = None, strategy: str = "greedy",
                  sample_k: int = 40, sample_p: float = 0.9,
-                 temperature: float = 1.0, eos_id: Optional[int] = None):
+                 temperature: float = 1.0, eos_id: Optional[int] = None,
+                 draft_n_layer: Optional[int] = None,
+                 ring_prefill_min_seq: Optional[int] = None):
         from .. import io as fluid_io
         from ..executor import Executor, analyze_state
         from ..framework.scope import Scope
@@ -203,6 +205,26 @@ class DecodePredictor:
         self.sample_p = float(sample_p)
         self.temperature = float(temperature)
         self.eos_id = eos_id if eos_id is not None else self.config.eos_id
+        # speculative decoding: the draft is the target's FIRST
+        # draft_n_layer layers driven through the same loaded state
+        # (self-drafting — no second parameter set to ship); default
+        # half depth, floor 1
+        self.draft_n_layer = (int(draft_n_layer)
+                              if draft_n_layer is not None
+                              else max(1, self.config.n_layer // 2))
+        if not 1 <= self.draft_n_layer <= self.config.n_layer:
+            raise ValueError(
+                "draft_n_layer must be in [1, %d], got %d"
+                % (self.config.n_layer, self.draft_n_layer))
+        # long-context prefill: prompt buckets at or past this length
+        # build their prefill graph with ring attention (sequence-
+        # parallel under an sp mesh; exact-attention fallback on one
+        # device, so the knob is portable). None = always dense.
+        env_ring = os.environ.get("PADDLE_TPU_RING_PREFILL_MIN_SEQ")
+        if ring_prefill_min_seq is None and env_ring:
+            ring_prefill_min_seq = int(env_ring)
+        self.ring_prefill_min_seq = (None if not ring_prefill_min_seq
+                                     else int(ring_prefill_min_seq))
         self._scope = Scope()
         exe = Executor(place)
         if not aot_cache:
@@ -236,16 +258,22 @@ class DecodePredictor:
 
     # -- graph building ---------------------------------------------------
     def _build(self, kind: str, batch: int, seq: int, strategy: str,
-               kv_dtype: str = "float32"):
-        """Build the (batch, seq) prefill or decode Program; returns
-        (program, feed_names, fetch_names). Deterministic for given
-        arguments, so the program content fingerprint (and with it the
-        AOT key) is stable across processes. ``kv_dtype="int8"`` builds
-        the quantized-slab decode step: int8 cache feeds plus per-layer
-        (batch, seq) ``kscale_i``/``vscale_i`` scale feeds, with each
-        layer's updated (cache, cache, scales, scales) fetched back —
-        the slab bytes halve vs bf16, so one slab budget holds 2x the
-        sequences (ops/quant.py)."""
+               kv_dtype: str = "float32", window: int = 0,
+               use_ring: bool = False):
+        """Build the (batch, seq) Program for one executable kind;
+        returns (program, feed_names, fetch_names). Deterministic for
+        given arguments, so the program content fingerprint (and with
+        it the AOT key) is stable across processes.
+
+        Kinds: "prefill" (full causal forward; ``use_ring=True`` swaps
+        flash attention for the sequence-parallel ring — the
+        long-context path), "decode" (one token per step;
+        ``kv_dtype="int8"`` builds the quantized-slab variant with
+        per-layer scale feeds), "draft" (the decode step at
+        ``draft_n_layer`` depth — the speculative proposer, driven by
+        the same loaded state), and "verify" (the ``window``-token
+        speculative verify / prefix suffix-extension step: window
+        appends + staircase attention + in-graph accept)."""
         from .. import Program, layers, program_guard, unique_name
         from ..models import transformer as _T
 
@@ -266,10 +294,52 @@ class DecodePredictor:
                         d_model=cfg.d_model, d_inner=cfg.d_inner,
                         max_len=cfg.max_len,
                         tie_embeddings=cfg.tie_embeddings,
-                        prefix=cfg.prefix)
+                        prefix=cfg.prefix,
+                        use_ring_attention=use_ring)
                     feeds = ["tokens", "lengths"]
                     fetches = [logits.name] + [
                         c.name for pair in caches for c in pair]
+                elif kind == "verify":
+                    tokens = layers.data(name="tokens",
+                                         shape=[batch, window],
+                                         dtype="int64",
+                                         append_batch_size=False)
+                    positions = layers.data(name="positions",
+                                            shape=[batch, window],
+                                            dtype="int64",
+                                            append_batch_size=False)
+                    lengths = layers.data(name="lengths", shape=[batch],
+                                          dtype="int32",
+                                          append_batch_size=False)
+                    last_idx = layers.data(name="last_idx", shape=[batch],
+                                           dtype="int32",
+                                           append_batch_size=False)
+                    kc, vc = [], []
+                    for i in range(cfg.n_layer):
+                        kc.append(layers.data(
+                            name="kcache_%d" % i,
+                            shape=[batch, seq, cfg.n_head, cfg.d_head],
+                            dtype="float32", append_batch_size=False))
+                        vc.append(layers.data(
+                            name="vcache_%d" % i,
+                            shape=[batch, seq, cfg.n_head, cfg.d_head],
+                            dtype="float32", append_batch_size=False))
+                    next_ids, accept, last_logits, ncaches = (
+                        _T.transformer_lm_verify(
+                            tokens, positions, lengths, last_idx, kc, vc,
+                            cfg.vocab_size, n_layer=cfg.n_layer,
+                            n_head=cfg.n_head, d_model=cfg.d_model,
+                            d_inner=cfg.d_inner, max_len=cfg.max_len,
+                            tie_embeddings=cfg.tie_embeddings,
+                            prefix=cfg.prefix))
+                    feeds = (["tokens", "positions", "lengths",
+                              "last_idx"]
+                             + [v.name for v in kc]
+                             + [v.name for v in vc])
+                    fetches = ([next_ids.name, accept.name,
+                                last_logits.name]
+                               + [c.name for pair in ncaches
+                                  for c in pair])
                 else:
                     tokens = layers.data(name="tokens", shape=[batch, 1],
                                          dtype="int64",
@@ -285,8 +355,10 @@ class DecodePredictor:
                                        append_batch_size=False)
                     cache_dt = ("int8" if kv_dtype == "int8"
                                 else "float32")
+                    n_layer = (self.draft_n_layer if kind == "draft"
+                               else cfg.n_layer)
                     kc, vc, ks, vs = [], [], [], []
-                    for i in range(cfg.n_layer):
+                    for i in range(n_layer):
                         kc.append(layers.data(
                             name="kcache_%d" % i,
                             shape=[batch, seq, cfg.n_head, cfg.d_head],
@@ -306,7 +378,7 @@ class DecodePredictor:
                                 append_batch_size=False))
                     next_ids, logits, ncaches = _T.transformer_lm_decode(
                         tokens, positions, lengths, kc, vc, cfg.vocab_size,
-                        n_layer=cfg.n_layer, n_head=cfg.n_head,
+                        n_layer=n_layer, n_head=cfg.n_head,
                         d_model=cfg.d_model, d_inner=cfg.d_inner,
                         max_len=cfg.max_len,
                         tie_embeddings=cfg.tie_embeddings,
@@ -338,18 +410,30 @@ class DecodePredictor:
 
     def acquire(self, kind: str, batch: int, seq: int,
                 strategy: Optional[str] = None,
-                kv_dtype: str = "float32"):
-        """Executable for one (kind, batch, seq, strategy, kv_dtype)
-        signature: memory hit, else the shared Engine's
+                kv_dtype: str = "float32", window: int = 0):
+        """Executable for one (kind, batch, seq, strategy, kv_dtype,
+        window) signature: memory hit, else the shared Engine's
         disk-load-or-compile path. Returns (executable, fetch_names).
         ``kv_dtype`` only shapes decode steps (int8 slabs + scale
         feeds); prefill always emits float slabs the caller quantizes
-        at scatter time."""
+        at scatter time. ``window`` is the verify kind's token width
+        (spec_k proposals + the committed token); "draft" builds the
+        decode step at ``draft_n_layer`` depth. Prefill buckets at or
+        past ``ring_prefill_min_seq`` build with ring attention —
+        their programs fingerprint differently, so dense and ring
+        prefills coexist in the AOT cache."""
         strategy = strategy or self.strategy
-        if kind != "decode":
+        if kind not in ("decode", "draft"):
             kv_dtype = "float32"
-        ck = (kind, batch, seq, strategy if kind == "decode" else "",
-              kv_dtype)
+        if kind == "draft":
+            strategy = "greedy"  # proposals are always argmax
+        use_ring = bool(kind == "prefill"
+                        and self.ring_prefill_min_seq is not None
+                        and seq >= self.ring_prefill_min_seq)
+        ck = (kind, batch, seq,
+              strategy if kind in ("decode", "draft") else "",
+              kv_dtype, int(window),
+              self.draft_n_layer if kind == "draft" else 0, use_ring)
         with self._lock:
             hit = self._compiled.get(ck)
         if hit is not None:
@@ -360,7 +444,8 @@ class DecodePredictor:
         from ..framework.trace import RngStream, trace_block
 
         program, feed_names, fetch_names = self._build(
-            kind, batch, seq, strategy, kv_dtype=kv_dtype)
+            kind, batch, seq, strategy, kv_dtype=kv_dtype,
+            window=window, use_ring=use_ring)
         engine = Engine(program, disk=self._disk, feed_names=feed_names,
                         fetch_names=fetch_names)
         feed_structs = self._feed_structs(program, feed_names)
@@ -379,10 +464,16 @@ class DecodePredictor:
         def lower():
             # donate the feeds (the KV slabs dominate them) so XLA
             # appends cache rows IN PLACE on device backends; CPU
-            # ignores donation with a warning, so keep it off there
+            # ignores donation with a warning, so keep it off there.
+            # NEVER donate the draft step's feeds: the speculative
+            # round re-feeds the SAME committed target slabs to the
+            # verify executable after drafting — donation would consume
+            # them (the draft's appended rows are hypotheses; its
+            # returned slabs are discarded each round)
             donate = ()
             try:
-                if jax.default_backend() not in ("cpu",):
+                if kind != "draft" \
+                        and jax.default_backend() not in ("cpu",):
                     donate = (0,)
             except Exception:  # pragma: no cover
                 pass
@@ -465,16 +556,30 @@ class DecodePredictor:
     def generate(self, prompts: Sequence[np.ndarray],
                  max_new_tokens: int = 32, strategy: Optional[str] = None,
                  seed: int = 0, eos_id: Optional[int] = None,
-                 beam_size: int = 4) -> List[np.ndarray]:
+                 beam_size: int = 4, speculative: bool = False,
+                 spec_k: int = 4) -> List[np.ndarray]:
         """Generate up to ``max_new_tokens`` per prompt (stopping a row
         early at ``eos_id``). Returns one int64 array of generated ids
         per prompt. ``strategy`` overrides the constructor's
-        ("greedy" | "topk" | "topp" | "beam")."""
+        ("greedy" | "topk" | "topp" | "beam").
+
+        ``speculative=True`` (greedy only) runs draft-verify rounds:
+        the ``draft_n_layer``-deep draft proposes ``spec_k`` tokens,
+        the target checks all of them in ONE verify call — output is
+        token-for-token identical to plain greedy (the lossless
+        property), up to spec_k+1 tokens per target-model call."""
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1, got %d"
                              % max_new_tokens)
         strategy = strategy or self.strategy
         eos = eos_id if eos_id is not None else self.eos_id
+        if speculative:
+            if strategy != "greedy":
+                raise ValueError(
+                    "speculative decoding is lossless for greedy only; "
+                    "got strategy %r" % (strategy,))
+            return self.generate_speculative(
+                prompts, max_new_tokens, spec_k=spec_k, eos_id=eos)
         if strategy == "beam":
             return self.generate_beam(prompts, max_new_tokens,
                                       beam_size=beam_size, eos_id=eos)
@@ -523,6 +628,152 @@ class DecodePredictor:
                 obs.DECODE_TOKENS.inc(live, kind="decode")
                 if finished.all():
                     break
+        return [np.asarray(g, np.int64) for g in generated]
+
+    # -- speculative decoding (draft-verify rounds, greedy/lossless) -------
+    def draft_window(self, drexe, caches, cur, lens, spec_k):
+        """One speculative round's DRAFT half, shared by
+        ``generate_speculative`` and ``DecodeServer._spec_round``:
+        run ``spec_k`` reduced-depth steps over the committed slabs'
+        first ``draft_n_layer`` layers (the draft executable never
+        donates, so the committed arrays stay valid for the verify
+        feed; its returned slabs are hypotheses, dropped here) and
+        build the verify window. Returns (window_tokens (B, spec_k+1),
+        positions (B, spec_k+1)) — positions clipped to max_len-1 so
+        window slots past a row's reach still embed in range."""
+        bb = cur.shape[0]
+        dn = self.draft_n_layer
+        max_len = self.config.max_len
+        dcaches = caches[:2 * dn]
+        dcur, dlens = cur.copy(), lens.copy()
+        zeros_seed = np.zeros((1,), np.int64)
+        proposals = []
+        t0 = time.perf_counter()
+        for _ in range(spec_k):
+            feeds = {"tokens": dcur.reshape(bb, 1).astype(np.int64),
+                     "positions": np.minimum(
+                         dlens, max_len - 1).reshape(bb, 1).astype(
+                             np.int64),
+                     "lengths": dlens, "seed": zeros_seed}
+            for i in range(dn):
+                feeds["kcache_%d" % i] = dcaches[2 * i]
+                feeds["vcache_%d" % i] = dcaches[2 * i + 1]
+            douts = drexe(feeds, self._state)
+            dcur = np.asarray(douts[0]).astype(np.int64)
+            dcaches = list(douts[2:])
+            proposals.append(dcur)
+            dlens = dlens + 1
+        obs.DECODE_STEP_MS.observe((time.perf_counter() - t0) * 1e3,
+                                   stage="draft")
+        window = np.stack([cur] + proposals, axis=1)
+        positions = np.minimum(
+            lens[:, None].astype(np.int64)
+            + np.arange(spec_k + 1, dtype=np.int64)[None, :],
+            max_len - 1)
+        return window, positions
+
+    def generate_speculative(self, prompts: Sequence[np.ndarray],
+                             max_new_tokens: int = 32, spec_k: int = 4,
+                             eos_id: Optional[int] = None
+                             ) -> List[np.ndarray]:
+        """Greedy speculative decode: per round, ``spec_k`` draft steps
+        (the target's first ``draft_n_layer`` layers — self-drafting,
+        same loaded state) propose tokens, then ONE verify window call
+        checks them all against the full target and emits
+        accept+1 tokens per row. Token-for-token identical to
+        ``generate(strategy="greedy")``; when the window would overrun
+        the slab, the tail finishes on plain decode steps."""
+        if spec_k < 1:
+            raise ValueError("spec_k must be >= 1, got %d" % spec_k)
+        eos = eos_id if eos_id is not None else self.eos_id
+        tokens, lens, b, s = self._bucketed(prompts, max_new_tokens)
+        bb = tokens.shape[0]
+        outs, caches = self._prefill(tokens, lens, s)
+        obs.DECODE_TOKENS.inc(int(lens[:b].sum()), kind="prefill")
+        cur = np.array(self._sample_host(outs[0], "greedy", 0))  # writable
+        generated = [[int(cur[i])] for i in range(b)]
+        finished = np.array([(eos is not None and int(cur[i]) == eos)
+                             or max_new_tokens <= 1 for i in range(b)])
+        obs.DECODE_TOKENS.inc(b, kind="decode")
+        lens = lens.copy().astype(np.int32)
+        T = spec_k + 1
+        if not finished.all():
+            dexe, _ = self.acquire("draft", bb, s)
+            vexe, _ = self.acquire("verify", bb, s, window=T)
+        zeros_seed = np.zeros((1,), np.int64)
+        zeros_idx = np.zeros((bb,), np.int32)
+        while not finished.all() and int(lens.max()) + T <= s:
+            window, positions = self.draft_window(dexe, caches, cur,
+                                                  lens, spec_k)
+            feeds = {"tokens": window, "positions": positions,
+                     "lengths": lens, "last_idx": zeros_idx}
+            for i in range(self.config.n_layer):
+                feeds["kcache_%d" % i] = caches[2 * i]
+                feeds["vcache_%d" % i] = caches[2 * i + 1]
+            t0 = time.perf_counter()
+            vouts = vexe(feeds, self._state)
+            obs.DECODE_STEP_MS.observe(
+                (time.perf_counter() - t0) * 1e3, stage="verify")
+            next_ids = np.asarray(vouts[0]).astype(np.int64)
+            accept = np.asarray(vouts[1]).astype(np.int64)
+            caches = list(vouts[3:])
+            live = int((~finished[:b]).sum()) if b else 0
+            obs.DECODE_SPEC_PROPOSED.inc(spec_k * live)
+            emitted = 0
+            for i in range(b):
+                if finished[i]:
+                    continue
+                a = int(accept[i])
+                obs.DECODE_SPEC_ACCEPTED.inc(a)
+                take = min(a + 1,
+                           max_new_tokens - len(generated[i]))
+                for j in range(take):
+                    tok = int(next_ids[i, j])
+                    generated[i].append(tok)
+                    emitted += 1
+                    if eos is not None and tok == eos:
+                        finished[i] = True
+                        break
+                if len(generated[i]) >= max_new_tokens:
+                    finished[i] = True
+                if not finished[i]:
+                    # rollback by truncation: rows past lens+a are
+                    # rejected-window garbage, masked by length and
+                    # overwritten by later appends
+                    lens[i] += a + 1
+                    cur[i] = next_ids[i, a]
+            obs.DECODE_TOKENS.inc(emitted, kind="decode")
+        if not finished.all():
+            # slab headroom exhausted: finish the tail on plain steps
+            dexe2, _ = self.acquire("decode", bb, s, "greedy")
+            while not finished.all():
+                feeds = {"tokens": cur.reshape(bb, 1).astype(np.int64),
+                         "positions": lens.reshape(bb, 1).astype(
+                             np.int64),
+                         "lengths": lens, "seed": zeros_seed}
+                for i in range(self.config.n_layer):
+                    feeds["kcache_%d" % i] = caches[2 * i]
+                    feeds["vcache_%d" % i] = caches[2 * i + 1]
+                t0 = time.perf_counter()
+                outs = dexe2(feeds, self._state)
+                obs.DECODE_STEP_MS.observe(
+                    (time.perf_counter() - t0) * 1e3, stage="step")
+                nxt = np.asarray(outs[0]).astype(np.int64)
+                caches = list(outs[2:])
+                emitted = 0
+                for i in range(b):
+                    if finished[i]:
+                        continue
+                    tok = int(nxt[i])
+                    generated[i].append(tok)
+                    emitted += 1
+                    lens[i] += 1
+                    cur[i] = tok
+                    if (eos is not None and tok == eos) \
+                            or len(generated[i]) >= max_new_tokens \
+                            or lens[i] + 1 >= s:
+                        finished[i] = True
+                obs.DECODE_TOKENS.inc(emitted, kind="decode")
         return [np.asarray(g, np.int64) for g in generated]
 
     # -- beam-search strategy (ops-layer beam step between decode execs) ---
@@ -647,7 +898,11 @@ class DecodeServer:
                  max_seq: Optional[int] = None, max_new_tokens: int = 32,
                  strategy: Optional[str] = None, capacity: int = 256,
                  eos_id: Optional[int] = None, continuous: bool = True,
-                 prewarm: bool = True, kv_dtype: Optional[str] = None):
+                 prewarm: bool = True, kv_dtype: Optional[str] = None,
+                 speculative: bool = False, spec_k: int = 4,
+                 prefix_cache: bool = False, prefix_block: int = 16,
+                 prefix_max_bytes: Optional[int] = None,
+                 prefix_store=None):
         from ..runtime.recordio import Channel
 
         if slots < 1:
@@ -680,6 +935,48 @@ class DecodeServer:
         self.eos_id = eos_id if eos_id is not None else predictor.eos_id
         self.continuous = bool(continuous)
         self._prewarm = prewarm
+        # speculative decoding: per loop iteration, spec_k draft steps
+        # (the target's first draft_n_layer layers) propose tokens and
+        # ONE verify window call checks them — each active slot
+        # advances by accept+1 tokens per round, token-for-token
+        # identical to the plain greedy loop (lossless)
+        self.speculative = bool(speculative)
+        self.spec_k = int(spec_k)
+        if self.speculative and self.strategy != "greedy":
+            raise ValueError(
+                "speculative decoding is lossless for greedy only; the "
+                "server strategy is %r" % (self.strategy,))
+        if (self.speculative or prefix_cache or prefix_store is not None) \
+                and self.spec_k < 1:
+            # prefix-only servers still size their suffix-extension
+            # window off spec_k (_win below) — fail HERE, not as a
+            # cryptic "verify windows need T >= 2" mid-admission
+            raise ValueError("spec_k must be >= 1, got %d" % self.spec_k)
+        # shared-prefix KV: admission hashes prompts against a
+        # refcounted store of prefilled rows — N users of one prompt
+        # pay ONE prefill; prompts sharing an aligned header seed from
+        # the cached rows and extend only their suffix
+        if prefix_store is not None:
+            self._prefix = prefix_store
+        elif prefix_cache:
+            from .prefix import PrefixStore
+
+            self._prefix = PrefixStore(max_bytes=prefix_max_bytes,
+                                       block=prefix_block)
+        else:
+            self._prefix = None
+        if (self.speculative or self._prefix is not None) \
+                and self.kv_dtype == "int8":
+            raise ValueError(
+                "speculative decoding / prefix sharing run float32 "
+                "slabs (int8 scatter-quantized windows are a device-"
+                "window follow-up); drop kv_dtype='int8' or the lever")
+        # the shared verify-window width: spec rounds AND prefix suffix
+        # extension ride one compiled (slots, S, T) signature
+        self._win = self.spec_k + 1
+        # prefill-execution count — the test-pinned "N users of one
+        # prompt pay ONE prefill" observable
+        self.prefill_executions = 0
         self._chan = Channel(capacity)
         self._results: Dict[int, "_DecodeFuture"] = {}
         self._next_id = 0
@@ -774,6 +1071,11 @@ class DecodeServer:
             if self.slots > 1:
                 self.predictor.acquire("prefill",
                                        _pow2_bucket(self.slots), sp)
+            if self.speculative:
+                self.predictor.acquire("draft", self.slots, self.seq)
+            if self.speculative or self._prefix is not None:
+                self.predictor.acquire("verify", self.slots, self.seq,
+                                       window=self._win)
             obs.SERVER_STAGE_MS.observe(
                 (time.perf_counter() - t0) * 1e3, stage="prewarm")
         self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -832,6 +1134,10 @@ class DecodeServer:
         rid = slot_state["rid"]
         fut = self._pop(rid)
         obs.DECODE_REQUESTS.inc(kind="retired")
+        if self._prefix is not None:
+            # refcount release: the retired sequence no longer pins its
+            # prefix entry against eviction
+            self._prefix.release(slot_state.get("prefix_entry"))
         if fut is not None:  # abandoned via cancel/timeout otherwise
             fut.set_result([np.asarray(slot_state["generated"], np.int64)])
             obs.PREDICT_LATENCY_MS.observe(
@@ -842,10 +1148,16 @@ class DecodeServer:
         """Prefill a sub-batch of queued requests into free slots.
         ``pending`` entries are (rid, prompt, max_new, seed); returns
         the updated caches (slab rows replaced via one scatter per
-        tensor)."""
+        tensor). With a prefix store attached, admission first hashes
+        each prompt against it — hits seed from cached rows (full hit:
+        no model call at all; partial hit: suffix-only extension
+        through the verify window) and identical prompts inside one
+        sub-batch dedupe to a single prefill row."""
         free = [i for i in range(self.slots) if active[i] is None]
         batch = pending[:len(free)]
         del pending[:len(batch)]
+        if self._prefix is not None:
+            return self._admit_prefix(batch, free, caches, lens, active)
         n = len(batch)
         bb = _pow2_bucket(n)
         # prefill at the PROMPTS' own sequence bucket, not the slab
@@ -865,6 +1177,7 @@ class DecodeServer:
             t0 = time.perf_counter()
             outs = pexe({"tokens": tokens, "lengths": plens},
                         self.predictor._state)
+            self.prefill_executions += 1
         except Exception as e:
             # an admission that cannot prefill (compile error, device
             # OOM) fails ITS requests and leaves the server serving —
@@ -927,6 +1240,232 @@ class DecodeServer:
                 lens[slot] = 0
         return caches
 
+    def _first_token(self, logits_row, seed):
+        """First sampled token for one admitted sequence, honoring the
+        per-request seed contract the plain admission path applies."""
+        first = int(self.predictor._sample_host(
+            logits_row.reshape(1, -1), self.strategy, self._seed_ctr)[0])
+        self._seed_ctr += 1
+        if seed is not None and self.strategy not in ("greedy",):
+            first = int(self.predictor._sample_host(
+                logits_row.reshape(1, -1), self.strategy, seed)[0])
+        return first
+
+    def _activate(self, slot, rid, prompt, max_new, first, lens, active,
+                  entry_id):
+        """Mark one slot live after its rows are resident (common tail
+        of every admission flavor)."""
+        st = {"rid": rid, "generated": [first], "max_new": max_new,
+              "cur": first, "count": 1, "prefix_entry": entry_id}
+        if entry_id is not None:
+            self._prefix.acquire(entry_id)
+        lens[slot] = len(prompt)
+        active[slot] = st
+        obs.DECODE_REQUESTS.inc(kind="admitted")
+        obs.DECODE_TOKENS.inc(kind="decode")
+        if (self.eos_id is not None and first == self.eos_id) \
+                or max_new <= 1:
+            self._retire(st)
+            active[slot] = None
+            lens[slot] = 0
+
+    def _admit_prefix(self, batch, free, caches, lens, active):
+        """Prefix-aware admission: hash each prompt against the store;
+        full hits admit with ZERO model calls, partial hits seed the
+        cached header rows and extend only their suffix through the
+        verify window, misses (deduped within the sub-batch) prefill
+        once and populate the store. Any failure fails THIS batch and
+        leaves the server serving."""
+        from .prefix import prefix_hash
+
+        n = len(batch)
+        if n == 0:
+            return caches
+        plan: List[dict] = []
+        uniq_prompts: List[np.ndarray] = []
+        uniq_map: Dict[str, int] = {}
+        for rid, prompt, _mn, _seed in batch:
+            eid, L, rows, logits = self._prefix.lookup(prompt)
+            if eid is not None and L == len(prompt):
+                plan.append({"kind": "full", "eid": eid, "L": L,
+                             "rows": rows, "logits": logits})
+            elif eid is not None:
+                plan.append({"kind": "partial", "eid": eid, "L": L,
+                             "rows": rows})
+            else:
+                h = prefix_hash(prompt)
+                if h in uniq_map:
+                    obs.DECODE_PREFIX_HITS.inc(kind="batch")
+                    plan.append({"kind": "dup", "uniq": uniq_map[h]})
+                else:
+                    uniq_map[h] = len(uniq_prompts)
+                    uniq_prompts.append(prompt)
+                    plan.append({"kind": "miss", "uniq": uniq_map[h]})
+        try:
+            # ONE prefill over the deduped misses
+            uniq_rows: List[List[np.ndarray]] = []
+            uniq_logits: List[np.ndarray] = []
+            uniq_eids: List[Optional[int]] = []
+            if uniq_prompts:
+                bb = _pow2_bucket(len(uniq_prompts))
+                sp = min(_pow2_bucket(max(len(p) for p in uniq_prompts),
+                                      floor=16), self.seq)
+                tokens = np.zeros((bb, sp), np.int64)
+                plens = np.ones((bb,), np.int32)
+                for i, p in enumerate(uniq_prompts):
+                    tokens[i, :len(p)] = p
+                    plens[i] = len(p)
+                pexe, _ = self.predictor.acquire("prefill", bb, sp)
+                t0 = time.perf_counter()
+                outs = pexe({"tokens": tokens, "lengths": plens},
+                            self.predictor._state)
+                self.prefill_executions += 1
+                obs.DECODE_STEP_MS.observe(
+                    (time.perf_counter() - t0) * 1e3, stage="prefill")
+                obs.DECODE_TOKENS.inc(
+                    int(plens[:len(uniq_prompts)].sum()), kind="prefill")
+                sub = [np.asarray(c) for c in outs[1:]]
+                logits_all = np.asarray(outs[0])
+                for i, p in enumerate(uniq_prompts):
+                    rows = [s[i, :len(p)] for s in sub]
+                    uniq_rows.append(rows)
+                    uniq_logits.append(logits_all[i])
+                    uniq_eids.append(self._prefix.insert(
+                        p, rows, logits_all[i]))
+            # scatter every request's resident prefix rows in ONE pass
+            # per cache tensor (a per-request scatter would copy the
+            # whole slab once per request — the plain path pays one
+            # copy per admission WAVE, and so must this one). Rows
+            # shorter than the wave's max length zero-pad: the padded
+            # positions sit beyond each slot's valid length, masked by
+            # every read and overwritten by later appends.
+            ext_jobs = []   # (idx-in-batch, slot, suffix, eid)
+            seeds_rows = []  # (slot, rows, L) for the batched scatter
+            for i, ((rid, prompt, max_new, seed), p) in enumerate(
+                    zip(batch, plan)):
+                slot = free[i]
+                if p["kind"] in ("miss", "dup"):
+                    rows = uniq_rows[p["uniq"]]
+                    logits = uniq_logits[p["uniq"]]
+                    eid = uniq_eids[p["uniq"]]
+                    L = len(prompt)
+                elif p["kind"] == "full":
+                    rows, logits, eid, L = (p["rows"], p["logits"],
+                                            p["eid"], p["L"])
+                else:
+                    rows, logits, eid, L = p["rows"], None, p["eid"], \
+                        p["L"]
+                seeds_rows.append((slot, rows, L))
+                if p["kind"] == "partial":
+                    lens[slot] = L  # extension advances it to len(prompt)
+                    ext_jobs.append((i, slot, np.asarray(
+                        prompt[L:], np.int64), eid))
+                else:
+                    first = self._first_token(logits, seed)
+                    self._activate(slot, rid, prompt, max_new, first,
+                                   lens, active, eid)
+            caches = list(caches)
+            lmax = max(L for _s, _r, L in seeds_rows)
+            slot_idx = jnp.asarray(np.array(
+                [s for s, _r, _l in seeds_rows], np.int32))
+            for j in range(len(caches)):
+                stacked = np.zeros(
+                    (len(seeds_rows), lmax) + tuple(caches[j].shape[2:]),
+                    np.float32)
+                for i, (_s, rows, L) in enumerate(seeds_rows):
+                    stacked[i, :L] = rows[j]
+                caches[j] = caches[j].at[slot_idx, :lmax].set(
+                    jnp.asarray(stacked))
+        except Exception as e:
+            # pre-extension admission failed (prefill compile/run,
+            # store insert, host scatter): fail THIS batch, free its
+            # slots, release any refs it took; already-active slots
+            # keep serving — everything up to here is host-side or a
+            # non-donating scatter, so their resident rows are intact
+            for (rid, _p, _mn, _seed), slot in zip(
+                    batch, free[:len(batch)]):
+                st = active[slot]
+                if st is not None and st["rid"] == rid:
+                    if self._prefix is not None:
+                        self._prefix.release(st.get("prefix_entry"))
+                    active[slot] = None
+                self._fail(rid, e)
+                lens[slot] = 0
+            return caches
+        if ext_jobs:
+            try:
+                caches = self._extend_suffixes(ext_jobs, batch, caches,
+                                               lens, active)
+            except Exception as e:
+                # a failed verify call may have CONSUMED the fed slabs
+                # under donation (device backends) — the pre-extension
+                # cache list is not reusable, so this is the
+                # step-failure contract, not the admission one: fail
+                # the extension jobs AND every active sequence, hand
+                # back fresh slabs. No ref release for the ext jobs
+                # here: acquire happens only in _activate (after a
+                # SUCCESSFUL extension) — releasing un-acquired refs
+                # would steal another live holder's pin; jobs that DID
+                # activate are in `active`, released by the line below
+                for i, slot, _suf, _eid in ext_jobs:
+                    self._fail(batch[i][0], e)
+                    lens[slot] = 0
+                caches = self._fail_all_active(active, lens, e)
+        return caches
+
+    def _extend_suffixes(self, ext_jobs, batch, caches, lens, active):
+        """Drive partial-hit suffixes through the shared verify-window
+        executable, chunk by chunk — multi-token cached prefill on the
+        RESIDENT slab. Non-extending slots ride along untouched: their
+        window rows land past their valid lengths (masked, then
+        overwritten by their own later appends)."""
+        cfg = self.predictor.config
+        T = self._win
+        vexe, _ = self.predictor.acquire("verify", self.slots, self.seq,
+                                         window=T)
+        remaining = {slot: suf for _i, slot, suf, _e in ext_jobs}
+        offset = {slot: 0 for _i, slot, _s, _e in ext_jobs}
+        final_logits: Dict[int, np.ndarray] = {}
+        while remaining:
+            tokens = np.zeros((self.slots, T), np.int64)
+            positions = np.zeros((self.slots, T), np.int64)
+            last_idx = np.zeros((self.slots,), np.int32)
+            chunk_lens = {}
+            for slot, suf in remaining.items():
+                off = offset[slot]
+                chunk = suf[off:off + T]
+                cl = len(chunk)
+                tokens[slot, :cl] = chunk
+                positions[slot] = np.minimum(
+                    lens[slot] + np.arange(T), cfg.max_len - 1)
+                last_idx[slot] = cl - 1
+                chunk_lens[slot] = cl
+            feeds = {"tokens": tokens, "positions": positions,
+                     "lengths": lens.copy(), "last_idx": last_idx}
+            feeds.update(zip(self._cache_feed_names, caches))
+            t0 = time.perf_counter()
+            vouts = vexe(feeds, self.predictor._state)
+            obs.DECODE_STEP_MS.observe(
+                (time.perf_counter() - t0) * 1e3, stage="extend")
+            last_logits = np.asarray(vouts[2])
+            caches = list(vouts[3:])
+            done = []
+            for slot, cl in chunk_lens.items():
+                lens[slot] += cl
+                offset[slot] += cl
+                obs.DECODE_TOKENS.inc(cl, kind="prefill")
+                if offset[slot] >= len(remaining[slot]):
+                    final_logits[slot] = last_logits[slot]
+                    done.append(slot)
+            for slot in done:
+                del remaining[slot]
+        for i, slot, _suf, eid in ext_jobs:
+            rid, prompt, max_new, seed = batch[i]
+            first = self._first_token(final_logits[slot], seed)
+            self._activate(slot, rid, prompt, max_new, first, lens,
+                           active, eid)
+        return caches
+
     def _fresh_slabs(self):
         """Zeroed cache arrays in ``self._cache_feed_names`` order."""
         cfg = self.predictor.config
@@ -943,6 +1482,85 @@ class DecodeServer:
                                       jnp.float32))
         return arrs
 
+    def _fail_all_active(self, active, lens, exc):
+        """Shared step-failure recovery: a decode/draft/verify call
+        that dies (device OOM, donated-buffer misuse, backend loss)
+        must not kill the serving loop and strand every future — fail
+        the ACTIVE sequences (their cache state is no longer
+        trustworthy), release their prefix refs, free the slots, and
+        hand back FRESH slabs (the failed call may have CONSUMED the
+        fed ones under donation; lengths are all 0 now, so zeros are
+        correct)."""
+        for i, st in enumerate(active):
+            if st is not None:
+                if self._prefix is not None:
+                    self._prefix.release(st.get("prefix_entry"))
+                self._fail(st["rid"], exc)
+                obs.DECODE_REQUESTS.inc(kind="retired")
+                active[i] = None
+                lens[i] = 0
+        return self._fresh_slabs()
+
+    def _spec_round(self, drexe, vexe, caches, lens, active, n_active):
+        """One speculative round across every active slot: spec_k draft
+        steps propose, ONE verify window call checks, each slot
+        advances by its accept+1 tokens (capped by budget and slab
+        room). Greedy-lossless: the emitted tokens are the target's own
+        argmaxes, token-for-token what the plain loop would emit."""
+        k, T = self.spec_k, self._win
+        cur = np.zeros((self.slots,), np.int64)
+        for i, st in enumerate(active):
+            if st is not None:
+                cur[i] = st["cur"]
+        try:
+            window, positions = self.predictor.draft_window(
+                drexe, caches, cur, lens, k)
+            feeds = {"tokens": window, "positions": positions,
+                     "lengths": lens.copy(),
+                     "last_idx": np.zeros((self.slots,), np.int32)}
+            feeds.update(zip(self._cache_feed_names, caches))
+            t0 = time.perf_counter()
+            vouts = vexe(feeds, self.predictor._state)
+            next_ids = np.asarray(vouts[0]).astype(np.int64)
+            accept = np.asarray(vouts[1]).astype(np.int64)
+        except Exception as e:
+            return self._fail_all_active(active, lens, e)
+        obs.DECODE_STEP_MS.observe((time.perf_counter() - t0) * 1e3,
+                                   stage="verify")
+        self.step_active_counts.append(n_active)
+        caches = list(vouts[3:])
+        obs.DECODE_SPEC_PROPOSED.inc(k * n_active)
+        emitted = 0
+        for i, st in enumerate(active):
+            if st is None:
+                continue
+            a = int(accept[i])
+            obs.DECODE_SPEC_ACCEPTED.inc(a)
+            # cap by budget and slab room: window position j needs rows
+            # lens..lens+j resident, so at most seq - lens tokens
+            take = min(a + 1, st["max_new"] - st["count"],
+                       self.seq - int(lens[i]))
+            consumed = take
+            stopped = False
+            for j in range(take):
+                tok = int(next_ids[i, j])
+                st["generated"].append(tok)
+                st["cur"] = tok
+                st["count"] += 1
+                emitted += 1
+                if self.eos_id is not None and tok == self.eos_id:
+                    stopped = True
+                    consumed = j + 1
+                    break
+            lens[i] += consumed
+            if stopped or st["count"] >= st["max_new"] \
+                    or lens[i] + 1 >= self.seq:
+                self._retire(st)
+                active[i] = None
+                lens[i] = 0
+        obs.DECODE_TOKENS.inc(emitted, kind="decode")
+        return caches
+
     def _loop(self):
         caches = self._fresh_slabs()
         lens = np.zeros((self.slots,), np.int32)
@@ -951,6 +1569,11 @@ class DecodeServer:
         dexe, _ = self.predictor.acquire("decode", self.slots, self.seq,
                                          self.strategy,
                                          kv_dtype=self.kv_dtype)
+        if self.speculative:
+            drexe, _ = self.predictor.acquire("draft", self.slots,
+                                              self.seq)
+            vexe, _ = self.predictor.acquire("verify", self.slots,
+                                             self.seq, window=self._win)
         closed = False
         while True:
             n_active = sum(1 for a in active if a is not None)
@@ -997,6 +1620,12 @@ class DecodeServer:
                 if closed and not pending:
                     return
                 continue
+            if self.speculative:
+                caches = self._spec_round(drexe, vexe, caches, lens,
+                                          active, n_active)
+                self._set_slot_gauges(
+                    sum(1 for a in active if a is not None))
+                continue
             # one token across every active slot
             cur = np.zeros((self.slots,), np.int64)
             for i, st in enumerate(active):
@@ -1019,17 +1648,7 @@ class DecodeServer:
                 # and strand every future: fail the ACTIVE sequences
                 # (their cache state is no longer trustworthy), free the
                 # slots, keep serving the queue
-                for i, st in enumerate(active):
-                    if st is not None:
-                        self._fail(st["rid"], e)
-                        obs.DECODE_REQUESTS.inc(kind="retired")
-                        active[i] = None
-                        lens[i] = 0
-                # the failed call may have CONSUMED the fed slabs
-                # (donate_argnums on device backends) — reusing them
-                # next iteration would poison every future step.
-                # Lengths are all 0 now, so fresh zeros are correct.
-                caches = self._fresh_slabs()
+                caches = self._fail_all_active(active, lens, e)
                 self._set_slot_gauges(0)
                 continue
             obs.DECODE_STEP_MS.observe((time.perf_counter() - t0) * 1e3,

@@ -145,6 +145,10 @@ class Router:
                  decode: bool = False,
                  decode_slots: int = 4,
                  decode_max_seq: Optional[int] = None,
+                 decode_speculative: bool = False,
+                 decode_spec_k: int = 4,
+                 decode_draft_layers: Optional[int] = None,
+                 decode_prefix_cache: bool = False,
                  max_new_tokens: int = 32,
                  strategy: Optional[str] = None,
                  slo_classes: Optional[Dict[str, "_slo.SLOClass"]] = None,
@@ -224,6 +228,14 @@ class Router:
             "decode": bool(decode),
             "decode_slots": int(decode_slots),
             "decode_max_seq": decode_max_seq,
+            # PR-14 decode levers, per replica: draft-verify
+            # speculative rounds and the refcounted shared-prefix KV
+            # store (each worker process holds its own store; the
+            # router's sticky dispatch keeps repeat prompts warm)
+            "decode_speculative": bool(decode_speculative),
+            "decode_spec_k": int(decode_spec_k),
+            "decode_draft_layers": decode_draft_layers,
+            "decode_prefix_cache": bool(decode_prefix_cache),
             "max_new_tokens": int(max_new_tokens),
             "strategy": strategy,
         }

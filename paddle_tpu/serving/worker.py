@@ -148,14 +148,18 @@ def worker_main(conn, options):
 
             pred = DecodePredictor(
                 options["model_dir"],
-                strategy=options.get("strategy") or "greedy")
+                strategy=options.get("strategy") or "greedy",
+                draft_n_layer=options.get("decode_draft_layers"))
             version = pred.fingerprint()
             server = DecodeServer(
                 pred,
                 slots=int(options.get("decode_slots", 4)),
                 max_seq=options.get("decode_max_seq"),
                 max_new_tokens=int(options.get("max_new_tokens", 32)),
-                capacity=int(options.get("capacity", 256)))
+                capacity=int(options.get("capacity", 256)),
+                speculative=bool(options.get("decode_speculative")),
+                spec_k=int(options.get("decode_spec_k", 4)),
+                prefix_cache=bool(options.get("decode_prefix_cache")))
         else:
             if shard > 1:
                 from .sharded import ShardedPredictor

@@ -95,8 +95,9 @@ def test_program_lint_cli_json_and_exit_code(capsys):
     import json
 
     doc = json.loads(out)
-    # mlp + deepfm + lstm + the PR-9 decode step + the int8 quant example
-    assert len(doc["programs"]) == 5
+    # mlp + deepfm + lstm + the PR-9 decode step + the int8 quant
+    # example + the PR-14 speculative verify window
+    assert len(doc["programs"]) == 6
     for p in doc["programs"]:
         assert p["counts"]["error"] == 0
         assert p["infer_coverage"] == 1.0

@@ -282,9 +282,12 @@ def test_decode_metrics_exported_and_merged(pred, tmp_path):
     """Acceptance pin: the decode series reach /metrics, and
     tools/metrics_dump.py --merge aggregates snapshots containing
     them."""
-    srv = DecodeServer(pred, slots=2, max_seq=32, max_new_tokens=4)
+    srv = DecodeServer(pred, slots=2, max_seq=32, max_new_tokens=4,
+                       speculative=True, spec_k=4, prefix_cache=True,
+                       prewarm=False)
     srv.start()
-    futs = [srv.submit((p,)) for p in _prompts(3, seed=10)]
+    base = _prompts(3, seed=10)
+    futs = [srv.submit((p,)) for p in base + [base[0]]]
     for f in futs:
         f.result(timeout=300)
     port = srv.start_http(0)
@@ -295,7 +298,14 @@ def test_decode_metrics_exported_and_merged(pred, tmp_path):
     for series in ("paddle_tpu_decode_tokens_total",
                    "paddle_tpu_decode_slots",
                    "paddle_tpu_decode_step_ms_bucket",
-                   "paddle_tpu_decode_requests_total"):
+                   "paddle_tpu_decode_requests_total",
+                   # PR-14 lever series: prefix-hit-rate and
+                   # acceptance-rate ride the same scrape
+                   "paddle_tpu_decode_prefix_queries_total",
+                   "paddle_tpu_decode_prefix_hits_total",
+                   "paddle_tpu_decode_prefix_bytes",
+                   "paddle_tpu_decode_spec_proposed_total",
+                   "paddle_tpu_decode_spec_accepted_total"):
         assert series in text, series
 
     from paddle_tpu.observability import export
@@ -313,20 +323,105 @@ def test_decode_metrics_exported_and_merged(pred, tmp_path):
     assert "paddle_tpu_decode_tokens_total" in names
 
 
+# -- shared-prefix KV (PR 14) ---------------------------------------------
+
+def test_prefix_sharing_one_prefill_with_parity_and_refcounts(pred):
+    """Acceptance pin: N concurrent sequences sharing a prompt prefix
+    execute exactly ONE prefill, their outputs match private-prefill
+    sequences, and the store's refcounts release on retirement."""
+    r = np.random.RandomState(21)
+    shared = r.randint(1, V, 8).astype(np.int64)
+    want = pred.generate([shared], max_new_tokens=6)[0]
+    # slots=2 + prewarm=False: every signature this server needs is
+    # already compiled by the earlier server tests (tier-1 budget)
+    srv = DecodeServer(pred, slots=2, max_seq=32, max_new_tokens=6,
+                       prefix_cache=True, prewarm=False)
+    srv.start()
+    futs = [srv.submit((shared,)) for _ in range(6)]
+    got = [f.result(timeout=300)[0] for f in futs]
+    assert srv.prefill_executions == 1, srv.prefill_executions
+    for g in got:
+        np.testing.assert_array_equal(g, want)
+    # refcount release on retirement: nothing pins the lone entry
+    store = srv._prefix
+    assert len(store) == 1
+    assert all(store.refs(eid) == 0 for eid in store._entries)
+    srv.stop()
+
+
+def test_prefix_partial_hit_extends_suffix_only(pred):
+    """Prompts sharing a block-aligned header with a cached entry seed
+    from its rows and extend ONLY their suffix through the verify
+    window — no second full prefill — with token parity vs private
+    prefill (padded-batch GEMMs are not bitwise; greedy argmax is the
+    parity surface at this scale)."""
+    r = np.random.RandomState(22)
+    header = r.randint(1, V, 16).astype(np.int64)
+    suffixed = [np.concatenate([header,
+                                r.randint(1, V, 3).astype(np.int64)])
+                for _ in range(3)]
+    want = pred.generate(suffixed, max_new_tokens=5)
+    srv = DecodeServer(pred, slots=2, max_seq=32, max_new_tokens=5,
+                       prefix_cache=True, prewarm=False, spec_k=4)
+    srv.start()
+    # seed the store with the header's rows...
+    srv.submit((header,)).result(timeout=300)
+    assert srv.prefill_executions == 1
+    # ...then every suffixed prompt is a partial hit: zero new prefills
+    futs = [srv.submit((p,)) for p in suffixed]
+    got = [f.result(timeout=300)[0] for f in futs]
+    srv.stop()
+    assert srv.prefill_executions == 1, srv.prefill_executions
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+
+
+# -- speculative decoding (PR 14) -----------------------------------------
+
+def test_server_speculative_is_lossless(pred):
+    """Acceptance pin: greedy speculative serving output is token-for-
+    token identical to non-speculative greedy — through the continuous-
+    batching server, mixed prompt lengths and budgets."""
+    prompts = _prompts(6, seed=23)
+    budgets = [2, 6, 4, 6, 3, 5]
+    want = pred.generate(prompts, max_new_tokens=6)
+    srv = DecodeServer(pred, slots=2, max_seq=32, max_new_tokens=6,
+                       speculative=True, spec_k=4, prewarm=False)
+    srv.start()
+    futs = [srv.submit((p, np.array([mn], np.int64)))
+            for p, mn in zip(prompts, budgets)]
+    got = [f.result(timeout=300)[0] for f in futs]
+    srv.stop()
+    for g, w, mn in zip(got, want, budgets):
+        assert len(g) == mn
+        np.testing.assert_array_equal(g, w[:mn])
+
+
+# (the predictor-level speculative pins — eos truncation, draft-depth
+# sweep — live in tests/test_speculative.py, the standalone tier)
+
+
 # -- fleet path -----------------------------------------------------------
 
 def test_fleet_decode_round_trip_with_drain_restart(model_dir, pred):
     """Acceptance pin: decode requests round-trip through the PR-8
     Router fleet, and a drain_restart mid-traffic drops NOTHING — the
-    zero-drop contract extended to in-flight decode sequences."""
+    zero-drop contract extended to in-flight decode sequences. PR 14:
+    the replicas run with BOTH new levers on (speculative rounds +
+    prefix store) and the prompt list carries duplicates, so drained /
+    requeued sequences are exactly the prefix-shared and
+    mid-speculation kind the contract must survive."""
     from paddle_tpu import observability as obs
     from paddle_tpu.serving import Router
 
-    prompts = _prompts(10, seed=11)
+    prompts = _prompts(8, seed=11)
+    prompts += [prompts[0].copy(), prompts[3].copy()]  # prefix sharers
     want = pred.generate(prompts, max_new_tokens=5)
     before_mis = obs.FLEET_MISVERSIONED.value()
     router = Router(model_dir, replicas=2, decode=True, decode_slots=2,
                     decode_max_seq=32, max_new_tokens=8,
+                    decode_speculative=True, decode_spec_k=2,
+                    decode_prefix_cache=True,
                     jax_platform="cpu")
     router.start()
     opts = np.array([5], np.int64)

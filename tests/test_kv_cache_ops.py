@@ -167,6 +167,101 @@ def test_kv_cache_infer_rules():
                 {"Cache": k, "Index": np.array([0, 2, 1], np.int32)})
 
 
+# -- speculative window ops (ops/speculative.py) ---------------------------
+
+
+def test_window_ops_match_sequential_decode_steps():
+    """THE window contract: cache_append_window + decode_attention_window
+    over a T-token window produce exactly what T sequential
+    cache_append + decode_attention steps produce — the property that
+    makes the speculative verify step ONE call."""
+    from paddle_tpu.ops import speculative as sp
+
+    T = 4
+    k_slab = _rand((B, S, H, D), 7)
+    v_slab = _rand((B, S, H, D), 8)
+    q_win = _rand((B, T, H, D), 9)
+    k_win = _rand((B, T, H, D), 10)
+    v_win = _rand((B, T, H, D), 11)
+    lens = np.array([5, 0, 12], np.int32)
+
+    # sequential reference: T single-row appends + single-query reads
+    ks, vs = jnp.asarray(k_slab), jnp.asarray(v_slab)
+    seq_out = []
+    for i in range(T):
+        pos = jnp.asarray(lens + i)
+        ks = kc.cache_append(ks, jnp.asarray(k_win[:, i:i + 1]), pos)
+        vs = kc.cache_append(vs, jnp.asarray(v_win[:, i:i + 1]), pos)
+        seq_out.append(np.asarray(kc.decode_attention_reference(
+            jnp.asarray(q_win[:, i:i + 1]), ks, vs,
+            jnp.asarray(lens + i + 1))))
+    seq_out = np.concatenate(seq_out, axis=1)
+
+    new_k = sp.cache_append_window(jnp.asarray(k_slab),
+                                   jnp.asarray(k_win), jnp.asarray(lens))
+    new_v = sp.cache_append_window(jnp.asarray(v_slab),
+                                   jnp.asarray(v_win), jnp.asarray(lens))
+    np.testing.assert_array_equal(np.asarray(new_k), np.asarray(ks))
+    np.testing.assert_array_equal(np.asarray(new_v), np.asarray(vs))
+    win_out = np.asarray(sp.decode_attention_window(
+        jnp.asarray(q_win), new_k, new_v, jnp.asarray(lens)))
+    np.testing.assert_allclose(win_out, seq_out, rtol=1e-5, atol=1e-6)
+
+
+def test_cache_append_window_drops_rows_past_slab_end():
+    """Out-of-range window rows are DROPPED, not clipped: a clipped
+    write would alias onto row S-1 with unspecified scatter order and
+    could corrupt the real row there."""
+    cache = _rand((B, S, H, D), 12)
+    new = _rand((B, 3, H, D), 13)
+    pos = np.array([S - 1, 0, S - 2], np.int32)
+    out = np.asarray(run_op("cache_append_window",
+                            {"Cache": cache, "New": new, "Pos": pos})
+                     ["Out"])
+    np.testing.assert_array_equal(out[0, S - 1], new[0, 0])  # in range
+    np.testing.assert_array_equal(out[0, :S - 1], cache[0, :S - 1])
+    np.testing.assert_array_equal(out[1, 0:3], new[1])
+    np.testing.assert_array_equal(out[2, S - 2], new[2, 0])
+    np.testing.assert_array_equal(out[2, S - 1], new[2, 1])
+
+
+def test_spec_accept_counts_longest_matching_prefix():
+    from paddle_tpu.ops.speculative import spec_accept
+
+    V, T = 7, 4
+    logits = np.full((3, T, V), -1.0, np.float32)
+    # row 0: target argmaxes [2, 3, 4, 5]; proposals [2, 3, 9] -> accept 2
+    # row 1: proposals all match -> accept 3;  row 2: first differs -> 0
+    targets = np.array([[2, 3, 4, 5], [1, 2, 3, 4], [6, 0, 1, 2]])
+    for b in range(3):
+        for i in range(T):
+            logits[b, i, targets[b, i]] = 1.0
+    proposed = np.array([[0, 2, 3, 9], [0, 1, 2, 3], [0, 5, 0, 1]],
+                        np.int64)
+    next_ids, accept = spec_accept(jnp.asarray(proposed),
+                                   jnp.asarray(logits))
+    np.testing.assert_array_equal(np.asarray(next_ids), targets)
+    np.testing.assert_array_equal(np.asarray(accept), [2, 3, 0])
+    # the emitted tokens next_ids[:accept+1] are the accepted proposals
+    # plus the bonus token at the first disagreement
+    assert list(np.asarray(next_ids)[0][:3]) == [2, 3, 4]
+
+
+def test_speculative_infer_rules():
+    T = 3
+    q = _rand((B, T, H, D))
+    k = _rand((B, S, H, D))
+    lens = np.array([1] * B, np.int32)
+    check_infer("decode_attention_window",
+                {"Q": q, "KCache": k, "VCache": k, "Lengths": lens})
+    check_infer("cache_append_window",
+                {"Cache": k, "New": q, "Pos": lens})
+    check_infer("spec_accept",
+                {"Proposed": np.zeros((B, T), np.int64),
+                 "Logits": _rand((B, T, 11))},
+                outs=("NextIds", "Accept"))
+
+
 def test_decode_attention_infer_rejects_bad_slab():
     from paddle_tpu.analysis import get_infer_rule
     from paddle_tpu.analysis.infer import (

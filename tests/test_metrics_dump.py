@@ -34,6 +34,14 @@ _REQUIRED_SERIES = (
     # bounded-latency load shedding (ISSUE 13): every shed is an
     # explicit reject AND a tick of this per-class series
     "paddle_tpu_fleet_shed_total",
+    # decode-serving levers (ISSUE 14): prefix-hit-rate and
+    # acceptance-rate are the ROADMAP-named signals — queries/hits and
+    # proposed/accepted must ride the same exposition
+    "paddle_tpu_decode_prefix_queries_total",
+    "paddle_tpu_decode_prefix_hits_total",
+    "paddle_tpu_decode_prefix_bytes",
+    "paddle_tpu_decode_spec_proposed_total",
+    "paddle_tpu_decode_spec_accepted_total",
 )
 
 
@@ -61,6 +69,9 @@ def test_prometheus_exposition_contains_required_series(dump_output):
     # the shed series carries its SLO class as a label, exactly this
     # exposition line (dashboards/alerts key on it)
     assert 'paddle_tpu_fleet_shed_total{class="interactive"} 1' in text
+    # prefix hits carry their kind label the same way (full | partial |
+    # batch) — the decode_round's miss->insert->hit lands exactly one
+    assert 'paddle_tpu_decode_prefix_hits_total{kind="full"} 1' in text
 
 
 def test_histogram_buckets_are_cumulative_and_consistent(dump_output):

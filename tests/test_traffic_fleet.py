@@ -262,6 +262,67 @@ def test_scripted_trace_scale_up_burst_kill_drain_shrink(model):
     assert served > 0
 
 
+# -- decode crash requeue with the PR-14 levers live -----------------------
+
+def test_decode_crash_requeue_with_spec_and_prefix(tmp_path):
+    """SIGKILL a decode replica mid-traffic with speculative rounds and
+    the prefix store live: every in-flight sequence (mid-speculation,
+    prefix-shared alike) re-prefills on a survivor — zero drops, zero
+    misversioned, token-for-token correct output (the zero-drop
+    contract of PR 8/13 extended to the PR-14 decode levers)."""
+    from paddle_tpu import optimizer
+    from paddle_tpu.models import transformer as T
+    from paddle_tpu.serving.decode import (DecodeConfig, DecodePredictor,
+                                           save_decode_model)
+
+    V, L = 37, 2
+    model_dir = str(tmp_path / "decode_model")
+    prog, sp = fluid.Program(), fluid.Program()
+    prog.random_seed = sp.random_seed = 7
+    with fluid.program_guard(prog, sp):
+        with fluid.unique_name.guard():
+            ids = layers.data(name="ids", shape=[2, 16], dtype="int64",
+                              append_batch_size=False)
+            lbl = layers.data(name="lbl", shape=[2, 16], dtype="int64",
+                              append_batch_size=False)
+            loss, _ = T.transformer_lm(
+                ids, lbl, V, n_layer=L, n_head=2, d_model=16, d_inner=32,
+                dropout_rate=0.0, max_len=64, fused_head=False)
+            optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    r = np.random.RandomState(0)
+    with fluid.scope_guard(scope):
+        exe.run(sp)
+        x = r.randint(0, V, (2, 16)).astype(np.int64)
+        exe.run(prog, feed={"ids": x, "lbl": x})
+        save_decode_model(model_dir, DecodeConfig(
+            vocab_size=V, n_layer=L, n_head=2, d_model=16, d_inner=32,
+            max_len=64), exe, scope=scope)
+    pred = DecodePredictor(model_dir)
+    prompts = [r.randint(1, V, r.randint(3, 9)).astype(np.int64)
+               for _ in range(6)]
+    prompts += [prompts[0].copy()] * 2  # prefix sharers
+    want = pred.generate(prompts, max_new_tokens=6)
+    before_mis = obs.FLEET_MISVERSIONED.value()
+    router = Router(model_dir, replicas=2, decode=True, decode_slots=2,
+                    decode_max_seq=32, max_new_tokens=6,
+                    decode_speculative=True, decode_spec_k=2,
+                    decode_prefix_cache=True, jax_platform="cpu")
+    router.start()
+    opts = np.array([6], np.int64)
+    futs = [router.submit((p, opts)) for p in prompts[:4]]
+    time.sleep(0.2)  # let some sequences reach mid-speculation
+    router._workers[0].proc.kill()  # hard SIGKILL, no drain
+    futs += [router.submit((p, opts)) for p in prompts[4:]]
+    got = [f.result(timeout=300)[0] for f in futs]
+    router.stop()
+    assert len(got) == len(prompts)  # zero drops
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+    assert obs.FLEET_MISVERSIONED.value() == before_mis
+
+
 # -- full-scale chaos + latency-vs-offered-load curve (slow) ---------------
 
 @pytest.mark.slow

@@ -110,6 +110,55 @@ def predict_roundtrip(tmpdir: str):
     parity_report(p, q, feeds, logits_tol=0.1)
 
 
+def decode_round(tmpdir: str):
+    """Exercise the PR-14 decode levers so their series ship through
+    the pinned exposition: a REAL micro speculative generate (draft +
+    verify executables over a 2-layer toy LM) ticks
+    ``paddle_tpu_decode_spec_{proposed,accepted}_total``, and a real
+    PrefixStore miss -> insert -> hit round ticks
+    ``paddle_tpu_decode_prefix_{queries,hits}_total`` and the
+    ``..._prefix_bytes`` gauge."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.models import transformer as T
+    from paddle_tpu.serving.decode import (DecodeConfig, DecodePredictor,
+                                           save_decode_model)
+    from paddle_tpu.serving.prefix import PrefixStore
+
+    model_dir = os.path.join(tmpdir, "decode")
+    V, L = 13, 1  # minimal: 3 tiny compiles (prefill, draft, verify)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(main, startup):
+        with fluid.unique_name.guard():
+            ids = layers.data(name="ids", shape=[2, 8], dtype="int64",
+                              append_batch_size=False)
+            lbl = layers.data(name="lbl", shape=[2, 8], dtype="int64",
+                              append_batch_size=False)
+            T.transformer_lm(ids, lbl, V, n_layer=L, n_head=1, d_model=8,
+                             d_inner=16, dropout_rate=0.0, max_len=32,
+                             fused_head=False)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        save_decode_model(model_dir, DecodeConfig(
+            vocab_size=V, n_layer=L, n_head=1, d_model=8, d_inner=16,
+            max_len=32), exe, scope=scope)
+    pred = DecodePredictor(model_dir, aot_cache=False, draft_n_layer=1)
+    pred.generate([np.array([3, 1, 4], np.int64)], max_new_tokens=3,
+                  speculative=True, spec_k=1)
+
+    store = PrefixStore(max_bytes=1 << 20)
+    prompt = np.arange(1, 9, dtype=np.int64)
+    store.lookup(prompt)  # miss
+    store.insert(prompt, [np.zeros((8, 1, 8), np.float32)
+                          for _ in range(2 * L)],
+                 np.zeros((V,), np.float32))
+    store.lookup(prompt)  # full hit
+
+
 def shed_round():
     """One load-shed through the REAL admission path (Router.submit with
     an already-expired deadline needs no worker processes), so the
@@ -192,6 +241,8 @@ def main():
 
         with tempfile.TemporaryDirectory() as td:
             predict_roundtrip(td)
+        with tempfile.TemporaryDirectory() as td:
+            decode_round(td)
 
     from paddle_tpu.observability import export
 

@@ -123,6 +123,41 @@ def _build_decode():
     return feeds, fetches
 
 
+def _build_speculative():
+    """The speculative VERIFY window (serving/decode.py kind="verify"):
+    the graph that checks spec_k draft proposals in one call —
+    cache_append_window / decode_attention_window / spec_accept stay
+    lint-clean and infer-covered in CI (PR 14)."""
+    from paddle_tpu import layers
+    from paddle_tpu.models.transformer import transformer_lm_verify
+
+    B, T, S, V, L, NH, D, DI, ML = 4, 3, 64, 256, 2, 4, 64, 128, 128
+    tokens = layers.data(name="tokens", shape=[B, T], dtype="int64",
+                         append_batch_size=False)
+    positions = layers.data(name="positions", shape=[B, T], dtype="int64",
+                            append_batch_size=False)
+    lengths = layers.data(name="lengths", shape=[B], dtype="int32",
+                          append_batch_size=False)
+    last_idx = layers.data(name="last_idx", shape=[B], dtype="int32",
+                           append_batch_size=False)
+    kc, vc = [], []
+    for i in range(L):
+        kc.append(layers.data(name="kcache_%d" % i,
+                              shape=[B, S, NH, D // NH], dtype="float32",
+                              append_batch_size=False))
+        vc.append(layers.data(name="vcache_%d" % i,
+                              shape=[B, S, NH, D // NH], dtype="float32",
+                              append_batch_size=False))
+    next_ids, accept, last_logits, ncaches = transformer_lm_verify(
+        tokens, positions, lengths, last_idx, kc, vc, V, n_layer=L,
+        n_head=NH, d_model=D, d_inner=DI, max_len=ML)
+    feeds = (["tokens", "positions", "lengths", "last_idx"]
+             + [v.name for v in kc] + [v.name for v in vc])
+    fetches = ([next_ids.name, accept.name, last_logits.name]
+               + [c.name for pair in ncaches for c in pair])
+    return feeds, fetches
+
+
 def _build_quant():
     """The int8 post-training-quantized serving graph (paddle_tpu/quant/
     + transpiler/passes/quantize.py): an fc stack initialized, run
@@ -162,7 +197,7 @@ def _build_quant():
 
 
 EXAMPLES = {"mlp": _build_mlp, "deepfm": _build_deepfm, "lstm": _build_lstm,
-            "decode": _build_decode}
+            "decode": _build_decode, "speculative": _build_speculative}
 # builders that return the (program, feeds, fetches) triple themselves
 # (transformed clones rather than ambient default-program graphs)
 PROGRAM_EXAMPLES = {"quant": _build_quant}
