@@ -108,6 +108,13 @@ def _infer_logical_not(ctx: InferContext):
     return {"Out": VarInfo(ctx.in_shape("X"), "bool")}
 
 
+@register_infer("select")
+def _infer_select(ctx: InferContext):
+    # Out = Mask ? X : Y — value shape/dtype follow X (the kernel
+    # aligns the mask; training.stream's non-finite guard emits these)
+    return {"Out": ctx.in_info("X")}
+
+
 @register_infer("isfinite")
 def _infer_isfinite(ctx: InferContext):
     return {"Out": info((), "bool")}

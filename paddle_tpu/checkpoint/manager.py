@@ -247,16 +247,23 @@ class CheckpointManager:
                     self._inflight -= 1
                 obs.CKPT_PENDING.set(self._queue.qsize())
 
+    def _encode_files(self, arrays) -> Dict[str, bytes]:
+        """Snapshot -> on-disk file set. The default is the checkpoint
+        layout (one persistables npz); subclasses reuse this manager's
+        whole async/retry/degrade/atomic-write machinery for other
+        artifact layouts (training.stream's versioned inference-model
+        exports override exactly this hook)."""
+        return {layout.PERSISTABLES_FILE: _encode_npz(arrays)}
+
     def _write(self, serial: int, arrays, meta, *, mode: str):
         t0 = time.perf_counter()
         delay = self.backoff_s
         attempt = 0
-        blob = _encode_npz(arrays)  # attempt-invariant: encode ONCE
+        files = self._encode_files(arrays)  # attempt-invariant: ONCE
         while True:
             try:
                 layout.write_checkpoint(
-                    self.directory, serial,
-                    {layout.PERSISTABLES_FILE: blob}, meta=meta or {})
+                    self.directory, serial, files, meta=meta or {})
                 break
             except Exception as e:
                 attempt += 1

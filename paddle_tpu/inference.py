@@ -582,9 +582,29 @@ class PredictorServer:
                 return
             t0 = time.perf_counter()
             reqs = []
-            try:
-                for msg in batch:
+            for msg in batch:
+                # per-MESSAGE decode: one malformed frame (fuzzed bytes,
+                # a torn requeue) must not take down the well-formed
+                # requests that happened to share its drain batch. A
+                # frame whose HEADER survived still names its request —
+                # that future gets a structured reject instead of
+                # hanging to its caller's timeout; headerless garbage is
+                # counted and dropped.
+                try:
                     reqs.append(_decode_request(msg))
+                except Exception as e:
+                    obs.PREDICT_FAILURES.inc(path="server_decode")
+                    try:
+                        fut = self._pop(_rio.frame_tag(msg))
+                    except Exception:
+                        continue
+                    if fut is not None:
+                        fut.set_exception(ValueError(
+                            "malformed request frame rejected: %s"
+                            % (e,)))
+            if not reqs:
+                continue
+            try:
                 rows = [r[1] for r in reqs]
                 nreal = len(rows)
                 bucket = (self._bucket_for(nreal) if self.pad_batches
@@ -597,8 +617,14 @@ class PredictorServer:
                     obs.SERVER_ROWS.inc(bucket - nreal, kind="pad")
                 obs.SERVER_STAGE_MS.observe(
                     (time.perf_counter() - t0) * 1e3, stage="stack")
-            except Exception as e:  # fan out to the decoded reqs; keep going
-                self._fail(reqs, e)
+            except Exception:
+                # mixed slot counts / row shapes inside ONE drain batch
+                # (a mangled-but-decodable frame riding with healthy
+                # requests, or genuinely inconsistent clients): degrade
+                # to per-request batches so only the offending request
+                # fails — the old fan-out failed every co-batched
+                # neighbour with the stranger's error
+                self._queue_singly(reqs)
                 continue
             # idle-device fast path: with nothing queued and the device
             # stage idle, the queue hop + thread wake would be pure added
@@ -616,6 +642,26 @@ class PredictorServer:
             if not ran_inline:
                 self._inflight.put((reqs, feed))
                 obs.SERVER_INFLIGHT_DEPTH.set(self._inflight.qsize())
+
+    def _queue_singly(self, reqs):
+        """Batch-assembly failure fallback: each request becomes its own
+        single-row batch, so assembly/shape errors fail exactly the
+        request that caused them (the predictor's own feed checks catch
+        arity/shape nonsense per request). The degraded path costs one
+        dispatch per request — it only runs when a drain batch was
+        internally inconsistent, which healthy uniform traffic never
+        is."""
+        for req in reqs:
+            try:
+                bucket = self._bucket_for(1) if self.pad_batches else 1
+                feed = self._assemble([req[1]], 1, bucket)
+            except Exception as e:
+                self._fail([req], e)
+                continue
+            obs.PREDICT_BATCH_ROWS.observe(1, path="server")
+            obs.SERVER_ROWS.inc(1, kind="real")
+            self._inflight.put(([req], feed))
+            obs.SERVER_INFLIGHT_DEPTH.set(self._inflight.qsize())
 
     def _device_loop(self):
         while True:

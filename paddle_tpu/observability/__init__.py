@@ -51,6 +51,7 @@ __all__ = [
     "DECODE_SPEC_PROPOSED", "DECODE_SPEC_ACCEPTED",
     "CKPT_SAVES", "CKPT_BYTES", "CKPT_PENDING", "CKPT_SAVE_MS",
     "CKPT_RESTORE_MS", "CKPT_RETRIES", "CKPT_FAILURES",
+    "SWAP_TOTAL", "SWAP_MS", "TRAIN_SKIPPED_BATCHES", "FLEET_WEDGED",
     "TRANSPILE_OPS_REMOVED", "TRANSPILE_OPS_FUSED", "TRANSPILE_PASS_MS",
     "QUANT_CALIB_BATCHES", "QUANT_OPS", "QUANT_PARITY",
 ]
@@ -315,6 +316,31 @@ CKPT_FAILURES = REGISTRY.counter(
     "paddle_tpu_ckpt_failures_total",
     "Checkpoint saves that failed every retry — surfaced as a warning "
     "+ degraded mode, never silently skipped")
+SWAP_TOTAL = REGISTRY.counter(
+    "paddle_tpu_swap_total",
+    "Hot model swaps through serving.swap.SwapController, by "
+    "result=ok (version flipped, old replicas retired) | rollback "
+    "(validation/spawn/canary/flip failure — the old version never "
+    "stopped serving and the fleet is restored)")
+SWAP_MS = REGISTRY.histogram(
+    "paddle_tpu_swap_ms",
+    "Wall time of hot-swap phases, phase=spawn (surge replicas on the "
+    "new version, warm-AOT) | canary (live-request parity probes) | "
+    "retire (drain + stop the old version) | total")
+TRAIN_SKIPPED_BATCHES = REGISTRY.counter(
+    "paddle_tpu_train_skipped_batches_total",
+    "Input the hardened training data plane dropped instead of "
+    "crashing or poisoning parameters, by reason=nonfinite (in-graph "
+    "NaN/Inf sentinel zeroed the update and quarantined the batch) | "
+    "corrupt_chunk (tolerant recordio chunk skip+resync) | "
+    "corrupt_record (record whose payload no longer unpickles)")
+FLEET_WEDGED = REGISTRY.counter(
+    "paddle_tpu_fleet_wedged_total",
+    "Live-but-hung replicas the router's watchdog reaped: outstanding "
+    "work with no completion past wedge_timeout_s — the worker is "
+    "SIGKILLed and its in-flight frames requeue exactly like a crash "
+    "(nonzero = raise wedge_timeout_s or investigate stuck device "
+    "dispatches)")
 PROFILER_EVENT_MS = REGISTRY.summary(
     "paddle_tpu_profiler_event_ms",
     "Legacy profiler event table (exact count/sum/min/max per event)")

@@ -192,13 +192,24 @@ class RecordIOWriter:
 
 
 class RecordIOReader:
-    """Iterates records; raises RecordIOError on checksum/format corruption."""
+    """Iterates records; raises RecordIOError on checksum/format corruption.
 
-    def __init__(self, path: str):
+    ``tolerant=True`` turns corruption from a crash into a SKIP: a chunk
+    whose header/magic/crc/decompress fails is dropped, the reader
+    resynchronizes on the next chunk magic, and iteration continues with
+    whatever survives (``skipped_chunks`` counts the losses, and each
+    skip ticks ``paddle_tpu_train_skipped_batches_total{reason=
+    "corrupt_chunk"}``). Chunk-level recovery needs byte-level seeks the
+    frozen C ABI does not expose, so tolerant mode always runs the
+    pure-Python implementation of the same on-disk format."""
+
+    def __init__(self, path: str, tolerant: bool = False):
         if not os.path.exists(path):
             raise RecordIOError("no such recordio file: %s" % path)
         self._path = path
-        self._lib = _load()
+        self.tolerant = bool(tolerant)
+        self.skipped_chunks = 0
+        self._lib = None if self.tolerant else _load()
         if self._lib is not None:
             self._h = self._lib.ptrt_rio_reader_open(path.encode())
             if not self._h:
@@ -206,6 +217,38 @@ class RecordIOReader:
         else:
             self._f = open(path, "rb")
             self._chunk: list = []
+
+    def _corrupt(self, why: str):
+        """One corrupt chunk: raise (strict) or count + resync
+        (tolerant). Returns True when iteration can continue."""
+        if not self.tolerant:
+            raise RecordIOError("%s in %s" % (why, self._path))
+        self.skipped_chunks += 1
+        from .. import observability as obs
+
+        obs.TRAIN_SKIPPED_BATCHES.inc(reason="corrupt_chunk")
+        return self._resync()
+
+    def _resync(self) -> bool:
+        """Scan forward for the next chunk magic (the header of the
+        chunk AFTER the torn one); positions the file AT it. False at
+        EOF — the tail is lost, iteration ends cleanly."""
+        needle = struct.pack("<I", _MAGIC)
+        tail = b""
+        while True:
+            block = self._f.read(1 << 16)
+            if not block:
+                return False
+            window = tail + block
+            # the torn chunk's own magic is already behind the file
+            # position (the caller seeks to start+1 before resyncing),
+            # so any match here is strictly forward progress
+            idx = window.find(needle)
+            if idx >= 0:
+                # rewind to the magic: current pos - bytes past it
+                self._f.seek(-(len(window) - idx), os.SEEK_CUR)
+                return True
+            tail = window[-(len(needle) - 1):]
 
     def __iter__(self) -> Iterator[bytes]:
         if self._lib is not None:
@@ -220,25 +263,46 @@ class RecordIOReader:
                 yield _take(self._lib, buf, n)
         else:
             while True:
+                start = self._f.tell()
                 hdr = self._f.read(_HDR.size)
                 if not hdr:
                     return
-                try:
-                    magic, comp, nrec, rawlen, complen, crc = _HDR.unpack(hdr)
-                except struct.error:
-                    raise RecordIOError("corrupt recordio header in %s" % self._path)
+                if len(hdr) < _HDR.size:
+                    if not self._corrupt("truncated recordio header"):
+                        return
+                    continue
+                magic, comp, nrec, rawlen, complen, crc = _HDR.unpack(hdr)
                 if magic != _MAGIC:
-                    raise RecordIOError("bad magic in %s" % self._path)
+                    # a desynced read: restart the scan just past the
+                    # bad header position, not past complen garbage
+                    self._f.seek(start + 1)
+                    if not self._corrupt("bad magic"):
+                        return
+                    continue
                 stored = self._f.read(complen)
-                if len(stored) != complen or (zlib.crc32(stored) & 0xFFFFFFFF) != crc:
-                    raise RecordIOError("corrupt recordio chunk in %s" % self._path)
-                raw = zlib.decompress(stored) if comp == 1 else stored
-                pos = 0
-                for _ in range(nrec):
-                    (ln,) = struct.unpack_from("<I", raw, pos)
-                    pos += 4
-                    yield raw[pos:pos + ln]
-                    pos += ln
+                if len(stored) != complen or \
+                        (zlib.crc32(stored) & 0xFFFFFFFF) != crc:
+                    # complen itself may be garbage: rescan from just
+                    # past this chunk's magic
+                    self._f.seek(start + 1)
+                    if not self._corrupt("corrupt recordio chunk"):
+                        return
+                    continue
+                try:
+                    raw = zlib.decompress(stored) if comp == 1 else stored
+                    recs = []
+                    pos = 0
+                    for _ in range(nrec):
+                        (ln,) = struct.unpack_from("<I", raw, pos)
+                        pos += 4
+                        recs.append(raw[pos:pos + ln])
+                        pos += ln
+                except Exception:
+                    self._f.seek(start + 1)
+                    if not self._corrupt("undecodable recordio chunk"):
+                        return
+                    continue
+                yield from recs
 
     def close(self):
         if self._lib is not None:
@@ -550,15 +614,41 @@ def recordio_convert(sample_reader, path: str, compressor: int = 1,
     return n
 
 
-def recordio_sample_reader(path: str, prefetch: bool = True, capacity: int = 256):
+def recordio_sample_reader(path: str, prefetch: bool = True,
+                           capacity: int = 256,
+                           skip_corrupt: bool = False):
     """Reader creator yielding the original samples back (C++ prefetch
-    thread keeps the channel full while the device computes)."""
+    thread keeps the channel full while the device computes).
+
+    ``skip_corrupt=True`` is the streaming-ingest hardening: corrupt
+    CHUNKS are dropped with chunk-magic resync
+    (``RecordIOReader(tolerant=True)``, which implies the pure-Python
+    read path — no C++ prefetch) and a RECORD whose pickle payload no
+    longer loads is skipped and counted
+    (``paddle_tpu_train_skipped_batches_total{reason="corrupt_record"}``)
+    instead of crashing the DataLoader worker that owns this reader."""
 
     def reader():
-        src = PrefetchReader(path, capacity) if prefetch else RecordIOReader(path)
+        if skip_corrupt:
+            src = RecordIOReader(path, tolerant=True)
+        elif prefetch:
+            src = PrefetchReader(path, capacity)
+        else:
+            src = RecordIOReader(path)
         try:
             for rec in src:
-                yield pickle.loads(rec)
+                if skip_corrupt:
+                    try:
+                        sample = pickle.loads(rec)
+                    except Exception:
+                        from .. import observability as obs
+
+                        obs.TRAIN_SKIPPED_BATCHES.inc(
+                            reason="corrupt_record")
+                        continue
+                    yield sample
+                else:
+                    yield pickle.loads(rec)
         finally:
             src.close()
 
@@ -668,10 +758,21 @@ def encode_frame_pickle(tag: int, rows) -> bytes:
 def frame_tag(msg) -> int:
     """The frame's u64 tag WITHOUT decoding the payload: a header peek
     on the zero-copy form (the router/worker request-id path), a full
-    unpickle only on the rare ``b"P"`` fallback form."""
+    unpickle only on the rare ``b"P"`` fallback form. Raises ValueError
+    on a frame that carries neither magic — a malformed/corrupt message
+    must be rejectable, never misread as tag garbage."""
     if bytes(msg[:1]) == b"P":
         return pickle.loads(memoryview(msg)[1:])[0]
-    _magic, tag, _nslots = _FRAME_HDR.unpack_from(memoryview(msg), 0)
+    mv = memoryview(msg)
+    if len(mv) < _FRAME_HDR.size:
+        raise ValueError(
+            "truncated array frame: %d byte(s), header needs %d"
+            % (len(mv), _FRAME_HDR.size))
+    magic, tag, _nslots = _FRAME_HDR.unpack_from(mv, 0)
+    if magic != _FRAME_MAGIC:
+        raise ValueError(
+            "not an array frame (magic 0x%02X, want 0x%02X)"
+            % (magic, _FRAME_MAGIC))
     return tag
 
 
@@ -684,7 +785,15 @@ def decode_frame(msg):
     if bytes(msg[:1]) == b"P":
         return pickle.loads(memoryview(msg)[1:])
     mv = memoryview(msg)
-    _magic, tag, nslots = _FRAME_HDR.unpack_from(mv, 0)
+    if len(mv) < _FRAME_HDR.size:
+        raise ValueError(
+            "truncated array frame: %d byte(s), header needs %d"
+            % (len(mv), _FRAME_HDR.size))
+    magic, tag, nslots = _FRAME_HDR.unpack_from(mv, 0)
+    if magic != _FRAME_MAGIC:
+        raise ValueError(
+            "not an array frame (magic 0x%02X, want 0x%02X)"
+            % (magic, _FRAME_MAGIC))
     off = _FRAME_HDR.size
     rows = []
     for _ in range(nslots):

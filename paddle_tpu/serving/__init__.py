@@ -31,6 +31,13 @@ single-process ``inference.PredictorServer`` cannot provide by itself.
   elastic-fleet knobs (``add_replica``/``remove_replica``/``reap_dead``):
   utilization+shed-driven scale-up, hysteretic drain-shrink, cooldown,
   and crash healing.
+- ``swap.SwapController`` — zero-downtime hot model swap: surge
+  new-version replicas behind the sticky active version (warm AOT
+  spawn + bucket prewarm), optionally canary live requests through
+  both versions, flip atomically with ``set_version``, retire the old
+  replicas with zero drops; any pre-flip failure rolls back with the
+  old version never having stopped serving. ``tools/swap_ctl.py``
+  watches a streaming trainer's export root and drives it.
 
 Import policy: ``Engine`` is imported eagerly (executor.py depends on
 it); ``Router``/``ShardedPredictor`` resolve lazily so importing the
@@ -44,10 +51,13 @@ from .engine import Engine  # noqa: F401
 __all__ = ["Engine", "Router", "ShardedPredictor", "worker_main",
            "DecodeConfig", "DecodePredictor", "DecodeServer",
            "save_decode_model", "PrefixStore", "Autoscaler", "SLOClass",
-           "RejectedError", "default_slo_classes"]
+           "RejectedError", "default_slo_classes", "SwapController",
+           "SwapError"]
 
 _LAZY = {
     "Router": ("router", "Router"),
+    "SwapController": ("swap", "SwapController"),
+    "SwapError": ("swap", "SwapError"),
     "ShardedPredictor": ("sharded", "ShardedPredictor"),
     "worker_main": ("worker", "worker_main"),
     "DecodeConfig": ("decode", "DecodeConfig"),

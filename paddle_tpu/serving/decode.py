@@ -594,41 +594,62 @@ class DecodePredictor:
         bb = tokens.shape[0]
         outs, caches = self._prefill(tokens, lens, s)
         obs.DECODE_TOKENS.inc(int(lens[:b].sum()), kind="prefill")
-        cur = self._sample_host(outs[0], strategy, seed)
+        cur = np.array(self._sample_host(outs[0], strategy, seed))
         generated = [[int(cur[i])] for i in range(b)]
         finished = np.array([eos is not None and int(cur[i]) == eos
                              for i in range(b)])
         obs.DECODE_TOKENS.inc(b, kind="decode")
         if max_new_tokens > 1 and not finished.all():
-            dexe, fetch_names = self.acquire("decode", bb, s, strategy)
-            lens = lens.copy()
-            for step in range(1, max_new_tokens):
-                feeds = {"tokens": cur.reshape(bb, 1).astype(np.int64),
-                         "positions": lens.reshape(bb, 1).astype(np.int64),
-                         "lengths": lens,
-                         "seed": np.array([seed + step], np.int64)}
-                for i in range(self.config.n_layer):
-                    feeds["kcache_%d" % i] = caches[2 * i]
-                    feeds["vcache_%d" % i] = caches[2 * i + 1]
-                t0 = time.perf_counter()
-                outs = dexe(feeds, self._state)
-                obs.DECODE_STEP_MS.observe(
-                    (time.perf_counter() - t0) * 1e3, stage="step")
-                cur = np.asarray(outs[0]).astype(np.int64)
-                caches = list(outs[2:])
-                lens = lens + 1
-                live = 0
-                for i in range(b):
-                    if finished[i]:
-                        continue
-                    generated[i].append(int(cur[i]))
-                    live += 1
-                    if eos is not None and int(cur[i]) == eos:
-                        finished[i] = True
-                obs.DECODE_TOKENS.inc(live, kind="decode")
-                if finished.all():
-                    break
+            dexe, _fetch_names = self.acquire("decode", bb, s, strategy)
+            self._plain_decode_steps(dexe, caches, cur, lens.copy(),
+                                     generated, finished, b, s, eos,
+                                     max_new_tokens, seed)
         return [np.asarray(g, np.int64) for g in generated]
+
+    def _plain_decode_steps(self, dexe, caches, cur, lens, generated,
+                            finished, b, s, eos, max_new_tokens,
+                            seed) -> list:
+        """THE one-token-per-iteration step loop, shared by
+        ``generate()`` (the whole decode after the first sample) and
+        ``generate_speculative()`` (the slab-headroom tail once a
+        verify window no longer fits). Mutates ``cur`` / ``lens`` /
+        ``generated`` / ``finished`` per ROW — a finished row's slot
+        state freezes (its re-fed token and parked length only touch
+        its own independent slab row, masked from every live row) —
+        and returns the final caches. A row stops at eos, at its token
+        budget, or when its slab row is full."""
+        bb = cur.shape[0]
+        step = 0
+        while not finished.all():
+            step += 1
+            feeds = {"tokens": cur.reshape(bb, 1).astype(np.int64),
+                     "positions": lens.reshape(bb, 1).astype(np.int64),
+                     "lengths": lens,
+                     "seed": np.array([seed + step], np.int64)}
+            for i in range(self.config.n_layer):
+                feeds["kcache_%d" % i] = caches[2 * i]
+                feeds["vcache_%d" % i] = caches[2 * i + 1]
+            t0 = time.perf_counter()
+            outs = dexe(feeds, self._state)
+            obs.DECODE_STEP_MS.observe(
+                (time.perf_counter() - t0) * 1e3, stage="step")
+            nxt = np.asarray(outs[0]).astype(np.int64)
+            caches = list(outs[2:])
+            emitted = 0
+            for i in range(b):
+                if finished[i]:
+                    continue
+                tok = int(nxt[i])
+                generated[i].append(tok)
+                cur[i] = tok
+                lens[i] += 1
+                emitted += 1
+                if (eos is not None and tok == eos) \
+                        or len(generated[i]) >= max_new_tokens \
+                        or lens[i] + 1 >= s:
+                    finished[i] = True
+            obs.DECODE_TOKENS.inc(emitted, kind="decode")
+        return caches
 
     # -- speculative decoding (draft-verify rounds, greedy/lossless) -------
     def draft_window(self, drexe, caches, cur, lens, spec_k):
@@ -700,7 +721,6 @@ class DecodePredictor:
         if not finished.all():
             dexe, _ = self.acquire("draft", bb, s)
             vexe, _ = self.acquire("verify", bb, s, window=T)
-        zeros_seed = np.zeros((1,), np.int64)
         zeros_idx = np.zeros((bb,), np.int32)
         while not finished.all() and int(lens.max()) + T <= s:
             window, positions = self.draft_window(dexe, caches, cur,
@@ -744,36 +764,14 @@ class DecodePredictor:
                     cur[i] = next_ids[i, a]
             obs.DECODE_TOKENS.inc(emitted, kind="decode")
         if not finished.all():
-            # slab headroom exhausted: finish the tail on plain steps
+            # slab headroom exhausted: finish the tail on the SAME
+            # plain step loop generate() runs (greedy ignores the seed
+            # feed, so the shared loop's seed+step stream is
+            # token-for-token the old constant-zero feed)
             dexe2, _ = self.acquire("decode", bb, s, "greedy")
-            while not finished.all():
-                feeds = {"tokens": cur.reshape(bb, 1).astype(np.int64),
-                         "positions": lens.reshape(bb, 1).astype(
-                             np.int64),
-                         "lengths": lens, "seed": zeros_seed}
-                for i in range(self.config.n_layer):
-                    feeds["kcache_%d" % i] = caches[2 * i]
-                    feeds["vcache_%d" % i] = caches[2 * i + 1]
-                t0 = time.perf_counter()
-                outs = dexe2(feeds, self._state)
-                obs.DECODE_STEP_MS.observe(
-                    (time.perf_counter() - t0) * 1e3, stage="step")
-                nxt = np.asarray(outs[0]).astype(np.int64)
-                caches = list(outs[2:])
-                emitted = 0
-                for i in range(b):
-                    if finished[i]:
-                        continue
-                    tok = int(nxt[i])
-                    generated[i].append(tok)
-                    emitted += 1
-                    lens[i] += 1
-                    cur[i] = tok
-                    if (eos is not None and tok == eos) \
-                            or len(generated[i]) >= max_new_tokens \
-                            or lens[i] + 1 >= s:
-                        finished[i] = True
-                obs.DECODE_TOKENS.inc(emitted, kind="decode")
+            self._plain_decode_steps(dexe2, caches, cur, lens,
+                                     generated, finished, b, s, eos,
+                                     max_new_tokens, seed=0)
         return [np.asarray(g, np.int64) for g in generated]
 
     # -- beam-search strategy (ops-layer beam step between decode execs) ---
@@ -1144,6 +1142,37 @@ class DecodeServer:
                 (time.perf_counter() - fut._t0) * 1e3, path="decode")
             obs.PREDICT_REQUESTS.inc(path="decode")
 
+    def _prefill_prompts(self, prompts):
+        """The ONE admission-prefill recipe (shared by ``_admit`` and
+        ``_admit_prefix``): bucket the prompts to a pow2 batch and
+        their OWN pow2 sequence length — not the slab length: admitting
+        a 16-token prompt into a 1024-token slab must cost a 16-token
+        forward (this is what lets continuous admission beat gang
+        scheduling — a slab-sized prefill per admission would eat the
+        win) — run the prefill executable, and account it. Returns
+        ``(outs, sp)``: the raw executable outputs (logits + per-layer
+        float K/V sub-slabs) and the sequence bucket they are shaped
+        at. Raises what the acquire/execute raises — the caller owns
+        the admission-failure contract."""
+        bb = _pow2_bucket(len(prompts))
+        sp = min(_pow2_bucket(max(len(p) for p in prompts), floor=16),
+                 self.seq)
+        tokens = np.zeros((bb, sp), np.int64)
+        plens = np.ones((bb,), np.int32)
+        for i, p in enumerate(prompts):
+            tokens[i, :len(p)] = p
+            plens[i] = len(p)
+        pexe, _ = self.predictor.acquire("prefill", bb, sp)
+        t0 = time.perf_counter()
+        outs = pexe({"tokens": tokens, "lengths": plens},
+                    self.predictor._state)
+        self.prefill_executions += 1
+        obs.DECODE_STEP_MS.observe((time.perf_counter() - t0) * 1e3,
+                                   stage="prefill")
+        obs.DECODE_TOKENS.inc(int(plens[:len(prompts)].sum()),
+                              kind="prefill")
+        return outs, sp
+
     def _admit(self, pending, caches, lens, active):
         """Prefill a sub-batch of queued requests into free slots.
         ``pending`` entries are (rid, prompt, max_new, seed); returns
@@ -1159,25 +1188,8 @@ class DecodeServer:
         if self._prefix is not None:
             return self._admit_prefix(batch, free, caches, lens, active)
         n = len(batch)
-        bb = _pow2_bucket(n)
-        # prefill at the PROMPTS' own sequence bucket, not the slab
-        # length: admitting a 16-token prompt into a 1024-token slab
-        # must cost a 16-token forward (this is what lets continuous
-        # admission beat gang scheduling — a slab-sized prefill per
-        # admission would eat the win)
-        sp = min(_pow2_bucket(max(len(b[1]) for b in batch), floor=16),
-                 self.seq)
-        tokens = np.zeros((bb, sp), np.int64)
-        plens = np.ones((bb,), np.int32)
-        for i, (_rid, prompt, _mn, _seed) in enumerate(batch):
-            tokens[i, :len(prompt)] = prompt
-            plens[i] = len(prompt)
         try:
-            pexe, _ = self.predictor.acquire("prefill", bb, sp)
-            t0 = time.perf_counter()
-            outs = pexe({"tokens": tokens, "lengths": plens},
-                        self.predictor._state)
-            self.prefill_executions += 1
+            outs, sp = self._prefill_prompts([b[1] for b in batch])
         except Exception as e:
             # an admission that cannot prefill (compile error, device
             # OOM) fails ITS requests and leaves the server serving —
@@ -1185,9 +1197,6 @@ class DecodeServer:
             for rid, _p, _mn, _seed in batch:
                 self._fail(rid, e)
             return caches
-        obs.DECODE_STEP_MS.observe((time.perf_counter() - t0) * 1e3,
-                                   stage="prefill")
-        obs.DECODE_TOKENS.inc(int(plens[:n].sum()), kind="prefill")
         first = np.array(self.predictor._sample_host(
             outs[0], self.strategy, self._seed_ctr))  # writable copy
         self._seed_ctr += 1
@@ -1307,23 +1316,7 @@ class DecodeServer:
             uniq_logits: List[np.ndarray] = []
             uniq_eids: List[Optional[int]] = []
             if uniq_prompts:
-                bb = _pow2_bucket(len(uniq_prompts))
-                sp = min(_pow2_bucket(max(len(p) for p in uniq_prompts),
-                                      floor=16), self.seq)
-                tokens = np.zeros((bb, sp), np.int64)
-                plens = np.ones((bb,), np.int32)
-                for i, p in enumerate(uniq_prompts):
-                    tokens[i, :len(p)] = p
-                    plens[i] = len(p)
-                pexe, _ = self.predictor.acquire("prefill", bb, sp)
-                t0 = time.perf_counter()
-                outs = pexe({"tokens": tokens, "lengths": plens},
-                            self.predictor._state)
-                self.prefill_executions += 1
-                obs.DECODE_STEP_MS.observe(
-                    (time.perf_counter() - t0) * 1e3, stage="prefill")
-                obs.DECODE_TOKENS.inc(
-                    int(plens[:len(uniq_prompts)].sum()), kind="prefill")
+                outs, _sp = self._prefill_prompts(uniq_prompts)
                 sub = [np.asarray(c) for c in outs[1:]]
                 logits_all = np.asarray(outs[0])
                 for i, p in enumerate(uniq_prompts):

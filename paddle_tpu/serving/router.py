@@ -60,6 +60,7 @@ aggregated fleet registry) and ``/health.json``.
 from __future__ import annotations
 
 import heapq
+import os
 import pickle
 import queue
 import struct
@@ -98,6 +99,7 @@ class _Worker:
         "idx", "name", "proc", "conn", "state", "version", "pid",
         "metrics_port", "outstanding", "dispatched", "reader",
         "ready_ev", "stopped_ev", "status_q", "send_lock", "error",
+        "last_hb", "hb_served", "last_progress", "ctrl_lock",
     )
 
     def __init__(self, idx: int, name: str):
@@ -119,6 +121,18 @@ class _Worker:
         self.status_q: "queue.Queue" = queue.Queue()
         self.send_lock = threading.Lock()
         self.error = None
+        # liveness signals for the wedged-worker watchdog: last
+        # heartbeat arrival + its served count (pipe/process liveness),
+        # and the last COMPLETION (serving progress — the signal that
+        # actually clears a wedge suspicion)
+        self.last_hb = None
+        self.hb_served = 0
+        self.last_progress = time.monotonic()
+        # serializes whole control ROUND TRIPS (send + reply) — the
+        # status queue is uncorrelated, so two concurrent callers
+        # (a /fleet.json scrape and a canary probe) would cross-read
+        # each other's replies without it
+        self.ctrl_lock = threading.Lock()
 
 
 class Router:
@@ -155,7 +169,11 @@ class Router:
                  default_slo: str = _slo.DEFAULT_CLASS,
                  max_pending: Optional[int] = None,
                  shed_interval_ms: float = 50.0,
-                 spawn_retries: int = 1):
+                 spawn_retries: int = 1,
+                 version: Optional[str] = None,
+                 wedge_timeout_s: Optional[float] = None,
+                 heartbeat_s: float = 1.0,
+                 tap_frames: int = 0):
         from ..runtime.recordio import Channel
 
         if replicas < 1:
@@ -209,8 +227,37 @@ class Router:
         # neighbour could have served
         self.max_outstanding = (int(max_outstanding) if max_outstanding
                                 else max(2 * max_batch * in_flight, 8))
+        # wedged-worker watchdog: a replica with in-flight work and NO
+        # completion for this long is presumed hung (not merely slow),
+        # SIGKILLed, and its frames requeue through the crash path.
+        # None (default) = off; set it ABOVE the worst-case single-batch
+        # latency (a cold-bucket compile mid-traffic would otherwise be
+        # reaped as a wedge)
+        self.wedge_timeout_s = (float(wedge_timeout_s)
+                                if wedge_timeout_s else None)
+        self._watch_stop = threading.Event()
+        self._watch_thread: Optional[threading.Thread] = None
+        # canary tap: the last few request frames (inner form, copied)
+        # so a hot swap can probe LIVE traffic through both versions
+        # before flipping (serving/swap.py). Default OFF — the tap is a
+        # per-request frame copy on the hot dispatch path, so only
+        # fleets that will swap pay it (SwapController.enable_tap /
+        # Router(tap_frames=N) arm it)
+        self._tap = None
+        if tap_frames:
+            self.enable_tap(tap_frames)
         self._opts = {
             "model_dir": model_dir, "max_batch": int(max_batch),
+            # the fleet's MODEL version label: sticky routing + the
+            # misversioned check key on it. None = the program content
+            # fingerprint (fine until hot swaps: two exports of one
+            # architecture share a fingerprint, so swap controllers pass
+            # an explicit per-export label via set_model_dir)
+            "version": version,
+            "heartbeat_s": float(heartbeat_s),
+            # swap.worker_boot barrier gate: armed by SwapController so
+            # chaos specs can target ONLY incoming-swap spawns
+            "swap_boot": False,
             "max_wait_ms": float(max_wait_ms), "in_flight": int(in_flight),
             "shard": int(shard), "http": bool(worker_http),
             "jax_platform": jax_platform, "env": dict(worker_env or {}),
@@ -278,6 +325,12 @@ class Router:
             target=self._dispatch_loop, daemon=True,
             name="ptpu-router-dispatch")
         self._dispatch_thread.start()
+        if self.wedge_timeout_s and self._watch_thread is None:
+            self._watch_stop.clear()
+            self._watch_thread = threading.Thread(
+                target=self._watchdog_loop, daemon=True,
+                name="ptpu-router-watchdog")
+            self._watch_thread.start()
 
     def _spawn(self, idx: int, name: Optional[str] = None) -> _Worker:
         from .worker import worker_main
@@ -422,8 +475,23 @@ class Router:
         if prio is None:  # bare pre-SLO frame: default class, no deadline
             klass = self.default_slo
             prio = self.slo_classes[klass].priority
-        return _Req(_rio.frame_tag(inner), msg, inner, klass, prio,
-                    deadline)
+        req = _Req(_rio.frame_tag(inner), msg, inner, klass, prio,
+                   deadline)
+        # tap AFTER the frame validated (frame_tag raised otherwise): a
+        # malformed frame must never poison the canary probe set
+        if self._tap is not None:
+            self._tap.append(bytes(inner))
+        return req
+
+    def _reject_malformed(self, msg, exc):
+        """A frame the dispatch loop cannot parse (fuzzed bytes on the
+        channel) must not kill the loop: count it and drop it. There is
+        no future to reject — ``submit()`` always encodes valid frames,
+        and a _parse_request failure means the tag itself was
+        unrecoverable (a torn SLO header hides the inner frame; a bare
+        frame's failed tag peek fails identically on retry), so a torn
+        channel frame is injected/corrupt bytes, not client work."""
+        obs.PREDICT_FAILURES.inc(path="router_decode")
 
     def _dispatch_loop(self):
         """Drain the front channel into a strict-priority pending queue
@@ -450,7 +518,11 @@ class Router:
                     closed = True
                 else:
                     for msg in batch:
-                        req = self._parse_request(msg)
+                        try:
+                            req = self._parse_request(msg)
+                        except Exception as e:
+                            self._reject_malformed(msg, e)
+                            continue
                         heapq.heappush(pending, (req.priority, seq, req))
                         seq += 1
             if pending:
@@ -646,7 +718,15 @@ class Router:
                 payload = w.conn.recv_bytes()
             except (EOFError, OSError):
                 break
-            for msg in wire.iter_messages(payload):
+            try:
+                msgs = list(wire.iter_messages(payload))
+            except wire.WireError:
+                # a torn multi-message must not kill the reader thread
+                # (that would strand every outstanding response AND skip
+                # the requeue below); count and wait for the next payload
+                obs.PREDICT_FAILURES.inc(path="router_decode")
+                continue
+            for msg in msgs:
                 try:
                     kind = bytes(msg[:1])
                     if kind == b"S":
@@ -678,9 +758,17 @@ class Router:
                 w.pid = st.get("pid")
                 w.metrics_port = st.get("metrics_port", 0)
                 w.state = "ready"
+                w.last_progress = time.monotonic()
                 self._cond.notify_all()
             self._refresh_worker_gauge()
             w.ready_ev.set()
+        elif st.get("hb"):
+            # worker-initiated heartbeat (pipe + process liveness; the
+            # watchdog's wedge verdict keys on COMPLETIONS, but the
+            # served count distinguishes hung from merely slow in
+            # health())
+            w.last_hb = time.monotonic()
+            w.hb_served = int(st.get("served", w.hb_served))
         elif "error" in st and not w.ready_ev.is_set():
             w.error = st.get("error")
             if st.get("traceback"):
@@ -699,6 +787,7 @@ class Router:
         with self._cond:
             entry = w.outstanding.pop(rid, None)
             obs.FLEET_OUTSTANDING.set(len(w.outstanding), replica=w.name)
+            w.last_progress = time.monotonic()  # watchdog: not wedged
             self._cond.notify_all()  # capacity freed / drain progressed
         if entry is not None and exc is None:
             # dispatch->response wall time feeds the shedding oracle:
@@ -725,6 +814,10 @@ class Router:
             # ever breaks that
             obs.FLEET_MISVERSIONED.inc()
         _tag, rows = _rio.decode_frame(frame)
+        # version attribution: the hot-swap acceptance contract verifies
+        # every served row against the direct predictor of the version
+        # that served it — the response already carries it, expose it
+        fut._version = version
         fut.set_result(rows)
         obs.PREDICT_LATENCY_MS.observe(
             (time.perf_counter() - fut._t0) * 1e3, path="router")
@@ -741,8 +834,9 @@ class Router:
             w.state = "dead" if crashed else "stopped"
             self._cond.notify_all()
         self._refresh_worker_gauge()
-        if not entries:
-            return
+        self._requeue_entries(w, entries)
+
+    def _requeue_entries(self, w: _Worker, entries):
         for rid, (req, _ver, _t) in entries:
             obs.FLEET_REQUEUED.inc()
             # back through the front channel, SLO header and all: the
@@ -757,7 +851,115 @@ class Router:
                         % w.name))
                     obs.PREDICT_FAILURES.inc(path="router")
 
+    # -- wedged-worker watchdog --------------------------------------------
+    def _watchdog_loop(self):
+        period = min(0.25, self.wedge_timeout_s / 4)
+        while not self._watch_stop.wait(period):
+            self._wedge_sweep()
+
+    def _wedge_sweep(self) -> List[str]:
+        """Reap live-but-HUNG replicas: in-flight work whose oldest
+        dispatch AND the replica's last completion are both older than
+        ``wedge_timeout_s``. ``reap_dead`` only catches dead PIDs — a
+        worker stuck in a device dispatch (or a fault-DELAY barrier)
+        keeps its PID and its pipe while serving nothing, starving every
+        frame routed to it. The reap is a SIGKILL: the reader thread
+        then sees EOF and requeues the in-flight frames exactly like a
+        crash (``paddle_tpu_fleet_requeued_total``), and
+        ``reap_dead()``/the autoscaler heal the fleet. Returns the
+        replica names wedged by THIS sweep."""
+        if not self.wedge_timeout_s:
+            return []
+        timeout = self.wedge_timeout_s
+        now_p = time.perf_counter()
+        now_m = time.monotonic()
+        wedged = []
+        with self._cond:
+            for w in self._workers:
+                if w.state not in ("ready", "draining") or not w.outstanding:
+                    continue
+                oldest = min(t for _req, _v, t in w.outstanding.values())
+                if (now_p - oldest) <= timeout:
+                    continue
+                if (now_m - w.last_progress) <= timeout:
+                    continue
+                # mark INSIDE the verdict lock: the kill below is
+                # asynchronous (the reader's EOF handling finishes the
+                # reap), and until it does the next sweep must not
+                # re-judge — and re-count — the same wedge
+                w.state = "wedged"
+                wedged.append(w)
+        names = []
+        for w in wedged:
+            obs.FLEET_WEDGED.inc()
+            names.append(w.name)
+            if w.proc is not None and w.proc.is_alive():
+                # SIGKILL -> reader EOF -> crash path marks it dead and
+                # requeues (one code path for crashed AND wedged)
+                w.proc.kill()
+            else:
+                # no process behind the handle (already-dead pid raced
+                # the sweep, or a fabricated handle in the metrics
+                # smoke): run the crash path directly
+                with self._cond:
+                    entries = list(w.outstanding.items())
+                    w.outstanding.clear()
+                    obs.FLEET_OUTSTANDING.set(0, replica=w.name)
+                    w.state = "dead"
+                    self._cond.notify_all()
+                self._refresh_worker_gauge()
+                self._requeue_entries(w, entries)
+        return names
+
     # -- fleet operations --------------------------------------------------
+    def enable_tap(self, frames: int = 32):
+        """Start keeping the last ``frames`` request frames for canary
+        probes (a per-request frame copy on the dispatch path — armed
+        by SwapController, or up front via Router(tap_frames=N))."""
+        import collections
+
+        if self._tap is None or self._tap.maxlen != int(frames):
+            self._tap = collections.deque(self._tap or (),
+                                          maxlen=int(frames))
+
+    def set_model_dir(self, model_dir: str, version: Optional[str] = None):
+        """Point FUTURE spawns (add_replica / drain_restart respawns) at
+        a different exported model, labeled ``version`` (default: the
+        dir's basename — distinct exports of one architecture share a
+        program fingerprint, so routing identity needs an explicit
+        label). Running replicas are untouched: this is the hot-swap
+        controller's first move — new-version replicas come up UNROUTABLE
+        behind the sticky active version until ``set_version`` flips."""
+        if version is None:
+            version = os.path.basename(os.path.normpath(model_dir))
+        with self._cond:
+            self.model_dir = model_dir
+            self._opts["model_dir"] = model_dir
+            self._opts["version"] = version
+        return version
+
+    def retire_worker(self, w: _Worker, timeout: float = 300.0) -> str:
+        """Drain one replica BY HANDLE and drop it from the fleet — the
+        hot-swap retire path: after a version flip the old-version
+        replicas are unroutable (sticky routing) but may still hold
+        in-flight work, and ``remove_replica``'s index/least-loaded
+        selection cannot name them. Zero-drop: outstanding responses are
+        waited out, then the worker stops gracefully (flushing its
+        queue)."""
+        deadline = time.monotonic() + timeout
+        pending = self._drain_out(w, deadline)
+        if pending:
+            raise RuntimeError(
+                "replica %s still has %d outstanding requests after "
+                "%.0fs" % (w.name, pending, timeout))
+        self._stop_worker(w, deadline)
+        with self._cond:
+            if w in self._workers:
+                self._workers.remove(w)
+            self._cond.notify_all()
+        self._refresh_worker_gauge()
+        return w.name
+
     def set_version(self, version: str):
         """Flip the fleet's active program version (hot-swap cutover):
         replicas reporting `version` become routable, everyone else
@@ -981,6 +1183,10 @@ class Router:
         """Drain the front channel through the fleet, then stop every
         replica gracefully (flushing their queues) and reap processes."""
         self.stop_http()
+        self._watch_stop.set()
+        if self._watch_thread is not None:
+            self._watch_thread.join(timeout=5)
+            self._watch_thread = None
         with self._cond:
             already = self._stopping and self._dispatch_thread is None
         if already:
@@ -1010,27 +1216,45 @@ class Router:
         counts: Dict[str, int] = {}
         for w in self._workers:
             counts[w.state] = counts.get(w.state, 0) + 1
-        for state in ("starting", "ready", "draining", "stopped", "dead"):
+        for state in ("starting", "ready", "draining", "wedged",
+                      "stopped", "dead"):
             obs.FLEET_WORKERS.set(counts.get(state, 0), state=state)
 
     def health(self) -> List[Dict]:
         """Per-replica view: state, version, pid, outstanding depth,
-        dispatch count, metrics port."""
+        dispatch count, metrics port, heartbeat age + served count."""
+        now = time.monotonic()
         with self._cond:
             return [{"replica": w.name, "state": w.state,
                      "version": w.version, "pid": w.pid,
                      "outstanding": len(w.outstanding),
                      "dispatched": w.dispatched,
                      "metrics_port": w.metrics_port,
+                     "heartbeat_age_s": (None if w.last_hb is None
+                                         else now - w.last_hb),
+                     "served": w.hb_served,
                      "shard": self.shard}
                     for w in self._workers]
 
-    def _worker_call(self, w: _Worker, cmd: str, timeout: float = 30.0):
+    def _worker_call(self, w: _Worker, cmd: str, timeout: float = 30.0,
+                     **extra):
+        """One control round trip (ping/metrics/probe). ``extra`` fields
+        ride the command dict (e.g. the probe frame bytes). The whole
+        round trip holds ``ctrl_lock`` and starts by draining stale
+        replies (a previous caller that timed out leaves its late reply
+        in the queue) — the status queue carries no correlation ids, so
+        serialization + drain IS the correlation."""
         try:
-            with w.send_lock:
-                w.conn.send_bytes(b"C" + pickle.dumps({"cmd": cmd},
-                                                      protocol=4))
-            return w.status_q.get(timeout=timeout)
+            with w.ctrl_lock:
+                while True:  # discard replies abandoned by timeouts
+                    try:
+                        w.status_q.get_nowait()
+                    except queue.Empty:
+                        break
+                with w.send_lock:
+                    w.conn.send_bytes(b"C" + pickle.dumps(
+                        dict(extra, cmd=cmd), protocol=4))
+                return w.status_q.get(timeout=timeout)
         except (OSError, ValueError, queue.Empty):
             return None
 

@@ -1,0 +1,312 @@
+"""Hot model swap: version N -> N+1 in a live fleet, zero downtime.
+
+The online-learning loop's serving half (ROADMAP item 6): a streaming
+trainer keeps exporting model versions, and the fleet must pick each one
+up WITHOUT dropping a request and WITHOUT ever interleaving version-N
+and version-N+1 rows to one client. All of the machinery already
+exists in the Router — this module only sequences it:
+
+1. **Load behind the running version.** ``Router.set_model_dir`` points
+   future spawns at the new export; one surge replica per currently-
+   ready replica boots on it (``add_replica`` — the shared persistent
+   AOT cache makes the spawn nearly compile-free for a same-architecture
+   export, and each worker's ``PredictorServer.start()`` pre-warms every
+   padding bucket before reporting ready). Sticky per-version routing
+   means the new replicas are READY but UNROUTABLE: the active version
+   still owns all traffic.
+2. **Canary (optional).** Up to ``canary`` recent LIVE request frames
+   (the Router's tap) — or caller-provided ``canary_samples`` — are
+   probed through BOTH versions via the worker control pipe. The new
+   version must answer with finite, shape-compatible rows; with
+   ``canary_tol`` set, max-abs logits drift beyond it is a failed
+   canary. Any failure rolls the swap back.
+3. **Atomic flip.** ``Router.set_version`` makes the new replicas
+   routable and the old ones unroutable in one move. Requests already
+   dispatched to old replicas complete under the version they were
+   routed under (zero misversioned, by the same sticky contract
+   drain_restart relies on); everything queued or new goes to N+1.
+4. **Retire.** Old replicas drain their in-flight responses and stop
+   gracefully (flushing their queues — zero drops), then leave the
+   fleet.
+
+Any failure BEFORE the flip rolls back completely: surge replicas are
+destroyed, the router's spawn options are restored, and the old version
+never stopped serving — ``paddle_tpu_swap_total{result="rollback"}``.
+The flip is the commit point: a post-flip retire problem raises but the
+swap stands (the new version is serving; the stuck old replica stays
+visible in ``health()`` for ``reap_dead``/the autoscaler).
+
+Chaos barriers (``checkpoint/faults.py``): ``swap.before_spawn``,
+``swap.before_canary``, ``swap.before_flip``, ``swap.before_retire``
+cross in the controller (arm DELAY/IO specs to widen windows or force a
+rollback at an exact instant), and ``swap.worker_boot`` crosses inside
+each INCOMING surge replica (arm KILL to SIGKILL the new version
+mid-swap — the old version must keep serving, test-pinned).
+
+``tools/swap_ctl.py`` wraps this in a watcher that polls a streaming
+trainer's export root and swaps each new complete export in.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import observability as obs
+from ..checkpoint.faults import fault_point
+
+__all__ = ["SwapController", "SwapError"]
+
+
+class SwapError(RuntimeError):
+    """A hot swap that could not commit (validation, surge spawn,
+    canary, or flip failure). ``rolled_back`` tells whether the fleet
+    was restored to the old version (True for every pre-flip failure)
+    or the swap COMMITTED and only the old-replica retire struggled
+    (False — the new version is serving)."""
+
+    def __init__(self, msg: str, rolled_back: bool = True):
+        super().__init__(msg)
+        self.rolled_back = rolled_back
+
+
+class SwapController:
+    """
+    ctl = SwapController(router)
+    ctl.swap("/models/ctr/checkpoint_42")          # flip + retire
+    ctl.swap(d, canary=8, canary_tol=1e-3)         # live-parity gated
+    """
+
+    def __init__(self, router, probe_timeout: float = 120.0,
+                 tap_frames: int = 32):
+        self.router = router
+        self.probe_timeout = float(probe_timeout)
+        # arm the router's live-request tap NOW (it is off by default —
+        # a per-request frame copy only swap-bound fleets should pay),
+        # so traffic between controller construction and swap() builds
+        # the canary probe set
+        if tap_frames:
+            router.enable_tap(tap_frames)
+
+    # -- canary ------------------------------------------------------------
+    def _canary_frames(self, canary: int,
+                       canary_samples: Optional[Sequence]) -> List[bytes]:
+        from ..inference import _encode_sample
+
+        if canary_samples is not None:
+            return [_encode_sample(0, s) for s in canary_samples]
+        tap = getattr(self.router, "_tap", None)
+        if not canary or tap is None:
+            return []
+        frames = list(tap)
+        return frames[-int(canary):]
+
+    def _probe(self, worker, frame: bytes):
+        """(rows, error) from one worker probe round trip."""
+        st = self.router._worker_call(worker, "probe", frame=frame,
+                                      timeout=self.probe_timeout)
+        if st is None:
+            return None, "probe timed out / pipe lost"
+        if "probe_error" in st:
+            return None, st["probe_error"]
+        if not isinstance(st, dict) or "probe" not in st:
+            # the status queue is uncorrelated: a concurrent
+            # ping/metrics reply (a /fleet.json scrape mid-swap) can be
+            # cross-read here — an unrecognizable reply is a probe
+            # FAILURE to report, never a None to crash on
+            return None, ("unrecognizable probe reply (concurrent "
+                          "control call?): %r" % (st,))
+        return st["probe"], None
+
+    def _run_canary(self, old_workers, new_workers, frames,
+                    canary_tol: Optional[float]):
+        """Probe each frame through one old and one new replica. The
+        old side is the reference: an old-side probe failure makes that
+        frame inconclusive (skipped), a NEW-side failure or a gate
+        violation fails the canary. Returns the number of frames
+        actually compared."""
+        compared = 0
+        for i, frame in enumerate(frames):
+            ref, ref_err = self._probe(old_workers[i % len(old_workers)],
+                                       frame)
+            got, got_err = self._probe(new_workers[i % len(new_workers)],
+                                       frame)
+            if got_err is not None:
+                raise SwapError(
+                    "canary %d/%d: new version failed to answer: %s"
+                    % (i + 1, len(frames), got_err))
+            for o in got:
+                if not np.isfinite(np.asarray(o, np.float64)).all():
+                    raise SwapError(
+                        "canary %d/%d: new version produced non-finite "
+                        "outputs" % (i + 1, len(frames)))
+            if ref_err is not None or ref is None:
+                continue  # inconclusive: reference side unavailable
+            if len(got) != len(ref) or any(
+                    np.asarray(g).shape != np.asarray(r).shape
+                    for g, r in zip(got, ref)):
+                raise SwapError(
+                    "canary %d/%d: output arity/shape changed: %s vs %s"
+                    % (i + 1, len(frames),
+                       [np.asarray(g).shape for g in got],
+                       [np.asarray(r).shape for r in ref]))
+            if canary_tol is not None:
+                diff = max(float(np.max(np.abs(
+                    np.asarray(g, np.float64) - np.asarray(r, np.float64)
+                ))) if np.asarray(g).size else 0.0
+                    for g, r in zip(got, ref))
+                if diff > canary_tol:
+                    raise SwapError(
+                        "canary %d/%d: logits drifted %.3g > tol %.3g "
+                        "between versions" % (i + 1, len(frames), diff,
+                                              canary_tol))
+            compared += 1
+        return compared
+
+    # -- the swap ----------------------------------------------------------
+    def swap(self, model_dir: str, version: Optional[str] = None,
+             canary: int = 0, canary_tol: Optional[float] = None,
+             canary_samples: Optional[Sequence] = None,
+             spawn_timeout: Optional[float] = None,
+             retire_timeout: float = 300.0) -> Dict:
+        """Swap the fleet onto the export at ``model_dir``. Returns
+        ``{"version", "previous", "replicas", "canaried", "retired"}``
+        on success; raises ``SwapError`` (with the fleet restored, see
+        ``rolled_back``) otherwise."""
+        router = self.router
+        t_total = time.perf_counter()
+        if version is None:
+            version = os.path.basename(os.path.normpath(model_dir))
+        # -- admission validation: cheap, before any fleet mutation ------
+        try:
+            fault_point("swap.before_spawn")
+            if not os.path.isfile(os.path.join(model_dir, "__model__")):
+                raise SwapError(
+                    "swap target %r is not an exported model directory "
+                    "(no __model__)" % model_dir)
+            with router._cond:
+                if version == router.active_version:
+                    raise SwapError(
+                        "fleet is already serving version %r" % version)
+                old_workers = [w for w in router._workers
+                               if w.state == "ready"]
+            if not old_workers:
+                raise SwapError("no ready replica to swap behind")
+            want_canary = bool(canary or canary_samples)
+            if want_canary and router._opts.get("decode"):
+                raise SwapError(
+                    "canary probes are a dense-predictor surface; swap "
+                    "decode fleets with canary=0")
+        except Exception as e:
+            obs.SWAP_TOTAL.inc(result="rollback")
+            obs.SWAP_MS.observe(
+                (time.perf_counter() - t_total) * 1e3, phase="total")
+            if isinstance(e, SwapError):
+                raise
+            raise SwapError("swap validation failed: %s" % e) from e
+
+        old_dir = router.model_dir
+        old_ver_opt = router._opts.get("version")
+        old_active = router.active_version
+        new_names: List[str] = []
+        compared = 0
+        try:
+            router.set_model_dir(model_dir, version)
+            router._opts["swap_boot"] = True
+            # -- surge: one new-version replica per ready old one -------
+            t0 = time.perf_counter()
+            for _ in range(len(old_workers)):
+                new_names.append(router.add_replica(timeout=spawn_timeout))
+            obs.SWAP_MS.observe((time.perf_counter() - t0) * 1e3,
+                                phase="spawn")
+            with router._cond:
+                new_workers = [w for w in router._workers
+                               if w.name in set(new_names)]
+            bad = [w.name for w in new_workers if w.version != version]
+            if bad:
+                raise SwapError(
+                    "surge replicas %s came up on the wrong version"
+                    % bad)
+            # -- canary -------------------------------------------------
+            fault_point("swap.before_canary")
+            if want_canary:
+                t0 = time.perf_counter()
+                frames = self._canary_frames(canary, canary_samples)
+                if not frames:
+                    # a requested gate that validated NOTHING must not
+                    # silently pass — no tapped traffic and no samples
+                    # means the operator's canary never ran
+                    raise SwapError(
+                        "canary requested but there is nothing to "
+                        "probe: no live request frames tapped (is the "
+                        "tap enabled? has the fleet served traffic?) "
+                        "and no canary_samples given")
+                compared = self._run_canary(old_workers, new_workers,
+                                            frames, canary_tol)
+                obs.SWAP_MS.observe((time.perf_counter() - t0) * 1e3,
+                                    phase="canary")
+            # -- atomic flip --------------------------------------------
+            fault_point("swap.before_flip")
+            router.set_version(version)
+        except BaseException as e:
+            # rollback: the old version never stopped serving — destroy
+            # the surge replicas, restore the spawn options, re-assert
+            # the old active version
+            router._opts["swap_boot"] = False
+            router.set_model_dir(old_dir, old_ver_opt)
+            router._opts["version"] = old_ver_opt  # set_model_dir defaults
+            if router.active_version != old_active:
+                router.set_version(old_active)
+            with router._cond:
+                doomed = [w for w in router._workers
+                          if w.name in set(new_names)]
+                for w in doomed:
+                    router._workers.remove(w)
+                router._cond.notify_all()
+            router._abort_workers(doomed)
+            obs.SWAP_TOTAL.inc(result="rollback")
+            obs.SWAP_MS.observe(
+                (time.perf_counter() - t_total) * 1e3, phase="total")
+            if not isinstance(e, Exception):
+                raise  # KeyboardInterrupt/SystemExit: rolled back, but
+                # the interrupt must still stop the caller (a watcher
+                # catching SwapError would otherwise swallow Ctrl-C)
+            if isinstance(e, SwapError):
+                raise
+            raise SwapError("hot swap to %r rolled back: %s"
+                            % (version, e)) from e
+        finally:
+            router._opts["swap_boot"] = False
+        # -- committed: retire the old version --------------------------
+        obs.SWAP_TOTAL.inc(result="ok")
+        retired, retire_errs = [], []
+        t0 = time.perf_counter()
+        try:
+            fault_point("swap.before_retire")
+            for w in old_workers:
+                try:
+                    retired.append(router.retire_worker(
+                        w, timeout=retire_timeout))
+                except Exception as e:  # noqa: BLE001 — collected below
+                    retire_errs.append("%s: %s" % (w.name, e))
+        except Exception as e:  # a barrier fault is a retire failure
+            retire_errs.append(str(e))
+        obs.SWAP_MS.observe((time.perf_counter() - t0) * 1e3,
+                            phase="retire")
+        obs.SWAP_MS.observe((time.perf_counter() - t_total) * 1e3,
+                            phase="total")
+        if retire_errs:
+            # post-commit: the new version IS serving — surface the
+            # cleanup failure as SwapError(rolled_back=False) so
+            # callers (SwapWatcher) advance past this serial instead of
+            # re-swapping it
+            raise SwapError(
+                "swap to %r COMMITTED (new version serving), but "
+                "retiring the old replicas failed: %s — reap_dead()/the "
+                "autoscaler can finish the cleanup"
+                % (version, "; ".join(retire_errs)), rolled_back=False)
+        return {"version": version, "previous": old_active,
+                "replicas": new_names, "canaried": compared,
+                "retired": retired}

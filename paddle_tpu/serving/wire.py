@@ -32,7 +32,14 @@ from __future__ import annotations
 import struct
 from typing import Iterator, List, Optional, Sequence, Tuple
 
-__all__ = ["pack", "iter_messages", "pack_slo", "read_slo"]
+__all__ = ["pack", "iter_messages", "pack_slo", "read_slo", "WireError"]
+
+
+class WireError(ValueError):
+    """A malformed wire payload (truncated multi-message, torn SLO
+    header). Parse paths raise THIS instead of a bare struct.error so
+    the router/worker loops can give the frame a structured reject —
+    never crash a serving thread, never silently misparse."""
 
 _MULTI = 0x4D  # b"M"
 _LEN = struct.Struct("<I")
@@ -54,7 +61,10 @@ def pack(msgs: Sequence[bytes]) -> bytes:
 
 
 def iter_messages(payload) -> Iterator:
-    """The messages inside a pipe payload (one, or a packed batch)."""
+    """The messages inside a pipe payload (one, or a packed batch).
+    Raises ``WireError`` on a truncated/overrunning length prefix — a
+    torn multi-message must surface as one structured parse error, not
+    as N-1 good frames plus silent garbage."""
     if payload[:1] != b"M":
         yield payload
         return
@@ -62,8 +72,16 @@ def iter_messages(payload) -> Iterator:
     off = 1
     end = len(mv)
     while off < end:
+        if end - off < _LEN.size:
+            raise WireError(
+                "truncated multi-message: %d trailing byte(s) where a "
+                "length prefix belongs" % (end - off))
         (n,) = _LEN.unpack_from(mv, off)
         off += _LEN.size
+        if n > end - off:
+            raise WireError(
+                "truncated multi-message: length prefix says %d bytes "
+                "but only %d remain" % (n, end - off))
         yield mv[off:off + n]
         off += n
 
@@ -92,9 +110,20 @@ def read_slo(msg) -> Tuple[Optional[int], Optional[float], Optional[str],
     if bytes(msg[:1]) != _SLO:
         return None, None, None, msg
     mv = memoryview(msg)
+    if len(mv) < 1 + _SLO_HDR.size:
+        raise WireError(
+            "truncated SLO header: %d byte(s), need at least %d"
+            % (len(mv), 1 + _SLO_HDR.size))
     prio, klen = _SLO_HDR.unpack_from(mv, 1)
     off = 1 + _SLO_HDR.size
-    klass = bytes(mv[off:off + klen]).decode("ascii")
+    if len(mv) < off + klen + _SLO_DL.size:
+        raise WireError(
+            "truncated SLO header: class+deadline need %d bytes, %d "
+            "remain" % (klen + _SLO_DL.size, len(mv) - off))
+    try:
+        klass = bytes(mv[off:off + klen]).decode("ascii")
+    except UnicodeDecodeError as e:
+        raise WireError("non-ascii SLO class name: %s" % e) from e
     off += klen
     (deadline,) = _SLO_DL.unpack_from(mv, off)
     off += _SLO_DL.size

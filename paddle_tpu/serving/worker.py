@@ -81,6 +81,12 @@ def worker_main(conn, options):
                                     "PADDLE_TPU_FAULT_IO"))
     if faults_armed:
         fault_point("serving.worker_boot")
+        if options.get("swap_boot"):
+            # this spawn is a hot-swap's INCOMING replica: a swap.*-
+            # scoped chaos spec (SIGKILL/delay the new version mid-swap)
+            # fires here without touching regular boots of the same
+            # fleet — the rollback-leaves-old-serving contract's barrier
+            fault_point("swap.worker_boot")
 
     import jax
 
@@ -150,7 +156,7 @@ def worker_main(conn, options):
                 options["model_dir"],
                 strategy=options.get("strategy") or "greedy",
                 draft_n_layer=options.get("decode_draft_layers"))
-            version = pred.fingerprint()
+            version = options.get("version") or pred.fingerprint()
             server = DecodeServer(
                 pred,
                 slots=int(options.get("decode_slots", 4)),
@@ -167,7 +173,11 @@ def worker_main(conn, options):
                 pred = ShardedPredictor(options["model_dir"], shard=shard)
             else:
                 pred = Predictor(options["model_dir"])
-            version = pred._engine.fingerprint()
+            # the MODEL version label (hot swap: distinct exports of one
+            # architecture share a program fingerprint, so the router
+            # hands each spawn an explicit label); fingerprint fallback
+            # keeps pre-swap fleets byte-identical in behavior
+            version = options.get("version") or pred._engine.fingerprint()
             server = PredictorServer(
                 pred,
                 max_batch=int(options.get("max_batch", 8)),
@@ -188,6 +198,8 @@ def worker_main(conn, options):
         {"ready": True, "version": version, "pid": os.getpid(),
          "name": name, "metrics_port": port, "shard": shard}, protocol=4))
 
+    served = [0]  # responses sent (rides each heartbeat)
+
     def respond(rid, fut):
         try:
             rows = fut.result(timeout=0)
@@ -195,6 +207,27 @@ def worker_main(conn, options):
                  + _encode_sample(rid, rows))
         except Exception as e:
             send(b"E" + _pickle_error(rid, e))
+        served[0] += 1
+
+    # heartbeats through the control pipe: a dedicated thread, so a
+    # main loop stuck in a device dispatch (or a chaos DELAY barrier)
+    # still proves pipe/process liveness while the served count exposes
+    # the STALL — the router's watchdog reaps live-but-hung replicas on
+    # exactly that signal (wedge_timeout_s)
+    hb_stop = threading.Event()
+    hb_interval = float(options.get("heartbeat_s", 1.0) or 0)
+
+    def _hb_loop():
+        while not hb_stop.wait(hb_interval):
+            send(b"S" + pickle.dumps(
+                {"hb": True, "served": served[0],
+                 "depth": len(server._results)}, protocol=4))
+
+    hb_thread = None
+    if hb_interval > 0:
+        hb_thread = threading.Thread(target=_hb_loop, daemon=True,
+                                     name="ptpu-worker-hb")
+        hb_thread.start()
 
     def _pickle_error(rid, e):
         """An error response must ALWAYS reach the router — an exception
@@ -213,6 +246,31 @@ def worker_main(conn, options):
 
     from ..runtime import recordio as _rio
 
+    def _probe(cmd):
+        """Hot-swap canary probe: run ONE request frame straight
+        through the predictor (bypassing the serving queue — the probe
+        must not consume a router-minted tag namespace or a batch
+        slot) and reply with the output rows over the status pipe."""
+        try:
+            if options.get("decode"):
+                raise RuntimeError(
+                    "canary probe is a dense-predictor surface (decode "
+                    "replicas generate, they don't score a fixed row)")
+            import numpy as _np
+
+            _rid, rows = _rio.decode_frame(memoryview(cmd["frame"]))
+            # under the server's device lock: every predictor dispatch
+            # is serialized through it (inference.py's single-threaded
+            # device invariant) — a probe racing the live device stage
+            # would otherwise run/compile concurrently with traffic
+            with server._dev_lock:
+                outs = pred.run([_np.asarray(r)[None] for r in rows])
+            send(b"S" + pickle.dumps(
+                {"probe": [_np.asarray(o) for o in outs]}, protocol=4))
+        except Exception as e:
+            send(b"S" + pickle.dumps({"probe_error": repr(e)},
+                                     protocol=4))
+
     try:
         stop = False
         while not stop:
@@ -220,11 +278,25 @@ def worker_main(conn, options):
                 payload = conn.recv_bytes()
             except (EOFError, OSError):
                 break  # router gone: drain and exit
-            for msg in wire.iter_messages(payload):
+            try:
+                msgs = list(wire.iter_messages(payload))
+            except wire.WireError:
+                # a torn multi-message: count, survive, keep serving
+                obs.PREDICT_FAILURES.inc(path="wire")
+                continue
+            for msg in msgs:
                 kind = bytes(msg[:1])
                 if kind == b"C":
-                    cmd = pickle.loads(msg[1:])
-                    op = cmd.get("cmd")
+                    try:
+                        cmd = pickle.loads(msg[1:])
+                        op = cmd.get("cmd")
+                    except Exception:
+                        # a b"C"-prefixed frame that isn't a pickled
+                        # dict must cost a counted drop, not the
+                        # replica (same contract as every other frame
+                        # kind)
+                        obs.PREDICT_FAILURES.inc(path="wire")
+                        continue
                     if op == "stop":
                         stop = True
                         break
@@ -239,13 +311,19 @@ def worker_main(conn, options):
                         send(b"S" + pickle.dumps(
                             {"metrics": export.to_json(
                                 include_timeline=False)}, protocol=4))
+                    elif op == "probe":
+                        _probe(cmd)
                     continue
                 if kind == b"Q":
                     # belt-and-braces: the router strips the SLO header
                     # before forwarding, but a direct caller (or a
                     # future router that forwards deadlines) must not
                     # wedge the replica on an unknown prefix
-                    msg = wire.read_slo(msg)[3]
+                    try:
+                        msg = wire.read_slo(msg)[3]
+                    except wire.WireError:
+                        obs.PREDICT_FAILURES.inc(path="wire")
+                        continue
                 if faults_armed:
                     fault_point("serving.request")
                 # request frame: submit as-is (bytes — the C channel
@@ -253,7 +331,16 @@ def worker_main(conn, options):
                 # back from the completing server thread via the done
                 # callback
                 msg = bytes(msg)
-                rid = _rio.frame_tag(msg)
+                try:
+                    rid = _rio.frame_tag(msg)
+                except Exception:
+                    # malformed frame with no recoverable tag: nothing
+                    # to address a structured reject TO — count it and
+                    # keep the replica alive (the router side gives the
+                    # tagless frame's future its reject, when one
+                    # exists)
+                    obs.PREDICT_FAILURES.inc(path="wire")
+                    continue
                 try:
                     fut = server.submit_frame(msg)
                 except Exception as e:
@@ -266,6 +353,9 @@ def worker_main(conn, options):
         # outstanding future completes -> every response is queued
         # BEFORE the stopped status below, and the sender flushes the
         # queue in order before exiting
+        hb_stop.set()
+        if hb_thread is not None:
+            hb_thread.join(timeout=5)
         server.stop()
         send(b"S" + pickle.dumps({"stopped": True}, protocol=4))
         out_q.put(_SENDER_STOP)

@@ -1,0 +1,18 @@
+"""paddle_tpu.training — loops above the Executor.
+
+``stream`` holds the online-learning trainer (ROADMAP item 6): an
+unbounded, epoch-less step loop with the data/control-plane hardening
+streaming traffic needs (in-graph NaN/Inf sentinel with quarantine,
+corrupt-record tolerance via the recordio reader's tolerant mode) and
+periodic ATOMIC versioned inference exports the hot-swap controller
+(``serving.swap`` / ``tools/swap_ctl.py``) follows.
+"""
+from __future__ import annotations
+
+from .stream import (  # noqa: F401
+    InferenceExportManager, NonFiniteStreamError, StreamingTrainer,
+    append_nonfinite_guard,
+)
+
+__all__ = ["StreamingTrainer", "InferenceExportManager",
+           "NonFiniteStreamError", "append_nonfinite_guard"]

@@ -1,0 +1,384 @@
+"""Streaming trainer: epoch-less online learning with a hardened step.
+
+The reference's production recommender loop (SURVEY §1: train
+continuously, export, serve without dropping traffic) rebuilt on this
+stack. Three pieces:
+
+**Unbounded step loop.** ``StreamingTrainer.run`` consumes a batch
+source with NO epoch boundary — an infinite generator, or a finite
+reader re-opened forever (``restart_source=True``, the recordio-file
+case). There is no epoch bookkeeping to resume; position is just the
+global step.
+
+**In-graph NaN/Inf sentinel.** One poisoned batch (corrupt row decoded
+into garbage floats, a loss spike into inf) would silently destroy the
+model: by the time a fetched loss shows NaN the optimizer has already
+applied NaN gradients. ``append_nonfinite_guard`` splices the check
+INTO the program between backward and the optimizer ops: a ``finite``
+scalar (isfinite over loss AND every gradient, AND-reduced) scales all
+gradients — a poisoned batch applies exactly-zero gradients, so
+parameters are untouched (bit-exact for SGD; adaptive optimizers decay
+their moments with zero gradients, documented drift). The host fetches
+the flag each step: a skipped batch is QUARANTINED to disk with
+provenance (step, loss, feed arrays), counted
+(``paddle_tpu_train_skipped_batches_total{reason="nonfinite"}``), and
+past a configurable threshold (total or consecutive) the stream ABORTS
+with ``NonFiniteStreamError`` — a poisoned pipeline must page someone,
+not quietly train on 0% of its data.
+
+**Atomic versioned exports.** Every ``export_interval`` clean steps the
+persistables are snapshotted ON the step path (cheap host copy — the
+PR-10 contract) and an ``InferenceExportManager`` — the async
+``CheckpointManager`` writer with its file layout swapped to
+``save_inference_model``'s (``__model__`` JSON + ``__params__.npz``) —
+publishes ``<export_dir>/checkpoint_<N>/`` crash-safely (tmp + fsync +
+``_COMPLETE`` sentinel + atomic rename). Readers (``Predictor``, the
+``tools/swap_ctl.py`` watcher) only ever see complete exports, each
+loadable directly as a ``save_inference_model`` directory.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import observability as obs
+from .. import optimizer as optimizer_mod
+from ..checkpoint.manager import CheckpointManager, _encode_npz
+from ..data_feeder import DataFeeder
+from ..executor import Executor
+from ..framework.core import Program, program_guard
+from ..framework.scope import Scope, scope_guard
+from ..framework import unique_name
+from ..layer_helper import LayerHelper
+from ..trainer import build_feed_var_list, check_and_get_place
+
+__all__ = ["StreamingTrainer", "InferenceExportManager",
+           "NonFiniteStreamError", "append_nonfinite_guard"]
+
+
+class NonFiniteStreamError(RuntimeError):
+    """The poisoned-batch threshold tripped: the stream is feeding the
+    trainer garbage faster than skipping can excuse. Carries the skip
+    counts and the quarantine directory for the post-mortem."""
+
+    def __init__(self, msg, skipped=0, consecutive=0, quarantine_dir=None):
+        super().__init__(msg)
+        self.skipped = skipped
+        self.consecutive = consecutive
+        self.quarantine_dir = quarantine_dir
+
+
+def append_nonfinite_guard(loss, params_grads):
+    """Splice the NaN/Inf step sentinel into the CURRENT program,
+    between the backward op and the optimizer ops the caller is about
+    to append: ``finite = isfinite(loss) AND isfinite(g) for every g``
+    (each ``isfinite`` op reduces its whole tensor to one bool), and
+    every gradient is replaced by ``select(finite, g, zeros_like(g))``
+    — the ORIGINAL gradient on a healthy step, EXACTLY ZERO on a
+    poisoned one. A select, not a multiply: ``NaN * 0`` is NaN, so
+    scaling would pass the poison straight through to the optimizer.
+    SGD then leaves parameters bit-identical (``p -= lr * 0``);
+    adaptive optimizers decay their moments with zero gradients —
+    close to, not exactly, a skip.
+
+    Returns ``(finite_var, gated_params_grads)``; fetch ``finite_var``
+    each step to know whether the batch trained or must be quarantined.
+    """
+    helper = LayerHelper("nonfinite_guard")
+    block = loss.block
+
+    def _isfinite(x):
+        out = helper.create_variable_for_type_inference(
+            dtype="bool", shape=(), stop_gradient=True)
+        block.append_op(type="isfinite", inputs={"X": [x]},
+                        outputs={"Out": [out]})
+        return out
+
+    finite = _isfinite(loss)
+    for _p, g in params_grads:
+        flag = _isfinite(g)
+        both = helper.create_variable_for_type_inference(
+            dtype="bool", shape=(), stop_gradient=True)
+        block.append_op(type="logical_and",
+                        inputs={"X": [finite], "Y": [flag]},
+                        outputs={"Out": [both]})
+        finite = both
+    gated = []
+    for p, g in params_grads:
+        zeros = helper.create_variable_for_type_inference(
+            dtype=g.dtype, shape=g.shape, stop_gradient=True)
+        block.append_op(type="fill_zeros_like", inputs={"X": [g]},
+                        outputs={"Out": [zeros]})
+        out = helper.create_variable_for_type_inference(
+            dtype=g.dtype, shape=g.shape, stop_gradient=True)
+        block.append_op(type="select",
+                        inputs={"Mask": [finite], "X": [g],
+                                "Y": [zeros]},
+                        outputs={"Out": [out]})
+        gated.append((p, out))
+    return finite, gated
+
+
+class InferenceExportManager(CheckpointManager):
+    """The PR-10 async checkpoint writer publishing INFERENCE exports:
+    same bounded-staleness queue, retry/backoff ladder, sync-degrade,
+    retention GC, and crash-safe tmp+sentinel+rename layout — but each
+    serial directory holds ``save_inference_model``'s files
+    (``__model__`` + ``__params__.npz``), so every complete export is
+    directly ``Predictor``-servable and hot-swappable.
+
+    ``program_meta`` is the export-time model description (built once:
+    the pruned inference program + feed/fetch names); snapshots passed
+    to ``save()`` are filtered to the parameters that program uses."""
+
+    def __init__(self, directory: str, program_meta: dict,
+                 param_names: Sequence[str], **kw):
+        super().__init__(directory, **kw)
+        self._model_blob = json.dumps(program_meta).encode("utf-8")
+        self._param_names = set(param_names)
+
+    def _encode_files(self, arrays) -> Dict[str, bytes]:
+        params = {n: v for n, v in arrays.items()
+                  if n in self._param_names}
+        missing = self._param_names - set(params)
+        if missing:
+            raise RuntimeError(
+                "inference export is missing persistables %s"
+                % sorted(missing)[:5])
+        return {"__model__": self._model_blob,
+                "__params__.npz": _encode_npz(params)}
+
+
+class StreamingTrainer:
+    """
+    st = StreamingTrainer(train_func, optimizer_func)
+    st.run(batch_source,
+           steps=10_000,                      # or None: run until the
+                                              # source ends / forever
+           export_dir=root, export_interval=500,
+           quarantine_dir=qdir, max_consecutive_skipped=32)
+
+    ``train_func()`` builds the graph and returns loss (or
+    [loss, *metrics]); ``optimizer_func()`` returns the Optimizer —
+    the same contract as ``Trainer``, except the optimizer is applied
+    through the non-finite guard (backward -> guard -> apply), so every
+    step carries the sentinel.
+    """
+
+    def __init__(self, train_func: Callable, optimizer_func: Callable,
+                 place=None, feed_order=None,
+                 infer_feed_names: Optional[Sequence[str]] = None):
+        self.place = check_and_get_place(place)
+        self.scope = Scope()
+        self.startup_program = Program()
+        self.train_program = Program()
+        with program_guard(self.train_program, self.startup_program):
+            with unique_name.guard():
+                outs = train_func()
+                self.train_func_outputs = list(outs) if isinstance(
+                    outs, (list, tuple)) else [outs]
+                # the inference twin BEFORE optimizer state pollutes the
+                # program (same move as Trainer.test_program)
+                self.infer_program = self.train_program.clone(
+                    for_test=True)
+                optimizer = optimizer_func()
+                if not isinstance(optimizer, optimizer_mod.Optimizer):
+                    raise TypeError(
+                        "optimizer_func must return an Optimizer")
+                loss = self.train_func_outputs[0]
+                params_grads = optimizer.backward(loss)
+                self.finite_var, gated = append_nonfinite_guard(
+                    loss, params_grads)
+                optimizer.apply_gradients(gated)
+        self.loss_var = self.train_func_outputs[0]
+        self.feed_order = feed_order
+        # export surface: feeds default to every data var, target is the
+        # first train_func output's forward twin (CTR: the prediction)
+        self._infer_feed_names = (list(infer_feed_names)
+                                  if infer_feed_names else None)
+        self._exe = Executor(self.place)
+        with scope_guard(self.scope):
+            self._exe.run(self.startup_program)
+        self.global_step = 0
+        self.skipped = 0
+        self._consecutive_skipped = 0
+        self.exports: List[int] = []
+
+    # -- export plumbing ---------------------------------------------------
+    def _build_export_manager(self, export_dir: str, keep: int,
+                              max_pending: int,
+                              infer_targets) -> InferenceExportManager:
+        from .. import io as io_mod
+
+        if infer_targets is None:
+            # Trainer.save_inference_model convention: train_func
+            # returns [loss, *served outputs] — export the first
+            # non-loss output (CTR: the prediction); a loss-only
+            # train_func exports the loss cone (and its label feed)
+            targets = [self.train_func_outputs[
+                1 if len(self.train_func_outputs) > 1 else 0]]
+        else:
+            targets = [self.train_func_outputs[t] if isinstance(t, int)
+                       else t for t in infer_targets]
+        names = [t.name if hasattr(t, "name") else str(t)
+                 for t in targets]
+        pruned = io_mod.get_inference_program(
+            names, main_program=self.infer_program)
+        feed_names = self._infer_feed_names
+        if feed_names is None:
+            feed_names = [v.name for v in
+                          self.infer_program.global_block().vars.values()
+                          if getattr(v, "is_data", False)
+                          # labels feed the loss, not the served graph:
+                          # keep only feeds the pruned program reads
+                          and any(v.name in op.input_arg_names
+                                  for blk in pruned.blocks
+                                  for op in blk.ops)]
+        used = {n for blk in pruned.blocks for op in blk.ops
+                for n in op.input_arg_names}
+        from ..io import is_persistable
+
+        param_names = [v.name for v in pruned.list_vars()
+                       if is_persistable(v) and v.name in used]
+        meta = {"feed_names": feed_names, "fetch_names": names,
+                "program": pruned.to_dict()}
+        return InferenceExportManager(
+            export_dir, meta, param_names,
+            max_num_checkpoints=keep, max_pending=max_pending)
+
+    def _quarantine(self, quarantine_dir: str, feed: Dict, loss_val,
+                    reason: str):
+        """Park the poisoned batch on disk with provenance — the
+        post-mortem artifact (which upstream producer, which step,
+        what it looked like)."""
+        os.makedirs(quarantine_dir, exist_ok=True)
+        stem = os.path.join(quarantine_dir,
+                            "batch_%08d_%s" % (self.global_step, reason))
+        arrays = {k: np.asarray(v) for k, v in feed.items()}
+        np.savez(stem + ".npz", **arrays)
+        meta = {"step": self.global_step, "reason": reason,
+                "loss": repr(np.asarray(loss_val).tolist()),
+                "wall_time": time.time(),
+                "feeds": {k: [list(a.shape), str(a.dtype)]
+                          for k, a in arrays.items()}}
+        with open(stem + ".json", "w") as f:
+            json.dump(meta, f, indent=2, sort_keys=True)
+
+    # -- the loop ----------------------------------------------------------
+    def run(self, reader: Callable, steps: Optional[int] = None,
+            export_dir: Optional[str] = None, export_interval: int = 0,
+            infer_targets=None, keep_exports: int = 3,
+            export_max_pending: int = 2, restart_source: bool = True,
+            quarantine_dir: Optional[str] = None,
+            max_skipped: Optional[int] = None,
+            max_consecutive_skipped: int = 32,
+            event_handler: Optional[Callable] = None) -> Dict:
+        """Train on ``reader()`` batches until ``steps`` (None = until
+        the source ends; with ``restart_source`` a finite source is
+        reopened forever, so None + restart_source only returns on
+        abort). Returns a summary dict. Every ``export_interval`` CLEAN
+        (non-skipped) steps one export publishes asynchronously;
+        ``exports`` lists the serials. ``event_handler(step, metrics)``
+        fires after each clean step."""
+        feed_var_list = build_feed_var_list(self.train_program,
+                                            self.feed_order)
+        feeder = DataFeeder(feed_list=feed_var_list, place=self.place)
+        manager = None
+        if export_dir is not None and export_interval:
+            manager = self._build_export_manager(
+                export_dir, keep_exports, export_max_pending,
+                infer_targets)
+        fetch = [self.loss_var.name, self.finite_var.name]
+        clean_steps = 0
+        quarantine_dir = quarantine_dir or (
+            os.path.join(export_dir, "_quarantine") if export_dir
+            else None)
+
+        def batches():
+            while True:
+                it = reader()
+                got = False
+                for b in it:
+                    got = True
+                    yield b
+                if not restart_source or not got:
+                    return
+
+        try:
+            with scope_guard(self.scope):
+                for data in batches():
+                    if steps is not None and self.global_step >= steps:
+                        break
+                    feed = (data if isinstance(data, dict)
+                            else feeder.feed(data))
+                    loss_val, finite = self._exe.run(
+                        self.train_program, feed=feed, fetch_list=fetch)
+                    self.global_step += 1
+                    if not bool(np.asarray(finite).reshape(-1)[0]):
+                        # poisoned batch: parameters untouched (gated),
+                        # quarantine + count + threshold check
+                        self.skipped += 1
+                        self._consecutive_skipped += 1
+                        obs.TRAIN_SKIPPED_BATCHES.inc(reason="nonfinite")
+                        if quarantine_dir is not None:
+                            self._quarantine(quarantine_dir, feed,
+                                             loss_val, "nonfinite")
+                        too_many = (max_skipped is not None
+                                    and self.skipped > max_skipped)
+                        too_consec = (max_consecutive_skipped is not None
+                                      and self._consecutive_skipped
+                                      > max_consecutive_skipped)
+                        if too_many or too_consec:
+                            raise NonFiniteStreamError(
+                                "non-finite input stream: %d batch(es) "
+                                "skipped (%d consecutively) by step %d "
+                                "— the pipeline is poisoned, not "
+                                "occasionally dirty%s" % (
+                                    self.skipped,
+                                    self._consecutive_skipped,
+                                    self.global_step,
+                                    "; quarantined batches are under %s"
+                                    % quarantine_dir
+                                    if quarantine_dir else ""),
+                                skipped=self.skipped,
+                                consecutive=self._consecutive_skipped,
+                                quarantine_dir=quarantine_dir)
+                        continue
+                    self._consecutive_skipped = 0
+                    clean_steps += 1
+                    if event_handler is not None:
+                        event_handler(self.global_step, loss_val)
+                    if manager is not None and \
+                            clean_steps % export_interval == 0:
+                        self._export(manager)
+        finally:
+            if manager is not None:
+                manager.close()  # drain: every queued export lands
+        return {"steps": self.global_step, "clean_steps": clean_steps,
+                "skipped": self.skipped, "exports": list(self.exports)}
+
+    def _export(self, manager: InferenceExportManager) -> int:
+        """Queue one async export of the current parameters (the step
+        path pays only the host snapshot)."""
+        arrays = manager.snapshot(self.train_program, self.scope)
+        serial = manager.save(arrays, meta={
+            "global_step": self.global_step,
+            "skipped": self.skipped,
+            "fingerprint": self.infer_program.fingerprint()})
+        self.exports.append(serial)
+        return serial
+
+    def export_now(self, export_dir: str, infer_targets=None,
+                   keep_exports: int = 3) -> int:
+        """One SYNCHRONOUS export outside a run() loop (tests, manual
+        publish): returns the serial."""
+        manager = self._build_export_manager(export_dir, keep_exports,
+                                             0, infer_targets)
+        try:
+            return self._export(manager)
+        finally:
+            manager.close()

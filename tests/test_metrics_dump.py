@@ -42,6 +42,13 @@ _REQUIRED_SERIES = (
     "paddle_tpu_decode_prefix_bytes",
     "paddle_tpu_decode_spec_proposed_total",
     "paddle_tpu_decode_spec_accepted_total",
+    # online learning & hot swap (ISSUE 15): the swap controller, the
+    # streaming trainer's poisoned-batch sentinel, and the wedged-
+    # worker watchdog all leave series in the same exposition
+    "paddle_tpu_swap_total",
+    "paddle_tpu_swap_ms_bucket",
+    "paddle_tpu_train_skipped_batches_total",
+    "paddle_tpu_fleet_wedged_total",
 )
 
 
@@ -72,6 +79,15 @@ def test_prometheus_exposition_contains_required_series(dump_output):
     # prefix hits carry their kind label the same way (full | partial |
     # batch) — the decode_round's miss->insert->hit lands exactly one
     assert 'paddle_tpu_decode_prefix_hits_total{kind="full"} 1' in text
+    # ISSUE 15 exact lines: one rejected swap (result label), one
+    # NaN-skipped batch and one corrupt chunk (reason labels), one
+    # wedge-reaped replica — dashboards/alerts key on these
+    assert 'paddle_tpu_swap_total{result="rollback"} 1' in text
+    assert ('paddle_tpu_train_skipped_batches_total{reason="nonfinite"}'
+            ' 1') in text
+    assert ('paddle_tpu_train_skipped_batches_total'
+            '{reason="corrupt_chunk"} 1') in text
+    assert "paddle_tpu_fleet_wedged_total 1" in text
 
 
 def test_histogram_buckets_are_cumulative_and_consistent(dump_output):

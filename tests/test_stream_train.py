@@ -1,0 +1,253 @@
+"""Streaming trainer + hardened data plane (ISSUE 15): the in-graph
+NaN/Inf sentinel (skip is EXACT for SGD, quarantine carries provenance,
+threshold aborts), corrupt-recordio tolerance (chunk resync + record
+skip, in and out of DataLoader workers), and atomic versioned inference
+exports (every complete serial is directly Predictor-servable)."""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, optimizer
+from paddle_tpu import observability as obs
+from paddle_tpu.checkpoint import layout
+from paddle_tpu.inference import Predictor
+from paddle_tpu.runtime.recordio import (RecordIOError, RecordIOReader,
+                                         RecordIOWriter,
+                                         recordio_sample_reader)
+from paddle_tpu.training import (NonFiniteStreamError, StreamingTrainer,
+                                 append_nonfinite_guard)
+
+
+def _mlp_train_func():
+    x = layers.data(name="x", shape=[4])
+    y = layers.data(name="y", shape=[1])
+    h = layers.fc(x, 8, act="relu")
+    pred = layers.fc(h, 1)
+    loss = layers.mean(layers.square(pred - y))
+    return [loss, pred]
+
+
+def _sgd():
+    return optimizer.SGD(learning_rate=0.05)
+
+
+def _batches(n, poison=()):
+    rs = np.random.RandomState(7)
+    out = []
+    for i in range(n):
+        x = rs.rand(4, 4).astype(np.float32)
+        y = rs.rand(4, 1).astype(np.float32)
+        if i in poison:
+            x = x.copy()
+            x[0, 0] = np.nan
+        out.append({"x": x, "y": y})
+    return out
+
+
+# -- the in-graph sentinel ------------------------------------------------
+
+def test_nonfinite_guard_unit():
+    """Graph-level: the finite flag reads False on a poisoned feed and
+    the gated gradients come out EXACTLY zero (select, not multiply —
+    NaN * 0 would pass the poison through)."""
+    mp, sp = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(mp, sp):
+        with fluid.unique_name.guard():
+            x = layers.data(name="x", shape=[4])
+            pred = layers.fc(x, 1)
+            loss = layers.mean(layers.square(pred))
+            opt = optimizer.SGD(learning_rate=0.0)  # lr 0: params frozen
+            params_grads = opt.backward(loss)
+            finite, gated = append_nonfinite_guard(loss, params_grads)
+            opt.apply_gradients(gated)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(sp)
+        fetch = [finite.name] + [g.name for _p, g in gated]
+        ok = exe.run(mp, feed={"x": np.ones((2, 4), np.float32)},
+                     fetch_list=fetch)
+        assert bool(np.asarray(ok[0]))
+        assert any(np.abs(g).sum() > 0 for g in ok[1:])
+        bad = exe.run(mp,
+                      feed={"x": np.full((2, 4), np.inf, np.float32)},
+                      fetch_list=fetch)
+        assert not bool(np.asarray(bad[0]))
+        for g in bad[1:]:
+            assert np.array_equal(np.asarray(g),
+                                  np.zeros_like(np.asarray(g)))
+
+
+def test_nan_batch_skipped_quarantined_and_bit_exact(tmp_path):
+    """The chaos pin: a NaN-poisoned stream trains through with the
+    poisoned batches quarantined and the loss/parameter trajectory
+    OTHERWISE UNAFFECTED — for SGD the skip is bit-exact vs a control
+    run that never saw the poison."""
+    skipped0 = obs.TRAIN_SKIPPED_BATCHES.value(reason="nonfinite")
+    batches = _batches(8, poison={3, 6})
+    qdir = str(tmp_path / "quarantine")
+    st = StreamingTrainer(_mlp_train_func, _sgd)
+    res = st.run(lambda: iter(batches), restart_source=False,
+                 quarantine_dir=qdir)
+    assert res["skipped"] == 2 and res["clean_steps"] == 6
+    assert obs.TRAIN_SKIPPED_BATCHES.value(reason="nonfinite") \
+        - skipped0 == 2
+    # quarantine: the batch bytes + provenance sidecar
+    names = sorted(os.listdir(qdir))
+    assert names == ["batch_00000004_nonfinite.json",
+                     "batch_00000004_nonfinite.npz",
+                     "batch_00000007_nonfinite.json",
+                     "batch_00000007_nonfinite.npz"]
+    meta = json.load(open(os.path.join(qdir, names[0])))
+    assert meta["reason"] == "nonfinite" and meta["step"] == 4
+    assert meta["feeds"]["x"] == [[4, 4], "float32"]
+    with np.load(os.path.join(qdir, names[1])) as npz:
+        assert np.isnan(npz["x"]).any()
+    # control: the same stream minus the poison — bit-exact params
+    control = StreamingTrainer(_mlp_train_func, _sgd)
+    control.run(lambda: iter([b for i, b in enumerate(batches)
+                              if i not in (3, 6)]),
+                restart_source=False)
+    for v in st.train_program.list_vars():
+        if not getattr(v, "persistable", False):
+            continue
+        a = np.asarray(st.scope.find_var(v.name))
+        b = np.asarray(control.scope.find_var(v.name))
+        assert np.array_equal(a, b), v.name
+
+
+def test_poisoned_stream_aborts_past_threshold(tmp_path):
+    bad = {"x": np.full((4, 4), np.nan, np.float32),
+           "y": np.zeros((4, 1), np.float32)}
+    st = StreamingTrainer(_mlp_train_func, _sgd)
+    with pytest.raises(NonFiniteStreamError) as ei:
+        st.run(lambda: iter([bad] * 50), restart_source=False,
+               max_consecutive_skipped=3,
+               quarantine_dir=str(tmp_path / "q"))
+    assert ei.value.consecutive == 4
+    assert "poisoned" in str(ei.value)
+    # total-budget threshold trips too, across non-consecutive skips
+    st2 = StreamingTrainer(_mlp_train_func, _sgd)
+    good = _batches(1)[0]
+    with pytest.raises(NonFiniteStreamError):
+        st2.run(lambda: iter([good, bad] * 50), restart_source=False,
+                max_skipped=2, max_consecutive_skipped=None,
+                quarantine_dir=str(tmp_path / "q2"))
+
+
+# -- exports --------------------------------------------------------------
+
+def test_streaming_exports_are_atomic_and_servable(tmp_path):
+    """ROADMAP-6 first half: an unbounded (restarted) source produces
+    two successive complete exports; each is a real
+    save_inference_model dir (Predictor loads it), published via the
+    crash-safe sentinel layout, with meta carrying the step."""
+    root = str(tmp_path / "exports")
+    st = StreamingTrainer(_mlp_train_func, _sgd)
+    res = st.run(lambda: iter(_batches(4)), steps=12,
+                 export_dir=root, export_interval=5,
+                 restart_source=True)  # 4-batch source, epoch-less loop
+    assert res["steps"] == 12
+    serials = layout.complete_serials(root)
+    assert len(serials) >= 2
+    outs = []
+    for s in serials:
+        d = layout.serial_dir(root, s)
+        assert layout.is_complete(d)
+        meta = layout.read_meta(d)
+        assert meta["global_step"] > 0
+        p = Predictor(d, aot_cache=False)
+        assert p.feed_names == ["x"]  # label feed is NOT exported
+        out, = p.run({"x": np.ones((2, 4), np.float32)})
+        outs.append(np.asarray(out))
+    # training progressed between exports: the versions really differ
+    assert not np.array_equal(outs[0], outs[-1])
+
+
+# -- corrupt recordio -----------------------------------------------------
+
+def _write_rio(path, n=8, compressor=1):
+    with RecordIOWriter(path, compressor=compressor,
+                        max_chunk_records=2) as w:
+        for i in range(n):
+            w.write(pickle.dumps((np.full((3,), i, np.float32),),
+                                 protocol=4))
+
+
+def test_tolerant_reader_skips_corrupt_chunk_and_resyncs(tmp_path):
+    path = str(tmp_path / "data.rio")
+    _write_rio(path)
+    blob = bytearray(open(path, "rb").read())
+    # flip a byte INSIDE the second chunk's payload (past its header):
+    # _HDR is <IIIQQI> = 32 bytes with complen at [20:28]
+    hdr = 32
+    first_len = int.from_bytes(blob[20:28], "little")  # complen of c0
+    blob[hdr + first_len + hdr + 2] ^= 0xFF
+    open(path, "wb").write(bytes(blob))
+    # strict: raises
+    with pytest.raises(RecordIOError):
+        list(RecordIOReader(path))
+    # tolerant: the other chunks' records survive, the loss is counted
+    c0 = obs.TRAIN_SKIPPED_BATCHES.value(reason="corrupt_chunk")
+    r = RecordIOReader(path, tolerant=True)
+    recs = [pickle.loads(x)[0][0] for x in r]
+    assert r.skipped_chunks == 1
+    assert obs.TRAIN_SKIPPED_BATCHES.value(reason="corrupt_chunk") \
+        - c0 == 1
+    assert len(recs) == 6 and 0.0 in recs and 7.0 in recs
+    assert 2.0 not in recs and 3.0 not in recs
+
+
+def test_tolerant_sample_reader_skips_unpicklable_record(tmp_path):
+    path = str(tmp_path / "recs.rio")
+    with RecordIOWriter(path, compressor=0, max_chunk_records=1) as w:
+        w.write(pickle.dumps(("ok-0",), protocol=4))
+        w.write(b"\x80\x05not really a pickle")
+        w.write(pickle.dumps(("ok-2",), protocol=4))
+    c0 = obs.TRAIN_SKIPPED_BATCHES.value(reason="corrupt_record")
+    got = list(recordio_sample_reader(path, skip_corrupt=True)())
+    assert got == [("ok-0",), ("ok-2",)]
+    assert obs.TRAIN_SKIPPED_BATCHES.value(reason="corrupt_record") \
+        - c0 == 1
+    # without the knob: the crash the DataLoader worker would have died
+    with pytest.raises(Exception):
+        list(recordio_sample_reader(path, prefetch=False)())
+
+
+class _TolerantSource:
+    """Module-level picklable source (forkserver contract) over a
+    corrupt recordio file with skip_corrupt on."""
+
+    def __init__(self, path):
+        self.path = path
+
+    def __call__(self):
+        return recordio_sample_reader(self.path, skip_corrupt=True)()
+
+
+def test_dataloader_survives_corrupt_recordio(tmp_path):
+    """The ISSUE wording end to end: a DataLoader WORKER iterating a
+    corrupt recordio source skips + counts instead of crashing the
+    worker (which would poison the whole epoch with a RuntimeError)."""
+    from paddle_tpu.io.dataloader import DataLoader
+
+    path = str(tmp_path / "loader.rio")
+    with RecordIOWriter(path, compressor=0, max_chunk_records=1) as w:
+        for i in range(6):
+            w.write(pickle.dumps((np.full((4,), i, np.float32),),
+                                 protocol=4))
+        w.write(b"garbage-record-not-pickle")
+    loader = DataLoader(["x"], shapes=[[4]], dtypes=["float32"],
+                        num_workers=1, capacity=4)
+    loader.decorate_sample_reader(_TolerantSource(path), batch_size=2)
+    try:
+        batches = list(loader)
+        assert len(batches) == 3
+        assert all(b["x"].shape == (2, 4) for b in batches)
+    finally:
+        loader.close()

@@ -1,0 +1,315 @@
+"""Zero-downtime online learning (ISSUE 15 tentpole): hot model swap
+under load, the swap watcher, chaos at the swap barriers, and the
+wedged-worker watchdog.
+
+The ROADMAP-6 acceptance contract is pinned here: a streaming trainer
+produces successive exports; the fleet hot-swaps twice under
+closed-loop client load with zero dropped and zero misversioned
+requests, and every served row verifies against the DIRECT predictor of
+the version that served it. Chaos variants: SIGKILL the incoming
+replica at the ``swap.worker_boot`` barrier (rollback, old version
+keeps serving), an injected IO fault at ``swap.before_flip`` (same),
+a canary parity failure (same), and a fault-DELAY wedged worker reaped
+via the watchdog with its in-flight frames completing on survivors.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, optimizer
+from paddle_tpu import observability as obs
+from paddle_tpu.checkpoint import faults, layout
+from paddle_tpu.inference import Predictor
+from paddle_tpu.serving import Router, SwapController, SwapError
+from paddle_tpu.training import StreamingTrainer
+
+PROBE = np.linspace(-1, 1, 5 * 4).reshape(5, 4).astype(np.float32)
+
+
+def _train_func():
+    x = layers.data(name="x", shape=[4])
+    y = layers.data(name="y", shape=[1])
+    h = layers.fc(x, 8, act="relu")
+    pred = layers.fc(h, 1)
+    loss = layers.mean(layers.square(pred - y))
+    return [loss, pred]
+
+
+@pytest.fixture(scope="module")
+def exports(tmp_path_factory):
+    """A streaming trainer's export root with >= 3 successive versions,
+    plus the direct-predictor reference rows per version (the
+    acceptance oracle). Loading each version once also primes its
+    model-local AOT cache, so fleet workers warm-start."""
+    root = str(tmp_path_factory.mktemp("stream_exports"))
+    rs = np.random.RandomState(3)
+    batches = [{"x": rs.rand(4, 4).astype(np.float32),
+                "y": rs.rand(4, 1).astype(np.float32)} for _ in range(4)]
+    st = StreamingTrainer(_train_func,
+                          lambda: optimizer.SGD(learning_rate=0.2))
+    st.run(lambda: iter(batches), steps=12, export_dir=root,
+           export_interval=4, keep_exports=8, restart_source=True)
+    serials = layout.complete_serials(root)
+    assert len(serials) >= 3, serials
+    want = {}
+    for s in serials[:3]:
+        d = layout.serial_dir(root, s)
+        out, = Predictor(d).run({"x": PROBE})
+        want["checkpoint_%d" % s] = np.asarray(out)
+    # successive exports really are different models
+    vs = list(want.values())
+    assert not np.allclose(vs[0], vs[-1])
+    return root, serials[:3], want
+
+
+def _dir(root, serial):
+    return layout.serial_dir(root, serial)
+
+
+# -- the ROADMAP-6 acceptance test ----------------------------------------
+
+def test_hot_swap_twice_under_load_every_row_verified(exports):
+    """Two hot swaps (controller, then the swap_ctl watcher) while
+    closed-loop clients hammer the fleet: zero dropped, zero
+    misversioned, zero failures, and every row equals the direct
+    predictor of the version that served it."""
+    root, serials, want = exports
+    s0, s1, s2 = serials
+    router = Router(_dir(root, s0), replicas=1, max_batch=4,
+                    jax_platform="cpu", start_timeout=300,
+                    version="checkpoint_%d" % s0)
+    router.start()
+    ctl = SwapController(router)
+    mis0 = obs.FLEET_MISVERSIONED.total()
+    fail0 = obs.PREDICT_FAILURES.value(path="router")
+    ok0 = obs.SWAP_TOTAL.value(result="ok")
+    stop = threading.Event()
+    errs, records = [], []
+    rec_lock = threading.Lock()
+
+    def client(cid):
+        try:
+            rs = np.random.RandomState(cid)
+            while not stop.is_set():
+                i = int(rs.randint(0, 5))
+                fut = router.submit((PROBE[i],))
+                row, = fut.result(timeout=120)
+                with rec_lock:
+                    records.append((i, np.asarray(row), fut._version))
+        except Exception as e:  # pragma: no cover - failure reporting
+            errs.append("client %d: %r" % (cid, e))
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(2)]
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(0.5)  # load + canary tap established
+        # swap 1: the controller, canary-gated on LIVE tapped requests
+        res1 = ctl.swap(_dir(root, s1), canary=2)
+        assert res1["version"] == "checkpoint_%d" % s1
+        assert res1["previous"] == "checkpoint_%d" % s0
+        assert res1["canaried"] >= 1
+        assert res1["retired"]  # the old replica drained + stopped
+        time.sleep(0.4)
+        # swap 2: the watcher (tools/swap_ctl.py) sees the newer export
+        import importlib.util as _ilu
+
+        spec = _ilu.spec_from_file_location(
+            "swap_ctl", os.path.join(os.path.dirname(__file__),
+                                     os.pardir, "tools", "swap_ctl.py"))
+        swap_ctl = _ilu.module_from_spec(spec)
+        spec.loader.exec_module(swap_ctl)
+        watcher = swap_ctl.SwapWatcher(router, root, start_serial=s1)
+        res2 = watcher.check_once()
+        assert res2 and res2.get("version") == "checkpoint_%d" % s2, res2
+        assert watcher.check_once() is None  # nothing newer
+        time.sleep(0.4)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=120)
+        router.stop()
+    assert not errs, errs[:5]
+    assert len(records) > 0
+    # every served row verifies against the direct predictor of the
+    # version that served it — THE acceptance criterion
+    seen_versions = set()
+    for i, row, version in records:
+        assert version in want, version
+        seen_versions.add(version)
+        np.testing.assert_allclose(row, want[version][i], rtol=1e-4,
+                                   atol=1e-5)
+    assert "checkpoint_%d" % s0 in seen_versions
+    assert "checkpoint_%d" % s2 in seen_versions
+    assert obs.FLEET_MISVERSIONED.total() - mis0 == 0
+    assert obs.PREDICT_FAILURES.value(path="router") - fail0 == 0
+    assert obs.SWAP_TOTAL.value(result="ok") - ok0 == 2
+
+
+# -- rollback chaos -------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def fleet0(exports):
+    """One replicas=1 fleet on version 0, shared by the rollback tests
+    (every rollback restores exactly this state)."""
+    root, serials, _want = exports
+    router = Router(_dir(root, serials[0]), replicas=1, max_batch=4,
+                    jax_platform="cpu", start_timeout=300,
+                    version="checkpoint_%d" % serials[0])
+    router.start()
+    yield router
+    router.stop()
+
+
+def _assert_v0_serving(router, exports_tuple):
+    root, serials, want = exports_tuple
+    v0 = "checkpoint_%d" % serials[0]
+    assert router.active_version == v0
+    assert [w["state"] for w in router.health()] == ["ready"]
+    fut = router.submit((PROBE[1],))
+    row, = fut.result(timeout=120)
+    np.testing.assert_allclose(row, want[v0][1], rtol=1e-4, atol=1e-5)
+    assert fut._version == v0
+
+
+def test_swap_rollback_on_failed_canary(fleet0, exports):
+    """The pinned rollback variant: versions genuinely differ, so a
+    tight canary tolerance must refuse the swap — old version keeps
+    serving, surge replicas destroyed, fleet exactly as before."""
+    root, serials, _want = exports
+    rb0 = obs.SWAP_TOTAL.value(result="rollback")
+    ctl = SwapController(fleet0)  # arms the live-request tap
+    # a canary with NOTHING tapped refuses the swap outright (a
+    # requested gate must never silently validate nothing)
+    if not fleet0._tap:
+        with pytest.raises(SwapError, match="nothing to probe"):
+            ctl.swap(_dir(root, serials[1]), canary=3,
+                     canary_tol=1e-12)
+    for i in range(4):  # now fill the tap with live traffic
+        fleet0.submit((PROBE[i],)).result(timeout=120)
+    with pytest.raises(SwapError, match="drifted"):
+        ctl.swap(_dir(root, serials[1]), canary=3, canary_tol=1e-12)
+    assert obs.SWAP_TOTAL.value(result="rollback") - rb0 >= 1
+    _assert_v0_serving(fleet0, exports)
+
+
+def test_swap_rollback_when_incoming_replica_sigkilled(fleet0, exports):
+    """Chaos pin: SIGKILL at the ``swap.worker_boot`` barrier (the
+    incoming new-version replica, mid-swap). The spawn fails, the swap
+    rolls back, and the old version never stops serving."""
+    root, serials, _want = exports
+    rb0 = obs.SWAP_TOTAL.value(result="rollback")
+    fleet0._opts["env"]["PADDLE_TPU_FAULT_KILL"] = "swap.worker_boot"
+    try:
+        with pytest.raises(SwapError):
+            SwapController(fleet0).swap(_dir(root, serials[1]))
+    finally:
+        fleet0._opts["env"].pop("PADDLE_TPU_FAULT_KILL", None)
+    assert obs.SWAP_TOTAL.value(result="rollback") - rb0 == 1
+    assert not fleet0._opts["swap_boot"]  # regular spawns unaffected
+    _assert_v0_serving(fleet0, exports)
+
+
+def test_swap_rollback_on_io_fault_before_flip(fleet0, exports,
+                                               monkeypatch):
+    """Chaos pin: an injected IO fault at the ``swap.before_flip``
+    barrier (controller side, surge already up) — rollback destroys the
+    surge replicas and restores the spawn options."""
+    root, serials, _want = exports
+    rb0 = obs.SWAP_TOTAL.value(result="rollback")
+    old_dir = fleet0.model_dir
+    monkeypatch.setenv("PADDLE_TPU_FAULT_IO", "swap.before_flip")
+    faults.reset()
+    try:
+        with pytest.raises(SwapError, match="rolled back"):
+            SwapController(fleet0).swap(_dir(root, serials[1]))
+    finally:
+        faults.reset()
+    assert obs.SWAP_TOTAL.value(result="rollback") - rb0 == 1
+    assert fleet0.model_dir == old_dir
+    assert fleet0._opts["version"] == "checkpoint_%d" % serials[0]
+    _assert_v0_serving(fleet0, exports)
+
+
+def test_swap_validation_rejects_non_export(fleet0, exports):
+    rb0 = obs.SWAP_TOTAL.value(result="rollback")
+    with pytest.raises(SwapError, match="__model__"):
+        SwapController(fleet0).swap("/definitely/not/a/model")
+    with pytest.raises(SwapError, match="already serving"):
+        SwapController(fleet0).swap(
+            fleet0.model_dir, version=fleet0.active_version)
+    assert obs.SWAP_TOTAL.value(result="rollback") - rb0 == 2
+    _assert_v0_serving(fleet0, exports)
+
+
+def test_worker_survives_malformed_pipe_frames(fleet0, exports):
+    """Wire-fuzz satellite, subprocess edition: garbage injected
+    straight onto a worker's pipe (bad kind byte, truncated multi-
+    message, torn SLO header, bogus request frame) must not kill the
+    replica — the next real request still serves."""
+    w = fleet0._workers[0]
+    for junk in (b"\x01garbage", b"M" + b"\x02",
+                 b"Q" + b"\x05", b"Z\xff\xff"):
+        with w.send_lock:
+            w.conn.send_bytes(junk)
+    _assert_v0_serving(fleet0, exports)
+
+
+# -- wedged-worker watchdog -----------------------------------------------
+
+def test_wedged_worker_reaped_via_watchdog_and_requeued(exports):
+    """Chaos pin: a fault-DELAY wedged worker (alive PID, heartbeats
+    flowing, zero progress) is reaped by the watchdog and its in-flight
+    frames complete on the survivor."""
+    root, serials, want = exports
+    v0 = "checkpoint_%d" % serials[0]
+    router = Router(_dir(root, serials[0]), replicas=1, max_batch=4,
+                    jax_platform="cpu", start_timeout=300,
+                    version=v0, wedge_timeout_s=2.0, heartbeat_s=0.2)
+    router.start()
+    wedged0 = obs.FLEET_WEDGED.total()
+    req0 = obs.FLEET_REQUEUED.total()
+    try:
+        router.submit((PROBE[0],)).result(timeout=120)  # warm
+        # second replica boots with the serving.request DELAY armed: it
+        # will hang 60s on its first frame — live PID, no progress
+        router._opts["env"]["PADDLE_TPU_FAULT_DELAY"] = \
+            "serving.request:60"
+        router.add_replica(timeout=300)
+        router._opts["env"].pop("PADDLE_TPU_FAULT_DELAY", None)
+        assert len(router.health()) == 2
+        futs = [router.submit((PROBE[i % 5],)) for i in range(10)]
+        for i, fut in enumerate(futs):
+            row, = fut.result(timeout=120)
+            np.testing.assert_allclose(row, want[v0][i % 5], rtol=1e-4,
+                                       atol=1e-5)
+            assert fut._version == v0
+        assert obs.FLEET_WEDGED.total() - wedged0 >= 1
+        assert obs.FLEET_REQUEUED.total() - req0 >= 1
+        # the wedged replica is dead (SIGKILLed) and reapable; the
+        # survivor still heartbeats
+        deadline = time.monotonic() + 30
+        while (time.monotonic() < deadline
+               and not any(h["state"] == "dead"
+                           for h in router.health())):
+            time.sleep(0.05)
+        states = sorted(h["state"] for h in router.health())
+        assert states == ["dead", "ready"], states
+        reaped = router.reap_dead()
+        assert reaped == ["replica1"], reaped
+        hb = [h["heartbeat_age_s"] for h in router.health()]
+        assert len(hb) == 1 and hb[0] is not None and hb[0] < 10
+        # fleet keeps serving after the reap
+        row, = router.submit((PROBE[2],)).result(timeout=120)
+        np.testing.assert_allclose(row, want[v0][2], rtol=1e-4,
+                                   atol=1e-5)
+    finally:
+        router._opts["env"].pop("PADDLE_TPU_FAULT_DELAY", None)
+        router.stop()

@@ -1,0 +1,221 @@
+"""Wire-frame fuzzing (ISSUE 15 satellite): malformed / truncated
+``b"Q"`` (SLO), ``b"M"`` (multi-message), and request frames must get a
+STRUCTURED reject — ``wire.WireError`` / ``ValueError`` from the parse
+layer, a failed future or a counted drop from the serving loops — and
+the process serving them must SURVIVE. Deterministic fuzz (seeded
+truncations + byte flips) over the parsers, then survival tests on a
+live in-process PredictorServer. (The subprocess-worker survival
+variant lives in test_swap.py, which already pays for a fleet.)"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu import observability as obs
+from paddle_tpu.inference import Predictor, PredictorServer, _encode_sample
+from paddle_tpu.runtime import recordio as _rio
+from paddle_tpu.serving import wire
+
+
+def _valid_frame(tag=7):
+    return _encode_sample(tag, (np.arange(4, dtype=np.float32),
+                                np.ones((2, 3), np.int64)))
+
+
+def _valid_slo_frame(tag=9):
+    return wire.pack_slo(_valid_frame(tag), 3, 1234.5, "interactive")
+
+
+# -- parser fuzz ----------------------------------------------------------
+
+def test_frame_roundtrip_still_works():
+    f = _valid_frame(42)
+    assert _rio.frame_tag(f) == 42
+    tag, rows = _rio.decode_frame(f)
+    assert tag == 42 and len(rows) == 2
+    np.testing.assert_array_equal(rows[0],
+                                  np.arange(4, dtype=np.float32))
+    prio, deadline, klass, inner = wire.read_slo(_valid_slo_frame(9))
+    assert (prio, klass) == (3, "interactive")
+    assert deadline == 1234.5
+    assert _rio.frame_tag(inner) == 9
+
+
+def test_frame_tag_and_decode_reject_garbage():
+    # wrong magic: a clear, typed rejection — not a garbage tag
+    junk = b"\x00" + _valid_frame()[1:]
+    with pytest.raises(ValueError, match="magic"):
+        _rio.frame_tag(junk)
+    with pytest.raises(ValueError, match="magic"):
+        _rio.decode_frame(junk)
+    # empty / sub-header frames
+    for n in range(_rio._FRAME_HDR.size):
+        with pytest.raises(ValueError):
+            _rio.frame_tag(_valid_frame()[:n] if n else b"")
+
+
+def test_truncated_frames_raise_not_hang(rng):
+    f = _valid_frame()
+    for cut in sorted(rng.choice(len(f) - 1, size=24, replace=False)):
+        cut = int(cut)
+        if cut >= _rio._FRAME_HDR.size:
+            # header intact: the tag peek still works…
+            assert _rio.frame_tag(f[:cut]) == 7
+        # …but a full decode of a truncated body must raise, never
+        # return silently wrong rows (numpy's frombuffer raises on
+        # short buffers; our own checks cover the header)
+        if cut < len(f):
+            with pytest.raises(Exception):
+                _rio.decode_frame(f[:cut])
+
+
+def test_truncated_slo_header_is_wire_error():
+    q = _valid_slo_frame()
+    hdr_end = 1 + 2 + len("interactive") + 8
+    for cut in range(1, hdr_end):
+        with pytest.raises(wire.WireError):
+            wire.read_slo(q[:cut])
+    # a bare (non-Q) frame is NOT an error: defaults apply
+    prio, deadline, klass, inner = wire.read_slo(_valid_frame())
+    assert prio is None and deadline is None and klass is None
+
+
+def test_mutated_slo_header_never_crashes(rng):
+    q = bytearray(_valid_slo_frame())
+    for _ in range(64):
+        buf = bytearray(q)
+        i = int(rng.randint(0, min(len(buf), 24)))
+        buf[i] = int(rng.randint(0, 256))
+        try:
+            prio, deadline, klass, inner = wire.read_slo(bytes(buf))
+        except (wire.WireError, ValueError):
+            continue  # structured reject
+        # parsed: fields must be sane types (never raw garbage objects)
+        assert prio is None or 0 <= prio <= 255
+        assert klass is None or isinstance(klass, str)
+
+
+def test_multi_message_truncations_are_wire_errors():
+    packed = wire.pack([_valid_frame(1), _valid_frame(2),
+                        _valid_frame(3)])
+    assert packed[:1] == b"M"
+    # cutting anywhere inside the framed region must either yield a
+    # strict prefix of the messages or raise WireError — never a
+    # half-message presented as whole
+    whole = [bytes(m) for m in wire.iter_messages(packed)]
+    assert len(whole) == 3
+    for cut in range(1, len(packed)):
+        try:
+            got = [bytes(m) for m in wire.iter_messages(packed[:cut])]
+        except wire.WireError:
+            continue
+        assert got == whole[:len(got)]
+    # an inflated inner length overruns: structured error
+    bad = bytearray(packed)
+    struct.pack_into("<I", bad, 1, 1 << 30)
+    with pytest.raises(wire.WireError):
+        list(wire.iter_messages(bytes(bad)))
+
+
+def test_pack_slo_roundtrip_fuzz(rng):
+    for _ in range(32):
+        prio = int(rng.randint(0, 256))
+        klass = "k%d" % rng.randint(0, 99)
+        deadline = float(rng.rand() * 1e6) + 1e-3
+        f = _valid_frame(int(rng.randint(0, 2 ** 31)))
+        p2, d2, k2, inner = wire.read_slo(
+            wire.pack_slo(f, prio, deadline, klass))
+        assert (p2, k2) == (prio, klass)
+        assert abs(d2 - deadline) < 1e-9
+        assert bytes(inner) == f
+
+
+# -- serving-loop survival ------------------------------------------------
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    model_dir = str(tmp_path_factory.mktemp("fuzz_model"))
+    mp, sp = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(mp, sp):
+        with fluid.unique_name.guard():
+            x = layers.data(name="x", shape=[4])
+            out = layers.fc(x, 3, act="softmax")
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(sp)
+        fluid.io.save_inference_model(model_dir, ["x"], [out], exe,
+                                      main_program=mp, scope=scope)
+    srv = PredictorServer(Predictor(model_dir, aot_cache=False),
+                          max_batch=4, prewarm=False)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def test_submit_frame_rejects_garbage_at_the_door(server):
+    with pytest.raises(ValueError):
+        server.submit_frame(b"\x13garbage-not-a-frame")
+
+
+def test_torn_body_with_intact_header_gets_structured_reject(server):
+    """A frame whose header (and so tag) survived but whose row payload
+    is torn registers a future at submit_frame — that future must get a
+    structured reject from the stacking stage, never hang to its
+    caller's timeout."""
+    torn = _valid_frame(991)[:_rio._FRAME_HDR.size + 5]
+    assert _rio.frame_tag(torn) == 991  # header intact, body gone
+    fut = server.submit_frame(torn)
+    with pytest.raises(ValueError, match="malformed request frame"):
+        fut.result(timeout=60)
+
+
+def test_mismatched_shape_request_fails_alone(server):
+    """A decodable request whose row shapes don't fit the model (or its
+    co-batched neighbours) fails with ITS OWN error while neighbours
+    keep serving — the per-request fallback path."""
+    x = np.linspace(0, 1, 4).astype(np.float32)
+    want, = server.predictor.run({"x": x[None]})
+    bad = server.submit((np.zeros(3, np.float32),))  # model wants 4
+    good = [server.submit((x,)) for _ in range(4)]
+    with pytest.raises(Exception):
+        bad.result(timeout=60)
+    for fut in good:
+        row, = fut.result(timeout=60)
+        np.testing.assert_allclose(row, want[0], rtol=1e-5, atol=1e-6)
+
+
+def test_server_survives_garbage_on_the_channel(server, rng):
+    """Fuzz frames injected straight into the serving channel (past
+    submit's encoding): the stacking stage must absorb them and keep
+    serving real traffic."""
+    fail0 = obs.PREDICT_FAILURES.value(path="server")
+    x = np.linspace(0, 1, 4).astype(np.float32)
+    want, = server.predictor.run({"x": x[None]})
+    garbage = [
+        b"",
+        b"\x00\x01\x02",
+        b"Z" + b"\xff" * 3,                      # torn header
+        _valid_frame()[: _rio._FRAME_HDR.size + 3],  # truncated body
+        b"P" + b"not-a-pickle",
+    ]
+    for g in garbage:
+        if g:
+            server._chan.send(g)
+        fut = server.submit((x,))
+        row, = fut.result(timeout=120)
+        np.testing.assert_allclose(row, want[0], rtol=1e-5, atol=1e-6)
+    for _ in range(32):  # seeded random mutations of a real frame
+        buf = bytearray(_valid_frame(int(rng.randint(1000, 2000))))
+        for _k in range(int(rng.randint(1, 4))):
+            buf[int(rng.randint(0, len(buf)))] = int(rng.randint(0, 256))
+        server._chan.send(bytes(buf))
+    fut = server.submit((x,))
+    row, = fut.result(timeout=120)
+    np.testing.assert_allclose(row, want[0], rtol=1e-5, atol=1e-6)
+    # failures were COUNTED (some mutations still decode fine, so only
+    # >= holds), and nothing above raised out of the serving threads
+    assert obs.PREDICT_FAILURES.value(path="server") >= fail0

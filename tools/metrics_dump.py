@@ -159,6 +159,83 @@ def decode_round(tmpdir: str):
     store.lookup(prompt)  # full hit
 
 
+def stream_round(tmpdir: str):
+    """Exercise the ISSUE-15 online-learning hardening so its series
+    ship through the pinned exposition: a real ``StreamingTrainer``
+    step skips ONE NaN-poisoned batch through the in-graph sentinel
+    (``paddle_tpu_train_skipped_batches_total{reason="nonfinite"}``,
+    quarantine included), and a tolerant recordio read skips ONE
+    corrupt chunk (``reason="corrupt_chunk"``)."""
+    import numpy as np
+
+    from paddle_tpu import layers, optimizer
+    from paddle_tpu.training import StreamingTrainer
+
+    def train_func():
+        x = layers.data(name="x", shape=[4])
+        y = layers.data(name="y", shape=[1])
+        pred = layers.fc(x, 1)
+        loss = layers.mean(layers.square(pred - y))
+        return [loss, pred]
+
+    st = StreamingTrainer(train_func,
+                          lambda: optimizer.SGD(learning_rate=0.01))
+    good = {"x": np.ones((2, 4), np.float32),
+            "y": np.ones((2, 1), np.float32)}
+    bad = {"x": np.full((2, 4), np.nan, np.float32),
+           "y": np.ones((2, 1), np.float32)}
+    st.run(lambda: iter([good, bad, good]), restart_source=False,
+           quarantine_dir=os.path.join(tmpdir, "quarantine"))
+
+    # corrupt-chunk skip through the tolerant recordio reader
+    from paddle_tpu.runtime.recordio import (RecordIOReader,
+                                             RecordIOWriter)
+
+    path = os.path.join(tmpdir, "stream.rio")
+    with RecordIOWriter(path, compressor=0, max_chunk_records=1) as w:
+        for i in range(3):
+            w.write(b"rec%d" % i)
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF  # flip one payload byte mid-file
+    open(path, "wb").write(bytes(blob))
+    list(RecordIOReader(path, tolerant=True))
+
+
+def swap_round():
+    """One REJECTED hot swap through the real controller admission
+    path (a nonexistent export dir fails validation before any worker
+    spawns — same no-process trick as shed_round), so
+    ``paddle_tpu_swap_total{result="rollback"}`` and the
+    ``paddle_tpu_swap_ms`` histogram ride the pinned exposition. Plus
+    one wedge sweep over a fabricated stuck replica handle — the REAL
+    ``Router._wedge_sweep`` code, no processes — for
+    ``paddle_tpu_fleet_wedged_total``."""
+    import numpy as np
+
+    from paddle_tpu.inference import _encode_sample
+    from paddle_tpu.serving import Router, SwapController, SwapError
+
+    router = Router("/nonexistent-model-dir", replicas=1,
+                    wedge_timeout_s=0.01)
+    try:
+        SwapController(router).swap("/nonexistent-new-version")
+    except SwapError:
+        pass
+
+    import time as _time
+
+    from paddle_tpu.serving.router import _Worker
+
+    w = _Worker(0, "replica-wedged")
+    w.state = "ready"
+    req = router._parse_request(
+        _encode_sample(7, (np.zeros(2, np.float32),)))
+    w.outstanding[7] = (req, None, _time.perf_counter() - 10.0)
+    w.last_progress = _time.monotonic() - 10.0
+    router._workers.append(w)
+    assert router._wedge_sweep() == ["replica-wedged"]
+
+
 def shed_round():
     """One load-shed through the REAL admission path (Router.submit with
     an already-expired deadline needs no worker processes), so the
@@ -236,6 +313,7 @@ def main():
         obs.set_replica(args.replica)
     tiny_train_loop(args.steps)
     shed_round()
+    swap_round()
     if not args.no_predict:
         import tempfile
 
@@ -243,6 +321,8 @@ def main():
             predict_roundtrip(td)
         with tempfile.TemporaryDirectory() as td:
             decode_round(td)
+        with tempfile.TemporaryDirectory() as td:
+            stream_round(td)
 
     from paddle_tpu.observability import export
 

@@ -1,0 +1,177 @@
+"""swap_ctl: watch a streaming trainer's export root, hot-swap the fleet.
+
+The control half of the online-learning loop (ROADMAP item 6): a
+``training.stream.StreamingTrainer`` publishes versioned inference
+exports into ``<export_root>/checkpoint_<N>/`` through the crash-safe
+checkpoint layout (tmp + fsync + ``_COMPLETE`` sentinel + atomic
+rename), and ``SwapWatcher`` polls for new COMPLETE serials and drives
+``serving.swap.SwapController`` for each one — the fleet follows the
+trainer with zero dropped and zero misversioned requests.
+
+Programmatic use (what the tests and serving jobs embed):
+
+    watcher = SwapWatcher(router, export_root, poll_s=2.0, canary=4)
+    watcher.start()          # swaps every new complete export in
+    ...
+    watcher.stop()
+
+CLI use (operator entry point — builds the fleet, serves the newest
+export, then follows the root):
+
+    python tools/swap_ctl.py --export-root /models/ctr --replicas 2 \
+        [--poll 2.0] [--canary 4] [--canary-tol 1e-3] [--http 8080] \
+        [--once]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class SwapWatcher:
+    """Poll ``export_root`` for new complete checkpoint serials and swap
+    each one into ``router``. A serial whose swap FAILS (rollback) is
+    remembered and skipped — the watcher moves on when a newer export
+    appears instead of rollback-looping on a bad one; ``history`` keeps
+    the outcome per serial."""
+
+    def __init__(self, router, export_root: str, poll_s: float = 2.0,
+                 canary: int = 0, canary_tol: Optional[float] = None,
+                 start_serial: Optional[int] = None,
+                 retire_timeout: float = 300.0):
+        from paddle_tpu.serving.swap import SwapController
+
+        self.router = router
+        self.export_root = str(export_root)
+        self.poll_s = float(poll_s)
+        self.canary = int(canary)
+        self.canary_tol = canary_tol
+        self.retire_timeout = float(retire_timeout)
+        # only canary-gated watchers arm the router's live-request tap
+        # (it costs a frame copy per dispatched request)
+        self._ctl = SwapController(
+            router, tap_frames=32 if self.canary else 0)
+        # serials <= this are considered already served (default: the
+        # newest complete export at construction — the one the caller
+        # presumably booted the fleet on)
+        if start_serial is None:
+            from paddle_tpu.checkpoint import layout
+
+            start_serial = layout.latest_serial(self.export_root)
+        self.last_serial = int(start_serial)
+        self._failed: set = set()
+        self.history: List[Dict] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def check_once(self) -> Optional[Dict]:
+        """One poll: swap the newest unserved complete serial, if any.
+        Returns the swap result dict, a {"serial", "error"} record on a
+        rolled-back swap, or None when there is nothing new."""
+        from paddle_tpu.checkpoint import layout
+        from paddle_tpu.serving.swap import SwapError
+
+        newest = layout.latest_serial(self.export_root)
+        if newest <= self.last_serial or newest in self._failed:
+            return None
+        model_dir = layout.serial_dir(self.export_root, newest)
+        version = os.path.basename(model_dir)
+        try:
+            result = self._ctl.swap(
+                model_dir, version=version, canary=self.canary,
+                canary_tol=self.canary_tol,
+                retire_timeout=self.retire_timeout)
+        except SwapError as e:
+            record = {"serial": newest, "version": version,
+                      "error": str(e), "rolled_back": e.rolled_back}
+            if e.rolled_back:
+                self._failed.add(newest)
+            else:
+                self.last_serial = newest  # committed despite retire woes
+            self.history.append(record)
+            return record
+        self.last_serial = newest
+        record = dict(result, serial=newest)
+        self.history.append(record)
+        return record
+
+    def _loop(self):
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.check_once()
+            except Exception:  # noqa: BLE001 — the watcher must survive
+                pass
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="ptpu-swap-watcher")
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--export-root", required=True,
+                    help="directory the streaming trainer exports into")
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--poll", type=float, default=2.0)
+    ap.add_argument("--canary", type=int, default=0,
+                    help="probe this many recent live requests through "
+                         "both versions before each flip")
+    ap.add_argument("--canary-tol", type=float, default=None,
+                    help="max abs logits drift the canary tolerates "
+                         "(default: finite/shape gate only)")
+    ap.add_argument("--http", type=int, default=0,
+                    help="serve fleet /metrics + /health.json here")
+    ap.add_argument("--once", action="store_true",
+                    help="check for one new export, swap it, exit")
+    args = ap.parse_args()
+
+    from paddle_tpu.checkpoint import layout
+    from paddle_tpu.serving import Router
+
+    serial = layout.latest_serial(args.export_root)
+    if serial < 0:
+        raise SystemExit("no complete export under %s" % args.export_root)
+    model_dir = layout.serial_dir(args.export_root, serial)
+    router = Router(model_dir, replicas=args.replicas,
+                    max_batch=args.max_batch,
+                    version=os.path.basename(model_dir))
+    router.start()
+    if args.http:
+        port = router.start_http(args.http)
+        print("fleet http on :%d" % port, file=sys.stderr)
+    watcher = SwapWatcher(router, args.export_root, poll_s=args.poll,
+                          canary=args.canary, canary_tol=args.canary_tol,
+                          start_serial=serial)
+    try:
+        if args.once:
+            print(watcher.check_once(), file=sys.stderr)
+            return
+        watcher.start()
+        while True:
+            time.sleep(60)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        watcher.stop()
+        router.stop()
+
+
+if __name__ == "__main__":
+    main()
