@@ -36,6 +36,7 @@ import numpy as np
 import jax
 
 from . import observability as obs
+from .observability import tracing as _tracing
 from .framework.core import Program
 from .framework.scope import Scope
 from .framework.trace import RngStream, trace_block
@@ -497,6 +498,12 @@ class PredictorServer:
             self._next_id += 1
             self._results[rid] = fut
         fut._bind(self, rid)
+        tid = _tracing.maybe_start()
+        if tid is not None:
+            # standalone-server client edge: no wire hop, so the id
+            # binds straight into the stage-correlation table
+            _tracing.bind_rid(rid, tid)
+            _tracing.record_span(tid, "client.submit", rid=rid)
         try:
             sent = self._chan.send(_encode_sample(rid, sample))
         except BaseException:
@@ -504,10 +511,12 @@ class PredictorServer:
             # entry registered above
             with self._lock:
                 self._results.pop(rid, None)
+            _tracing.pop_rid(rid)
             raise
         if not sent:
             with self._lock:
                 self._results.pop(rid, None)
+            _tracing.pop_rid(rid)
             raise RuntimeError("predictor server is stopped")
         return fut
 
@@ -615,8 +624,17 @@ class PredictorServer:
                 obs.SERVER_ROWS.inc(nreal, kind="real")
                 if bucket > nreal:
                     obs.SERVER_ROWS.inc(bucket - nreal, kind="pad")
-                obs.SERVER_STAGE_MS.observe(
-                    (time.perf_counter() - t0) * 1e3, stage="stack")
+                stack_ms = (time.perf_counter() - t0) * 1e3
+                obs.SERVER_STAGE_MS.observe(stack_ms, stage="stack")
+                if _tracing.bound():
+                    for rid, _ in reqs:
+                        t_id = _tracing.rid_trace(rid)
+                        if t_id is not None:
+                            _tracing.record_span(
+                                t_id, "server.stack", dur_ms=stack_ms,
+                                rid=rid, rows=nreal, bucket=bucket)
+                            obs.REQUEST_PHASE_MS.observe(stack_ms,
+                                                         phase="stack")
             except Exception:
                 # mixed slot counts / row shapes inside ONE drain batch
                 # (a mangled-but-decodable frame riding with healthy
@@ -682,12 +700,20 @@ class PredictorServer:
         except Exception as e:  # fan the error out; keep serving
             self._fail(reqs, e)
             return
-        obs.SERVER_STAGE_MS.observe(
-            (time.perf_counter() - t0) * 1e3, stage="device")
+        dev_ms = (time.perf_counter() - t0) * 1e3
+        obs.SERVER_STAGE_MS.observe(dev_ms, stage="device")
         n = len(reqs)
         self.batch_size_counts[n] = self.batch_size_counts.get(n, 0) + 1
         now = time.perf_counter()
+        traced = _tracing.bound()
         for i, (rid, _) in enumerate(reqs):
+            if traced:
+                # span + phase BEFORE _pop — _pop drops the binding
+                t_id = _tracing.rid_trace(rid)
+                if t_id is not None:
+                    _tracing.record_span(t_id, "server.device",
+                                         dur_ms=dev_ms, rid=rid, rows=n)
+                    obs.REQUEST_PHASE_MS.observe(dev_ms, phase="device")
             fut = self._pop(rid)
             if fut is not None:  # None: abandoned via cancel/timeout
                 fut.set_result([o[i] for o in outs])
@@ -709,6 +735,9 @@ class PredictorServer:
                     (now - fut._t0) * 1e3, path="server")
 
     def _pop(self, rid):
+        # every future exit path (fan-out, failure, cancel, malformed-
+        # frame reject) funnels here: the trace binding can never leak
+        _tracing.pop_rid(rid)
         with self._lock:
             return self._results.pop(rid, None)
 

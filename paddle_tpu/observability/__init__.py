@@ -19,7 +19,7 @@ from __future__ import annotations
 import weakref
 from typing import Optional
 
-from . import export, metrics, timeline  # noqa: F401
+from . import export, metrics, timeline, tracing  # noqa: F401
 from .metrics import (  # noqa: F401
     DEFAULT_SIZE_BUCKETS, MetricRegistry, REGISTRY, get_registry,
     process_labels, set_replica,
@@ -52,6 +52,7 @@ __all__ = [
     "CKPT_SAVES", "CKPT_BYTES", "CKPT_PENDING", "CKPT_SAVE_MS",
     "CKPT_RESTORE_MS", "CKPT_RETRIES", "CKPT_FAILURES",
     "SWAP_TOTAL", "SWAP_MS", "TRAIN_SKIPPED_BATCHES", "FLEET_WEDGED",
+    "REQUEST_PHASE_MS", "TRACE_SPANS", "tracing",
     "TRANSPILE_OPS_REMOVED", "TRANSPILE_OPS_FUSED", "TRANSPILE_PASS_MS",
     "QUANT_CALIB_BATCHES", "QUANT_OPS", "QUANT_PARITY",
 ]
@@ -341,6 +342,22 @@ FLEET_WEDGED = REGISTRY.counter(
     "SIGKILLed and its in-flight frames requeue exactly like a crash "
     "(nonzero = raise wedge_timeout_s or investigate stuck device "
     "dispatches)")
+REQUEST_PHASE_MS = REGISTRY.histogram(
+    "paddle_tpu_request_phase_ms",
+    "Per-phase latency attribution of TRACED serving requests, by "
+    "phase=queue (router admission -> dispatch) | service (dispatch -> "
+    "reply, the whole worker round trip) | stack | device (the worker-"
+    "side stages) | total (submit -> reply). Folded from trace spans as "
+    "requests complete, so mass appears only while "
+    "PADDLE_TPU_TRACE_SAMPLE > 0 — the attributed view of "
+    "paddle_tpu_predict_latency_ms")
+TRACE_SPANS = REGISTRY.counter(
+    "paddle_tpu_trace_spans_total",
+    "Trace spans recorded by this process's flight recorder, by "
+    "phase=span name (client.submit, router.dispatch, worker.recv, "
+    "server.device, decode.retire, ...) — nonzero means sampling is "
+    "live; compare against the recorder's dropped count in /trace.json")
+tracing._SPANS_TOTAL = TRACE_SPANS
 PROFILER_EVENT_MS = REGISTRY.summary(
     "paddle_tpu_profiler_event_ms",
     "Legacy profiler event table (exact count/sum/min/max per event)")
@@ -413,7 +430,9 @@ def nbytes_of(values) -> int:
 
 
 def reset_all():
-    """Zero the registry and clear the timeline (the registry-wide reset
-    the legacy ``profiler.reset_profiler`` delegates to)."""
+    """Zero the registry and clear the timeline + trace recorder (the
+    registry-wide reset the legacy ``profiler.reset_profiler``
+    delegates to)."""
     REGISTRY.reset()
     TIMELINE.reset()
+    tracing.reset()

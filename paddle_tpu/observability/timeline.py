@@ -27,6 +27,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from . import tracing
+
 __all__ = ["StepTimeline", "TIMELINE", "get_timeline", "hlo_cost_stats"]
 
 _DEFAULT_CAP = 1024
@@ -104,6 +106,13 @@ class StepTimeline:
         if device_ms is not None:
             ev["device_ms"] = round(device_ms, 4)
         self._append(ev)
+        # mirror into the distributed-tracing flight recorder (rate-
+        # sampled like request traces, under the process-scoped id) so a
+        # trainer's steps land on the same trace_dump waterfall/clock as
+        # the serving spans; free when PADDLE_TPU_TRACE_SAMPLE is 0
+        if tracing.sampled():
+            tracing.record_span(tracing.process_trace_id(), "train.step",
+                                dur_ms=wall_ms, kind=kind, steps=steps)
 
     def record_compile(self, kind: str, program: Optional[str] = None, *,
                        wall_ms: Optional[float] = None,

@@ -14,6 +14,13 @@ count/sum/min/max per event — the reference report's columns), and
 ``reset_profiler`` performs the registry-wide reset. The always-on metrics
 (compile cache, step latency, serving) record regardless of the
 start/stop window; this window only gates the legacy event table.
+
+Each event also lands as a span in the distributed-tracing flight
+recorder (``observability.tracing``), under the process-scoped trace id
+— so a legacy ``with profiler.profiler():`` window gets a timeline in
+``tools/trace_dump.py`` (text waterfall / Chrome trace JSON) for free,
+on the same clock as the serving spans. The start/stop window IS the
+opt-in; the spans cost nothing while profiling is off.
 """
 from __future__ import annotations
 
@@ -23,6 +30,7 @@ import warnings
 from typing import Optional
 
 from . import observability as _obs
+from .observability import tracing as _tracing
 
 __all__ = [
     "cuda_profiler", "reset_profiler", "start_profiler", "stop_profiler",
@@ -43,6 +51,8 @@ def is_profiling() -> bool:
 def record_event(name: str, seconds: float):
     if _enabled:
         _obs.PROFILER_EVENT_MS.observe(seconds * 1e3, event=name)
+        _tracing.record_span(_tracing.process_trace_id(),
+                             "profiler." + name, dur_ms=seconds * 1e3)
 
 
 def record_cache(hit: bool):

@@ -52,6 +52,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import observability as obs
+from ..observability import tracing as _tracing
 from ..runtime import aot_cache as _aot
 from ..runtime import recordio as _rio
 
@@ -1014,15 +1015,23 @@ class DecodeServer:
             self._next_id += 1
             self._results[rid] = fut
         fut._bind(self, rid)
+        tid = _tracing.maybe_start()
+        if tid is not None:
+            # standalone-server client edge (the PredictorServer.submit
+            # pattern): no wire hop, bind straight into the stage table
+            _tracing.bind_rid(rid, tid)
+            _tracing.record_span(tid, "client.submit", rid=rid)
         try:
             sent = self._chan.send(_encode_sample(rid, sample))
         except BaseException:
             with self._lock:
                 self._results.pop(rid, None)
+            _tracing.pop_rid(rid)
             raise
         if not sent:
             with self._lock:
                 self._results.pop(rid, None)
+            _tracing.pop_rid(rid)
             raise RuntimeError("decode server is stopped")
         return fut
 
@@ -1046,6 +1055,9 @@ class DecodeServer:
         return fut
 
     def _pop(self, rid):
+        # every future exit path funnels here: the trace binding a
+        # traced request carried can never leak
+        _tracing.pop_rid(rid)
         with self._lock:
             return self._results.pop(rid, None)
 
@@ -1130,6 +1142,9 @@ class DecodeServer:
 
     def _retire(self, slot_state):
         rid = slot_state["rid"]
+        # span BEFORE _pop — _pop drops the trace binding
+        _tracing.rid_span(rid, "decode.retire",
+                          tokens=int(slot_state["count"]))
         fut = self._pop(rid)
         obs.DECODE_REQUESTS.inc(kind="retired")
         if self._prefix is not None:
@@ -1189,7 +1204,9 @@ class DecodeServer:
             return self._admit_prefix(batch, free, caches, lens, active)
         n = len(batch)
         try:
+            t_pf = time.perf_counter()
             outs, sp = self._prefill_prompts([b[1] for b in batch])
+            pf_ms = (time.perf_counter() - t_pf) * 1e3
         except Exception as e:
             # an admission that cannot prefill (compile error, device
             # OOM) fails ITS requests and leaves the server serving —
@@ -1240,6 +1257,9 @@ class DecodeServer:
                   "cur": tok, "count": 1}
             lens[slot] = len(prompt)
             active[slot] = st
+            _tracing.rid_span(rid, "decode.admit", kind="fresh",
+                              prompt_len=len(prompt),
+                              prefill_ms=round(pf_ms, 3))
             obs.DECODE_REQUESTS.inc(kind="admitted")
             obs.DECODE_TOKENS.inc(kind="decode")
             if (self.eos_id is not None and tok == self.eos_id) \
@@ -1315,8 +1335,11 @@ class DecodeServer:
             uniq_rows: List[List[np.ndarray]] = []
             uniq_logits: List[np.ndarray] = []
             uniq_eids: List[Optional[int]] = []
+            pf_ms = 0.0
             if uniq_prompts:
+                t_pf = time.perf_counter()
                 outs, _sp = self._prefill_prompts(uniq_prompts)
+                pf_ms = (time.perf_counter() - t_pf) * 1e3
                 sub = [np.asarray(c) for c in outs[1:]]
                 logits_all = np.asarray(outs[0])
                 for i, p in enumerate(uniq_prompts):
@@ -1337,6 +1360,14 @@ class DecodeServer:
             for i, ((rid, prompt, max_new, seed), p) in enumerate(
                     zip(batch, plan)):
                 slot = free[i]
+                # prefix-aware admission span: the kind says whether
+                # this sequence paid a prefill (miss/dup share the
+                # deduped one) or rode cached rows (full/partial)
+                _tracing.rid_span(
+                    rid, "decode.admit", kind="prefix_" + p["kind"],
+                    prompt_len=len(prompt),
+                    prefill_ms=(round(pf_ms, 3)
+                                if p["kind"] in ("miss", "dup") else 0.0))
                 if p["kind"] in ("miss", "dup"):
                     rows = uniq_rows[p["uniq"]]
                     logits = uniq_logits[p["uniq"]]
@@ -1524,11 +1555,15 @@ class DecodeServer:
         caches = list(vouts[3:])
         obs.DECODE_SPEC_PROPOSED.inc(k * n_active)
         emitted = 0
+        traced = _tracing.bound()
         for i, st in enumerate(active):
             if st is None:
                 continue
             a = int(accept[i])
             obs.DECODE_SPEC_ACCEPTED.inc(a)
+            if traced:
+                _tracing.rid_span(st["rid"], "decode.spec_round",
+                                  accepted=a, proposed=k)
             # cap by budget and slab room: window position j needs rows
             # lens..lens+j resident, so at most seq - lens tokens
             take = min(a + 1, st["max_new"] - st["count"],

@@ -70,6 +70,7 @@ from typing import Dict, List, Optional
 
 from .. import observability as obs
 from ..inference import _Future, _encode_sample
+from ..observability import tracing as _tracing
 from ..runtime import recordio as _rio
 from . import slo as _slo
 
@@ -79,17 +80,23 @@ __all__ = ["Router"]
 class _Req:
     """One drained request in the dispatch loop: the raw (possibly
     SLO-prefixed) bytes for crash-requeue, the inner frame the worker
-    receives, and the resolved SLO fields."""
+    receives (still trace-prefixed for a sampled request — the id must
+    cross the process boundary), and the resolved SLO/trace fields.
+    ``t0`` is the wall-clock parse time the queue span measures from."""
 
-    __slots__ = ("rid", "raw", "inner", "klass", "priority", "deadline")
+    __slots__ = ("rid", "raw", "inner", "klass", "priority", "deadline",
+                 "trace_id", "t0")
 
-    def __init__(self, rid, raw, inner, klass, priority, deadline):
+    def __init__(self, rid, raw, inner, klass, priority, deadline,
+                 trace_id=None, t0=0.0):
         self.rid = rid
         self.raw = raw
         self.inner = inner
         self.klass = klass
         self.priority = priority
         self.deadline = deadline
+        self.trace_id = trace_id
+        self.t0 = t0
 
 
 class _Worker:
@@ -446,9 +453,16 @@ class Router:
             return fut
         try:
             frame = _encode_sample(rid, sample)
-            if annotated:
+            tid = _tracing.maybe_start()
+            if tid is not None or annotated:
                 from . import wire
-
+            if tid is not None:
+                # the ONE sampling decision: from here the id rides the
+                # wire (and any crash-requeue) with the request
+                frame = wire.pack_trace(frame, tid)
+                _tracing.record_span(tid, "client.submit", rid=rid,
+                                     klass=klass.name)
+            if annotated:
                 deadline = (None if deadline_ms is None
                             else time.monotonic() + deadline_ms / 1e3)
                 frame = wire.pack_slo(frame, prio, deadline, klass.name)
@@ -475,12 +489,15 @@ class Router:
         if prio is None:  # bare pre-SLO frame: default class, no deadline
             klass = self.default_slo
             prio = self.slo_classes[klass].priority
-        req = _Req(_rio.frame_tag(inner), msg, inner, klass, prio,
-                   deadline)
+        # the trace header (if any) STAYS on `inner` — the worker needs
+        # the id; `bare` is only for the rid peek and the canary tap
+        tid, bare = wire.read_trace(inner)
+        req = _Req(_rio.frame_tag(bare), msg, inner, klass, prio,
+                   deadline, trace_id=tid, t0=time.time())
         # tap AFTER the frame validated (frame_tag raised otherwise): a
         # malformed frame must never poison the canary probe set
         if self._tap is not None:
-            self._tap.append(bytes(inner))
+            self._tap.append(bytes(bare))
         return req
 
     def _reject_malformed(self, msg, exc):
@@ -624,6 +641,20 @@ class Router:
         with self._lock:
             self._shed_count += 1
         obs.FLEET_SHED.inc(**{"class": req.klass})
+        if req.trace_id is not None:
+            # a shed request never dispatched: its whole life was the
+            # queue phase. The dominant phase of the DECISION differs —
+            # "hopeless" sheds fire because the service estimate eats
+            # the remaining budget, not because queueing already did.
+            queued_ms = max(0.0, (time.time() - req.t0) * 1e3)
+            est = self._svc_ewma_ms
+            dominant = ("service" if reason == "hopeless"
+                        and est is not None and est > queued_ms
+                        else "queue")
+            _tracing.record_span(req.trace_id, "router.shed", ts=req.t0,
+                                 dur_ms=queued_ms, rid=req.rid,
+                                 reason=reason, dominant_phase=dominant)
+            obs.REQUEST_PHASE_MS.observe(queued_ms, phase="queue")
         fut = self._pop(req.rid)
         if fut is None:
             return  # abandoned via cancel/timeout
@@ -707,6 +738,15 @@ class Router:
             obs.FLEET_BACKPRESSURE_MS.inc(
                 (time.perf_counter() - t0) * 1e3)
         obs.FLEET_DISPATCHES.inc(replica=w.name)
+        if req.trace_id is not None:
+            now = time.time()
+            queued_ms = max(0.0, (now - req.t0) * 1e3)
+            _tracing.record_span(req.trace_id, "router.queue",
+                                 ts=req.t0, dur_ms=queued_ms,
+                                 rid=req.rid, klass=req.klass)
+            _tracing.record_span(req.trace_id, "router.dispatch", ts=now,
+                                 rid=req.rid, replica=w.name)
+            obs.REQUEST_PHASE_MS.observe(queued_ms, phase="queue")
         return w
 
     # -- responses ---------------------------------------------------------
@@ -797,6 +837,12 @@ class Router:
             prev = self._svc_ewma_ms
             self._svc_ewma_ms = (svc_ms if prev is None
                                  else 0.8 * prev + 0.2 * svc_ms)
+        if entry is not None and entry[0].trace_id is not None:
+            svc_ms_t = (time.perf_counter() - entry[2]) * 1e3
+            _tracing.record_span(entry[0].trace_id, "router.reply",
+                                 dur_ms=svc_ms_t, rid=rid,
+                                 replica=w.name, error=exc is not None)
+            obs.REQUEST_PHASE_MS.observe(svc_ms_t, phase="service")
         fut = self._pop(rid)
         if fut is None:
             return  # abandoned via cancel/timeout
@@ -822,6 +868,9 @@ class Router:
         obs.PREDICT_LATENCY_MS.observe(
             (time.perf_counter() - fut._t0) * 1e3, path="router")
         obs.PREDICT_REQUESTS.inc(path="router")
+        if entry is not None and entry[0].trace_id is not None:
+            obs.REQUEST_PHASE_MS.observe(
+                (time.perf_counter() - fut._t0) * 1e3, phase="total")
 
     def _on_worker_exit(self, w: _Worker):
         """Reader saw EOF: graceful stop keeps state, a crash requeues
@@ -839,6 +888,12 @@ class Router:
     def _requeue_entries(self, w: _Worker, entries):
         for rid, (req, _ver, _t) in entries:
             obs.FLEET_REQUEUED.inc()
+            if req.trace_id is not None:
+                # req.raw still carries the trace header: the re-parsed
+                # request stays traced and the merged waterfall shows
+                # the crash as requeue -> second queue/dispatch pair
+                _tracing.record_span(req.trace_id, "router.requeue",
+                                     rid=rid, replica=w.name)
             # back through the front channel, SLO header and all: the
             # dispatch loop re-routes to a live replica (predict is
             # stateless — replay is safe) and a deadline that lapsed
@@ -1274,12 +1329,28 @@ class Router:
                 snaps.append(st["metrics"])
         return export.merge_json_snapshots(snaps)
 
+    def fleet_trace(self, timeout: float = 30.0) -> Dict:
+        """One merged span list across the fleet: every live worker's
+        flight-recorder snapshot (pulled over the control pipe, the
+        ``fleet_metrics`` pattern) plus the router's own, ts-sorted per
+        trace so a single request reads as a waterfall
+        (``tracing.merge_snapshots``). Served at ``GET /trace.json``."""
+        snaps = [_tracing.snapshot()]
+        with self._cond:
+            live = [w for w in self._workers if w.state == "ready"]
+        for w in live:
+            st = self._worker_call(w, "trace", timeout=timeout)
+            if st and "trace" in st:
+                snaps.append(st["trace"])
+        return _tracing.merge_snapshots(snaps)
+
     # -- HTTP --------------------------------------------------------------
     def start_http(self, port: int = 0, host: str = "127.0.0.1") -> int:
         """Fleet observability endpoint: ``GET /metrics`` (router
         process, Prometheus text), ``GET /health.json`` (per-replica
-        states), ``GET /fleet.json`` (health + merged fleet registry).
-        port=0 picks a free port; returns the bound port."""
+        states), ``GET /fleet.json`` (health + merged fleet registry),
+        ``GET /trace.json`` (merged flight-recorder spans). port=0
+        picks a free port; returns the bound port."""
         if self._http is not None:
             return self._http.server_address[1]
         import json as _json
@@ -1304,6 +1375,11 @@ class Router:
                         {"health": router.health(),
                          "metrics": router.fleet_metrics()},
                         indent=2, sort_keys=True).encode("utf-8")
+                    ctype = "application/json"
+                elif path == "/trace.json":
+                    body = _json.dumps(
+                        router.fleet_trace(), indent=2,
+                        sort_keys=True).encode("utf-8")
                     ctype = "application/json"
                 else:
                     h.send_response(404)

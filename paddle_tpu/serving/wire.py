@@ -26,13 +26,28 @@ deadline) — the header only ever travels within the router process
 process-local clock is the right one. A bare (un-prefixed) frame means
 default class / default priority / no deadline — the pre-SLO wire form
 is still valid, byte for byte.
+
+Trace header (``pack_trace`` / ``read_trace``): a request sampled for
+distributed tracing (observability/tracing.py) carries its trace_id on
+the wire the same way:
+
+    b"T" | u8 id_len | trace_id ascii | frame
+
+Canonical nesting when both headers ride one frame is Q(T(frame)) — the
+SLO header outermost, matching the parse order the router already uses
+(``read_slo`` first). Unlike the SLO header the trace header DOES cross
+the process boundary to workers (that is the point — the id correlates
+spans fleet-wide), and workers strip it defensively exactly like a
+stray ``b"Q"``. An un-sampled request never grows a header: the
+pre-trace wire form stays valid byte for byte.
 """
 from __future__ import annotations
 
 import struct
 from typing import Iterator, List, Optional, Sequence, Tuple
 
-__all__ = ["pack", "iter_messages", "pack_slo", "read_slo", "WireError"]
+__all__ = ["pack", "iter_messages", "pack_slo", "read_slo",
+           "pack_trace", "read_trace", "WireError"]
 
 
 class WireError(ValueError):
@@ -46,6 +61,8 @@ _LEN = struct.Struct("<I")
 _SLO = b"Q"
 _SLO_HDR = struct.Struct("<BB")  # priority, class name length
 _SLO_DL = struct.Struct("<d")    # absolute monotonic deadline (0 = none)
+_TRACE = b"T"
+_TRACE_HDR = struct.Struct("<B")  # trace_id length
 
 
 def pack(msgs: Sequence[bytes]) -> bytes:
@@ -128,3 +145,34 @@ def read_slo(msg) -> Tuple[Optional[int], Optional[float], Optional[str],
     (deadline,) = _SLO_DL.unpack_from(mv, off)
     off += _SLO_DL.size
     return prio, (deadline if deadline > 0.0 else None), klass, mv[off:]
+
+
+def pack_trace(frame: bytes, trace_id: str) -> bytes:
+    """Prefix a request frame with its trace_id (see module doc)."""
+    t = trace_id.encode("ascii")
+    if not t or len(t) > 255:
+        raise ValueError("trace id must be 1..255 ascii bytes, got %r"
+                         % (trace_id,))
+    return _TRACE + _TRACE_HDR.pack(len(t)) + t + frame
+
+
+def read_trace(msg) -> Tuple[Optional[str], object]:
+    """``(trace_id, inner_frame)`` from a request message. A bare frame
+    (no ``b"T"`` prefix) returns ``(None, msg)`` — the request is simply
+    not traced. The inner frame is a zero-copy memoryview slice."""
+    if bytes(msg[:1]) != _TRACE:
+        return None, msg
+    mv = memoryview(msg)
+    if len(mv) < 1 + _TRACE_HDR.size:
+        raise WireError("truncated trace header: no id length byte")
+    (tlen,) = _TRACE_HDR.unpack_from(mv, 1)
+    off = 1 + _TRACE_HDR.size
+    if tlen == 0 or len(mv) < off + tlen:
+        raise WireError(
+            "truncated trace header: id needs %d bytes, %d remain"
+            % (tlen, len(mv) - off))
+    try:
+        trace_id = bytes(mv[off:off + tlen]).decode("ascii")
+    except UnicodeDecodeError as e:
+        raise WireError("non-ascii trace id: %s" % e) from e
+    return trace_id, mv[off + tlen:]

@@ -14,9 +14,14 @@ Pipe wire protocol (each message one ``send_bytes`` payload):
 router -> worker
     ``b"Z..."`` / ``b"P..."``  request frame, forwarded VERBATIM from
                                the client (the embedded tag is the
-                               router-minted request id)
+                               router-minted request id); a traced
+                               request arrives ``b"T"``-prefixed
+                               (wire.pack_trace) — the worker strips
+                               the header, binds rid -> trace_id, and
+                               records recv/stack/device/reply spans
+                               into its flight recorder
     ``b"C" + pickle(dict)``    control: {"cmd": "stop" | "ping" |
-                               "metrics"}
+                               "metrics" | "trace" | "probe"}
 
 worker -> router
     ``b"S" + pickle(dict)``    status: ready/pong/metrics/stopped
@@ -44,6 +49,7 @@ import os
 import pickle
 import struct
 import threading
+import time
 import traceback
 
 __all__ = ["worker_main"]
@@ -98,6 +104,7 @@ def worker_main(conn, options):
 
     from .. import observability as obs
     from ..inference import Predictor, PredictorServer, _encode_sample
+    from ..observability import tracing as _tracing
 
     from . import wire
 
@@ -200,13 +207,17 @@ def worker_main(conn, options):
 
     served = [0]  # responses sent (rides each heartbeat)
 
-    def respond(rid, fut):
+    def respond(rid, fut, tid=None, t0=0.0):
         try:
             rows = fut.result(timeout=0)
             send(b"R" + struct.pack("<B", len(vtag)) + vtag
                  + _encode_sample(rid, rows))
         except Exception as e:
             send(b"E" + _pickle_error(rid, e))
+        if tid is not None:
+            # the whole worker residency, channel recv -> reply queued
+            _tracing.record_span(tid, "worker.reply", ts=t0,
+                                 dur_ms=(time.time() - t0) * 1e3, rid=rid)
         served[0] += 1
 
     # heartbeats through the control pipe: a dedicated thread, so a
@@ -311,6 +322,9 @@ def worker_main(conn, options):
                         send(b"S" + pickle.dumps(
                             {"metrics": export.to_json(
                                 include_timeline=False)}, protocol=4))
+                    elif op == "trace":
+                        send(b"S" + pickle.dumps(
+                            {"trace": _tracing.snapshot()}, protocol=4))
                     elif op == "probe":
                         _probe(cmd)
                     continue
@@ -321,6 +335,16 @@ def worker_main(conn, options):
                     # wedge the replica on an unknown prefix
                     try:
                         msg = wire.read_slo(msg)[3]
+                    except wire.WireError:
+                        obs.PREDICT_FAILURES.inc(path="wire")
+                        continue
+                tid = None
+                if bytes(msg[:1]) == b"T":
+                    # traced request: strip the header (defensively,
+                    # like b"Q") and remember the id — spans below and
+                    # in the server stages correlate through it
+                    try:
+                        tid, msg = wire.read_trace(msg)
                     except wire.WireError:
                         obs.PREDICT_FAILURES.inc(path="wire")
                         continue
@@ -341,13 +365,22 @@ def worker_main(conn, options):
                     # exists)
                     obs.PREDICT_FAILURES.inc(path="wire")
                     continue
+                t_recv = 0.0
+                if tid is not None:
+                    t_recv = time.time()
+                    _tracing.bind_rid(rid, tid)
+                    _tracing.record_span(tid, "worker.recv", ts=t_recv,
+                                         rid=rid)
                 try:
                     fut = server.submit_frame(msg)
                 except Exception as e:
+                    if tid is not None:
+                        _tracing.pop_rid(rid)
                     send(b"E" + _pickle_error(rid, e))
                     continue
                 fut.add_done_callback(
-                    lambda f, rid=rid: respond(rid, f))
+                    lambda f, rid=rid, tid=tid, t0=t_recv:
+                    respond(rid, f, tid, t0))
     finally:
         # stop() drains the stacking queue (never drops): every
         # outstanding future completes -> every response is queued

@@ -1,6 +1,6 @@
 """Tier-1 smoke for tools/loadgen.py: trace-builder units (pure python)
 plus ONE subprocess run driving a scripted 2-second trace through a
-1-replica fleet, pinning the ``loadgen/1`` verdict schema. The full
+1-replica fleet, pinning the ``loadgen/2`` verdict schema. The full
 burst/chaos/autoscale traces live in tests/test_traffic_fleet.py (the
 heavy variants marked ``slow``) — this file is the cheap in-window
 budget pin the ISSUE demands."""
@@ -107,13 +107,17 @@ def test_scripted_trace_verdict_schema(model_dir, tmp_path):
     line = [ln for ln in proc.stdout.splitlines()
             if ln.startswith("{")][-1]
     r = json.loads(line)
-    # -- the loadgen/1 schema pin -----------------------------------------
-    assert r["schema"] == "loadgen/1"
+    # -- the loadgen/2 schema pin -----------------------------------------
+    assert r["schema"] == "loadgen/2"
     assert r["trace"] == "smoke-2s"
     for key in ("duration_s", "offered", "completed", "rejected",
                 "errors", "dropped", "achieved_rps", "per_class",
-                "phases", "fleet", "ok", "sheds_all_rejected"):
+                "phases", "fleet", "ok", "sheds_all_rejected",
+                "trace_phases"):
         assert key in r, key
+    # tracing was not armed, so the attribution is present but empty
+    # (the loadgen/2 addition costs nothing unless --trace-sample is)
+    assert r["trace_phases"] == {}
     # every request answered: result or explicit reject, nothing hung
     assert r["offered"] > 0
     assert r["completed"] == r["offered"]

@@ -49,6 +49,11 @@ _REQUIRED_SERIES = (
     "paddle_tpu_swap_ms_bucket",
     "paddle_tpu_train_skipped_batches_total",
     "paddle_tpu_fleet_wedged_total",
+    # distributed request tracing (ISSUE 16): the trace_round's fully
+    # sampled shed leaves span counts and a per-phase latency sample
+    "paddle_tpu_trace_spans_total",
+    "paddle_tpu_request_phase_ms_bucket",
+    "paddle_tpu_request_phase_ms_count",
 )
 
 
@@ -88,6 +93,16 @@ def test_prometheus_exposition_contains_required_series(dump_output):
     assert ('paddle_tpu_train_skipped_batches_total'
             '{reason="corrupt_chunk"} 1') in text
     assert "paddle_tpu_fleet_wedged_total 1" in text
+    # ISSUE 16 exact lines: the trace_round's one sampled request
+    # records a client-submit span and a shed span, and the shed folds
+    # its whole (queued) life into the phase histogram — these are the
+    # lines a tracing dashboard keys on
+    assert 'paddle_tpu_trace_spans_total{phase="client.submit"} 1' in text
+    assert 'paddle_tpu_trace_spans_total{phase="router.shed"} 1' in text
+    assert 'paddle_tpu_request_phase_ms_count{phase="queue"} 1' in text
+    # the trace_round sheds under class="batch" (so the interactive pin
+    # above stays exact) — its own shed line rides the exposition too
+    assert 'paddle_tpu_fleet_shed_total{class="batch"} 1' in text
 
 
 def test_histogram_buckets_are_cumulative_and_consistent(dump_output):
@@ -153,12 +168,14 @@ def test_replica_label_and_merge(tmp_path):
         assert snap["replica"] == name
         steps = snap["metrics"]["paddle_tpu_steps_total"]["series"]
         assert all(s["labels"]["replica"] == name for s in steps)
-        # the shed series rides every worker dump too (ISSUE 13): one
-        # admission-path shed, labeled by class AND this replica
+        # the shed series rides every worker dump too (ISSUE 13 +
+        # the ISSUE-16 trace_round's batch-class shed): one
+        # admission-path shed per class, labeled by class AND replica
         shed = snap["metrics"]["paddle_tpu_fleet_shed_total"]["series"]
-        assert [s["labels"] for s in shed] == [
-            {"class": "interactive", "replica": name}]
-        assert [s["value"] for s in shed] == [1]
+        assert sorted(
+            (s["labels"]["class"], s["labels"]["replica"], s["value"])
+            for s in shed) == [("batch", name, 1),
+                               ("interactive", name, 1)]
         path = tmp_path / ("%s.json" % name)
         path.write_text(proc.stdout)
         dumps.append((str(path), snap))
@@ -179,12 +196,15 @@ def test_replica_label_and_merge(tmp_path):
     want = sum(total(s["metrics"]["paddle_tpu_steps_total"]["series"])
                for _p, s in dumps)
     assert total(series) == want
-    # fleet_shed_total merges collision-free too: per-replica series
-    # stay addressable, the fleet-wide shed count is their sum
+    # fleet_shed_total merges collision-free too: per-replica AND
+    # per-class series stay addressable, the fleet-wide shed count is
+    # their sum (interactive + batch, per worker)
     shed = merged["metrics"]["paddle_tpu_fleet_shed_total"]["series"]
-    assert sorted(s["labels"]["replica"] for s in shed) == ["w0", "w1"]
-    assert all(s["labels"]["class"] == "interactive" for s in shed)
-    assert total(shed) == 2
+    assert sorted((s["labels"]["class"], s["labels"]["replica"])
+                  for s in shed) == [
+        ("batch", "w0"), ("batch", "w1"),
+        ("interactive", "w0"), ("interactive", "w1")]
+    assert total(shed) == 4
 
 
 def test_unlabeled_export_format_unchanged():

@@ -1,0 +1,90 @@
+"""Tier-1 smoke for tools/trace_dump.py: the --demo fixture through all
+three output modes in subprocesses, pinning the ``trace_dump/1`` JSON
+schema (a rename breaks every consumer of the structured document) and
+the Chrome trace-event invariants Perfetto relies on. The demo path is
+jax-free and renders in milliseconds — cheap enough for the in-window
+suite."""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TOOL = os.path.join(_REPO, "tools", "trace_dump.py")
+
+
+def _run(*argv, stdin=None):
+    proc = subprocess.run(
+        [sys.executable, _TOOL] + list(argv), input=stdin,
+        capture_output=True, text=True, timeout=120, cwd=_REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return proc.stdout
+
+
+def test_demo_json_schema_pinned():
+    doc = json.loads(_run("--demo", "--json"))
+    # the trace_dump/1 surface: these keys are the contract
+    assert doc["schema"] == "trace_dump/1"
+    for key in ("replicas", "recorded", "dropped", "span_count",
+                "trace_count", "traces"):
+        assert key in doc, key
+    assert doc["trace_count"] == len(doc["traces"]) == 2
+    assert doc["span_count"] == sum(len(t["spans"]) for t in doc["traces"])
+    for tr in doc["traces"]:
+        for key in ("trace_id", "start_ts", "total_ms", "spans"):
+            assert key in tr, key
+        # spans are ts-sorted within a trace (the waterfall invariant)
+        ts = [s["ts"] for s in tr["spans"]]
+        assert ts == sorted(ts)
+        for s in tr["spans"]:
+            for key in ("trace_id", "name", "ts", "dur_ms", "replica"):
+                assert key in s, key
+            assert s["trace_id"] == tr["trace_id"]
+    # the demo's served request crosses both processes
+    served = max(doc["traces"], key=lambda t: len(t["spans"]))
+    replicas = {s["replica"] for s in served["spans"]}
+    assert replicas == {"router", "w0"}
+    names = {s["name"] for s in served["spans"]}
+    assert {"client.submit", "router.queue", "router.dispatch",
+            "worker.recv", "server.device", "router.reply"} <= names
+
+
+def test_demo_text_waterfall():
+    out = _run("--demo")
+    assert "trace " in out and "client.submit" in out
+    assert "router.shed" in out  # the shed request renders too
+    assert "#" in out            # proportional bars
+
+
+def test_demo_chrome_trace_events():
+    doc = json.loads(_run("--demo", "--chrome"))
+    events = doc["traceEvents"]
+    slices = [e for e in events if e["ph"] == "X"]
+    metas = [e for e in events if e["ph"] == "M"]
+    assert slices and metas
+    # every slice has the fields chrome://tracing requires, µs units
+    for e in slices:
+        for key in ("name", "pid", "tid", "ts", "dur"):
+            assert key in e, key
+    # replicas became named process rows
+    pnames = {e["args"]["name"] for e in metas
+              if e["name"] == "process_name"}
+    assert pnames == {"router", "w0"}
+
+
+def test_roundtrip_via_stdin():
+    # the --json doc's source (a merge_snapshots document) feeds back
+    # through stdin — the curl | trace_dump.py pipeline
+    demo = _run("--demo", "--json")
+    merged = json.loads(demo)
+    # reconstruct the /trace.json shape from the doc
+    snap = {"replicas": merged["replicas"],
+            "recorded": merged["recorded"],
+            "dropped": merged["dropped"],
+            "spans": [s for t in merged["traces"] for s in t["spans"]]}
+    out = _run("--json", stdin=json.dumps(snap))
+    doc = json.loads(out)
+    assert doc["schema"] == "trace_dump/1"
+    assert doc["span_count"] == merged["span_count"]

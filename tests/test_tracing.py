@@ -1,0 +1,270 @@
+"""Distributed request tracing (ISSUE 16): the wire trace header, the
+bounded flight recorder, and the fleet round trip — one sampled request
+submitted at the router front door must come back from
+``Router.fleet_trace()`` as a single trace_id whose spans were recorded
+by THREE processes (client/router, worker, server stages) in
+near-monotonic waterfall order, and a SIGKILL mid-flight must not break
+the trace (the requeued request re-dispatches with its header intact).
+
+Off-by-default is load-bearing: at sample rate 0 the wire bytes are
+byte-identical to the pre-trace form and the recorder never grows."""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu import observability as obs
+from paddle_tpu.inference import Predictor
+from paddle_tpu.observability import tracing
+from paddle_tpu.serving import Router, wire
+
+
+@pytest.fixture(autouse=True)
+def trace_isolation():
+    """Every test starts with an empty ring, no rid bindings, and
+    sampling OFF — and cannot leak a nonzero rate into the suite."""
+    tracing.reset()
+    tracing.set_sample_rate(0.0)
+    yield
+    tracing.set_sample_rate(0.0)
+    tracing.reset()
+
+
+@pytest.fixture(scope="module")
+def model(tmp_path_factory):
+    """Saved 4->8->6 softmax MLP + feed rows (the fleet-test fixture)."""
+    model_dir = str(tmp_path_factory.mktemp("trace_model"))
+    mp, sp = fluid.Program(), fluid.Program()
+    mp.random_seed = sp.random_seed = 11
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope), fluid.program_guard(mp, sp):
+        with fluid.unique_name.guard():
+            x = layers.data(name="x", shape=[4])
+            h = layers.fc(x, 8, act="relu")
+            out = layers.fc(h, 6, act="softmax")
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(sp)
+        fluid.io.save_inference_model(model_dir, ["x"], [out], exe,
+                                      main_program=mp, scope=scope)
+    feed = np.linspace(-1, 1, 5 * 4).reshape(5, 4).astype(np.float32)
+    want, = Predictor(model_dir).run({"x": feed})
+    return model_dir, feed, np.asarray(want)
+
+
+# -- wire header ----------------------------------------------------------
+
+def test_pack_read_trace_roundtrip():
+    frame = b"\x01payload-bytes"
+    tid = tracing.new_trace_id()
+    wrapped = wire.pack_trace(frame, tid)
+    got_tid, rest = wire.read_trace(wrapped)
+    assert got_tid == tid
+    assert bytes(rest) == frame
+    # canonical nesting Q(T(frame)): SLO outermost, read in parse order
+    q = wire.pack_slo(wire.pack_trace(frame, tid), 1, None, "standard")
+    prio, deadline, klass, inner = wire.read_slo(q)
+    assert (prio, klass) == (1, "standard")
+    tid2, bare = wire.read_trace(inner)
+    assert tid2 == tid and bytes(bare) == frame
+
+
+def test_bare_frame_passes_through_untouched():
+    # a pre-trace frame is valid byte for byte: no header, no copy
+    frame = b"\x07bare"
+    tid, rest = wire.read_trace(frame)
+    assert tid is None and rest is frame
+
+
+def test_trace_header_malformed_raises():
+    tid = "ab12cd34ef56ab78"
+    wrapped = wire.pack_trace(b"frame", tid)
+    with pytest.raises(wire.WireError):
+        wire.read_trace(wrapped[:3])  # truncated id
+    with pytest.raises(wire.WireError):
+        wire.read_trace(b"T\x00")     # zero-length id
+    with pytest.raises(ValueError):
+        wire.pack_trace(b"frame", "")
+    with pytest.raises(ValueError):
+        wire.pack_trace(b"frame", "x" * 256)
+
+
+def test_off_by_default_wire_is_byte_identical():
+    # sampling off: maybe_start mints nothing, so submit() never wraps —
+    # the wire form is EXACTLY the pre-trace bytes (the acceptance
+    # criterion that makes tracing free when unused)
+    assert tracing.sample_rate() == 0.0
+    assert tracing.maybe_start() is None
+    assert not tracing.sampled()
+    n0 = len(tracing.snapshot()["spans"])
+    assert n0 == 0  # isolation fixture emptied the ring; nothing recorded
+
+
+# -- the recorder ---------------------------------------------------------
+
+def test_recorder_ring_is_bounded():
+    rec = tracing.TraceRecorder(capacity=8)
+    for i in range(20):
+        rec.record("t1", "span%d" % i, dur_ms=1.0)
+    snap = rec.snapshot()
+    assert len(snap["spans"]) == 8
+    assert snap["recorded"] == 20 and snap["dropped"] == 12
+    # the survivors are the NEWEST spans (ring semantics)
+    assert [s["name"] for s in snap["spans"]] == \
+        ["span%d" % i for i in range(12, 20)]
+
+
+def test_record_span_defaults_ts_to_span_start():
+    rec = tracing.TraceRecorder(capacity=4)
+    t0 = time.time()
+    rec.record("t1", "phase", dur_ms=1000.0)
+    s = rec.snapshot()["spans"][0]
+    # ts = now - dur: the span STARTED about a second ago
+    assert t0 - 1.2 <= s["ts"] <= t0 - 0.8 + 0.2
+
+
+def test_merge_snapshots_stamps_replicas_and_sorts():
+    a = {"recorded": 2, "dropped": 0, "replica": "",
+         "spans": [{"trace_id": "t2", "name": "late", "ts": 5.0,
+                    "dur_ms": 0, "seq": 0},
+                   {"trace_id": "t1", "name": "first", "ts": 1.0,
+                    "dur_ms": 0, "seq": 1}]}
+    b = {"recorded": 1, "dropped": 3, "replica": "w0",
+         "spans": [{"trace_id": "t1", "name": "second", "ts": 2.0,
+                    "dur_ms": 0, "seq": 0}]}
+    merged = tracing.merge_snapshots([a, b])
+    assert merged["replicas"] == ["router", "w0"]
+    assert merged["recorded"] == 3 and merged["dropped"] == 3
+    names = [s["name"] for s in merged["spans"]]
+    assert names == ["first", "second", "late"]  # (trace_id, ts) order
+    assert merged["spans"][0]["replica"] == "router"
+    assert merged["spans"][1]["replica"] == "w0"
+
+
+def test_rid_binding_table():
+    assert not tracing.bound()
+    assert tracing.rid_trace(7) is None  # falsy fast path, no lock
+    tracing.bind_rid(7, "tid7")
+    assert tracing.bound()
+    tracing.rid_span(7, "stage", dur_ms=2.0, rows=3)
+    tracing.rid_span(8, "stage")  # unbound rid: silently nothing
+    assert tracing.pop_rid(7) == "tid7"
+    assert not tracing.bound()
+    spans = tracing.snapshot()["spans"]
+    assert [s["name"] for s in spans] == ["stage"]
+    assert spans[0]["trace_id"] == "tid7" and spans[0]["rows"] == 3
+
+
+# -- fleet round trip (the ISSUE-16 acceptance test) ----------------------
+
+def test_fleet_round_trip_one_trace_across_processes(model):
+    """client -> router queue -> dispatch -> worker recv -> stacking ->
+    device -> reply, all under ONE trace_id, spans from the router
+    process AND a worker subprocess, in near-monotonic ts order."""
+    model_dir, feed, want = model
+    router = Router(model_dir, replicas=2, max_batch=4,
+                    jax_platform="cpu", start_timeout=300)
+    tracing.set_sample_rate(1.0)
+    try:
+        router.start()
+        futs = [router.submit((feed[i % 5],)) for i in range(6)]
+        for i, fut in enumerate(futs):
+            row, = fut.result(timeout=120)
+            np.testing.assert_allclose(row, want[i % 5], rtol=1e-4,
+                                       atol=1e-5)
+        merged = router.fleet_trace()
+    finally:
+        tracing.set_sample_rate(0.0)
+        router.stop()
+
+    by_tid = {}
+    for s in merged["spans"]:
+        by_tid.setdefault(s["trace_id"], []).append(s)
+    # every submit minted its own trace at rate 1.0
+    request_traces = {tid: spans for tid, spans in by_tid.items()
+                      if any(s["name"] == "client.submit" for s in spans)}
+    assert len(request_traces) == 6, sorted(by_tid)
+
+    waterfall = ["client.submit", "router.queue", "router.dispatch",
+                 "worker.recv", "server.stack", "server.device",
+                 "worker.reply", "router.reply"]
+    full = 0
+    for tid, spans in request_traces.items():
+        names = [s["name"] for s in spans]
+        assert names.count("client.submit") == 1
+        assert names.count("router.reply") == 1
+        if set(waterfall) <= set(names):
+            full += 1
+            # the router-side spans and the worker-side spans came from
+            # different PROCESSES, merged over the control pipe
+            replicas = {s["replica"] for s in spans}
+            assert "router" in replicas
+            assert replicas - {"router"}, replicas  # >=1 worker process
+            # near-monotonic: each successive waterfall stage STARTS no
+            # earlier than the one before it (shared machine clock;
+            # 50 ms tolerance for clock granularity between processes)
+            starts = {}
+            for s in spans:
+                if s["name"] not in starts:
+                    starts[s["name"]] = s["ts"]
+            order = [starts[n] for n in ("client.submit", "router.queue",
+                                         "router.dispatch", "worker.recv",
+                                         "server.device", "router.reply")]
+            for a, b in zip(order, order[1:]):
+                assert b >= a - 0.05, (tid, order)
+    # every request that was served end to end carries the full
+    # waterfall (all 6 were — each got a result above)
+    assert full == 6, "only %d/6 traces carried the full waterfall" % full
+
+    # completed requests folded into the per-phase histogram (the
+    # router-side phases live in THIS process's registry; stack/device
+    # fold in the worker processes and arrive via fleet_metrics)
+    for phase in ("queue", "service", "total"):
+        assert obs.REQUEST_PHASE_MS.stats(phase=phase)["count"] >= 6, phase
+
+
+def test_crash_requeue_keeps_trace_alive(model):
+    """SIGKILL a replica with traced requests in flight: requeued
+    frames still carry their T header (req.raw is resent verbatim), so
+    the re-dispatch lands under the SAME trace_id and every trace that
+    recorded a requeue still completes with a router.reply."""
+    model_dir, feed, want = model
+    router = Router(model_dir, replicas=2, max_batch=4,
+                    jax_platform="cpu", start_timeout=300)
+    tracing.set_sample_rate(1.0)
+    try:
+        router.start()
+        futs = [router.submit((feed[i % 5],)) for i in range(40)]
+        router._workers[0].proc.kill()  # hard SIGKILL, no drain
+        for i, fut in enumerate(futs):
+            row, = fut.result(timeout=120)
+            np.testing.assert_allclose(row, want[i % 5], rtol=1e-4,
+                                       atol=1e-5)
+        merged = router.fleet_trace()
+    finally:
+        tracing.set_sample_rate(0.0)
+        router.stop()
+
+    by_tid = {}
+    for s in merged["spans"]:
+        by_tid.setdefault(s["trace_id"], []).append(s)
+    requeued = {tid: spans for tid, spans in by_tid.items()
+                if any(s["name"] == "router.requeue" for s in spans)}
+    # the kill either caught frames in flight (requeued traces exist)
+    # or landed between batches — both legal (the fleet-test stance);
+    # the invariant is zero losses, asserted via fut.result above. For
+    # every trace the crash DID touch, the story must be complete:
+    for tid, spans in requeued.items():
+        names = [s["name"] for s in spans]
+        # re-dispatched after the requeue... (second dispatch span)
+        assert names.count("router.dispatch") >= 2, names
+        # ...and answered (by the survivor; the victim's ring died
+        # with it, so its worker-side spans are legitimately absent)
+        assert "router.reply" in names, names
+    # and every traced request completed, requeued or not
+    replies = sum(1 for spans in by_tid.values()
+                  for s in spans if s["name"] == "router.reply")
+    assert replies == 40

@@ -346,7 +346,7 @@ def test_full_chaos_latency_curve(model):
              if ln.startswith("{")]
     assert len(lines) == 2
     for r in lines:
-        assert r["schema"] == "loadgen/1"
+        assert r["schema"] == "loadgen/2"
         assert r["ok"] is True, r
         assert r["dropped"] == 0 and r["errors"] == 0
         assert r["sheds_all_rejected"] is True

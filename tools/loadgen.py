@@ -1,4 +1,4 @@
-"""Traffic generator + SLO verdict for the serving fleet (loadgen/1).
+"""Traffic generator + SLO verdict for the serving fleet (loadgen/2).
 
 The fleet's latency contract is only as real as the traffic it was
 proven under. This tool generates that traffic against a live Router —
@@ -9,8 +9,15 @@ diurnal ramps, bursts, and heavy-tail per-arrival fan-out, with every
 request submitted under an SLO class (priority + deadline). It records
 per-class latency percentiles, every structured shed reject, and the
 fleet counters, and emits ONE JSON verdict line per run (schema
-``loadgen/1``; ``--curve`` sweeps offered load and emits one line per
+``loadgen/2``; ``--curve`` sweeps offered load and emits one line per
 level — the latency-vs-offered-load curve for PERF_NOTES).
+
+loadgen/2 adds ``trace_phases``: per-phase p50/p99 latency attribution
+pulled from the distributed-tracing flight recorder
+(``router.fleet_trace()``), keyed by span name (router.queue,
+server.device, worker.reply, ...). It is ``{}`` unless sampling is
+armed (``--trace-sample`` / ``PADDLE_TPU_TRACE_SAMPLE``) — the verdict
+costs nothing when tracing is off. All loadgen/1 fields are unchanged.
 
 Traffic is scripted: ``--shape steady|burst|diurnal`` builds a trace,
 ``--trace FILE`` loads one:
@@ -51,7 +58,7 @@ from typing import Callable, Dict, List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-SCHEMA = "loadgen/1"
+SCHEMA = "loadgen/2"
 
 DEFAULT_CLASSES = {
     "interactive": {"priority": 0, "deadline_ms": None, "weight": 0.7},
@@ -394,6 +401,22 @@ def run_trace(router, trace: Dict, next_sample: Callable, seed: int = 0,
     # the shed counter and the rejects the clients saw must agree
     report["sheds_all_rejected"] = (
         report["fleet"]["shed_total"] == report["rejected"])
+    # loadgen/2: per-phase latency attribution from the fleet's trace
+    # recorders — WHERE the p99 went (queue vs device vs stacking), not
+    # just how big it was. Empty unless sampling is armed.
+    phase_ms: Dict[str, List[float]] = {}
+    fleet_trace = getattr(router, "fleet_trace", None)
+    if fleet_trace is not None:
+        try:
+            for s in fleet_trace(timeout=10.0).get("spans", ()):
+                phase_ms.setdefault(s["name"], []).append(
+                    float(s.get("dur_ms", 0.0)))
+        except Exception:
+            pass
+    report["trace_phases"] = {
+        name: {"count": len(xs),
+               "p50_ms": _pctl(xs, 50), "p99_ms": _pctl(xs, 99)}
+        for name, xs in sorted(phase_ms.items())}
     return report
 
 
@@ -455,6 +478,10 @@ def main():
     ap.add_argument("--curve", metavar="RPS,RPS,...",
                     help="sweep offered load, one loadgen/1 line per "
                          "level (the latency-vs-offered-load curve)")
+    ap.add_argument("--trace-sample", type=float, default=None,
+                    metavar="RATE", help="arm distributed tracing at "
+                    "this sample rate (0..1); fills the verdict's "
+                    "trace_phases attribution")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--result-timeout", type=float, default=120.0)
     ap.add_argument("--start-timeout", type=float, default=300.0)
@@ -476,6 +503,12 @@ def main():
         trace["classes"]["interactive"]["deadline_ms"] = args.deadline_ms
 
     from paddle_tpu.serving import Autoscaler, Router
+
+    if args.trace_sample is not None:
+        # the ONE sampling decision lives at the client edge (here);
+        # workers record on header arrival and need no configuration
+        from paddle_tpu.observability import tracing
+        tracing.set_sample_rate(args.trace_sample)
 
     levels = ([float(x) for x in args.curve.split(",")] if args.curve
               else [None])

@@ -254,6 +254,41 @@ def shed_round():
         pass
 
 
+def trace_round():
+    """One fully-sampled request through the REAL client edge + shed
+    path (the shed_round no-process trick), so the ISSUE-16 tracing
+    exposition ships through the same pinned format: exactly one
+    ``paddle_tpu_trace_spans_total`` tick each for phase="client.submit"
+    and phase="router.shed", and exactly one
+    ``paddle_tpu_request_phase_ms`` sample in phase="queue" (a shed
+    request's whole life). Submitted under class "batch" so the
+    shed_round's pinned ``{class="interactive"} 1`` line stays exact.
+    Sampling is forced to 1.0 for this round only — every other round
+    runs untraced, as a default-config process would."""
+    import numpy as np
+
+    from paddle_tpu.observability import tracing
+    from paddle_tpu.serving import RejectedError, Router
+
+    tracing.set_sample_rate(1.0)
+    try:
+        router = Router("/nonexistent-model-dir", replicas=1)
+        fut = router.submit((np.zeros(2, np.float32),), slo="batch",
+                            deadline_ms=5000)
+        # drive the dispatch-side parse + shed by hand: no worker
+        # processes, same real code paths the fleet runs
+        msgs = router._chan.recv_batch(1, 1.0)
+        req = router._parse_request(msgs[0])
+        assert req.trace_id is not None, "sampled request lost its id"
+        router._shed(req, "expired")
+        try:
+            fut.result(timeout=1)
+        except RejectedError:
+            pass
+    finally:
+        tracing.set_sample_rate(0.0)
+
+
 def merge_dumps(paths):
     """Load each JSON dump and print the aggregated snapshot. Stays off
     the jax import path ENTIRELY: merging is pure dict arithmetic
@@ -314,6 +349,7 @@ def main():
     tiny_train_loop(args.steps)
     shed_round()
     swap_round()
+    trace_round()
     if not args.no_predict:
         import tempfile
 
